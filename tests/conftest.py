@@ -1,0 +1,16 @@
+"""Test bootstrap: force an 8-device virtual CPU mesh before JAX loads.
+
+Mirrors the reference's "multi-node-without-a-cluster" unit strategy
+(SURVEY.md §4): distributed semantics are exercised in-process. For the
+workload plane that means a virtual 8-device mesh on CPU; for the
+control plane it means the InMemorySubstrate fake.
+"""
+
+import os
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = (
+        flags + " --xla_force_host_platform_device_count=8"
+    ).strip()

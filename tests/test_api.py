@@ -1,0 +1,264 @@
+"""API-layer tests: serde round-trip, defaulting, validation.
+
+Modeled on reference pkg/apis/tensorflow/v1/defaults_test.go and
+pkg/apis/tensorflow/validation/validation_test.go.
+"""
+
+import pytest
+
+from tf_operator_tpu.api import k8s, set_defaults, types as t, validate
+from tf_operator_tpu.api.defaults import normalize_replica_type
+from tf_operator_tpu.api.serde import deep_copy
+from tf_operator_tpu.api.validation import ValidationError, expected_hosts, is_valid
+
+
+def make_job(replica_specs=None, name="test-job", namespace="default"):
+    job = t.TFJob(metadata=k8s.ObjectMeta(name=name, namespace=namespace, uid="uid-1"))
+    for key, replicas in (replica_specs or {"Worker": 1}).items():
+        job.spec.tf_replica_specs[key] = t.ReplicaSpec(
+            replicas=replicas,
+            template=k8s.PodTemplateSpec(
+                spec=k8s.PodSpec(
+                    containers=[k8s.Container(name="tensorflow", image="busybox")]
+                )
+            ),
+        )
+    return job
+
+
+class TestSerde:
+    def test_round_trip(self):
+        job = make_job({"Worker": 4, "PS": 2})
+        job.spec.run_policy.backoff_limit = 3
+        job.spec.run_policy.clean_pod_policy = t.CleanPodPolicy.ALL
+        data = job.to_dict()
+        # RunPolicy fields inline on spec, like the reference wire format.
+        assert data["spec"]["backoffLimit"] == 3
+        assert data["spec"]["cleanPodPolicy"] == "All"
+        assert "runPolicy" not in data["spec"]
+        back = t.TFJob.from_dict(data)
+        assert back.spec.run_policy.backoff_limit == 3
+        assert back.spec.run_policy.clean_pod_policy == t.CleanPodPolicy.ALL
+        assert back.num_replicas(t.ReplicaType.WORKER) == 4
+        assert back.to_dict() == data
+
+    def test_unknown_fields_survive(self):
+        data = {
+            "apiVersion": "kubeflow.org/v1",
+            "kind": "TFJob",
+            "metadata": {"name": "j", "namespace": "ns"},
+            "spec": {
+                "tfReplicaSpecs": {
+                    "Worker": {
+                        "replicas": 1,
+                        "template": {
+                            "spec": {
+                                "containers": [
+                                    {
+                                        "name": "tensorflow",
+                                        "image": "img",
+                                        "volumeMounts": [{"name": "v", "mountPath": "/x"}],
+                                    }
+                                ],
+                                "volumes": [{"name": "v"}],
+                            }
+                        },
+                    }
+                }
+            },
+        }
+        job = t.TFJob.from_dict(data)
+        out = job.to_dict()
+        spec = out["spec"]["tfReplicaSpecs"]["Worker"]["template"]["spec"]
+        assert spec["volumes"] == [{"name": "v"}]
+        assert spec["containers"][0]["volumeMounts"][0]["mountPath"] == "/x"
+
+    def test_deep_copy_isolated(self):
+        job = make_job()
+        clone = job.copy()
+        clone.spec.tf_replica_specs["Worker"].replicas = 9
+        assert job.spec.tf_replica_specs["Worker"].replicas == 1
+
+    def test_pod_round_trip(self):
+        pod = k8s.Pod(
+            metadata=k8s.ObjectMeta(name="p", labels={"a": "b"}),
+            spec=k8s.PodSpec(containers=[k8s.Container(name="tensorflow", image="i")]),
+            status=k8s.PodStatus(phase=k8s.POD_RUNNING),
+        )
+        clone = deep_copy(pod)
+        assert clone.status.phase == k8s.POD_RUNNING
+        clone.metadata.labels["a"] = "c"
+        assert pod.metadata.labels["a"] == "b"
+
+
+class TestDefaults:
+    def test_replicas_and_restart_policy(self):
+        job = make_job()
+        job.spec.tf_replica_specs["Worker"].replicas = None
+        set_defaults(job)
+        spec = job.spec.tf_replica_specs["Worker"]
+        assert spec.replicas == 1
+        assert spec.restart_policy == t.RestartPolicy.NEVER
+        assert job.spec.run_policy.clean_pod_policy == t.CleanPodPolicy.RUNNING
+
+    def test_default_port_appended(self):
+        job = make_job()
+        set_defaults(job)
+        ports = job.spec.tf_replica_specs["Worker"].template.spec.containers[0].ports
+        assert any(
+            p.name == t.DEFAULT_PORT_NAME and p.container_port == t.DEFAULT_PORT
+            for p in ports
+        )
+        # idempotent
+        set_defaults(job)
+        assert len([p for p in ports if p.name == t.DEFAULT_PORT_NAME]) == 1
+
+    def test_case_normalization(self):
+        # reference defaults_test.go:120 (setTypeNamesToCamelCase)
+        job = make_job({"worker": 2, "ps": 1, "MASTER": 1})
+        set_defaults(job)
+        assert set(job.spec.tf_replica_specs) == {"Worker", "PS", "Master"}
+        assert normalize_replica_type("evaluator") == "Evaluator"
+        assert normalize_replica_type("tpu") == "TPU"
+
+    def test_tpu_defaults(self):
+        job = make_job({"TPU": 2})
+        spec = job.spec.tf_replica_specs["TPU"]
+        spec.tpu_accelerator = "v5e-8"
+        spec.tpu_topology = "2x4"
+        set_defaults(job)
+        pod_spec = spec.template.spec
+        assert pod_spec.node_selector[t.GKE_TPU_ACCELERATOR_SELECTOR] == "v5e-8"
+        assert pod_spec.node_selector[t.GKE_TPU_TOPOLOGY_SELECTOR] == "2x4"
+        res = pod_spec.containers[0].resources
+        assert res.limits[t.TPU_RESOURCE_KEY] == 4
+
+
+class TestValidation:
+    def test_valid_job(self):
+        validate(make_job({"Worker": 2, "PS": 1, "Chief": 1}))
+
+    def test_empty_specs(self):
+        with pytest.raises(ValidationError, match="tfReplicaSpecs"):
+            validate(t.TFJob())
+
+    def test_no_containers(self):
+        job = make_job()
+        job.spec.tf_replica_specs["Worker"].template.spec.containers = []
+        with pytest.raises(ValidationError, match="containers"):
+            validate(job)
+
+    def test_missing_image(self):
+        job = make_job()
+        job.spec.tf_replica_specs["Worker"].template.spec.containers[0].image = ""
+        with pytest.raises(ValidationError, match="image"):
+            validate(job)
+
+    def test_wrong_container_name(self):
+        job = make_job()
+        job.spec.tf_replica_specs["Worker"].template.spec.containers[0].name = "main"
+        with pytest.raises(ValidationError, match="tensorflow"):
+            validate(job)
+
+    def test_chief_and_master_conflict(self):
+        with pytest.raises(ValidationError, match="Chief/Master"):
+            validate(make_job({"Chief": 1, "Master": 1, "Worker": 1}))
+
+    def test_multiple_evaluator_replicas(self):
+        with pytest.raises(ValidationError, match="Evaluator"):
+            validate(make_job({"Worker": 1, "Evaluator": 2}))
+
+    def test_invalid_replica_type(self):
+        assert not is_valid(make_job({"Gardener": 1}))
+
+    def test_wrong_json_type_rejected_at_parse(self):
+        # Bad specs fail at admission instead of crashing the controller
+        # later (reference informer.go:82-105 / kubeflow#561 rationale).
+        with pytest.raises(TypeError, match="expected int"):
+            t.TFJob.from_dict(
+                {"spec": {"tfReplicaSpecs": {"Worker": {"replicas": "two"}}}}
+            )
+
+    def test_nil_replica_spec_reported_not_crashed(self):
+        job = t.TFJob.from_dict(
+            {"metadata": {"name": "j"}, "spec": {"tfReplicaSpecs": {"Worker": None}}}
+        )
+        set_defaults(job)
+        with pytest.raises(ValidationError, match="nil"):
+            validate(job)
+
+    def test_tpu_topology_checks(self):
+        job = make_job({"TPU": 2})
+        spec = job.spec.tf_replica_specs["TPU"]
+        spec.tpu_accelerator = "v5e-8"
+        spec.tpu_topology = "2x4"
+        validate(job)  # 8 chips / 4 per host = 2 hosts = 2 replicas: ok
+        spec.replicas = 3
+        with pytest.raises(ValidationError, match="slice"):
+            validate(job)
+        spec.replicas = 2
+        spec.tpu_topology = "bogus"
+        with pytest.raises(ValidationError, match="tpuTopology"):
+            validate(job)
+
+    def test_tpu_gpu_mixing_rejected(self):
+        job = make_job({"TPU": 1})
+        spec = job.spec.tf_replica_specs["TPU"]
+        spec.template.spec.containers[0].resources = k8s.ResourceRequirements(
+            limits={"nvidia.com/gpu": 1}
+        )
+        with pytest.raises(ValidationError, match="mix"):
+            validate(job)
+
+    def test_expected_hosts(self):
+        assert expected_hosts("v5e-8", "2x4") == 2
+        assert expected_hosts("v5e-4", "2x2") == 1
+        assert expected_hosts("v5e-256", "16x16") == 64
+        assert expected_hosts("v4-8", "2x2x1") == 1
+        assert expected_hosts("v3-8", "2x2x2") == 1  # v3 hosts have 8 chips
+        with pytest.raises(ValidationError, match="multiple"):
+            expected_hosts("v5e-6", "2x3")  # 6 chips not divisible by 4/host
+
+    def test_accelerator_topology_chip_mismatch(self):
+        job = make_job({"TPU": 64})
+        spec = job.spec.tf_replica_specs["TPU"]
+        spec.tpu_accelerator = "v5e-8"  # 8 chips declared...
+        spec.tpu_topology = "16x16"  # ...but 256-chip topology
+        with pytest.raises(ValidationError, match="declares 8 chips"):
+            validate(job)
+
+    def test_accessors_tolerate_unknown_keys(self):
+        job = make_job({"Gardener": 3, "Worker": 2})
+        assert job.replica_types() == [t.ReplicaType.WORKER]
+        assert job.total_replicas() == 2
+
+    def test_tpu_chip_default_matches_generation(self):
+        job = make_job({"TPU": 1})
+        spec = job.spec.tf_replica_specs["TPU"]
+        spec.tpu_accelerator = "v3-8"
+        spec.tpu_topology = "2x2x2"
+        set_defaults(job)
+        res = spec.template.spec.containers[0].resources
+        assert res.limits[t.TPU_RESOURCE_KEY] == 8  # v3 host = 8 chips
+
+
+class TestExitCodes:
+    # reference pkg/util/train/train_util.go:18-53
+    def test_retryable(self):
+        for code in (130, 137, 138, 143):
+            assert t.is_retryable_exit_code(code)
+
+    def test_permanent(self):
+        for code in (1, 2, 126, 127, 128, 139, 3, 42, 255):
+            assert not t.is_retryable_exit_code(code)
+
+
+class TestNaming:
+    def test_replica_name(self):
+        assert t.replica_name("mnist", "Worker", 0) == "mnist-worker-0"
+        assert t.replica_name("mnist", "PS", 3) == "mnist-ps-3"
+
+    def test_gen_labels(self):
+        labels = t.gen_labels("my/job")
+        assert labels[t.LABEL_JOB_NAME] == "my-job"
+        assert labels[t.LABEL_GROUP_NAME] == t.GROUP_NAME

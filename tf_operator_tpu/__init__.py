@@ -1,0 +1,21 @@
+"""tf_operator_tpu: a TPU-native distributed-training job framework.
+
+A ground-up rebuild of the Kubeflow TFJob operator (reference:
+davidlicug/tf-operator) for TPU pod slices, in two planes:
+
+- **Control plane** (`api/`, `runtime/`, `controller/`, `server/`,
+  `sdk/`): a TFJob-compatible CRD model and reconciler that creates
+  pods + headless services per replica role, enforces the full policy
+  matrix (restart/exit-code, backoff, deadline, TTL, clean-pod, success
+  policies, dynamic workers, gang scheduling), and injects TPU pod-slice
+  environment (`TPU_WORKER_ID`/`TPU_WORKER_HOSTNAMES`/topology) instead
+  of — or alongside — `TF_CONFIG`.
+
+- **Workload plane** (`models/`, `ops/`, `parallel/`, `train/`): the
+  part the reference delegated to user TF containers, rebuilt
+  TPU-first: `jax.distributed` bootstrap from the injected env, pjit
+  meshes over ICI/DCN, reference models (MNIST, ResNet-50, BERT),
+  pallas kernels, orbax checkpointing.
+"""
+
+__version__ = "0.1.0"

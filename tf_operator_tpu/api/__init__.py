@@ -1,0 +1,5 @@
+from . import k8s, serde, types
+from .defaults import set_defaults
+from .validation import ValidationError, is_valid, validate
+
+__all__ = ["k8s", "serde", "types", "set_defaults", "validate", "is_valid", "ValidationError"]

@@ -1,0 +1,93 @@
+"""Defaulting for TFJob, mirroring reference pkg/apis/tensorflow/v1/defaults.go.
+
+Applied at admission (reference job.go:91 calls scheme defaulting before
+any reconcile): replica-type key normalization, replicas -> 1,
+restartPolicy -> Never, cleanPodPolicy -> Running, and the default
+tfjob-port 2222 appended to the workload container if absent
+(defaults.go:36-113).
+
+TPU additions: a TPU replica set defaults its pod spec's node selectors
+from tpuAccelerator/tpuTopology and requests one google.com/tpu chip per
+pod if no explicit TPU resource is set.
+"""
+
+from __future__ import annotations
+
+from typing import Dict
+
+from . import types as t
+from .k8s import Container, ContainerPort, ResourceRequirements
+from .validation import chips_per_host
+
+# Canonical spellings for case-insensitive replica-type keys
+# (reference defaults.go:63-77 setTypeNamesToCamelCase).
+_CANONICAL = {rt.value.lower(): rt.value for rt in t.ReplicaType}
+
+
+def normalize_replica_type(key: str) -> str:
+    return _CANONICAL.get(key.lower(), key)
+
+
+def _set_default_port(container: Container) -> None:
+    """Append tfjob-port 2222 if the workload container declares no port
+    with that name (reference defaults.go:36-51 setDefaultPort)."""
+    for port in container.ports:
+        if port.name == t.DEFAULT_PORT_NAME:
+            return
+    container.ports.append(
+        ContainerPort(name=t.DEFAULT_PORT_NAME, container_port=t.DEFAULT_PORT)
+    )
+
+
+def _set_tpu_defaults(spec: t.ReplicaSpec) -> None:
+    pod_spec = spec.template.spec
+    if spec.tpu_accelerator:
+        pod_spec.node_selector.setdefault(
+            t.GKE_TPU_ACCELERATOR_SELECTOR, spec.tpu_accelerator
+        )
+    if spec.tpu_topology:
+        pod_spec.node_selector.setdefault(t.GKE_TPU_TOPOLOGY_SELECTOR, spec.tpu_topology)
+    container = pod_spec.container(t.DEFAULT_CONTAINER_NAME)
+    if container is None:
+        return
+    if container.resources is None:
+        container.resources = ResourceRequirements()
+    res = container.resources
+    if t.TPU_RESOURCE_KEY not in res.limits and t.TPU_RESOURCE_KEY not in res.requests:
+        # One host's worth of chips: a TPU pod must claim every chip on
+        # its host VM, and the count varies by generation (v2/v3: 8,
+        # v4/v5e/v5p/v6e: 4).
+        chips = chips_per_host(spec.tpu_accelerator or "v5e")
+        res.limits[t.TPU_RESOURCE_KEY] = chips
+        res.requests[t.TPU_RESOURCE_KEY] = chips
+
+
+def set_defaults(job: t.TFJob) -> t.TFJob:
+    """Default a TFJob in place (and return it).
+
+    Mirrors SetDefaults_TFJob (reference defaults.go:92-113).
+    """
+    spec = job.spec
+    if spec.run_policy.clean_pod_policy is None:
+        spec.run_policy.clean_pod_policy = t.CleanPodPolicy.RUNNING
+    if spec.success_policy is None:
+        spec.success_policy = t.SuccessPolicy.DEFAULT
+
+    normalized: Dict[str, t.ReplicaSpec] = {}
+    for key, rspec in spec.tf_replica_specs.items():
+        normalized[normalize_replica_type(key)] = rspec
+    spec.tf_replica_specs = normalized
+
+    for key, rspec in spec.tf_replica_specs.items():
+        if rspec is None:
+            continue  # validation reports nil specs; don't crash here
+        if rspec.replicas is None:
+            rspec.replicas = 1
+        if rspec.restart_policy is None:
+            rspec.restart_policy = t.RestartPolicy.NEVER
+        container = rspec.template.spec.container(t.DEFAULT_CONTAINER_NAME)
+        if container is not None:
+            _set_default_port(container)
+        if key == t.ReplicaType.TPU.value:
+            _set_tpu_defaults(rspec)
+    return job

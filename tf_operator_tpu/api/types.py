@@ -1,0 +1,332 @@
+"""TFJob API types (tpu.kubeflow.org/v1, wire-compatible with kubeflow.org/v1).
+
+Re-designed from the reference's API layer:
+  - pkg/apis/tensorflow/v1/types.go:27-127 (TFJob/TFJobSpec/TFReplicaType)
+  - vendor/github.com/kubeflow/common/pkg/apis/common/v1/types.go:24-201
+    (ReplicaSpec, JobStatus, JobCondition, RestartPolicy, CleanPodPolicy,
+    RunPolicy, SchedulingPolicy)
+  - pkg/apis/tensorflow/v1/common.go:17-23 (SuccessPolicy)
+  - pkg/apis/tensorflow/v1/constants.go (ports, container name)
+
+New in this framework: the ``TPU`` replica type, per-job TPU topology
+(``tpuTopology``/``tpuAccelerator`` on the replica spec), and the
+``google.com/tpu`` resource key, per the north-star in BASELINE.json.
+"""
+
+from __future__ import annotations
+
+import enum
+from dataclasses import dataclass, field
+from typing import Any, Dict, List, Optional
+
+from .k8s import ObjectMeta, PodTemplateSpec
+from .serde import from_jsonable, to_jsonable
+
+# --- Group / version / kind -------------------------------------------------
+
+GROUP_NAME = "kubeflow.org"
+VERSION = "v1"
+KIND = "TFJob"
+PLURAL = "tfjobs"
+SINGULAR = "tfjob"
+API_VERSION = f"{GROUP_NAME}/{VERSION}"
+
+# --- Constants (reference pkg/apis/tensorflow/v1/constants.go) --------------
+
+DEFAULT_PORT_NAME = "tfjob-port"
+DEFAULT_CONTAINER_NAME = "tensorflow"
+DEFAULT_PORT = 2222
+
+# TPU resource/env vocabulary (new; north-star BASELINE.json).
+TPU_RESOURCE_KEY = "google.com/tpu"
+GKE_TPU_ACCELERATOR_SELECTOR = "cloud.google.com/gke-tpu-accelerator"
+GKE_TPU_TOPOLOGY_SELECTOR = "cloud.google.com/gke-tpu-topology"
+
+# Env injected into workload containers.
+ENV_TF_CONFIG = "TF_CONFIG"
+ENV_TPU_WORKER_ID = "TPU_WORKER_ID"
+ENV_TPU_WORKER_HOSTNAMES = "TPU_WORKER_HOSTNAMES"
+ENV_TPU_TOPOLOGY = "TPU_TOPOLOGY"
+ENV_TPU_ACCELERATOR = "TPU_ACCELERATOR_TYPE"
+ENV_COORDINATOR_ADDRESS = "JAX_COORDINATOR_ADDRESS"
+ENV_NUM_PROCESSES = "JAX_NUM_PROCESSES"
+ENV_PROCESS_ID = "JAX_PROCESS_ID"
+ENV_CUSTOM_CLUSTER_DOMAIN = "CUSTOM_CLUSTER_DOMAIN"
+
+# Label keys stamped on child pods/services.
+# Reference: jobcontroller.go:139-143, controller.go:55-56, GenLabels
+# jobcontroller.go:211-222.
+LABEL_GROUP_NAME = "group-name"
+LABEL_JOB_NAME = "job-name"
+LABEL_TF_JOB_NAME = "tf-job-name"  # deprecated twin kept for compat
+LABEL_REPLICA_TYPE = "tf-replica-type"
+LABEL_REPLICA_INDEX = "tf-replica-index"
+LABEL_JOB_ROLE = "job-role"
+
+# Gang-scheduling annotation consumed by kube-batch/volcano
+# (reference pod.go:224-229).
+ANNOTATION_GANG_GROUP = "scheduling.k8s.io/group-name"
+
+
+class ReplicaType(str, enum.Enum):
+    """Replica roles. Reference types.go:88-110, plus the new TPU role."""
+
+    PS = "PS"
+    WORKER = "Worker"
+    CHIEF = "Chief"
+    MASTER = "Master"
+    EVALUATOR = "Evaluator"
+    TPU = "TPU"
+
+
+# Roles that count as "the designated success indicator" when present
+# (reference status.go:87-142: chief OR master; else worker 0).
+CHIEF_LIKE = (ReplicaType.CHIEF, ReplicaType.MASTER)
+
+
+class RestartPolicy(str, enum.Enum):
+    """Reference common/v1/types.go:152-163."""
+
+    ALWAYS = "Always"
+    ON_FAILURE = "OnFailure"
+    NEVER = "Never"
+    EXIT_CODE = "ExitCode"
+
+
+class CleanPodPolicy(str, enum.Enum):
+    """Reference common/v1/types.go:131-137."""
+
+    ALL = "All"
+    RUNNING = "Running"
+    NONE = "None"
+
+
+class SuccessPolicy(str, enum.Enum):
+    """Reference pkg/apis/tensorflow/v1/common.go:17-23."""
+
+    DEFAULT = ""
+    ALL_WORKERS = "AllWorkers"
+
+
+class ConditionType(str, enum.Enum):
+    """Reference common/v1/types.go:100-126."""
+
+    CREATED = "Created"
+    RUNNING = "Running"
+    RESTARTING = "Restarting"
+    SUCCEEDED = "Succeeded"
+    FAILED = "Failed"
+
+
+@dataclass
+class ReplicaSpec:
+    """Reference common/v1/types.go:60-80, plus TPU topology fields."""
+
+    replicas: Optional[int] = None
+    template: PodTemplateSpec = field(default_factory=PodTemplateSpec)
+    restart_policy: Optional[RestartPolicy] = None
+    # New: TPU slice shape for this replica set, e.g. "v5e-8" + "2x4".
+    # Drives worker fan-out validation and node-selector injection.
+    tpu_accelerator: Optional[str] = None
+    tpu_topology: Optional[str] = None
+    extra: Dict[str, Any] = field(default_factory=dict)
+
+
+@dataclass
+class SchedulingPolicy:
+    """Reference common/v1/types.go:193-201."""
+
+    min_available: Optional[int] = None
+    queue: Optional[str] = None
+    extra: Dict[str, Any] = field(default_factory=dict)
+
+
+@dataclass
+class RunPolicy:
+    """Policies shared by all job operators. Reference common/v1/types.go:166-190."""
+
+    clean_pod_policy: Optional[CleanPodPolicy] = None
+    ttl_seconds_after_finished: Optional[int] = field(
+        default=None, metadata={"json": "ttlSecondsAfterFinished"}
+    )
+    active_deadline_seconds: Optional[int] = None
+    backoff_limit: Optional[int] = None
+    scheduling_policy: Optional[SchedulingPolicy] = None
+    extra: Dict[str, Any] = field(default_factory=dict)
+
+
+@dataclass
+class TFJobSpec:
+    """Reference pkg/apis/tensorflow/v1/types.go:47-86.
+
+    The reference inlines RunPolicy fields directly on the spec; we keep
+    the same flat wire format via serde metadata-free inlining below.
+    """
+
+    tf_replica_specs: Dict[str, ReplicaSpec] = field(
+        default_factory=dict, metadata={"json": "tfReplicaSpecs", "keep_empty": True}
+    )
+    run_policy: RunPolicy = field(default_factory=RunPolicy, metadata={"json": "runPolicy"})
+    success_policy: Optional[SuccessPolicy] = None
+    enable_dynamic_worker: Optional[bool] = None
+    extra: Dict[str, Any] = field(default_factory=dict)
+
+
+@dataclass
+class ReplicaStatus:
+    """Reference common/v1/types.go:38-50."""
+
+    active: int = 0
+    succeeded: int = 0
+    failed: int = 0
+
+
+@dataclass
+class JobCondition:
+    """Reference common/v1/types.go:83-98."""
+
+    type: ConditionType = ConditionType.CREATED
+    status: str = "True"
+    reason: str = ""
+    message: str = ""
+    last_update_time: Optional[str] = None
+    last_transition_time: Optional[str] = None
+
+
+@dataclass
+class JobStatus:
+    """Reference common/v1/types.go:24-36."""
+
+    conditions: List[JobCondition] = field(default_factory=list)
+    replica_statuses: Dict[str, ReplicaStatus] = field(default_factory=dict)
+    start_time: Optional[str] = None
+    completion_time: Optional[str] = None
+    last_reconcile_time: Optional[str] = None
+    extra: Dict[str, Any] = field(default_factory=dict)
+
+
+@dataclass
+class TFJob:
+    api_version: str = API_VERSION
+    kind: str = KIND
+    metadata: ObjectMeta = field(default_factory=ObjectMeta)
+    spec: TFJobSpec = field(default_factory=TFJobSpec)
+    status: JobStatus = field(default_factory=JobStatus)
+    extra: Dict[str, Any] = field(default_factory=dict)
+
+    # -- convenience -------------------------------------------------------
+
+    @property
+    def name(self) -> str:
+        return self.metadata.name
+
+    @property
+    def namespace(self) -> str:
+        return self.metadata.namespace
+
+    def key(self) -> str:
+        """Workqueue key, "namespace/name" (reference util.go:24-32)."""
+        return f"{self.metadata.namespace}/{self.metadata.name}" if self.metadata.namespace else self.metadata.name
+
+    def replica_spec(self, rtype: ReplicaType) -> Optional[ReplicaSpec]:
+        return self.spec.tf_replica_specs.get(rtype.value)
+
+    def replica_types(self) -> List[ReplicaType]:
+        """Replica roles present on this job, skipping unknown keys.
+
+        Unknown/non-canonical keys are a validation concern
+        (validation.py reports them); accessors must not crash on them.
+        """
+        out: List[ReplicaType] = []
+        for key in self.spec.tf_replica_specs:
+            try:
+                out.append(ReplicaType(key))
+            except ValueError:
+                continue
+        return out
+
+    def num_replicas(self, rtype: ReplicaType) -> int:
+        spec = self.replica_spec(rtype)
+        if spec is None:
+            return 0
+        return spec.replicas if spec.replicas is not None else 1
+
+    def total_replicas(self) -> int:
+        return sum(self.num_replicas(rt) for rt in self.replica_types())
+
+    def has_condition(self, ctype: ConditionType) -> bool:
+        return any(c.type == ctype and c.status == "True" for c in self.status.conditions)
+
+    def is_finished(self) -> bool:
+        """Terminal check. Reference pkg/util/status.go semantics: a job is
+        finished once Succeeded or Failed is True."""
+        return self.has_condition(ConditionType.SUCCEEDED) or self.has_condition(
+            ConditionType.FAILED
+        )
+
+    # -- serde -------------------------------------------------------------
+
+    def to_dict(self) -> Dict[str, Any]:
+        out = to_jsonable(self)
+        # The reference's wire format inlines RunPolicy fields on the spec
+        # (types.go:47-75: cleanPodPolicy, ttlSecondsAfterFinished,
+        # activeDeadlineSeconds, backoffLimit live directly under .spec).
+        spec = out.get("spec", {})
+        run_policy = spec.pop("runPolicy", None)
+        if run_policy:
+            for key, value in run_policy.items():
+                spec.setdefault(key, value)
+        return out
+
+    @classmethod
+    def from_dict(cls, data: Dict[str, Any]) -> "TFJob":
+        data = dict(data)
+        spec = dict(data.get("spec") or {})
+        if "runPolicy" not in spec:
+            run_policy: Dict[str, Any] = {}
+            for key in (
+                "cleanPodPolicy",
+                "ttlSecondsAfterFinished",
+                "activeDeadlineSeconds",
+                "backoffLimit",
+                "schedulingPolicy",
+            ):
+                if key in spec:
+                    run_policy[key] = spec.pop(key)
+            if run_policy:
+                spec["runPolicy"] = run_policy
+        data["spec"] = spec
+        return from_jsonable(data, cls)
+
+    def copy(self) -> "TFJob":
+        return TFJob.from_dict(self.to_dict())
+
+
+def replica_name(job_name: str, rtype: str, index: int) -> str:
+    """Child pod/service name: "{job}-{type}-{index}" (lowercased rtype).
+
+    Reference jobcontroller/util.go:47-57 (GenGeneralName).
+    """
+    return f"{job_name}-{rtype.lower()}-{index}".replace("/", "-")
+
+
+def gen_labels(job_name: str) -> Dict[str, str]:
+    """Base selector labels. Reference jobcontroller.go:211-222."""
+    safe = job_name.replace("/", "-")
+    return {
+        LABEL_GROUP_NAME: GROUP_NAME,
+        LABEL_JOB_NAME: safe,
+        LABEL_TF_JOB_NAME: safe,
+    }
+
+
+def is_retryable_exit_code(exit_code: int) -> bool:
+    """Exit-code classification for RestartPolicy ExitCode.
+
+    Semantics from reference pkg/util/train/train_util.go:18-53:
+    codes signalling transient infrastructure trouble (SIGINT 130,
+    SIGKILL 137, SIGTERM 143) and the user-defined retry code (SIGUSR1
+    138) retry; documented permanent shell errors (1, 2, 126, 127, 128,
+    SIGSEGV 139) and anything unclassified do not.
+    """
+    return exit_code in (130, 137, 138, 143)

@@ -1,0 +1,170 @@
+"""TFJob validation, mirroring reference pkg/apis/tensorflow/validation/validation.go:27-73.
+
+Checks: replica specs present and non-nil, each template has containers,
+each has a container named "tensorflow" with an image, at most one
+Chief/Master, at most one Evaluator. TPU additions: topology strings
+parse, chip counts are consistent with worker fan-out, and TPU replica
+sets don't mix with GPU resource requests.
+"""
+
+from __future__ import annotations
+
+import re
+from typing import List
+
+from . import types as t
+
+
+class ValidationError(ValueError):
+    pass
+
+
+_TOPOLOGY_RE = re.compile(r"^\d+x\d+(x\d+)?$")
+# accelerator "v5e-8" etc.: generation + chip count
+_ACCEL_RE = re.compile(r"^v\d+[a-z]*-\d+$", re.IGNORECASE)
+
+# chips per TPU host VM by generation (public GKE topology facts)
+_CHIPS_PER_HOST = {"v4": 4, "v5e": 4, "v5p": 4, "v6e": 4, "v2": 8, "v3": 8}
+
+
+def chips_per_host(accelerator: str) -> int:
+    gen = accelerator.split("-")[0].lower()
+    return _CHIPS_PER_HOST.get(gen, 4)
+
+
+def accelerator_chip_count(accelerator: str) -> int:
+    """Total chips encoded in the accelerator name suffix ("v5e-8" -> 8)."""
+    return int(accelerator.rsplit("-", 1)[1])
+
+
+def topology_chip_count(topology: str) -> int:
+    dims = [int(d) for d in topology.lower().split("x")]
+    count = 1
+    for d in dims:
+        count *= d
+    return count
+
+
+def expected_hosts(accelerator: str, topology: str) -> int:
+    """Number of host VMs (= pods = replicas) for a slice shape."""
+    per_host = chips_per_host(accelerator)
+    chips = topology_chip_count(topology)
+    if chips > per_host and chips % per_host != 0:
+        raise ValidationError(
+            f"topology {topology!r} has {chips} chips, not a multiple of the "
+            f"{per_host} chips per {accelerator} host"
+        )
+    return max(1, chips // per_host)
+
+
+def _validate_tpu_replica(key: str, spec: t.ReplicaSpec, errs: List[str]) -> None:
+    if spec.tpu_topology and not _TOPOLOGY_RE.match(spec.tpu_topology):
+        errs.append(
+            f"TFJobSpec.tfReplicaSpecs.{key}.tpuTopology {spec.tpu_topology!r} "
+            "must look like '2x4' or '4x4x4'"
+        )
+    if spec.tpu_accelerator and not _ACCEL_RE.match(spec.tpu_accelerator):
+        errs.append(
+            f"TFJobSpec.tfReplicaSpecs.{key}.tpuAccelerator {spec.tpu_accelerator!r} "
+            "must look like 'v5e-8'"
+        )
+    if (
+        spec.tpu_accelerator
+        and spec.tpu_topology
+        and _ACCEL_RE.match(spec.tpu_accelerator)
+        and _TOPOLOGY_RE.match(spec.tpu_topology)
+    ):
+        chips = topology_chip_count(spec.tpu_topology)
+        declared = accelerator_chip_count(spec.tpu_accelerator)
+        if declared != chips:
+            errs.append(
+                f"TFJobSpec.tfReplicaSpecs.{key}: accelerator "
+                f"{spec.tpu_accelerator!r} declares {declared} chips but topology "
+                f"{spec.tpu_topology!r} has {chips}"
+            )
+        else:
+            try:
+                want = expected_hosts(spec.tpu_accelerator, spec.tpu_topology)
+            except ValidationError as err:
+                errs.append(f"TFJobSpec.tfReplicaSpecs.{key}: {err}")
+            else:
+                if spec.replicas is not None and spec.replicas != want:
+                    errs.append(
+                        f"TFJobSpec.tfReplicaSpecs.{key}.replicas={spec.replicas} "
+                        f"but {spec.tpu_accelerator}/{spec.tpu_topology} is a "
+                        f"{want}-host slice; a multi-host slice must run exactly "
+                        "one pod per host"
+                    )
+    container = spec.template.spec.container(t.DEFAULT_CONTAINER_NAME)
+    if container is not None and container.resources is not None:
+        for res in (container.resources.limits, container.resources.requests):
+            for res_key in res:
+                if "nvidia.com/gpu" in res_key:
+                    errs.append(
+                        f"TFJobSpec.tfReplicaSpecs.{key} requests GPU resources; "
+                        "TPU replica sets must not mix accelerator types"
+                    )
+
+
+def validate(job: t.TFJob) -> None:
+    """Raise ValidationError listing every problem found."""
+    errs: List[str] = []
+    specs = job.spec.tf_replica_specs
+    if not specs:
+        errs.append("TFJobSpec is not valid: tfReplicaSpecs must be specified")
+
+    chief_like = 0
+    evaluators = 0
+    for key, spec in specs.items():
+        if spec is None:
+            errs.append(f"TFJobSpec.tfReplicaSpecs.{key} is not valid: spec is nil")
+            continue
+        try:
+            rtype = t.ReplicaType(key)
+        except ValueError:
+            errs.append(
+                f"TFJobSpec.tfReplicaSpecs key {key!r} is not a valid replica type "
+                f"(expected one of {[rt.value for rt in t.ReplicaType]})"
+            )
+            continue
+        containers = spec.template.spec.containers
+        if not containers:
+            errs.append(
+                f"TFJobSpec.tfReplicaSpecs.{key} is not valid: containers must be specified"
+            )
+            continue
+        for container in containers:
+            if not container.image:
+                errs.append(
+                    f"TFJobSpec.tfReplicaSpecs.{key} is not valid: image is "
+                    f"undefined in container {container.name!r}"
+                )
+        if spec.template.spec.container(t.DEFAULT_CONTAINER_NAME) is None:
+            errs.append(
+                f"TFJobSpec.tfReplicaSpecs.{key} is not valid: there must be a "
+                f"container named {t.DEFAULT_CONTAINER_NAME!r}"
+            )
+        if rtype in t.CHIEF_LIKE:
+            chief_like += 1
+        if rtype == t.ReplicaType.EVALUATOR:
+            # Evaluator cardinality counts replicas, not replica sets
+            # (reference validation.go:45-46).
+            evaluators += spec.replicas if spec.replicas is not None else 1
+        if rtype == t.ReplicaType.TPU:
+            _validate_tpu_replica(key, spec, errs)
+
+    if chief_like > 1:
+        errs.append("TFJobSpec is not valid: more than 1 Chief/Master replica set")
+    if evaluators > 1:
+        errs.append("TFJobSpec is not valid: more than 1 Evaluator replica")
+
+    if errs:
+        raise ValidationError("; ".join(errs))
+
+
+def is_valid(job: t.TFJob) -> bool:
+    try:
+        validate(job)
+        return True
+    except ValidationError:
+        return False
