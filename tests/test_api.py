@@ -215,7 +215,7 @@ class TestValidation:
         assert expected_hosts("v5e-4", "2x2") == 1
         assert expected_hosts("v5e-256", "16x16") == 64
         assert expected_hosts("v4-8", "2x2x1") == 1
-        assert expected_hosts("v3-8", "2x2x2") == 1  # v3 hosts have 8 chips
+        assert expected_hosts("v3-8", "2x2x2") == 2  # 4 chips per host VM
         with pytest.raises(ValidationError, match="multiple"):
             expected_hosts("v5e-6", "2x3")  # 6 chips not divisible by 4/host
 
@@ -232,14 +232,31 @@ class TestValidation:
         assert job.replica_types() == [t.ReplicaType.WORKER]
         assert job.total_replicas() == 2
 
-    def test_tpu_chip_default_matches_generation(self):
-        job = make_job({"TPU": 1})
+    def test_tpu_chip_default_full_host(self):
+        job = make_job({"TPU": 2})
         spec = job.spec.tf_replica_specs["TPU"]
-        spec.tpu_accelerator = "v3-8"
-        spec.tpu_topology = "2x2x2"
+        spec.tpu_accelerator = "v5e-8"
+        spec.tpu_topology = "2x4"
         set_defaults(job)
         res = spec.template.spec.containers[0].resources
-        assert res.limits[t.TPU_RESOURCE_KEY] == 8  # v3 host = 8 chips
+        assert res.limits[t.TPU_RESOURCE_KEY] == 4  # full 4-chip host per pod
+
+    def test_tpu_chip_default_sub_host_slice(self):
+        # a 1x1 slice must claim 1 chip, or it can never schedule on a
+        # 1-chip node
+        job = make_job({"TPU": 1})
+        spec = job.spec.tf_replica_specs["TPU"]
+        spec.tpu_accelerator = "v5e-1"
+        spec.tpu_topology = "1x1"
+        set_defaults(job)
+        res = spec.template.spec.containers[0].resources
+        assert res.limits[t.TPU_RESOURCE_KEY] == 1
+
+    def test_tpu_fields_rejected_on_non_tpu_replica(self):
+        job = make_job({"Worker": 1})
+        job.spec.tf_replica_specs["Worker"].tpu_topology = "2x4"
+        with pytest.raises(ValidationError, match="only valid on the TPU"):
+            validate(job)
 
 
 class TestExitCodes:

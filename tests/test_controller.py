@@ -1,0 +1,573 @@
+"""Controller tests.
+
+Two tiers, mirroring the reference's strategy (SURVEY.md §4):
+- `TestNormalPath`: table-driven reconciler state machine with fake
+  controls (reference controller_test.go:66-357).
+- `TestLifecycle`: whole-controller behavior against InMemorySubstrate
+  with simulated kubelet transitions (the role of the reference's E2E
+  suites + fake training server).
+"""
+
+import json
+
+import pytest
+
+from tf_operator_tpu.api import k8s, set_defaults, types as t
+from tf_operator_tpu.controller import (
+    FakeClock,
+    Reconciler,
+    ReconcilerConfig,
+    TFJobController,
+)
+from tf_operator_tpu.controller.reconciler import slices_by_index
+from tf_operator_tpu.runtime import (
+    ControllerExpectations,
+    EventRecorder,
+    FakePodControl,
+    FakeServiceControl,
+    InMemorySubstrate,
+    NullRecorder,
+)
+from tf_operator_tpu.runtime.control import owner_reference
+
+from tests.test_api import make_job
+
+
+def build_pod(job, rtype, index, phase, exit_code=None, restart_count=0):
+    rt = rtype.lower()
+    labels = t.gen_labels(job.name)
+    labels[t.LABEL_REPLICA_TYPE] = rt
+    labels[t.LABEL_REPLICA_INDEX] = str(index)
+    pod = k8s.Pod(
+        metadata=k8s.ObjectMeta(
+            name=t.replica_name(job.name, rt, index),
+            namespace=job.namespace,
+            labels=labels,
+            owner_references=[owner_reference(job)],
+        ),
+        spec=k8s.PodSpec(containers=[k8s.Container(name="tensorflow", image="i")]),
+        status=k8s.PodStatus(phase=phase),
+    )
+    if exit_code is not None:
+        pod.status.container_statuses = [
+            k8s.ContainerStatus(
+                name="tensorflow",
+                restart_count=restart_count,
+                state=k8s.ContainerState(
+                    terminated=k8s.ContainerStateTerminated(exit_code=exit_code)
+                ),
+            )
+        ]
+    elif restart_count:
+        pod.status.container_statuses = [
+            k8s.ContainerStatus(name="tensorflow", restart_count=restart_count)
+        ]
+    return pod
+
+
+def make_reconciler(**kwargs):
+    pod_control = FakePodControl()
+    service_control = FakeServiceControl()
+    reconciler = Reconciler(
+        pod_control=pod_control,
+        service_control=service_control,
+        recorder=NullRecorder(),
+        expectations=ControllerExpectations(),
+        clock=kwargs.pop("clock", FakeClock()),
+        **kwargs,
+    )
+    return reconciler, pod_control, service_control
+
+
+def worker_ps_job(workers=4, ps=2, **spec_kwargs):
+    job = make_job({"Worker": workers, "PS": ps})
+    job.metadata.uid = "uid-job"
+    set_defaults(job)
+    for key, value in spec_kwargs.items():
+        setattr(job.spec, key, value)
+    return job
+
+
+# Table rows: (name, pod builder args, expected pod creations, expected pod
+# deletions, expected active/succeeded/failed workers, expected condition)
+# Pods are given as (rtype, index, phase, exit_code) tuples.
+NORMAL_PATH_CASES = [
+    ("no pods yet", [], 6, 0, (0, 0, 0), None),
+    (
+        "all pending",
+        [("Worker", i, k8s.POD_PENDING, None) for i in range(4)]
+        + [("PS", i, k8s.POD_PENDING, None) for i in range(2)],
+        0, 0, (0, 0, 0), None,
+    ),
+    (
+        "all running",
+        [("Worker", i, k8s.POD_RUNNING, None) for i in range(4)]
+        + [("PS", i, k8s.POD_RUNNING, None) for i in range(2)],
+        0, 0, (4, 0, 0), t.ConditionType.RUNNING,
+    ),
+    (
+        "2 running 2 pending",
+        [("Worker", 0, k8s.POD_RUNNING, None), ("Worker", 1, k8s.POD_RUNNING, None),
+         ("Worker", 2, k8s.POD_PENDING, None), ("Worker", 3, k8s.POD_PENDING, None),
+         ("PS", 0, k8s.POD_RUNNING, None), ("PS", 1, k8s.POD_RUNNING, None)],
+        0, 0, (2, 0, 0), t.ConditionType.RUNNING,
+    ),
+    (
+        "all workers succeeded",
+        [("Worker", i, k8s.POD_SUCCEEDED, 0) for i in range(4)]
+        + [("PS", i, k8s.POD_RUNNING, None) for i in range(2)],
+        0, 0, (0, 4, 0), t.ConditionType.SUCCEEDED,
+    ),
+    (
+        "worker0 done, rest running (default policy)",
+        [("Worker", 0, k8s.POD_SUCCEEDED, 0)]
+        + [("Worker", i, k8s.POD_RUNNING, None) for i in range(1, 4)]
+        + [("PS", i, k8s.POD_RUNNING, None) for i in range(2)],
+        0, 0, (3, 1, 0), t.ConditionType.SUCCEEDED,
+    ),
+    (
+        "one worker failed (restart Never)",
+        [("Worker", 0, k8s.POD_RUNNING, None), ("Worker", 1, k8s.POD_FAILED, 1)]
+        + [("Worker", i, k8s.POD_RUNNING, None) for i in range(2, 4)]
+        + [("PS", i, k8s.POD_RUNNING, None) for i in range(2)],
+        0, 0, (3, 0, 1), t.ConditionType.FAILED,
+    ),
+]
+
+
+class TestNormalPath:
+    @pytest.mark.parametrize(
+        "name,pods,creations,deletions,counters,condition",
+        NORMAL_PATH_CASES,
+        ids=[c[0] for c in NORMAL_PATH_CASES],
+    )
+    def test_state(self, name, pods, creations, deletions, counters, condition):
+        job = worker_ps_job()
+        reconciler, pod_control, service_control = make_reconciler()
+        observed = [build_pod(job, *args) for args in pods]
+        reconciler.reconcile(job, observed, [])
+
+        assert len(pod_control.created) == creations
+        assert len(pod_control.deleted) == deletions
+        # no services exist in these rows, so all 6 are created every time
+        assert len(service_control.created) == 6
+        worker_status = job.status.replica_statuses["Worker"]
+        assert (
+            worker_status.active,
+            worker_status.succeeded,
+            worker_status.failed,
+        ) == counters
+        if condition is None:
+            assert not job.status.conditions or all(
+                c.type == t.ConditionType.CREATED for c in job.status.conditions
+            )
+        else:
+            assert job.has_condition(condition), [c.type for c in job.status.conditions]
+
+    def test_success_policy_all_workers_waits(self):
+        job = worker_ps_job()
+        job.spec.success_policy = t.SuccessPolicy.ALL_WORKERS
+        reconciler, *_ = make_reconciler()
+        pods = [build_pod(job, "Worker", 0, k8s.POD_SUCCEEDED, 0)] + [
+            build_pod(job, "Worker", i, k8s.POD_RUNNING) for i in range(1, 4)
+        ]
+        reconciler.reconcile(job, pods, [])
+        assert not job.has_condition(t.ConditionType.SUCCEEDED)
+        assert job.has_condition(t.ConditionType.RUNNING)
+
+    def test_chief_based_success(self):
+        job = make_job({"Chief": 1, "Worker": 2})
+        set_defaults(job)
+        reconciler, *_ = make_reconciler()
+        pods = [build_pod(job, "Chief", 0, k8s.POD_SUCCEEDED, 0)] + [
+            build_pod(job, "Worker", i, k8s.POD_RUNNING) for i in range(2)
+        ]
+        reconciler.reconcile(job, pods, [])
+        assert job.has_condition(t.ConditionType.SUCCEEDED)
+
+    def test_chief_running_means_running(self):
+        job = make_job({"Chief": 1, "Worker": 2})
+        set_defaults(job)
+        reconciler, *_ = make_reconciler()
+        pods = [build_pod(job, "Chief", 0, k8s.POD_RUNNING)] + [
+            build_pod(job, "Worker", i, k8s.POD_SUCCEEDED, 0) for i in range(2)
+        ]
+        reconciler.reconcile(job, pods, [])
+        # workers done but chief still running: job is Running, not done
+        assert job.has_condition(t.ConditionType.RUNNING)
+        assert not job.has_condition(t.ConditionType.SUCCEEDED)
+
+    def test_exit_code_restart_deletes_pod(self):
+        job = worker_ps_job()
+        job.spec.tf_replica_specs["Worker"].restart_policy = t.RestartPolicy.EXIT_CODE
+        reconciler, pod_control, _ = make_reconciler()
+        pods = [build_pod(job, "Worker", 1, k8s.POD_FAILED, exit_code=137)] + [
+            build_pod(job, "Worker", i, k8s.POD_RUNNING) for i in (0, 2, 3)
+        ]
+        reconciler.reconcile(job, pods, [])
+        assert t.replica_name(job.name, "worker", 1) in pod_control.deleted
+        assert job.has_condition(t.ConditionType.RESTARTING)
+        assert not job.has_condition(t.ConditionType.FAILED)
+
+    def test_exit_code_permanent_fails(self):
+        job = worker_ps_job()
+        job.spec.tf_replica_specs["Worker"].restart_policy = t.RestartPolicy.EXIT_CODE
+        reconciler, pod_control, _ = make_reconciler()
+        pods = [build_pod(job, "Worker", 1, k8s.POD_FAILED, exit_code=1)]
+        reconciler.reconcile(job, pods, [])
+        assert pod_control.deleted == []
+        assert job.has_condition(t.ConditionType.FAILED)
+
+    def test_restarting_and_running_mutually_exclusive(self):
+        job = worker_ps_job()
+        job.spec.tf_replica_specs["Worker"].restart_policy = t.RestartPolicy.EXIT_CODE
+        reconciler, *_ = make_reconciler()
+        pods = [build_pod(job, "Worker", i, k8s.POD_RUNNING) for i in range(4)]
+        reconciler.reconcile(job, pods, [])
+        assert job.has_condition(t.ConditionType.RUNNING)
+        pods[3] = build_pod(job, "Worker", 3, k8s.POD_FAILED, exit_code=143)
+        reconciler.reconcile(job, pods, [])
+        assert job.has_condition(t.ConditionType.RESTARTING)
+        assert not any(
+            c.type == t.ConditionType.RUNNING for c in job.status.conditions
+        )
+
+    def test_dynamic_worker_scale_down(self):
+        job = worker_ps_job(enable_dynamic_worker=True)
+        job.spec.tf_replica_specs["Worker"].replicas = 2
+        reconciler, pod_control, service_control = make_reconciler()
+        pods = [build_pod(job, "Worker", i, k8s.POD_RUNNING) for i in range(4)]
+        reconciler.reconcile(job, pods, [])
+        assert sorted(pod_control.deleted) == [
+            t.replica_name(job.name, "worker", 2),
+            t.replica_name(job.name, "worker", 3),
+        ]
+
+    def test_tpu_slice_restarts_as_a_unit(self):
+        """One dead host breaks the ICI mesh for every peer: the whole
+        TPU replica set must restart together (SURVEY.md hard part #1)."""
+        job = make_job({"TPU": 4})
+        job.spec.tf_replica_specs["TPU"].restart_policy = t.RestartPolicy.EXIT_CODE
+        set_defaults(job)
+        reconciler, pod_control, _ = make_reconciler()
+        pods = [build_pod(job, "TPU", 0, k8s.POD_FAILED, exit_code=137)] + [
+            build_pod(job, "TPU", i, k8s.POD_RUNNING) for i in range(1, 4)
+        ]
+        reconciler.reconcile(job, pods, [])
+        # every host torn down, not just the failed one
+        assert len(pod_control.deleted) == 4
+        assert job.has_condition(t.ConditionType.RESTARTING)
+
+    def test_tpu_permanent_failure_fails_whole_job(self):
+        job = make_job({"TPU": 2})
+        job.spec.tf_replica_specs["TPU"].restart_policy = t.RestartPolicy.EXIT_CODE
+        set_defaults(job)
+        reconciler, pod_control, _ = make_reconciler()
+        pods = [
+            build_pod(job, "TPU", 0, k8s.POD_FAILED, exit_code=1),
+            build_pod(job, "TPU", 1, k8s.POD_RUNNING),
+        ]
+        reconciler.reconcile(job, pods, [])
+        assert pod_control.deleted == []
+        assert job.has_condition(t.ConditionType.FAILED)
+
+    def test_master_role_election(self):
+        # without chief: worker 0 is master
+        job = worker_ps_job()
+        reconciler, pod_control, _ = make_reconciler()
+        reconciler.reconcile(job, [], [])
+        roles = {
+            p.metadata.name: p.metadata.labels.get(t.LABEL_JOB_ROLE)
+            for p in pod_control.created
+        }
+        assert roles[t.replica_name(job.name, "worker", 0)] == "master"
+        assert roles[t.replica_name(job.name, "worker", 1)] is None
+        assert roles[t.replica_name(job.name, "ps", 0)] is None
+
+        # with chief: chief is master, worker 0 is not
+        job2 = make_job({"Chief": 1, "Worker": 2})
+        set_defaults(job2)
+        reconciler2, pod_control2, _ = make_reconciler()
+        reconciler2.reconcile(job2, [], [])
+        roles2 = {
+            p.metadata.name: p.metadata.labels.get(t.LABEL_JOB_ROLE)
+            for p in pod_control2.created
+        }
+        assert roles2[t.replica_name(job2.name, "chief", 0)] == "master"
+        assert roles2[t.replica_name(job2.name, "worker", 0)] is None
+
+    def test_backoff_limit_by_restart_counts(self):
+        job = worker_ps_job()
+        job.spec.tf_replica_specs["Worker"].restart_policy = t.RestartPolicy.ON_FAILURE
+        job.spec.run_policy.backoff_limit = 3
+        reconciler, pod_control, _ = make_reconciler()
+        pods = [
+            build_pod(job, "Worker", i, k8s.POD_RUNNING, restart_count=2)
+            for i in range(4)
+        ]
+        reconciler.reconcile(job, pods, [])
+        assert job.has_condition(t.ConditionType.FAILED)
+        # children are torn down on limit breach
+        assert len(pod_control.deleted) == 4
+
+    def test_active_deadline_exceeded(self):
+        clock = FakeClock()
+        job = worker_ps_job()
+        job.spec.run_policy.active_deadline_seconds = 60
+        reconciler, pod_control, _ = make_reconciler(clock=clock)
+        pods = [build_pod(job, "Worker", i, k8s.POD_RUNNING) for i in range(4)] + [
+            build_pod(job, "PS", i, k8s.POD_RUNNING) for i in range(2)
+        ]
+        reconciler.reconcile(job, pods, [])
+        assert job.has_condition(t.ConditionType.RUNNING)
+        clock.advance(61)
+        reconciler.reconcile(job, pods, [])
+        assert job.has_condition(t.ConditionType.FAILED)
+        assert "deadline" in job.status.conditions[-1].message
+
+    def test_terminal_cleanup_respects_clean_pod_policy(self):
+        for policy, expect_deleted in [
+            (t.CleanPodPolicy.ALL, 2),
+            (t.CleanPodPolicy.RUNNING, 1),
+            (t.CleanPodPolicy.NONE, 0),
+        ]:
+            job = worker_ps_job(ps=0, workers=2)
+            job.spec.run_policy.clean_pod_policy = policy
+            job.status.conditions = [
+                t.JobCondition(type=t.ConditionType.SUCCEEDED, status="True")
+            ]
+            reconciler, pod_control, service_control = make_reconciler()
+            pods = [
+                build_pod(job, "Worker", 0, k8s.POD_RUNNING),
+                build_pod(job, "Worker", 1, k8s.POD_SUCCEEDED, 0),
+            ]
+            reconciler.reconcile(job, pods, [])
+            assert len(pod_control.deleted) == expect_deleted, policy
+
+    def test_slices_by_index(self):
+        job = worker_ps_job()
+        pods = [build_pod(job, "Worker", i, k8s.POD_RUNNING) for i in (0, 2, 5)]
+        slices, extra = slices_by_index(pods, 4)
+        assert [len(s) for s in slices] == [1, 0, 1, 0]
+        assert len(extra) == 1
+
+
+class TestClusterSpecInjection:
+    def get_env(self, pod, name):
+        return pod.spec.container("tensorflow").env_value(name)
+
+    def test_tf_config_injected(self):
+        job = worker_ps_job(workers=2, ps=1)
+        reconciler, pod_control, _ = make_reconciler()
+        reconciler.reconcile(job, [], [])
+        worker1 = next(
+            p for p in pod_control.created
+            if p.metadata.name == t.replica_name(job.name, "worker", 1)
+        )
+        config = json.loads(self.get_env(worker1, t.ENV_TF_CONFIG))
+        assert config["task"] == {"type": "worker", "index": 1}
+        assert config["environment"] == "cloud"
+        assert config["cluster"]["ps"] == [
+            f"test-job-ps-0.{job.namespace}.svc:2222"
+        ]
+        assert len(config["cluster"]["worker"]) == 2
+
+    def test_single_process_job_gets_no_tf_config(self):
+        job = make_job({"Worker": 1})
+        set_defaults(job)
+        reconciler, pod_control, _ = make_reconciler()
+        reconciler.reconcile(job, [], [])
+        assert self.get_env(pod_control.created[0], t.ENV_TF_CONFIG) is None
+
+    def test_sparse_config_for_elastic(self):
+        job = worker_ps_job(workers=3, ps=1, enable_dynamic_worker=True)
+        reconciler, pod_control, _ = make_reconciler()
+        reconciler.reconcile(job, [], [])
+        worker2 = next(
+            p for p in pod_control.created
+            if p.metadata.name == t.replica_name(job.name, "worker", 2)
+        )
+        config = json.loads(self.get_env(worker2, t.ENV_TF_CONFIG))
+        assert "sparseCluster" in config
+        assert list(config["sparseCluster"]["worker"]) == ["2"]
+        assert len(config["sparseCluster"]["ps"]) == 1
+
+    def test_tpu_env_injected(self):
+        job = make_job({"TPU": 2})
+        spec = job.spec.tf_replica_specs["TPU"]
+        spec.tpu_accelerator = "v5e-8"
+        spec.tpu_topology = "2x4"
+        set_defaults(job)
+        reconciler, pod_control, _ = make_reconciler()
+        reconciler.reconcile(job, [], [])
+        assert len(pod_control.created) == 2
+        pod1 = next(
+            p for p in pod_control.created
+            if p.metadata.name == t.replica_name(job.name, "tpu", 1)
+        )
+        assert self.get_env(pod1, t.ENV_TPU_WORKER_ID) == "1"
+        hostnames = self.get_env(pod1, t.ENV_TPU_WORKER_HOSTNAMES).split(",")
+        assert hostnames == [
+            f"test-job-tpu-0.{job.namespace}.svc",
+            f"test-job-tpu-1.{job.namespace}.svc",
+        ]
+        assert self.get_env(pod1, t.ENV_TPU_TOPOLOGY) == "2x4"
+        assert self.get_env(pod1, t.ENV_TPU_ACCELERATOR) == "v5e-8"
+        assert self.get_env(pod1, t.ENV_COORDINATOR_ADDRESS).endswith(":2222")
+        assert self.get_env(pod1, t.ENV_PROCESS_ID) == "1"
+        assert self.get_env(pod1, t.ENV_NUM_PROCESSES) == "2"
+        # node selectors from defaulting
+        assert (
+            pod1.spec.node_selector[t.GKE_TPU_ACCELERATOR_SELECTOR] == "v5e-8"
+        )
+
+    def test_gang_annotations(self):
+        job = worker_ps_job()
+        reconciler, pod_control, _ = make_reconciler(
+            config=ReconcilerConfig(enable_gang_scheduling=True)
+        )
+        reconciler.reconcile(job, [], [])
+        pod = pod_control.created[0]
+        assert pod.metadata.annotations[t.ANNOTATION_GANG_GROUP] == job.name
+        assert pod.spec.scheduler_name == "volcano"
+
+    def test_exit_code_maps_to_pod_restart_never(self):
+        job = worker_ps_job()
+        job.spec.tf_replica_specs["Worker"].restart_policy = t.RestartPolicy.EXIT_CODE
+        reconciler, pod_control, _ = make_reconciler()
+        reconciler.reconcile(job, [], [])
+        worker = next(
+            p for p in pod_control.created
+            if p.metadata.labels[t.LABEL_REPLICA_TYPE] == "worker"
+        )
+        assert worker.spec.restart_policy == "Never"
+
+
+class TestLifecycle:
+    """Whole-controller flows over the in-memory substrate."""
+
+    def setup_controller(self, clock=None):
+        sub = InMemorySubstrate()
+        controller = TFJobController(sub, clock=clock)
+        return sub, controller
+
+    def run_job(self, sub, controller, job):
+        sub.create_job(job)
+        controller.run_until_quiet()
+
+    def test_happy_path_to_succeeded(self):
+        sub, controller = self.setup_controller()
+        job = make_job({"Worker": 2, "PS": 1}, name="mnist", namespace="kubeflow")
+        self.run_job(sub, controller, job)
+
+        pods = sub.list_pods("kubeflow")
+        services = sub.list_services("kubeflow")
+        assert len(pods) == 3 and len(services) == 3
+        stored = sub.get_job("kubeflow", "mnist")
+        assert stored.has_condition(t.ConditionType.CREATED)
+
+        sub.run_all_pending()
+        controller.run_until_quiet()
+        assert sub.get_job("kubeflow", "mnist").has_condition(t.ConditionType.RUNNING)
+
+        # worker 0 completes -> success under the default policy
+        sub.terminate_pod("kubeflow", "mnist-worker-0", exit_code=0)
+        controller.run_until_quiet()
+        stored = sub.get_job("kubeflow", "mnist")
+        assert stored.has_condition(t.ConditionType.SUCCEEDED)
+        assert stored.status.completion_time is not None
+        # default CleanPodPolicy=Running: still-running pods were deleted
+        assert all(not p.is_active() for p in sub.list_pods("kubeflow"))
+        # succeeded events recorded
+        assert any(
+            e.reason == "TFJobSucceeded" for e in sub.events_for("TFJob", "mnist")
+        )
+
+    def test_exit_code_restart_recreates_pod(self):
+        sub, controller = self.setup_controller()
+        job = make_job({"Worker": 2}, name="restarty")
+        job.spec.tf_replica_specs["Worker"].restart_policy = t.RestartPolicy.EXIT_CODE
+        self.run_job(sub, controller, job)
+        sub.run_all_pending()
+        controller.run_until_quiet()
+
+        sub.terminate_pod("default", "restarty-worker-1", exit_code=137)
+        # first sync: pod deleted, job marked Restarting
+        controller.process_next(timeout=0.1)
+        stored = sub.get_job("default", "restarty")
+        assert stored.has_condition(t.ConditionType.RESTARTING)
+        # follow-up syncs: pod recreated at the same index; with worker 0
+        # still running the job flips back to Running (the conditions are
+        # mutually exclusive, reference status.go:284-306)
+        controller.run_until_quiet()
+        pod = sub.get_pod("default", "restarty-worker-1")
+        assert pod.status.phase == k8s.POD_PENDING
+        stored = sub.get_job("default", "restarty")
+        assert stored.has_condition(t.ConditionType.RUNNING)
+        assert not stored.has_condition(t.ConditionType.RESTARTING)
+        assert any(
+            e.reason == "TFJobRestarting" for e in sub.events_for("TFJob", "restarty")
+        )
+
+    def test_permanent_failure_fails_job(self):
+        sub, controller = self.setup_controller()
+        job = make_job({"Worker": 2}, name="perma")
+        self.run_job(sub, controller, job)
+        sub.run_all_pending()
+        controller.run_until_quiet()
+        sub.terminate_pod("default", "perma-worker-1", exit_code=1)
+        controller.run_until_quiet()
+        assert sub.get_job("default", "perma").has_condition(t.ConditionType.FAILED)
+
+    def test_invalid_job_marked_failed(self):
+        sub, controller = self.setup_controller()
+        job = t.TFJob(metadata=k8s.ObjectMeta(name="bad", namespace="default"))
+        job.spec.tf_replica_specs["Worker"] = t.ReplicaSpec(
+            replicas=1, template=k8s.PodTemplateSpec()
+        )  # no containers
+        sub.create_job(job)
+        controller.run_until_quiet()
+        stored = sub.get_job("default", "bad")
+        assert stored.has_condition(t.ConditionType.FAILED)
+        assert stored.status.conditions[-1].reason == "TFJobFailedValidation"
+        assert sub.list_pods("default") == []
+
+    def test_ttl_cleanup(self):
+        clock = FakeClock()
+        sub, controller = self.setup_controller(clock=clock)
+        job = make_job({"Worker": 1, "PS": 1}, name="ttl-job")
+        job.spec.run_policy.ttl_seconds_after_finished = 30
+        self.run_job(sub, controller, job)
+        sub.run_all_pending()
+        controller.run_until_quiet()
+        sub.terminate_pod("default", "ttl-job-worker-0", exit_code=0)
+        controller.run_until_quiet()
+        assert sub.get_job("default", "ttl-job").has_condition(
+            t.ConditionType.SUCCEEDED
+        )
+        clock.advance(31)
+        controller.enqueue("default/ttl-job")
+        controller.run_until_quiet()
+        with pytest.raises(Exception):
+            sub.get_job("default", "ttl-job")
+
+    def test_namespace_scoping(self):
+        sub = InMemorySubstrate()
+        controller = TFJobController(sub, namespace="watched")
+        sub.create_job(make_job({"Worker": 1}, name="elsewhere", namespace="other"))
+        controller.run_until_quiet()
+        assert sub.list_pods("other") == []
+
+    def test_no_double_create_under_expectation(self):
+        """The informer-lag guard: a second sync before ADD events are
+        observed must not double-create (SURVEY.md hard part #2)."""
+        sub, controller = self.setup_controller()
+        job = make_job({"Worker": 2}, name="once")
+        sub.create_job(job)
+        controller.run_until_quiet()
+        assert len(sub.list_pods("default")) == 2
+        # force many redundant syncs
+        for _ in range(3):
+            controller.enqueue("default/once")
+            controller.run_until_quiet()
+        assert len(sub.list_pods("default")) == 2

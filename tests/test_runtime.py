@@ -1,0 +1,194 @@
+"""Runtime-layer tests: substrate, workqueue, expectations, control.
+
+Modeled on reference pkg/control/*_test.go and the workqueue/
+expectations invariants the reference controller depends on.
+"""
+
+import threading
+import time
+
+import pytest
+
+from tf_operator_tpu.api import k8s, types as t
+from tf_operator_tpu.runtime import (
+    ControllerExpectations,
+    EventRecorder,
+    FakePodControl,
+    InMemorySubstrate,
+    NotFound,
+    RateLimitingQueue,
+    RealPodControl,
+    RealServiceControl,
+    WorkQueue,
+    is_controlled_by,
+)
+
+from tests.test_api import make_job
+
+
+def make_pod(name, namespace="default", labels=None):
+    return k8s.Pod(
+        metadata=k8s.ObjectMeta(name=name, namespace=namespace, labels=labels or {}),
+        spec=k8s.PodSpec(containers=[k8s.Container(name="tensorflow", image="i")]),
+    )
+
+
+class TestInMemorySubstrate:
+    def test_job_crud_and_status_subresource(self):
+        sub = InMemorySubstrate()
+        job = sub.create_job(make_job())
+        assert job.metadata.uid
+        job.status.start_time = "2026-01-01T00:00:00Z"
+        job.spec.tf_replica_specs["Worker"].replicas = 99  # must NOT persist
+        sub.update_job_status(job)
+        stored = sub.get_job("default", "test-job")
+        assert stored.status.start_time == "2026-01-01T00:00:00Z"
+        assert stored.spec.tf_replica_specs["Worker"].replicas == 1
+        sub.delete_job("default", "test-job")
+        with pytest.raises(NotFound):
+            sub.get_job("default", "test-job")
+
+    def test_label_selector_listing(self):
+        sub = InMemorySubstrate()
+        sub.create_pod(make_pod("a", labels={"job-name": "x", "i": "0"}))
+        sub.create_pod(make_pod("b", labels={"job-name": "x", "i": "1"}))
+        sub.create_pod(make_pod("c", labels={"job-name": "y"}))
+        assert len(sub.list_pods("default", {"job-name": "x"})) == 2
+        assert len(sub.list_pods("default", {"job-name": "x", "i": "1"})) == 1
+        assert len(sub.list_pods("other")) == 0
+
+    def test_watch_events(self):
+        sub = InMemorySubstrate()
+        seen = []
+        sub.subscribe("pod", lambda verb, pod: seen.append((verb, pod.metadata.name)))
+        sub.create_pod(make_pod("a"))
+        sub.mark_pod_running("default", "a")
+        sub.delete_pod("default", "a")
+        assert seen == [("ADDED", "a"), ("MODIFIED", "a"), ("DELETED", "a")]
+
+    def test_cascade_gc_on_job_delete(self):
+        sub = InMemorySubstrate()
+        job = sub.create_job(make_job())
+        recorder = EventRecorder(sub)
+        pod_control = RealPodControl(sub, recorder)
+        pod_control.create_pod("default", make_pod("test-job-worker-0"), job)
+        svc_control = RealServiceControl(sub, recorder)
+        svc_control.create_service(
+            "default", k8s.Service(metadata=k8s.ObjectMeta(name="test-job-worker-0")), job
+        )
+        sub.delete_job("default", "test-job")
+        assert sub.list_pods("default") == []
+        assert sub.list_services("default") == []
+
+    def test_kubelet_simulator_exit_codes(self):
+        sub = InMemorySubstrate()
+        sub.create_pod(make_pod("a"))
+        sub.terminate_pod("default", "a", exit_code=137)
+        pod = sub.get_pod("default", "a")
+        assert pod.status.phase == k8s.POD_FAILED
+        assert k8s.pod_main_exit_code(pod, "tensorflow") == 137
+        sub.create_pod(make_pod("b"))
+        sub.terminate_pod("default", "b", exit_code=0)
+        assert sub.get_pod("default", "b").status.phase == k8s.POD_SUCCEEDED
+
+    def test_returned_objects_are_copies(self):
+        sub = InMemorySubstrate()
+        sub.create_pod(make_pod("a", labels={"k": "v"}))
+        pod = sub.get_pod("default", "a")
+        pod.metadata.labels["k"] = "mutated"
+        assert sub.get_pod("default", "a").metadata.labels["k"] == "v"
+
+
+class TestWorkQueue:
+    def test_dedup_while_queued(self):
+        q = WorkQueue()
+        q.add("a")
+        q.add("a")
+        q.add("b")
+        assert len(q) == 2
+
+    def test_dirty_while_processing_requeues_once(self):
+        q = WorkQueue()
+        q.add("a")
+        item = q.get()
+        q.add("a")  # arrives while a worker holds "a"
+        q.add("a")
+        assert len(q) == 0  # not yet re-queued
+        q.done(item)
+        assert len(q) == 1
+        assert q.get() == "a"
+
+    def test_rate_limited_backoff_growth(self):
+        q = RateLimitingQueue()
+        assert q.num_requeues("k") == 0
+        q.add_rate_limited("k")
+        time.sleep(0.02)
+        assert q.get(timeout=1.0) == "k"
+        q.done("k")
+        assert q.num_requeues("k") == 1
+        q.forget("k")
+        assert q.num_requeues("k") == 0
+
+    def test_add_after(self):
+        q = RateLimitingQueue()
+        q.add_after("x", 0.03)
+        assert q.get(timeout=0.001) is None
+        assert q.get(timeout=1.0) == "x"
+
+    def test_shutdown_unblocks_getters(self):
+        q = WorkQueue()
+        results = []
+        worker = threading.Thread(target=lambda: results.append(q.get()))
+        worker.start()
+        q.shut_down()
+        worker.join(timeout=2)
+        assert results == [None]
+
+
+class TestExpectations:
+    def test_create_expectations_cycle(self):
+        exp = ControllerExpectations()
+        key = "ns/job"
+        assert exp.satisfied(key)  # never set
+        exp.expect_creations(key, 2)
+        assert not exp.satisfied(key)
+        exp.creation_observed(key)
+        assert not exp.satisfied(key)
+        exp.creation_observed(key)
+        assert exp.satisfied(key)
+
+    def test_ttl_failsafe(self):
+        exp = ControllerExpectations(ttl=0.01)
+        exp.expect_creations("k", 5)
+        assert not exp.satisfied("k")
+        time.sleep(0.02)
+        assert exp.satisfied("k")  # expired: resync rather than deadlock
+
+    def test_deletions(self):
+        exp = ControllerExpectations()
+        exp.expect_deletions("k", 1)
+        assert not exp.satisfied("k")
+        exp.deletion_observed("k")
+        assert exp.satisfied("k")
+
+
+class TestControl:
+    def test_real_pod_control_sets_ownership_and_events(self):
+        sub = InMemorySubstrate()
+        job = sub.create_job(make_job())
+        control = RealPodControl(sub, EventRecorder(sub))
+        control.create_pod("default", make_pod("test-job-worker-0"), job)
+        pod = sub.get_pod("default", "test-job-worker-0")
+        assert is_controlled_by(pod.metadata, job)
+        ref = pod.metadata.owner_references[0]
+        assert (ref.kind, ref.name, ref.controller) == (t.KIND, "test-job", True)
+        events = sub.events_for(t.KIND, "test-job")
+        assert any(e.reason == "SuccessfulCreatePod" for e in events)
+
+    def test_fake_pod_control_records(self):
+        fake = FakePodControl()
+        job = make_job()
+        fake.create_pod("default", make_pod("p0"), job)
+        fake.delete_pod("default", "p1", job)
+        assert [p.metadata.name for p in fake.created] == ["p0"]
+        assert fake.deleted == ["p1"]

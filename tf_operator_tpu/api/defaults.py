@@ -17,7 +17,7 @@ from typing import Dict
 
 from . import types as t
 from .k8s import Container, ContainerPort, ResourceRequirements
-from .validation import chips_per_host
+from .validation import chips_per_pod
 
 # Canonical spellings for case-insensitive replica-type keys
 # (reference defaults.go:63-77 setTypeNamesToCamelCase).
@@ -54,10 +54,10 @@ def _set_tpu_defaults(spec: t.ReplicaSpec) -> None:
         container.resources = ResourceRequirements()
     res = container.resources
     if t.TPU_RESOURCE_KEY not in res.limits and t.TPU_RESOURCE_KEY not in res.requests:
-        # One host's worth of chips: a TPU pod must claim every chip on
-        # its host VM, and the count varies by generation (v2/v3: 8,
-        # v4/v5e/v5p/v6e: 4).
-        chips = chips_per_host(spec.tpu_accelerator or "v5e")
+        # A TPU pod claims every chip it can see: a full host for
+        # multi-host slices, only the slice's own chips for sub-host
+        # shapes (1x1, 2x2) so the pod stays schedulable there.
+        chips = chips_per_pod(spec.tpu_accelerator or "v5e", spec.tpu_topology)
         res.limits[t.TPU_RESOURCE_KEY] = chips
         res.requests[t.TPU_RESOURCE_KEY] = chips
 

@@ -19,6 +19,7 @@ from __future__ import annotations
 
 import dataclasses
 import enum
+import functools
 import typing
 from typing import Any, Optional, Type, TypeVar, Union
 
@@ -105,6 +106,16 @@ def _coerce(value: Any, tp: Any) -> Any:
     return value
 
 
+@functools.lru_cache(maxsize=None)
+def _class_schema(cls: type):
+    """Resolved type hints + json-key map, cached per class: hint
+    resolution evals stringified annotations and sits on the controller's
+    deserialization hot path."""
+    hints = typing.get_type_hints(cls)
+    known = {_json_key(field): field for field in dataclasses.fields(cls)}
+    return hints, known
+
+
 def from_jsonable(data: Any, cls: Type[T]) -> T:
     """Build dataclass ``cls`` from a plain JSON-able dict.
 
@@ -116,8 +127,7 @@ def from_jsonable(data: Any, cls: Type[T]) -> T:
         data = {}
     if not isinstance(data, dict):
         raise TypeError(f"cannot build {cls.__name__} from {type(data).__name__}")
-    hints = typing.get_type_hints(cls)
-    known = {_json_key(field): field for field in dataclasses.fields(cls)}
+    hints, known = _class_schema(cls)
     kwargs: dict[str, Any] = {}
     extra: dict[str, Any] = {}
     for key, value in data.items():

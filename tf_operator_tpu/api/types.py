@@ -299,7 +299,7 @@ class TFJob:
         return from_jsonable(data, cls)
 
     def copy(self) -> "TFJob":
-        return TFJob.from_dict(self.to_dict())
+        return from_jsonable(to_jsonable(self), TFJob)
 
 
 def replica_name(job_name: str, rtype: str, index: int) -> str:

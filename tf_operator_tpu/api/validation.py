@@ -10,7 +10,7 @@ sets don't mix with GPU resource requests.
 from __future__ import annotations
 
 import re
-from typing import List
+from typing import List, Optional
 
 from . import types as t
 
@@ -23,8 +23,11 @@ _TOPOLOGY_RE = re.compile(r"^\d+x\d+(x\d+)?$")
 # accelerator "v5e-8" etc.: generation + chip count
 _ACCEL_RE = re.compile(r"^v\d+[a-z]*-\d+$", re.IGNORECASE)
 
-# chips per TPU host VM by generation (public GKE topology facts)
-_CHIPS_PER_HOST = {"v4": 4, "v5e": 4, "v5p": 4, "v6e": 4, "v2": 8, "v3": 8}
+# Chips per TPU host VM in this framework's canonical slice shapes: every
+# supported generation (v2-v6e boards) carries 4 chips per host, one pod
+# per host. The accelerator suffix in OUR naming always counts chips
+# ("v5e-8" = 8 chips), never TensorCores.
+_CHIPS_PER_HOST = {"v2": 4, "v3": 4, "v4": 4, "v5e": 4, "v5p": 4, "v6e": 4}
 
 
 def chips_per_host(accelerator: str) -> int:
@@ -35,6 +38,15 @@ def chips_per_host(accelerator: str) -> int:
 def accelerator_chip_count(accelerator: str) -> int:
     """Total chips encoded in the accelerator name suffix ("v5e-8" -> 8)."""
     return int(accelerator.rsplit("-", 1)[1])
+
+
+def chips_per_pod(accelerator: str, topology: Optional[str]) -> int:
+    """Per-pod chip request: a sub-host slice (e.g. 1x1, 2x2 on v5e)
+    claims only its own chips; multi-host slices claim a full host."""
+    per_host = chips_per_host(accelerator)
+    if topology and _TOPOLOGY_RE.match(topology):
+        return min(per_host, topology_chip_count(topology))
+    return per_host
 
 
 def topology_chip_count(topology: str) -> int:
@@ -152,6 +164,11 @@ def validate(job: t.TFJob) -> None:
             evaluators += spec.replicas if spec.replicas is not None else 1
         if rtype == t.ReplicaType.TPU:
             _validate_tpu_replica(key, spec, errs)
+        elif spec.tpu_accelerator or spec.tpu_topology:
+            errs.append(
+                f"TFJobSpec.tfReplicaSpecs.{key}: tpuAccelerator/tpuTopology "
+                "are only valid on the TPU replica type"
+            )
 
     if chief_like > 1:
         errs.append("TFJobSpec is not valid: more than 1 Chief/Master replica set")

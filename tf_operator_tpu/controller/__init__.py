@@ -1,0 +1,25 @@
+from .clock import Clock, FakeClock
+from .controller import TFJobController
+from .reconciler import Reconciler, ReconcilerConfig
+from .status import (
+    REASON_CREATED,
+    REASON_FAILED,
+    REASON_RESTARTING,
+    REASON_RUNNING,
+    REASON_SUCCEEDED,
+    set_condition,
+)
+
+__all__ = [
+    "Clock",
+    "FakeClock",
+    "TFJobController",
+    "Reconciler",
+    "ReconcilerConfig",
+    "set_condition",
+    "REASON_CREATED",
+    "REASON_RUNNING",
+    "REASON_SUCCEEDED",
+    "REASON_FAILED",
+    "REASON_RESTARTING",
+]

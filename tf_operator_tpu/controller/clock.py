@@ -1,0 +1,35 @@
+"""Injectable clock so the policy machine (deadlines, TTL, backoff) is
+deterministic under test — the role metav1.Now() plays in the reference,
+made a seam instead of a global."""
+
+from __future__ import annotations
+
+import datetime
+
+
+def parse_iso(ts: str) -> datetime.datetime:
+    return datetime.datetime.fromisoformat(ts.replace("Z", "+00:00"))
+
+
+class Clock:
+    def now(self) -> datetime.datetime:
+        return datetime.datetime.now(datetime.timezone.utc)
+
+    def now_iso(self) -> str:
+        return self.now().strftime("%Y-%m-%dT%H:%M:%SZ")
+
+    def seconds_since(self, ts: str) -> float:
+        return (self.now() - parse_iso(ts)).total_seconds()
+
+
+class FakeClock(Clock):
+    """Starts at a fixed instant; advances only when told."""
+
+    def __init__(self, start: str = "2026-01-01T00:00:00Z") -> None:
+        self._now = parse_iso(start)
+
+    def now(self) -> datetime.datetime:
+        return self._now
+
+    def advance(self, seconds: float) -> None:
+        self._now += datetime.timedelta(seconds=seconds)

@@ -1,0 +1,178 @@
+"""Cluster-spec backends: how each replica learns who its peers are.
+
+This is the reference's entire "distributed communication bootstrap"
+(SURVEY.md §2 #13): the operator never moves tensors, it tells each
+process its peers and lets the data plane (TF gRPC / NCCL there,
+XLA-over-ICI/DCN here) do the rest.
+
+Two pluggable backends:
+
+- **TF_CONFIG** (reference pkg/controller.v1/tensorflow/tensorflow.go:
+  97-198): JSON env var with the full DNS cluster spec; sparse variant
+  for elastic workers (tensorflow.go:64-83); hostNetwork port overrides
+  read from job annotations (tensorflow.go:165-173).
+
+- **TPU** (new, the BASELINE.json north star): for TPU replica sets the
+  pod-slice bootstrap env is injected instead — ``TPU_WORKER_ID``,
+  ``TPU_WORKER_HOSTNAMES``, topology vars — which libtpu reads to form
+  the ICI mesh, plus JAX coordinator env so
+  ``jax.distributed.initialize()`` comes up with zero flags (the role
+  GKE's TPU webhook plays for native GKE TPU workloads).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+from typing import Dict, List, Optional
+
+from ..api import k8s
+from ..api.types import (
+    DEFAULT_CONTAINER_NAME,
+    DEFAULT_PORT,
+    DEFAULT_PORT_NAME,
+    ENV_COORDINATOR_ADDRESS,
+    ENV_CUSTOM_CLUSTER_DOMAIN,
+    ENV_NUM_PROCESSES,
+    ENV_PROCESS_ID,
+    ENV_TF_CONFIG,
+    ENV_TPU_ACCELERATOR,
+    ENV_TPU_TOPOLOGY,
+    ENV_TPU_WORKER_HOSTNAMES,
+    ENV_TPU_WORKER_ID,
+    ReplicaType,
+    TFJob,
+    replica_name,
+)
+
+
+def replica_port(job: TFJob, rtype: str) -> int:
+    """Port declared as "tfjob-port" on the workload container
+    (reference GetPortFromTFJob, tensorflow.go:86-95)."""
+    spec = job.spec.tf_replica_specs.get(rtype)
+    if spec is not None:
+        container = spec.template.spec.container(DEFAULT_CONTAINER_NAME)
+        if container is not None:
+            for port in container.ports:
+                if port.name == DEFAULT_PORT_NAME:
+                    return port.container_port
+    return DEFAULT_PORT
+
+
+def service_dns(job: TFJob, rtype: str, index: int) -> str:
+    """Stable DNS identity from the per-replica headless service:
+    "{job}-{type}-{i}.{ns}.svc[.{domain}]" (reference tensorflow.go:155-163)."""
+    host = f"{replica_name(job.name, rtype, index)}.{job.namespace}.svc"
+    domain = os.environ.get(ENV_CUSTOM_CLUSTER_DOMAIN, "")
+    if domain:
+        host += "." + domain
+    return host
+
+
+def _annotation_port(job: TFJob, rt: str, index: int) -> Optional[int]:
+    """hostNetwork port override persisted by the PortAllocator in job
+    annotations as "{rt}: p0,p1,..." (reference tensorflow.go:165-173)."""
+    raw = job.metadata.annotations.get(rt)
+    if not raw:
+        return None
+    ports = raw.split(",")
+    if index < len(ports):
+        try:
+            value = int(ports[index])
+        except ValueError:
+            return None
+        if value != 0:
+            return value
+    return None
+
+
+def gen_cluster_spec(job: TFJob) -> Dict[str, List[str]]:
+    """Full cluster spec: lowercase replica type -> ["dns:port", ...]
+    (reference genClusterSpec, tensorflow.go:142-198)."""
+    cluster: Dict[str, List[str]] = {}
+    for rtype, spec in job.spec.tf_replica_specs.items():
+        if spec is None:
+            continue
+        rt = rtype.lower()
+        port = replica_port(job, rtype)
+        host_network = bool(spec.template.spec.host_network)
+        endpoints = []
+        for index in range(spec.replicas or 1):
+            endpoint_port = port
+            if host_network and port == DEFAULT_PORT:
+                endpoint_port = _annotation_port(job, rt, index) or port
+            endpoints.append(f"{service_dns(job, rt, index)}:{endpoint_port}")
+        cluster[rt] = endpoints
+    return cluster
+
+
+def is_distributed(job: TFJob) -> bool:
+    """Single-process jobs get no TF_CONFIG (reference isDistributed,
+    pod.go:286-307 / kubeflow#1078)."""
+    return job.total_replicas() != 1
+
+
+def gen_tf_config(job: TFJob, rt: str, index: int) -> str:
+    """TF_CONFIG JSON for one task (reference genTFConfigJSONStr,
+    tensorflow.go:97-139). Elastic jobs get the sparse form: the task's
+    own worker entry plus all PS, so workers can join/leave without
+    rewriting every peer's config."""
+    cluster = gen_cluster_spec(job)
+    task = {"type": rt, "index": index}
+    if job.spec.enable_dynamic_worker:
+        sparse: Dict[str, object] = {"worker": {}, "ps": []}
+        ps_key = ReplicaType.PS.value.lower()
+        worker_key = ReplicaType.WORKER.value.lower()
+        if rt == ps_key:
+            sparse["ps"] = [cluster[rt][index]]
+        elif rt == worker_key:
+            sparse["ps"] = cluster.get(ps_key, [])
+            sparse["worker"] = {index: cluster[rt][index]}
+        return json.dumps({"sparseCluster": sparse, "task": task})
+    return json.dumps({"cluster": cluster, "task": task, "environment": "cloud"})
+
+
+def set_tf_config(template: k8s.PodTemplateSpec, job: TFJob, rt: str, index: int) -> None:
+    """Inject TF_CONFIG into the workload container (reference
+    setClusterSpec, pod.go:254-282)."""
+    if not is_distributed(job):
+        return
+    container = template.spec.container(DEFAULT_CONTAINER_NAME)
+    if container is None:
+        return
+    container.set_env(ENV_TF_CONFIG, gen_tf_config(job, rt, index))
+
+
+def set_tpu_env(template: k8s.PodTemplateSpec, job: TFJob, rt: str, index: int) -> None:
+    """Inject the TPU pod-slice bootstrap env for a TPU replica.
+
+    All pods of one TPU replica set are hosts of a single logical slice:
+    worker ``index`` is host ``TPU_WORKER_ID`` of the ICI mesh, and
+    every host must know every hostname to wire the mesh. JAX processes
+    additionally get coordinator env so jax.distributed.initialize()
+    needs no arguments.
+    """
+    spec = job.spec.tf_replica_specs.get(ReplicaType.TPU.value)
+    if spec is None or rt != ReplicaType.TPU.value.lower():
+        return
+    container = template.spec.container(DEFAULT_CONTAINER_NAME)
+    if container is None:
+        return
+    replicas = spec.replicas or 1
+    port = replica_port(job, ReplicaType.TPU.value)
+    hostnames = [service_dns(job, rt, i) for i in range(replicas)]
+    container.set_env(ENV_TPU_WORKER_ID, str(index))
+    container.set_env(ENV_TPU_WORKER_HOSTNAMES, ",".join(hostnames))
+    if spec.tpu_topology:
+        container.set_env(ENV_TPU_TOPOLOGY, spec.tpu_topology)
+    if spec.tpu_accelerator:
+        container.set_env(ENV_TPU_ACCELERATOR, spec.tpu_accelerator)
+    container.set_env(ENV_COORDINATOR_ADDRESS, f"{hostnames[0]}:{port}")
+    container.set_env(ENV_NUM_PROCESSES, str(replicas))
+    container.set_env(ENV_PROCESS_ID, str(index))
+
+
+def set_cluster_spec(template: k8s.PodTemplateSpec, job: TFJob, rt: str, index: int) -> None:
+    """Apply every applicable backend for this replica."""
+    set_tf_config(template, job, rt, index)
+    set_tpu_env(template, job, rt, index)

@@ -1,0 +1,275 @@
+"""TFJobController: watch wiring, workqueue, admission, sync loop.
+
+Re-design of reference controller.go:104-343 + job.go:35-183 on top of
+the Substrate seam: informer event handlers feed expectations and the
+rate-limited queue; workers pop keys and run the Reconciler; status is
+persisted only on change (controller.go:505-508).
+"""
+
+from __future__ import annotations
+
+import logging
+import threading
+from typing import List, Optional
+
+from ..api import k8s, set_defaults, validate
+from ..api.types import ConditionType, TFJob, gen_labels
+from ..api.validation import ValidationError
+from ..runtime import (
+    ADDED,
+    DELETED,
+    MODIFIED,
+    ControllerExpectations,
+    EventRecorder,
+    NotFound,
+    RateLimitingQueue,
+    RealPodControl,
+    RealServiceControl,
+)
+from .clock import Clock
+from .reconciler import (
+    Reconciler,
+    ReconcilerConfig,
+    expectation_pods_key,
+    expectation_services_key,
+)
+from .status import REASON_CREATED, set_condition
+
+logger = logging.getLogger("tf_operator_tpu.controller")
+
+REASON_FAILED_VALIDATION = "TFJobFailedValidation"
+
+
+def _controller_owner(meta: k8s.ObjectMeta) -> Optional[k8s.OwnerReference]:
+    for ref in meta.owner_references:
+        if ref.controller:
+            return ref
+    return None
+
+
+class TFJobController:
+    def __init__(
+        self,
+        substrate,
+        config: Optional[ReconcilerConfig] = None,
+        clock: Optional[Clock] = None,
+        namespace: Optional[str] = None,
+        metrics=None,
+        gang=None,
+        port_allocator=None,
+    ) -> None:
+        self.substrate = substrate
+        self.clock = clock or Clock()
+        self.namespace = namespace
+        self.metrics = metrics
+        self.port_allocator = port_allocator
+        self.recorder = EventRecorder(substrate)
+        self.expectations = ControllerExpectations()
+        self.queue = RateLimitingQueue()
+        self.reconciler = Reconciler(
+            pod_control=RealPodControl(substrate, self.recorder),
+            service_control=RealServiceControl(substrate, self.recorder),
+            recorder=self.recorder,
+            expectations=self.expectations,
+            clock=self.clock,
+            config=config,
+            num_requeues=self.queue.num_requeues,
+            schedule_resync=self.queue.add_after,
+            delete_job=self._delete_job,
+            gang=gang,
+            metrics=metrics,
+        )
+        self._stop = threading.Event()
+        self._workers: List[threading.Thread] = []
+
+        substrate.subscribe("tfjob", self._on_job)
+        substrate.subscribe("pod", self._on_pod)
+        substrate.subscribe("service", self._on_service)
+
+    # -- event handlers (the informer side) --------------------------------
+
+    def _in_scope(self, namespace: str) -> bool:
+        return self.namespace is None or namespace == self.namespace
+
+    def _on_job(self, verb: str, job: TFJob) -> None:
+        if not self._in_scope(job.namespace):
+            return
+        if verb == ADDED:
+            self._admit(job)
+        elif verb == MODIFIED:
+            # re-arm the deadline timer if one applies
+            # (reference job.go:166-182)
+            deadline = job.spec.run_policy.active_deadline_seconds
+            if deadline is not None and job.status.start_time is not None:
+                remaining = deadline - self.clock.seconds_since(job.status.start_time)
+                self.queue.add_after(job.key(), max(0.0, remaining))
+            self.enqueue(job.key())
+        elif verb == DELETED:
+            self.expectations.delete_expectations(job.key())
+            if self.metrics is not None:
+                self.metrics.deleted()
+
+    def _admit(self, job: TFJob) -> None:
+        """Admission-time work (reference addTFJob, job.go:35-144):
+        default, validate (invalid jobs are marked Failed, not crashed
+        on), allocate hostNetwork ports, stamp Created, enqueue."""
+        job = job.copy()
+        set_defaults(job)
+        try:
+            validate(job)
+        except ValidationError as err:
+            logger.warning("job %s failed validation: %s", job.key(), err)
+            self.recorder.event(
+                job.kind, job.name, job.namespace, "Warning",
+                REASON_FAILED_VALIDATION, str(err),
+            )
+            set_condition(
+                job, ConditionType.FAILED, REASON_FAILED_VALIDATION, str(err),
+                self.clock.now_iso(),
+            )
+            self._update_status(job)
+            return
+        if self.port_allocator is not None:
+            annotations = self.port_allocator.allocate(job)
+            if annotations:
+                stored = self.substrate.get_job(job.namespace, job.name)
+                stored.metadata.annotations.update(annotations)
+                self.substrate.update_job(stored)
+        set_condition(
+            job, ConditionType.CREATED, REASON_CREATED,
+            f"TFJob {job.name} is created.", self.clock.now_iso(),
+        )
+        self._update_status(job)
+        if self.metrics is not None:
+            self.metrics.created()
+        self.enqueue(job.key())
+
+    def _on_pod(self, verb: str, pod: k8s.Pod) -> None:
+        if not self._in_scope(pod.metadata.namespace):
+            return
+        owner = _controller_owner(pod.metadata)
+        if owner is None or owner.kind != "TFJob":
+            return
+        job_key = f"{pod.metadata.namespace}/{owner.name}"
+        rt = pod.metadata.labels.get("tf-replica-type", "")
+        if verb == ADDED:
+            self.expectations.creation_observed(expectation_pods_key(job_key, rt))
+        elif verb == DELETED:
+            self.expectations.deletion_observed(expectation_pods_key(job_key, rt))
+        self.enqueue(job_key)
+
+    def _on_service(self, verb: str, svc: k8s.Service) -> None:
+        if not self._in_scope(svc.metadata.namespace):
+            return
+        owner = _controller_owner(svc.metadata)
+        if owner is None or owner.kind != "TFJob":
+            return
+        job_key = f"{svc.metadata.namespace}/{owner.name}"
+        rt = svc.metadata.labels.get("tf-replica-type", "")
+        if verb == ADDED:
+            self.expectations.creation_observed(expectation_services_key(job_key, rt))
+        elif verb == DELETED:
+            self.expectations.deletion_observed(expectation_services_key(job_key, rt))
+        self.enqueue(job_key)
+
+    def enqueue(self, key: str) -> None:
+        self.queue.add(key)
+
+    # -- sync --------------------------------------------------------------
+
+    def _satisfied_expectations(self, job: TFJob) -> bool:
+        """Trust the cache only once every expected child event arrived
+        (reference satisfiedExpectations, controller.go:514-533)."""
+        for rtype in job.replica_types():
+            rt = rtype.value.lower()
+            if not self.expectations.satisfied(expectation_pods_key(job.key(), rt)):
+                return False
+            if not self.expectations.satisfied(
+                expectation_services_key(job.key(), rt)
+            ):
+                return False
+        return True
+
+    def sync(self, key: str) -> None:
+        """Process one key (reference syncTFJob, controller.go:299-343)."""
+        try:
+            namespace, name = key.split("/", 1)
+        except ValueError:
+            logger.error("invalid key %r", key)
+            return
+        try:
+            job = self.substrate.get_job(namespace, name)
+        except NotFound:
+            self.expectations.delete_expectations(key)
+            return
+        set_defaults(job)
+
+        needs_sync = job.spec.enable_dynamic_worker or self._satisfied_expectations(job)
+        if not needs_sync or job.metadata.deletion_timestamp is not None:
+            return
+
+        old_status = job.to_dict().get("status", {})
+        pods = self.substrate.list_pods(namespace, gen_labels(name))
+        services = self.substrate.list_services(namespace, gen_labels(name))
+        self.reconciler.reconcile(job, pods, services)
+        if job.to_dict().get("status", {}) != old_status:
+            self._update_status(job)
+
+    def _update_status(self, job: TFJob) -> None:
+        try:
+            self.substrate.update_job_status(job)
+        except NotFound:
+            pass  # job deleted mid-sync; nothing to persist
+
+    def _delete_job(self, job: TFJob) -> None:
+        """TTL-driven deletion (reference job.go:236-254)."""
+        try:
+            self.substrate.delete_job(job.namespace, job.name)
+        except NotFound:
+            return
+        self.expectations.delete_expectations(job.key())
+        logger.info("job %s deleted after TTL", job.key())
+
+    # -- run loops ---------------------------------------------------------
+
+    def process_next(self, timeout: Optional[float] = None) -> bool:
+        key = self.queue.get(timeout=timeout)
+        if key is None:
+            return False
+        try:
+            self.sync(key)
+        except Exception:
+            logger.exception("error syncing %r; requeueing", key)
+            self.queue.add_rate_limited(key)
+        else:
+            self.queue.forget(key)
+        finally:
+            self.queue.done(key)
+        return True
+
+    def run_until_quiet(self, max_steps: int = 100) -> int:
+        """Drain the queue synchronously — deterministic test loop.
+        Returns the number of keys processed."""
+        steps = 0
+        while steps < max_steps and self.process_next(timeout=0.05):
+            steps += 1
+        return steps
+
+    def run(self, threadiness: int = 1) -> None:
+        """Start worker threads (reference Run, controller.go:189-228)."""
+        for i in range(threadiness):
+            worker = threading.Thread(
+                target=self._worker_loop, name=f"tfjob-worker-{i}", daemon=True
+            )
+            worker.start()
+            self._workers.append(worker)
+
+    def _worker_loop(self) -> None:
+        while not self._stop.is_set():
+            self.process_next(timeout=0.2)
+
+    def stop(self) -> None:
+        self._stop.set()
+        self.queue.shut_down()
+        for worker in self._workers:
+            worker.join(timeout=2)

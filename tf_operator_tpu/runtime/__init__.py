@@ -1,0 +1,49 @@
+from .control import (
+    FakePodControl,
+    FakeServiceControl,
+    RealPodControl,
+    RealServiceControl,
+    is_controlled_by,
+    owner_reference,
+)
+from .events import EventRecorder, NullRecorder
+from .expectations import ControllerExpectations
+from .substrate import (
+    ADDED,
+    DELETED,
+    MODIFIED,
+    AlreadyExists,
+    Conflict,
+    InMemorySubstrate,
+    NotFound,
+    Substrate,
+    match_labels,
+    now_iso,
+)
+from .workqueue import DelayingQueue, ExponentialBackoff, RateLimitingQueue, WorkQueue
+
+__all__ = [
+    "ADDED",
+    "MODIFIED",
+    "DELETED",
+    "AlreadyExists",
+    "Conflict",
+    "NotFound",
+    "Substrate",
+    "InMemorySubstrate",
+    "match_labels",
+    "now_iso",
+    "ControllerExpectations",
+    "WorkQueue",
+    "DelayingQueue",
+    "RateLimitingQueue",
+    "ExponentialBackoff",
+    "EventRecorder",
+    "NullRecorder",
+    "RealPodControl",
+    "RealServiceControl",
+    "FakePodControl",
+    "FakeServiceControl",
+    "owner_reference",
+    "is_controlled_by",
+]

@@ -1,0 +1,52 @@
+"""Event recorder: lifecycle breadcrumbs on the substrate.
+
+Reference: record.EventRecorder wiring at jobcontroller.go:160-163;
+events are part of the operator's observable contract (asserted by the
+E2E suite, py/kubeflow/tf_operator/k8s_util.py:158).
+"""
+
+from __future__ import annotations
+
+import logging
+
+from ..api import k8s
+from .substrate import Substrate
+
+logger = logging.getLogger("tf_operator_tpu.events")
+
+
+class EventRecorder:
+    def __init__(self, substrate: Substrate, component: str = "tfjob-tpu-operator") -> None:
+        self._substrate = substrate
+        self.component = component
+
+    def event(
+        self,
+        obj_kind: str,
+        obj_name: str,
+        namespace: str,
+        event_type: str,
+        reason: str,
+        message: str,
+    ) -> None:
+        self._substrate.record_event(
+            k8s.Event(
+                type=event_type,
+                reason=reason,
+                message=message,
+                involved_object_kind=obj_kind,
+                involved_object_name=obj_name,
+                involved_object_namespace=namespace,
+            )
+        )
+        logger.info(
+            "%s %s %s/%s: %s (%s)",
+            event_type, reason, namespace, obj_name, message, obj_kind,
+        )
+
+
+class NullRecorder:
+    """Recorder that only logs; for tests that don't assert events."""
+
+    def event(self, obj_kind, obj_name, namespace, event_type, reason, message) -> None:
+        logger.debug("%s %s %s/%s: %s", event_type, reason, namespace, obj_name, message)

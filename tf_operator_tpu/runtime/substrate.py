@@ -1,0 +1,357 @@
+"""Substrate: the cluster-API seam the controller runs against.
+
+The reference controller talks to a Kubernetes apiserver through
+client-go clientsets and exercises its logic in tests through *fake*
+clientsets (reference controller_test.go:44-64). We make that seam a
+first-class interface: `Substrate` is the minimal cluster surface the
+job controller needs (TFJob store + pod/service CRUD + watch events),
+with two implementations:
+
+- `InMemorySubstrate` (here): a thread-safe fake apiserver plus a tiny
+  kubelet simulator, the unit/E2E test substrate. Plays the combined
+  role of the reference's fake clientsets and its remote-controllable
+  fake training server (test/test-server/test_app.py:15-82).
+- `KubeSubstrate` (kube.py): real apiserver over stdlib HTTP.
+
+Watch semantics mirror informers: subscribers get (verb, object)
+callbacks after the store mutates; the controller layers expectations
+on top exactly like the reference (jobcontroller/pod.go:20-160).
+"""
+
+from __future__ import annotations
+
+import datetime
+import itertools
+import threading
+from typing import Any, Callable, Dict, Iterable, List, Optional, Protocol, Tuple
+
+from ..api import k8s
+from ..api.serde import deep_copy
+from ..api.types import TFJob
+
+ADDED = "ADDED"
+MODIFIED = "MODIFIED"
+DELETED = "DELETED"
+
+WatchCallback = Callable[[str, Any], None]
+
+
+def now_iso() -> str:
+    return datetime.datetime.now(datetime.timezone.utc).strftime("%Y-%m-%dT%H:%M:%SZ")
+
+
+def match_labels(selector: Dict[str, str], labels: Dict[str, str]) -> bool:
+    return all(labels.get(key) == value for key, value in selector.items())
+
+
+class NotFound(KeyError):
+    pass
+
+
+class AlreadyExists(ValueError):
+    pass
+
+
+class Conflict(RuntimeError):
+    """Optimistic-concurrency failure (stale resourceVersion)."""
+
+
+class Substrate(Protocol):
+    """What the controller requires of a cluster. All objects passed in
+    and returned are owned by the caller (value semantics)."""
+
+    # TFJob store (the CRD)
+    def list_jobs(self, namespace: Optional[str] = None) -> List[TFJob]: ...
+    def get_job(self, namespace: str, name: str) -> TFJob: ...
+    def update_job_status(self, job: TFJob) -> TFJob: ...
+    def delete_job(self, namespace: str, name: str) -> None: ...
+
+    # Pods
+    def create_pod(self, pod: k8s.Pod) -> k8s.Pod: ...
+    def get_pod(self, namespace: str, name: str) -> k8s.Pod: ...
+    def list_pods(
+        self, namespace: str, selector: Optional[Dict[str, str]] = None
+    ) -> List[k8s.Pod]: ...
+    def delete_pod(self, namespace: str, name: str) -> None: ...
+    def patch_pod_labels(
+        self, namespace: str, name: str, labels: Dict[str, str]
+    ) -> k8s.Pod: ...
+
+    # Services
+    def create_service(self, service: k8s.Service) -> k8s.Service: ...
+    def list_services(
+        self, namespace: str, selector: Optional[Dict[str, str]] = None
+    ) -> List[k8s.Service]: ...
+    def delete_service(self, namespace: str, name: str) -> None: ...
+
+    # Events + watches
+    def record_event(self, event: k8s.Event) -> None: ...
+    def subscribe(self, kind: str, callback: WatchCallback) -> None: ...
+
+
+class InMemorySubstrate:
+    """Fake apiserver + kubelet simulator for tests and local runs.
+
+    Kubelet simulation is explicit: tests drive pod phases with
+    ``mark_pod_running`` / ``terminate_pod`` the way the reference's E2E
+    suite drives its fake training server's ``/exit?exitCode=n``
+    endpoint (test/test-server/test_app.py:47-53).
+    """
+
+    def __init__(self) -> None:
+        self._lock = threading.RLock()
+        self._uid = itertools.count(1)
+        self._rv = itertools.count(1)
+        self._jobs: Dict[Tuple[str, str], TFJob] = {}
+        self._pods: Dict[Tuple[str, str], k8s.Pod] = {}
+        self._services: Dict[Tuple[str, str], k8s.Service] = {}
+        self.events: List[k8s.Event] = []
+        self._subscribers: Dict[str, List[WatchCallback]] = {}
+
+    # -- plumbing ----------------------------------------------------------
+
+    def _stamp(self, meta: k8s.ObjectMeta) -> None:
+        if not meta.uid:
+            meta.uid = f"uid-{next(self._uid)}"
+        meta.resource_version = str(next(self._rv))
+        if meta.creation_timestamp is None:
+            meta.creation_timestamp = now_iso()
+
+    def _notify(self, kind: str, verb: str, obj: Any) -> None:
+        for callback in self._subscribers.get(kind, []):
+            callback(verb, deep_copy(obj))
+
+    def subscribe(self, kind: str, callback: WatchCallback) -> None:
+        with self._lock:
+            self._subscribers.setdefault(kind, []).append(callback)
+
+    # -- TFJobs ------------------------------------------------------------
+
+    def create_job(self, job: TFJob) -> TFJob:
+        with self._lock:
+            key = (job.namespace, job.name)
+            if key in self._jobs:
+                raise AlreadyExists(f"tfjob {key} exists")
+            job = job.copy()
+            self._stamp(job.metadata)
+            self._jobs[key] = job
+            self._notify("tfjob", ADDED, job)
+            return job.copy()
+
+    def list_jobs(self, namespace: Optional[str] = None) -> List[TFJob]:
+        with self._lock:
+            return [
+                job.copy()
+                for (ns, _), job in self._jobs.items()
+                if namespace is None or ns == namespace
+            ]
+
+    def get_job(self, namespace: str, name: str) -> TFJob:
+        with self._lock:
+            job = self._jobs.get((namespace, name))
+            if job is None:
+                raise NotFound(f"tfjob {namespace}/{name}")
+            return job.copy()
+
+    def update_job(self, job: TFJob) -> TFJob:
+        with self._lock:
+            key = (job.namespace, job.name)
+            if key not in self._jobs:
+                raise NotFound(f"tfjob {key}")
+            stored = self._jobs[key]
+            if (
+                job.metadata.resource_version
+                and job.metadata.resource_version != stored.metadata.resource_version
+            ):
+                raise Conflict(f"tfjob {key}: stale resourceVersion")
+            job = job.copy()
+            job.metadata.resource_version = str(next(self._rv))
+            self._jobs[key] = job
+            self._notify("tfjob", MODIFIED, job)
+            return job.copy()
+
+    def update_job_status(self, job: TFJob) -> TFJob:
+        """Status-subresource write: only .status (+ resourceVersion) moves.
+
+        The reference writes status through UpdateStatus / a raw CRD REST
+        client (status.go:176-184, k8sutil/client.go).
+        """
+        with self._lock:
+            key = (job.namespace, job.name)
+            stored = self._jobs.get(key)
+            if stored is None:
+                raise NotFound(f"tfjob {key}")
+            stored.status = deep_copy(job.status)
+            stored.metadata.resource_version = str(next(self._rv))
+            self._notify("tfjob", MODIFIED, stored)
+            return stored.copy()
+
+    def delete_job(self, namespace: str, name: str) -> None:
+        with self._lock:
+            job = self._jobs.pop((namespace, name), None)
+            if job is None:
+                raise NotFound(f"tfjob {namespace}/{name}")
+            self._notify("tfjob", DELETED, job)
+            self._cascade_delete(job.metadata.uid)
+
+    def _cascade_delete(self, owner_uid: str) -> None:
+        """Garbage-collect children owned (via ownerReferences) by a gone
+        object — the role the k8s GC controller plays for the reference."""
+        for store, kind in ((self._pods, "pod"), (self._services, "service")):
+            doomed = [
+                key
+                for key, obj in store.items()
+                if any(ref.uid == owner_uid for ref in obj.metadata.owner_references)
+            ]
+            for key in doomed:
+                obj = store.pop(key)
+                self._notify(kind, DELETED, obj)
+
+    # -- Pods --------------------------------------------------------------
+
+    def create_pod(self, pod: k8s.Pod) -> k8s.Pod:
+        with self._lock:
+            key = (pod.metadata.namespace, pod.metadata.name)
+            if key in self._pods:
+                raise AlreadyExists(f"pod {key} exists")
+            pod = deep_copy(pod)
+            self._stamp(pod.metadata)
+            pod.status.phase = k8s.POD_PENDING
+            self._pods[key] = pod
+            self._notify("pod", ADDED, pod)
+            return deep_copy(pod)
+
+    def get_pod(self, namespace: str, name: str) -> k8s.Pod:
+        with self._lock:
+            pod = self._pods.get((namespace, name))
+            if pod is None:
+                raise NotFound(f"pod {namespace}/{name}")
+            return deep_copy(pod)
+
+    def list_pods(
+        self, namespace: str, selector: Optional[Dict[str, str]] = None
+    ) -> List[k8s.Pod]:
+        with self._lock:
+            return [
+                deep_copy(pod)
+                for (ns, _), pod in self._pods.items()
+                if ns == namespace
+                and (selector is None or match_labels(selector, pod.metadata.labels))
+            ]
+
+    def delete_pod(self, namespace: str, name: str) -> None:
+        with self._lock:
+            pod = self._pods.pop((namespace, name), None)
+            if pod is None:
+                raise NotFound(f"pod {namespace}/{name}")
+            self._notify("pod", DELETED, pod)
+
+    def patch_pod_labels(
+        self, namespace: str, name: str, labels: Dict[str, str]
+    ) -> k8s.Pod:
+        with self._lock:
+            pod = self._pods.get((namespace, name))
+            if pod is None:
+                raise NotFound(f"pod {namespace}/{name}")
+            pod.metadata.labels.update(labels)
+            pod.metadata.resource_version = str(next(self._rv))
+            self._notify("pod", MODIFIED, pod)
+            return deep_copy(pod)
+
+    # -- Services ----------------------------------------------------------
+
+    def create_service(self, service: k8s.Service) -> k8s.Service:
+        with self._lock:
+            key = (service.metadata.namespace, service.metadata.name)
+            if key in self._services:
+                raise AlreadyExists(f"service {key} exists")
+            service = deep_copy(service)
+            self._stamp(service.metadata)
+            self._services[key] = service
+            self._notify("service", ADDED, service)
+            return deep_copy(service)
+
+    def list_services(
+        self, namespace: str, selector: Optional[Dict[str, str]] = None
+    ) -> List[k8s.Service]:
+        with self._lock:
+            return [
+                deep_copy(svc)
+                for (ns, _), svc in self._services.items()
+                if ns == namespace
+                and (selector is None or match_labels(selector, svc.metadata.labels))
+            ]
+
+    def delete_service(self, namespace: str, name: str) -> None:
+        with self._lock:
+            svc = self._services.pop((namespace, name), None)
+            if svc is None:
+                raise NotFound(f"service {namespace}/{name}")
+            self._notify("service", DELETED, svc)
+
+    # -- Events ------------------------------------------------------------
+
+    def record_event(self, event: k8s.Event) -> None:
+        with self._lock:
+            if event.timestamp is None:
+                event.timestamp = now_iso()
+            self.events.append(event)
+
+    def events_for(self, kind: str, name: str) -> List[k8s.Event]:
+        with self._lock:
+            return [
+                e
+                for e in self.events
+                if e.involved_object_kind == kind and e.involved_object_name == name
+            ]
+
+    # -- Kubelet simulator -------------------------------------------------
+
+    def mark_pod_running(self, namespace: str, name: str) -> None:
+        self._set_phase(namespace, name, k8s.POD_RUNNING)
+
+    def terminate_pod(self, namespace: str, name: str, exit_code: int = 0) -> None:
+        """Terminate the main container with a chosen exit code — the
+        in-process analog of the fake server's /exit?exitCode=n."""
+        phase = k8s.POD_SUCCEEDED if exit_code == 0 else k8s.POD_FAILED
+        with self._lock:
+            pod = self._pods.get((namespace, name))
+            if pod is None:
+                raise NotFound(f"pod {namespace}/{name}")
+            pod.status.phase = phase
+            container_name = (
+                pod.spec.containers[0].name if pod.spec.containers else "tensorflow"
+            )
+            pod.status.container_statuses = [
+                k8s.ContainerStatus(
+                    name=container_name,
+                    state=k8s.ContainerState(
+                        terminated=k8s.ContainerStateTerminated(exit_code=exit_code)
+                    ),
+                )
+            ]
+            pod.metadata.resource_version = str(next(self._rv))
+            self._notify("pod", MODIFIED, pod)
+
+    def _set_phase(self, namespace: str, name: str, phase: str) -> None:
+        with self._lock:
+            pod = self._pods.get((namespace, name))
+            if pod is None:
+                raise NotFound(f"pod {namespace}/{name}")
+            pod.status.phase = phase
+            pod.metadata.resource_version = str(next(self._rv))
+            self._notify("pod", MODIFIED, pod)
+
+    def run_all_pending(self, namespace: Optional[str] = None) -> int:
+        """Advance every Pending pod to Running (a permissive scheduler +
+        kubelet tick). Returns how many pods moved."""
+        with self._lock:
+            moved = []
+            for (ns, name), pod in self._pods.items():
+                if namespace is not None and ns != namespace:
+                    continue
+                if pod.status.phase == k8s.POD_PENDING:
+                    moved.append((ns, name))
+        for ns, name in moved:
+            self.mark_pod_running(ns, name)
+        return len(moved)

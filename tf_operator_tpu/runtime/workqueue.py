@@ -1,0 +1,149 @@
+"""Rate-limited work queue with client-go semantics.
+
+The reference's hot loop is driven by a client-go
+RateLimitingInterface (reference jobcontroller.go:126-136, 189-194):
+an item is never processed by two workers at once, re-adds during
+processing coalesce into one redo, and per-item retries back off
+exponentially. Those invariants are the controller's concurrency
+model, so they're reproduced here exactly.
+
+A C++ implementation with the same interface lives in native/ (see
+native_queue.py); this pure-Python one is the reference semantics and
+the fallback.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import Dict, Hashable, Optional, Set
+
+
+class ExponentialBackoff:
+    """Per-item exponential failure backoff (client-go
+    ItemExponentialFailureRateLimiter; defaults 5ms base, 1000s cap)."""
+
+    def __init__(self, base_delay: float = 0.005, max_delay: float = 1000.0) -> None:
+        self.base_delay = base_delay
+        self.max_delay = max_delay
+        self._failures: Dict[Hashable, int] = {}
+        self._lock = threading.Lock()
+
+    def when(self, item: Hashable) -> float:
+        with self._lock:
+            failures = self._failures.get(item, 0)
+            self._failures[item] = failures + 1
+        return min(self.base_delay * (2**failures), self.max_delay)
+
+    def forget(self, item: Hashable) -> None:
+        with self._lock:
+            self._failures.pop(item, None)
+
+    def num_requeues(self, item: Hashable) -> int:
+        with self._lock:
+            return self._failures.get(item, 0)
+
+
+class WorkQueue:
+    """Deduplicating queue: invariants of client-go workqueue.Type.
+
+    - An item added while queued is not duplicated.
+    - An item added while being *processed* ("dirty while running") is
+      re-queued when its worker calls done().
+    - shut_down() drains: get() returns None once empty.
+    """
+
+    def __init__(self) -> None:
+        self._cond = threading.Condition()
+        self._queue: list = []
+        self._dirty: Set[Hashable] = set()
+        self._processing: Set[Hashable] = set()
+        self._shutting_down = False
+
+    def add(self, item: Hashable) -> None:
+        with self._cond:
+            if self._shutting_down or item in self._dirty:
+                return
+            self._dirty.add(item)
+            if item not in self._processing:
+                self._queue.append(item)
+                self._cond.notify()
+
+    def get(self, timeout: Optional[float] = None) -> Optional[Hashable]:
+        """Block for the next item; None on shutdown-and-drained or timeout."""
+        with self._cond:
+            deadline = None if timeout is None else time.monotonic() + timeout
+            while not self._queue:
+                if self._shutting_down:
+                    return None
+                remaining = None if deadline is None else deadline - time.monotonic()
+                if remaining is not None and remaining <= 0:
+                    return None
+                self._cond.wait(remaining)
+            item = self._queue.pop(0)
+            self._processing.add(item)
+            self._dirty.discard(item)
+            return item
+
+    def done(self, item: Hashable) -> None:
+        with self._cond:
+            self._processing.discard(item)
+            if item in self._dirty:
+                self._queue.append(item)
+                self._cond.notify()
+
+    def shut_down(self) -> None:
+        with self._cond:
+            self._shutting_down = True
+            self._cond.notify_all()
+
+    def __len__(self) -> int:
+        with self._cond:
+            return len(self._queue)
+
+
+class DelayingQueue(WorkQueue):
+    """WorkQueue plus add_after, via a background timer thread."""
+
+    def __init__(self) -> None:
+        super().__init__()
+        self._timer_lock = threading.Lock()
+        self._timers: Set[threading.Timer] = set()
+
+    def add_after(self, item: Hashable, delay: float) -> None:
+        if delay <= 0:
+            self.add(item)
+            return
+        timer = threading.Timer(delay, self._fire, args=(item,))
+        timer.daemon = True
+        with self._timer_lock:
+            self._timers.add(timer)
+        timer.start()
+
+    def _fire(self, item: Hashable) -> None:
+        self.add(item)
+
+    def shut_down(self) -> None:
+        with self._timer_lock:
+            for timer in self._timers:
+                timer.cancel()
+            self._timers.clear()
+        super().shut_down()
+
+
+class RateLimitingQueue(DelayingQueue):
+    """DelayingQueue plus per-item exponential retry accounting
+    (client-go RateLimitingInterface: AddRateLimited/Forget/NumRequeues)."""
+
+    def __init__(self, backoff: Optional[ExponentialBackoff] = None) -> None:
+        super().__init__()
+        self._backoff = backoff or ExponentialBackoff()
+
+    def add_rate_limited(self, item: Hashable) -> None:
+        self.add_after(item, self._backoff.when(item))
+
+    def forget(self, item: Hashable) -> None:
+        self._backoff.forget(item)
+
+    def num_requeues(self, item: Hashable) -> int:
+        return self._backoff.num_requeues(item)
