@@ -1,16 +1,26 @@
-"""Test bootstrap: force an 8-device virtual CPU mesh before JAX loads.
+"""Test bootstrap: force an 8-device virtual CPU mesh.
 
 Mirrors the reference's "multi-node-without-a-cluster" unit strategy
-(SURVEY.md §4): distributed semantics are exercised in-process. For the
-workload plane that means a virtual 8-device mesh on CPU; for the
-control plane it means the InMemorySubstrate fake.
+(SURVEY.md §4): distributed semantics are exercised in-process — a
+virtual 8-device CPU mesh for the workload plane, the InMemorySubstrate
+fake for the control plane.
+
+Note: this environment may import jax at interpreter startup (a TPU
+PJRT plugin via sitecustomize), so setting env vars here is not enough;
+we override through jax.config, which wins as long as no backend has
+been initialized yet. Unit tests must run on CPU even when a TPU is
+attached — TPU benchmarking happens only in bench.py.
 """
 
 import os
 
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
 flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in flags:
     os.environ["XLA_FLAGS"] = (
         flags + " --xla_force_host_platform_device_count=8"
     ).strip()
+os.environ["JAX_PLATFORMS"] = "cpu"
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")

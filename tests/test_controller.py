@@ -551,6 +551,58 @@ class TestLifecycle:
         with pytest.raises(Exception):
             sub.get_job("default", "ttl-job")
 
+    def test_exit_code_restarts_respect_backoff_limit(self):
+        """ExitCode restarts burn BackoffLimit retries; once exhausted,
+        the next retryable failure is fatal."""
+        sub, controller = self.setup_controller()
+        job = make_job({"Worker": 1}, name="flappy")
+        job.spec.tf_replica_specs["Worker"].restart_policy = t.RestartPolicy.EXIT_CODE
+        job.spec.run_policy.backoff_limit = 2
+        self.run_job(sub, controller, job)
+
+        for attempt in range(2):  # two retryable failures: restarts
+            sub.run_all_pending()
+            controller.run_until_quiet()
+            sub.terminate_pod("default", "flappy-worker-0", exit_code=137)
+            controller.run_until_quiet()
+            assert not sub.get_job("default", "flappy").has_condition(
+                t.ConditionType.FAILED
+            ), f"failed too early on attempt {attempt}"
+        # third retryable failure: retries exhausted -> Failed
+        sub.run_all_pending()
+        controller.run_until_quiet()
+        sub.terminate_pod("default", "flappy-worker-0", exit_code=137)
+        controller.run_until_quiet()
+        assert sub.get_job("default", "flappy").has_condition(t.ConditionType.FAILED)
+
+    def test_preexisting_job_picked_up_by_resync(self):
+        """Jobs created before the controller exists must still converge
+        (informer initial LIST semantics)."""
+        sub = InMemorySubstrate()
+        sub.create_job(make_job({"Worker": 2}, name="early"))
+        controller = TFJobController(sub)
+        controller.resync()
+        controller.run_until_quiet()
+        assert len(sub.list_pods("default")) == 2
+        assert sub.get_job("default", "early").has_condition(t.ConditionType.CREATED)
+
+    def test_dynamic_scale_to_zero(self):
+        sub, controller = self.setup_controller()
+        job = make_job({"Worker": 3, "PS": 1}, name="shrink")
+        job.spec.enable_dynamic_worker = True
+        self.run_job(sub, controller, job)
+        assert len(sub.list_pods("default")) == 4
+
+        stored = sub.get_job("default", "shrink")
+        stored.spec.tf_replica_specs["Worker"].replicas = 0
+        sub.update_job(stored)
+        controller.run_until_quiet()
+        workers = [
+            p for p in sub.list_pods("default")
+            if p.metadata.labels[t.LABEL_REPLICA_TYPE] == "worker"
+        ]
+        assert workers == []  # not perpetually recreating worker 0
+
     def test_namespace_scoping(self):
         sub = InMemorySubstrate()
         controller = TFJobController(sub, namespace="watched")

@@ -174,11 +174,15 @@ class TFJobSpec:
 
 @dataclass
 class ReplicaStatus:
-    """Reference common/v1/types.go:38-50."""
+    """Reference common/v1/types.go:38-50, plus a persistent restart
+    counter (new): ExitCode restarts must count toward BackoffLimit
+    across syncs and controller restarts, so they live in status rather
+    than controller memory."""
 
     active: int = 0
     succeeded: int = 0
     failed: int = 0
+    restarts: int = 0
 
 
 @dataclass

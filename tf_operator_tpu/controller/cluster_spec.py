@@ -97,7 +97,8 @@ def gen_cluster_spec(job: TFJob) -> Dict[str, List[str]]:
         port = replica_port(job, rtype)
         host_network = bool(spec.template.spec.host_network)
         endpoints = []
-        for index in range(spec.replicas or 1):
+        replicas = spec.replicas if spec.replicas is not None else 1
+        for index in range(replicas):
             endpoint_port = port
             if host_network and port == DEFAULT_PORT:
                 endpoint_port = _annotation_port(job, rt, index) or port
@@ -158,7 +159,7 @@ def set_tpu_env(template: k8s.PodTemplateSpec, job: TFJob, rt: str, index: int) 
     container = template.spec.container(DEFAULT_CONTAINER_NAME)
     if container is None:
         return
-    replicas = spec.replicas or 1
+    replicas = spec.replicas if spec.replicas is not None else 1
     port = replica_port(job, ReplicaType.TPU.value)
     hostnames = [service_dns(job, rt, i) for i in range(replicas)]
     container.set_env(ENV_TPU_WORKER_ID, str(index))

@@ -13,6 +13,7 @@ import threading
 from typing import List, Optional
 
 from ..api import k8s, set_defaults, validate
+from ..api.serde import to_jsonable
 from ..api.types import ConditionType, TFJob, gen_labels
 from ..api.validation import ValidationError
 from ..runtime import (
@@ -208,11 +209,11 @@ class TFJobController:
         if not needs_sync or job.metadata.deletion_timestamp is not None:
             return
 
-        old_status = job.to_dict().get("status", {})
+        old_status = to_jsonable(job.status)
         pods = self.substrate.list_pods(namespace, gen_labels(name))
         services = self.substrate.list_services(namespace, gen_labels(name))
         self.reconciler.reconcile(job, pods, services)
-        if job.to_dict().get("status", {}) != old_status:
+        if to_jsonable(job.status) != old_status:
             self._update_status(job)
 
     def _update_status(self, job: TFJob) -> None:
@@ -231,6 +232,17 @@ class TFJobController:
         logger.info("job %s deleted after TTL", job.key())
 
     # -- run loops ---------------------------------------------------------
+
+    def resync(self) -> None:
+        """Initial LIST + periodic level-trigger: pick up jobs that
+        existed before this controller subscribed (informer initial list
+        + resync in the reference, server.go:119-133 / options.go:24).
+        Jobs that never went through admission get admitted now."""
+        for job in self.substrate.list_jobs(self.namespace):
+            if not job.status.conditions and not job.is_finished():
+                self._admit(job)
+            else:
+                self.enqueue(job.key())
 
     def process_next(self, timeout: Optional[float] = None) -> bool:
         key = self.queue.get(timeout=timeout)
@@ -255,14 +267,29 @@ class TFJobController:
             steps += 1
         return steps
 
-    def run(self, threadiness: int = 1) -> None:
+    def run(self, threadiness: int = 1, resync_period: float = 30.0) -> None:
         """Start worker threads (reference Run, controller.go:189-228)."""
+        self.resync()
         for i in range(threadiness):
             worker = threading.Thread(
                 target=self._worker_loop, name=f"tfjob-worker-{i}", daemon=True
             )
             worker.start()
             self._workers.append(worker)
+        if resync_period > 0:
+            resyncer = threading.Thread(
+                target=self._resync_loop, args=(resync_period,),
+                name="tfjob-resync", daemon=True,
+            )
+            resyncer.start()
+            self._workers.append(resyncer)
+
+    def _resync_loop(self, period: float) -> None:
+        while not self._stop.wait(period):
+            try:
+                self.resync()
+            except Exception:
+                logger.exception("resync failed")
 
     def _worker_loop(self) -> None:
         while not self._stop.is_set():

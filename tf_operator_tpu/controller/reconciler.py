@@ -278,9 +278,17 @@ class Reconciler:
         """Converge one replica set (reference reconcilePods, pod.go:52-151)."""
         rt = rtype.value.lower()
         typed_pods = filter_by_replica_type(pods, rt)
-        replicas = spec.replicas or 1
+        replicas = spec.replicas if spec.replicas is not None else 1
         restart = False
         worker0_completed = False
+        # ExitCode restarts count toward BackoffLimit: once the job has
+        # burned its retries (persisted in status.replicaStatuses[*].
+        # restarts), the next retryable failure becomes fatal.
+        backoff = job.spec.run_policy.backoff_limit
+        retries_left = None
+        if backoff is not None:
+            used = sum(s.restarts for s in job.status.replica_statuses.values())
+            retries_left = backoff - used
 
         initialize_replica_statuses(job, rtype)
         slices, out_of_range = slices_by_index(typed_pods, replicas)
@@ -288,7 +296,7 @@ class Reconciler:
         if job.spec.enable_dynamic_worker and out_of_range:
             if rtype == ReplicaType.WORKER:
                 for pod in out_of_range:
-                    self.pod_control.delete_pod(job.namespace, pod.metadata.name, job)
+                    self._delete_pod(job, pod, rt)
                     self._job_event(
                         job, "Normal", EVENT_SCALE_DOWN,
                         f"Pod {pod.metadata.name} is being removed",
@@ -318,6 +326,7 @@ class Reconciler:
                     and pod.status.phase == k8s.POD_FAILED
                     and exit_code is not None
                     and is_retryable_exit_code(exit_code)
+                    and (retries_left is None or retries_left > 0)
                 ):
                     if rtype == ReplicaType.TPU:
                         # A multi-host slice is ONE logical accelerator:
@@ -331,10 +340,10 @@ class Reconciler:
                         # Transient failure: delete the pod; the next
                         # sync recreates it at the same index
                         # (pod.go:131-139).
-                        self.pod_control.delete_pod(
-                            job.namespace, pod.metadata.name, job
-                        )
+                        self._delete_pod(job, pod, rt)
                         restart = True
+                    if retries_left is not None:
+                        retries_left -= 1
                 if (
                     rtype in (ReplicaType.WORKER, ReplicaType.TPU)
                     and index == 0
@@ -347,11 +356,14 @@ class Reconciler:
         if restart and rtype == ReplicaType.TPU:
             # slice-granular restart: tear down every host of the slice
             for pod in typed_pods:
-                self.pod_control.delete_pod(job.namespace, pod.metadata.name, job)
+                self._delete_pod(job, pod, rt)
                 self._job_event(
                     job, "Normal", EVENT_SLICE_RESTART,
                     f"Pod {pod.metadata.name} is being restarted with its slice",
                 )
+
+        if restart:
+            job.status.replica_statuses[rtype.value].restarts += 1
 
         self.status_updater.update_status_single(
             job, rtype, replicas, restart, worker0_completed
@@ -411,6 +423,27 @@ class Reconciler:
             self.expectations.creation_observed(key)
             raise
 
+    def _delete_pod(self, job: TFJob, pod: k8s.Pod, rt: str) -> None:
+        """Delete with deletion-expectation accounting, the mirror of the
+        create path: under an informer-lagged substrate the next sync
+        must not act on a cache that still lists this pod."""
+        key = expectation_pods_key(job.key(), rt)
+        self.expectations.raise_expectations(key, 0, 1)
+        try:
+            self.pod_control.delete_pod(job.namespace, pod.metadata.name, job)
+        except Exception:
+            self.expectations.deletion_observed(key)
+            raise
+
+    def _delete_service(self, job: TFJob, svc: k8s.Service, rt: str) -> None:
+        key = expectation_services_key(job.key(), rt)
+        self.expectations.raise_expectations(key, 0, 1)
+        try:
+            self.service_control.delete_service(job.namespace, svc.metadata.name, job)
+        except Exception:
+            self.expectations.deletion_observed(key)
+            raise
+
     def _rewrite_host_ports(
         self, job: TFJob, template: k8s.PodTemplateSpec, rt: str, index: int
     ) -> None:
@@ -464,12 +497,12 @@ class Reconciler:
         identities the cluster spec points at (reference service.go:35-143)."""
         rt = rtype.value.lower()
         typed = filter_by_replica_type(services, rt)
-        replicas = spec.replicas or 1
+        replicas = spec.replicas if spec.replicas is not None else 1
         slices, out_of_range = slices_by_index(typed, replicas)
 
         if job.spec.enable_dynamic_worker and out_of_range:
             for svc in out_of_range:
-                self.service_control.delete_service(job.namespace, svc.metadata.name, job)
+                self._delete_service(job, svc, rt)
 
         for index, svc_slice in enumerate(slices):
             if len(svc_slice) > 1:
@@ -518,9 +551,11 @@ class Reconciler:
         for pod in pods:
             if policy == CleanPodPolicy.RUNNING and not pod.is_active():
                 continue
-            self.pod_control.delete_pod(job.namespace, pod.metadata.name, job)
+            rt = pod.metadata.labels.get(LABEL_REPLICA_TYPE, "")
+            self._delete_pod(job, pod, rt)
         for svc in services:
-            self.service_control.delete_service(job.namespace, svc.metadata.name, job)
+            rt = svc.metadata.labels.get(LABEL_REPLICA_TYPE, "")
+            self._delete_service(job, svc, rt)
 
     def cleanup_job(self, job: TFJob) -> None:
         """TTLSecondsAfterFinished (reference job.go:210-233): delete the

@@ -97,9 +97,13 @@ def set_condition(
 
 
 def initialize_replica_statuses(job: TFJob, rtype: ReplicaType) -> None:
-    """Reset counters for one replica type before re-counting
-    (reference initializeTFReplicaStatuses, status.go:194-202)."""
-    job.status.replica_statuses[rtype.value] = ReplicaStatus()
+    """Reset phase counters for one replica type before re-counting
+    (reference initializeTFReplicaStatuses, status.go:194-202). The
+    restart counter is cumulative and carries over."""
+    old = job.status.replica_statuses.get(rtype.value)
+    job.status.replica_statuses[rtype.value] = ReplicaStatus(
+        restarts=old.restarts if old is not None else 0
+    )
 
 
 def update_replica_status(job: TFJob, rtype: ReplicaType, pod: k8s.Pod) -> None:

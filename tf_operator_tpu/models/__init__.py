@@ -1,0 +1,16 @@
+from .bert import BERT_BASE, BERT_TINY, BertConfig, BertEncoder, BertForMLM, mlm_loss
+from .mnist import MnistCNN
+from .resnet import ResNet, ResNet18ish, ResNet50
+
+__all__ = [
+    "MnistCNN",
+    "ResNet",
+    "ResNet50",
+    "ResNet18ish",
+    "BertConfig",
+    "BertEncoder",
+    "BertForMLM",
+    "BERT_BASE",
+    "BERT_TINY",
+    "mlm_loss",
+]

@@ -1,0 +1,141 @@
+"""BERT encoder for MLM pretraining — the flagship distributed model
+(BASELINE.json config #4: TPUStrategy BERT-base pretraining on a v5e-8
+pod slice; reported as tokens/sec/chip).
+
+TPU-first layout:
+- bf16 weights/activations, f32 layernorm + loss
+- kernel names match parallel/sharding.TRANSFORMER_RULES, so Megatron
+  tensor parallelism and FSDP apply via path rules with zero model
+  changes
+- attention goes through ops/attention's seam: flash (pallas) and ring
+  (sequence-parallel) variants drop in via `attention_fn`
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from flax import linen as nn
+
+from ..ops.attention import MultiHeadAttention
+
+
+@dataclasses.dataclass(frozen=True)
+class BertConfig:
+    vocab_size: int = 30522
+    hidden_size: int = 768
+    num_layers: int = 12
+    num_heads: int = 12
+    intermediate_size: int = 3072
+    max_position_embeddings: int = 512
+    dtype: jnp.dtype = jnp.bfloat16
+
+    @property
+    def head_dim(self) -> int:
+        return self.hidden_size // self.num_heads
+
+
+# BERT-base (the BASELINE pretraining config) and a tiny test variant.
+BERT_BASE = BertConfig()
+BERT_TINY = BertConfig(
+    vocab_size=1024, hidden_size=128, num_layers=2, num_heads=4,
+    intermediate_size=512, max_position_embeddings=128,
+)
+
+
+class TransformerBlock(nn.Module):
+    config: BertConfig
+    attention_fn: object = None
+
+    @nn.compact
+    def __call__(self, x: jax.Array, mask: Optional[jax.Array] = None) -> jax.Array:
+        cfg = self.config
+        y = nn.LayerNorm(dtype=jnp.float32, name="ln_attn")(x)
+        y = MultiHeadAttention(
+            num_heads=cfg.num_heads,
+            head_dim=cfg.head_dim,
+            dtype=cfg.dtype,
+            attention_fn=self.attention_fn,
+            name="attention",
+        )(y.astype(cfg.dtype), mask)
+        x = x + y
+        y = nn.LayerNorm(dtype=jnp.float32, name="ln_mlp")(x)
+        y = nn.Dense(cfg.intermediate_size, dtype=cfg.dtype, name="mlp_in")(
+            y.astype(cfg.dtype)
+        )
+        y = nn.gelu(y)
+        y = nn.Dense(cfg.hidden_size, dtype=cfg.dtype, name="mlp_out")(y)
+        return x + y
+
+
+class BertEncoder(nn.Module):
+    config: BertConfig
+    attention_fn: object = None
+
+    @nn.compact
+    def __call__(
+        self, input_ids: jax.Array, mask: Optional[jax.Array] = None
+    ) -> jax.Array:
+        cfg = self.config
+        tokens = nn.Embed(
+            cfg.vocab_size, cfg.hidden_size, dtype=cfg.dtype, name="token_embed"
+        )(input_ids)
+        positions = nn.Embed(
+            cfg.max_position_embeddings, cfg.hidden_size, dtype=cfg.dtype,
+            name="position_embed",
+        )(jnp.arange(input_ids.shape[-1])[None, :])
+        x = tokens + positions
+        attn_mask = None
+        if mask is not None:
+            # [batch, 1, 1, keys]: broadcast over heads and queries
+            attn_mask = mask[:, None, None, :].astype(bool)
+        for layer in range(cfg.num_layers):
+            x = TransformerBlock(
+                cfg, attention_fn=self.attention_fn, name=f"layer_{layer}"
+            )(x, attn_mask)
+        return nn.LayerNorm(dtype=jnp.float32, name="ln_final")(x)
+
+
+class BertForMLM(nn.Module):
+    """Encoder + tied-embedding MLM head -> [batch, seq, vocab] logits."""
+
+    config: BertConfig
+    attention_fn: object = None
+
+    @nn.compact
+    def __call__(
+        self, input_ids: jax.Array, mask: Optional[jax.Array] = None
+    ) -> jax.Array:
+        cfg = self.config
+        encoder = BertEncoder(cfg, attention_fn=self.attention_fn, name="encoder")
+        hidden = encoder(input_ids, mask)
+        # untied output head (keeps sharding rules simple: vocab on tp)
+        logits = nn.Dense(cfg.vocab_size, dtype=jnp.float32, name="mlm_head")(
+            hidden.astype(cfg.dtype)
+        )
+        return logits
+
+
+def mlm_loss(logits: jax.Array, labels: jax.Array, weights: jax.Array) -> jax.Array:
+    """Masked cross-entropy in f32; `weights` marks the masked positions."""
+    logits = logits.astype(jnp.float32)
+    log_probs = jax.nn.log_softmax(logits, axis=-1)
+    picked = jnp.take_along_axis(log_probs, labels[..., None], axis=-1)[..., 0]
+    weights = weights.astype(jnp.float32)
+    return -(picked * weights).sum() / jnp.maximum(weights.sum(), 1.0)
+
+
+def synthetic_batch(rng: jax.Array, batch_size: int, seq_len: int, cfg: BertConfig):
+    ids_rng, mask_rng = jax.random.split(rng)
+    input_ids = jax.random.randint(ids_rng, (batch_size, seq_len), 0, cfg.vocab_size)
+    # mask ~15% of positions for MLM
+    mlm_mask = jax.random.bernoulli(mask_rng, 0.15, (batch_size, seq_len))
+    return {
+        "input_ids": input_ids,
+        "labels": input_ids,
+        "mlm_weights": mlm_mask.astype(jnp.float32),
+        "attention_mask": jnp.ones((batch_size, seq_len), jnp.int32),
+    }

@@ -1,0 +1,43 @@
+"""MNIST CNN — the framework's hello-world workload.
+
+JAX equivalent of the reference's dist-mnist example
+(reference examples/v1/dist-mnist/dist_mnist.py: 2-conv + fc network
+trained PS/Worker-style); here the same architecture trains
+data-parallel over a mesh, no parameter servers needed — gradients
+all-reduce over ICI.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from flax import linen as nn
+
+
+class MnistCNN(nn.Module):
+    """conv5x5(32) -> pool -> conv5x5(64) -> pool -> fc(1024) -> fc(10),
+    the dist_mnist.py architecture reimagined in linen."""
+
+    dtype: jnp.dtype = jnp.float32
+
+    @nn.compact
+    def __call__(self, x: jax.Array) -> jax.Array:
+        x = x.astype(self.dtype)
+        x = nn.Conv(32, (5, 5), padding="SAME", dtype=self.dtype)(x)
+        x = nn.relu(x)
+        x = nn.max_pool(x, (2, 2), strides=(2, 2))
+        x = nn.Conv(64, (5, 5), padding="SAME", dtype=self.dtype)(x)
+        x = nn.relu(x)
+        x = nn.max_pool(x, (2, 2), strides=(2, 2))
+        x = x.reshape((x.shape[0], -1))
+        x = nn.Dense(1024, dtype=self.dtype)(x)
+        x = nn.relu(x)
+        return nn.Dense(10, dtype=jnp.float32)(x)
+
+
+def synthetic_batch(rng: jax.Array, batch_size: int):
+    """Deterministic synthetic MNIST-shaped data for tests/benchmarks."""
+    image_rng, label_rng = jax.random.split(rng)
+    images = jax.random.normal(image_rng, (batch_size, 28, 28, 1), jnp.float32)
+    labels = jax.random.randint(label_rng, (batch_size,), 0, 10)
+    return {"image": images, "label": labels}

@@ -1,0 +1,98 @@
+"""ResNet-50 — the throughput-benchmark model (BASELINE.md: report
+images/sec/chip on v5e-8).
+
+Counterpart of the reference's MultiWorkerMirrored ResNet-50 config
+(BASELINE.json config #3), built TPU-first:
+- bf16 convolutions/matmuls (MXU), f32 BatchNorm statistics and logits
+- under jit-with-shardings, BatchNorm's batch-mean is a *global* mean:
+  GSPMD turns the reduction over the sharded batch axis into an
+  all-reduce, giving sync-BN across the mesh for free (the thing
+  MultiWorkerMirrored needs NCCL plumbing for)
+- static shapes and channel counts divisible by 128 keep XLA on the
+  MXU's native tiling
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Any, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+from flax import linen as nn
+
+ModuleDef = Any
+
+
+class BottleneckBlock(nn.Module):
+    filters: int
+    strides: Tuple[int, int]
+    conv: ModuleDef
+    norm: ModuleDef
+
+    @nn.compact
+    def __call__(self, x: jax.Array) -> jax.Array:
+        residual = x
+        y = self.conv(self.filters, (1, 1))(x)
+        y = self.norm()(y)
+        y = nn.relu(y)
+        y = self.conv(self.filters, (3, 3), self.strides)(y)
+        y = self.norm()(y)
+        y = nn.relu(y)
+        y = self.conv(self.filters * 4, (1, 1))(y)
+        # zero-init the last BN scale: residual branches start as
+        # identity, the standard trick for large-batch training
+        y = self.norm(scale_init=nn.initializers.zeros)(y)
+        if residual.shape != y.shape:
+            residual = self.conv(
+                self.filters * 4, (1, 1), self.strides, name="proj"
+            )(residual)
+            residual = self.norm(name="proj_bn")(residual)
+        return nn.relu(residual + y)
+
+
+class ResNet(nn.Module):
+    stage_sizes: Sequence[int]
+    num_classes: int = 1000
+    width: int = 64
+    dtype: jnp.dtype = jnp.bfloat16
+
+    @nn.compact
+    def __call__(self, x: jax.Array, train: bool = True) -> jax.Array:
+        conv = partial(nn.Conv, use_bias=False, dtype=self.dtype)
+        norm = partial(
+            nn.BatchNorm,
+            use_running_average=not train,
+            momentum=0.9,
+            epsilon=1e-5,
+            dtype=jnp.float32,
+        )
+        x = x.astype(self.dtype)
+        x = conv(self.width, (7, 7), (2, 2), padding=[(3, 3), (3, 3)], name="stem")(x)
+        x = norm(name="stem_bn")(x)
+        x = nn.relu(x)
+        x = nn.max_pool(x, (3, 3), strides=(2, 2), padding="SAME")
+        for stage, size in enumerate(self.stage_sizes):
+            for block in range(size):
+                strides = (2, 2) if stage > 0 and block == 0 else (1, 1)
+                x = BottleneckBlock(
+                    filters=self.width * 2**stage,
+                    strides=strides,
+                    conv=conv,
+                    norm=norm,
+                )(x)
+        x = jnp.mean(x, axis=(1, 2))
+        return nn.Dense(self.num_classes, dtype=jnp.float32)(x)
+
+
+ResNet50 = partial(ResNet, stage_sizes=(3, 4, 6, 3))
+ResNet18ish = partial(ResNet, stage_sizes=(2, 2, 2, 2))  # small test variant
+
+
+def synthetic_batch(rng: jax.Array, batch_size: int, image_size: int = 224):
+    image_rng, label_rng = jax.random.split(rng)
+    images = jax.random.normal(
+        image_rng, (batch_size, image_size, image_size, 3), jnp.float32
+    )
+    labels = jax.random.randint(label_rng, (batch_size,), 0, 1000)
+    return {"image": images, "label": labels}

@@ -1,0 +1,3 @@
+from .attention import MultiHeadAttention, dot_product_attention
+
+__all__ = ["MultiHeadAttention", "dot_product_attention"]

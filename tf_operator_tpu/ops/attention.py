@@ -1,0 +1,65 @@
+"""Attention ops, structured for TPU execution.
+
+The reference contains no kernels (100% Go control plane; SURVEY.md §2);
+this is net-new data-plane capability. Design notes:
+- weights kept bf16, softmax accumulation in f32 (MXU-native mix)
+- kernel names (query/key/value/attn_out) line up with
+  parallel/sharding.TRANSFORMER_RULES so tp sharding applies by path
+- `dot_product_attention` is the seam where the pallas flash-attention
+  kernel (ops/pallas/) and ring attention (parallel/ring_attention.py)
+  plug in.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from flax import linen as nn
+
+
+def dot_product_attention(
+    query: jax.Array,
+    key: jax.Array,
+    value: jax.Array,
+    mask: Optional[jax.Array] = None,
+) -> jax.Array:
+    """Reference attention: [batch, len, heads, head_dim] inputs.
+
+    Softmax runs in f32 regardless of input dtype; the two einsums stay
+    in the input dtype so they hit the MXU as bf16 matmuls.
+    """
+    depth = query.shape[-1]
+    scale = jnp.asarray(1.0 / jnp.sqrt(depth), dtype=query.dtype)
+    scores = jnp.einsum("bqhd,bkhd->bhqk", query * scale, key)
+    scores = scores.astype(jnp.float32)
+    if mask is not None:
+        scores = jnp.where(mask, scores, jnp.finfo(jnp.float32).min)
+    weights = jax.nn.softmax(scores, axis=-1).astype(query.dtype)
+    return jnp.einsum("bhqk,bkhd->bqhd", weights, value)
+
+
+class MultiHeadAttention(nn.Module):
+    num_heads: int
+    head_dim: int
+    dtype: jnp.dtype = jnp.bfloat16
+    attention_fn: object = None  # swap in flash/ring attention
+
+    @nn.compact
+    def __call__(self, x: jax.Array, mask: Optional[jax.Array] = None) -> jax.Array:
+        features = self.num_heads * self.head_dim
+        dense = lambda name: nn.DenseGeneral(  # noqa: E731
+            features=(self.num_heads, self.head_dim),
+            axis=-1,
+            dtype=self.dtype,
+            name=name,
+        )
+        query = dense("query")(x)
+        key = dense("key")(x)
+        value = dense("value")(x)
+        attend = self.attention_fn or dot_product_attention
+        out = attend(query, key, value, mask)
+        return nn.DenseGeneral(
+            features=x.shape[-1], axis=(-2, -1), dtype=self.dtype, name="attn_out"
+        )(out)

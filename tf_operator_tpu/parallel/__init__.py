@@ -1,0 +1,39 @@
+from .distributed import ProcessEnv, initialize, read_process_env
+from .mesh import (
+    AXES,
+    MeshConfig,
+    batch_sharding,
+    batch_spec,
+    build_mesh,
+    local_batch_size,
+    mesh_summary,
+    replicated,
+    single_device_mesh,
+)
+from .sharding import (
+    CONV_RULES,
+    REPLICATED_RULES,
+    TRANSFORMER_RULES,
+    place,
+    shardings_for_tree,
+)
+
+__all__ = [
+    "AXES",
+    "MeshConfig",
+    "build_mesh",
+    "single_device_mesh",
+    "batch_sharding",
+    "batch_spec",
+    "replicated",
+    "local_batch_size",
+    "mesh_summary",
+    "ProcessEnv",
+    "read_process_env",
+    "initialize",
+    "TRANSFORMER_RULES",
+    "CONV_RULES",
+    "REPLICATED_RULES",
+    "shardings_for_tree",
+    "place",
+]

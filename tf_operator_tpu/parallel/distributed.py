@@ -1,0 +1,96 @@
+"""Multi-host bootstrap from operator-injected environment.
+
+The data-plane half of the cluster-spec contract: the controller
+injects ``TPU_WORKER_ID`` / ``TPU_WORKER_HOSTNAMES`` / JAX coordinator
+env into every pod (controller/cluster_spec.py:set_tpu_env, replacing
+the reference's TF_CONFIG + tf.train.ClusterSpec bootstrap, reference
+tensorflow.go:97-198); this module is what the workload calls first so
+``jax.distributed.initialize`` forms the cluster with zero flags.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import logging
+import os
+from typing import Optional
+
+from ..api.types import (
+    ENV_COORDINATOR_ADDRESS,
+    ENV_NUM_PROCESSES,
+    ENV_PROCESS_ID,
+    ENV_TPU_ACCELERATOR,
+    ENV_TPU_TOPOLOGY,
+    ENV_TPU_WORKER_HOSTNAMES,
+    ENV_TPU_WORKER_ID,
+)
+
+logger = logging.getLogger("tf_operator_tpu.distributed")
+
+
+@dataclasses.dataclass(frozen=True)
+class ProcessEnv:
+    """The injected slice identity, parsed."""
+
+    process_id: int = 0
+    num_processes: int = 1
+    coordinator_address: Optional[str] = None
+    hostnames: tuple = ()
+    topology: Optional[str] = None
+    accelerator: Optional[str] = None
+
+    @property
+    def is_multi_host(self) -> bool:
+        return self.num_processes > 1
+
+    @property
+    def is_coordinator(self) -> bool:
+        return self.process_id == 0
+
+
+def read_process_env(environ=None) -> ProcessEnv:
+    env = environ if environ is not None else os.environ
+    hostnames_raw = env.get(ENV_TPU_WORKER_HOSTNAMES, "")
+    hostnames = tuple(h for h in hostnames_raw.split(",") if h)
+    process_id = int(env.get(ENV_PROCESS_ID, env.get(ENV_TPU_WORKER_ID, "0")))
+    num_processes = int(env.get(ENV_NUM_PROCESSES, str(len(hostnames) or 1)))
+    coordinator = env.get(ENV_COORDINATOR_ADDRESS)
+    if coordinator is None and hostnames:
+        coordinator = f"{hostnames[0]}:2222"
+    return ProcessEnv(
+        process_id=process_id,
+        num_processes=num_processes,
+        coordinator_address=coordinator,
+        hostnames=hostnames,
+        topology=env.get(ENV_TPU_TOPOLOGY),
+        accelerator=env.get(ENV_TPU_ACCELERATOR),
+    )
+
+
+_initialized = False
+
+
+def initialize(environ=None) -> ProcessEnv:
+    """Initialize jax.distributed from the injected env (idempotent).
+
+    Single-process jobs skip initialization entirely, mirroring the
+    operator's "no TF_CONFIG for local jobs" rule (reference
+    pod.go:286-307).
+    """
+    global _initialized
+    proc = read_process_env(environ)
+    if not proc.is_multi_host or _initialized:
+        return proc
+    import jax
+
+    logger.info(
+        "jax.distributed.initialize coordinator=%s process=%d/%d",
+        proc.coordinator_address, proc.process_id, proc.num_processes,
+    )
+    jax.distributed.initialize(
+        coordinator_address=proc.coordinator_address,
+        num_processes=proc.num_processes,
+        process_id=proc.process_id,
+    )
+    _initialized = True
+    return proc

@@ -1,0 +1,105 @@
+"""Device-mesh construction: the TPU-native replacement for replica-count
+topology.
+
+The reference's only sharding vocabulary is replica-type/count wired
+through TF_CONFIG (reference tensorflow.go:97-198); scaling happens in
+user TF code. Here the mesh IS the framework's parallelism model:
+axes for data (dp), fully-sharded-data (fsdp), tensor (tp), and
+sequence/context (sp) parallelism, laid out so the inner, most
+communication-hungry axes ride ICI and only dp crosses DCN
+(the scaling-book recipe: pick a mesh, annotate shardings, let XLA
+insert collectives).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import math
+from typing import List, Optional, Sequence, Tuple
+
+import jax
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec
+
+# Canonical axis order, outermost (crosses DCN first) to innermost
+# (pure ICI): data, fsdp, sequence, tensor.
+AXES = ("dp", "fsdp", "sp", "tp")
+
+
+@dataclasses.dataclass(frozen=True)
+class MeshConfig:
+    """Per-axis sizes; -1 on dp means "absorb remaining devices"."""
+
+    dp: int = -1
+    fsdp: int = 1
+    sp: int = 1
+    tp: int = 1
+
+    def resolve(self, n_devices: int) -> Tuple[int, int, int, int]:
+        fixed = self.fsdp * self.sp * self.tp
+        dp = self.dp
+        if dp == -1:
+            if n_devices % fixed != 0:
+                raise ValueError(
+                    f"{n_devices} devices not divisible by fsdp*sp*tp={fixed}"
+                )
+            dp = n_devices // fixed
+        if dp * fixed != n_devices:
+            raise ValueError(
+                f"mesh {dp}x{self.fsdp}x{self.sp}x{self.tp} != {n_devices} devices"
+            )
+        return (dp, self.fsdp, self.sp, self.tp)
+
+
+def build_mesh(
+    config: Optional[MeshConfig] = None,
+    devices: Optional[Sequence[jax.Device]] = None,
+) -> Mesh:
+    """Build a Mesh over the given (default: all) devices.
+
+    Device order matters: jax.devices() enumerates TPU devices in
+    ICI-contiguous order, so reshaping that order into (dp, fsdp, sp, tp)
+    keeps the innermost axes (tp, sp) on directly-wired neighbors and
+    pushes the dp axis across hosts/DCN.
+    """
+    config = config or MeshConfig()
+    devs = list(devices if devices is not None else jax.devices())
+    shape = config.resolve(len(devs))
+    device_array = np.array(devs).reshape(shape)
+    return Mesh(device_array, AXES)
+
+
+def single_device_mesh() -> Mesh:
+    return Mesh(np.array(jax.devices()[:1]).reshape(1, 1, 1, 1), AXES)
+
+
+def batch_sharding(mesh: Mesh) -> NamedSharding:
+    """Batch tensors shard over every data-ish axis (dp and fsdp both
+    consume batch; sp additionally shards the sequence dim, handled by
+    the per-model specs)."""
+    return NamedSharding(mesh, PartitionSpec(("dp", "fsdp")))
+
+
+def batch_spec(shard_sequence: bool = False) -> PartitionSpec:
+    """[batch, seq, ...] activations: batch over dp+fsdp, optionally
+    sequence over sp (context parallelism)."""
+    if shard_sequence:
+        return PartitionSpec(("dp", "fsdp"), "sp")
+    return PartitionSpec(("dp", "fsdp"))
+
+
+def replicated(mesh: Mesh) -> NamedSharding:
+    return NamedSharding(mesh, PartitionSpec())
+
+
+def local_batch_size(mesh: Mesh, global_batch: int) -> int:
+    data_shards = mesh.shape["dp"] * mesh.shape["fsdp"]
+    if global_batch % data_shards != 0:
+        raise ValueError(
+            f"global batch {global_batch} not divisible by {data_shards} data shards"
+        )
+    return global_batch // data_shards
+
+
+def mesh_summary(mesh: Mesh) -> str:
+    return "x".join(f"{axis}={size}" for axis, size in mesh.shape.items())

@@ -1,0 +1,103 @@
+"""Parameter/activation sharding rules: path-pattern -> PartitionSpec.
+
+The GSPMD contract: we annotate shardings on params and batches, XLA
+inserts the collectives (all-reduce for dp grads, all-gather/
+reduce-scatter for fsdp, collective-permute inside tp matmuls). Rules
+are regex patterns over flattened parameter paths so models don't need
+framework-specific annotations woven through their code.
+"""
+
+from __future__ import annotations
+
+import re
+from typing import Any, List, Optional, Sequence, Tuple
+
+import jax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec
+
+Rules = Sequence[Tuple[str, PartitionSpec]]
+
+# Transformer sharding recipe (Megatron-style TP + optional FSDP):
+#   - attention qkv / mlp up-projection kernels: shard output dim on tp
+#   - attention out / mlp down-projection kernels: shard input dim on tp
+#   - embeddings: shard vocab/hidden on tp
+#   - everything 1-D (bias, layernorm scale): replicated
+# fsdp additionally shards the first remaining dim of large kernels.
+TRANSFORMER_RULES: Rules = (
+    (r".*(query|key|value|qkv).*kernel$", PartitionSpec("fsdp", "tp")),
+    (r".*(attn_out|out_proj|attention_output).*kernel$", PartitionSpec("tp", "fsdp")),
+    (r".*(mlp_in|intermediate|up_proj|gate_proj).*kernel$", PartitionSpec("fsdp", "tp")),
+    (r".*(mlp_out|down_proj).*kernel$", PartitionSpec("tp", "fsdp")),
+    (r".*embedding$", PartitionSpec("tp", "fsdp")),
+    (r".*kernel$", PartitionSpec("fsdp", None)),
+    (r".*", PartitionSpec()),
+)
+
+# Conv nets: no tp (convs don't factor as cleanly); fsdp shards the
+# output-channel dim of large kernels, small params replicate.
+CONV_RULES: Rules = (
+    (r".*kernel$", PartitionSpec(None, None, None, "fsdp")),
+    (r".*", PartitionSpec()),
+)
+
+REPLICATED_RULES: Rules = ((r".*", PartitionSpec()),)
+
+
+def _path_str(path) -> str:
+    parts = []
+    for entry in path:
+        if hasattr(entry, "key"):
+            parts.append(str(entry.key))
+        elif hasattr(entry, "idx"):
+            parts.append(str(entry.idx))
+        else:
+            parts.append(str(entry))
+    return "/".join(parts)
+
+
+def _spec_for(path: str, ndim: int, rules: Rules) -> PartitionSpec:
+    for pattern, spec in rules:
+        if re.fullmatch(pattern, path):
+            if len(spec) > ndim:
+                # rule written for a higher-rank param: drop trailing axes
+                spec = PartitionSpec(*spec[:ndim])
+            return spec
+    return PartitionSpec()
+
+
+def shardings_for_tree(
+    tree: Any, mesh: Mesh, rules: Rules = TRANSFORMER_RULES
+) -> Any:
+    """NamedSharding pytree matching `tree`, chosen by path rules.
+
+    Axes that don't divide evenly fall back to replication for that
+    dimension — a wrong-but-correct default that keeps small models
+    working on big meshes.
+    """
+
+    def assign(path, leaf):
+        path_s = _path_str(path)
+        spec = _spec_for(path_s, getattr(leaf, "ndim", 0), rules)
+        spec = _drop_indivisible(spec, getattr(leaf, "shape", ()), mesh)
+        return NamedSharding(mesh, spec)
+
+    return jax.tree_util.tree_map_with_path(assign, tree)
+
+
+def _drop_indivisible(spec: PartitionSpec, shape, mesh: Mesh) -> PartitionSpec:
+    out: List[Optional[Any]] = []
+    for dim, names in enumerate(spec):
+        if names is None or dim >= len(shape):
+            out.append(None)
+            continue
+        group = names if isinstance(names, tuple) else (names,)
+        size = 1
+        for name in group:
+            size *= mesh.shape[name]
+        out.append(names if size and shape[dim] % size == 0 else None)
+    return PartitionSpec(*out)
+
+
+def place(tree: Any, shardings: Any) -> Any:
+    """Device-put a pytree with its sharding pytree."""
+    return jax.tree_util.tree_map(jax.device_put, tree, shardings)

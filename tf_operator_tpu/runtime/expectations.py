@@ -52,7 +52,13 @@ class ControllerExpectations:
             if entry is None:
                 return
             old_adds, old_deletes, ts = entry
-            self._store[key] = (old_adds - adds, old_deletes - deletes, ts)
+            # floor at 0: an unexpected observation must not corrupt
+            # accounting for later expectations on the same key
+            self._store[key] = (
+                max(0, old_adds - adds),
+                max(0, old_deletes - deletes),
+                ts,
+            )
 
     def satisfied(self, key: str) -> bool:
         """True if the cache can be trusted for this key: no outstanding

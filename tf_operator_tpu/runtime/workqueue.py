@@ -114,13 +114,15 @@ class DelayingQueue(WorkQueue):
         if delay <= 0:
             self.add(item)
             return
-        timer = threading.Timer(delay, self._fire, args=(item,))
+        timer: threading.Timer = threading.Timer(delay, lambda: self._fire(item, timer))
         timer.daemon = True
         with self._timer_lock:
             self._timers.add(timer)
         timer.start()
 
-    def _fire(self, item: Hashable) -> None:
+    def _fire(self, item: Hashable, timer: threading.Timer) -> None:
+        with self._timer_lock:
+            self._timers.discard(timer)
         self.add(item)
 
     def shut_down(self) -> None:

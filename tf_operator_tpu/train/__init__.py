@@ -1,0 +1,17 @@
+from .trainer import (
+    Checkpointer,
+    Task,
+    Trainer,
+    TrainState,
+    classification_task,
+    mlm_task,
+)
+
+__all__ = [
+    "Trainer",
+    "TrainState",
+    "Task",
+    "classification_task",
+    "mlm_task",
+    "Checkpointer",
+]

@@ -1,0 +1,312 @@
+"""The training engine: sharded init, jitted steps, checkpoint/resume.
+
+The part the reference delegates entirely to user TF containers
+(SURVEY.md §2.3: the operator orchestrates, TF trains). Built TPU-first:
+
+- one `jax.jit`-compiled train step over a Mesh; GSPMD inserts the
+  collectives (dp grad all-reduce, fsdp all-gather/reduce-scatter, tp
+  permutes) from sharding annotations alone
+- parameters are *initialized sharded* (jit with out_shardings), so
+  models bigger than one host's HBM never materialize unsharded
+- donated state: the optimizer update runs in-place in HBM
+- first-class orbax checkpointing — mandatory on preemptible TPU
+  slices, where elastic recovery is checkpoint-resume (SURVEY.md §5:
+  the reference has none; its "resume" is pod restart)
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import logging
+import time
+from typing import Any, Callable, Dict, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import optax
+from flax import struct
+from jax.sharding import Mesh, NamedSharding, PartitionSpec
+
+from ..parallel import mesh as mesh_lib
+from ..parallel import sharding as sharding_lib
+
+logger = logging.getLogger("tf_operator_tpu.trainer")
+
+
+class TrainState(struct.PyTreeNode):
+    step: jax.Array
+    params: Any
+    opt_state: Any
+    batch_stats: Any = None  # BatchNorm running stats (ResNet); None otherwise
+
+
+@dataclasses.dataclass
+class Task:
+    """How to compute loss for a model family."""
+
+    apply_fn: Callable
+    loss_fn: Callable[[Any, Dict[str, jax.Array]], jax.Array]
+    has_batch_stats: bool = False
+
+
+def classification_task(model) -> Task:
+    """Softmax cross-entropy over logits; handles BatchNorm models."""
+
+    def loss_fn(variables, batch, train=True):
+        if "batch_stats" in variables:
+            logits, updates = model.apply(
+                variables, batch["image"], train=train, mutable=["batch_stats"]
+            )
+            new_stats = updates["batch_stats"]
+        else:
+            logits = model.apply(variables, batch["image"])
+            new_stats = None
+        labels = jax.nn.one_hot(batch["label"], logits.shape[-1])
+        loss = optax.softmax_cross_entropy(logits, labels).mean()
+        accuracy = jnp.mean(
+            (jnp.argmax(logits, -1) == batch["label"]).astype(jnp.float32)
+        )
+        return loss, {"accuracy": accuracy, "batch_stats": new_stats}
+
+    return Task(apply_fn=model.apply, loss_fn=loss_fn, has_batch_stats=True)
+
+
+def mlm_task(model) -> Task:
+    from ..models.bert import mlm_loss
+
+    def loss_fn(variables, batch, train=True):
+        logits = model.apply(
+            variables, batch["input_ids"], batch.get("attention_mask")
+        )
+        loss = mlm_loss(logits, batch["labels"], batch["mlm_weights"])
+        return loss, {"batch_stats": None}
+
+    return Task(apply_fn=model.apply, loss_fn=loss_fn)
+
+
+class Trainer:
+    def __init__(
+        self,
+        model,
+        task: Task,
+        optimizer: optax.GradientTransformation,
+        mesh: Optional[Mesh] = None,
+        rules: sharding_lib.Rules = sharding_lib.TRANSFORMER_RULES,
+        shard_sequence: bool = False,
+        checkpoint_dir: Optional[str] = None,
+    ) -> None:
+        self.model = model
+        self.task = task
+        self.optimizer = optimizer
+        self.mesh = mesh if mesh is not None else mesh_lib.build_mesh()
+        self.rules = rules
+        self.shard_sequence = shard_sequence
+        self._ckpt = (
+            Checkpointer(checkpoint_dir) if checkpoint_dir is not None else None
+        )
+        self._train_step = None
+        self.state_shardings = None
+
+    # -- init --------------------------------------------------------------
+
+    def _model_inputs(self, batch):
+        if "image" in batch:
+            return (batch["image"],)
+        return (batch["input_ids"], batch.get("attention_mask"))
+
+    def init(self, rng: jax.Array, sample_batch: Dict[str, jax.Array]) -> TrainState:
+        """Initialize the TrainState *already sharded*: abstract-eval the
+        init to learn shapes, derive shardings by rule, then run init
+        under jit with those out_shardings."""
+        inputs = self._model_inputs(sample_batch)
+
+        def init_fn(rng):
+            variables = self.model.init(rng, *inputs)
+            params = variables["params"]
+            return TrainState(
+                step=jnp.zeros((), jnp.int32),
+                params=params,
+                opt_state=self.optimizer.init(params),
+                batch_stats=variables.get("batch_stats"),
+            )
+
+        abstract = jax.eval_shape(init_fn, rng)
+        self.state_shardings = self._shardings_for_state(abstract)
+        with self.mesh:
+            state = jax.jit(init_fn, out_shardings=self.state_shardings)(rng)
+        return state
+
+    def _shardings_for_state(self, abstract: TrainState) -> TrainState:
+        params_sh = sharding_lib.shardings_for_tree(
+            abstract.params, self.mesh, self.rules
+        )
+
+        def like_params(tree):
+            if tree is None:
+                return None
+            return sharding_lib.shardings_for_tree(tree, self.mesh, self.rules)
+
+        replicated = NamedSharding(self.mesh, PartitionSpec())
+        opt_sh = _opt_state_shardings(
+            abstract.opt_state, abstract.params, params_sh, replicated
+        )
+        return TrainState(
+            step=replicated,
+            params=params_sh,
+            opt_state=opt_sh,
+            batch_stats=like_params(abstract.batch_stats),
+        )
+
+    # -- steps -------------------------------------------------------------
+
+    def _build_train_step(self):
+        task = self.task
+        optimizer = self.optimizer
+        batch_sharding = NamedSharding(
+            self.mesh, mesh_lib.batch_spec(self.shard_sequence)
+        )
+
+        def train_step(state: TrainState, batch):
+            def loss_of(params):
+                variables = {"params": params}
+                if state.batch_stats is not None:
+                    variables["batch_stats"] = state.batch_stats
+                return task.loss_fn(variables, batch)
+
+            (loss, aux), grads = jax.value_and_grad(loss_of, has_aux=True)(
+                state.params
+            )
+            updates, new_opt_state = optimizer.update(
+                grads, state.opt_state, state.params
+            )
+            new_params = optax.apply_updates(state.params, updates)
+            metrics = {
+                k: v for k, v in aux.items() if k != "batch_stats" and v is not None
+            }
+            metrics["loss"] = loss
+            return (
+                TrainState(
+                    step=state.step + 1,
+                    params=new_params,
+                    opt_state=new_opt_state,
+                    batch_stats=aux.get("batch_stats"),
+                ),
+                metrics,
+            )
+
+        return jax.jit(
+            train_step,
+            in_shardings=(self.state_shardings, batch_sharding),
+            out_shardings=(self.state_shardings, NamedSharding(self.mesh, PartitionSpec())),
+            donate_argnums=(0,),
+        )
+
+    def step(self, state: TrainState, batch) -> Tuple[TrainState, Dict[str, jax.Array]]:
+        if self._train_step is None:
+            self._train_step = self._build_train_step()
+        with self.mesh:
+            return self._train_step(state, batch)
+
+    def place_batch(self, batch):
+        sharding = NamedSharding(self.mesh, mesh_lib.batch_spec(self.shard_sequence))
+        return jax.tree_util.tree_map(
+            lambda x: jax.device_put(x, sharding), batch
+        )
+
+    # -- loops -------------------------------------------------------------
+
+    def fit(
+        self,
+        state: TrainState,
+        batches,
+        steps: int,
+        log_every: int = 50,
+        checkpoint_every: Optional[int] = None,
+    ) -> Tuple[TrainState, Dict[str, float]]:
+        last_metrics: Dict[str, float] = {}
+        start = time.perf_counter()
+        for i in range(steps):
+            batch = self.place_batch(next(batches))
+            state, metrics = self.step(state, batch)
+            if checkpoint_every and (i + 1) % checkpoint_every == 0:
+                self.save(state)
+            if (i + 1) % log_every == 0 or i + 1 == steps:
+                last_metrics = {
+                    k: float(v) for k, v in metrics.items()
+                }
+                elapsed = time.perf_counter() - start
+                logger.info(
+                    "step %d loss=%.4f (%.1f steps/s)",
+                    int(state.step), last_metrics.get("loss", float("nan")),
+                    (i + 1) / max(elapsed, 1e-9),
+                )
+        return state, last_metrics
+
+    # -- checkpointing -----------------------------------------------------
+
+    def save(self, state: TrainState) -> None:
+        if self._ckpt is None:
+            raise ValueError("Trainer built without checkpoint_dir")
+        self._ckpt.save(int(state.step), state)
+
+    def restore(self, state: TrainState) -> Optional[TrainState]:
+        """Restore the latest checkpoint into the (sharded) structure of
+        `state`; None if no checkpoint exists yet."""
+        if self._ckpt is None:
+            raise ValueError("Trainer built without checkpoint_dir")
+        return self._ckpt.restore_latest(state)
+
+
+def _opt_state_shardings(opt_state, params, params_sh, replicated):
+    """Optimizer moments inherit their params' shardings.
+
+    optax states are nested (named)tuples whose param-shaped subtrees
+    share the params' treedef (adam's mu/nu, momentum's trace, ...);
+    walk the structure, substituting the param shardings for any subtree
+    structurally identical to params and replicating everything else
+    (counts, scalars).
+    """
+    params_treedef = jax.tree_util.tree_structure(params)
+
+    def rec(node):
+        if jax.tree_util.tree_structure(node) == params_treedef:
+            return params_sh
+        if isinstance(node, tuple):
+            children = [rec(child) for child in node]
+            if hasattr(node, "_fields"):  # NamedTuple state
+                return type(node)(*children)
+            return type(node)(children)
+        return jax.tree_util.tree_map(lambda _: replicated, node)
+
+    return rec(opt_state)
+
+
+class Checkpointer:
+    """Thin orbax wrapper: save/restore sharded TrainStates.
+
+    First-class here because TPU elasticity is checkpoint-granular
+    (SURVEY.md §7 hard part #3): a resized slice re-initializes and
+    resumes from the last step, where the reference's elastic workers
+    could just mutate TF_CONFIG.
+    """
+
+    def __init__(self, directory: str, keep: int = 3) -> None:
+        import orbax.checkpoint as ocp
+
+        self._ocp = ocp
+        self.directory = directory
+        self.manager = ocp.CheckpointManager(
+            directory, options=ocp.CheckpointManagerOptions(max_to_keep=keep)
+        )
+
+    def save(self, step: int, state: TrainState) -> None:
+        self.manager.save(step, args=self._ocp.args.StandardSave(state))
+        self.manager.wait_until_finished()
+
+    def restore_latest(self, target: TrainState) -> Optional[TrainState]:
+        step = self.manager.latest_step()
+        if step is None:
+            return None
+        return self.manager.restore(
+            step, args=self._ocp.args.StandardRestore(target)
+        )
