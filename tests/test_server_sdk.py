@@ -1,0 +1,273 @@
+"""Tests for the server layer (ports, gang, metrics, leader election,
+process wiring) and the Python SDK."""
+
+import json
+import threading
+import urllib.request
+
+import pytest
+
+from tf_operator_tpu.api import k8s, types as t
+from tf_operator_tpu.controller import ReconcilerConfig, TFJobController
+from tf_operator_tpu.controller.gang import GangScheduler
+from tf_operator_tpu.controller.ports import PortAllocator, PortRangeExhausted
+from tf_operator_tpu.runtime import InMemorySubstrate, NotFound
+from tf_operator_tpu.sdk import TFJobClient
+from tf_operator_tpu.server import (
+    FileLock,
+    MonitoringServer,
+    OperatorMetrics,
+    OperatorServer,
+    parse_args,
+)
+
+from tests.test_api import make_job
+
+
+def hostnet_job(name="hn", workers=2, ps=1):
+    job = make_job({"Worker": workers, "PS": ps}, name=name)
+    for spec in job.spec.tf_replica_specs.values():
+        spec.template.spec.host_network = True
+    return job
+
+
+class TestPortAllocator:
+    def test_allocates_per_replica(self):
+        alloc = PortAllocator(bport=20000, eport=20010)
+        annotations = alloc.allocate(hostnet_job())
+        assert set(annotations) == {"worker", "ps"}
+        worker_ports = annotations["worker"].split(",")
+        assert len(worker_ports) == 2
+        all_ports = worker_ports + annotations["ps"].split(",")
+        assert len(set(all_ports)) == 3  # unique
+        assert alloc.in_use() == 3
+
+    def test_skips_non_hostnetwork(self):
+        alloc = PortAllocator()
+        assert alloc.allocate(make_job({"Worker": 2})) == {}
+
+    def test_release_returns_ports(self):
+        alloc = PortAllocator(bport=20000, eport=20003)
+        job = hostnet_job(workers=3, ps=0)
+        job.spec.tf_replica_specs.pop("PS")
+        alloc.allocate(job)
+        with pytest.raises(PortRangeExhausted):
+            alloc.allocate(hostnet_job(name="other"))
+        alloc.release(job.key())
+        assert alloc.in_use() == 0
+        assert alloc.allocate(hostnet_job(name="other", workers=1, ps=1))
+
+    def test_register_existing_prevents_double_assign(self):
+        alloc = PortAllocator(bport=20000, eport=20010)
+        job = hostnet_job()
+        job.metadata.annotations["worker"] = "20000,20001"
+        alloc.register_existing([job])
+        other = alloc.allocate(hostnet_job(name="other", workers=1, ps=0))
+        assert other["worker"] not in ("20000", "20001")
+
+    def test_idempotent_when_annotated(self):
+        alloc = PortAllocator()
+        job = hostnet_job()
+        first = alloc.allocate(job)
+        job.metadata.annotations.update(first)
+        assert alloc.allocate(job) == {}
+
+
+class TestGangScheduling:
+    def test_pod_group_synced_and_deleted(self):
+        sub = InMemorySubstrate()
+        controller = TFJobController(
+            sub, config=ReconcilerConfig(enable_gang_scheduling=True)
+        )
+        job = make_job({"Worker": 2, "PS": 1}, name="gang")
+        sub.create_job(job)
+        controller.run_until_quiet()
+        group = sub.get_pod_group("default", "gang")
+        assert group is not None and group.min_member == 3
+        # pods tagged into the group
+        pod = sub.list_pods("default")[0]
+        assert pod.metadata.annotations[t.ANNOTATION_GANG_GROUP] == "gang"
+        assert pod.spec.scheduler_name == "volcano"
+
+        sub.run_all_pending()
+        controller.run_until_quiet()
+        sub.terminate_pod("default", "gang-worker-0", exit_code=0)
+        controller.run_until_quiet()
+        # terminal job cleans up its PodGroup
+        assert sub.get_pod_group("default", "gang") is None
+
+    def test_tpu_min_member_is_whole_slice(self):
+        sub = InMemorySubstrate()
+        gang = GangScheduler(sub)
+        job = make_job({"TPU": 4})
+        # user asks for minAvailable=1; a 4-host slice must still gang at 4
+        job.spec.run_policy.scheduling_policy = t.SchedulingPolicy(min_available=1)
+        assert gang.min_member(job) == 4
+
+
+class TestMetrics:
+    def test_counters_through_lifecycle(self):
+        sub = InMemorySubstrate()
+        metrics = OperatorMetrics()
+        controller = TFJobController(sub, metrics=metrics)
+        job = make_job({"Worker": 1}, name="m1")
+        sub.create_job(job)
+        controller.run_until_quiet()
+        sub.run_all_pending()
+        controller.run_until_quiet()
+        sub.terminate_pod("default", "m1-worker-0", exit_code=0)
+        controller.run_until_quiet()
+        assert metrics.value("jobs_created_total") == 1
+        assert metrics.value("jobs_successful_total") == 1
+        sub.delete_job("default", "m1")
+        assert metrics.value("jobs_deleted_total") == 1
+
+    def test_http_exposition(self):
+        metrics = OperatorMetrics()
+        metrics.created()
+        metrics.set_leader(True)
+        server = MonitoringServer(metrics, port=0)
+        port = server.start()
+        try:
+            body = urllib.request.urlopen(
+                f"http://127.0.0.1:{port}/metrics"
+            ).read().decode()
+            assert "tf_operator_tpu_jobs_created_total 1" in body
+            assert "tf_operator_tpu_is_leader 1" in body
+            health = urllib.request.urlopen(
+                f"http://127.0.0.1:{port}/healthz"
+            ).read()
+            assert health == b"ok"
+        finally:
+            server.stop()
+
+
+class TestLeaderElection:
+    def test_file_lock_mutual_exclusion(self, tmp_path):
+        path = str(tmp_path / "lock")
+        first, second = FileLock(path), FileLock(path)
+        assert first.try_acquire()
+        assert not second.try_acquire()
+        first.release()
+        assert second.try_acquire()
+        second.release()
+
+
+class TestServerProcess:
+    def test_operator_server_end_to_end(self, tmp_path):
+        options = parse_args(
+            [
+                "--substrate", "memory",
+                "--monitoring-port", "0",
+                "--no-enable-leader-election",
+                "--resync-period", "0.2",
+            ]
+        )
+        options.leader_lock_path = str(tmp_path / "lock")
+        server = OperatorServer(options)
+        thread = threading.Thread(target=server.run, daemon=True)
+        thread.start()
+        try:
+            sub = server.substrate
+            client = TFJobClient(sub)
+            client.create(make_job({"Worker": 1, "PS": 1}, name="srv"))
+            deadline = 50
+            for _ in range(deadline):
+                if len(sub.list_pods("default")) == 2:
+                    break
+                threading.Event().wait(0.1)
+            assert len(sub.list_pods("default")) == 2
+            sub.run_all_pending()
+            sub.terminate_pod("default", "srv-worker-0", exit_code=0)
+            job = client.wait_for_job(
+                "srv", timeout_seconds=10, polling_interval=0.1
+            )
+            assert job.has_condition(t.ConditionType.SUCCEEDED)
+        finally:
+            server.shutdown()
+
+    def test_flag_parsing_defaults(self):
+        options = parse_args([])
+        assert options.threadiness == 1
+        assert options.monitoring_port == 8443
+        assert options.gang_scheduler_name == "volcano"
+
+
+class TestSDK:
+    def setup_env(self):
+        sub = InMemorySubstrate()
+        controller = TFJobController(sub)
+        return sub, controller, TFJobClient(sub)
+
+    def test_create_applies_defaults_and_validates(self):
+        sub, controller, client = self.setup_env()
+        created = client.create(
+            {
+                "apiVersion": "kubeflow.org/v1",
+                "kind": "TFJob",
+                "metadata": {"name": "sdk-job"},
+                "spec": {
+                    "tfReplicaSpecs": {
+                        "worker": {
+                            "replicas": 2,
+                            "template": {
+                                "spec": {
+                                    "containers": [
+                                        {"name": "tensorflow", "image": "img"}
+                                    ]
+                                }
+                            },
+                        }
+                    }
+                },
+            }
+        )
+        assert created.num_replicas(t.ReplicaType.WORKER) == 2
+        with pytest.raises(Exception):
+            client.create({"metadata": {"name": "bad"}, "spec": {}})
+
+    def test_wait_and_predicates(self):
+        sub, controller, client = self.setup_env()
+        client.create(make_job({"Worker": 1}, name="w1"))
+        controller.run_until_quiet()
+        sub.run_all_pending()
+        controller.run_until_quiet()
+        assert client.is_job_running("w1")
+        sub.terminate_pod("default", "w1-worker-0", exit_code=0)
+        controller.run_until_quiet()
+        job = client.wait_for_job("w1", timeout_seconds=2, polling_interval=0.05)
+        assert client.is_job_succeeded("w1")
+        assert client.get_job_status("w1") == "Succeeded"
+
+    def test_wait_raises_on_failure(self):
+        sub, controller, client = self.setup_env()
+        client.create(make_job({"Worker": 1}, name="boom"))
+        controller.run_until_quiet()
+        sub.run_all_pending()
+        controller.run_until_quiet()
+        sub.terminate_pod("default", "boom-worker-0", exit_code=1)
+        controller.run_until_quiet()
+        with pytest.raises(RuntimeError, match="failed"):
+            client.wait_for_job("boom", timeout_seconds=2, polling_interval=0.05)
+
+    def test_pod_names_and_logs(self):
+        sub, controller, client = self.setup_env()
+        client.create(make_job({"Worker": 2, "PS": 1}, name="logs"))
+        controller.run_until_quiet()
+        assert sorted(client.get_pod_names("logs", replica_type="Worker")) == [
+            "logs-worker-0",
+            "logs-worker-1",
+        ]
+        assert client.get_pod_names("logs", master=True) == ["logs-worker-0"]
+        sub.append_pod_log("default", "logs-worker-0", "step 1\n")
+        logs = client.get_logs("logs", master=True)
+        assert logs == {"logs-worker-0": "step 1\n"}
+
+    def test_patch_merges_spec(self):
+        sub, controller, client = self.setup_env()
+        client.create(make_job({"Worker": 2}, name="patchy"))
+        client.patch(
+            "patchy",
+            {"spec": {"tfReplicaSpecs": {"Worker": {"replicas": 4}}}},
+        )
+        assert client.get("patchy").num_replicas(t.ReplicaType.WORKER) == 4

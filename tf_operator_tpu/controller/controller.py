@@ -64,6 +64,10 @@ class TFJobController:
         self.namespace = namespace
         self.metrics = metrics
         self.port_allocator = port_allocator
+        if gang is None and config is not None and config.enable_gang_scheduling:
+            from .gang import GangScheduler
+
+            gang = GangScheduler(substrate)
         self.recorder = EventRecorder(substrate)
         self.expectations = ControllerExpectations()
         self.queue = RateLimitingQueue()
@@ -107,6 +111,8 @@ class TFJobController:
             self.enqueue(job.key())
         elif verb == DELETED:
             self.expectations.delete_expectations(job.key())
+            if self.port_allocator is not None:
+                self.port_allocator.release(job.key())
             if self.metrics is not None:
                 self.metrics.deleted()
 
@@ -238,7 +244,12 @@ class TFJobController:
         existed before this controller subscribed (informer initial list
         + resync in the reference, server.go:119-133 / options.go:24).
         Jobs that never went through admission get admitted now."""
-        for job in self.substrate.list_jobs(self.namespace):
+        jobs = self.substrate.list_jobs(self.namespace)
+        if self.port_allocator is not None:
+            # re-register persisted host-port allocations before any new
+            # allocation can double-assign (reference port.go:106-134)
+            self.port_allocator.register_existing(jobs)
+        for job in jobs:
             if not job.status.conditions and not job.is_finished():
                 self._admit(job)
             else:

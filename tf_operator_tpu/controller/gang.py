@@ -1,0 +1,106 @@
+"""Gang scheduling: all-or-nothing placement via PodGroups.
+
+Re-design of reference jobcontroller.go:224-278 (kube-batch/volcano
+PodGroup sync) with the TPU twist from BASELINE.json's north star: for
+a job with a TPU replica set, minMember is the WHOLE slice — a
+multi-host slice that comes up partially is useless (the ICI mesh never
+forms), so partial placement must never start. Pods opt into the group
+via the scheduling.k8s.io/group-name annotation + schedulerName
+(reconciler.create_new_pod).
+"""
+
+from __future__ import annotations
+
+import logging
+from typing import Optional
+
+from ..api.types import TFJob
+
+logger = logging.getLogger("tf_operator_tpu.gang")
+
+
+class PodGroup:
+    """Minimal PodGroup object (scheduling.x-k8s.io / volcano shape)."""
+
+    def __init__(self, name: str, namespace: str, min_member: int, owner_uid: str,
+                 queue: Optional[str] = None) -> None:
+        self.name = name
+        self.namespace = namespace
+        self.min_member = min_member
+        self.owner_uid = owner_uid
+        self.queue = queue
+
+    def copy(self) -> "PodGroup":
+        return PodGroup(
+            name=self.name,
+            namespace=self.namespace,
+            min_member=self.min_member,
+            owner_uid=self.owner_uid,
+            queue=self.queue,
+        )
+
+    def to_dict(self) -> dict:
+        spec = {"minMember": self.min_member}
+        if self.queue:
+            spec["queue"] = self.queue
+        return {
+            "apiVersion": "scheduling.volcano.sh/v1beta1",
+            "kind": "PodGroup",
+            "metadata": {"name": self.name, "namespace": self.namespace},
+            "spec": spec,
+        }
+
+
+class GangScheduler:
+    """Keeps one PodGroup per job in sync on the substrate."""
+
+    def __init__(self, substrate) -> None:
+        self.substrate = substrate
+
+    def min_member(self, job: TFJob) -> int:
+        """minAvailable: explicit SchedulingPolicy wins; else every
+        replica (reference controller.go:476-482). TPU jobs may never
+        gang below the slice size."""
+        policy = job.spec.run_policy.scheduling_policy
+        total = job.total_replicas()
+        if policy is not None and policy.min_available is not None:
+            requested = policy.min_available
+        else:
+            requested = total
+        tpu_spec = job.spec.tf_replica_specs.get("TPU")
+        if tpu_spec is not None:
+            tpu_replicas = (
+                tpu_spec.replicas if tpu_spec.replicas is not None else 1
+            )
+            requested = max(requested, tpu_replicas)
+        return min(requested, total)
+
+    def sync_pod_group(self, job: TFJob, min_member: Optional[int] = None) -> PodGroup:
+        if min_member is None:
+            min_member = self.min_member(job)
+        existing = self.substrate.get_pod_group(job.namespace, job.name)
+        queue = None
+        policy = job.spec.run_policy.scheduling_policy
+        if policy is not None:
+            queue = policy.queue
+        if existing is not None:
+            if existing.min_member != min_member:
+                existing.min_member = min_member
+                self.substrate.update_pod_group(existing)
+            return existing
+        group = PodGroup(
+            name=job.name,
+            namespace=job.namespace,
+            min_member=min_member,
+            owner_uid=job.metadata.uid,
+            queue=queue,
+        )
+        self.substrate.create_pod_group(group)
+        logger.info(
+            "created PodGroup %s/%s minMember=%d", job.namespace, job.name, min_member
+        )
+        return group
+
+    def delete_pod_group(self, job: TFJob) -> None:
+        if self.substrate.get_pod_group(job.namespace, job.name) is not None:
+            self.substrate.delete_pod_group(job.namespace, job.name)

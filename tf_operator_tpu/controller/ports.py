@@ -1,0 +1,116 @@
+"""PortAllocator: host-port management for hostNetwork jobs.
+
+Re-design of the fork-specific allocator (reference port.go:44-332):
+jobs running with hostNetwork share the node's port space, so each
+replica gets a unique port from a configured range [bport, eport),
+persisted in the job's annotations as "{rtype}: p0,p1,..." — consumed
+by cluster-spec generation (cluster_spec._annotation_port) and pod
+creation (reconciler._rewrite_host_ports). Ports are released when the
+job ends; on startup existing jobs' allocations are re-registered so a
+controller restart never double-assigns (reference syncAll,
+port.go:106-134).
+"""
+
+from __future__ import annotations
+
+import logging
+import threading
+from typing import Dict, Iterable, List, Optional, Set
+
+from ..api.types import DEFAULT_PORT, ReplicaType, TFJob
+
+logger = logging.getLogger("tf_operator_tpu.ports")
+
+
+class PortRangeExhausted(RuntimeError):
+    pass
+
+
+class PortAllocator:
+    def __init__(self, bport: int = 20000, eport: int = 30000) -> None:
+        if eport <= bport:
+            raise ValueError(f"empty port range [{bport}, {eport})")
+        self.bport = bport
+        self.eport = eport
+        self._lock = threading.Lock()
+        self._used: Set[int] = set()
+        # job key -> all ports held, for release on job end
+        self._by_job: Dict[str, List[int]] = {}
+        self._next = bport
+
+    # -- allocation --------------------------------------------------------
+
+    def _take_one(self) -> int:
+        """Next free port, scanning cyclically from the last position."""
+        for _ in range(self.eport - self.bport):
+            port = self._next
+            self._next += 1
+            if self._next >= self.eport:
+                self._next = self.bport
+            if port not in self._used:
+                self._used.add(port)
+                return port
+        raise PortRangeExhausted(
+            f"no free host ports in [{self.bport}, {self.eport})"
+        )
+
+    def allocate(self, job: TFJob) -> Dict[str, str]:
+        """Allocate ports for every hostNetwork replica set of the job.
+        Returns the annotations to persist ({} when none needed);
+        idempotent for jobs that already carry allocations."""
+        annotations: Dict[str, str] = {}
+        with self._lock:
+            held = self._by_job.setdefault(job.key(), [])
+            for rtype_key, spec in job.spec.tf_replica_specs.items():
+                if spec is None or not spec.template.spec.host_network:
+                    continue
+                rt = rtype_key.lower()
+                if job.metadata.annotations.get(rt):
+                    continue  # already allocated (e.g. controller restart)
+                replicas = spec.replicas if spec.replicas is not None else 1
+                try:
+                    ports = [self._take_one() for _ in range(replicas)]
+                except PortRangeExhausted:
+                    self._release_locked(job.key())
+                    raise
+                held.extend(ports)
+                annotations[rt] = ",".join(str(p) for p in ports)
+        return annotations
+
+    # -- release -----------------------------------------------------------
+
+    def release(self, job_key: str) -> None:
+        with self._lock:
+            self._release_locked(job_key)
+
+    def _release_locked(self, job_key: str) -> None:
+        for port in self._by_job.pop(job_key, []):
+            self._used.discard(port)
+
+    # -- startup GC --------------------------------------------------------
+
+    def register_existing(self, jobs: Iterable[TFJob]) -> None:
+        """Re-register allocations persisted in live jobs' annotations so
+        a restarted controller never double-assigns (reference
+        port.go:139-187)."""
+        with self._lock:
+            for job in jobs:
+                if job.is_finished():
+                    continue
+                held = self._by_job.setdefault(job.key(), [])
+                for rtype_key in job.spec.tf_replica_specs:
+                    raw = job.metadata.annotations.get(rtype_key.lower())
+                    if not raw:
+                        continue
+                    for part in raw.split(","):
+                        try:
+                            port = int(part)
+                        except ValueError:
+                            continue
+                        if self.bport <= port < self.eport and port not in held:
+                            self._used.add(port)
+                            held.append(port)
+
+    def in_use(self) -> int:
+        with self._lock:
+            return len(self._used)

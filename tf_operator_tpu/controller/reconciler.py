@@ -184,7 +184,7 @@ class Reconciler:
             return job
 
         if self.gang is not None and self.config.enable_gang_scheduling:
-            self.gang.sync_pod_group(job, job.total_replicas())
+            self.gang.sync_pod_group(job)
 
         for rtype_key, spec in job.spec.tf_replica_specs.items():
             if spec is None:
@@ -280,10 +280,12 @@ class Reconciler:
         typed_pods = filter_by_replica_type(pods, rt)
         replicas = spec.replicas if spec.replicas is not None else 1
         restart = False
+        restarts_this_sync = 0
         worker0_completed = False
         # ExitCode restarts count toward BackoffLimit: once the job has
         # burned its retries (persisted in status.replicaStatuses[*].
-        # restarts), the next retryable failure becomes fatal.
+        # restarts), the next retryable failure becomes fatal. A TPU
+        # slice restart is ONE retry however many hosts died with it.
         backoff = job.spec.run_policy.backoff_limit
         retries_left = None
         if backoff is not None:
@@ -334,7 +336,12 @@ class Reconciler:
                         # so the whole replica set restarts together —
                         # not just the failed index (contrast the
                         # reference's per-pod restart, pod.go:131-139;
-                        # SURVEY.md §7 hard part #1).
+                        # SURVEY.md §7 hard part #1). One slice restart
+                        # is one retry, however many hosts died.
+                        if not restart:
+                            restarts_this_sync += 1
+                            if retries_left is not None:
+                                retries_left -= 1
                         restart = True
                     else:
                         # Transient failure: delete the pod; the next
@@ -342,8 +349,9 @@ class Reconciler:
                         # (pod.go:131-139).
                         self._delete_pod(job, pod, rt)
                         restart = True
-                    if retries_left is not None:
-                        retries_left -= 1
+                        restarts_this_sync += 1
+                        if retries_left is not None:
+                            retries_left -= 1
                 if (
                     rtype in (ReplicaType.WORKER, ReplicaType.TPU)
                     and index == 0
@@ -362,8 +370,8 @@ class Reconciler:
                     f"Pod {pod.metadata.name} is being restarted with its slice",
                 )
 
-        if restart:
-            job.status.replica_statuses[rtype.value].restarts += 1
+        if restarts_this_sync:
+            job.status.replica_statuses[rtype.value].restarts += restarts_this_sync
 
         self.status_updater.update_status_single(
             job, rtype, replicas, restart, worker0_completed

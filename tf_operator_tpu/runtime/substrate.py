@@ -20,6 +20,7 @@ on top exactly like the reference (jobcontroller/pod.go:20-160).
 
 from __future__ import annotations
 
+import dataclasses
 import datetime
 import itertools
 import threading
@@ -105,6 +106,8 @@ class InMemorySubstrate:
         self._jobs: Dict[Tuple[str, str], TFJob] = {}
         self._pods: Dict[Tuple[str, str], k8s.Pod] = {}
         self._services: Dict[Tuple[str, str], k8s.Service] = {}
+        self._pod_groups: Dict[Tuple[str, str], Any] = {}
+        self._pod_logs: Dict[Tuple[str, str], str] = {}
         self.events: List[k8s.Event] = []
         self._subscribers: Dict[str, List[WatchCallback]] = {}
 
@@ -119,7 +122,12 @@ class InMemorySubstrate:
 
     def _notify(self, kind: str, verb: str, obj: Any) -> None:
         for callback in self._subscribers.get(kind, []):
-            callback(verb, deep_copy(obj))
+            if dataclasses.is_dataclass(obj):
+                callback(verb, deep_copy(obj))
+            elif hasattr(obj, "copy"):
+                callback(verb, obj.copy())
+            else:
+                callback(verb, obj)
 
     def subscribe(self, kind: str, callback: WatchCallback) -> None:
         with self._lock:
@@ -244,6 +252,8 @@ class InMemorySubstrate:
             pod = self._pods.pop((namespace, name), None)
             if pod is None:
                 raise NotFound(f"pod {namespace}/{name}")
+            # a pod recreated at the same name must start with fresh logs
+            self._pod_logs.pop((namespace, name), None)
             self._notify("pod", DELETED, pod)
 
     def patch_pod_labels(
@@ -289,6 +299,32 @@ class InMemorySubstrate:
                 raise NotFound(f"service {namespace}/{name}")
             self._notify("service", DELETED, svc)
 
+    # -- PodGroups (gang scheduling) ---------------------------------------
+
+    def create_pod_group(self, group) -> None:
+        with self._lock:
+            key = (group.namespace, group.name)
+            if key in self._pod_groups:
+                raise AlreadyExists(f"podgroup {key} exists")
+            self._pod_groups[key] = group.copy()
+            self._notify("podgroup", ADDED, group)
+
+    def get_pod_group(self, namespace: str, name: str):
+        with self._lock:
+            group = self._pod_groups.get((namespace, name))
+            return group.copy() if group is not None else None
+
+    def update_pod_group(self, group) -> None:
+        with self._lock:
+            self._pod_groups[(group.namespace, group.name)] = group.copy()
+            self._notify("podgroup", MODIFIED, group)
+
+    def delete_pod_group(self, namespace: str, name: str) -> None:
+        with self._lock:
+            group = self._pod_groups.pop((namespace, name), None)
+            if group is not None:
+                self._notify("podgroup", DELETED, group)
+
     # -- Events ------------------------------------------------------------
 
     def record_event(self, event: k8s.Event) -> None:
@@ -304,6 +340,20 @@ class InMemorySubstrate:
                 for e in self.events
                 if e.involved_object_kind == kind and e.involved_object_name == name
             ]
+
+    # -- Pod logs ----------------------------------------------------------
+
+    def append_pod_log(self, namespace: str, name: str, text: str) -> None:
+        with self._lock:
+            self._pod_logs[(namespace, name)] = (
+                self._pod_logs.get((namespace, name), "") + text
+            )
+
+    def read_pod_log(self, namespace: str, name: str) -> str:
+        with self._lock:
+            if (namespace, name) not in self._pods:
+                raise NotFound(f"pod {namespace}/{name}")
+            return self._pod_logs.get((namespace, name), "")
 
     # -- Kubelet simulator -------------------------------------------------
 

@@ -1,0 +1,3 @@
+from .client import TFJobClient
+
+__all__ = ["TFJobClient"]

@@ -1,0 +1,188 @@
+"""TFJobClient: the user-facing SDK.
+
+API surface mirrors the reference Python SDK
+(sdk/python/kubeflow/tfjob/api/tf_job_client.py:28-392): create / get /
+patch / delete, wait_for_job / wait_for_condition, status predicates,
+pod-name and log retrieval by role labels. Instead of swagger-generated
+transport, it speaks to any Substrate — the in-memory fake in tests,
+the real apiserver via KubeSubstrate in clusters.
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Callable, Dict, List, Optional, Union
+
+from ..api import set_defaults, types as t, validate
+from ..runtime.substrate import NotFound, Substrate
+
+JobLike = Union[t.TFJob, dict]
+
+DEFAULT_TIMEOUT = 600  # reference tf_job_client.py:121-122
+DEFAULT_POLL_INTERVAL = 30
+
+
+class TimeoutError_(TimeoutError):
+    pass
+
+
+class TFJobClient:
+    def __init__(self, substrate: Substrate, namespace: str = "default") -> None:
+        self.substrate = substrate
+        self.namespace = namespace
+
+    # -- CRUD --------------------------------------------------------------
+
+    def create(self, job: JobLike, namespace: Optional[str] = None) -> t.TFJob:
+        """Validate client-side, then submit (reference :52-75)."""
+        if isinstance(job, dict):
+            job = t.TFJob.from_dict(job)
+        job = job.copy()
+        if namespace:
+            job.metadata.namespace = namespace
+        elif not job.metadata.namespace:
+            job.metadata.namespace = self.namespace
+        set_defaults(job)
+        validate(job)
+        return self.substrate.create_job(job)
+
+    def get(self, name: str, namespace: Optional[str] = None) -> t.TFJob:
+        return self.substrate.get_job(namespace or self.namespace, name)
+
+    def list(self, namespace: Optional[str] = None) -> List[t.TFJob]:
+        return self.substrate.list_jobs(namespace or self.namespace)
+
+    def patch(self, name: str, patch: dict, namespace: Optional[str] = None) -> t.TFJob:
+        """Merge a partial spec into the stored job (reference :100-130)."""
+        namespace = namespace or self.namespace
+        job = self.substrate.get_job(namespace, name)
+        merged = _deep_merge(job.to_dict(), patch)
+        return self.substrate.update_job(t.TFJob.from_dict(merged))
+
+    def delete(self, name: str, namespace: Optional[str] = None) -> None:
+        self.substrate.delete_job(namespace or self.namespace, name)
+
+    # -- waiting -----------------------------------------------------------
+
+    def wait_for_condition(
+        self,
+        name: str,
+        expected_condition: Union[str, t.ConditionType],
+        namespace: Optional[str] = None,
+        timeout_seconds: int = DEFAULT_TIMEOUT,
+        polling_interval: float = DEFAULT_POLL_INTERVAL,
+        status_callback: Optional[Callable[[t.TFJob], None]] = None,
+    ) -> t.TFJob:
+        """Poll until the condition is True (reference :198-279)."""
+        expected = t.ConditionType(expected_condition)
+        deadline = time.monotonic() + timeout_seconds
+        while True:
+            try:
+                job = self.get(name, namespace)
+            except NotFound:
+                job = None
+            if job is not None:
+                if status_callback is not None:
+                    status_callback(job)
+                if job.has_condition(expected):
+                    return job
+                # terminal short-circuit: stop waiting for Running or
+                # Succeeded once the job has already failed
+                if expected != t.ConditionType.FAILED and job.has_condition(
+                    t.ConditionType.FAILED
+                ):
+                    raise RuntimeError(
+                        f"job {name} failed while waiting for {expected.value}: "
+                        + (job.status.conditions[-1].message if job.status.conditions else "")
+                    )
+            if time.monotonic() >= deadline:
+                raise TimeoutError_(
+                    f"timeout waiting for {name} to reach {expected.value}"
+                )
+            time.sleep(polling_interval)
+
+    def wait_for_job(
+        self,
+        name: str,
+        namespace: Optional[str] = None,
+        timeout_seconds: int = DEFAULT_TIMEOUT,
+        polling_interval: float = DEFAULT_POLL_INTERVAL,
+    ) -> t.TFJob:
+        """Wait until the job finishes, raising if it failed."""
+        deadline = time.monotonic() + timeout_seconds
+        while True:
+            job = self.get(name, namespace)
+            if job.has_condition(t.ConditionType.SUCCEEDED):
+                return job
+            if job.has_condition(t.ConditionType.FAILED):
+                message = job.status.conditions[-1].message if job.status.conditions else ""
+                raise RuntimeError(f"job {name} failed: {message}")
+            if time.monotonic() >= deadline:
+                raise TimeoutError_(f"timeout waiting for {name} to finish")
+            time.sleep(polling_interval)
+
+    # -- status predicates (reference :281-314) ----------------------------
+
+    def get_job_status(self, name: str, namespace: Optional[str] = None) -> str:
+        job = self.get(name, namespace)
+        if job.status.conditions:
+            return job.status.conditions[-1].type.value
+        return ""
+
+    def is_job_running(self, name: str, namespace: Optional[str] = None) -> bool:
+        return self.get_job_status(name, namespace) == t.ConditionType.RUNNING.value
+
+    def is_job_succeeded(self, name: str, namespace: Optional[str] = None) -> bool:
+        return self.get_job_status(name, namespace) == t.ConditionType.SUCCEEDED.value
+
+    # -- pods / logs (reference :317-392) ----------------------------------
+
+    def get_pod_names(
+        self,
+        name: str,
+        namespace: Optional[str] = None,
+        master: bool = False,
+        replica_type: Optional[str] = None,
+        replica_index: Optional[int] = None,
+    ) -> List[str]:
+        namespace = namespace or self.namespace
+        selector: Dict[str, str] = dict(t.gen_labels(name))
+        if master:
+            selector[t.LABEL_JOB_ROLE] = "master"
+        if replica_type is not None:
+            selector[t.LABEL_REPLICA_TYPE] = replica_type.lower()
+        if replica_index is not None:
+            selector[t.LABEL_REPLICA_INDEX] = str(replica_index)
+        pods = self.substrate.list_pods(namespace, selector)
+        return [pod.metadata.name for pod in pods]
+
+    def get_logs(
+        self,
+        name: str,
+        namespace: Optional[str] = None,
+        master: bool = True,
+        replica_type: Optional[str] = None,
+        replica_index: Optional[int] = None,
+    ) -> Dict[str, str]:
+        """Pod name -> log text, for substrates that expose logs."""
+        namespace = namespace or self.namespace
+        names = self.get_pod_names(
+            name, namespace, master=master,
+            replica_type=replica_type, replica_index=replica_index,
+        )
+        reader = getattr(self.substrate, "read_pod_log", None)
+        if reader is None:
+            raise NotImplementedError(
+                f"substrate {type(self.substrate).__name__} does not expose logs"
+            )
+        return {pod_name: reader(namespace, pod_name) for pod_name in names}
+
+
+def _deep_merge(base: dict, patch: dict) -> dict:
+    out = dict(base)
+    for key, value in patch.items():
+        if isinstance(value, dict) and isinstance(out.get(key), dict):
+            out[key] = _deep_merge(out[key], value)
+        else:
+            out[key] = value
+    return out

@@ -1,0 +1,15 @@
+from .leader import FileLock, LeaderElector
+from .metrics import MonitoringServer, OperatorMetrics
+from .options import ServerOptions, parse_args
+from .server import OperatorServer, main
+
+__all__ = [
+    "FileLock",
+    "LeaderElector",
+    "MonitoringServer",
+    "OperatorMetrics",
+    "ServerOptions",
+    "parse_args",
+    "OperatorServer",
+    "main",
+]

@@ -1,0 +1,88 @@
+"""Leader election.
+
+The reference elects through a Kubernetes Endpoints lock with 15s
+lease / 5s renew / 3s retry (reference server.go:157-182, 52-57). The
+same role here is played by a pluggable lock with two implementations:
+a file lock (single-node deployments, tests) and a substrate lease (a
+TFJob-store-backed lease record for multi-replica operators).
+"""
+
+from __future__ import annotations
+
+import fcntl
+import logging
+import os
+import threading
+import time
+from typing import Callable, Optional
+
+logger = logging.getLogger("tf_operator_tpu.leader")
+
+LEASE_DURATION = 15.0
+RENEW_DEADLINE = 5.0
+RETRY_PERIOD = 3.0
+
+
+class FileLock:
+    """flock-based mutual exclusion; held for the process lifetime."""
+
+    def __init__(self, path: str) -> None:
+        self.path = path
+        self._fd: Optional[int] = None
+
+    def try_acquire(self) -> bool:
+        fd = os.open(self.path, os.O_CREAT | os.O_RDWR, 0o644)
+        try:
+            fcntl.flock(fd, fcntl.LOCK_EX | fcntl.LOCK_NB)
+        except OSError:
+            os.close(fd)
+            return False
+        os.ftruncate(fd, 0)
+        os.write(fd, str(os.getpid()).encode())
+        self._fd = fd
+        return True
+
+    def release(self) -> None:
+        if self._fd is not None:
+            fcntl.flock(self._fd, fcntl.LOCK_UN)
+            os.close(self._fd)
+            self._fd = None
+
+
+class LeaderElector:
+    """Block until leadership, run the callback, renew in background.
+
+    on_started_leading runs in the caller's thread (like the reference's
+    OnStartedLeading driving tc.Run); on_stopped_leading fires if the
+    lock is lost.
+    """
+
+    def __init__(
+        self,
+        lock: FileLock,
+        on_started_leading: Callable[[], None],
+        on_stopped_leading: Optional[Callable[[], None]] = None,
+        retry_period: float = RETRY_PERIOD,
+    ) -> None:
+        self.lock = lock
+        self.on_started_leading = on_started_leading
+        self.on_stopped_leading = on_stopped_leading
+        self.retry_period = retry_period
+        self._stop = threading.Event()
+
+    def run(self) -> None:
+        while not self._stop.is_set():
+            if self.lock.try_acquire():
+                logger.info("became leader (lock %s)", self.lock.path)
+                try:
+                    self.on_started_leading()
+                finally:
+                    self.lock.release()
+                    if self.on_stopped_leading is not None:
+                        self.on_stopped_leading()
+                return
+            logger.debug("not leader; retrying in %.1fs", self.retry_period)
+            self._stop.wait(self.retry_period)
+
+    def stop(self) -> None:
+        self._stop.set()

@@ -1,0 +1,126 @@
+"""Prometheus metrics, text exposition format over stdlib HTTP.
+
+Mirrors the reference's metric surface (SURVEY.md #22; names from
+docs/monitoring/README.md:59-91 and the counter definitions in
+job.go:27-32, controller.go:68-71, status.go:45-58, server.go:61-66),
+with no client-library dependency: counters render straight to the
+/metrics text format.
+"""
+
+from __future__ import annotations
+
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Dict, Optional, Tuple
+
+
+class OperatorMetrics:
+    def __init__(self, prefix: str = "tf_operator_tpu") -> None:
+        self.prefix = prefix
+        self._lock = threading.Lock()
+        self._counters: Dict[str, float] = {
+            "jobs_created_total": 0,
+            "jobs_deleted_total": 0,
+            "jobs_successful_total": 0,
+            "jobs_failed_total": 0,
+            "jobs_restarted_total": 0,
+        }
+        self._gauges: Dict[str, float] = {"is_leader": 0}
+        self._help = {
+            "jobs_created_total": "Counts number of jobs created",
+            "jobs_deleted_total": "Counts number of jobs deleted",
+            "jobs_successful_total": "Counts number of jobs successful",
+            "jobs_failed_total": "Counts number of jobs failed",
+            "jobs_restarted_total": "Counts number of jobs restarted",
+            "is_leader": "1 when this replica holds leadership",
+        }
+
+    def _inc(self, name: str) -> None:
+        with self._lock:
+            self._counters[name] += 1
+
+    def created(self) -> None:
+        self._inc("jobs_created_total")
+
+    def deleted(self) -> None:
+        self._inc("jobs_deleted_total")
+
+    def succeeded(self) -> None:
+        self._inc("jobs_successful_total")
+
+    def failed(self) -> None:
+        self._inc("jobs_failed_total")
+
+    def restarted(self) -> None:
+        self._inc("jobs_restarted_total")
+
+    def set_leader(self, is_leader: bool) -> None:
+        with self._lock:
+            self._gauges["is_leader"] = 1 if is_leader else 0
+
+    def value(self, name: str) -> float:
+        with self._lock:
+            if name in self._counters:
+                return self._counters[name]
+            return self._gauges[name]
+
+    def render(self) -> str:
+        lines = []
+        with self._lock:
+            for name, value in sorted(self._counters.items()):
+                full = f"{self.prefix}_{name}"
+                lines.append(f"# HELP {full} {self._help[name]}")
+                lines.append(f"# TYPE {full} counter")
+                lines.append(f"{full} {value}")
+            for name, value in sorted(self._gauges.items()):
+                full = f"{self.prefix}_{name}"
+                lines.append(f"# HELP {full} {self._help[name]}")
+                lines.append(f"# TYPE {full} gauge")
+                lines.append(f"{full} {value}")
+        return "\n".join(lines) + "\n"
+
+
+class MonitoringServer:
+    """/metrics + /healthz endpoint (reference main.go:39-50)."""
+
+    def __init__(self, metrics: OperatorMetrics, port: int = 8443) -> None:
+        self.metrics = metrics
+        self.port = port
+        self._httpd: Optional[ThreadingHTTPServer] = None
+        self._thread: Optional[threading.Thread] = None
+
+    def start(self) -> int:
+        metrics = self.metrics
+
+        class Handler(BaseHTTPRequestHandler):
+            def do_GET(self) -> None:  # noqa: N802
+                if self.path == "/metrics":
+                    body = metrics.render().encode()
+                    self.send_response(200)
+                    self.send_header("Content-Type", "text/plain; version=0.0.4")
+                elif self.path == "/healthz":
+                    body = b"ok"
+                    self.send_response(200)
+                    self.send_header("Content-Type", "text/plain")
+                else:
+                    body = b"not found"
+                    self.send_response(404)
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
+            def log_message(self, *args) -> None:
+                pass  # quiet; operator logs go through logging
+
+        self._httpd = ThreadingHTTPServer(("0.0.0.0", self.port), Handler)
+        self.port = self._httpd.server_address[1]
+        self._thread = threading.Thread(
+            target=self._httpd.serve_forever, name="monitoring", daemon=True
+        )
+        self._thread.start()
+        return self.port
+
+    def stop(self) -> None:
+        if self._httpd is not None:
+            self._httpd.shutdown()
+            self._httpd.server_close()

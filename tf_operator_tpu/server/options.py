@@ -1,0 +1,85 @@
+"""Server flag surface, mirroring reference app/options/options.go:27-87."""
+
+from __future__ import annotations
+
+import argparse
+import dataclasses
+import os
+from typing import List, Optional
+
+
+@dataclasses.dataclass
+class ServerOptions:
+    namespace: Optional[str] = None  # None = all namespaces
+    threadiness: int = 1
+    resync_period: float = 30.0
+    monitoring_port: int = 8443
+    json_log_format: bool = True
+    enable_gang_scheduling: bool = False
+    gang_scheduler_name: str = "volcano"
+    enable_leader_election: bool = True
+    leader_lock_path: str = "/tmp/tfjob-tpu-operator.lock"
+    # host-port range for hostNetwork jobs (reference --bport/--eport)
+    bport: int = 20000
+    eport: int = 30000
+    kubeconfig: Optional[str] = None
+    master: Optional[str] = None
+    substrate: str = "kube"  # "kube" | "memory" (demo/testing)
+
+
+def parse_args(argv: Optional[List[str]] = None) -> ServerOptions:
+    parser = argparse.ArgumentParser(prog="tfjob-tpu-operator")
+    opts = ServerOptions()
+    parser.add_argument(
+        "--namespace",
+        default=os.environ.get("KUBEFLOW_NAMESPACE") or None,
+        help="Restrict watching to one namespace (default: all; env KUBEFLOW_NAMESPACE)",
+    )
+    parser.add_argument("--threadiness", type=int, default=opts.threadiness)
+    parser.add_argument(
+        "--resync-period", type=float, default=opts.resync_period,
+        help="Seconds between level-trigger resyncs",
+    )
+    parser.add_argument("--monitoring-port", type=int, default=opts.monitoring_port)
+    parser.add_argument(
+        "--json-log-format", action=argparse.BooleanOptionalAction,
+        default=opts.json_log_format,
+    )
+    parser.add_argument(
+        "--enable-gang-scheduling", action="store_true",
+        default=opts.enable_gang_scheduling,
+    )
+    parser.add_argument(
+        "--gang-scheduler-name", default=opts.gang_scheduler_name
+    )
+    parser.add_argument(
+        "--enable-leader-election", action=argparse.BooleanOptionalAction,
+        default=opts.enable_leader_election,
+    )
+    parser.add_argument("--leader-lock-path", default=opts.leader_lock_path)
+    parser.add_argument("--bport", type=int, default=opts.bport)
+    parser.add_argument("--eport", type=int, default=opts.eport)
+    parser.add_argument(
+        "--kubeconfig", default=os.environ.get("KUBECONFIG") or None
+    )
+    parser.add_argument("--master", default=None)
+    parser.add_argument(
+        "--substrate", choices=["kube", "memory"], default=opts.substrate
+    )
+    ns = parser.parse_args(argv)
+    return ServerOptions(
+        namespace=ns.namespace,
+        threadiness=ns.threadiness,
+        resync_period=ns.resync_period,
+        monitoring_port=ns.monitoring_port,
+        json_log_format=ns.json_log_format,
+        enable_gang_scheduling=ns.enable_gang_scheduling,
+        gang_scheduler_name=ns.gang_scheduler_name,
+        enable_leader_election=ns.enable_leader_election,
+        leader_lock_path=ns.leader_lock_path,
+        bport=ns.bport,
+        eport=ns.eport,
+        kubeconfig=ns.kubeconfig,
+        master=ns.master,
+        substrate=ns.substrate,
+    )

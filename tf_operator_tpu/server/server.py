@@ -1,0 +1,142 @@
+"""Operator process: wiring + lifecycle (reference cmd/tf-operator.v1).
+
+Startup order mirrors reference app/server.go:68-185: logging, metrics
+endpoint, substrate/clients, CRD existence check, controller
+construction, leader election gating the reconcile loop.
+
+Run it: ``python -m tf_operator_tpu.server --substrate memory`` (demo)
+or against a real apiserver with in-cluster credentials / kubeconfig.
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+import signal
+import sys
+import threading
+from typing import Optional
+
+from ..controller import ReconcilerConfig, TFJobController
+from ..controller.ports import PortAllocator
+from ..runtime import InMemorySubstrate
+from .leader import FileLock, LeaderElector
+from .metrics import MonitoringServer, OperatorMetrics
+from .options import ServerOptions, parse_args
+
+logger = logging.getLogger("tf_operator_tpu.server")
+
+
+class JsonFormatter(logging.Formatter):
+    """Stackdriver-style JSON logs (reference main.go:58-61)."""
+
+    def format(self, record: logging.LogRecord) -> str:
+        entry = {
+            "severity": record.levelname,
+            "message": record.getMessage(),
+            "logger": record.name,
+            "timestamp": self.formatTime(record),
+        }
+        if record.exc_info:
+            entry["exception"] = self.formatException(record.exc_info)
+        return json.dumps(entry)
+
+
+def setup_logging(json_format: bool) -> None:
+    handler = logging.StreamHandler(sys.stderr)
+    if json_format:
+        handler.setFormatter(JsonFormatter())
+    else:
+        handler.setFormatter(
+            logging.Formatter("%(asctime)s %(levelname)s %(name)s %(message)s")
+        )
+    root = logging.getLogger()
+    root.handlers[:] = [handler]
+    root.setLevel(logging.INFO)
+
+
+def build_substrate(options: ServerOptions):
+    if options.substrate == "memory":
+        return InMemorySubstrate()
+    from ..runtime.kube import KubeSubstrate
+
+    return KubeSubstrate.from_config(
+        kubeconfig=options.kubeconfig, master=options.master
+    )
+
+
+def check_crd_exists(substrate) -> bool:
+    """Fail fast when the TFJob CRD is not installed (reference
+    server.go:211-223)."""
+    try:
+        substrate.list_jobs()
+        return True
+    except Exception as err:
+        logger.error("TFJob CRD not reachable: %s", err)
+        return False
+
+
+class OperatorServer:
+    def __init__(self, options: ServerOptions, substrate=None) -> None:
+        self.options = options
+        self.metrics = OperatorMetrics()
+        self.monitoring = MonitoringServer(self.metrics, options.monitoring_port)
+        self.substrate = substrate if substrate is not None else build_substrate(options)
+        self.controller = TFJobController(
+            self.substrate,
+            config=ReconcilerConfig(
+                enable_gang_scheduling=options.enable_gang_scheduling,
+                gang_scheduler_name=options.gang_scheduler_name,
+            ),
+            namespace=options.namespace,
+            metrics=self.metrics,
+            port_allocator=PortAllocator(options.bport, options.eport),
+        )
+        self._stop = threading.Event()
+        self._elector: Optional[LeaderElector] = None
+
+    def run(self) -> int:
+        self.monitoring.start()
+        logger.info("monitoring on :%d", self.monitoring.port)
+        if not check_crd_exists(self.substrate):
+            return 1
+
+        def lead() -> None:
+            self.metrics.set_leader(True)
+            self.controller.run(
+                threadiness=self.options.threadiness,
+                resync_period=self.options.resync_period,
+            )
+            self._stop.wait()
+            self.controller.stop()
+
+        if self.options.enable_leader_election:
+            self._elector = LeaderElector(
+                FileLock(self.options.leader_lock_path),
+                on_started_leading=lead,
+                on_stopped_leading=lambda: self.metrics.set_leader(False),
+            )
+            self._elector.run()
+        else:
+            lead()
+        return 0
+
+    def shutdown(self, *_args) -> None:
+        logger.info("shutting down")
+        self._stop.set()
+        if self._elector is not None:
+            self._elector.stop()
+        self.monitoring.stop()
+
+
+def main(argv=None) -> int:
+    options = parse_args(argv)
+    setup_logging(options.json_log_format)
+    server = OperatorServer(options)
+    signal.signal(signal.SIGTERM, server.shutdown)
+    signal.signal(signal.SIGINT, server.shutdown)
+    return server.run()
+
+
+if __name__ == "__main__":
+    sys.exit(main())
