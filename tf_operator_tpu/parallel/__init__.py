@@ -10,6 +10,7 @@ from .mesh import (
     replicated,
     single_device_mesh,
 )
+from .ring_attention import make_ring_attention
 from .sharding import (
     CONV_RULES,
     REPLICATED_RULES,
@@ -36,4 +37,5 @@ __all__ = [
     "REPLICATED_RULES",
     "shardings_for_tree",
     "place",
+    "make_ring_attention",
 ]

@@ -1,0 +1,139 @@
+"""Ring attention: exact attention over sequence shards (context
+parallelism).
+
+First-class long-context support (absent in the reference, SURVEY.md
+§5: its operator never sees sequence length). Each device on the
+``sp`` mesh axis holds one sequence shard of Q/K/V; KV shards rotate
+around the ring with ``lax.ppermute`` (ICI neighbor exchange) while
+each device folds the visiting KV block into a flash-style
+online-softmax accumulator. Communication overlaps compute, memory is
+O(seq/n) per device, and the result is numerically exact attention —
+the blockwise/ring-attention construction (Liu et al. 2023) expressed
+with XLA collectives.
+"""
+
+from __future__ import annotations
+
+import functools
+import math
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.sharding import Mesh, PartitionSpec as P
+
+try:  # jax >= 0.4.35
+    from jax import shard_map as _shard_map_mod
+
+    shard_map = _shard_map_mod.shard_map  # type: ignore[attr-defined]
+except (ImportError, AttributeError):
+    from jax.experimental.shard_map import shard_map  # type: ignore
+
+NEG_INF = -1e30
+
+
+def _ring_shard(q, k, v, axis_name: str, causal: bool, n: int):
+    """Per-device body. q/k/v: [batch, seq_shard, heads, head_dim] (the
+    local shard); returns the local output shard. `n` is the static
+    ring size (scan length must be concrete)."""
+    my_rank = lax.axis_index(axis_name)
+    seq_shard = q.shape[1]
+    sm_scale = 1.0 / math.sqrt(q.shape[-1])
+    q32 = q.astype(jnp.float32) * sm_scale
+
+    def fold(acc, step, k_blk, v_blk):
+        """Fold one visiting KV block into the online-softmax state."""
+        o, m, l = acc
+        # the block visiting at `step` originated at rank (my - step) % n
+        src = (my_rank - step) % n
+        s = jnp.einsum(
+            "bqhd,bkhd->bhqk", q32, k_blk.astype(jnp.float32)
+        )  # [b, h, q_shard, k_shard]
+        if causal:
+            q_pos = my_rank * seq_shard + lax.broadcasted_iota(
+                jnp.int32, s.shape[-2:], 0
+            )
+            k_pos = src * seq_shard + lax.broadcasted_iota(
+                jnp.int32, s.shape[-2:], 1
+            )
+            s = jnp.where(q_pos >= k_pos, s, NEG_INF)
+        m_new = jnp.maximum(m, jnp.max(s, axis=-1))
+        p = jnp.exp(s - m_new[..., None])
+        alpha = jnp.exp(m - m_new)
+        l = l * alpha + jnp.sum(p, axis=-1)
+        o = o * alpha[..., None] + jnp.einsum(
+            "bhqk,bkhd->bhqd", p, v_blk.astype(jnp.float32)
+        )
+        return (o, m_new, l)
+
+    def fold_and_rotate(carry, step):
+        acc, k_blk, v_blk = carry
+        acc = fold(acc, step, k_blk, v_blk)
+        # rotate KV around the ring: neighbor exchange over ICI,
+        # overlapped with the next block's compute by XLA latency hiding
+        perm = [(i, (i + 1) % n) for i in range(n)]
+        k_blk = lax.ppermute(k_blk, axis_name, perm)
+        v_blk = lax.ppermute(v_blk, axis_name, perm)
+        return (acc, k_blk, v_blk), None
+
+    batch, _, heads, head_dim = q.shape
+    acc = (
+        jnp.zeros((batch, heads, seq_shard, head_dim), jnp.float32),
+        jnp.full((batch, heads, seq_shard), NEG_INF, jnp.float32),
+        jnp.zeros((batch, heads, seq_shard), jnp.float32),
+    )
+    if n > 1:
+        # n-1 fold+rotate rounds; the final visiting block is folded
+        # outside the loop so no collective is issued for a rotation
+        # whose result would be discarded
+        (acc, k_last, v_last), _ = lax.scan(
+            fold_and_rotate, (acc, k, v), jnp.arange(n - 1)
+        )
+    else:
+        k_last, v_last = k, v
+    o, m, l = fold(acc, n - 1, k_last, v_last)
+    out = o / jnp.maximum(l, 1e-30)[..., None]
+    return out.transpose(0, 2, 1, 3).astype(q.dtype)
+
+
+def make_ring_attention(
+    mesh: Mesh,
+    axis_name: str = "sp",
+    causal: bool = False,
+    batch_axes=("dp", "fsdp"),
+    heads_axis: Optional[str] = "tp",
+):
+    """Build an attention_fn (query, key, value, mask) -> out compatible
+    with ops.attention.MultiHeadAttention, computing exact attention
+    with the sequence dimension sharded over `axis_name`.
+
+    Padding masks are not supported on the ring path (sequence-parallel
+    pretraining assumes packed/unpadded batches); passing one raises.
+    """
+    spec = P(batch_axes, axis_name, heads_axis, None)
+    n = mesh.shape[axis_name]
+
+    def sharded_body(q, k, v):
+        return _ring_shard(q, k, v, axis_name=axis_name, causal=causal, n=n)
+
+    try:
+        sharded = shard_map(
+            sharded_body, mesh=mesh, in_specs=(spec, spec, spec),
+            out_specs=spec, check_vma=False,
+        )
+    except TypeError:  # older jax spells the flag check_rep
+        sharded = shard_map(
+            sharded_body, mesh=mesh, in_specs=(spec, spec, spec),
+            out_specs=spec, check_rep=False,
+        )
+
+    def attention_fn(query, key, value, mask=None):
+        if mask is not None:
+            raise NotImplementedError(
+                "ring attention requires unpadded (packed) batches; "
+                "drop the attention mask for sequence-parallel training"
+            )
+        return sharded(query, key, value)
+
+    return attention_fn
