@@ -41,11 +41,41 @@ class TestFlashAttention:
         out = flash_attention(q, k, v, causal=True)
         np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=2e-6)
 
-    def test_gradients(self, qkv):
+    def test_gradients_all_inputs(self, qkv):
+        # differentiate w.r.t. q, k AND v: the dk/dv accumulators in the
+        # chunked backward are the riskiest paths
         q, k, v = qkv
-        g_ref = jax.grad(lambda q: dot_product_attention(q, k, v).sum())(q)
-        g_out = jax.grad(lambda q: flash_attention(q, k, v).sum())(q)
-        np.testing.assert_allclose(np.asarray(g_out), np.asarray(g_ref), atol=5e-6)
+        ref_grads = jax.grad(
+            lambda q, k, v: (dot_product_attention(q, k, v) ** 2).sum(),
+            argnums=(0, 1, 2),
+        )(q, k, v)
+        out_grads = jax.grad(
+            lambda q, k, v: (flash_attention(q, k, v) ** 2).sum(),
+            argnums=(0, 1, 2),
+        )(q, k, v)
+        for name, got, want in zip("qkv", out_grads, ref_grads):
+            np.testing.assert_allclose(
+                np.asarray(got), np.asarray(want), atol=1e-4,
+                err_msg=f"d{name} mismatch",
+            )
+
+    def test_causal_gradients_all_inputs(self, qkv):
+        q, k, v = qkv
+        s = q.shape[1]
+        mask = jnp.tril(jnp.ones((s, s), bool))[None, None]
+        ref_grads = jax.grad(
+            lambda q, k, v: (dot_product_attention(q, k, v, mask) ** 2).sum(),
+            argnums=(0, 1, 2),
+        )(q, k, v)
+        out_grads = jax.grad(
+            lambda q, k, v: (flash_attention(q, k, v, causal=True) ** 2).sum(),
+            argnums=(0, 1, 2),
+        )(q, k, v)
+        for name, got, want in zip("qkv", out_grads, ref_grads):
+            np.testing.assert_allclose(
+                np.asarray(got), np.asarray(want), atol=1e-4,
+                err_msg=f"d{name} mismatch",
+            )
 
     def test_fallback_on_mask_or_misaligned(self, qkv):
         q, k, v = qkv
@@ -107,13 +137,24 @@ class TestRingAttention:
             np.asarray(ring(q, k, v)), np.asarray(ref), atol=2e-6
         )
 
-    def test_gradients(self, qkv):
+    def test_gradients_all_inputs(self, qkv):
+        # k/v gradients flow backward through the transposed ppermute
+        # ring — the path a wrong-direction permutation would corrupt
         q, k, v = qkv
         mesh = build_mesh(MeshConfig(dp=2, sp=4))
         ring = make_ring_attention(mesh)
-        g_ref = jax.grad(lambda q: dot_product_attention(q, k, v).sum())(q)
-        g_ring = jax.grad(lambda q: ring(q, k, v).sum())(q)
-        np.testing.assert_allclose(np.asarray(g_ring), np.asarray(g_ref), atol=5e-6)
+        ref_grads = jax.grad(
+            lambda q, k, v: (dot_product_attention(q, k, v) ** 2).sum(),
+            argnums=(0, 1, 2),
+        )(q, k, v)
+        ring_grads = jax.grad(
+            lambda q, k, v: (ring(q, k, v) ** 2).sum(), argnums=(0, 1, 2)
+        )(q, k, v)
+        for name, got, want in zip("qkv", ring_grads, ref_grads):
+            np.testing.assert_allclose(
+                np.asarray(got), np.asarray(want), atol=1e-4,
+                err_msg=f"d{name} mismatch",
+            )
 
     def test_mask_rejected(self, qkv):
         q, k, v = qkv
@@ -143,7 +184,8 @@ class TestRingAttention:
         )
         rng = jax.random.PRNGKey(2)
         batch = bert_lib.synthetic_batch(rng, 4, 256, cfg)
-        batch.pop("attention_mask")  # packed sequences: no padding mask
+        # note: attention_mask left in — the Trainer drops it for
+        # sequence-parallel runs (the mechanism, not the caller)
         state = trainer.init(rng, batch)
         state, metrics = trainer.step(state, trainer.place_batch(batch))
         assert np.isfinite(float(metrics["loss"]))

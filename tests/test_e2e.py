@@ -1,0 +1,246 @@
+"""Behavior-level E2E suites: real controller + real processes.
+
+Mirror of the reference's Python E2E classes (SURVEY.md §4.2 —
+simple_tfjob_tests, estimator_runconfig_tests, shutdown_policy_tests,
+replica_restart_policy_tests, cleanpod_policy_tests,
+pod_names_validation_tests), with the GKE cluster replaced by
+InMemorySubstrate + ProcessKubelet: every pod is a live local process
+running the fake workload server, controlled over HTTP exactly like the
+reference's /exit?exitCode=n fault injection.
+"""
+
+import json
+import time
+import urllib.request
+
+import pytest
+
+from tf_operator_tpu.api import types as t
+from tf_operator_tpu.controller import TFJobController
+from tf_operator_tpu.runtime import InMemorySubstrate
+from tf_operator_tpu.runtime.process_kubelet import ProcessKubelet
+from tf_operator_tpu.sdk import TFJobClient
+
+from tests.test_api import make_job
+
+
+def wait_until(predicate, timeout=15.0, interval=0.1, message="condition"):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if predicate():
+            return
+        time.sleep(interval)
+    raise AssertionError(f"timed out waiting for {message}")
+
+
+def http_json(url, timeout=3.0):
+    with urllib.request.urlopen(url, timeout=timeout) as resp:
+        return json.loads(resp.read())
+
+
+@pytest.fixture()
+def cluster():
+    """A running 'cluster': substrate + process kubelet + controller."""
+    substrate = InMemorySubstrate()
+    kubelet = ProcessKubelet(substrate)
+    controller = TFJobController(substrate)
+    controller.run(threadiness=2, resync_period=0.5)
+    client = TFJobClient(substrate)
+    try:
+        yield substrate, kubelet, controller, client
+    finally:
+        controller.stop()
+        kubelet.shutdown()
+
+
+def pod_running(substrate, name, namespace="default"):
+    def check():
+        try:
+            from tf_operator_tpu.api import k8s
+
+            return substrate.get_pod(namespace, name).status.phase == k8s.POD_RUNNING
+        except KeyError:
+            return False
+
+    return check
+
+
+class TestSimpleTFJob:
+    """simple_tfjob_tests.py: job runs to completion."""
+
+    def test_worker_job_succeeds(self, cluster):
+        substrate, kubelet, controller, client = cluster
+        client.create(make_job({"Worker": 2}, name="simple"))
+        wait_until(
+            lambda: client.get_job_status("simple") == "Running",
+            message="job running",
+        )
+        # remote-controlled success: worker 0 exits 0
+        wait_until(pod_running(substrate, "simple-worker-0"), message="worker0 up")
+        try:
+            http_json(kubelet.url_of("default", "simple-worker-0", "/exit?exitCode=0"))
+        except OSError:
+            pass  # connection may drop as the process exits
+        wait_until(
+            lambda: client.is_job_succeeded("simple"), message="job succeeded"
+        )
+        job = client.get("simple")
+        assert job.status.completion_time is not None
+
+
+class TestClusterSpecInjection:
+    """estimator_runconfig_tests.py analog: assert the cluster spec the
+    *process itself* parsed, not what the controller intended."""
+
+    def test_tf_config_as_seen_by_process(self, cluster):
+        substrate, kubelet, controller, client = cluster
+        client.create(make_job({"Worker": 2, "PS": 1}, name="cfg"))
+        wait_until(pod_running(substrate, "cfg-worker-1"), message="worker1 up")
+        config = http_json(kubelet.url_of("default", "cfg-worker-1", "/tfconfig"))
+        assert config["task"] == {"type": "worker", "index": 1}
+        assert config["environment"] == "cloud"
+        assert config["cluster"]["worker"] == [
+            "cfg-worker-0.default.svc:2222",
+            "cfg-worker-1.default.svc:2222",
+        ]
+        assert config["cluster"]["ps"] == ["cfg-ps-0.default.svc:2222"]
+
+    def test_tpu_env_as_seen_by_process(self, cluster):
+        substrate, kubelet, controller, client = cluster
+        job = make_job({"TPU": 2}, name="tpu-env")
+        spec = job.spec.tf_replica_specs["TPU"]
+        spec.tpu_accelerator = "v5e-8"
+        spec.tpu_topology = "2x4"
+        client.create(job)
+        wait_until(pod_running(substrate, "tpu-env-tpu-1"), message="tpu host up")
+        env = http_json(kubelet.url_of("default", "tpu-env-tpu-1", "/env"))
+        assert env["TPU_WORKER_ID"] == "1"
+        assert env["TPU_TOPOLOGY"] == "2x4"
+        assert env["TPU_WORKER_HOSTNAMES"] == (
+            "tpu-env-tpu-0.default.svc,tpu-env-tpu-1.default.svc"
+        )
+        proc_env = http_json(
+            kubelet.url_of("default", "tpu-env-tpu-1", "/processenv")
+        )
+        assert proc_env["process_id"] == 1
+        assert proc_env["num_processes"] == 2
+        assert proc_env["coordinator_address"] == "tpu-env-tpu-0.default.svc:2222"
+
+
+class TestShutdownPolicy:
+    """shutdown_policy_tests.py: chief exit ends the job."""
+
+    def test_chief_completion_ends_job(self, cluster):
+        substrate, kubelet, controller, client = cluster
+        client.create(make_job({"Chief": 1, "Worker": 2}, name="shut"))
+        wait_until(pod_running(substrate, "shut-chief-0"), message="chief up")
+        wait_until(pod_running(substrate, "shut-worker-1"), message="workers up")
+        try:
+            http_json(kubelet.url_of("default", "shut-chief-0", "/exit?exitCode=0"))
+        except OSError:
+            pass
+        wait_until(lambda: client.is_job_succeeded("shut"), message="job done")
+        # CleanPodPolicy Running (default): live workers were torn down,
+        # which kills their processes
+        wait_until(
+            lambda: all(
+                not p.is_active() for p in substrate.list_pods("default")
+            ),
+            message="workers cleaned",
+        )
+
+
+class TestReplicaRestartPolicy:
+    """replica_restart_policy_tests.py: exit-code semantics on live
+    processes."""
+
+    def test_retryable_code_restarts_replica(self, cluster):
+        substrate, kubelet, controller, client = cluster
+        job = make_job({"Worker": 2}, name="restart")
+        job.spec.tf_replica_specs["Worker"].restart_policy = t.RestartPolicy.EXIT_CODE
+        client.create(job)
+        wait_until(pod_running(substrate, "restart-worker-1"), message="worker1 up")
+        first_port = kubelet.port_of("default", "restart-worker-1")
+        try:
+            http_json(
+                kubelet.url_of("default", "restart-worker-1", "/exit?exitCode=137")
+            )
+        except OSError:
+            pass
+        # the controller deletes + recreates; a NEW process appears
+        wait_until(
+            lambda: (
+                pod_running(substrate, "restart-worker-1")()
+                and kubelet.port_of("default", "restart-worker-1") != first_port
+            ),
+            message="worker1 restarted as a new process",
+        )
+        assert not client.get("restart").is_finished()
+        stored = client.get("restart")
+        assert stored.status.replica_statuses["Worker"].restarts == 1
+
+    def test_permanent_code_fails_job(self, cluster):
+        substrate, kubelet, controller, client = cluster
+        job = make_job({"Worker": 2}, name="perm")
+        job.spec.tf_replica_specs["Worker"].restart_policy = t.RestartPolicy.EXIT_CODE
+        client.create(job)
+        wait_until(pod_running(substrate, "perm-worker-0"), message="worker0 up")
+        try:
+            http_json(kubelet.url_of("default", "perm-worker-0", "/exit?exitCode=1"))
+        except OSError:
+            pass
+        wait_until(
+            lambda: client.get("perm").has_condition(t.ConditionType.FAILED),
+            message="job failed",
+        )
+
+
+class TestCleanPodPolicy:
+    """cleanpod_policy_tests.py over live processes."""
+
+    @pytest.mark.parametrize(
+        "policy,expect_remaining",
+        [(t.CleanPodPolicy.NONE, 2), (t.CleanPodPolicy.ALL, 0)],
+        ids=["None", "All"],
+    )
+    def test_cleanup(self, cluster, policy, expect_remaining):
+        substrate, kubelet, controller, client = cluster
+        name = f"clean-{policy.value.lower()}"
+        job = make_job({"Worker": 2}, name=name)
+        job.spec.run_policy.clean_pod_policy = policy
+        client.create(job)
+        wait_until(pod_running(substrate, f"{name}-worker-0"), message="up")
+        try:
+            http_json(
+                kubelet.url_of("default", f"{name}-worker-0", "/exit?exitCode=0")
+            )
+        except OSError:
+            pass
+        wait_until(lambda: client.is_job_succeeded(name), message="succeeded")
+        time.sleep(0.5)  # let cleanup settle
+        assert len(substrate.list_pods("default")) == expect_remaining
+
+
+class TestPodNames:
+    """pod_names_validation_tests.py."""
+
+    def test_names_and_services(self, cluster):
+        substrate, kubelet, controller, client = cluster
+        client.create(make_job({"Worker": 2, "PS": 1, "Evaluator": 1}, name="names"))
+        wait_until(
+            lambda: len(substrate.list_pods("default")) == 4, message="pods up"
+        )
+        expected = {
+            "names-worker-0", "names-worker-1", "names-ps-0", "names-evaluator-0",
+        }
+        assert {p.metadata.name for p in substrate.list_pods("default")} == expected
+        assert {
+            s.metadata.name for s in substrate.list_services("default")
+        } == expected
+        # logs flow from real process stdout through the substrate
+        wait_until(pod_running(substrate, "names-worker-0"), message="w0 up")
+        wait_until(
+            lambda: "workload server"
+            in client.get_logs("names", master=True)["names-worker-0"],
+            message="logs captured",
+        )

@@ -40,6 +40,11 @@ class BertConfig:
 
 # BERT-base (the BASELINE pretraining config) and a tiny test variant.
 BERT_BASE = BertConfig()
+# TPU-optimized base variant: same parameter count, 6 heads x 128 dims
+# instead of 12 x 64 — head_dim 128 fills the MXU's 128-lane tile, which
+# makes the pallas flash-attention kernel eligible (and ~3x faster than
+# the XLA path; narrow 64-dim heads are measurably slower in-kernel).
+BERT_BASE_WIDE = BertConfig(num_heads=6)
 BERT_TINY = BertConfig(
     vocab_size=1024, hidden_size=128, num_layers=2, num_heads=4,
     intermediate_size=512, max_position_embeddings=128,

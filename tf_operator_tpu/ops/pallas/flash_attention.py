@@ -28,9 +28,26 @@ import jax.numpy as jnp
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
+import logging
+
 DEFAULT_BLOCK_Q = 128
 DEFAULT_BLOCK_KV = 128
 NEG_INF = -1e30
+
+logger = logging.getLogger("tf_operator_tpu.flash_attention")
+_warned: set = set()
+
+
+def _warn_fallback(sq: int, sk: int, d: int) -> None:
+    key = (sq, sk, d)
+    if key not in _warned:
+        _warned.add(key)
+        logger.warning(
+            "flash_attention falling back to the XLA path for shape "
+            "seq=%d/%d head_dim=%d (kernel requires block-aligned seq and "
+            "head_dim%%128==0 — see supports()); wide-head configs like "
+            "BERT_BASE_WIDE are flash-eligible", sq, sk, d,
+        )
 
 
 def _flash_kernel(
@@ -186,6 +203,10 @@ _flash.defvjp(_flash_fwd, _flash_bwd)
 
 def supports(seq_q: int, seq_kv: int, head_dim: int,
              block_q: int = DEFAULT_BLOCK_Q, block_kv: int = DEFAULT_BLOCK_KV) -> bool:
+    """Shapes the kernel beats XLA on. head_dim must fill the 128-lane
+    tile: measured on v5e, the kernel is ~3x faster than the XLA path at
+    head_dim 128 but ~6x SLOWER at head_dim 64/32 (mostly-empty MXU
+    tiles), so narrow heads deliberately take the reference path."""
     return (
         seq_q % block_q == 0
         and seq_kv % block_kv == 0
@@ -212,6 +233,8 @@ def flash_attention(
     b, sq, h, d = query.shape
     sk = key.shape[1]
     if mask is not None or not supports(sq, sk, d, block_q, block_kv):
+        if mask is None:
+            _warn_fallback(sq, sk, d)
         if causal:
             # the fallback must honor causality too
             causal_mask = (

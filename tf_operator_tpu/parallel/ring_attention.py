@@ -23,11 +23,14 @@ import jax.numpy as jnp
 from jax import lax
 from jax.sharding import Mesh, PartitionSpec as P
 
-try:  # jax >= 0.4.35
-    from jax import shard_map as _shard_map_mod
-
-    shard_map = _shard_map_mod.shard_map  # type: ignore[attr-defined]
-except (ImportError, AttributeError):
+# jax >= 0.7 exposes shard_map as a top-level function; older versions
+# as jax.experimental.shard_map.shard_map (module attr).
+_sm = getattr(jax, "shard_map", None)
+if callable(_sm):
+    shard_map = _sm
+elif _sm is not None and hasattr(_sm, "shard_map"):
+    shard_map = _sm.shard_map
+else:
     from jax.experimental.shard_map import shard_map  # type: ignore
 
 NEG_INF = -1e30

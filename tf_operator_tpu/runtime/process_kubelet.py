@@ -1,0 +1,185 @@
+"""ProcessKubelet: run pods as real local processes.
+
+The reference tests controller semantics against a live cluster by
+running its fake training server as the "tensorflow" container on GKE
+(SURVEY.md §4.2 trick #2). This kubelet gives the same fidelity with no
+cluster: it watches the InMemorySubstrate's pod store and, for each
+created pod, launches an actual OS process with the pod's injected env
+(TF_CONFIG / TPU_* / JAX_*), reports phase transitions back from real
+process lifecycle, and kills processes when pods are deleted.
+
+The controller cannot tell this apart from a node agent: pods it
+creates start Running, crash with real exit codes, and die on delete.
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+import socket
+import subprocess
+import sys
+import threading
+import time
+import urllib.request
+from typing import Dict, List, Optional, Tuple
+
+from ..api import k8s
+from .substrate import ADDED, DELETED, InMemorySubstrate, NotFound
+
+logger = logging.getLogger("tf_operator_tpu.process_kubelet")
+
+DEFAULT_COMMAND = [sys.executable, "-m", "tf_operator_tpu.testing.workload_server"]
+
+
+def free_port() -> int:
+    with socket.socket() as sock:
+        sock.bind(("127.0.0.1", 0))
+        return sock.getsockname()[1]
+
+
+class ProcessKubelet:
+    """Attach to a substrate; pods become subprocesses."""
+
+    def __init__(
+        self,
+        substrate: InMemorySubstrate,
+        command: Optional[List[str]] = None,
+        wait_ready: bool = True,
+    ) -> None:
+        self.substrate = substrate
+        self.command = command or DEFAULT_COMMAND
+        self.wait_ready = wait_ready
+        self._lock = threading.Lock()
+        self._procs: Dict[Tuple[str, str], subprocess.Popen] = {}
+        self._ports: Dict[Tuple[str, str], int] = {}
+        substrate.subscribe("pod", self._on_pod)
+
+    # -- event handling ----------------------------------------------------
+
+    def _on_pod(self, verb: str, pod: k8s.Pod) -> None:
+        key = (pod.metadata.namespace, pod.metadata.name)
+        if verb == ADDED:
+            thread = threading.Thread(
+                target=self._launch, args=(pod,), daemon=True,
+                name=f"kubelet-{pod.metadata.name}",
+            )
+            thread.start()
+        elif verb == DELETED:
+            self._kill(key)
+
+    # -- lifecycle ---------------------------------------------------------
+
+    def _launch(self, pod: k8s.Pod) -> None:
+        key = (pod.metadata.namespace, pod.metadata.name)
+        container = pod.spec.containers[0] if pod.spec.containers else None
+        port = free_port()
+        env = dict(os.environ)
+        # pods must not inherit the host process's accelerator claim:
+        # with a tunneled single-chip TPU, every child would otherwise
+        # race to grab the chip at interpreter start (slow + contended)
+        env.pop("PALLAS_AXON_POOL_IPS", None)
+        if env.get("JAX_PLATFORMS") in (None, "axon"):
+            env["JAX_PLATFORMS"] = "cpu"
+        if container is not None:
+            for var in container.env:
+                env[var.name] = var.value
+        env["PORT"] = str(port)
+        command = (
+            list(container.command)
+            if container is not None and container.command
+            else list(self.command)
+        )
+        if container is not None and container.args:
+            command += list(container.args)
+        try:
+            proc = subprocess.Popen(
+                command,
+                env=env,
+                cwd=os.path.dirname(os.path.dirname(os.path.dirname(__file__))),
+                stdout=subprocess.PIPE,
+                stderr=subprocess.STDOUT,
+                text=True,
+            )
+        except OSError as err:
+            logger.error("pod %s failed to launch: %s", key, err)
+            try:
+                self.substrate.terminate_pod(*key, exit_code=127)
+            except NotFound:
+                pass
+            return
+        with self._lock:
+            self._procs[key] = proc
+            self._ports[key] = port
+        if self.wait_ready:
+            self._await_ready(port)
+        try:
+            self.substrate.mark_pod_running(*key)
+        except NotFound:
+            self._kill(key)
+            return
+        threading.Thread(
+            target=self._reap, args=(key, proc), daemon=True,
+            name=f"reaper-{pod.metadata.name}",
+        ).start()
+        threading.Thread(
+            target=self._pump_logs, args=(key, proc), daemon=True,
+        ).start()
+
+    def _await_ready(self, port: int, timeout: float = 15.0) -> None:
+        deadline = time.monotonic() + timeout
+        while time.monotonic() < deadline:
+            try:
+                urllib.request.urlopen(
+                    f"http://127.0.0.1:{port}/healthz", timeout=0.3
+                )
+                return
+            except OSError:
+                time.sleep(0.05)
+
+    def _reap(self, key: Tuple[str, str], proc: subprocess.Popen) -> None:
+        code = proc.wait()
+        with self._lock:
+            if self._procs.get(key) is not proc:
+                return  # superseded (pod deleted + recreated)
+            self._procs.pop(key, None)
+            self._ports.pop(key, None)
+        try:
+            self.substrate.terminate_pod(*key, exit_code=code)
+        except NotFound:
+            pass  # pod already deleted
+
+    def _pump_logs(self, key: Tuple[str, str], proc: subprocess.Popen) -> None:
+        if proc.stdout is None:
+            return
+        for line in proc.stdout:
+            try:
+                self.substrate.append_pod_log(*key, text=line)
+            except Exception:
+                break
+
+    def _kill(self, key: Tuple[str, str]) -> None:
+        with self._lock:
+            proc = self._procs.pop(key, None)
+            self._ports.pop(key, None)
+        if proc is not None and proc.poll() is None:
+            proc.terminate()
+            try:
+                proc.wait(timeout=3)
+            except subprocess.TimeoutExpired:
+                proc.kill()
+
+    # -- test access -------------------------------------------------------
+
+    def port_of(self, namespace: str, name: str) -> int:
+        with self._lock:
+            return self._ports[(namespace, name)]
+
+    def url_of(self, namespace: str, name: str, path: str = "") -> str:
+        return f"http://127.0.0.1:{self.port_of(namespace, name)}{path}"
+
+    def shutdown(self) -> None:
+        with self._lock:
+            keys = list(self._procs)
+        for key in keys:
+            self._kill(key)

@@ -1,0 +1,4 @@
+from ..runtime.process_kubelet import ProcessKubelet
+from .workload_server import collect_env
+
+__all__ = ["ProcessKubelet", "collect_env"]

@@ -1,0 +1,108 @@
+"""Fake workload server: a remote-controllable stand-in for a training
+container.
+
+Port of the reference's test-server (test/test-server/test_app.py:15-82)
+from Flask to stdlib http.server, extended for the TPU contract:
+
+- GET /env        -> JSON of the bootstrap env this process received
+                     (TF_CONFIG, TPU_*, JAX_*) — the analog of /tfconfig
+- GET /tfconfig   -> parsed TF_CONFIG (what a TF RunConfig would see),
+                     mirroring /runconfig assertions
+                     (estimator_runconfig_tests.py:25-100)
+- GET /processenv -> the slice identity as parallel.distributed parses it
+- GET /exit?exitCode=n -> terminate with a chosen code (remote-controlled
+                     fault injection, shutdown_policy_tests.py:46-51)
+- GET /healthz    -> ok
+
+Run: python -m tf_operator_tpu.testing.workload_server [--port N]
+(default port: $PORT, else the tfjob default 2222).
+"""
+
+from __future__ import annotations
+
+import argparse
+import dataclasses
+import json
+import os
+import sys
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from urllib.parse import parse_qs, urlparse
+
+INTERESTING_PREFIXES = ("TF_CONFIG", "TPU_", "JAX_", "TFJOB_")
+
+
+def collect_env() -> dict:
+    return {
+        key: value
+        for key, value in os.environ.items()
+        if key.startswith(INTERESTING_PREFIXES)
+    }
+
+
+def make_handler():
+    class Handler(BaseHTTPRequestHandler):
+        def _reply(self, code: int, payload) -> None:
+            body = json.dumps(payload).encode()
+            self.send_response(code)
+            self.send_header("Content-Type", "application/json")
+            self.send_header("Content-Length", str(len(body)))
+            self.end_headers()
+            self.wfile.write(body)
+
+        def do_GET(self) -> None:  # noqa: N802
+            url = urlparse(self.path)
+            if url.path == "/healthz":
+                self._reply(200, {"status": "ok"})
+            elif url.path == "/env":
+                self._reply(200, collect_env())
+            elif url.path == "/tfconfig":
+                raw = os.environ.get("TF_CONFIG")
+                if not raw:
+                    self._reply(404, {"error": "TF_CONFIG not set"})
+                else:
+                    self._reply(200, json.loads(raw))
+            elif url.path == "/processenv":
+                from ..parallel.distributed import read_process_env
+
+                self._reply(200, dataclasses.asdict(read_process_env()))
+            elif url.path == "/exit":
+                params = parse_qs(url.query)
+                code = int(params.get("exitCode", ["0"])[0])
+                self._reply(200, {"exiting": code})
+
+                # exit from a helper thread, slightly delayed so the
+                # response flushes; do NOT shutdown() the server first —
+                # that lets the main thread return 0 before _exit(code)
+                def _die() -> None:
+                    import time
+
+                    time.sleep(0.2)
+                    os._exit(code)
+
+                threading.Thread(target=_die, daemon=True).start()
+            else:
+                self._reply(404, {"error": f"no route {url.path}"})
+
+        def log_message(self, *args) -> None:
+            pass
+
+    return Handler
+
+
+def main(argv=None) -> int:
+    parser = argparse.ArgumentParser()
+    parser.add_argument(
+        "--port",
+        type=int,
+        default=int(os.environ.get("PORT", "2222")),
+    )
+    args = parser.parse_args(argv)
+    httpd = ThreadingHTTPServer(("127.0.0.1", args.port), make_handler())
+    print(f"workload server on :{httpd.server_address[1]}", flush=True)
+    httpd.serve_forever()
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,88 @@
+"""dist-MNIST training entrypoint — the workload inside the pods.
+
+JAX counterpart of reference examples/v1/dist-mnist/dist_mnist.py
+(PS/Worker async SGD there): here every pod calls
+``parallel.initialize()`` to join the slice from the operator-injected
+env, builds one data-parallel mesh, and gradients all-reduce over ICI —
+no parameter servers to run.
+
+    python -m tf_operator_tpu.train.mnist --steps 200 --batch-size 64
+"""
+
+from __future__ import annotations
+
+import argparse
+import logging
+import sys
+
+logger = logging.getLogger("tf_operator_tpu.train.mnist")
+
+
+def main(argv=None) -> int:
+    parser = argparse.ArgumentParser()
+    parser.add_argument("--steps", type=int, default=500)
+    parser.add_argument("--batch-size", type=int, default=64, help="global batch")
+    parser.add_argument("--learning-rate", type=float, default=1e-3)
+    parser.add_argument("--target-accuracy", type=float, default=None)
+    parser.add_argument("--checkpoint-dir", default=None)
+    parser.add_argument("--log-every", type=int, default=50)
+    args = parser.parse_args(argv)
+    logging.basicConfig(level=logging.INFO, stream=sys.stderr)
+
+    from ..parallel import distributed
+
+    proc = distributed.initialize()
+    logger.info(
+        "process %d/%d (coordinator=%s)",
+        proc.process_id, proc.num_processes, proc.coordinator_address,
+    )
+
+    import jax
+    import optax
+
+    from ..models import mnist as mnist_lib
+    from ..parallel.mesh import build_mesh, mesh_summary
+    from ..parallel.sharding import REPLICATED_RULES
+    from ..train.trainer import Trainer, classification_task
+
+    mesh = build_mesh()
+    logger.info("mesh: %s", mesh_summary(mesh))
+    model = mnist_lib.MnistCNN()
+    trainer = Trainer(
+        model,
+        classification_task(model),
+        optax.adam(args.learning_rate),
+        mesh=mesh,
+        rules=REPLICATED_RULES,
+        checkpoint_dir=args.checkpoint_dir,
+    )
+    rng = jax.random.PRNGKey(0)
+    sample = mnist_lib.synthetic_batch(rng, args.batch_size)
+    state = trainer.init(rng, sample)
+    if args.checkpoint_dir:
+        restored = trainer.restore(state)
+        if restored is not None:
+            state = restored
+            logger.info("resumed from step %d", int(state.step))
+
+    def batches():
+        key = jax.random.PRNGKey(1)
+        while True:
+            key, sub = jax.random.split(key)
+            yield mnist_lib.synthetic_batch(sub, args.batch_size)
+
+    state, metrics = trainer.fit(
+        state, batches(), steps=args.steps, log_every=args.log_every,
+        checkpoint_every=100 if args.checkpoint_dir else None,
+    )
+    logger.info("final: %s", metrics)
+    if args.checkpoint_dir:
+        trainer.save(state)
+    if args.target_accuracy is not None and metrics.get("accuracy", 0) < args.target_accuracy:
+        logger.error("accuracy %.4f below target %.4f", metrics.get("accuracy", 0), args.target_accuracy)
+        return 1
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

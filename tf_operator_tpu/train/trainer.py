@@ -109,6 +109,14 @@ class Trainer:
 
     # -- init --------------------------------------------------------------
 
+    def _prepare_batch(self, batch):
+        """Sequence-parallel (ring attention) training runs on packed,
+        unpadded batches: the padding mask is dropped HERE, at the
+        mechanism, so callers don't each have to remember to."""
+        if self.shard_sequence and "attention_mask" in batch:
+            batch = {k: v for k, v in batch.items() if k != "attention_mask"}
+        return batch
+
     def _model_inputs(self, batch):
         if "image" in batch:
             return (batch["image"],)
@@ -118,7 +126,7 @@ class Trainer:
         """Initialize the TrainState *already sharded*: abstract-eval the
         init to learn shapes, derive shardings by rule, then run init
         under jit with those out_shardings."""
-        inputs = self._model_inputs(sample_batch)
+        inputs = self._model_inputs(self._prepare_batch(sample_batch))
 
         def init_fn(rng):
             variables = self.model.init(rng, *inputs)
@@ -208,6 +216,7 @@ class Trainer:
             return self._train_step(state, batch)
 
     def place_batch(self, batch):
+        batch = self._prepare_batch(batch)
         sharding = NamedSharding(self.mesh, mesh_lib.batch_spec(self.shard_sequence))
         return jax.tree_util.tree_map(
             lambda x: jax.device_put(x, sharding), batch
