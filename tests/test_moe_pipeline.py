@@ -1,0 +1,310 @@
+"""Expert parallelism (MoE) and pipeline parallelism tests.
+
+Both capabilities are net-new vs the reference (SURVEY.md §2.3 lists EP
+and PP as "absent"); the correctness bar is self-consistency: the
+parallel execution must match a sequential single-device reference
+bit-for-bit-ish (f32 tolerance), forward AND gradient, on the virtual
+8-device CPU mesh (conftest).
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from tf_operator_tpu.models import moe as m
+from tf_operator_tpu.models.moe_pipeline import PipelinedMoELM
+from tf_operator_tpu.parallel import pipeline as pl
+from tf_operator_tpu.parallel.mesh import MeshConfig, build_mesh
+from tf_operator_tpu.parallel.sharding import MOE_RULES, place, shardings_for_tree
+
+CFG = m.MoEConfig(
+    vocab_size=256, hidden_size=32, num_layers=4, num_heads=4,
+    intermediate_size=64, max_position_embeddings=64, num_experts=4,
+    experts_per_token=2, moe_every=1, dtype=jnp.float32,
+)
+
+
+def _batch(rng, batch=8, seq=16):
+    return jax.random.randint(rng, (batch, seq), 0, CFG.vocab_size)
+
+
+class TestRouter:
+    def test_dispatch_respects_capacity_and_topk(self):
+        router = m.TopKRouter(CFG)
+        x = jax.random.normal(jax.random.PRNGKey(0), (2, 16, CFG.hidden_size))
+        dispatch, combine = router.apply(
+            router.init(jax.random.PRNGKey(1), x), x
+        )
+        # every token occupies at most experts_per_token capacity slots
+        per_token = dispatch.sum(axis=(2, 3))
+        assert float(per_token.max()) <= CFG.experts_per_token + 1e-6
+        # no capacity slot is claimed by two tokens
+        per_slot = dispatch.sum(axis=1)
+        assert float(per_slot.max()) <= 1 + 1e-6
+        # combine carries probabilities in (0, 1]
+        assert float(combine.max()) <= 1 + 1e-6
+        assert float(combine.min()) >= 0.0
+
+    def test_single_expert_equals_dense_mlp(self):
+        """num_experts=1, k=1, ample capacity: MoE == plain MLP with the
+        same weights (routing is forced through the one expert)."""
+        cfg = m.MoEConfig(
+            vocab_size=64, hidden_size=16, num_layers=1, num_heads=2,
+            intermediate_size=32, num_experts=1, experts_per_token=1,
+            capacity_factor=2.0, moe_every=1, dtype=jnp.float32,
+        )
+        mlp = m.MoEMlp(cfg)
+        x = jax.random.normal(jax.random.PRNGKey(0), (2, 8, 16))
+        variables = mlp.init(jax.random.PRNGKey(1), x)
+        out = mlp.apply(variables, x)
+        w_in = variables["params"]["expert_in"][0]
+        w_out = variables["params"]["expert_out"][0]
+        # router prob for a single expert is exactly 1.0
+        ref = jax.nn.gelu(x @ w_in) @ w_out
+        np.testing.assert_allclose(out, ref, atol=1e-5)
+
+    def test_aux_loss_sown(self):
+        model = m.MoELM(CFG)
+        rng = jax.random.PRNGKey(0)
+        ids = _batch(rng)
+        variables = model.init(rng, ids)
+        _, state = model.apply(variables, ids, mutable=["losses"])
+        aux = m.total_aux_loss(state["losses"])
+        # 2 MoE layers (moe_every=1, 4 layers => all MoE), aux > 0
+        assert float(aux) > 0
+
+
+class TestExpertParallel:
+    def test_gspmd_ep_matches_replicated(self):
+        """MoELM under an ep-sharded mesh == the same params fully
+        replicated: GSPMD all-to-alls must not change the math."""
+        model = m.MoELM(CFG)
+        rng = jax.random.PRNGKey(0)
+        ids = _batch(rng)
+        variables = model.init(rng, ids)
+        ref = model.apply(variables, ids)
+
+        mesh = build_mesh(MeshConfig(dp=2, ep=4))
+        sh = shardings_for_tree(variables["params"], mesh, MOE_RULES)
+        params = place(variables["params"], sh)
+        out = jax.jit(lambda p, i: model.apply({"params": p}, i))(params, ids)
+        np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=2e-5)
+
+    def test_expert_kernels_sharded_on_ep(self):
+        mesh = build_mesh(MeshConfig(dp=2, ep=4))
+        model = m.MoELM(CFG)
+        variables = model.init(jax.random.PRNGKey(0), _batch(jax.random.PRNGKey(1)))
+        sh = shardings_for_tree(variables["params"], mesh, MOE_RULES)
+        leaf = sh["layer_0"]["moe_mlp"]["expert_in"]
+        assert leaf.spec[0] == "ep"
+
+
+class TestPipeline:
+    def _layers(self, L=8, H=16):
+        rng = np.random.RandomState(0)
+        return [
+            {
+                "w": jnp.asarray(rng.randn(H, H) * 0.1, jnp.float32),
+                "b": jnp.asarray(rng.randn(H) * 0.1, jnp.float32),
+            }
+            for _ in range(L)
+        ]
+
+    @staticmethod
+    def _layer_fn(p, h):
+        return h + jnp.tanh(h @ p["w"] + p["b"])
+
+    def test_stack_layers_shape(self):
+        stacked = pl.stack_layers(self._layers(), 4)
+        assert stacked["w"].shape == (4, 2, 16, 16)
+        with pytest.raises(ValueError, match="divisible"):
+            pl.stack_layers(self._layers(), 3)
+
+    def test_forward_matches_sequential(self):
+        layers = self._layers()
+        x = jnp.asarray(np.random.RandomState(1).randn(8, 4, 16), jnp.float32)
+        ref = x
+        for p in layers:
+            ref = self._layer_fn(p, ref)
+        mesh = build_mesh(MeshConfig(dp=2, pp=4))
+        stacked = pl.stack_layers(layers, 4)
+        out = jax.jit(
+            lambda s, x: pl.pipeline_apply(
+                self._layer_fn, s, x, mesh=mesh, n_microbatches=4
+            )
+        )(stacked, x)
+        np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=1e-6)
+
+    def test_gradient_matches_sequential(self):
+        layers = self._layers()
+        x = jnp.asarray(np.random.RandomState(1).randn(8, 4, 16), jnp.float32)
+        mesh = build_mesh(MeshConfig(dp=2, pp=4))
+        stacked = pl.stack_layers(layers, 4)
+
+        def loss_pl(s):
+            out = pl.pipeline_apply(
+                self._layer_fn, s, x, mesh=mesh, n_microbatches=4
+            )
+            return (out**2).mean()
+
+        def loss_seq(ls):
+            h = x
+            for p in ls:
+                h = self._layer_fn(p, h)
+            return (h**2).mean()
+
+        g_pl = jax.jit(jax.grad(loss_pl))(stacked)
+        g_seq = pl.stack_layers(jax.grad(loss_seq)(layers), 4)
+        err = jax.tree_util.tree_map(
+            lambda a, b: float(jnp.abs(a - b).max()), jax.device_get(g_pl), g_seq
+        )
+        assert max(jax.tree_util.tree_leaves(err)) < 1e-5
+
+    def test_single_stage_mesh(self):
+        layers = self._layers()
+        x = jnp.asarray(np.random.RandomState(1).randn(16, 4, 16), jnp.float32)
+        mesh = build_mesh(MeshConfig(dp=8, pp=1))
+        stacked = pl.stack_layers(layers, 1)
+        ref = x
+        for p in layers:
+            ref = self._layer_fn(p, ref)
+        out = pl.pipeline_apply(
+            self._layer_fn, stacked, x, mesh=mesh, n_microbatches=2
+        )
+        np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=1e-6)
+
+    def test_bad_microbatch_count_raises(self):
+        layers = self._layers()
+        x = jnp.ones((6, 4, 16), jnp.float32)
+        mesh = build_mesh(MeshConfig(dp=2, pp=4))
+        stacked = pl.stack_layers(layers, 4)
+        with pytest.raises(ValueError, match="microbatches"):
+            jax.eval_shape(
+                lambda s, x: pl.pipeline_apply(
+                    self._layer_fn, s, x, mesh=mesh, n_microbatches=4
+                ),
+                stacked,
+                x,
+            )
+
+
+class TestPipelinedMoELM:
+    """pp x ep x dp composition: the full expert-parallel pipeline."""
+
+    def _setup(self):
+        mesh = build_mesh(MeshConfig(dp=2, pp=2, ep=2))
+        model = PipelinedMoELM(CFG, mesh, n_microbatches=2)
+        rng = jax.random.PRNGKey(0)
+        ids = _batch(rng)
+        params = model.place(model.init(rng, ids))
+        return model, params, ids
+
+    def _sequential(self, model, params, ids):
+        ref_block = m.MoEBlock(CFG, use_moe=True)
+        mask = m.causal_mask(ids.shape[-1])
+        x = model.embed.apply({"params": params["embed"]}, ids)
+        for s in range(2):
+            for l in range(CFG.num_layers // 2):
+                p = jax.tree_util.tree_map(lambda leaf: leaf[s, l], params["blocks"])
+                x = ref_block.apply({"params": p}, x, mask)
+        return model.head.apply({"params": params["head"]}, x)
+
+    def test_forward_matches_sequential(self):
+        model, params, ids = self._setup()
+        out = jax.jit(model.apply)(params, ids)
+        ref = self._sequential(model, jax.device_get(params), ids)
+        np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=1e-5)
+
+    def test_gradient_matches_sequential(self):
+        model, params, ids = self._setup()
+
+        def loss_pl(p):
+            return m.lm_loss(model.apply(p, ids), ids)
+
+        def loss_seq(p):
+            return m.lm_loss(self._sequential(model, p, ids), ids)
+
+        g1 = jax.device_get(jax.jit(jax.grad(loss_pl))(params))
+        g2 = jax.grad(loss_seq)(jax.device_get(params))
+        err = jax.tree_util.tree_map(
+            lambda a, b: float(jnp.abs(a - b).max()), g1, g2
+        )
+        assert max(jax.tree_util.tree_leaves(err)) < 1e-5
+
+    def test_train_step_decreases_loss(self):
+        import optax
+
+        model, params, ids = self._setup()
+        opt = optax.adam(1e-2)
+        opt_state = opt.init(jax.device_get(params))
+
+        @jax.jit
+        def step(params, opt_state):
+            loss, grads = jax.value_and_grad(
+                lambda p: m.lm_loss(model.apply(p, ids), ids)
+            )(params)
+            updates, opt_state = opt.update(grads, opt_state)
+            return optax.apply_updates(params, updates), opt_state, loss
+
+        losses = []
+        for _ in range(5):
+            params, opt_state, loss = step(params, opt_state)
+            losses.append(float(loss))
+        assert losses[-1] < losses[0]
+
+    def test_aux_loss_surfaced_through_pipeline(self):
+        """The router load-balancing loss must be obtainable (and
+        roughly match the sequential sown value) despite shard_map."""
+        model, params, ids = self._setup()
+        logits, aux = jax.jit(model.apply_with_aux)(params, ids)
+        assert float(aux) > 0
+        # sequential reference: sum of sown aux across all layers
+        ref_block = m.MoEBlock(CFG, use_moe=True)
+        mask = m.causal_mask(ids.shape[-1])
+        host = jax.device_get(params)
+        x = model.embed.apply({"params": host["embed"]}, ids)
+        ref_aux = 0.0
+        for s in range(2):
+            for l in range(CFG.num_layers // 2):
+                p = jax.tree_util.tree_map(lambda leaf: leaf[s, l], host["blocks"])
+                x, state = ref_block.apply(
+                    {"params": p}, x, mask, mutable=["losses"]
+                )
+                ref_aux += float(m.total_aux_loss(state["losses"]))
+        # microbatch-granular means make this approximate, not exact
+        assert abs(float(aux) - ref_aux) / ref_aux < 0.25
+
+    def test_single_stage_with_expert_parallel(self):
+        """pp=1 with ep>1 must still run through shard_map (regression:
+        a single-stage fast path once bypassed it, breaking the manual
+        expert-parallel mode's local shapes and axis_index)."""
+        mesh = build_mesh(MeshConfig(dp=2, pp=1, ep=4))
+        model = PipelinedMoELM(CFG, mesh, n_microbatches=2)
+        rng = jax.random.PRNGKey(0)
+        ids = _batch(rng)
+        params = model.place(model.init(rng, ids))
+        out = jax.jit(model.apply)(params, ids)
+
+        ref_block = m.MoEBlock(CFG, use_moe=True)
+        mask = m.causal_mask(ids.shape[-1])
+        host = jax.device_get(params)
+        x = model.embed.apply({"params": host["embed"]}, ids)
+        for l in range(CFG.num_layers):
+            p = jax.tree_util.tree_map(lambda leaf: leaf[0, l], host["blocks"])
+            x = ref_block.apply({"params": p}, x, mask)
+        ref = model.head.apply({"params": host["head"]}, x)
+        np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=1e-5)
+
+    def test_validates_divisibility(self):
+        mesh = build_mesh(MeshConfig(dp=2, pp=2, ep=2))
+        bad = m.MoEConfig(
+            vocab_size=64, hidden_size=32, num_layers=3, num_heads=4,
+            intermediate_size=64, num_experts=4, moe_every=1,
+            dtype=jnp.float32,
+        )
+        with pytest.raises(ValueError, match="divisible"):
+            PipelinedMoELM(bad, mesh)
+        alternating = m.MoEConfig(moe_every=2)
+        with pytest.raises(ValueError, match="homogeneous"):
+            PipelinedMoELM(alternating, mesh)

@@ -37,7 +37,9 @@ def devices8():
 class TestMesh:
     def test_build_default(self, devices8):
         mesh = build_mesh()
-        assert mesh.shape == {"dp": 8, "fsdp": 1, "sp": 1, "tp": 1}
+        assert mesh.shape == {
+            "dp": 8, "pp": 1, "fsdp": 1, "ep": 1, "sp": 1, "tp": 1,
+        }
 
     def test_build_dp_tp(self, devices8):
         mesh = build_mesh(MeshConfig(dp=2, tp=4))

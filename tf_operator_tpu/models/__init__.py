@@ -1,5 +1,6 @@
 from .bert import BERT_BASE, BERT_TINY, BertConfig, BertEncoder, BertForMLM, mlm_loss
 from .mnist import MnistCNN
+from .moe import MOE_BASE, MOE_TINY, MoEConfig, MoELM, lm_loss, total_aux_loss
 from .resnet import ResNet, ResNet18ish, ResNet50
 
 __all__ = [
@@ -13,4 +14,10 @@ __all__ = [
     "BERT_BASE",
     "BERT_TINY",
     "mlm_loss",
+    "MoEConfig",
+    "MoELM",
+    "MOE_BASE",
+    "MOE_TINY",
+    "lm_loss",
+    "total_aux_loss",
 ]

@@ -1,0 +1,329 @@
+"""Mixture-of-Experts decoder LM — the expert-parallel model family.
+
+The reference has no MoE (or any model code: it is a pure Go control
+plane, SURVEY.md §2.3 lists EP as "absent"); this is net-new data-plane
+capability, built the TPU way:
+
+- GShard-style token-choice top-k routing with a fixed expert capacity,
+  expressed as dense one-hot einsums — static shapes, no gather/scatter,
+  so XLA tiles everything onto the MXU.
+- Expert weights carry a leading [num_experts] dim sharded on the `ep`
+  mesh axis (parallel/sharding.MOE_RULES); with tokens sharded on
+  dp/fsdp, XLA lowers the dispatch/combine einsums to the canonical
+  all-to-all + local-FFN + all-to-all expert-parallel schedule over ICI.
+- Router math in f32 (softmax + load-balancing loss are precision
+  sensitive); expert FFNs in bf16 for the MXU.
+- The auxiliary load-balancing loss (Shazeer et al.) is surfaced via
+  Flax `sow` under the "losses" collection, so callers opt in with
+  `mutable=["losses"]` without threading tuples through every layer.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from flax import linen as nn
+
+from ..ops.attention import MultiHeadAttention
+
+
+@dataclasses.dataclass(frozen=True)
+class MoEConfig:
+    vocab_size: int = 32000
+    hidden_size: int = 768
+    num_layers: int = 12
+    num_heads: int = 12
+    intermediate_size: int = 3072
+    max_position_embeddings: int = 2048
+    num_experts: int = 8
+    experts_per_token: int = 2
+    capacity_factor: float = 1.25
+    # every `moe_every`-th block uses an MoE MLP (GShard alternation);
+    # 1 = every block (Mixtral-style)
+    moe_every: int = 2
+    router_aux_weight: float = 0.01
+    dtype: jnp.dtype = jnp.bfloat16
+
+    @property
+    def head_dim(self) -> int:
+        return self.hidden_size // self.num_heads
+
+
+MOE_TINY = MoEConfig(
+    vocab_size=512, hidden_size=64, num_layers=2, num_heads=4,
+    intermediate_size=128, max_position_embeddings=128, num_experts=4,
+    experts_per_token=2, moe_every=1, dtype=jnp.float32,
+)
+# BASELINE-class pretraining config: BERT-base-sized attention with 8
+# experts, alternating MoE blocks (~4x FFN params at ~1x FLOPs/token).
+MOE_BASE = MoEConfig()
+
+
+def expert_capacity(cfg: MoEConfig, tokens_per_group: int) -> int:
+    """Fixed per-expert buffer size: static shapes are non-negotiable on
+    TPU, so overflow tokens are dropped (their residual path carries
+    them) rather than dynamically resized."""
+    ideal = tokens_per_group * cfg.experts_per_token / cfg.num_experts
+    return max(4, int(np.ceil(ideal * cfg.capacity_factor)))
+
+
+class TopKRouter(nn.Module):
+    """Token-choice top-k router -> (dispatch, combine) dense masks.
+
+    dispatch: [groups, tokens, experts, capacity] one-hot, 1 where the
+    token occupies that expert's capacity slot; combine: same shape,
+    carrying the router probability (so combine @ expert_out mixes).
+    """
+
+    config: MoEConfig
+
+    @nn.compact
+    def __call__(self, x: jax.Array) -> Tuple[jax.Array, jax.Array]:
+        cfg = self.config
+        groups, tokens = x.shape[0], x.shape[1]
+        capacity = expert_capacity(cfg, tokens)
+
+        logits = nn.Dense(
+            cfg.num_experts, use_bias=False, dtype=jnp.float32,
+            param_dtype=jnp.float32, name="router",
+        )(x.astype(jnp.float32))
+        probs = jax.nn.softmax(logits, axis=-1)  # [g, t, e]
+
+        # Iterative top-k: argmax, mask, repeat. k is a small static
+        # constant so the Python loop unrolls into the jaxpr.
+        remaining = probs
+        expert_masks = []
+        gate_probs = []
+        for _ in range(cfg.experts_per_token):
+            idx = jnp.argmax(remaining, axis=-1)  # [g, t]
+            onehot = jax.nn.one_hot(idx, cfg.num_experts, dtype=probs.dtype)
+            expert_masks.append(onehot)
+            gate_probs.append((probs * onehot).sum(-1))
+            remaining = remaining * (1.0 - onehot)
+
+        # Capacity assignment: position of each token in its expert's
+        # buffer = running count of earlier claims on that expert,
+        # counting all k-slots of earlier tokens before this token's.
+        position_in_expert = []
+        claims = jnp.zeros((groups, cfg.num_experts), probs.dtype)
+        for onehot in expert_masks:
+            prior = jnp.cumsum(onehot, axis=1) - onehot + claims[:, None, :]
+            position_in_expert.append((prior * onehot).sum(-1))  # [g, t]
+            claims = claims + onehot.sum(axis=1)
+
+        dispatch = jnp.zeros(
+            (groups, tokens, cfg.num_experts, capacity), probs.dtype
+        )
+        combine = jnp.zeros_like(dispatch)
+        for onehot, gate, pos in zip(expert_masks, gate_probs, position_in_expert):
+            within = (pos < capacity).astype(probs.dtype)
+            slot = jax.nn.one_hot(pos.astype(jnp.int32), capacity, dtype=probs.dtype)
+            mask = onehot[..., None] * slot[..., None, :] * within[..., None, None]
+            dispatch = dispatch + mask
+            combine = combine + mask * gate[..., None, None]
+
+        # Load-balancing auxiliary loss (Shazeer/GShard): num_experts *
+        # E[router prob per expert] . E[top-1 assignment per expert];
+        # minimized when routing is uniform.
+        top1_frac = expert_masks[0].mean(axis=(0, 1))
+        prob_frac = probs.mean(axis=(0, 1))
+        aux = cfg.num_experts * jnp.sum(top1_frac * prob_frac)
+        self.sow("losses", "router_aux", cfg.router_aux_weight * aux)
+        return dispatch, combine
+
+
+class MoEMlp(nn.Module):
+    """Expert-parallel FFN: dispatch -> per-expert GeLU MLP -> combine.
+
+    Expert kernels are single params with a leading expert dim
+    ([e, h, f] / [e, f, h]) so one sharding rule puts them on `ep` and
+    the batched einsums keep the MXU full (one big contraction instead
+    of num_experts small ones).
+
+    Two expert-parallel modes:
+    - GSPMD (default, ``ep_axis=None``): params annotated by MOE_RULES;
+      XLA inserts the all-to-alls around the dispatch/combine einsums.
+    - manual (``ep_axis="ep"``, for use inside shard_map, e.g. under the
+      pipeline transform where GSPMD is unavailable): each ep-rank holds
+      a [e/ep, ...] kernel shard, computes its experts' contribution
+      from its slice of the dispatch mask, and a psum over ``ep_axis``
+      completes the combine.
+    """
+
+    config: MoEConfig
+    ep_axis: Optional[str] = None
+    ep_size: int = 1
+
+    @nn.compact
+    def __call__(self, x: jax.Array) -> jax.Array:
+        cfg = self.config
+        dispatch, combine = TopKRouter(cfg, name="router_gate")(x)
+        dispatch = dispatch.astype(cfg.dtype)
+        combine = combine.astype(cfg.dtype)
+        xd = x.astype(cfg.dtype)
+
+        # Init always sees the GLOBAL expert count; inside shard_map
+        # (manual ep mode) the passed-in kernels are the local
+        # [e/ep_size, ...] shards, so the declared shape must match.
+        manual_ep = self.ep_axis is not None and not self.is_initializing()
+        n_exp = cfg.num_experts // self.ep_size if manual_ep else cfg.num_experts
+        w_in = self.param(
+            "expert_in",
+            nn.initializers.lecun_normal(batch_axis=(0,)),
+            (n_exp, cfg.hidden_size, cfg.intermediate_size),
+            cfg.dtype,
+        )
+        w_out = self.param(
+            "expert_out",
+            nn.initializers.lecun_normal(batch_axis=(0,)),
+            (n_exp, cfg.intermediate_size, cfg.hidden_size),
+            cfg.dtype,
+        )
+        if manual_ep:
+            # slice the (globally-computed) routing masks down to this
+            # rank's experts
+            e_local = w_in.shape[0]
+            start = jax.lax.axis_index(self.ep_axis) * e_local
+            dispatch = jax.lax.dynamic_slice_in_dim(dispatch, start, e_local, 2)
+            combine = jax.lax.dynamic_slice_in_dim(combine, start, e_local, 2)
+        # all-to-all boundary (tokens -> experts) under ep sharding
+        expert_in = jnp.einsum("gtec,gth->egch", dispatch, xd)
+        h = jnp.einsum("egch,ehf->egcf", expert_in, w_in)
+        h = nn.gelu(h)
+        h = jnp.einsum("egcf,efh->egch", h, w_out)
+        # all-to-all boundary (experts -> tokens)
+        y = jnp.einsum("gtec,egch->gth", combine, h)
+        if manual_ep:
+            y = jax.lax.psum(y, self.ep_axis)
+        return y
+
+
+class MoEBlock(nn.Module):
+    config: MoEConfig
+    use_moe: bool = True
+    attention_fn: object = None
+    ep_axis: Optional[str] = None
+    ep_size: int = 1
+
+    @nn.compact
+    def __call__(self, x: jax.Array, mask: Optional[jax.Array] = None) -> jax.Array:
+        cfg = self.config
+        y = nn.LayerNorm(dtype=jnp.float32, name="ln_attn")(x)
+        y = MultiHeadAttention(
+            num_heads=cfg.num_heads, head_dim=cfg.head_dim, dtype=cfg.dtype,
+            attention_fn=self.attention_fn, name="attention",
+        )(y.astype(cfg.dtype), mask)
+        x = x + y
+        y = nn.LayerNorm(dtype=jnp.float32, name="ln_mlp")(x)
+        if self.use_moe:
+            y = MoEMlp(
+                cfg, ep_axis=self.ep_axis, ep_size=self.ep_size, name="moe_mlp"
+            )(y)
+        else:
+            y = nn.Dense(cfg.intermediate_size, dtype=cfg.dtype, name="mlp_in")(
+                y.astype(cfg.dtype)
+            )
+            y = nn.gelu(y)
+            y = nn.Dense(cfg.hidden_size, dtype=cfg.dtype, name="mlp_out")(y)
+        return x + y
+
+
+def causal_mask(seq_len: int) -> jax.Array:
+    """[1, 1, q, k] lower-triangular mask for decoder self-attention."""
+    return jnp.tril(jnp.ones((seq_len, seq_len), bool))[None, None, :, :]
+
+
+class MoEEmbed(nn.Module):
+    """Token + learned-position embedding (shared by MoELM and the
+    pipelined variant so the two stay checkpoint-compatible)."""
+
+    config: MoEConfig
+
+    @nn.compact
+    def __call__(self, input_ids: jax.Array) -> jax.Array:
+        cfg = self.config
+        x = nn.Embed(
+            cfg.vocab_size, cfg.hidden_size, dtype=cfg.dtype, name="token_embed"
+        )(input_ids)
+        return x + nn.Embed(
+            cfg.max_position_embeddings, cfg.hidden_size, dtype=cfg.dtype,
+            name="position_embed",
+        )(jnp.arange(input_ids.shape[-1])[None, :])
+
+
+class MoEHead(nn.Module):
+    """Final layernorm + untied LM head (f32 logits)."""
+
+    config: MoEConfig
+
+    @nn.compact
+    def __call__(self, x: jax.Array) -> jax.Array:
+        cfg = self.config
+        x = nn.LayerNorm(dtype=jnp.float32, name="ln_final")(x)
+        return nn.Dense(
+            cfg.vocab_size, use_bias=False, dtype=jnp.float32, name="lm_head"
+        )(x.astype(cfg.dtype))
+
+
+class MoELM(nn.Module):
+    """Causal decoder LM with alternating dense/MoE FFN blocks."""
+
+    config: MoEConfig
+    attention_fn: object = None
+
+    @nn.compact
+    def __call__(
+        self, input_ids: jax.Array, mask: Optional[jax.Array] = None
+    ) -> jax.Array:
+        cfg = self.config
+        seq_len = input_ids.shape[-1]
+        x = MoEEmbed(cfg, name="embed")(input_ids)
+        attn_mask = causal_mask(seq_len)
+        if mask is not None:
+            attn_mask = attn_mask & mask[:, None, None, :].astype(bool)
+        for layer in range(cfg.num_layers):
+            # layers 1, 1+moe_every, ... are MoE (layer 0 stays dense:
+            # standard practice, the first block's routing is unstable)
+            use_moe = cfg.moe_every > 0 and layer % cfg.moe_every == (
+                1 % cfg.moe_every
+            )
+            x = MoEBlock(
+                cfg, use_moe=use_moe, attention_fn=self.attention_fn,
+                name=f"layer_{layer}",
+            )(x, attn_mask)
+        return MoEHead(cfg, name="head")(x)
+
+
+def lm_loss(
+    logits: jax.Array, labels: jax.Array, weights: Optional[jax.Array] = None
+) -> jax.Array:
+    """Next-token cross-entropy in f32 (shift happens here)."""
+    logits = logits[:, :-1].astype(jnp.float32)
+    targets = labels[:, 1:]
+    log_probs = jax.nn.log_softmax(logits, axis=-1)
+    picked = jnp.take_along_axis(log_probs, targets[..., None], axis=-1)[..., 0]
+    if weights is None:
+        return -picked.mean()
+    w = weights[:, 1:].astype(jnp.float32)
+    return -(picked * w).sum() / jnp.maximum(w.sum(), 1.0)
+
+
+def total_aux_loss(losses_collection) -> jax.Array:
+    """Sum every sown router_aux scalar (one per MoE block)."""
+    leaves = jax.tree_util.tree_leaves(losses_collection)
+    if not leaves:
+        return jnp.asarray(0.0, jnp.float32)
+    return sum(jnp.asarray(leaf, jnp.float32).sum() for leaf in leaves)
+
+
+def synthetic_batch(rng: jax.Array, batch_size: int, seq_len: int, cfg: MoEConfig):
+    input_ids = jax.random.randint(rng, (batch_size, seq_len), 0, cfg.vocab_size)
+    return {
+        "input_ids": input_ids,
+        "labels": input_ids,
+        "attention_mask": jnp.ones((batch_size, seq_len), jnp.int32),
+    }

@@ -1,0 +1,148 @@
+"""Pipelined MoE LM: pp x ep x dp composition of the MoE decoder.
+
+Glue between models/moe.MoEBlock and parallel/pipeline: embedding and
+LM head run under plain GSPMD at the ends; the homogeneous stack of MoE
+blocks streams through the GPipe schedule over the ``pp`` axis, with
+expert kernels additionally sharded over ``ep`` (MoEMlp's manual
+expert-parallel mode, since GSPMD doesn't reach inside shard_map).
+
+This is the composition the dryrun exercises: dp x pp x ep x tp meshes
+on one jitted train step.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, Tuple
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from ..parallel.pipeline import pipeline_apply, stack_layers
+from .moe import MoEBlock, MoEConfig, MoEEmbed, MoEHead, causal_mask, total_aux_loss
+
+
+class PipelinedMoELM:
+    """Functional model: params = {embed, blocks, head}.
+
+    blocks leaves are [n_stages, layers_per_stage, ...], stage dim on
+    ``pp``, expert dims on ``ep``; every block is MoE (the stack must be
+    homogeneous for stack_layers).
+    """
+
+    def __init__(
+        self,
+        config: MoEConfig,
+        mesh: Mesh,
+        n_microbatches: int = 2,
+        ep_axis: str = "ep",
+        pp_axis: str = "pp",
+    ) -> None:
+        if config.moe_every != 1:
+            raise ValueError("pipelined stack must be homogeneous: moe_every=1")
+        self.config = config
+        self.mesh = mesh
+        self.n_microbatches = n_microbatches
+        self.ep_axis = ep_axis
+        self.pp_axis = pp_axis
+        self.n_stages = mesh.shape[pp_axis]
+        if config.num_layers % self.n_stages != 0:
+            raise ValueError(
+                f"{config.num_layers} layers not divisible by "
+                f"{self.n_stages} pipeline stages"
+            )
+        if config.num_experts % mesh.shape[ep_axis] != 0:
+            raise ValueError(
+                f"{config.num_experts} experts not divisible by "
+                f"ep={mesh.shape[ep_axis]}"
+            )
+        self.block = MoEBlock(
+            config, use_moe=True, ep_axis=ep_axis, ep_size=mesh.shape[ep_axis]
+        )
+        self.embed = MoEEmbed(config)
+        self.head = MoEHead(config)
+
+    # -- params ------------------------------------------------------------
+
+    def init(self, rng: jax.Array, input_ids: jax.Array) -> Dict[str, Any]:
+        cfg = self.config
+        seq = input_ids.shape[-1]
+        rngs = jax.random.split(rng, cfg.num_layers + 2)
+        x0 = jnp.zeros((1, seq, cfg.hidden_size), cfg.dtype)
+        mask = causal_mask(seq)
+        layer_params = [
+            self.block.init(rngs[i], x0, mask)["params"]
+            for i in range(cfg.num_layers)
+        ]
+        return {
+            "embed": self.embed.init(rngs[-2], input_ids)["params"],
+            "blocks": stack_layers(layer_params, self.n_stages),
+            "head": self.head.init(
+                rngs[-1], jnp.zeros((1, seq, cfg.hidden_size), cfg.dtype)
+            )["params"],
+        }
+
+    def _block_spec(self, path, leaf) -> P:
+        name = "/".join(str(getattr(e, "key", e)) for e in path)
+        if name.endswith("expert_in") or name.endswith("expert_out"):
+            # [stage, layer, expert, ...]: stage on pp, expert on ep
+            extra = leaf.ndim - 3
+            return P(self.pp_axis, None, self.ep_axis, *([None] * extra))
+        return P(self.pp_axis, *([None] * (leaf.ndim - 1)))
+
+    def _block_specs(self, blocks: Any) -> Any:
+        return jax.tree_util.tree_map_with_path(self._block_spec, blocks)
+
+    def param_specs(self, params: Dict[str, Any]) -> Dict[str, Any]:
+        replicate = lambda tree: jax.tree_util.tree_map(lambda _: P(), tree)  # noqa: E731
+        return {
+            "embed": replicate(params["embed"]),
+            "blocks": self._block_specs(params["blocks"]),
+            "head": replicate(params["head"]),
+        }
+
+    def shardings(self, params: Dict[str, Any]) -> Dict[str, Any]:
+        return jax.tree_util.tree_map(
+            lambda spec: NamedSharding(self.mesh, spec),
+            self.param_specs(params),
+            is_leaf=lambda x: isinstance(x, P),
+        )
+
+    def place(self, params: Dict[str, Any]) -> Dict[str, Any]:
+        return jax.tree_util.tree_map(
+            jax.device_put, params, self.shardings(params)
+        )
+
+    # -- forward -----------------------------------------------------------
+
+    def apply_with_aux(
+        self, params: Dict[str, Any], input_ids: jax.Array
+    ) -> Tuple[jax.Array, jax.Array]:
+        """(logits, router aux loss). The aux scalar is each block's sown
+        load-balancing loss, summed over layers and averaged over
+        microbatches/data shards by pipeline_apply."""
+        seq = input_ids.shape[-1]
+        mask = causal_mask(seq)
+        x = self.embed.apply({"params": params["embed"]}, input_ids)
+
+        def layer_fn(p, h):
+            h, state = self.block.apply(
+                {"params": p}, h, mask, mutable=["losses"]
+            )
+            return h, total_aux_loss(state.get("losses", {}))
+
+        x, aux = pipeline_apply(
+            layer_fn,
+            params["blocks"],
+            x,
+            mesh=self.mesh,
+            n_microbatches=self.n_microbatches,
+            axis=self.pp_axis,
+            param_specs=self._block_specs(params["blocks"]),
+            layer_aux=True,
+        )
+        return self.head.apply({"params": params["head"]}, x), aux
+
+    def apply(self, params: Dict[str, Any], input_ids: jax.Array) -> jax.Array:
+        logits, _ = self.apply_with_aux(params, input_ids)
+        return logits

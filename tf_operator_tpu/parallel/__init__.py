@@ -13,6 +13,7 @@ from .mesh import (
 from .ring_attention import make_ring_attention
 from .sharding import (
     CONV_RULES,
+    MOE_RULES,
     REPLICATED_RULES,
     TRANSFORMER_RULES,
     place,
@@ -34,6 +35,7 @@ __all__ = [
     "initialize",
     "TRANSFORMER_RULES",
     "CONV_RULES",
+    "MOE_RULES",
     "REPLICATED_RULES",
     "shardings_for_tree",
     "place",

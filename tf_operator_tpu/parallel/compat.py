@@ -1,0 +1,31 @@
+"""jax-version compatibility shims shared by the shard_map users."""
+
+from __future__ import annotations
+
+import jax
+
+# jax >= 0.7 exposes shard_map as a top-level function; older versions
+# as jax.experimental.shard_map.shard_map (module attr).
+_sm = getattr(jax, "shard_map", None)
+if callable(_sm):
+    shard_map = _sm
+elif _sm is not None and hasattr(_sm, "shard_map"):
+    shard_map = _sm.shard_map
+else:
+    from jax.experimental.shard_map import shard_map  # type: ignore
+
+
+def shard_map_norep(body, mesh, in_specs, out_specs):
+    """shard_map with replication checking off (our bodies use masked
+    per-rank writes + psum broadcasts the checker can't see through);
+    newer jax spells the flag check_vma, older check_rep."""
+    try:
+        return shard_map(
+            body, mesh=mesh, in_specs=in_specs, out_specs=out_specs,
+            check_vma=False,
+        )
+    except TypeError:
+        return shard_map(
+            body, mesh=mesh, in_specs=in_specs, out_specs=out_specs,
+            check_rep=False,
+        )

@@ -4,11 +4,13 @@ topology.
 The reference's only sharding vocabulary is replica-type/count wired
 through TF_CONFIG (reference tensorflow.go:97-198); scaling happens in
 user TF code. Here the mesh IS the framework's parallelism model:
-axes for data (dp), fully-sharded-data (fsdp), tensor (tp), and
-sequence/context (sp) parallelism, laid out so the inner, most
-communication-hungry axes ride ICI and only dp crosses DCN
-(the scaling-book recipe: pick a mesh, annotate shardings, let XLA
-insert collectives).
+axes for data (dp), pipeline (pp), fully-sharded-data (fsdp), expert
+(ep), sequence/context (sp), and tensor (tp) parallelism, laid out so
+the inner, most communication-hungry axes ride ICI and only dp/pp
+cross DCN (the scaling-book recipe: pick a mesh, annotate shardings,
+let XLA insert collectives; pipeline traffic is point-to-point
+activations so it tolerates DCN, expert all-to-all and tensor
+collectives want ICI neighbors).
 """
 
 from __future__ import annotations
@@ -22,8 +24,8 @@ import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec
 
 # Canonical axis order, outermost (crosses DCN first) to innermost
-# (pure ICI): data, fsdp, sequence, tensor.
-AXES = ("dp", "fsdp", "sp", "tp")
+# (pure ICI): data, pipeline, fsdp, expert, sequence, tensor.
+AXES = ("dp", "pp", "fsdp", "ep", "sp", "tp")
 
 
 @dataclasses.dataclass(frozen=True)
@@ -31,24 +33,27 @@ class MeshConfig:
     """Per-axis sizes; -1 on dp means "absorb remaining devices"."""
 
     dp: int = -1
+    pp: int = 1
     fsdp: int = 1
+    ep: int = 1
     sp: int = 1
     tp: int = 1
 
-    def resolve(self, n_devices: int) -> Tuple[int, int, int, int]:
-        fixed = self.fsdp * self.sp * self.tp
+    def resolve(self, n_devices: int) -> Tuple[int, int, int, int, int, int]:
+        fixed = self.pp * self.fsdp * self.ep * self.sp * self.tp
         dp = self.dp
         if dp == -1:
             if n_devices % fixed != 0:
                 raise ValueError(
-                    f"{n_devices} devices not divisible by fsdp*sp*tp={fixed}"
+                    f"{n_devices} devices not divisible by pp*fsdp*ep*sp*tp={fixed}"
                 )
             dp = n_devices // fixed
         if dp * fixed != n_devices:
             raise ValueError(
-                f"mesh {dp}x{self.fsdp}x{self.sp}x{self.tp} != {n_devices} devices"
+                f"mesh {dp}x{self.pp}x{self.fsdp}x{self.ep}x{self.sp}x{self.tp}"
+                f" != {n_devices} devices"
             )
-        return (dp, self.fsdp, self.sp, self.tp)
+        return (dp, self.pp, self.fsdp, self.ep, self.sp, self.tp)
 
 
 def build_mesh(
@@ -58,9 +63,9 @@ def build_mesh(
     """Build a Mesh over the given (default: all) devices.
 
     Device order matters: jax.devices() enumerates TPU devices in
-    ICI-contiguous order, so reshaping that order into (dp, fsdp, sp, tp)
-    keeps the innermost axes (tp, sp) on directly-wired neighbors and
-    pushes the dp axis across hosts/DCN.
+    ICI-contiguous order, so reshaping that order into
+    (dp, pp, fsdp, ep, sp, tp) keeps the innermost axes (tp, sp, ep) on
+    directly-wired neighbors and pushes the dp/pp axes across hosts/DCN.
     """
     config = config or MeshConfig()
     devs = list(devices if devices is not None else jax.devices())
@@ -70,7 +75,7 @@ def build_mesh(
 
 
 def single_device_mesh() -> Mesh:
-    return Mesh(np.array(jax.devices()[:1]).reshape(1, 1, 1, 1), AXES)
+    return Mesh(np.array(jax.devices()[:1]).reshape((1,) * len(AXES)), AXES)
 
 
 def batch_sharding(mesh: Mesh) -> NamedSharding:

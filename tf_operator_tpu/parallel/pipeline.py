@@ -1,0 +1,163 @@
+"""Pipeline parallelism: GPipe-schedule stage execution over the ``pp``
+mesh axis.
+
+Absent in the reference (SURVEY.md §2.3 lists PP as "absent" — its
+operator only counts replicas); this is net-new data-plane capability,
+built the TPU way: each device on the ``pp`` axis holds one stage's
+layer weights, microbatches stream through the ring with
+``lax.ppermute`` (point-to-point activation transfer — the one
+parallelism whose traffic tolerates DCN, which is why ``pp`` sits next
+to ``dp`` in the mesh order), and the whole schedule is a single
+``lax.scan`` under one jit — no data-dependent Python control flow, so
+XLA pipelines the permute against the next microbatch's compute.
+
+The schedule is the classic GPipe fill/drain: with S stages and M
+microbatches the scan runs M + S - 1 steps; bubble fraction
+(S-1)/(M+S-1) shrinks as callers raise ``n_microbatches``. Reverse-mode
+differentiation falls out of scan+ppermute transposes, giving 1F1B-ish
+backward traffic for free.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Sequence, Tuple, Union
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.sharding import Mesh, PartitionSpec as P
+
+from .compat import shard_map_norep
+
+
+def stack_layers(layer_params: Sequence[Any], n_stages: int) -> Any:
+    """Stack L per-layer param pytrees into a pipeline-ready pytree whose
+    leaves are [n_stages, L // n_stages, ...] — leading dim sharded on
+    the ``pp`` axis (pipeline_apply's default param specs), second dim
+    scanned within a stage."""
+    n_layers = len(layer_params)
+    if n_layers % n_stages != 0:
+        raise ValueError(f"{n_layers} layers not divisible by {n_stages} stages")
+    per = n_layers // n_stages
+    return jax.tree_util.tree_map(
+        lambda *leaves: jnp.stack(leaves).reshape(
+            (n_stages, per) + leaves[0].shape
+        ),
+        *layer_params,
+    )
+
+
+def pipeline_apply(
+    layer_fn: Callable[[Any, jax.Array], Any],
+    stacked_params: Any,
+    x: jax.Array,
+    *,
+    mesh: Mesh,
+    n_microbatches: int,
+    axis: str = "pp",
+    batch_axes=("dp", "fsdp"),
+    param_specs: Any = None,
+    layer_aux: bool = False,
+) -> Union[jax.Array, Tuple[jax.Array, jax.Array]]:
+    """Run x through all stages under the GPipe schedule.
+
+    layer_fn(params_one_layer, x) -> x applies ONE layer; a stage scans
+    it over its [L/S, ...] slice. stacked_params comes from
+    stack_layers (leaves [S, L/S, ...], stage dim sharded on ``pp``).
+    x: [batch, ...] activations, batch sharded over ``batch_axes``,
+    identical shape in and out (residual-block contract).
+
+    param_specs optionally overrides the per-leaf PartitionSpec (default:
+    stage dim on ``axis``, everything else replicated). Pass specs that
+    additionally shard e.g. the expert dim on ``ep`` when layer_fn does
+    its own manual collectives for those axes (MoEMlp ep_axis mode).
+
+    layer_aux=True changes the layer_fn contract to return
+    (x, aux_scalar); pipeline_apply then returns (out, aux) where aux is
+    the per-layer scalar summed over layers and averaged over
+    microbatches (bubble steps masked out). Per-microbatch means are
+    averaged rather than recomputed globally, so mean-of-means aux
+    quantities (e.g. MoE load-balancing loss) are approximate at
+    microbatch granularity — the standard pipelined-MoE trade.
+    """
+    n_stages = mesh.shape[axis]
+
+    if param_specs is None:
+        param_specs = jax.tree_util.tree_map(
+            lambda leaf: P(*([axis] + [None] * (leaf.ndim - 1))), stacked_params
+        )
+    x_spec = P(batch_axes, *([None] * (x.ndim - 1)))
+
+    def stage_body(params, x_local):
+        # params leaves: [1, L/S, ...] (local pp shard); x_local: the
+        # local batch shard, replicated over pp.
+        my_params = jax.tree_util.tree_map(lambda l: l[0], params)
+        rank = lax.axis_index(axis)
+        batch = x_local.shape[0]
+        if batch % n_microbatches != 0:
+            raise ValueError(
+                f"local batch {batch} not divisible by {n_microbatches} microbatches"
+            )
+        mb = batch // n_microbatches
+        x_mb = x_local.reshape((n_microbatches, mb) + x_local.shape[1:])
+
+        def stage(h):
+            def body(carry, p):
+                out = layer_fn(p, carry)
+                if layer_aux:
+                    out, aux = out
+                    return out, jnp.asarray(aux, jnp.float32)
+                return out, jnp.float32(0.0)
+
+            out, aux_per_layer = lax.scan(body, h, my_params)
+            return out, aux_per_layer.sum()
+
+        perm = [(i, (i + 1) % n_stages) for i in range(n_stages)]
+        outputs = jnp.zeros_like(x_mb)
+        recv = jnp.zeros_like(x_mb[0])
+
+        def step(carry, t):
+            recv, outputs, aux_sum = carry
+            # stage 0 ingests microbatch t (clipped during drain steps);
+            # later stages consume what rotated in from the left.
+            feed_idx = jnp.clip(t, 0, n_microbatches - 1)
+            fed = lax.dynamic_index_in_dim(x_mb, feed_idx, 0, keepdims=False)
+            h = jnp.where(rank == 0, fed, recv)
+            y, aux = stage(h)
+            # this rank computes real data (microbatch t-rank) only
+            # between fill and drain; garbage steps are masked out of
+            # the aux accumulator (outputs are masked by `valid` below)
+            on_real_data = (t >= rank) & (t - rank < n_microbatches)
+            aux_sum = aux_sum + jnp.where(on_real_data, aux, 0.0)
+            # last stage has microbatch t-(S-1) finished at step t
+            out_idx = jnp.clip(t - (n_stages - 1), 0, n_microbatches - 1)
+            valid = (rank == n_stages - 1) & (t >= n_stages - 1)
+            updated = lax.dynamic_update_index_in_dim(outputs, y, out_idx, 0)
+            outputs = jnp.where(valid, updated, outputs)
+            recv = lax.ppermute(y, axis, perm)
+            return (recv, outputs, aux_sum), None
+
+        (recv, outputs, aux_sum), _ = lax.scan(
+            step,
+            (recv, outputs, jnp.float32(0.0)),
+            jnp.arange(n_microbatches + n_stages - 1),
+        )
+        # only the last stage holds real outputs; psum broadcasts them
+        # around the ring so every pp rank returns the same activations
+        # (keeps the loss/optimizer SPMD across the whole mesh).
+        mine = jnp.where(rank == n_stages - 1, outputs, jnp.zeros_like(outputs))
+        outputs = lax.psum(mine, axis)
+        # aux: SUM each stage's (masked) layer sums over the ring
+        # (layers are split across pp), then MEAN over microbatches and
+        # over the data shards (each dp/fsdp rank saw different tokens)
+        # so the P() out_spec is genuinely replicated.
+        aux_total = lax.psum(aux_sum, axis) / n_microbatches
+        aux_total = lax.pmean(aux_total, batch_axes)
+        return outputs.reshape((batch,) + x_local.shape[1:]), aux_total
+
+    fn = shard_map_norep(
+        stage_body, mesh=mesh, in_specs=(param_specs, x_spec),
+        out_specs=(x_spec, P()),
+    )
+    out, aux = fn(stacked_params, x)
+    return (out, aux) if layer_aux else out

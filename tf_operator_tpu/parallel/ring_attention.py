@@ -23,15 +23,7 @@ import jax.numpy as jnp
 from jax import lax
 from jax.sharding import Mesh, PartitionSpec as P
 
-# jax >= 0.7 exposes shard_map as a top-level function; older versions
-# as jax.experimental.shard_map.shard_map (module attr).
-_sm = getattr(jax, "shard_map", None)
-if callable(_sm):
-    shard_map = _sm
-elif _sm is not None and hasattr(_sm, "shard_map"):
-    shard_map = _sm.shard_map
-else:
-    from jax.experimental.shard_map import shard_map  # type: ignore
+from .compat import shard_map, shard_map_norep  # noqa: F401  (re-export)
 
 NEG_INF = -1e30
 
@@ -120,16 +112,9 @@ def make_ring_attention(
     def sharded_body(q, k, v):
         return _ring_shard(q, k, v, axis_name=axis_name, causal=causal, n=n)
 
-    try:
-        sharded = shard_map(
-            sharded_body, mesh=mesh, in_specs=(spec, spec, spec),
-            out_specs=spec, check_vma=False,
-        )
-    except TypeError:  # older jax spells the flag check_rep
-        sharded = shard_map(
-            sharded_body, mesh=mesh, in_specs=(spec, spec, spec),
-            out_specs=spec, check_rep=False,
-        )
+    sharded = shard_map_norep(
+        sharded_body, mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec
+    )
 
     def attention_fn(query, key, value, mask=None):
         if mask is not None:

@@ -33,6 +33,15 @@ TRANSFORMER_RULES: Rules = (
     (r".*", PartitionSpec()),
 )
 
+# MoE: expert kernels [e, h, f]/[e, f, h] shard the expert dim on ep
+# (the all-to-all axis) and factor the matmul dims over fsdp/tp like the
+# dense rules; router kernels replicate (tiny, f32, precision-critical).
+MOE_RULES: Rules = (
+    (r".*router.*kernel$", PartitionSpec()),
+    (r".*expert_in$", PartitionSpec("ep", "fsdp", "tp")),
+    (r".*expert_out$", PartitionSpec("ep", "tp", "fsdp")),
+) + tuple(TRANSFORMER_RULES)
+
 # Conv nets: no tp (convs don't factor as cleanly); fsdp shards the
 # output-channel dim of large kernels, small params replicate.
 CONV_RULES: Rules = (
