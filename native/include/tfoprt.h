@@ -1,0 +1,106 @@
+/* tfoprt — native runtime core for tf_operator_tpu.
+ *
+ * C ABI over the C++ implementations of the controller's hot runtime
+ * structures, designed for ctypes binding from Python:
+ *
+ *   - rate-limiting work queue (semantics of client-go workqueue, the
+ *     structure driving the reference's reconcile hot loop,
+ *     reference jobcontroller.go:126-136 / controller.go:225-283)
+ *   - controller expectations TTL cache (reference jobcontroller.go:111-124)
+ *   - host-port allocator (reference port.go:44-332)
+ *
+ * All handles are opaque pointers. All item/key arguments are
+ * NUL-terminated UTF-8 strings (controller keys are "namespace/name").
+ * Thread-safe: every function may be called from any thread; blocking
+ * calls (tfoprt_queue_get) release Python's GIL automatically because
+ * ctypes drops it for the duration of a foreign call.
+ */
+#ifndef TFOPRT_H
+#define TFOPRT_H
+
+#include <stdint.h>
+
+#ifdef __cplusplus
+extern "C" {
+#endif
+
+/* ---- version ---------------------------------------------------------- */
+/* ABI version; bump on any signature change. */
+int32_t tfoprt_abi_version(void);
+
+/* ---- rate-limiting work queue ----------------------------------------- */
+
+typedef void *tfoprt_queue_t;
+
+/* base_delay/max_delay: per-item exponential backoff parameters in
+ * seconds (client-go ItemExponentialFailureRateLimiter defaults are
+ * 0.005 / 1000.0). */
+tfoprt_queue_t tfoprt_queue_new(double base_delay, double max_delay);
+void tfoprt_queue_free(tfoprt_queue_t q);
+
+void tfoprt_queue_add(tfoprt_queue_t q, const char *item);
+void tfoprt_queue_add_after(tfoprt_queue_t q, const char *item, double delay_s);
+void tfoprt_queue_add_rate_limited(tfoprt_queue_t q, const char *item);
+
+/* Blocks up to timeout_s (timeout_s < 0 means forever) for the next
+ * item. On success copies the item plus a NUL into buf and returns its
+ * length. Returns -1 on timeout or shutdown-and-drained. If the item
+ * would not fit in buf_len-1 bytes it is left at the front of the
+ * queue and -(item_len+2) is returned: retry with a larger buffer —
+ * the item is never truncated or lost. */
+int32_t tfoprt_queue_get(tfoprt_queue_t q, double timeout_s, char *buf,
+                         int32_t buf_len);
+
+void tfoprt_queue_done(tfoprt_queue_t q, const char *item);
+void tfoprt_queue_forget(tfoprt_queue_t q, const char *item);
+int32_t tfoprt_queue_num_requeues(tfoprt_queue_t q, const char *item);
+int32_t tfoprt_queue_len(tfoprt_queue_t q);
+void tfoprt_queue_shutdown(tfoprt_queue_t q);
+
+/* ---- controller expectations ------------------------------------------ */
+
+typedef void *tfoprt_exp_t;
+
+tfoprt_exp_t tfoprt_exp_new(double ttl_s);
+void tfoprt_exp_free(tfoprt_exp_t e);
+
+/* Overwrites the entry (ExpectCreations/ExpectDeletions). */
+void tfoprt_exp_set(tfoprt_exp_t e, const char *key, int32_t adds,
+                    int32_t deletes);
+/* Adds to the entry (RaiseExpectations). */
+void tfoprt_exp_raise(tfoprt_exp_t e, const char *key, int32_t adds,
+                      int32_t deletes);
+void tfoprt_exp_creation_observed(tfoprt_exp_t e, const char *key);
+void tfoprt_exp_deletion_observed(tfoprt_exp_t e, const char *key);
+/* 1 = cache trustworthy (fulfilled, expired, or never set); 0 = wait. */
+int32_t tfoprt_exp_satisfied(tfoprt_exp_t e, const char *key);
+void tfoprt_exp_delete(tfoprt_exp_t e, const char *key);
+
+/* ---- host-port allocator ---------------------------------------------- */
+
+typedef void *tfoprt_ports_t;
+
+/* Range [bport, eport). Returns NULL if the range is empty. */
+tfoprt_ports_t tfoprt_ports_new(int32_t bport, int32_t eport);
+void tfoprt_ports_free(tfoprt_ports_t p);
+
+/* Allocates the next free port to job_key; -1 when exhausted. */
+int32_t tfoprt_ports_take(tfoprt_ports_t p, const char *job_key);
+/* Re-registers a persisted allocation (controller restart GC,
+ * reference port.go:139-187). Returns 1 if newly registered, 0 if the
+ * port was out of range or already held. */
+int32_t tfoprt_ports_register(tfoprt_ports_t p, const char *job_key,
+                              int32_t port);
+/* Releases every port held by job_key. Returns the count released. */
+int32_t tfoprt_ports_release(tfoprt_ports_t p, const char *job_key);
+/* Releases one specific port held by job_key (rollback of a partial
+ * allocation). Returns 1 if released, 0 if job_key did not hold it. */
+int32_t tfoprt_ports_free_port(tfoprt_ports_t p, const char *job_key,
+                               int32_t port);
+int32_t tfoprt_ports_in_use(tfoprt_ports_t p);
+
+#ifdef __cplusplus
+} /* extern "C" */
+#endif
+
+#endif /* TFOPRT_H */

@@ -1,0 +1,106 @@
+// Controller expectations TTL cache: native implementation of the
+// stale-cache guard (semantics of k8s ControllerExpectations; the
+// reference leans on it at jobcontroller.go:111-124 and
+// controller.go:514-533). Matches tf_operator_tpu/runtime/expectations.py.
+
+#include "tfoprt.h"
+
+#include <chrono>
+#include <mutex>
+#include <string>
+#include <unordered_map>
+
+namespace {
+
+using Clock = std::chrono::steady_clock;
+
+struct Entry {
+  int32_t adds = 0;
+  int32_t deletes = 0;
+  Clock::time_point stamp;
+};
+
+class Expectations {
+ public:
+  explicit Expectations(double ttl_s) : ttl_(ttl_s) {}
+
+  void Set(const std::string &key, int32_t adds, int32_t deletes) {
+    std::lock_guard<std::mutex> lk(mu_);
+    store_[key] = Entry{adds, deletes, Clock::now()};
+  }
+
+  void Raise(const std::string &key, int32_t adds, int32_t deletes) {
+    std::lock_guard<std::mutex> lk(mu_);
+    Entry &e = store_[key];
+    e.adds += adds;
+    e.deletes += deletes;
+    e.stamp = Clock::now();
+  }
+
+  void Lower(const std::string &key, int32_t adds, int32_t deletes) {
+    std::lock_guard<std::mutex> lk(mu_);
+    auto it = store_.find(key);
+    if (it == store_.end()) return;
+    // floor at 0: an unexpected observation must not corrupt
+    // accounting for later expectations on the same key
+    it->second.adds = it->second.adds > adds ? it->second.adds - adds : 0;
+    it->second.deletes =
+        it->second.deletes > deletes ? it->second.deletes - deletes : 0;
+  }
+
+  int32_t Satisfied(const std::string &key) {
+    std::lock_guard<std::mutex> lk(mu_);
+    auto it = store_.find(key);
+    if (it == store_.end()) return 1;
+    const Entry &e = it->second;
+    if (e.adds <= 0 && e.deletes <= 0) return 1;
+    double age = std::chrono::duration<double>(Clock::now() - e.stamp).count();
+    return age > ttl_ ? 1 : 0;
+  }
+
+  void Delete(const std::string &key) {
+    std::lock_guard<std::mutex> lk(mu_);
+    store_.erase(key);
+  }
+
+ private:
+  const double ttl_;
+  std::mutex mu_;
+  std::unordered_map<std::string, Entry> store_;
+};
+
+Expectations *E(tfoprt_exp_t e) { return static_cast<Expectations *>(e); }
+
+}  // namespace
+
+extern "C" {
+
+tfoprt_exp_t tfoprt_exp_new(double ttl_s) { return new Expectations(ttl_s); }
+
+void tfoprt_exp_free(tfoprt_exp_t e) { delete E(e); }
+
+void tfoprt_exp_set(tfoprt_exp_t e, const char *key, int32_t adds,
+                    int32_t deletes) {
+  E(e)->Set(key, adds, deletes);
+}
+
+void tfoprt_exp_raise(tfoprt_exp_t e, const char *key, int32_t adds,
+                      int32_t deletes) {
+  E(e)->Raise(key, adds, deletes);
+}
+
+void tfoprt_exp_creation_observed(tfoprt_exp_t e, const char *key) {
+  E(e)->Lower(key, 1, 0);
+}
+
+void tfoprt_exp_deletion_observed(tfoprt_exp_t e, const char *key) {
+  E(e)->Lower(key, 0, 1);
+}
+
+int32_t tfoprt_exp_satisfied(tfoprt_exp_t e, const char *key) {
+  return E(e)->Satisfied(key);
+}
+
+void tfoprt_exp_delete(tfoprt_exp_t e, const char *key) { E(e)->Delete(key); }
+
+}  // extern "C"

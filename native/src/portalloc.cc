@@ -1,0 +1,133 @@
+// Host-port allocator: native implementation of the hostNetwork port
+// manager (re-design of the reference's fork-specific PortAllocator,
+// reference port.go:44-332). Bitmap over [bport, eport) with a cyclic
+// scan cursor; per-job holdings for release-on-job-end and for the
+// startup re-registration GC.
+
+#include "tfoprt.h"
+
+#include <mutex>
+#include <string>
+#include <unordered_map>
+#include <vector>
+
+namespace {
+
+class PortAllocator {
+ public:
+  PortAllocator(int32_t bport, int32_t eport)
+      : bport_(bport), eport_(eport), next_(bport),
+        used_(static_cast<size_t>(eport - bport), false) {}
+
+  int32_t Take(const std::string &job_key) {
+    std::lock_guard<std::mutex> lk(mu_);
+    for (int32_t i = 0, n = eport_ - bport_; i < n; i++) {
+      int32_t port = next_;
+      if (++next_ >= eport_) next_ = bport_;
+      if (!used_[port - bport_]) {
+        used_[port - bport_] = true;
+        in_use_++;
+        by_job_[job_key].push_back(port);
+        return port;
+      }
+    }
+    return -1;
+  }
+
+  int32_t Register(const std::string &job_key, int32_t port) {
+    std::lock_guard<std::mutex> lk(mu_);
+    if (port < bport_ || port >= eport_) return 0;
+    auto &held = by_job_[job_key];
+    for (int32_t p : held)
+      if (p == port) return 0;
+    if (!used_[port - bport_]) {
+      used_[port - bport_] = true;
+      in_use_++;
+    }
+    held.push_back(port);
+    return 1;
+  }
+
+  int32_t FreePort(const std::string &job_key, int32_t port) {
+    std::lock_guard<std::mutex> lk(mu_);
+    auto it = by_job_.find(job_key);
+    if (it == by_job_.end()) return 0;
+    auto &held = it->second;
+    for (size_t i = 0; i < held.size(); i++) {
+      if (held[i] == port) {
+        held.erase(held.begin() + static_cast<long>(i));
+        if (used_[port - bport_]) {
+          used_[port - bport_] = false;
+          in_use_--;
+        }
+        if (held.empty()) by_job_.erase(it);
+        return 1;
+      }
+    }
+    return 0;
+  }
+
+  int32_t Release(const std::string &job_key) {
+    std::lock_guard<std::mutex> lk(mu_);
+    auto it = by_job_.find(job_key);
+    if (it == by_job_.end()) return 0;
+    int32_t released = 0;
+    for (int32_t port : it->second) {
+      if (used_[port - bport_]) {
+        used_[port - bport_] = false;
+        in_use_--;
+        released++;
+      }
+    }
+    by_job_.erase(it);
+    return released;
+  }
+
+  int32_t InUse() {
+    std::lock_guard<std::mutex> lk(mu_);
+    return in_use_;
+  }
+
+ private:
+  const int32_t bport_, eport_;
+  int32_t next_;
+  int32_t in_use_ = 0;
+  std::mutex mu_;
+  std::vector<bool> used_;
+  std::unordered_map<std::string, std::vector<int32_t>> by_job_;
+};
+
+PortAllocator *P(tfoprt_ports_t p) { return static_cast<PortAllocator *>(p); }
+
+}  // namespace
+
+extern "C" {
+
+tfoprt_ports_t tfoprt_ports_new(int32_t bport, int32_t eport) {
+  if (eport <= bport) return nullptr;
+  return new PortAllocator(bport, eport);
+}
+
+void tfoprt_ports_free(tfoprt_ports_t p) { delete P(p); }
+
+int32_t tfoprt_ports_take(tfoprt_ports_t p, const char *job_key) {
+  return P(p)->Take(job_key);
+}
+
+int32_t tfoprt_ports_register(tfoprt_ports_t p, const char *job_key,
+                              int32_t port) {
+  return P(p)->Register(job_key, port);
+}
+
+int32_t tfoprt_ports_release(tfoprt_ports_t p, const char *job_key) {
+  return P(p)->Release(job_key);
+}
+
+int32_t tfoprt_ports_free_port(tfoprt_ports_t p, const char *job_key,
+                               int32_t port) {
+  return P(p)->FreePort(job_key, port);
+}
+
+int32_t tfoprt_ports_in_use(tfoprt_ports_t p) { return P(p)->InUse(); }
+
+}  // extern "C"

@@ -1,0 +1,231 @@
+// Rate-limiting work queue: native implementation of the controller's
+// hot-loop structure (semantics of client-go workqueue.RateLimitingInterface,
+// used by the reference at jobcontroller.go:126-136, controller.go:225-283).
+//
+// Invariants (identical to tf_operator_tpu/runtime/workqueue.py):
+//   * an item queued twice is processed once (dedup via `dirty`)
+//   * an item re-added while a worker holds it is re-queued on done()
+//   * per-item retries back off exponentially; forget() resets
+//   * delayed adds fire from a single timer thread with a min-heap
+//   * shutdown drains: get() returns -1 once the queue is empty
+
+#include "tfoprt.h"
+
+#include <atomic>
+#include <chrono>
+#include <condition_variable>
+#include <cstring>
+#include <deque>
+#include <mutex>
+#include <queue>
+#include <string>
+#include <thread>
+#include <unordered_map>
+#include <unordered_set>
+#include <vector>
+
+namespace {
+
+using Clock = std::chrono::steady_clock;
+
+struct DelayedItem {
+  Clock::time_point ready_at;
+  std::string item;
+  bool operator>(const DelayedItem &o) const { return ready_at > o.ready_at; }
+};
+
+class RateLimitingQueue {
+ public:
+  RateLimitingQueue(double base_delay, double max_delay)
+      : base_delay_(base_delay), max_delay_(max_delay) {
+    timer_thread_ = std::thread([this] { TimerLoop(); });
+  }
+
+  ~RateLimitingQueue() {
+    Shutdown();
+    if (timer_thread_.joinable()) timer_thread_.join();
+  }
+
+  void Add(const std::string &item) {
+    std::lock_guard<std::mutex> lk(mu_);
+    AddLocked(item);
+  }
+
+  void AddAfter(const std::string &item, double delay_s) {
+    if (delay_s <= 0) {
+      Add(item);
+      return;
+    }
+    std::lock_guard<std::mutex> lk(mu_);
+    if (shutting_down_) return;
+    delayed_.push(DelayedItem{
+        Clock::now() + std::chrono::duration_cast<Clock::duration>(
+                           std::chrono::duration<double>(delay_s)),
+        item});
+    timer_cv_.notify_one();
+  }
+
+  void AddRateLimited(const std::string &item) {
+    double delay;
+    {
+      std::lock_guard<std::mutex> lk(mu_);
+      int failures = failures_[item]++;
+      delay = base_delay_;
+      for (int i = 0; i < failures && delay < max_delay_; i++) delay *= 2;
+      if (delay > max_delay_) delay = max_delay_;
+    }
+    AddAfter(item, delay);
+  }
+
+  // Returns length of item written to *out, or -1 on timeout/shutdown.
+  // If the item is longer than max_len the pop is undone (item back at
+  // the FRONT, dirty/processing restored) and -(len+2) is returned so
+  // the caller can retry with a larger buffer without losing the item.
+  int32_t Get(double timeout_s, size_t max_len, std::string *out) {
+    std::unique_lock<std::mutex> lk(mu_);
+    auto ready = [this] { return !queue_.empty() || shutting_down_; };
+    if (timeout_s < 0) {
+      cv_.wait(lk, ready);
+    } else if (!cv_.wait_for(lk, std::chrono::duration<double>(timeout_s),
+                             ready)) {
+      return -1;
+    }
+    if (queue_.empty()) return -1;  // shutting down and drained
+    if (queue_.front().size() > max_len) {
+      return -(static_cast<int32_t>(queue_.front().size()) + 2);
+    }
+    *out = queue_.front();
+    queue_.pop_front();
+    processing_.insert(*out);
+    dirty_.erase(*out);
+    return static_cast<int32_t>(out->size());
+  }
+
+  void Done(const std::string &item) {
+    std::lock_guard<std::mutex> lk(mu_);
+    processing_.erase(item);
+    if (dirty_.count(item)) {
+      queue_.push_back(item);
+      cv_.notify_one();
+    }
+  }
+
+  void Forget(const std::string &item) {
+    std::lock_guard<std::mutex> lk(mu_);
+    failures_.erase(item);
+  }
+
+  int32_t NumRequeues(const std::string &item) {
+    std::lock_guard<std::mutex> lk(mu_);
+    auto it = failures_.find(item);
+    return it == failures_.end() ? 0 : it->second;
+  }
+
+  int32_t Len() {
+    std::lock_guard<std::mutex> lk(mu_);
+    return static_cast<int32_t>(queue_.size());
+  }
+
+  void Shutdown() {
+    std::lock_guard<std::mutex> lk(mu_);
+    shutting_down_ = true;
+    cv_.notify_all();
+    timer_cv_.notify_all();
+  }
+
+ private:
+  void AddLocked(const std::string &item) {
+    if (shutting_down_ || dirty_.count(item)) return;
+    dirty_.insert(item);
+    if (!processing_.count(item)) {
+      queue_.push_back(item);
+      cv_.notify_one();
+    }
+  }
+
+  void TimerLoop() {
+    std::unique_lock<std::mutex> lk(mu_);
+    while (!shutting_down_) {
+      if (delayed_.empty()) {
+        timer_cv_.wait(lk);
+        continue;
+      }
+      auto next = delayed_.top().ready_at;
+      if (Clock::now() >= next) {
+        std::string item = delayed_.top().item;
+        delayed_.pop();
+        AddLocked(item);
+      } else {
+        timer_cv_.wait_until(lk, next);
+      }
+    }
+  }
+
+  const double base_delay_, max_delay_;
+  std::mutex mu_;
+  std::condition_variable cv_, timer_cv_;
+  std::deque<std::string> queue_;
+  std::unordered_set<std::string> dirty_, processing_;
+  std::unordered_map<std::string, int> failures_;
+  std::priority_queue<DelayedItem, std::vector<DelayedItem>,
+                      std::greater<DelayedItem>>
+      delayed_;
+  bool shutting_down_ = false;
+  std::thread timer_thread_;
+};
+
+RateLimitingQueue *Q(tfoprt_queue_t q) {
+  return static_cast<RateLimitingQueue *>(q);
+}
+
+}  // namespace
+
+extern "C" {
+
+int32_t tfoprt_abi_version(void) { return 2; }
+
+tfoprt_queue_t tfoprt_queue_new(double base_delay, double max_delay) {
+  return new RateLimitingQueue(base_delay, max_delay);
+}
+
+void tfoprt_queue_free(tfoprt_queue_t q) { delete Q(q); }
+
+void tfoprt_queue_add(tfoprt_queue_t q, const char *item) { Q(q)->Add(item); }
+
+void tfoprt_queue_add_after(tfoprt_queue_t q, const char *item,
+                            double delay_s) {
+  Q(q)->AddAfter(item, delay_s);
+}
+
+void tfoprt_queue_add_rate_limited(tfoprt_queue_t q, const char *item) {
+  Q(q)->AddRateLimited(item);
+}
+
+int32_t tfoprt_queue_get(tfoprt_queue_t q, double timeout_s, char *buf,
+                         int32_t buf_len) {
+  if (buf_len <= 0) return -1;
+  std::string out;
+  int32_t n = Q(q)->Get(timeout_s, static_cast<size_t>(buf_len) - 1, &out);
+  if (n < 0) return n;  // timeout/shutdown (-1) or too-small (-(len+2))
+  std::memcpy(buf, out.data(), static_cast<size_t>(n));
+  buf[n] = '\0';
+  return n;
+}
+
+void tfoprt_queue_done(tfoprt_queue_t q, const char *item) {
+  Q(q)->Done(item);
+}
+
+void tfoprt_queue_forget(tfoprt_queue_t q, const char *item) {
+  Q(q)->Forget(item);
+}
+
+int32_t tfoprt_queue_num_requeues(tfoprt_queue_t q, const char *item) {
+  return Q(q)->NumRequeues(item);
+}
+
+int32_t tfoprt_queue_len(tfoprt_queue_t q) { return Q(q)->Len(); }
+
+void tfoprt_queue_shutdown(tfoprt_queue_t q) { Q(q)->Shutdown(); }
+
+}  // extern "C"

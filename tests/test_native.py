@@ -1,0 +1,271 @@
+"""Native (C++) runtime core: same invariants as the pure-Python twins.
+
+Parametrized over both implementations so their semantics can never
+drift: every invariant of the client-go workqueue model the controller
+relies on (reference jobcontroller.go:126-136) is asserted against the
+Python classes and the ctypes-bound native ones
+(native/src/{workqueue,expectations,portalloc}.cc).
+"""
+
+import time
+
+import pytest
+
+from tf_operator_tpu.controller.ports import PortAllocator, _PyPortBitmap
+from tf_operator_tpu.runtime import _native
+from tf_operator_tpu.runtime import native_queue as nq
+from tf_operator_tpu.runtime.expectations import ControllerExpectations
+from tf_operator_tpu.runtime.workqueue import RateLimitingQueue
+
+NATIVE = _native.ensure_built()
+
+queue_impls = [pytest.param(RateLimitingQueue, id="python")]
+exp_impls = [pytest.param(ControllerExpectations, id="python")]
+bitmap_impls = [pytest.param(_PyPortBitmap, id="python")]
+if NATIVE:
+    queue_impls.append(pytest.param(nq.NativeRateLimitingQueue, id="native"))
+    exp_impls.append(pytest.param(nq.NativeExpectations, id="native"))
+    bitmap_impls.append(pytest.param(nq.NativePortBitmap, id="native"))
+
+
+def test_native_library_loads():
+    """The toolchain is baked into this image, so the native path must
+    actually be exercised here — a silent fallback would mean the C++
+    core is never tested."""
+    assert NATIVE
+
+
+@pytest.mark.parametrize("impl", queue_impls)
+class TestQueueInvariants:
+    def test_dedup_while_queued(self, impl):
+        q = impl()
+        q.add("a")
+        q.add("a")
+        q.add("b")
+        assert len(q) == 2
+        q.shut_down()
+
+    def test_requeue_if_dirty_while_processing(self, impl):
+        q = impl()
+        q.add("a")
+        item = q.get(1.0)
+        assert item == "a"
+        q.add("a")  # dirty while a worker holds it
+        assert len(q) == 0  # not queued until done()
+        q.done("a")
+        assert q.get(1.0) == "a"
+        q.shut_down()
+
+    def test_done_without_readd_does_not_requeue(self, impl):
+        q = impl()
+        q.add("a")
+        q.get(1.0)
+        q.done("a")
+        assert q.get(0.05) is None
+        q.shut_down()
+
+    def test_fifo_order(self, impl):
+        q = impl()
+        for key in ("x", "y", "z"):
+            q.add(key)
+        assert [q.get(1.0) for _ in range(3)] == ["x", "y", "z"]
+        q.shut_down()
+
+    def test_add_after_fires(self, impl):
+        q = impl()
+        q.add_after("late", 0.05)
+        assert q.get(0.01) is None
+        assert q.get(2.0) == "late"
+        q.shut_down()
+
+    def test_add_after_zero_is_immediate(self, impl):
+        q = impl()
+        q.add_after("now", 0.0)
+        assert q.get(0.5) == "now"
+        q.shut_down()
+
+    def test_rate_limited_backoff_grows(self, impl):
+        q = impl()
+        assert q.num_requeues("k") == 0
+        q.add_rate_limited("k")
+        assert q.num_requeues("k") == 1
+        q.get(2.0)
+        q.done("k")
+        q.add_rate_limited("k")
+        assert q.num_requeues("k") == 2
+        q.forget("k")
+        assert q.num_requeues("k") == 0
+        q.shut_down()
+
+    def test_shutdown_unblocks_get(self, impl):
+        import threading
+
+        q = impl()
+        results = []
+        t = threading.Thread(target=lambda: results.append(q.get(10.0)))
+        t.start()
+        time.sleep(0.05)
+        q.shut_down()
+        t.join(timeout=5.0)
+        assert not t.is_alive()
+        assert results == [None]
+
+    def test_add_after_shutdown_ignored(self, impl):
+        q = impl()
+        q.shut_down()
+        q.add("late")
+        assert q.get(0.05) is None
+
+
+@pytest.mark.parametrize("impl", exp_impls)
+class TestExpectationInvariants:
+    def test_never_set_is_satisfied(self, impl):
+        assert impl().satisfied("ns/j")
+
+    def test_creations_block_until_observed(self, impl):
+        e = impl()
+        e.expect_creations("k", 2)
+        assert not e.satisfied("k")
+        e.creation_observed("k")
+        assert not e.satisfied("k")
+        e.creation_observed("k")
+        assert e.satisfied("k")
+
+    def test_deletions_block_until_observed(self, impl):
+        e = impl()
+        e.expect_deletions("k", 1)
+        assert not e.satisfied("k")
+        e.deletion_observed("k")
+        assert e.satisfied("k")
+
+    def test_unexpected_observation_floors_at_zero(self, impl):
+        e = impl()
+        e.creation_observed("k")  # no expectation set
+        e.expect_creations("k", 1)
+        assert not e.satisfied("k")  # earlier observation must not leak
+        e.creation_observed("k")
+        assert e.satisfied("k")
+
+    def test_raise_accumulates(self, impl):
+        e = impl()
+        e.expect_creations("k", 1)
+        e.raise_expectations("k", 1, 0)
+        e.creation_observed("k")
+        assert not e.satisfied("k")
+        e.creation_observed("k")
+        assert e.satisfied("k")
+
+    def test_ttl_failsafe(self, impl):
+        e = impl(0.05)
+        e.expect_creations("k", 5)
+        assert not e.satisfied("k")
+        time.sleep(0.1)
+        assert e.satisfied("k")
+
+    def test_delete_clears(self, impl):
+        e = impl()
+        e.expect_creations("k", 5)
+        e.delete_expectations("k")
+        assert e.satisfied("k")
+
+
+@pytest.mark.parametrize("impl", bitmap_impls)
+class TestPortBitmap:
+    def test_take_unique_until_exhausted(self, impl):
+        b = impl(100, 103)
+        got = {b.take("j") for _ in range(3)}
+        assert got == {100, 101, 102}
+        assert b.take("j") == -1
+        assert b.in_use() == 3
+
+    def test_release_returns_ports(self, impl):
+        b = impl(100, 104)
+        b.take("a")
+        b.take("a")
+        b.take("b")
+        assert b.release("a") == 2
+        assert b.in_use() == 1
+        assert b.release("a") == 0
+
+    def test_register_out_of_range_and_dup(self, impl):
+        b = impl(100, 110)
+        assert b.register("j", 105)
+        assert not b.register("j", 105)  # already held by j
+        assert not b.register("j", 99)  # out of range
+        assert b.in_use() == 1
+
+    def test_cyclic_reuse_after_release(self, impl):
+        b = impl(100, 102)
+        b.take("a")
+        b.take("a")
+        b.release("a")
+        assert b.take("b") in (100, 101)
+
+    def test_empty_range_rejected(self, impl):
+        with pytest.raises(ValueError):
+            impl(100, 100)
+
+    def test_free_port_releases_one(self, impl):
+        b = impl(100, 110)
+        p1 = b.take("j")
+        p2 = b.take("j")
+        assert b.free_port("j", p1)
+        assert not b.free_port("j", p1)  # no longer held
+        assert not b.free_port("other", p2)  # wrong job
+        assert b.in_use() == 1
+        assert b.release("j") == 1
+
+
+def test_allocate_rollback_preserves_prior_allocations():
+    """Exhaustion rollback must free only this call's ports: earlier
+    calls' allocations are persisted in annotations with live pods
+    bound to them (code-review finding on the bitmap refactor)."""
+    from tf_operator_tpu.controller.ports import PortRangeExhausted
+    from tests.test_api import make_job
+
+    alloc = PortAllocator(20000, 20003)  # room for 3 ports
+    job = make_job({"PS": 2}, name="roll")
+    job.spec.tf_replica_specs["PS"].template.spec.host_network = True
+    ann = alloc.allocate(job)
+    assert len(ann["ps"].split(",")) == 2
+    job.metadata.annotations.update(ann)
+
+    # grow: add a worker set needing 2 more ports; only 1 free -> raise
+    job2 = make_job({"PS": 2, "Worker": 2}, name="roll")
+    for spec in job2.spec.tf_replica_specs.values():
+        spec.template.spec.host_network = True
+    job2.metadata.annotations.update(ann)
+    with pytest.raises(PortRangeExhausted):
+        alloc.allocate(job2)
+    # PS allocation intact, the partially-taken worker port rolled back
+    assert alloc.in_use() == 2
+
+    other = make_job({"Worker": 1}, name="other")
+    other.spec.tf_replica_specs["Worker"].template.spec.host_network = True
+    got = alloc.allocate(other)
+    assert got["worker"] not in (ann["ps"].split(","))
+
+
+def test_port_allocator_uses_native_when_available():
+    alloc = PortAllocator(20000, 20010)
+    if NATIVE:
+        assert type(alloc._bitmap).__name__ == "NativePortBitmap"
+    assert alloc.in_use() == 0
+
+
+def test_factories_pick_native_when_available():
+    q = nq.make_rate_limiting_queue()
+    e = nq.make_expectations()
+    if NATIVE:
+        assert type(q).__name__ == "NativeRateLimitingQueue"
+        assert type(e).__name__ == "NativeExpectations"
+    q.shut_down()
+
+
+def test_python_fallback_forced(monkeypatch):
+    monkeypatch.setenv("TFOPRT_DISABLE_NATIVE", "1")
+    q = nq.make_rate_limiting_queue()
+    e = nq.make_expectations()
+    assert type(q).__name__ == "RateLimitingQueue"
+    assert type(e).__name__ == "ControllerExpectations"
+    q.shut_down()

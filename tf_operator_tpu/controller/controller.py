@@ -20,10 +20,8 @@ from ..runtime import (
     ADDED,
     DELETED,
     MODIFIED,
-    ControllerExpectations,
     EventRecorder,
     NotFound,
-    RateLimitingQueue,
     RealPodControl,
     RealServiceControl,
 )
@@ -69,8 +67,12 @@ class TFJobController:
 
             gang = GangScheduler(substrate)
         self.recorder = EventRecorder(substrate)
-        self.expectations = ControllerExpectations()
-        self.queue = RateLimitingQueue()
+        # Native (C++) queue + expectations when libtfoprt is available,
+        # pure-Python otherwise — identical semantics either way.
+        from ..runtime.native_queue import make_expectations, make_rate_limiting_queue
+
+        self.expectations = make_expectations()
+        self.queue = make_rate_limiting_queue()
         self.reconciler = Reconciler(
             pod_control=RealPodControl(substrate, self.recorder),
             service_control=RealServiceControl(substrate, self.recorder),

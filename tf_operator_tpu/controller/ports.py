@@ -9,83 +9,142 @@ creation (reconciler._rewrite_host_ports). Ports are released when the
 job ends; on startup existing jobs' allocations are re-registered so a
 controller restart never double-assigns (reference syncAll,
 port.go:106-134).
+
+The bitmap core is pluggable: the C++ implementation in
+native/src/portalloc.cc is used when libtfoprt.so loads, with
+`_PyPortBitmap` as the identical-semantics fallback.
 """
 
 from __future__ import annotations
 
-import logging
 import threading
-from typing import Dict, Iterable, List, Optional, Set
+from typing import Dict, Iterable, List, Set
 
-from ..api.types import DEFAULT_PORT, ReplicaType, TFJob
-
-logger = logging.getLogger("tf_operator_tpu.ports")
+from ..api.types import TFJob
 
 
 class PortRangeExhausted(RuntimeError):
     pass
 
 
-class PortAllocator:
-    def __init__(self, bport: int = 20000, eport: int = 30000) -> None:
+class _PyPortBitmap:
+    """Pure-Python twin of native NativePortBitmap: cyclic-scan bitmap
+    over [bport, eport) with per-job holdings."""
+
+    def __init__(self, bport: int, eport: int) -> None:
         if eport <= bport:
             raise ValueError(f"empty port range [{bport}, {eport})")
-        self.bport = bport
-        self.eport = eport
+        self._bport = bport
+        self._eport = eport
+        self._next = bport
         self._lock = threading.Lock()
         self._used: Set[int] = set()
-        # job key -> all ports held, for release on job end
         self._by_job: Dict[str, List[int]] = {}
-        self._next = bport
+
+    def take(self, job_key: str) -> int:
+        with self._lock:
+            for _ in range(self._eport - self._bport):
+                port = self._next
+                self._next += 1
+                if self._next >= self._eport:
+                    self._next = self._bport
+                if port not in self._used:
+                    self._used.add(port)
+                    self._by_job.setdefault(job_key, []).append(port)
+                    return port
+        return -1
+
+    def register(self, job_key: str, port: int) -> bool:
+        with self._lock:
+            if not (self._bport <= port < self._eport):
+                return False
+            held = self._by_job.setdefault(job_key, [])
+            if port in held:
+                return False
+            self._used.add(port)
+            held.append(port)
+            return True
+
+    def release(self, job_key: str) -> int:
+        with self._lock:
+            released = 0
+            for port in self._by_job.pop(job_key, []):
+                if port in self._used:
+                    self._used.discard(port)
+                    released += 1
+            return released
+
+    def free_port(self, job_key: str, port: int) -> bool:
+        with self._lock:
+            held = self._by_job.get(job_key)
+            if held is None or port not in held:
+                return False
+            held.remove(port)
+            self._used.discard(port)
+            if not held:
+                del self._by_job[job_key]
+            return True
+
+    def in_use(self) -> int:
+        with self._lock:
+            return len(self._used)
+
+
+def _make_bitmap(bport: int, eport: int):
+    if eport <= bport:
+        raise ValueError(f"empty port range [{bport}, {eport})")
+    try:
+        from ..runtime.native_queue import NativePortBitmap
+
+        return NativePortBitmap(bport, eport)
+    except (RuntimeError, ImportError):
+        return _PyPortBitmap(bport, eport)
+
+
+class PortAllocator:
+    def __init__(self, bport: int = 20000, eport: int = 30000) -> None:
+        self.bport = bport
+        self.eport = eport
+        self._bitmap = _make_bitmap(bport, eport)
 
     # -- allocation --------------------------------------------------------
-
-    def _take_one(self) -> int:
-        """Next free port, scanning cyclically from the last position."""
-        for _ in range(self.eport - self.bport):
-            port = self._next
-            self._next += 1
-            if self._next >= self.eport:
-                self._next = self.bport
-            if port not in self._used:
-                self._used.add(port)
-                return port
-        raise PortRangeExhausted(
-            f"no free host ports in [{self.bport}, {self.eport})"
-        )
 
     def allocate(self, job: TFJob) -> Dict[str, str]:
         """Allocate ports for every hostNetwork replica set of the job.
         Returns the annotations to persist ({} when none needed);
         idempotent for jobs that already carry allocations."""
         annotations: Dict[str, str] = {}
-        with self._lock:
-            held = self._by_job.setdefault(job.key(), [])
-            for rtype_key, spec in job.spec.tf_replica_specs.items():
-                if spec is None or not spec.template.spec.host_network:
-                    continue
-                rt = rtype_key.lower()
-                if job.metadata.annotations.get(rt):
-                    continue  # already allocated (e.g. controller restart)
-                replicas = spec.replicas if spec.replicas is not None else 1
-                try:
-                    ports = [self._take_one() for _ in range(replicas)]
-                except PortRangeExhausted:
-                    self._release_locked(job.key())
-                    raise
-                held.extend(ports)
-                annotations[rt] = ",".join(str(p) for p in ports)
+        taken_this_call: List[int] = []
+        for rtype_key, spec in job.spec.tf_replica_specs.items():
+            if spec is None or not spec.template.spec.host_network:
+                continue
+            rt = rtype_key.lower()
+            if job.metadata.annotations.get(rt):
+                continue  # already allocated (e.g. controller restart)
+            replicas = spec.replicas if spec.replicas is not None else 1
+            ports = []
+            for _ in range(replicas):
+                port = self._bitmap.take(job.key())
+                if port < 0:
+                    # roll back only the ports taken in THIS call
+                    # (across all its replica types — none were
+                    # persisted); allocations from *earlier* calls are
+                    # in annotations with live pods bound to them and
+                    # must survive
+                    for taken in taken_this_call:
+                        self._bitmap.free_port(job.key(), taken)
+                    raise PortRangeExhausted(
+                        f"no free host ports in [{self.bport}, {self.eport})"
+                    )
+                ports.append(port)
+                taken_this_call.append(port)
+            annotations[rt] = ",".join(str(p) for p in ports)
         return annotations
 
     # -- release -----------------------------------------------------------
 
     def release(self, job_key: str) -> None:
-        with self._lock:
-            self._release_locked(job_key)
-
-    def _release_locked(self, job_key: str) -> None:
-        for port in self._by_job.pop(job_key, []):
-            self._used.discard(port)
+        self._bitmap.release(job_key)
 
     # -- startup GC --------------------------------------------------------
 
@@ -93,24 +152,19 @@ class PortAllocator:
         """Re-register allocations persisted in live jobs' annotations so
         a restarted controller never double-assigns (reference
         port.go:139-187)."""
-        with self._lock:
-            for job in jobs:
-                if job.is_finished():
+        for job in jobs:
+            if job.is_finished():
+                continue
+            for rtype_key in job.spec.tf_replica_specs:
+                raw = job.metadata.annotations.get(rtype_key.lower())
+                if not raw:
                     continue
-                held = self._by_job.setdefault(job.key(), [])
-                for rtype_key in job.spec.tf_replica_specs:
-                    raw = job.metadata.annotations.get(rtype_key.lower())
-                    if not raw:
+                for part in raw.split(","):
+                    try:
+                        port = int(part)
+                    except ValueError:
                         continue
-                    for part in raw.split(","):
-                        try:
-                            port = int(part)
-                        except ValueError:
-                            continue
-                        if self.bport <= port < self.eport and port not in held:
-                            self._used.add(port)
-                            held.append(port)
+                    self._bitmap.register(job.key(), port)
 
     def in_use(self) -> int:
-        with self._lock:
-            return len(self._used)
+        return self._bitmap.in_use()

@@ -1,0 +1,173 @@
+"""ctypes loader for the native runtime core (native/libtfoprt.so).
+
+The C++ library implements the controller's hottest runtime structures
+— rate-limiting work queue, expectations TTL cache, port allocator —
+behind the C ABI in native/include/tfoprt.h. This module locates the
+shared library (building it with `make` on first use when a toolchain
+is present) and exposes a configured ctypes handle, or None when the
+native path is unavailable; callers fall back to the pure-Python
+implementations with identical semantics.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import logging
+import os
+import subprocess
+import threading
+from typing import Optional
+
+logger = logging.getLogger("tf_operator_tpu.native")
+
+_REPO_NATIVE_DIR = os.path.join(
+    os.path.dirname(os.path.dirname(os.path.dirname(os.path.abspath(__file__)))),
+    "native",
+)
+_LIB_NAME = "libtfoprt.so"
+ABI_VERSION = 2
+
+_lock = threading.Lock()
+_lib: Optional[ctypes.CDLL] = None
+_tried = False
+
+
+def _candidate_paths() -> list:
+    paths = []
+    env = os.environ.get("TFOPRT_NATIVE_LIB")
+    if env:
+        paths.append(env)
+    paths.append(os.path.join(_REPO_NATIVE_DIR, "build", _LIB_NAME))
+    # installed alongside the package (setuptools build copies it here)
+    paths.append(
+        os.path.join(os.path.dirname(os.path.abspath(__file__)), _LIB_NAME)
+    )
+    return paths
+
+
+def _try_build(timeout: float = 120.0) -> None:
+    """Best-effort `make` in native/ when sources are present."""
+    if not os.path.isdir(os.path.join(_REPO_NATIVE_DIR, "src")):
+        return
+    logger.info("building native runtime (%s)...", _REPO_NATIVE_DIR)
+    try:
+        subprocess.run(
+            ["make", "-C", _REPO_NATIVE_DIR],
+            check=True,
+            capture_output=True,
+            timeout=timeout,
+        )
+    except Exception as exc:  # no toolchain, build error, timeout
+        logger.warning(
+            "native runtime build failed (%s); using pure-Python fallback",
+            exc,
+        )
+
+
+def _configure(lib: ctypes.CDLL) -> ctypes.CDLL:
+    c_char_p = ctypes.c_char_p
+    c_double = ctypes.c_double
+    c_int32 = ctypes.c_int32
+    c_void_p = ctypes.c_void_p
+
+    lib.tfoprt_abi_version.restype = c_int32
+    lib.tfoprt_abi_version.argtypes = []
+
+    lib.tfoprt_queue_new.restype = c_void_p
+    lib.tfoprt_queue_new.argtypes = [c_double, c_double]
+    lib.tfoprt_queue_free.argtypes = [c_void_p]
+    lib.tfoprt_queue_add.argtypes = [c_void_p, c_char_p]
+    lib.tfoprt_queue_add_after.argtypes = [c_void_p, c_char_p, c_double]
+    lib.tfoprt_queue_add_rate_limited.argtypes = [c_void_p, c_char_p]
+    lib.tfoprt_queue_get.restype = c_int32
+    lib.tfoprt_queue_get.argtypes = [c_void_p, c_double, c_char_p, c_int32]
+    lib.tfoprt_queue_done.argtypes = [c_void_p, c_char_p]
+    lib.tfoprt_queue_forget.argtypes = [c_void_p, c_char_p]
+    lib.tfoprt_queue_num_requeues.restype = c_int32
+    lib.tfoprt_queue_num_requeues.argtypes = [c_void_p, c_char_p]
+    lib.tfoprt_queue_len.restype = c_int32
+    lib.tfoprt_queue_len.argtypes = [c_void_p]
+    lib.tfoprt_queue_shutdown.argtypes = [c_void_p]
+
+    lib.tfoprt_exp_new.restype = c_void_p
+    lib.tfoprt_exp_new.argtypes = [c_double]
+    lib.tfoprt_exp_free.argtypes = [c_void_p]
+    lib.tfoprt_exp_set.argtypes = [c_void_p, c_char_p, c_int32, c_int32]
+    lib.tfoprt_exp_raise.argtypes = [c_void_p, c_char_p, c_int32, c_int32]
+    lib.tfoprt_exp_creation_observed.argtypes = [c_void_p, c_char_p]
+    lib.tfoprt_exp_deletion_observed.argtypes = [c_void_p, c_char_p]
+    lib.tfoprt_exp_satisfied.restype = c_int32
+    lib.tfoprt_exp_satisfied.argtypes = [c_void_p, c_char_p]
+    lib.tfoprt_exp_delete.argtypes = [c_void_p, c_char_p]
+
+    lib.tfoprt_ports_new.restype = c_void_p
+    lib.tfoprt_ports_new.argtypes = [c_int32, c_int32]
+    lib.tfoprt_ports_free.argtypes = [c_void_p]
+    lib.tfoprt_ports_take.restype = c_int32
+    lib.tfoprt_ports_take.argtypes = [c_void_p, c_char_p]
+    lib.tfoprt_ports_register.restype = c_int32
+    lib.tfoprt_ports_register.argtypes = [c_void_p, c_char_p, c_int32]
+    lib.tfoprt_ports_release.restype = c_int32
+    lib.tfoprt_ports_release.argtypes = [c_void_p, c_char_p]
+    lib.tfoprt_ports_free_port.restype = c_int32
+    lib.tfoprt_ports_free_port.argtypes = [c_void_p, c_char_p, c_int32]
+    lib.tfoprt_ports_in_use.restype = c_int32
+    lib.tfoprt_ports_in_use.argtypes = [c_void_p]
+    return lib
+
+
+def load() -> Optional[ctypes.CDLL]:
+    """The configured native library, or None when unavailable.
+
+    Probe-only: never compiles (constructors on the controller startup
+    path call this, so it must be fast). Use ensure_built() to compile
+    the library when it is missing — the server does this once at
+    startup, before any controller is constructed.
+    Set TFOPRT_DISABLE_NATIVE=1 to force the pure-Python path.
+    """
+    global _lib, _tried
+    if os.environ.get("TFOPRT_DISABLE_NATIVE"):
+        return None
+    with _lock:
+        if _tried:
+            return _lib
+        _tried = True
+        for path in _candidate_paths():
+            if not os.path.exists(path):
+                continue
+            try:
+                lib = _configure(ctypes.CDLL(path))
+            except (OSError, AttributeError) as exc:
+                logger.warning("failed to load %s: %s", path, exc)
+                continue
+            if lib.tfoprt_abi_version() != ABI_VERSION:
+                logger.warning(
+                    "%s ABI %d != expected %d; ignoring",
+                    path, lib.tfoprt_abi_version(), ABI_VERSION,
+                )
+                continue
+            _lib = lib
+            return _lib
+        return None
+
+
+def ensure_built(timeout: float = 120.0) -> bool:
+    """Build the native library if it is missing, then (re-)probe.
+
+    The only place a compile can happen; callers invoke it explicitly
+    at process startup (server.Run), never from constructors. Returns
+    availability.
+    """
+    global _tried
+    if os.environ.get("TFOPRT_DISABLE_NATIVE"):
+        return False
+    if load() is not None:
+        return True
+    _try_build(timeout)  # module lock NOT held during the compile
+    with _lock:
+        _tried = False  # re-probe the freshly built artifact
+    return load() is not None
+
+
+def available() -> bool:
+    return load() is not None

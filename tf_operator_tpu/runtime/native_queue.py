@@ -1,0 +1,185 @@
+"""Native-backed runtime structures (C++ via ctypes).
+
+Wrappers over native/libtfoprt.so with interfaces identical to the
+pure-Python `workqueue.RateLimitingQueue`,
+`expectations.ControllerExpectations`, and the port-bitmap core of
+`controller.ports.PortAllocator`. The `make_*` factories return the
+native implementation when the library is loadable and the Python one
+otherwise, so the controller is agnostic to which is active
+(`TFOPRT_DISABLE_NATIVE=1` forces Python).
+
+Blocking `get` calls release the GIL (ctypes foreign calls), so a
+native queue also removes the Python condvar from the reconcile hot
+path (reference hot loop: controller.go:225-283).
+"""
+
+from __future__ import annotations
+
+import ctypes
+from typing import Hashable, Optional
+
+from . import _native
+from .expectations import EXPECTATION_TTL_SECONDS, ControllerExpectations
+from .workqueue import RateLimitingQueue
+
+_BUF_LEN = 4096  # controller keys are "namespace/name": far below this
+
+
+def _encode(item: Hashable) -> bytes:
+    if isinstance(item, bytes):
+        return item
+    return str(item).encode("utf-8")
+
+
+class NativeRateLimitingQueue:
+    """Interface-compatible with workqueue.RateLimitingQueue."""
+
+    def __init__(
+        self, base_delay: float = 0.005, max_delay: float = 1000.0
+    ) -> None:
+        lib = _native.load()
+        if lib is None:
+            raise RuntimeError("native library unavailable")
+        self._lib = lib
+        self._h = lib.tfoprt_queue_new(base_delay, max_delay)
+
+    def add(self, item: Hashable) -> None:
+        self._lib.tfoprt_queue_add(self._h, _encode(item))
+
+    def add_after(self, item: Hashable, delay: float) -> None:
+        self._lib.tfoprt_queue_add_after(self._h, _encode(item), delay)
+
+    def add_rate_limited(self, item: Hashable) -> None:
+        self._lib.tfoprt_queue_add_rate_limited(self._h, _encode(item))
+
+    def get(self, timeout: Optional[float] = None) -> Optional[str]:
+        t = -1.0 if timeout is None else timeout
+        # fresh buffer per call: concurrent workers block in the native
+        # call with the GIL released, so a shared buffer would race
+        buf_len = _BUF_LEN
+        while True:
+            buf = ctypes.create_string_buffer(buf_len)
+            n = self._lib.tfoprt_queue_get(self._h, t, buf, buf_len)
+            if n == -1:
+                return None
+            if n < -1:
+                # item longer than the buffer: left at the front of the
+                # queue, -(len+2) returned — retry with room for it
+                buf_len = -n
+                continue
+            return buf.value.decode("utf-8")
+
+    def done(self, item: Hashable) -> None:
+        self._lib.tfoprt_queue_done(self._h, _encode(item))
+
+    def forget(self, item: Hashable) -> None:
+        self._lib.tfoprt_queue_forget(self._h, _encode(item))
+
+    def num_requeues(self, item: Hashable) -> int:
+        return self._lib.tfoprt_queue_num_requeues(self._h, _encode(item))
+
+    def shut_down(self) -> None:
+        self._lib.tfoprt_queue_shutdown(self._h)
+
+    def __len__(self) -> int:
+        return self._lib.tfoprt_queue_len(self._h)
+
+    def __del__(self) -> None:
+        h, self._h = getattr(self, "_h", None), None
+        if h and getattr(self, "_lib", None):
+            self._lib.tfoprt_queue_shutdown(h)
+            self._lib.tfoprt_queue_free(h)
+
+
+class NativeExpectations:
+    """Interface-compatible with expectations.ControllerExpectations."""
+
+    def __init__(self, ttl: float = EXPECTATION_TTL_SECONDS) -> None:
+        lib = _native.load()
+        if lib is None:
+            raise RuntimeError("native library unavailable")
+        self._lib = lib
+        self._h = lib.tfoprt_exp_new(ttl)
+
+    def expect_creations(self, key: str, count: int) -> None:
+        self._lib.tfoprt_exp_set(self._h, _encode(key), count, 0)
+
+    def expect_deletions(self, key: str, count: int) -> None:
+        self._lib.tfoprt_exp_set(self._h, _encode(key), 0, count)
+
+    def raise_expectations(self, key: str, adds: int, deletes: int) -> None:
+        self._lib.tfoprt_exp_raise(self._h, _encode(key), adds, deletes)
+
+    def creation_observed(self, key: str) -> None:
+        self._lib.tfoprt_exp_creation_observed(self._h, _encode(key))
+
+    def deletion_observed(self, key: str) -> None:
+        self._lib.tfoprt_exp_deletion_observed(self._h, _encode(key))
+
+    def satisfied(self, key: str) -> bool:
+        return bool(self._lib.tfoprt_exp_satisfied(self._h, _encode(key)))
+
+    def delete_expectations(self, key: str) -> None:
+        self._lib.tfoprt_exp_delete(self._h, _encode(key))
+
+    def __del__(self) -> None:
+        h, self._h = getattr(self, "_h", None), None
+        if h and getattr(self, "_lib", None):
+            self._lib.tfoprt_exp_free(h)
+
+
+class NativePortBitmap:
+    """Low-level port bitmap used by controller.ports.PortAllocator."""
+
+    def __init__(self, bport: int, eport: int) -> None:
+        lib = _native.load()
+        if lib is None:
+            raise RuntimeError("native library unavailable")
+        self._lib = lib
+        self._h = lib.tfoprt_ports_new(bport, eport)
+        if not self._h:
+            raise ValueError(f"empty port range [{bport}, {eport})")
+
+    def take(self, job_key: str) -> int:
+        """Next free port for job_key, or -1 when exhausted."""
+        return self._lib.tfoprt_ports_take(self._h, _encode(job_key))
+
+    def register(self, job_key: str, port: int) -> bool:
+        return bool(
+            self._lib.tfoprt_ports_register(self._h, _encode(job_key), port)
+        )
+
+    def release(self, job_key: str) -> int:
+        return self._lib.tfoprt_ports_release(self._h, _encode(job_key))
+
+    def free_port(self, job_key: str, port: int) -> bool:
+        """Release one specific port (rollback of a partial allocation)."""
+        return bool(
+            self._lib.tfoprt_ports_free_port(self._h, _encode(job_key), port)
+        )
+
+    def in_use(self) -> int:
+        return self._lib.tfoprt_ports_in_use(self._h)
+
+    def __del__(self) -> None:
+        h, self._h = getattr(self, "_h", None), None
+        if h and getattr(self, "_lib", None):
+            self._lib.tfoprt_ports_free(h)
+
+
+def native_available() -> bool:
+    return _native.available()
+
+
+def make_rate_limiting_queue():
+    """Native queue when available, pure-Python otherwise."""
+    if _native.available():
+        return NativeRateLimitingQueue()
+    return RateLimitingQueue()
+
+
+def make_expectations():
+    """Native expectations cache when available, pure-Python otherwise."""
+    if _native.available():
+        return NativeExpectations()
+    return ControllerExpectations()

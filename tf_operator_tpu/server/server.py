@@ -79,6 +79,14 @@ def check_crd_exists(substrate) -> bool:
 class OperatorServer:
     def __init__(self, options: ServerOptions, substrate=None) -> None:
         self.options = options
+        # compile the native runtime core here if missing — the one
+        # allowed build site, so controller construction stays fast
+        from ..runtime import _native
+
+        if _native.ensure_built():
+            logger.info("native runtime core active (libtfoprt)")
+        else:
+            logger.info("native runtime core unavailable; pure-Python fallback")
         self.metrics = OperatorMetrics()
         self.monitoring = MonitoringServer(self.metrics, options.monitoring_port)
         self.substrate = substrate if substrate is not None else build_substrate(options)
