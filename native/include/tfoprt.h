@@ -88,7 +88,8 @@ void tfoprt_ports_free(tfoprt_ports_t p);
 int32_t tfoprt_ports_take(tfoprt_ports_t p, const char *job_key);
 /* Re-registers a persisted allocation (controller restart GC,
  * reference port.go:139-187). Returns 1 if newly registered, 0 if the
- * port was out of range or already held. */
+ * port was out of range, already held by this job, or held by another
+ * job (ownership is exclusive — never shared across jobs). */
 int32_t tfoprt_ports_register(tfoprt_ports_t p, const char *job_key,
                               int32_t port);
 /* Releases every port held by job_key. Returns the count released. */

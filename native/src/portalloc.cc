@@ -37,14 +37,18 @@ class PortAllocator {
   int32_t Register(const std::string &job_key, int32_t port) {
     std::lock_guard<std::mutex> lk(mu_);
     if (port < bport_ || port >= eport_) return 0;
-    auto &held = by_job_[job_key];
-    for (int32_t p : held)
-      if (p == port) return 0;
-    if (!used_[port - bport_]) {
-      used_[port - bport_] = true;
-      in_use_++;
+    auto it = by_job_.find(job_key);
+    if (it != by_job_.end()) {
+      for (int32_t p : it->second)
+        if (p == port) return 0;  // already held by this job
     }
-    held.push_back(port);
+    // refuse shared ownership: a port marked used but absent from this
+    // job's holdings belongs to another job, and granting it here would
+    // free it for reassignment when the first holder releases
+    if (used_[port - bport_]) return 0;
+    used_[port - bport_] = true;
+    in_use_++;
+    by_job_[job_key].push_back(port);
     return 1;
   }
 

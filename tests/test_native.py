@@ -194,6 +194,18 @@ class TestPortBitmap:
         assert not b.register("j", 99)  # out of range
         assert b.in_use() == 1
 
+    def test_register_refuses_shared_ownership(self, impl):
+        """A port held by job A must not also be granted to job B:
+        shared ownership would free it for reassignment while A's pod
+        still binds it (code-review finding)."""
+        b = impl(100, 110)
+        assert b.register("a", 105)
+        assert not b.register("b", 105)
+        assert b.release("a") == 1
+        # b never owned it, so nothing to release; now it's free again
+        assert b.release("b") == 0
+        assert b.register("b", 105)
+
     def test_cyclic_reuse_after_release(self, impl):
         b = impl(100, 102)
         b.take("a")
@@ -269,3 +281,23 @@ def test_python_fallback_forced(monkeypatch):
     assert type(q).__name__ == "RateLimitingQueue"
     assert type(e).__name__ == "ControllerExpectations"
     q.shut_down()
+
+
+def test_allocate_registers_preexisting_annotations():
+    """A job created mid-flight with annotations already carrying ports
+    (e.g. a re-applied exported manifest) must occupy those ports in
+    the bitmap, or the next allocate() double-assigns them
+    (code-review finding)."""
+    from tests.test_api import make_job
+
+    alloc = PortAllocator(20000, 20004)
+    carried = make_job({"PS": 2}, name="carried")
+    carried.spec.tf_replica_specs["PS"].template.spec.host_network = True
+    carried.metadata.annotations["ps"] = "20000,20001"
+    assert alloc.allocate(carried) == {}  # skips, but claims 20000-20001
+
+    fresh = make_job({"PS": 2}, name="fresh")
+    fresh.spec.tf_replica_specs["PS"].template.spec.host_network = True
+    ann = alloc.allocate(fresh)
+    got = {int(p) for p in ann["ps"].split(",")}
+    assert got == {20002, 20003}

@@ -1,0 +1,127 @@
+"""utils package: structured loggers, helpers, version.
+
+Mirrors what the reference exercises implicitly through
+pkg/logger/logger.go usage and pkg/util tests.
+"""
+
+import json
+import logging
+
+from tf_operator_tpu.api import k8s
+from tf_operator_tpu.api.types import TFJob, gen_labels
+from tf_operator_tpu.utils import (
+    JsonFieldFormatter,
+    filter_active_pods,
+    filter_pod_count,
+    logger_for_job,
+    logger_for_key,
+    logger_for_pod,
+    logger_for_replica,
+    pformat,
+    rand_string,
+    version_info,
+)
+from tf_operator_tpu.utils.version import VERSION
+
+
+def _job(name="j1", namespace="ns"):
+    job = TFJob()
+    job.metadata.name = name
+    job.metadata.namespace = namespace
+    job.metadata.uid = "uid-7"
+    return job
+
+
+def _capture(adapter, message):
+    records = []
+
+    class Sink(logging.Handler):
+        def emit(self, record):
+            records.append(record)
+
+    base = adapter.logger
+    sink = Sink()
+    base.addHandler(sink)
+    base.setLevel(logging.INFO)
+    try:
+        adapter.info(message)
+    finally:
+        base.removeHandler(sink)
+    return records[0]
+
+
+class TestStructuredLogger:
+    def test_job_fields(self):
+        record = _capture(logger_for_job(_job()), "hello")
+        assert record.fields == {"job": "ns.j1", "uid": "uid-7"}
+
+    def test_replica_fields(self):
+        record = _capture(logger_for_replica(_job(), "Worker"), "hello")
+        assert record.fields["replica-type"] == "Worker"
+        assert record.fields["job"] == "ns.j1"
+
+    def test_pod_fields_from_labels(self):
+        pod = k8s.Pod()
+        pod.metadata.name = "j1-worker-0"
+        pod.metadata.namespace = "ns"
+        pod.metadata.uid = "pod-uid"
+        pod.metadata.labels = dict(gen_labels("j1"))
+        pod.metadata.labels["tf-replica-type"] = "worker"
+        pod.metadata.labels["tf-replica-index"] = "0"
+        record = _capture(logger_for_pod(pod), "hello")
+        assert record.fields["job"] == "ns.j1"
+        assert record.fields["replica-type"] == "worker"
+        assert record.fields["replica-index"] == "0"
+
+    def test_key_logger(self):
+        record = _capture(logger_for_key("ns/j1"), "hello")
+        assert record.fields == {"job": "ns/j1"}
+
+    def test_json_formatter_folds_fields_in(self):
+        record = _capture(logger_for_job(_job()), "converged")
+        line = JsonFieldFormatter().format(record)
+        entry = json.loads(line)
+        assert entry["message"] == "converged"
+        assert entry["job"] == "ns.j1"
+        assert entry["uid"] == "uid-7"
+        assert entry["severity"] == "INFO"
+
+    def test_with_fields_merges(self):
+        adapter = logger_for_job(_job()).with_fields(step="reconcile")
+        record = _capture(adapter, "hello")
+        assert record.fields["step"] == "reconcile"
+        assert record.fields["job"] == "ns.j1"
+
+
+class TestUtil:
+    def test_pformat_dataclass(self):
+        text = pformat(_job())
+        parsed = json.loads(text)
+        assert parsed["metadata"]["name"] == "j1"
+
+    def test_pformat_plain(self):
+        assert json.loads(pformat({"a": 1})) == {"a": 1}
+
+    def test_rand_string(self):
+        value = rand_string(8)
+        assert len(value) == 8
+        assert value.islower() or value.isdigit() or value.isalnum()
+
+    def test_filter_active_pods(self):
+        active = k8s.Pod()
+        done = k8s.Pod()
+        done.status.phase = k8s.POD_SUCCEEDED
+        assert filter_active_pods([active, done]) == [active]
+
+    def test_filter_pod_count(self):
+        pods = [k8s.Pod() for _ in range(3)]
+        pods[0].status.phase = k8s.POD_RUNNING
+        pods[1].status.phase = k8s.POD_RUNNING
+        assert filter_pod_count(pods, k8s.POD_RUNNING) == 2
+
+
+class TestVersion:
+    def test_version_info(self):
+        info = version_info()
+        assert VERSION in info
+        assert "tf-operator-tpu" in info

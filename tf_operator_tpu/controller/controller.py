@@ -16,6 +16,7 @@ from ..api import k8s, set_defaults, validate
 from ..api.serde import to_jsonable
 from ..api.types import ConditionType, TFJob, gen_labels
 from ..api.validation import ValidationError
+from ..utils.logger import logger_for_job
 from ..runtime import (
     ADDED,
     DELETED,
@@ -127,7 +128,7 @@ class TFJobController:
         try:
             validate(job)
         except ValidationError as err:
-            logger.warning("job %s failed validation: %s", job.key(), err)
+            logger_for_job(job, logger).warning("failed validation: %s", err)
             self.recorder.event(
                 job.kind, job.name, job.namespace, "Warning",
                 REASON_FAILED_VALIDATION, str(err),
@@ -237,7 +238,7 @@ class TFJobController:
         except NotFound:
             return
         self.expectations.delete_expectations(job.key())
-        logger.info("job %s deleted after TTL", job.key())
+        logger_for_job(job, logger).info("deleted after TTL")
 
     # -- run loops ---------------------------------------------------------
 

@@ -58,11 +58,12 @@ class _PyPortBitmap:
         with self._lock:
             if not (self._bport <= port < self._eport):
                 return False
-            held = self._by_job.setdefault(job_key, [])
-            if port in held:
-                return False
+            if port in self._by_job.get(job_key, []):
+                return False  # already held by this job
+            if port in self._used:
+                return False  # held by another job: no shared ownership
             self._used.add(port)
-            held.append(port)
+            self._by_job.setdefault(job_key, []).append(port)
             return True
 
     def release(self, job_key: str) -> int:
@@ -119,8 +120,13 @@ class PortAllocator:
             if spec is None or not spec.template.spec.host_network:
                 continue
             rt = rtype_key.lower()
-            if job.metadata.annotations.get(rt):
-                continue  # already allocated (e.g. controller restart)
+            existing = job.metadata.annotations.get(rt)
+            if existing:
+                # already allocated (controller restart, or a manifest
+                # re-applied with its annotations): claim the ports in
+                # the bitmap so they can't be handed out again
+                self._register_ports(job.key(), existing)
+                continue
             replicas = spec.replicas if spec.replicas is not None else 1
             ports = []
             for _ in range(replicas):
@@ -157,14 +163,16 @@ class PortAllocator:
                 continue
             for rtype_key in job.spec.tf_replica_specs:
                 raw = job.metadata.annotations.get(rtype_key.lower())
-                if not raw:
-                    continue
-                for part in raw.split(","):
-                    try:
-                        port = int(part)
-                    except ValueError:
-                        continue
-                    self._bitmap.register(job.key(), port)
+                if raw:
+                    self._register_ports(job.key(), raw)
+
+    def _register_ports(self, job_key: str, raw: str) -> None:
+        for part in raw.split(","):
+            try:
+                port = int(part)
+            except ValueError:
+                continue
+            self._bitmap.register(job_key, port)
 
     def in_use(self) -> int:
         return self._bitmap.in_use()

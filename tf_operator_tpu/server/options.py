@@ -66,7 +66,15 @@ def parse_args(argv: Optional[List[str]] = None) -> ServerOptions:
     parser.add_argument(
         "--substrate", choices=["kube", "memory"], default=opts.substrate
     )
+    parser.add_argument(
+        "--version", action="store_true", help="Print version and exit"
+    )
     ns = parser.parse_args(argv)
+    if ns.version:
+        from ..utils.version import version_info
+
+        print(version_info())
+        raise SystemExit(0)
     return ServerOptions(
         namespace=ns.namespace,
         threadiness=ns.threadiness,

@@ -10,7 +10,6 @@ or against a real apiserver with in-cluster credentials / kubeconfig.
 
 from __future__ import annotations
 
-import json
 import logging
 import signal
 import sys
@@ -20,26 +19,16 @@ from typing import Optional
 from ..controller import ReconcilerConfig, TFJobController
 from ..controller.ports import PortAllocator
 from ..runtime import InMemorySubstrate
+from ..utils import JsonFieldFormatter, version_info
 from .leader import FileLock, LeaderElector
 from .metrics import MonitoringServer, OperatorMetrics
 from .options import ServerOptions, parse_args
 
 logger = logging.getLogger("tf_operator_tpu.server")
 
-
-class JsonFormatter(logging.Formatter):
-    """Stackdriver-style JSON logs (reference main.go:58-61)."""
-
-    def format(self, record: logging.LogRecord) -> str:
-        entry = {
-            "severity": record.levelname,
-            "message": record.getMessage(),
-            "logger": record.name,
-            "timestamp": self.formatTime(record),
-        }
-        if record.exc_info:
-            entry["exception"] = self.formatException(record.exc_info)
-        return json.dumps(entry)
+# Stackdriver-style JSON logs with structured per-job fields
+# (reference main.go:58-61 + pkg/logger/logger.go via utils.logger)
+JsonFormatter = JsonFieldFormatter
 
 
 def setup_logging(json_format: bool) -> None:
@@ -140,6 +129,7 @@ class OperatorServer:
 def main(argv=None) -> int:
     options = parse_args(argv)
     setup_logging(options.json_log_format)
+    logger.info(version_info())
     server = OperatorServer(options)
     signal.signal(signal.SIGTERM, server.shutdown)
     signal.signal(signal.SIGINT, server.shutdown)

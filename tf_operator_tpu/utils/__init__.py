@@ -1,0 +1,30 @@
+"""Misc plumbing: structured per-job logging, small helpers, version.
+
+The reference spreads these over pkg/logger/logger.go, pkg/util/util.go,
+pkg/util/k8sutil/k8sutil.go and pkg/version/version.go (SURVEY.md #19,
+#20); here they live in one package.
+"""
+
+from .logger import (
+    JsonFieldFormatter,
+    logger_for_job,
+    logger_for_key,
+    logger_for_pod,
+    logger_for_replica,
+)
+from .util import filter_active_pods, filter_pod_count, pformat, rand_string
+from .version import VERSION, version_info
+
+__all__ = [
+    "JsonFieldFormatter",
+    "logger_for_job",
+    "logger_for_key",
+    "logger_for_pod",
+    "logger_for_replica",
+    "filter_active_pods",
+    "filter_pod_count",
+    "pformat",
+    "rand_string",
+    "VERSION",
+    "version_info",
+]

@@ -1,0 +1,100 @@
+"""Structured per-job / per-replica / per-pod loggers.
+
+The reference attaches logrus fields (job, uid, replica-type,
+replica-index) to every controller log line so one job's lifecycle can
+be grepped out of the stream (pkg/logger/logger.go:26-80). The Python
+analog is a ``logging.LoggerAdapter`` that carries a ``fields`` dict;
+``JsonFieldFormatter`` merges those fields into the Stackdriver-style
+JSON entry the server emits (reference main.go:58-61).
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+from typing import Any, Dict, Optional
+
+
+class FieldsAdapter(logging.LoggerAdapter):
+    """LoggerAdapter that threads a structured ``fields`` dict through
+    ``record.fields`` and prefixes plain-text output with the fields."""
+
+    def __init__(self, logger: logging.Logger, fields: Dict[str, Any]) -> None:
+        super().__init__(logger, {"fields": fields})
+
+    @property
+    def fields(self) -> Dict[str, Any]:
+        return self.extra["fields"]
+
+    def with_fields(self, **more: Any) -> "FieldsAdapter":
+        merged = dict(self.fields)
+        merged.update(more)
+        return FieldsAdapter(self.logger, merged)
+
+    def process(self, msg, kwargs):
+        extra = kwargs.setdefault("extra", {})
+        extra.setdefault("fields", self.fields)
+        return msg, kwargs
+
+
+class JsonFieldFormatter(logging.Formatter):
+    """JSON log lines with any structured fields folded in."""
+
+    def format(self, record: logging.LogRecord) -> str:
+        entry: Dict[str, Any] = {
+            "severity": record.levelname,
+            "message": record.getMessage(),
+            "logger": record.name,
+            "timestamp": self.formatTime(record),
+            "filename": f"{record.filename}:{record.lineno}",
+        }
+        fields = getattr(record, "fields", None)
+        if fields:
+            entry.update(fields)
+        if record.exc_info:
+            entry["exception"] = self.formatException(record.exc_info)
+        return json.dumps(entry)
+
+
+_base = logging.getLogger("tf_operator_tpu")
+
+
+def logger_for_key(key: str, logger: Optional[logging.Logger] = None) -> FieldsAdapter:
+    """Fields from a workqueue key "namespace/name" (reference
+    logger.go:64-73)."""
+    return FieldsAdapter(logger or _base, {"job": key})
+
+
+def logger_for_job(job, logger: Optional[logging.Logger] = None) -> FieldsAdapter:
+    """Fields identifying one TFJob (reference logger.go:26-38)."""
+    fields = {
+        "job": f"{job.metadata.namespace}.{job.metadata.name}",
+        "uid": job.metadata.uid,
+    }
+    return FieldsAdapter(logger or _base, fields)
+
+
+def logger_for_replica(
+    job, rtype: str, logger: Optional[logging.Logger] = None
+) -> FieldsAdapter:
+    """Job fields + replica-type (reference logger.go:40-50)."""
+    adapter = logger_for_job(job, logger)
+    return adapter.with_fields(**{"replica-type": str(rtype)})
+
+
+def logger_for_pod(pod, logger: Optional[logging.Logger] = None) -> FieldsAdapter:
+    """Fields from a child pod's identifying labels (reference
+    logger.go:52-62)."""
+    labels = pod.metadata.labels or {}
+    fields: Dict[str, Any] = {
+        "pod": f"{pod.metadata.namespace}.{pod.metadata.name}",
+        "uid": pod.metadata.uid,
+    }
+    # avoid importing api.types here: label keys are stable strings
+    if "job-name" in labels:
+        fields["job"] = f"{pod.metadata.namespace}.{labels['job-name']}"
+    if "tf-replica-type" in labels:
+        fields["replica-type"] = labels["tf-replica-type"]
+    if "tf-replica-index" in labels:
+        fields["replica-index"] = labels["tf-replica-index"]
+    return FieldsAdapter(logger or _base, fields)

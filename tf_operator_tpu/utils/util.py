@@ -1,0 +1,43 @@
+"""Small helpers (reference pkg/util/util.go:32-76 and
+pkg/util/k8sutil/k8sutil.go:35-123)."""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+import random
+import string
+from typing import Any, Iterable, List
+
+from ..api import k8s
+from ..api.serde import to_jsonable
+
+
+def pformat(obj: Any) -> str:
+    """Pretty-print an API object or plain value as indented JSON
+    (reference util.go Pformat:32-44)."""
+    if dataclasses.is_dataclass(obj) and not isinstance(obj, type):
+        obj = to_jsonable(obj)
+    try:
+        return json.dumps(obj, indent=2, sort_keys=True, default=str)
+    except TypeError:
+        return repr(obj)
+
+
+def rand_string(n: int, rng: random.Random | None = None) -> str:
+    """Random lowercase suffix for generated names (reference
+    util.go:59-76)."""
+    rng = rng or random
+    alphabet = string.ascii_lowercase + string.digits
+    return "".join(rng.choice(alphabet) for _ in range(n))
+
+
+def filter_active_pods(pods: Iterable[k8s.Pod]) -> List[k8s.Pod]:
+    """Pods that are neither Succeeded nor Failed and not being deleted
+    (reference k8sutil.go FilterActivePods:78-96)."""
+    return [pod for pod in pods if pod.is_active()]
+
+
+def filter_pod_count(pods: Iterable[k8s.Pod], phase: str) -> int:
+    """Count pods in a given phase (reference k8sutil.go:99-108)."""
+    return sum(1 for pod in pods if pod.status.phase == phase)

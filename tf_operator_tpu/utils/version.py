@@ -1,0 +1,36 @@
+"""Version stamp (reference pkg/version/version.go:21-43).
+
+The reference bakes Version/GitSHA in at link time via -ldflags; here
+the git SHA is resolved lazily from the repo when available.
+"""
+
+from __future__ import annotations
+
+import subprocess
+import sys
+from pathlib import Path
+
+VERSION = "1.0.0"
+
+
+def git_sha() -> str:
+    try:
+        out = subprocess.run(
+            ["git", "rev-parse", "--short", "HEAD"],
+            cwd=Path(__file__).resolve().parent,
+            capture_output=True,
+            text=True,
+            timeout=5,
+        )
+        if out.returncode == 0:
+            return out.stdout.strip()
+    except Exception:
+        pass
+    return "unknown"
+
+
+def version_info() -> str:
+    return (
+        f"tf-operator-tpu version {VERSION}, git SHA {git_sha()}, "
+        f"python {sys.version.split()[0]}"
+    )
