@@ -103,9 +103,12 @@ class TestUtil:
         assert json.loads(pformat({"a": 1})) == {"a": 1}
 
     def test_rand_string(self):
+        import string
+
         value = rand_string(8)
         assert len(value) == 8
-        assert value.islower() or value.isdigit() or value.isalnum()
+        # must stay RFC-1123-safe (lowercase alphanumeric only)
+        assert all(c in string.ascii_lowercase + string.digits for c in value)
 
     def test_filter_active_pods(self):
         active = k8s.Pod()
@@ -125,3 +128,14 @@ class TestVersion:
         info = version_info()
         assert VERSION in info
         assert "tf-operator-tpu" in info
+
+
+class TestTextFormatter:
+    def test_text_formatter_appends_fields(self):
+        from tf_operator_tpu.utils import TextFieldFormatter
+
+        record = _capture(logger_for_job(_job()), "failed validation")
+        line = TextFieldFormatter("%(levelname)s %(message)s").format(record)
+        assert "failed validation" in line
+        assert "job=ns.j1" in line
+        assert "uid=uid-7" in line

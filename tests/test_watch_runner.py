@@ -1,0 +1,253 @@
+"""SDK watch helper + E2E test-runner harness.
+
+Covers the analogs of the reference's tf_job_watch.py and
+py/kubeflow/tf_operator/test_runner.py:23-212 (reflective discovery,
+retry-on-flake, JUnit XML artifact).
+"""
+
+import threading
+import time
+import xml.etree.ElementTree as ET
+
+from tf_operator_tpu.api.types import ConditionType, TFJob
+from tf_operator_tpu.controller import ReconcilerConfig, TFJobController
+from tf_operator_tpu.runtime import InMemorySubstrate
+from tf_operator_tpu.sdk import TFJobClient, WatchEvent, format_event, watch
+from tf_operator_tpu.testing import TestCase, run, run_test
+from tf_operator_tpu.testing.test_runner import discover
+
+
+def make_job_dict(name, replicas=1):
+    return {
+        "apiVersion": "kubeflow.org/v1",
+        "kind": "TFJob",
+        "metadata": {"name": name, "namespace": "default"},
+        "spec": {
+            "tfReplicaSpecs": {
+                "Worker": {
+                    "replicas": replicas,
+                    "template": {
+                        "spec": {
+                            "containers": [
+                                {"name": "tensorflow", "image": "img"}
+                            ]
+                        }
+                    },
+                }
+            }
+        },
+    }
+
+
+class TestWatch:
+    def _run_controller(self, substrate):
+        controller = TFJobController(substrate, config=ReconcilerConfig())
+        controller.run(threadiness=1, resync_period=0.2)
+        return controller
+
+    def test_watch_streams_lifecycle_to_terminal(self):
+        substrate = InMemorySubstrate()
+        controller = self._run_controller(substrate)
+        client = TFJobClient(substrate)
+        try:
+            client.create(make_job_dict("w1"))
+
+            def drive():
+                deadline = time.monotonic() + 5
+                while time.monotonic() < deadline:
+                    if substrate.run_all_pending():
+                        break
+                    time.sleep(0.05)
+                time.sleep(0.2)
+                for pod in substrate.list_pods("default"):
+                    substrate.terminate_pod(
+                        "default", pod.metadata.name, exit_code=0
+                    )
+
+            threading.Thread(target=drive, daemon=True).start()
+            states = [
+                event.state
+                for event in watch(
+                    substrate, name="w1", timeout_seconds=10
+                )
+            ]
+            assert states[-1] == ConditionType.SUCCEEDED.value
+            assert ConditionType.RUNNING.value in states
+        finally:
+            controller.stop()
+
+    def test_watch_initial_list_includes_preexisting(self):
+        substrate = InMemorySubstrate()
+        client = TFJobClient(substrate)
+        client.create(make_job_dict("pre"))
+        events = []
+        for event in watch(
+            substrate, name="pre", timeout_seconds=0, stop_at_terminal=False
+        ):
+            events.append(event)
+        assert [e.type for e in events] == ["ADDED"]
+        assert events[0].job.name == "pre"
+
+    def test_watch_filters_namespace_and_name(self):
+        substrate = InMemorySubstrate()
+        client = TFJobClient(substrate)
+        client.create(make_job_dict("target"))
+        client.create(make_job_dict("other"))
+        seen = [
+            event.job.name
+            for event in watch(
+                substrate, name="target", timeout_seconds=0,
+                stop_at_terminal=False,
+            )
+        ]
+        assert seen == ["target"]
+
+    def test_format_event_row(self):
+        job = TFJob.from_dict(make_job_dict("fmt"))
+        row = format_event(WatchEvent("ADDED", job))
+        assert "fmt" in row
+        assert "-" in row  # no conditions yet
+
+
+class TestRunnerHarness:
+    def test_discovery_is_reflective_and_sorted(self):
+        class Suite(TestCase):
+            def test_b(self):
+                pass
+
+            def test_a(self):
+                pass
+
+            def helper(self):
+                pass
+
+        assert discover(Suite) == ["test_a", "test_b"]
+
+    def test_retry_until_success(self):
+        attempts = []
+
+        class Flaky(TestCase):
+            def test_flaky(self):
+                attempts.append(1)
+                if len(attempts) < 2:
+                    raise RuntimeError("flake")
+
+        result = run_test(Flaky, "test_flaky", backoff_seconds=0)
+        assert result.passed
+        assert result.attempts == 2
+
+    def test_persistent_failure_recorded(self):
+        class Broken(TestCase):
+            def test_broken(self):
+                raise RuntimeError("always")
+
+        result = run_test(Broken, "test_broken", max_retries=2, backoff_seconds=0)
+        assert not result.passed
+        assert "always" in result.failure
+        assert result.attempts == 2
+
+    def test_setup_teardown_run_per_attempt(self):
+        calls = []
+
+        class WithFixture(TestCase):
+            def setup(self):
+                calls.append("setup")
+
+            def teardown(self):
+                calls.append("teardown")
+
+            def test_ok(self):
+                calls.append("test")
+
+        run_test(WithFixture, "test_ok")
+        assert calls == ["setup", "test", "teardown"]
+
+    def test_teardown_runs_on_failure(self):
+        calls = []
+
+        class Fails(TestCase):
+            def teardown(self):
+                calls.append("teardown")
+
+            def test_fail(self):
+                raise RuntimeError("nope")
+
+        run_test(Fails, "test_fail", max_retries=1, backoff_seconds=0)
+        assert calls == ["teardown"]
+
+    def test_junit_xml_artifact(self, tmp_path):
+        class Mixed(TestCase):
+            def test_pass(self):
+                pass
+
+            def test_fail(self):
+                raise RuntimeError("boom")
+
+        report = run(
+            Mixed, artifacts_dir=str(tmp_path), max_retries=1,
+            backoff_seconds=0,
+        )
+        assert report.failures == 1
+        path = tmp_path / "junit_Mixed.xml"
+        root = ET.fromstring(path.read_text())
+        assert root.tag == "testsuite"
+        assert root.get("tests") == "2"
+        assert root.get("failures") == "1"
+        cases = {c.get("name"): c for c in root.findall("testcase")}
+        assert cases["test_fail"].find("failure") is not None
+        assert cases["test_pass"].find("failure") is None
+
+
+class TestWatchFixes:
+    """Regression tests for code-review findings on the watch helper."""
+
+    def test_watch_unsubscribes_on_return(self):
+        substrate = InMemorySubstrate()
+        client = TFJobClient(substrate)
+        client.create(make_job_dict("w"))
+        before = len(substrate._subscribers.get("tfjob", []))
+        for _ in watch(substrate, name="w", timeout_seconds=0,
+                       stop_at_terminal=False):
+            pass
+        after = len(substrate._subscribers.get("tfjob", []))
+        assert after == before  # callback detached, no leak
+
+    def test_poll_fallback_detects_deletion(self):
+        class PollOnly:
+            """Substrate facade without subscribe(): forces poll path."""
+
+            def __init__(self, inner):
+                self._inner = inner
+
+            def get_job(self, namespace, name):
+                return self._inner.get_job(namespace, name)
+
+            def list_jobs(self, namespace=None):
+                return self._inner.list_jobs(namespace)
+
+        substrate = InMemorySubstrate()
+        client = TFJobClient(substrate)
+        client.create(make_job_dict("doomed"))
+
+        def delete_soon():
+            time.sleep(0.4)
+            substrate.delete_job("default", "doomed")
+
+        threading.Thread(target=delete_soon, daemon=True).start()
+        events = list(
+            watch(PollOnly(substrate), name="doomed", timeout_seconds=5)
+        )
+        assert events[-1].type == "DELETED"
+
+    def test_subscribe_path_no_duplicate_added_for_listed_job(self):
+        substrate = InMemorySubstrate()
+        client = TFJobClient(substrate)
+        client.create(make_job_dict("once"))
+        # replay the exact listed version into the queue by hand is
+        # racy to stage; instead watch with no further activity and
+        # assert exactly one ADDED arrives within the window
+        events = list(
+            watch(substrate, name="once", timeout_seconds=1,
+                  stop_at_terminal=False)
+        )
+        assert [e.type for e in events].count("ADDED") == 1

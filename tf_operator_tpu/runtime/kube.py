@@ -342,6 +342,14 @@ class KubeSubstrate:
             thread.start()
             self._watch_threads.append(thread)
 
+    def unsubscribe(self, kind: str, callback: Callable) -> None:
+        """Remove a watch callback. The kind's watch thread is left
+        running (it is shared and cheap when idle); only the callback
+        stops receiving events."""
+        callbacks = self._subscribers.get(kind, [])
+        if callback in callbacks:
+            callbacks.remove(callback)
+
     def _watch_path(self, kind: str) -> str:
         if kind == "tfjob":
             return f"/apis/{GROUP_NAME}/{VERSION}/{PLURAL}?watch=true"

@@ -88,6 +88,7 @@ class Substrate(Protocol):
     # Events + watches
     def record_event(self, event: k8s.Event) -> None: ...
     def subscribe(self, kind: str, callback: WatchCallback) -> None: ...
+    def unsubscribe(self, kind: str, callback: WatchCallback) -> None: ...
 
 
 class InMemorySubstrate:
@@ -132,6 +133,14 @@ class InMemorySubstrate:
     def subscribe(self, kind: str, callback: WatchCallback) -> None:
         with self._lock:
             self._subscribers.setdefault(kind, []).append(callback)
+
+    def unsubscribe(self, kind: str, callback: WatchCallback) -> None:
+        """Remove a watch callback (finite watchers like sdk.watch must
+        detach or every past watcher keeps receiving events forever)."""
+        with self._lock:
+            callbacks = self._subscribers.get(kind, [])
+            if callback in callbacks:
+                callbacks.remove(callback)
 
     # -- TFJobs ------------------------------------------------------------
 

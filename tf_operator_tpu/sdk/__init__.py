@@ -1,3 +1,4 @@
 from .client import TFJobClient
+from .watch import WatchEvent, format_event, watch
 
-__all__ = ["TFJobClient"]
+__all__ = ["TFJobClient", "WatchEvent", "format_event", "watch"]

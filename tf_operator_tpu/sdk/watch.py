@@ -1,0 +1,135 @@
+"""Watch helper: stream TFJob status transitions.
+
+Analog of the reference SDK's watch module
+(sdk/python/kubeflow/tfjob/api/tf_job_watch.py): follow one job (or a
+whole namespace) and yield a row per status change until a terminal
+condition or timeout. Uses the substrate's watch subscription when
+available, falling back to polling — the same dual path the reference
+gets from the k8s watch API vs. polling in wait_for_condition.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import queue
+import time
+from typing import Iterator, Optional
+
+from ..api import types as t
+from ..runtime.substrate import NotFound, Substrate
+
+
+@dataclasses.dataclass
+class WatchEvent:
+    type: str  # ADDED | MODIFIED | DELETED
+    job: t.TFJob
+
+    @property
+    def state(self) -> str:
+        if self.job.status.conditions:
+            return self.job.status.conditions[-1].type.value
+        return ""
+
+
+def watch(
+    substrate: Substrate,
+    namespace: str = "default",
+    name: Optional[str] = None,
+    timeout_seconds: int = 600,
+    stop_at_terminal: bool = True,
+) -> Iterator[WatchEvent]:
+    """Yield WatchEvents for TFJobs in a namespace (optionally one job)
+    until timeout — or, with stop_at_terminal, until the watched job
+    reaches Succeeded/Failed (reference tf_job_watch.py behavior of
+    returning once the job finishes)."""
+    subscribe = getattr(substrate, "subscribe", None)
+    deadline = time.monotonic() + timeout_seconds
+    if subscribe is not None:
+        inbox: "queue.Queue" = queue.Queue()
+
+        def on_event(verb: str, job) -> None:
+            inbox.put((verb, job))
+
+        subscribe("tfjob", on_event)
+        try:
+            # initial LIST so pre-existing jobs produce a synthetic
+            # ADDED, mirroring informer initial-sync semantics; remember
+            # the exact versions yielded so a create that raced the
+            # subscribe isn't replayed from the queue as a duplicate
+            listed_versions = {}
+            for job in substrate.list_jobs(namespace):
+                if name is None or job.name == name:
+                    listed_versions[job.key()] = job.metadata.resource_version
+                    yield WatchEvent("ADDED", job)
+                    if stop_at_terminal and name is not None and job.is_finished():
+                        return
+            while True:
+                remaining = deadline - time.monotonic()
+                if remaining <= 0:
+                    return
+                try:
+                    verb, job = inbox.get(timeout=min(remaining, 1.0))
+                except queue.Empty:
+                    continue
+                if job.namespace != namespace:
+                    continue
+                if name is not None and job.name != name:
+                    continue
+                if (
+                    verb == "ADDED"
+                    and listed_versions.get(job.key())
+                    == job.metadata.resource_version
+                ):
+                    continue  # already yielded by the initial list
+                yield WatchEvent(verb, job)
+                if (
+                    stop_at_terminal
+                    and name is not None
+                    and (verb == "DELETED" or job.is_finished())
+                ):
+                    return
+        finally:
+            unsubscribe = getattr(substrate, "unsubscribe", None)
+            if unsubscribe is not None:
+                unsubscribe("tfjob", on_event)
+    else:  # poll fallback
+        last: dict = {}
+        while time.monotonic() < deadline:
+            try:
+                jobs = (
+                    [substrate.get_job(namespace, name)]
+                    if name is not None
+                    else substrate.list_jobs(namespace)
+                )
+            except NotFound:
+                jobs = []
+            present = {job.key() for job in jobs}
+            for key in list(last):
+                if key not in present:
+                    _, gone_job = last.pop(key)
+                    yield WatchEvent("DELETED", gone_job)
+                    if stop_at_terminal and name is not None:
+                        return
+            for job in jobs:
+                state = (
+                    job.status.conditions[-1].type.value
+                    if job.status.conditions
+                    else ""
+                )
+                key = job.key()
+                if key not in last or last[key][0] != state:
+                    verb = "ADDED" if key not in last else "MODIFIED"
+                    last[key] = (state, job)
+                    yield WatchEvent(verb, job)
+                    if stop_at_terminal and name is not None and job.is_finished():
+                        return
+                else:
+                    last[key] = (state, job)
+            time.sleep(0.2)
+
+
+def format_event(event: WatchEvent) -> str:
+    """One table row: NAME  STATE  TIME (reference tf_job_watch.py's
+    tabulated output)."""
+    started = event.job.status.start_time or ""
+    return f"{event.job.name:<24} {event.state or '-':<12} {started}"

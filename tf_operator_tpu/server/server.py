@@ -20,6 +20,7 @@ from ..controller import ReconcilerConfig, TFJobController
 from ..controller.ports import PortAllocator
 from ..runtime import InMemorySubstrate
 from ..utils import JsonFieldFormatter, version_info
+from ..utils.logger import TextFieldFormatter
 from .leader import FileLock, LeaderElector
 from .metrics import MonitoringServer, OperatorMetrics
 from .options import ServerOptions, parse_args
@@ -37,7 +38,7 @@ def setup_logging(json_format: bool) -> None:
         handler.setFormatter(JsonFormatter())
     else:
         handler.setFormatter(
-            logging.Formatter("%(asctime)s %(levelname)s %(name)s %(message)s")
+            TextFieldFormatter("%(asctime)s %(levelname)s %(name)s %(message)s")
         )
     root = logging.getLogger()
     root.handlers[:] = [handler]

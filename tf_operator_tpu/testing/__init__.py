@@ -1,4 +1,13 @@
 from ..runtime.process_kubelet import ProcessKubelet
+from .test_runner import TestCase, TestResult, TestSuiteReport, run, run_test
 from .workload_server import collect_env
 
-__all__ = ["ProcessKubelet", "collect_env"]
+__all__ = [
+    "ProcessKubelet",
+    "TestCase",
+    "TestResult",
+    "TestSuiteReport",
+    "run",
+    "run_test",
+    "collect_env",
+]

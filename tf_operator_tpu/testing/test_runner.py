@@ -1,0 +1,148 @@
+"""E2E test runner: reflective discovery + retries + JUnit XML.
+
+Port of the reference harness (py/kubeflow/tf_operator/test_runner.py:
+23-212): a TestCase base class records per-test outcome/time/failure;
+``run`` reflectively discovers ``test_*`` methods, retries flaky runs,
+and writes a JUnit XML report the CI dashboard can ingest (the
+reference uploads these to GCS for Prow; here the artifact dir is a
+plain path).
+"""
+
+from __future__ import annotations
+
+import time
+import traceback
+import xml.etree.ElementTree as ET
+from dataclasses import dataclass, field
+from pathlib import Path
+from typing import List, Optional, Type
+
+MAX_RETRIES = 3  # reference test_runner.py:21-23
+RETRY_BACKOFF_SECONDS = 1.0
+
+
+@dataclass
+class TestResult:
+    class_name: str
+    name: str
+    time_seconds: float = 0.0
+    failure: Optional[str] = None
+    attempts: int = 1
+
+    @property
+    def passed(self) -> bool:
+        return self.failure is None
+
+
+class TestCase:
+    """Subclass and define ``test_*`` methods. Optional ``setup()`` /
+    ``teardown()`` run around each test method (the reference's
+    per-class create/delete of its TFJob fixture)."""
+
+    def setup(self) -> None:  # pragma: no cover - default no-op
+        pass
+
+    def teardown(self) -> None:  # pragma: no cover - default no-op
+        pass
+
+
+@dataclass
+class TestSuiteReport:
+    name: str
+    results: List[TestResult] = field(default_factory=list)
+
+    @property
+    def failures(self) -> int:
+        return sum(1 for r in self.results if not r.passed)
+
+    @property
+    def total_time(self) -> float:
+        return sum(r.time_seconds for r in self.results)
+
+    def to_junit_xml(self) -> str:
+        suite = ET.Element(
+            "testsuite",
+            name=self.name,
+            tests=str(len(self.results)),
+            failures=str(self.failures),
+            time=f"{self.total_time:.3f}",
+        )
+        for result in self.results:
+            case = ET.SubElement(
+                suite,
+                "testcase",
+                classname=result.class_name,
+                name=result.name,
+                time=f"{result.time_seconds:.3f}",
+            )
+            if result.failure is not None:
+                failure = ET.SubElement(case, "failure", message="test failed")
+                failure.text = result.failure
+        return ET.tostring(suite, encoding="unicode")
+
+    def write(self, artifacts_dir: str) -> Path:
+        """junit_{suite}.xml in the artifacts dir (reference
+        test_runner.py:78-82 writes junit_* for the Prow dashboard)."""
+        path = Path(artifacts_dir) / f"junit_{self.name}.xml"
+        path.parent.mkdir(parents=True, exist_ok=True)
+        path.write_text('<?xml version="1.0"?>' + self.to_junit_xml())
+        return path
+
+
+def discover(test_class: Type[TestCase]) -> List[str]:
+    """Reflectively list test_* methods (reference test_runner.py:176-
+    190 uses dir() + startswith filtering)."""
+    return sorted(
+        name
+        for name in dir(test_class)
+        if name.startswith("test_") and callable(getattr(test_class, name))
+    )
+
+
+def run_test(
+    test_class: Type[TestCase],
+    method_name: str,
+    max_retries: int = MAX_RETRIES,
+    backoff_seconds: float = RETRY_BACKOFF_SECONDS,
+) -> TestResult:
+    """Run one test with retries; only the last attempt's failure is
+    reported (reference retries flakes before declaring failure)."""
+    result = TestResult(class_name=test_class.__name__, name=method_name)
+    start = time.monotonic()
+    for attempt in range(1, max_retries + 1):
+        result.attempts = attempt
+        instance = test_class()
+        try:
+            instance.setup()
+            try:
+                getattr(instance, method_name)()
+            finally:
+                instance.teardown()
+        except Exception:
+            result.failure = traceback.format_exc()
+            if attempt < max_retries:
+                time.sleep(backoff_seconds)
+                continue
+        else:
+            result.failure = None
+        break
+    result.time_seconds = time.monotonic() - start
+    return result
+
+
+def run(
+    test_class: Type[TestCase],
+    artifacts_dir: Optional[str] = None,
+    max_retries: int = MAX_RETRIES,
+    backoff_seconds: float = RETRY_BACKOFF_SECONDS,
+) -> TestSuiteReport:
+    """Run every test_* method of a TestCase class, optionally writing
+    the JUnit report (the reference's main(), test_runner.py:176-209)."""
+    report = TestSuiteReport(name=test_class.__name__)
+    for method_name in discover(test_class):
+        report.results.append(
+            run_test(test_class, method_name, max_retries, backoff_seconds)
+        )
+    if artifacts_dir is not None:
+        report.write(artifacts_dir)
+    return report

@@ -7,6 +7,7 @@ pkg/util/k8sutil/k8sutil.go and pkg/version/version.go (SURVEY.md #19,
 
 from .logger import (
     JsonFieldFormatter,
+    TextFieldFormatter,
     logger_for_job,
     logger_for_key,
     logger_for_pod,
@@ -17,6 +18,7 @@ from .version import VERSION, version_info
 
 __all__ = [
     "JsonFieldFormatter",
+    "TextFieldFormatter",
     "logger_for_job",
     "logger_for_key",
     "logger_for_pod",

@@ -17,7 +17,8 @@ from typing import Any, Dict, Optional
 
 class FieldsAdapter(logging.LoggerAdapter):
     """LoggerAdapter that threads a structured ``fields`` dict through
-    ``record.fields`` and prefixes plain-text output with the fields."""
+    ``record.fields``; pair with JsonFieldFormatter or
+    TextFieldFormatter so the fields reach the output."""
 
     def __init__(self, logger: logging.Logger, fields: Dict[str, Any]) -> None:
         super().__init__(logger, {"fields": fields})
@@ -54,6 +55,20 @@ class JsonFieldFormatter(logging.Formatter):
         if record.exc_info:
             entry["exception"] = self.formatException(record.exc_info)
         return json.dumps(entry)
+
+
+class TextFieldFormatter(logging.Formatter):
+    """Plain-text formatter that appends structured fields as
+    ``key=value`` pairs, so per-job identity survives outside JSON mode
+    (the reference's logrus text formatter does the same)."""
+
+    def format(self, record: logging.LogRecord) -> str:
+        line = super().format(record)
+        fields = getattr(record, "fields", None)
+        if fields:
+            rendered = " ".join(f"{k}={v}" for k, v in fields.items())
+            line = f"{line} [{rendered}]"
+        return line
 
 
 _base = logging.getLogger("tf_operator_tpu")

@@ -14,10 +14,25 @@ VERSION = "1.0.0"
 
 
 def git_sha() -> str:
+    package_dir = Path(__file__).resolve().parent
     try:
+        top = subprocess.run(
+            ["git", "rev-parse", "--show-toplevel"],
+            cwd=package_dir,
+            capture_output=True,
+            text=True,
+            timeout=5,
+        )
+        if top.returncode != 0:
+            return "unknown"
+        # only trust a repo that actually contains this package as a
+        # tracked source tree — a pip-installed copy nested under some
+        # unrelated checkout must not report that checkout's SHA
+        if not (Path(top.stdout.strip()) / "tf_operator_tpu").is_dir():
+            return "unknown"
         out = subprocess.run(
             ["git", "rev-parse", "--short", "HEAD"],
-            cwd=Path(__file__).resolve().parent,
+            cwd=package_dir,
             capture_output=True,
             text=True,
             timeout=5,
