@@ -1,0 +1,128 @@
+"""Schema generation from the typed model (reference analog:
+openapi_generated.go + hack/python-sdk swagger pipeline).
+
+Pins: the committed CRD YAML is exactly what the generator emits (the
+"zz_generated is up to date" check), every example manifest validates
+against the generated schema, and the schema checker rejects the
+malformed-manifest classes the serde layer also rejects.
+"""
+
+from pathlib import Path
+
+import pytest
+import yaml
+
+from tf_operator_tpu.api import k8s
+from tf_operator_tpu.api.openapi import (
+    SchemaError,
+    check_schema,
+    crd_yaml,
+    generate_crd,
+    schema_for,
+    spec_schema,
+)
+from tf_operator_tpu.api.types import ReplicaSpec, ReplicaType, RestartPolicy, TFJob
+
+REPO = Path(__file__).resolve().parent.parent
+
+
+class TestSchemaFor:
+    def test_scalars_and_enum(self):
+        assert schema_for(int) == {"type": "integer"}
+        assert schema_for(str) == {"type": "string"}
+        enum_schema = schema_for(RestartPolicy)
+        assert enum_schema["type"] == "string"
+        assert "ExitCode" in enum_schema["enum"]
+
+    def test_dataclass_preserves_unknown(self):
+        schema = schema_for(ReplicaSpec)
+        assert schema["x-kubernetes-preserve-unknown-fields"] is True
+        assert schema["properties"]["replicas"] == {"type": "integer"}
+        assert schema["properties"]["tpuAccelerator"] == {"type": "string"}
+
+    def test_container_list(self):
+        schema = schema_for(k8s.PodSpec)
+        containers = schema["properties"]["containers"]
+        assert containers["type"] == "array"
+        assert containers["items"]["properties"]["image"] == {"type": "string"}
+
+
+class TestSpecSchema:
+    def test_run_policy_inlined_flat(self):
+        schema = spec_schema()
+        # wire format: policy fields live directly under .spec
+        assert "cleanPodPolicy" in schema["properties"]
+        assert "backoffLimit" in schema["properties"]
+        assert "runPolicy" not in schema["properties"]
+
+    def test_all_replica_roles_present(self):
+        schema = spec_schema()
+        roles = schema["properties"]["tfReplicaSpecs"]["properties"]
+        for rt in ReplicaType:
+            assert rt.value in roles
+
+
+class TestCrdPinned:
+    def test_committed_crd_matches_generator(self):
+        committed = (REPO / "examples/crd/tfjob-crd.yaml").read_text()
+        assert committed == crd_yaml(), (
+            "examples/crd/tfjob-crd.yaml is stale; regenerate with "
+            "python -m tf_operator_tpu.api.openapi > examples/crd/tfjob-crd.yaml"
+        )
+
+    def test_crd_loads_as_yaml_without_anchors(self):
+        text = (REPO / "examples/crd/tfjob-crd.yaml").read_text()
+        assert "&id" not in text
+        crd = yaml.safe_load(text)
+        assert crd["metadata"]["name"] == "tfjobs.kubeflow.org"
+        version = crd["spec"]["versions"][0]
+        assert version["subresources"] == {"status": {}}
+
+
+def _job_spec_schema():
+    crd = generate_crd()
+    root = crd["spec"]["versions"][0]["schema"]["openAPIV3Schema"]
+    return root["properties"]["spec"]
+
+
+class TestCheckSchema:
+    @pytest.mark.parametrize(
+        "path",
+        sorted((REPO / "examples/v1").glob("*.yaml")),
+        ids=lambda p: p.name,
+    )
+    def test_every_example_manifest_validates(self, path):
+        manifest = yaml.safe_load(path.read_text())
+        if manifest.get("kind") != "TFJob":
+            pytest.skip("non-TFJob manifest (e.g. PVC)")
+        check_schema(manifest["spec"], _job_spec_schema())
+        # and the typed model also accepts it (serde agreement)
+        TFJob.from_dict(manifest)
+
+    def test_wrong_scalar_type_rejected(self):
+        with pytest.raises(SchemaError, match="backoffLimit"):
+            check_schema({"backoffLimit": "three"}, _job_spec_schema())
+
+    def test_bool_is_not_integer(self):
+        with pytest.raises(SchemaError):
+            check_schema({"backoffLimit": True}, _job_spec_schema())
+
+    def test_bad_enum_rejected(self):
+        spec = {
+            "tfReplicaSpecs": {
+                "Worker": {"restartPolicy": "Sometimes"}
+            }
+        }
+        with pytest.raises(SchemaError, match="restartPolicy"):
+            check_schema(spec, _job_spec_schema())
+
+    def test_unknown_keys_tolerated_where_extra_exists(self):
+        spec = {
+            "tfReplicaSpecs": {
+                "Worker": {
+                    "replicas": 1,
+                    "someFutureField": {"nested": True},
+                }
+            }
+        }
+        check_schema(spec, _job_spec_schema())  # must not raise

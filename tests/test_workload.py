@@ -208,3 +208,46 @@ class TestTraining:
         orig = jax.tree_util.tree_leaves(state.params)[0]
         back = jax.tree_util.tree_leaves(restored.params)[0]
         np.testing.assert_allclose(np.asarray(orig), np.asarray(back))
+
+
+class TestSmoke:
+    def test_every_device_participates(self):
+        """train.smoke's psum must see all 8 virtual devices."""
+        from tf_operator_tpu.train.smoke import run_smoke
+
+        assert run_smoke(matrix_size=16)
+
+
+class TestSummaries:
+    def test_jsonl_scalars(self, tmp_path):
+        import json
+
+        from tf_operator_tpu.train.summaries import SummaryWriter
+
+        with SummaryWriter(str(tmp_path / "logs")) as writer:
+            writer.scalars(10, {"loss": 0.5, "accuracy": 0.9})
+            writer.scalars(20, {"loss": 0.25})
+        lines = [
+            json.loads(line)
+            for line in (tmp_path / "logs" / "metrics.jsonl").read_text().splitlines()
+        ]
+        assert lines[0]["step"] == 10 and lines[0]["loss"] == 0.5
+        assert lines[1]["step"] == 20
+
+    def test_disabled_writer_writes_nothing(self, tmp_path):
+        from tf_operator_tpu.train.summaries import maybe_writer
+
+        target = tmp_path / "nothing"
+        with maybe_writer(str(target), process_id=1) as writer:
+            writer.scalars(1, {"loss": 1.0})
+        assert not target.exists()
+
+    def test_mnist_cli_writes_summaries(self, tmp_path):
+        from tf_operator_tpu.train import mnist
+
+        code = mnist.main([
+            "--steps", "4", "--batch-size", "8", "--log-every", "2",
+            "--summary-dir", str(tmp_path / "s"),
+        ])
+        assert code == 0
+        assert (tmp_path / "s" / "metrics.jsonl").exists()

@@ -25,6 +25,12 @@ def main(argv=None) -> int:
     parser.add_argument("--learning-rate", type=float, default=1e-3)
     parser.add_argument("--target-accuracy", type=float, default=None)
     parser.add_argument("--checkpoint-dir", default=None)
+    parser.add_argument(
+        "--summary-dir", default=None,
+        help="Write scalar summaries here (metrics.jsonl always; "
+        "TensorBoard events when torch.utils.tensorboard is available) "
+        "— the mnist_with_summaries analog",
+    )
     parser.add_argument("--log-every", type=int, default=50)
     args = parser.parse_args(argv)
     logging.basicConfig(level=logging.INFO, stream=sys.stderr)
@@ -71,10 +77,14 @@ def main(argv=None) -> int:
             key, sub = jax.random.split(key)
             yield mnist_lib.synthetic_batch(sub, args.batch_size)
 
-    state, metrics = trainer.fit(
-        state, batches(), steps=args.steps, log_every=args.log_every,
-        checkpoint_every=100 if args.checkpoint_dir else None,
-    )
+    from .summaries import maybe_writer
+
+    with maybe_writer(args.summary_dir, proc.process_id) as writer:
+        state, metrics = trainer.fit(
+            state, batches(), steps=args.steps, log_every=args.log_every,
+            checkpoint_every=100 if args.checkpoint_dir else None,
+            metrics_callback=writer.scalars,
+        )
     logger.info("final: %s", metrics)
     if args.checkpoint_dir:
         trainer.save(state)

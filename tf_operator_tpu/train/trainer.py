@@ -231,7 +231,11 @@ class Trainer:
         steps: int,
         log_every: int = 50,
         checkpoint_every: Optional[int] = None,
+        metrics_callback=None,
     ) -> Tuple[TrainState, Dict[str, float]]:
+        """metrics_callback(step, metrics_dict) fires on every logging
+        interval — the hook summary writers attach to (the reference's
+        mnist_with_summaries example plays this role with TF summaries)."""
         last_metrics: Dict[str, float] = {}
         start = time.perf_counter()
         for i in range(steps):
@@ -244,11 +248,14 @@ class Trainer:
                     k: float(v) for k, v in metrics.items()
                 }
                 elapsed = time.perf_counter() - start
+                last_metrics["steps_per_sec"] = (i + 1) / max(elapsed, 1e-9)
                 logger.info(
                     "step %d loss=%.4f (%.1f steps/s)",
                     int(state.step), last_metrics.get("loss", float("nan")),
-                    (i + 1) / max(elapsed, 1e-9),
+                    last_metrics["steps_per_sec"],
                 )
+                if metrics_callback is not None:
+                    metrics_callback(int(state.step), dict(last_metrics))
         return state, last_metrics
 
     # -- checkpointing -----------------------------------------------------
