@@ -36,7 +36,7 @@ def main() -> None:
         model = resnet_lib.ResNet50(num_classes=1000)
         per_chip_batch = 128
         image_size = 224
-        steps = 20
+        steps = 50
     else:  # CPU smoke fallback: tiny shapes, same code path
         model = resnet_lib.ResNet(
             stage_sizes=(1, 1), num_classes=10, width=8, dtype=jnp.float32

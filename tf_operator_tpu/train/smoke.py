@@ -47,13 +47,16 @@ def run_smoke(matrix_size: int = 256) -> bool:
             ).astype(jnp.float32)
             # trace(ones@ones) = size*size; normalize to 1 per device
             unit = product_trace / float(matrix_size * matrix_size)
-            return jax.lax.psum(shard * unit, "dp")
+            # replicated scalar out (P()): in multi-host runs a sharded
+            # output would not be fully addressable and float() on it
+            # raises — every process must get the whole answer
+            return jnp.sum(jax.lax.psum(shard * unit, "dp"))
 
         return shard_map(
-            body, mesh=mesh, in_specs=P("dp"), out_specs=P("dp")
+            body, mesh=mesh, in_specs=P("dp"), out_specs=P()
         )(x)
 
-    total = float(all_contribs(ranks)[0])
+    total = float(all_contribs(ranks))
     expected = n * (n + 1) / 2
     ok = abs(total - expected) < 1e-3
     logger.info(

@@ -237,18 +237,26 @@ class Trainer:
         interval — the hook summary writers attach to (the reference's
         mnist_with_summaries example plays this role with TF summaries)."""
         last_metrics: Dict[str, float] = {}
-        start = time.perf_counter()
+        interval_start = time.perf_counter()
+        interval_steps = 0
         for i in range(steps):
             batch = self.place_batch(next(batches))
             state, metrics = self.step(state, batch)
+            interval_steps += 1
             if checkpoint_every and (i + 1) % checkpoint_every == 0:
                 self.save(state)
             if (i + 1) % log_every == 0 or i + 1 == steps:
                 last_metrics = {
                     k: float(v) for k, v in metrics.items()
                 }
-                elapsed = time.perf_counter() - start
-                last_metrics["steps_per_sec"] = (i + 1) / max(elapsed, 1e-9)
+                now = time.perf_counter()
+                # per-interval rate, not a cumulative mean: the first
+                # point absorbs the jit compile, later points must show
+                # the true current rate so mid-run regressions surface
+                last_metrics["steps_per_sec"] = interval_steps / max(
+                    now - interval_start, 1e-9
+                )
+                interval_start, interval_steps = now, 0
                 logger.info(
                     "step %d loss=%.4f (%.1f steps/s)",
                     int(state.step), last_metrics.get("loss", float("nan")),
