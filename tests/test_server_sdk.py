@@ -271,3 +271,63 @@ class TestSDK:
             {"spec": {"tfReplicaSpecs": {"Worker": {"replicas": 4}}}},
         )
         assert client.get("patchy").num_replicas(t.ReplicaType.WORKER) == 4
+
+
+class TestDebugEndpoints:
+    """pprof-analog endpoints on the monitoring port (reference serves
+    pprof + promhttp together, main.go:21,39-50)."""
+
+    def _get(self, port, path):
+        import urllib.request
+
+        with urllib.request.urlopen(f"http://127.0.0.1:{port}{path}") as resp:
+            return resp.status, resp.read()
+
+    def test_debug_threads_and_vars(self):
+        import json
+
+        from tf_operator_tpu.server.metrics import MonitoringServer, OperatorMetrics
+
+        metrics = OperatorMetrics()
+        metrics.created()
+        server = MonitoringServer(metrics, port=0)
+        port = server.start()
+        try:
+            status, body = self._get(port, "/debug/threads")
+            assert status == 200
+            assert b"thread" in body and b"serve_forever" in body
+            status, body = self._get(port, "/debug/vars")
+            assert status == 200
+            data = json.loads(body)
+            assert data["counters"]["jobs_created_total"] == 1
+            assert data["uptime_seconds"] >= 0
+            assert data["threads"] >= 1
+        finally:
+            server.stop()
+
+
+class TestProfilerHook:
+    def test_fit_writes_xla_trace(self, tmp_path):
+        import jax
+        import optax
+
+        from tf_operator_tpu.models import mnist as mnist_lib
+        from tf_operator_tpu.parallel.mesh import build_mesh
+        from tf_operator_tpu.parallel.sharding import REPLICATED_RULES
+        from tf_operator_tpu.train import Trainer, classification_task
+
+        model = mnist_lib.MnistCNN()
+        trainer = Trainer(
+            model, classification_task(model), optax.adam(1e-3),
+            mesh=build_mesh(), rules=REPLICATED_RULES,
+        )
+        rng = jax.random.PRNGKey(0)
+        batch = trainer.place_batch(mnist_lib.synthetic_batch(rng, 8))
+        state = trainer.init(rng, batch)
+        trace_dir = tmp_path / "trace"
+        trainer.fit(
+            state, iter(lambda: mnist_lib.synthetic_batch(rng, 8), None),
+            steps=6, log_every=10, profile_dir=str(trace_dir),
+        )
+        produced = list(trace_dir.rglob("*"))
+        assert any(p.is_file() for p in produced), "no trace files written"

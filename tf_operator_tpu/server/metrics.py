@@ -10,6 +10,7 @@ with no client-library dependency: counters render straight to the
 from __future__ import annotations
 
 import threading
+import time
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Dict, Optional, Tuple
 
@@ -64,6 +65,11 @@ class OperatorMetrics:
                 return self._counters[name]
             return self._gauges[name]
 
+    def snapshot(self) -> Tuple[Dict[str, float], Dict[str, float]]:
+        """Consistent (counters, gauges) copy for debug/introspection."""
+        with self._lock:
+            return dict(self._counters), dict(self._gauges)
+
     def render(self) -> str:
         lines = []
         with self._lock:
@@ -80,17 +86,52 @@ class OperatorMetrics:
         return "\n".join(lines) + "\n"
 
 
+def _dump_threads() -> str:
+    """All live thread stacks — the goroutine-dump half of Go pprof
+    (reference serves pprof via blank import, main.go:21)."""
+    import sys
+    import traceback
+
+    names = {t.ident: t.name for t in threading.enumerate()}
+    chunks = []
+    for ident, frame in sys._current_frames().items():
+        chunks.append(f"--- thread {names.get(ident, '?')} ({ident}) ---")
+        chunks.extend(line.rstrip() for line in traceback.format_stack(frame))
+    return "\n".join(chunks) + "\n"
+
+
 class MonitoringServer:
-    """/metrics + /healthz endpoint (reference main.go:39-50)."""
+    """/metrics + /healthz + /debug/* endpoints (reference main.go:39-50
+    serves promhttp and pprof on the same monitoring port)."""
 
     def __init__(self, metrics: OperatorMetrics, port: int = 8443) -> None:
         self.metrics = metrics
         self.port = port
+        self.started_at = time.time()
         self._httpd: Optional[ThreadingHTTPServer] = None
         self._thread: Optional[threading.Thread] = None
 
+    def _debug_vars(self) -> bytes:
+        import json
+
+        from ..utils.version import VERSION, git_sha
+
+        counters, gauges = self.metrics.snapshot()
+        return json.dumps(
+            {
+                "version": VERSION,
+                "git_sha": git_sha(),
+                "uptime_seconds": round(time.time() - self.started_at, 1),
+                "threads": threading.active_count(),
+                "counters": counters,
+                "gauges": gauges,
+            },
+            indent=2,
+        ).encode()
+
     def start(self) -> int:
         metrics = self.metrics
+        server = self
 
         class Handler(BaseHTTPRequestHandler):
             def do_GET(self) -> None:  # noqa: N802
@@ -102,6 +143,14 @@ class MonitoringServer:
                     body = b"ok"
                     self.send_response(200)
                     self.send_header("Content-Type", "text/plain")
+                elif self.path == "/debug/threads":
+                    body = _dump_threads().encode()
+                    self.send_response(200)
+                    self.send_header("Content-Type", "text/plain")
+                elif self.path == "/debug/vars":
+                    body = server._debug_vars()
+                    self.send_response(200)
+                    self.send_header("Content-Type", "application/json")
                 else:
                     body = b"not found"
                     self.send_response(404)

@@ -34,6 +34,10 @@ def main(argv=None) -> int:
     parser.add_argument("--sp", type=int, default=1)
     parser.add_argument("--flash", action="store_true", help="pallas flash attention")
     parser.add_argument("--checkpoint-dir", default=None)
+    parser.add_argument(
+        "--profile-dir", default=None,
+        help="Capture an XLA/TPU profiler trace of steady-state steps",
+    )
     parser.add_argument("--log-every", type=int, default=20)
     args = parser.parse_args(argv)
     logging.basicConfig(level=logging.INFO, stream=sys.stderr)
@@ -88,12 +92,18 @@ def main(argv=None) -> int:
     state, metrics = trainer.step(state, trainer.place_batch(sample))
     float(metrics["loss"])
 
+    from .profiling import StepProfiler
+
+    profiler = StepProfiler(args.profile_dir, args.steps, window=(0, 5))
     start = time.perf_counter()
     for step in range(args.steps):
+        profiler.before_step(step)
         state, metrics = trainer.step(state, trainer.place_batch(sample))
+        profiler.after_step(step, drain=lambda: float(metrics["loss"]))
         if (step + 1) % args.log_every == 0:
             logger.info("step %d loss=%.4f", int(state.step), float(metrics["loss"]))
     loss = float(metrics["loss"])  # forces the chain
+    profiler.close()
     elapsed = time.perf_counter() - start
     tokens = args.batch_size * args.seq_len * args.steps
     n_chips = len(jax.devices())

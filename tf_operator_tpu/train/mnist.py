@@ -31,6 +31,11 @@ def main(argv=None) -> int:
         "TensorBoard events when torch.utils.tensorboard is available) "
         "— the mnist_with_summaries analog",
     )
+    parser.add_argument(
+        "--profile-dir", default=None,
+        help="Capture an XLA/TPU profiler trace of a few steady-state "
+        "steps to this directory (TensorBoard/Perfetto viewable)",
+    )
     parser.add_argument("--log-every", type=int, default=50)
     args = parser.parse_args(argv)
     logging.basicConfig(level=logging.INFO, stream=sys.stderr)
@@ -84,6 +89,7 @@ def main(argv=None) -> int:
             state, batches(), steps=args.steps, log_every=args.log_every,
             checkpoint_every=100 if args.checkpoint_dir else None,
             metrics_callback=writer.scalars,
+            profile_dir=args.profile_dir,
         )
     logger.info("final: %s", metrics)
     if args.checkpoint_dir:

@@ -1,0 +1,69 @@
+"""XLA/TPU profiler capture over a window of training steps.
+
+One implementation shared by Trainer.fit and the CLI timing loops so
+the start/stop discipline (skip the compile step, drain the device
+before stopping, always stop if the loop ends early) lives in one
+place — the workload-layer half of the reference's pprof-style
+self-profiling (SURVEY.md §5, reference main.go:21).
+"""
+
+from __future__ import annotations
+
+import logging
+from typing import Callable, Optional, Tuple
+
+logger = logging.getLogger("tf_operator_tpu.profiling")
+
+
+class StepProfiler:
+    """Captures [start, stop) steps of a loop into ``profile_dir``.
+
+    Usage:
+        profiler = StepProfiler(args.profile_dir, total_steps, (3, 8))
+        for i in range(total_steps):
+            profiler.before_step(i)
+            ... run step i ...
+            profiler.after_step(i, drain=lambda: float(loss))
+
+    A None/empty profile_dir makes every call a no-op.
+    """
+
+    def __init__(
+        self,
+        profile_dir: Optional[str],
+        total_steps: int,
+        window: Tuple[int, int] = (3, 8),
+    ) -> None:
+        self.profile_dir = profile_dir or None
+        self._active = False
+        if self.profile_dir is None or total_steps <= 0:
+            self.start_step = self.stop_after = -1
+            return
+        # clamp into the run: short runs still produce a trace
+        self.start_step = min(window[0], total_steps - 1)
+        self.stop_after = min(max(window[1], self.start_step + 1), total_steps)
+
+    def before_step(self, i: int) -> None:
+        if self.profile_dir is not None and i == self.start_step:
+            import jax
+
+            jax.profiler.start_trace(self.profile_dir)
+            self._active = True
+
+    def after_step(self, i: int, drain: Optional[Callable[[], object]] = None) -> None:
+        if self._active and i + 1 >= self.stop_after:
+            self._stop(drain)
+
+    def close(self, drain: Optional[Callable[[], object]] = None) -> None:
+        """Safety net for loops that end before the window does."""
+        if self._active:
+            self._stop(drain)
+
+    def _stop(self, drain) -> None:
+        import jax
+
+        if drain is not None:
+            drain()  # wait for in-flight device work so the trace is complete
+        jax.profiler.stop_trace()
+        self._active = False
+        logger.info("profiler trace written to %s", self.profile_dir)

@@ -24,6 +24,10 @@ def main(argv=None) -> int:
     parser.add_argument("--learning-rate", type=float, default=0.1)
     parser.add_argument("--small", action="store_true", help="tiny variant (CPU smoke)")
     parser.add_argument("--checkpoint-dir", default=None)
+    parser.add_argument(
+        "--profile-dir", default=None,
+        help="Capture an XLA/TPU profiler trace of steady-state steps",
+    )
     parser.add_argument("--log-every", type=int, default=20)
     args = parser.parse_args(argv)
     logging.basicConfig(level=logging.INFO, stream=sys.stderr)
@@ -71,14 +75,20 @@ def main(argv=None) -> int:
         if restored is not None:
             state = restored
 
+    from .profiling import StepProfiler
+
     state, metrics = trainer.step(state, batch)  # compile
     float(metrics["loss"])
+    profiler = StepProfiler(args.profile_dir, args.steps, window=(0, 5))
     start = time.perf_counter()
     for step in range(args.steps):
+        profiler.before_step(step)
         state, metrics = trainer.step(state, batch)
+        profiler.after_step(step, drain=lambda: float(metrics["loss"]))
         if (step + 1) % args.log_every == 0:
             logger.info("step %d loss=%.4f", int(state.step), float(metrics["loss"]))
     float(metrics["loss"])
+    profiler.close()
     elapsed = time.perf_counter() - start
     logger.info(
         "images/sec/chip: %.1f", global_batch * args.steps / elapsed / n_chips

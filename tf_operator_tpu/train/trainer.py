@@ -232,17 +232,35 @@ class Trainer:
         log_every: int = 50,
         checkpoint_every: Optional[int] = None,
         metrics_callback=None,
+        profile_dir: Optional[str] = None,
+        profile_window: Tuple[int, int] = (3, 8),
     ) -> Tuple[TrainState, Dict[str, float]]:
         """metrics_callback(step, metrics_dict) fires on every logging
         interval — the hook summary writers attach to (the reference's
-        mnist_with_summaries example plays this role with TF summaries)."""
+        mnist_with_summaries example plays this role with TF summaries).
+
+        profile_dir captures an XLA/TPU profiler trace (viewable in
+        TensorBoard or Perfetto) over profile_window's [start, stop)
+        steps — the workload-layer half of the reference's pprof-style
+        self-profiling (SURVEY.md §5, main.go:21), skipping the compile
+        step so the trace shows steady-state device time."""
+        from .profiling import StepProfiler
+
         last_metrics: Dict[str, float] = {}
         interval_start = time.perf_counter()
         interval_steps = 0
+        profiler = StepProfiler(profile_dir, steps, profile_window)
         for i in range(steps):
+            profiler.before_step(i)
             batch = self.place_batch(next(batches))
             state, metrics = self.step(state, batch)
             interval_steps += 1
+            profiler.after_step(
+                i,
+                drain=lambda: jax.tree_util.tree_map(
+                    lambda x: x.block_until_ready(), metrics
+                ),
+            )
             if checkpoint_every and (i + 1) % checkpoint_every == 0:
                 self.save(state)
             if (i + 1) % log_every == 0 or i + 1 == steps:
@@ -264,6 +282,7 @@ class Trainer:
                 )
                 if metrics_callback is not None:
                     metrics_callback(int(state.step), dict(last_metrics))
+        profiler.close()
         return state, last_metrics
 
     # -- checkpointing -----------------------------------------------------

@@ -6,6 +6,7 @@ the git SHA is resolved lazily from the repo when available.
 
 from __future__ import annotations
 
+import functools
 import subprocess
 import sys
 from pathlib import Path
@@ -13,6 +14,7 @@ from pathlib import Path
 VERSION = "1.0.0"
 
 
+@functools.lru_cache(maxsize=1)
 def git_sha() -> str:
     package_dir = Path(__file__).resolve().parent
     try:
