@@ -290,7 +290,7 @@ class TestDebugEndpoints:
 
         metrics = OperatorMetrics()
         metrics.created()
-        server = MonitoringServer(metrics, port=0)
+        server = MonitoringServer(metrics, port=0, enable_debug=True)
         port = server.start()
         try:
             status, body = self._get(port, "/debug/threads")
@@ -331,3 +331,19 @@ class TestProfilerHook:
         )
         produced = list(trace_dir.rglob("*"))
         assert any(p.is_file() for p in produced), "no trace files written"
+
+def test_debug_endpoints_off_by_default():
+    import urllib.error
+
+    server = MonitoringServer(OperatorMetrics(), port=0)
+    port = server.start()
+    try:
+        try:
+            urllib.request.urlopen(f"http://127.0.0.1:{port}/debug/threads")
+            assert False, "should 404 when not enabled"
+        except urllib.error.HTTPError as err:
+            assert err.code == 404
+        with urllib.request.urlopen(f"http://127.0.0.1:{port}/metrics") as resp:
+            assert resp.status == 200
+    finally:
+        server.stop()

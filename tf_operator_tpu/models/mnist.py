@@ -9,6 +9,8 @@ all-reduce over ICI.
 
 from __future__ import annotations
 
+import functools
+
 import jax
 import jax.numpy as jnp
 from flax import linen as nn
@@ -35,9 +37,30 @@ class MnistCNN(nn.Module):
         return nn.Dense(10, dtype=jnp.float32)(x)
 
 
-def synthetic_batch(rng: jax.Array, batch_size: int):
-    """Deterministic synthetic MNIST-shaped data for tests/benchmarks."""
-    image_rng, label_rng = jax.random.split(rng)
-    images = jax.random.normal(image_rng, (batch_size, 28, 28, 1), jnp.float32)
+@functools.lru_cache(maxsize=1)
+def _digit_prototypes() -> jax.Array:
+    """Ten fixed low-frequency 28x28 'digit' prototypes, deterministic
+    across processes. Generated as 7x7 noise upsampled to 28x28 so each
+    class has a smooth, translatable shape a CNN can generalize over."""
+    coarse = jax.random.normal(jax.random.PRNGKey(42), (10, 7, 7, 1))
+    return jax.image.resize(coarse, (10, 28, 28, 1), method="cubic")
+
+
+def synthetic_batch(rng: jax.Array, batch_size: int, noise: float = 0.3):
+    """Learnable synthetic MNIST stand-in (no dataset download needed —
+    this image has zero egress): each sample is its class prototype,
+    randomly translated up to ±3 px and corrupted with Gaussian noise.
+    Fresh batches are new samples from the same distribution, so
+    accuracy measures generalization, and the BASELINE "dist-mnist to
+    99%" target is reachable in a few hundred steps."""
+    label_rng, shift_rng, noise_rng = jax.random.split(rng, 3)
     labels = jax.random.randint(label_rng, (batch_size,), 0, 10)
+    images = _digit_prototypes()[labels]
+    shifts = jax.random.randint(shift_rng, (batch_size, 2), -3, 4)
+
+    def translate(image, shift):
+        return jnp.roll(image, shift, axis=(0, 1))
+
+    images = jax.vmap(translate)(images, shifts)
+    images = images + noise * jax.random.normal(noise_rng, images.shape)
     return {"image": images, "label": labels}

@@ -104,9 +104,18 @@ class MonitoringServer:
     """/metrics + /healthz + /debug/* endpoints (reference main.go:39-50
     serves promhttp and pprof on the same monitoring port)."""
 
-    def __init__(self, metrics: OperatorMetrics, port: int = 8443) -> None:
+    def __init__(
+        self,
+        metrics: OperatorMetrics,
+        port: int = 8443,
+        enable_debug: bool = False,
+    ) -> None:
+        # /debug/* is opt-in: thread stacks expose code structure and the
+        # monitoring port binds 0.0.0.0 (the Go reference likewise only
+        # exposes pprof when the operator is deployed with it enabled)
         self.metrics = metrics
         self.port = port
+        self.enable_debug = enable_debug
         self.started_at = time.time()
         self._httpd: Optional[ThreadingHTTPServer] = None
         self._thread: Optional[threading.Thread] = None
@@ -143,11 +152,11 @@ class MonitoringServer:
                     body = b"ok"
                     self.send_response(200)
                     self.send_header("Content-Type", "text/plain")
-                elif self.path == "/debug/threads":
+                elif self.path == "/debug/threads" and server.enable_debug:
                     body = _dump_threads().encode()
                     self.send_response(200)
                     self.send_header("Content-Type", "text/plain")
-                elif self.path == "/debug/vars":
+                elif self.path == "/debug/vars" and server.enable_debug:
                     body = server._debug_vars()
                     self.send_response(200)
                     self.send_header("Content-Type", "application/json")

@@ -14,6 +14,7 @@ class ServerOptions:
     threadiness: int = 1
     resync_period: float = 30.0
     monitoring_port: int = 8443
+    enable_debug_endpoints: bool = False
     json_log_format: bool = True
     enable_gang_scheduling: bool = False
     gang_scheduler_name: str = "volcano"
@@ -41,6 +42,11 @@ def parse_args(argv: Optional[List[str]] = None) -> ServerOptions:
         help="Seconds between level-trigger resyncs",
     )
     parser.add_argument("--monitoring-port", type=int, default=opts.monitoring_port)
+    parser.add_argument(
+        "--enable-debug-endpoints", action="store_true",
+        default=opts.enable_debug_endpoints,
+        help="Serve /debug/threads and /debug/vars on the monitoring port",
+    )
     parser.add_argument(
         "--json-log-format", action=argparse.BooleanOptionalAction,
         default=opts.json_log_format,
@@ -80,6 +86,7 @@ def parse_args(argv: Optional[List[str]] = None) -> ServerOptions:
         threadiness=ns.threadiness,
         resync_period=ns.resync_period,
         monitoring_port=ns.monitoring_port,
+        enable_debug_endpoints=ns.enable_debug_endpoints,
         json_log_format=ns.json_log_format,
         enable_gang_scheduling=ns.enable_gang_scheduling,
         gang_scheduler_name=ns.gang_scheduler_name,

@@ -78,7 +78,11 @@ class OperatorServer:
         else:
             logger.info("native runtime core unavailable; pure-Python fallback")
         self.metrics = OperatorMetrics()
-        self.monitoring = MonitoringServer(self.metrics, options.monitoring_port)
+        self.monitoring = MonitoringServer(
+            self.metrics,
+            options.monitoring_port,
+            enable_debug=options.enable_debug_endpoints,
+        )
         self.substrate = substrate if substrate is not None else build_substrate(options)
         self.controller = TFJobController(
             self.substrate,

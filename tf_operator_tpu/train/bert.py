@@ -96,14 +96,16 @@ def main(argv=None) -> int:
 
     profiler = StepProfiler(args.profile_dir, args.steps, window=(0, 5))
     start = time.perf_counter()
-    for step in range(args.steps):
-        profiler.before_step(step)
-        state, metrics = trainer.step(state, trainer.place_batch(sample))
-        profiler.after_step(step, drain=lambda: float(metrics["loss"]))
-        if (step + 1) % args.log_every == 0:
-            logger.info("step %d loss=%.4f", int(state.step), float(metrics["loss"]))
-    loss = float(metrics["loss"])  # forces the chain
-    profiler.close()
+    try:
+        for step in range(args.steps):
+            profiler.before_step(step)
+            state, metrics = trainer.step(state, trainer.place_batch(sample))
+            profiler.after_step(step, drain=lambda: float(metrics["loss"]))
+            if (step + 1) % args.log_every == 0:
+                logger.info("step %d loss=%.4f", int(state.step), float(metrics["loss"]))
+        loss = float(metrics["loss"])  # forces the chain
+    finally:
+        profiler.close()
     elapsed = time.perf_counter() - start
     tokens = args.batch_size * args.seq_len * args.steps
     n_chips = len(jax.devices())

@@ -81,14 +81,16 @@ def main(argv=None) -> int:
     float(metrics["loss"])
     profiler = StepProfiler(args.profile_dir, args.steps, window=(0, 5))
     start = time.perf_counter()
-    for step in range(args.steps):
-        profiler.before_step(step)
-        state, metrics = trainer.step(state, batch)
-        profiler.after_step(step, drain=lambda: float(metrics["loss"]))
-        if (step + 1) % args.log_every == 0:
-            logger.info("step %d loss=%.4f", int(state.step), float(metrics["loss"]))
-    float(metrics["loss"])
-    profiler.close()
+    try:
+        for step in range(args.steps):
+            profiler.before_step(step)
+            state, metrics = trainer.step(state, batch)
+            profiler.after_step(step, drain=lambda: float(metrics["loss"]))
+            if (step + 1) % args.log_every == 0:
+                logger.info("step %d loss=%.4f", int(state.step), float(metrics["loss"]))
+        float(metrics["loss"])
+    finally:
+        profiler.close()
     elapsed = time.perf_counter() - start
     logger.info(
         "images/sec/chip: %.1f", global_batch * args.steps / elapsed / n_chips

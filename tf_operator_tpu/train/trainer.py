@@ -250,39 +250,45 @@ class Trainer:
         interval_start = time.perf_counter()
         interval_steps = 0
         profiler = StepProfiler(profile_dir, steps, profile_window)
-        for i in range(steps):
-            profiler.before_step(i)
-            batch = self.place_batch(next(batches))
-            state, metrics = self.step(state, batch)
-            interval_steps += 1
-            profiler.after_step(
-                i,
-                drain=lambda: jax.tree_util.tree_map(
-                    lambda x: x.block_until_ready(), metrics
-                ),
-            )
-            if checkpoint_every and (i + 1) % checkpoint_every == 0:
-                self.save(state)
-            if (i + 1) % log_every == 0 or i + 1 == steps:
-                last_metrics = {
-                    k: float(v) for k, v in metrics.items()
-                }
-                now = time.perf_counter()
-                # per-interval rate, not a cumulative mean: the first
-                # point absorbs the jit compile, later points must show
-                # the true current rate so mid-run regressions surface
-                last_metrics["steps_per_sec"] = interval_steps / max(
-                    now - interval_start, 1e-9
+        try:
+            for i in range(steps):
+                profiler.before_step(i)
+                batch = self.place_batch(next(batches))
+                state, metrics = self.step(state, batch)
+                interval_steps += 1
+                profiler.after_step(
+                    i,
+                    drain=lambda: jax.tree_util.tree_map(
+                        lambda x: x.block_until_ready(), metrics
+                    ),
                 )
-                interval_start, interval_steps = now, 0
-                logger.info(
-                    "step %d loss=%.4f (%.1f steps/s)",
-                    int(state.step), last_metrics.get("loss", float("nan")),
-                    last_metrics["steps_per_sec"],
-                )
-                if metrics_callback is not None:
-                    metrics_callback(int(state.step), dict(last_metrics))
-        profiler.close()
+                if checkpoint_every and (i + 1) % checkpoint_every == 0:
+                    self.save(state)
+                if (i + 1) % log_every == 0 or i + 1 == steps:
+                    last_metrics = {
+                        k: float(v) for k, v in metrics.items()
+                    }
+                    now = time.perf_counter()
+                    # per-interval rate, not a cumulative mean: the
+                    # first point absorbs the jit compile, later points
+                    # must show the true current rate so mid-run
+                    # regressions surface
+                    last_metrics["steps_per_sec"] = interval_steps / max(
+                        now - interval_start, 1e-9
+                    )
+                    interval_start, interval_steps = now, 0
+                    logger.info(
+                        "step %d loss=%.4f (%.1f steps/s)",
+                        int(state.step), last_metrics.get("loss", float("nan")),
+                        last_metrics["steps_per_sec"],
+                    )
+                    if metrics_callback is not None:
+                        metrics_callback(int(state.step), dict(last_metrics))
+        finally:
+            # an exception mid-loop must still stop the (process-global)
+            # jax trace, or every later profiled run in this process
+            # fails with "profiler is already active"
+            profiler.close()
         return state, last_metrics
 
     # -- checkpointing -----------------------------------------------------
