@@ -347,3 +347,175 @@ def test_debug_endpoints_off_by_default():
             assert resp.status == 200
     finally:
         server.stop()
+
+
+class TestLeaseLock:
+    """Cluster-wide leader election through a substrate lease — the
+    reference's Endpoints-lock analog (server.go:157-182, 52-57)."""
+
+    def _locks(self, duration=15.0):
+        sub = InMemorySubstrate()
+        clock = {"now": 1000.0}
+        from tf_operator_tpu.server import LeaseLock
+
+        a = LeaseLock(sub, identity="a", lease_duration=duration,
+                      clock=lambda: clock["now"])
+        b = LeaseLock(sub, identity="b", lease_duration=duration,
+                      clock=lambda: clock["now"])
+        return a, b, clock
+
+    def test_mutual_exclusion(self):
+        a, b, _ = self._locks()
+        assert a.try_acquire()
+        assert not b.try_acquire()
+        assert a.renew()
+        assert not b.renew()
+
+    def test_takeover_after_expiry(self):
+        a, b, clock = self._locks(duration=15.0)
+        assert a.try_acquire()
+        clock["now"] += 16.0  # a's lease expires un-renewed
+        assert b.try_acquire()
+        # a discovers it lost on its next renewal
+        assert not a.renew()
+        assert b.renew()
+
+    def test_release_frees_immediately(self):
+        a, b, _ = self._locks()
+        assert a.try_acquire()
+        a.release()
+        assert b.try_acquire()
+
+    def test_reacquire_by_same_holder(self):
+        a, _, _ = self._locks()
+        assert a.try_acquire()
+        assert a.try_acquire()  # idempotent for the current holder
+
+    def test_stale_resource_version_conflicts(self):
+        from tf_operator_tpu.runtime.substrate import Conflict
+        from tf_operator_tpu.server import Lease
+
+        sub = InMemorySubstrate()
+        sub.create_lease(Lease(holder="x"))
+        stale = sub.get_lease("default", "tfjob-tpu-operator")
+        fresh = sub.get_lease("default", "tfjob-tpu-operator")
+        fresh.renew_time = 5.0
+        sub.update_lease(fresh)
+        stale.renew_time = 9.0
+        import pytest as _pytest
+
+        with _pytest.raises(Conflict):
+            sub.update_lease(stale)
+
+    def test_elector_surrenders_on_lost_lease(self):
+        import time as _time
+
+        from tf_operator_tpu.server import LeaderElector, LeaseLock
+
+        sub = InMemorySubstrate()
+        clock = {"now": 1000.0}
+        lock = LeaseLock(sub, identity="me", lease_duration=1.0,
+                         clock=lambda: clock["now"])
+        stopped = threading.Event()
+        done = threading.Event()
+
+        def lead():
+            done.wait(10.0)
+
+        elector = LeaderElector(
+            lock, on_started_leading=lead,
+            on_stopped_leading=stopped.set,
+            retry_period=0.05, renew_deadline=0.1,
+        )
+        thread = threading.Thread(target=elector.run, daemon=True)
+        thread.start()
+        _time.sleep(0.3)  # leading, renewing fine
+        assert not stopped.is_set()
+        # another replica steals after expiry
+        clock["now"] += 2.0
+        thief = LeaseLock(sub, identity="thief", lease_duration=1.0,
+                          clock=lambda: clock["now"])
+        assert thief.try_acquire()
+        assert stopped.wait(5.0), "elector never noticed the lost lease"
+        done.set()
+        thread.join(timeout=5.0)
+
+    def test_transient_renew_failure_does_not_surrender(self):
+        """One failed renewal must not churn leadership while the lease
+        is still valid (client-go retries until renew_deadline)."""
+        import time as _time
+
+        from tf_operator_tpu.server import LeaderElector
+
+        class FlakyLock:
+            path = "flaky"
+
+            def __init__(self):
+                self.calls = 0
+
+            def try_acquire(self):
+                return True
+
+            def renew(self):
+                self.calls += 1
+                return self.calls != 1  # first renewal fails, rest OK
+
+            def release(self):
+                pass
+
+        stopped = threading.Event()
+        done = threading.Event()
+        elector = LeaderElector(
+            FlakyLock(), on_started_leading=lambda: done.wait(5.0),
+            on_stopped_leading=stopped.set,
+            retry_period=0.05, renew_deadline=10.0,
+        )
+        thread = threading.Thread(target=elector.run, daemon=True)
+        thread.start()
+        _time.sleep(0.5)  # several renew attempts, incl. the failure
+        assert not stopped.is_set(), "one transient failure surrendered leadership"
+        done.set()
+        thread.join(timeout=5.0)
+
+    def test_stopped_leading_fires_exactly_once(self):
+        from tf_operator_tpu.server import LeaderElector
+
+        class Lock:
+            path = "l"
+
+            def try_acquire(self):
+                return True
+
+            def renew(self):
+                return False  # immediate loss
+
+            def release(self):
+                pass
+
+        count = []
+        done = threading.Event()
+        elector = LeaderElector(
+            Lock(), on_started_leading=lambda: done.wait(3.0),
+            on_stopped_leading=lambda: count.append(1),
+            retry_period=0.05, renew_deadline=0.05,
+        )
+        thread = threading.Thread(target=elector.run, daemon=True)
+        thread.start()
+        import time as _time
+
+        _time.sleep(0.3)
+        done.set()
+        thread.join(timeout=5.0)
+        assert count == [1]
+
+
+def test_lease_timestamp_parse_tolerates_second_precision():
+    """kubectl writes lease times without fractional seconds; parsing
+    must not wedge leader election (code-review finding)."""
+    from tf_operator_tpu.runtime.kube import KubeSubstrate
+
+    parse = KubeSubstrate._micro_time_to_epoch
+    assert parse("2026-07-29T00:00:00.123456Z") > 0
+    assert parse("2026-07-29T00:00:00Z") > 0  # no fraction
+    assert parse(None) == 0.0
+    assert parse("garbage") == 0.0  # degrade to expired, don't raise

@@ -330,6 +330,89 @@ class KubeSubstrate:
         except ApiError as err:
             logger.warning("failed to record event: %s", err)
 
+    # -- Leases (leader election, coordination.k8s.io/v1) ------------------
+
+    @staticmethod
+    def _lease_path(namespace: str, name: Optional[str] = None) -> str:
+        base = f"/apis/coordination.k8s.io/v1/namespaces/{namespace}/leases"
+        return f"{base}/{name}" if name else base
+
+    @staticmethod
+    def _epoch_to_micro_time(epoch: float) -> str:
+        import datetime
+
+        return datetime.datetime.fromtimestamp(
+            epoch, datetime.timezone.utc
+        ).strftime("%Y-%m-%dT%H:%M:%S.%fZ")
+
+    @staticmethod
+    def _micro_time_to_epoch(text: Optional[str]) -> float:
+        # tolerant of second-precision timestamps (kubectl and other
+        # clients omit the fraction); a parse failure must not wedge
+        # leader election, so fall back to "expired long ago"
+        if not text:
+            return 0.0
+        from ..controller.clock import parse_iso
+
+        try:
+            return parse_iso(text).timestamp()
+        except ValueError:
+            logger.warning("unparseable lease timestamp %r; treating as expired", text)
+            return 0.0
+
+    def _lease_body(self, lease) -> dict:
+        return {
+            "apiVersion": "coordination.k8s.io/v1",
+            "kind": "Lease",
+            "metadata": {
+                "name": lease.name,
+                "namespace": lease.namespace,
+                **(
+                    {"resourceVersion": lease.resource_version}
+                    if lease.resource_version
+                    else {}
+                ),
+            },
+            "spec": {
+                "holderIdentity": lease.holder,
+                "acquireTime": self._epoch_to_micro_time(lease.acquire_time),
+                "renewTime": self._epoch_to_micro_time(lease.renew_time),
+                "leaseDurationSeconds": int(lease.lease_duration_seconds),
+            },
+        }
+
+    def get_lease(self, namespace: str, name: str):
+        from ..server.leader import Lease
+
+        try:
+            obj = self._request("GET", self._lease_path(namespace, name))
+        except NotFound:
+            return None
+        spec = obj.get("spec", {})
+        return Lease(
+            namespace=namespace,
+            name=name,
+            holder=spec.get("holderIdentity") or "",
+            acquire_time=self._micro_time_to_epoch(spec.get("acquireTime")),
+            renew_time=self._micro_time_to_epoch(spec.get("renewTime")),
+            lease_duration_seconds=float(spec.get("leaseDurationSeconds") or 15),
+            resource_version=obj.get("metadata", {}).get("resourceVersion", ""),
+        )
+
+    def create_lease(self, lease) -> None:
+        self._request(
+            "POST", self._lease_path(lease.namespace), self._lease_body(lease)
+        )
+
+    def update_lease(self, lease) -> None:
+        # PUT with resourceVersion: the apiserver rejects stale writes
+        # with 409, which LeaseLock treats as lost contention
+        self._request(
+            "PUT",
+            self._lease_path(lease.namespace, lease.name),
+            self._lease_body(lease),
+        )
+
     # -- Watches -----------------------------------------------------------
 
     def subscribe(self, kind: str, callback: Callable) -> None:

@@ -108,6 +108,7 @@ class InMemorySubstrate:
         self._pods: Dict[Tuple[str, str], k8s.Pod] = {}
         self._services: Dict[Tuple[str, str], k8s.Service] = {}
         self._pod_groups: Dict[Tuple[str, str], Any] = {}
+        self._leases: Dict[Tuple[str, str], Any] = {}
         self._pod_logs: Dict[Tuple[str, str], str] = {}
         self.events: List[k8s.Event] = []
         self._subscribers: Dict[str, List[WatchCallback]] = {}
@@ -333,6 +334,40 @@ class InMemorySubstrate:
             group = self._pod_groups.pop((namespace, name), None)
             if group is not None:
                 self._notify("podgroup", DELETED, group)
+
+    # -- Leases (leader election) ------------------------------------------
+
+    def get_lease(self, namespace: str, name: str):
+        with self._lock:
+            lease = self._leases.get((namespace, name))
+            return lease.copy() if lease is not None else None
+
+    def create_lease(self, lease) -> None:
+        with self._lock:
+            key = (lease.namespace, lease.name)
+            if key in self._leases:
+                raise AlreadyExists(f"lease {key} exists")
+            lease = lease.copy()
+            lease.resource_version = str(next(self._rv))
+            self._leases[key] = lease
+
+    def update_lease(self, lease) -> None:
+        """Compare-and-swap on resourceVersion — two operators renewing
+        concurrently must not both succeed (the reference gets this from
+        the apiserver's optimistic concurrency)."""
+        with self._lock:
+            key = (lease.namespace, lease.name)
+            stored = self._leases.get(key)
+            if stored is None:
+                raise NotFound(f"lease {key}")
+            if (
+                lease.resource_version
+                and lease.resource_version != stored.resource_version
+            ):
+                raise Conflict(f"lease {key}: stale resourceVersion")
+            lease = lease.copy()
+            lease.resource_version = str(next(self._rv))
+            self._leases[key] = lease
 
     # -- Events ------------------------------------------------------------
 

@@ -1,4 +1,4 @@
-from .leader import FileLock, LeaderElector
+from .leader import FileLock, LeaderElector, Lease, LeaseLock
 from .metrics import MonitoringServer, OperatorMetrics
 from .options import ServerOptions, parse_args
 from .server import OperatorServer, main
@@ -6,6 +6,8 @@ from .server import OperatorServer, main
 __all__ = [
     "FileLock",
     "LeaderElector",
+    "Lease",
+    "LeaseLock",
     "MonitoringServer",
     "OperatorMetrics",
     "ServerOptions",

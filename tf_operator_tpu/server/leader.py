@@ -9,9 +9,11 @@ TFJob-store-backed lease record for multi-replica operators).
 
 from __future__ import annotations
 
+import dataclasses
 import fcntl
 import logging
 import os
+import socket
 import threading
 import time
 from typing import Callable, Optional
@@ -24,7 +26,8 @@ RETRY_PERIOD = 3.0
 
 
 class FileLock:
-    """flock-based mutual exclusion; held for the process lifetime."""
+    """flock-based mutual exclusion; held for the process lifetime.
+    Single-node only — for multi-replica HA use LeaseLock."""
 
     def __init__(self, path: str) -> None:
         self.path = path
@@ -42,6 +45,10 @@ class FileLock:
         self._fd = fd
         return True
 
+    def renew(self) -> bool:
+        """flock is held until released; renewal cannot fail."""
+        return self._fd is not None
+
     def release(self) -> None:
         if self._fd is not None:
             fcntl.flock(self._fd, fcntl.LOCK_UN)
@@ -49,37 +56,194 @@ class FileLock:
             self._fd = None
 
 
-class LeaderElector:
-    """Block until leadership, run the callback, renew in background.
+@dataclasses.dataclass
+class Lease:
+    """Coordination lease record (k8s coordination.k8s.io/v1 Lease
+    shape, reduced to the fields client-go leader election uses)."""
 
-    on_started_leading runs in the caller's thread (like the reference's
-    OnStartedLeading driving tc.Run); on_stopped_leading fires if the
-    lock is lost.
+    namespace: str = "default"
+    name: str = "tfjob-tpu-operator"
+    holder: str = ""
+    acquire_time: float = 0.0
+    renew_time: float = 0.0
+    lease_duration_seconds: float = LEASE_DURATION
+    resource_version: str = ""
+
+    def expired(self, now: float) -> bool:
+        return now > self.renew_time + self.lease_duration_seconds
+
+    def copy(self) -> "Lease":
+        return dataclasses.replace(self)
+
+
+def default_identity() -> str:
+    """hostname + random suffix, like client-go's hostname_uuid: pid
+    alone collides for two electors in one process (tests) and can
+    collide across hosts."""
+    import uuid
+
+    return f"{socket.gethostname()}_{uuid.uuid4().hex[:8]}"
+
+
+class LeaseLock:
+    """Cluster-wide mutual exclusion through a substrate lease — the
+    multi-replica HA boundary the reference gets from its Endpoints
+    resource lock (server.go:157-182): acquire if absent/expired, renew
+    by compare-and-swap on resourceVersion, steal only after expiry.
     """
 
     def __init__(
         self,
-        lock: FileLock,
+        substrate,
+        namespace: str = "default",
+        name: str = "tfjob-tpu-operator",
+        identity: Optional[str] = None,
+        lease_duration: float = LEASE_DURATION,
+        clock: Callable[[], float] = time.time,
+    ) -> None:
+        self.substrate = substrate
+        self.namespace = namespace
+        self.name = name
+        self.identity = identity or default_identity()
+        self.lease_duration = lease_duration
+        self.clock = clock
+        # rendered in "became leader (lock ...)" log lines
+        self.path = f"lease:{namespace}/{name}"
+
+    def _read(self) -> Optional[Lease]:
+        return self.substrate.get_lease(self.namespace, self.name)
+
+    def try_acquire(self) -> bool:
+        now = self.clock()
+        try:
+            current = self._read()
+            if current is None:
+                self.substrate.create_lease(
+                    Lease(
+                        namespace=self.namespace,
+                        name=self.name,
+                        holder=self.identity,
+                        acquire_time=now,
+                        renew_time=now,
+                        lease_duration_seconds=self.lease_duration,
+                    )
+                )
+                return True
+            if current.holder not in ("", self.identity) and not current.expired(now):
+                return False
+            fresh = current.copy()
+            if fresh.holder != self.identity:
+                fresh.acquire_time = now
+            fresh.holder = self.identity
+            fresh.renew_time = now
+            fresh.lease_duration_seconds = self.lease_duration
+            self.substrate.update_lease(fresh)
+            return True
+        except Exception as err:
+            logger.debug("lease acquire failed: %s", err)
+            return False
+
+    def renew(self) -> bool:
+        now = self.clock()
+        try:
+            current = self._read()
+            if current is None or current.holder != self.identity:
+                return False  # lost (deleted or stolen after expiry)
+            fresh = current.copy()
+            fresh.renew_time = now
+            self.substrate.update_lease(fresh)
+            return True
+        except Exception as err:
+            logger.warning("lease renew failed: %s", err)
+            return False
+
+    def release(self) -> None:
+        try:
+            current = self._read()
+            if current is not None and current.holder == self.identity:
+                fresh = current.copy()
+                fresh.holder = ""
+                self.substrate.update_lease(fresh)
+        except Exception as err:
+            logger.debug("lease release failed: %s", err)
+
+
+class LeaderElector:
+    """Block until leadership, run the callback, renew in background.
+
+    on_started_leading runs in the caller's thread (like the reference's
+    OnStartedLeading driving tc.Run); on_stopped_leading fires when the
+    lock is released or lost. Renewal runs on a background thread every
+    renew_deadline seconds; a failed renewal (lease stolen after expiry,
+    apiserver unreachable past the lease) means another replica may be
+    leading, so leadership is surrendered (the reference's client-go
+    elector behaves the same; operators then typically exit).
+    """
+
+    def __init__(
+        self,
+        lock,
         on_started_leading: Callable[[], None],
         on_stopped_leading: Optional[Callable[[], None]] = None,
         retry_period: float = RETRY_PERIOD,
+        renew_deadline: float = RENEW_DEADLINE,
     ) -> None:
         self.lock = lock
         self.on_started_leading = on_started_leading
         self.on_stopped_leading = on_stopped_leading
         self.retry_period = retry_period
+        self.renew_deadline = renew_deadline
         self._stop = threading.Event()
+        self._lost = threading.Event()
+        self._notify_lock = threading.Lock()
+        self._notified = False
+
+    def is_leading(self) -> bool:
+        return not self._lost.is_set() and not self._stop.is_set()
+
+    def _notify_stopped(self) -> None:
+        """on_stopped_leading must fire exactly once, whichever of the
+        renew thread / run() reaches it first."""
+        with self._notify_lock:
+            if self._notified:
+                return
+            self._notified = True
+        if self.on_stopped_leading is not None:
+            self.on_stopped_leading()
+
+    def _renew_loop(self) -> None:
+        """client-go semantics: retry every retry_period; only give up
+        once renew_deadline has passed without a successful renewal —
+        one transient apiserver error must not churn leadership while
+        the lease is still valid."""
+        last_success = time.monotonic()
+        while not self._stop.wait(self.retry_period):
+            if self.lock.renew():
+                last_success = time.monotonic()
+            elif time.monotonic() - last_success >= self.renew_deadline:
+                logger.error(
+                    "lost leadership (no successful renewal for %.1fs)",
+                    self.renew_deadline,
+                )
+                self._lost.set()
+                self._notify_stopped()
+                return
 
     def run(self) -> None:
         while not self._stop.is_set():
             if self.lock.try_acquire():
                 logger.info("became leader (lock %s)", self.lock.path)
+                renewer = threading.Thread(
+                    target=self._renew_loop, name="lease-renew", daemon=True
+                )
+                renewer.start()
                 try:
                     self.on_started_leading()
                 finally:
+                    self._stop.set()
+                    renewer.join(timeout=self.retry_period + 1)
                     self.lock.release()
-                    if self.on_stopped_leading is not None:
-                        self.on_stopped_leading()
+                    self._notify_stopped()
                 return
             logger.debug("not leader; retrying in %.1fs", self.retry_period)
             self._stop.wait(self.retry_period)

@@ -19,7 +19,12 @@ class ServerOptions:
     enable_gang_scheduling: bool = False
     gang_scheduler_name: str = "volcano"
     enable_leader_election: bool = True
+    # "lease" = cluster-wide lease through the substrate (multi-replica
+    # HA, the reference's Endpoints-lock analog); "file" = single-node
+    leader_lock: str = "lease"
     leader_lock_path: str = "/tmp/tfjob-tpu-operator.lock"
+    leader_lease_namespace: str = "kubeflow"
+    leader_lease_name: str = "tfjob-tpu-operator"
     # host-port range for hostNetwork jobs (reference --bport/--eport)
     bport: int = 20000
     eport: int = 30000
@@ -62,7 +67,16 @@ def parse_args(argv: Optional[List[str]] = None) -> ServerOptions:
         "--enable-leader-election", action=argparse.BooleanOptionalAction,
         default=opts.enable_leader_election,
     )
+    parser.add_argument(
+        "--leader-lock", choices=["lease", "file"], default=opts.leader_lock,
+        help="lease = cluster-wide substrate lease (multi-replica HA); "
+        "file = single-node flock",
+    )
     parser.add_argument("--leader-lock-path", default=opts.leader_lock_path)
+    parser.add_argument(
+        "--leader-lease-namespace", default=opts.leader_lease_namespace
+    )
+    parser.add_argument("--leader-lease-name", default=opts.leader_lease_name)
     parser.add_argument("--bport", type=int, default=opts.bport)
     parser.add_argument("--eport", type=int, default=opts.eport)
     parser.add_argument(
@@ -91,7 +105,10 @@ def parse_args(argv: Optional[List[str]] = None) -> ServerOptions:
         enable_gang_scheduling=ns.enable_gang_scheduling,
         gang_scheduler_name=ns.gang_scheduler_name,
         enable_leader_election=ns.enable_leader_election,
+        leader_lock=ns.leader_lock,
         leader_lock_path=ns.leader_lock_path,
+        leader_lease_namespace=ns.leader_lease_namespace,
+        leader_lease_name=ns.leader_lease_name,
         bport=ns.bport,
         eport=ns.eport,
         kubeconfig=ns.kubeconfig,

@@ -21,7 +21,7 @@ from ..controller.ports import PortAllocator
 from ..runtime import InMemorySubstrate
 from ..utils import JsonFieldFormatter, version_info
 from ..utils.logger import TextFieldFormatter
-from .leader import FileLock, LeaderElector
+from .leader import FileLock, LeaderElector, LeaseLock
 from .metrics import MonitoringServer, OperatorMetrics
 from .options import ServerOptions, parse_args
 
@@ -112,11 +112,29 @@ class OperatorServer:
             self._stop.wait()
             self.controller.stop()
 
+        def stopped_leading() -> None:
+            # losing the lease means another replica may already be
+            # reconciling: stop this controller and unblock lead(), or
+            # two leaders run concurrently (split brain)
+            self.metrics.set_leader(False)
+            self.controller.stop()
+            self._stop.set()
+
         if self.options.enable_leader_election:
+            if self.options.leader_lock == "lease" and hasattr(
+                self.substrate, "get_lease"
+            ):
+                lock = LeaseLock(
+                    self.substrate,
+                    namespace=self.options.leader_lease_namespace,
+                    name=self.options.leader_lease_name,
+                )
+            else:
+                lock = FileLock(self.options.leader_lock_path)
             self._elector = LeaderElector(
-                FileLock(self.options.leader_lock_path),
+                lock,
                 on_started_leading=lead,
-                on_stopped_leading=lambda: self.metrics.set_leader(False),
+                on_stopped_leading=stopped_leading,
             )
             self._elector.run()
         else:
