@@ -519,3 +519,52 @@ def test_lease_timestamp_parse_tolerates_second_precision():
     assert parse("2026-07-29T00:00:00Z") > 0  # no fraction
     assert parse(None) == 0.0
     assert parse("garbage") == 0.0  # degrade to expired, don't raise
+
+
+class TestLeaseGuards:
+    """Follow-up code-review findings on the lease election."""
+
+    def test_timing_invariant_enforced(self):
+        from tf_operator_tpu.server import LeaderElector, LeaseLock
+
+        lock = LeaseLock(InMemorySubstrate(), lease_duration=5.0)
+        with pytest.raises(ValueError, match="lease_duration"):
+            LeaderElector(lock, on_started_leading=lambda: None,
+                          renew_deadline=10.0)
+        with pytest.raises(ValueError, match="renew_deadline"):
+            LeaderElector(lock, on_started_leading=lambda: None,
+                          retry_period=3.0, renew_deadline=1.0)
+
+    def test_is_leading_false_while_waiting(self):
+        import time as _time
+
+        from tf_operator_tpu.server import LeaderElector, LeaseLock
+
+        sub = InMemorySubstrate()
+        holder = LeaseLock(sub, identity="holder")
+        assert holder.try_acquire()
+        waiter_lock = LeaseLock(sub, identity="waiter")
+        elector = LeaderElector(
+            waiter_lock, on_started_leading=lambda: None,
+            retry_period=0.05, renew_deadline=0.1,
+        )
+        thread = threading.Thread(target=elector.run, daemon=True)
+        thread.start()
+        _time.sleep(0.2)
+        assert not elector.is_leading()  # still waiting for the lock
+        elector.stop()
+        thread.join(timeout=5.0)
+
+    def test_lease_lock_without_substrate_support_fails_loudly(self):
+        class NoLeaseSubstrate(InMemorySubstrate):
+            @property
+            def get_lease(self):  # hasattr() -> False
+                raise AttributeError("no lease support")
+
+        options = parse_args([
+            "--substrate", "memory", "--monitoring-port", "0",
+            "--leader-lock", "lease",
+        ])
+        server = OperatorServer(options, substrate=NoLeaseSubstrate())
+        assert server.run() == 1  # refuses instead of silent file lock
+        server.monitoring.stop()

@@ -27,7 +27,15 @@ from typing import Any, Callable, Dict, List, Optional
 from ..api import k8s
 from ..api.serde import from_jsonable, to_jsonable
 from ..api.types import GROUP_NAME, PLURAL, TFJob, VERSION
-from .substrate import ADDED, AlreadyExists, Conflict, DELETED, MODIFIED, NotFound
+from .substrate import (
+    ADDED,
+    AlreadyExists,
+    Conflict,
+    DELETED,
+    Lease,
+    MODIFIED,
+    NotFound,
+)
 
 logger = logging.getLogger("tf_operator_tpu.kube")
 
@@ -382,8 +390,6 @@ class KubeSubstrate:
         }
 
     def get_lease(self, namespace: str, name: str):
-        from ..server.leader import Lease
-
         try:
             obj = self._request("GET", self._lease_path(namespace, name))
         except NotFound:

@@ -49,6 +49,27 @@ class NotFound(KeyError):
     pass
 
 
+@dataclasses.dataclass
+class Lease:
+    """Coordination lease record (k8s coordination.k8s.io/v1 Lease
+    shape, reduced to the fields client-go leader election uses).
+    Stored by substrates; consumed by server.leader.LeaseLock."""
+
+    namespace: str = "default"
+    name: str = "tfjob-tpu-operator"
+    holder: str = ""
+    acquire_time: float = 0.0
+    renew_time: float = 0.0
+    lease_duration_seconds: float = 15.0
+    resource_version: str = ""
+
+    def expired(self, now: float) -> bool:
+        return now > self.renew_time + self.lease_duration_seconds
+
+    def copy(self) -> "Lease":
+        return dataclasses.replace(self)
+
+
 class AlreadyExists(ValueError):
     pass
 

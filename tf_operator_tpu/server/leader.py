@@ -9,7 +9,6 @@ TFJob-store-backed lease record for multi-replica operators).
 
 from __future__ import annotations
 
-import dataclasses
 import fcntl
 import logging
 import os
@@ -17,6 +16,8 @@ import socket
 import threading
 import time
 from typing import Callable, Optional
+
+from ..runtime.substrate import Lease
 
 logger = logging.getLogger("tf_operator_tpu.leader")
 
@@ -54,26 +55,6 @@ class FileLock:
             fcntl.flock(self._fd, fcntl.LOCK_UN)
             os.close(self._fd)
             self._fd = None
-
-
-@dataclasses.dataclass
-class Lease:
-    """Coordination lease record (k8s coordination.k8s.io/v1 Lease
-    shape, reduced to the fields client-go leader election uses)."""
-
-    namespace: str = "default"
-    name: str = "tfjob-tpu-operator"
-    holder: str = ""
-    acquire_time: float = 0.0
-    renew_time: float = 0.0
-    lease_duration_seconds: float = LEASE_DURATION
-    resource_version: str = ""
-
-    def expired(self, now: float) -> bool:
-        return now > self.renew_time + self.lease_duration_seconds
-
-    def copy(self) -> "Lease":
-        return dataclasses.replace(self)
 
 
 def default_identity() -> str:
@@ -140,7 +121,9 @@ class LeaseLock:
             self.substrate.update_lease(fresh)
             return True
         except Exception as err:
-            logger.debug("lease acquire failed: %s", err)
+            # RBAC denials / wrong namespace would otherwise make the
+            # operator spin forever with no visible reason
+            logger.warning("lease acquire failed: %s", err)
             return False
 
     def renew(self) -> bool:
@@ -173,11 +156,12 @@ class LeaderElector:
 
     on_started_leading runs in the caller's thread (like the reference's
     OnStartedLeading driving tc.Run); on_stopped_leading fires when the
-    lock is released or lost. Renewal runs on a background thread every
-    renew_deadline seconds; a failed renewal (lease stolen after expiry,
-    apiserver unreachable past the lease) means another replica may be
-    leading, so leadership is surrendered (the reference's client-go
-    elector behaves the same; operators then typically exit).
+    lock is released or lost. A background thread attempts renewal every
+    retry_period seconds; leadership is surrendered only when
+    renew_deadline passes with no successful renewal (lease stolen
+    after expiry, apiserver unreachable past the lease) — the
+    reference's client-go elector behaves the same; operators then
+    typically exit.
     """
 
     def __init__(
@@ -188,6 +172,20 @@ class LeaderElector:
         retry_period: float = RETRY_PERIOD,
         renew_deadline: float = RENEW_DEADLINE,
     ) -> None:
+        # client-go's invariant: leaseDuration > renewDeadline >
+        # retryPeriod, else a deposed leader can outlive its lease
+        # (concurrent-leaders window)
+        lease_duration = getattr(lock, "lease_duration", None)
+        if lease_duration is not None and lease_duration <= renew_deadline:
+            raise ValueError(
+                f"lease_duration ({lease_duration}) must exceed "
+                f"renew_deadline ({renew_deadline})"
+            )
+        if renew_deadline < retry_period:
+            raise ValueError(
+                f"renew_deadline ({renew_deadline}) must be >= "
+                f"retry_period ({retry_period})"
+            )
         self.lock = lock
         self.on_started_leading = on_started_leading
         self.on_stopped_leading = on_stopped_leading
@@ -195,11 +193,18 @@ class LeaderElector:
         self.renew_deadline = renew_deadline
         self._stop = threading.Event()
         self._lost = threading.Event()
+        self._leading = threading.Event()
         self._notify_lock = threading.Lock()
         self._notified = False
 
     def is_leading(self) -> bool:
-        return not self._lost.is_set() and not self._stop.is_set()
+        """True only between lock acquisition and loss/stop — a replica
+        still waiting for the lock is NOT leading."""
+        return (
+            self._leading.is_set()
+            and not self._lost.is_set()
+            and not self._stop.is_set()
+        )
 
     def _notify_stopped(self) -> None:
         """on_stopped_leading must fire exactly once, whichever of the
@@ -233,6 +238,7 @@ class LeaderElector:
         while not self._stop.is_set():
             if self.lock.try_acquire():
                 logger.info("became leader (lock %s)", self.lock.path)
+                self._leading.set()
                 renewer = threading.Thread(
                     target=self._renew_loop, name="lease-renew", daemon=True
                 )

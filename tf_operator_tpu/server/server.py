@@ -121,9 +121,18 @@ class OperatorServer:
             self._stop.set()
 
         if self.options.enable_leader_election:
-            if self.options.leader_lock == "lease" and hasattr(
-                self.substrate, "get_lease"
-            ):
+            if self.options.leader_lock == "lease":
+                if not hasattr(self.substrate, "get_lease"):
+                    # silently downgrading to a node-local flock would
+                    # let every replica elect itself (split brain) —
+                    # fail loudly; --leader-lock=file is the opt-out
+                    logger.error(
+                        "--leader-lock=lease requires a substrate with "
+                        "lease support (%s has none); use --leader-lock=file "
+                        "for single-node deployments",
+                        type(self.substrate).__name__,
+                    )
+                    return 1
                 lock = LeaseLock(
                     self.substrate,
                     namespace=self.options.leader_lease_namespace,
