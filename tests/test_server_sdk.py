@@ -497,7 +497,7 @@ class TestLeaseLock:
         elector = LeaderElector(
             Lock(), on_started_leading=lambda: done.wait(3.0),
             on_stopped_leading=lambda: count.append(1),
-            retry_period=0.05, renew_deadline=0.05,
+            retry_period=0.05, renew_deadline=0.06,
         )
         thread = threading.Thread(target=elector.run, daemon=True)
         thread.start()
@@ -534,6 +534,11 @@ class TestLeaseGuards:
         with pytest.raises(ValueError, match="renew_deadline"):
             LeaderElector(lock, on_started_leading=lambda: None,
                           retry_period=3.0, renew_deadline=1.0)
+        # equality is also rejected: one failed attempt would already
+        # exceed the deadline
+        with pytest.raises(ValueError, match="renew_deadline"):
+            LeaderElector(lock, on_started_leading=lambda: None,
+                          retry_period=3.0, renew_deadline=3.0)
 
     def test_is_leading_false_while_waiting(self):
         import time as _time
@@ -567,4 +572,4 @@ class TestLeaseGuards:
         ])
         server = OperatorServer(options, substrate=NoLeaseSubstrate())
         assert server.run() == 1  # refuses instead of silent file lock
-        server.monitoring.stop()
+        # run() stops its own monitoring server on the error path

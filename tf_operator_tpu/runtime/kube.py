@@ -31,6 +31,7 @@ from .substrate import (
     ADDED,
     AlreadyExists,
     Conflict,
+    DEFAULT_LEASE_DURATION,
     DELETED,
     Lease,
     MODIFIED,
@@ -401,7 +402,9 @@ class KubeSubstrate:
             holder=spec.get("holderIdentity") or "",
             acquire_time=self._micro_time_to_epoch(spec.get("acquireTime")),
             renew_time=self._micro_time_to_epoch(spec.get("renewTime")),
-            lease_duration_seconds=float(spec.get("leaseDurationSeconds") or 15),
+            lease_duration_seconds=float(
+                spec.get("leaseDurationSeconds") or DEFAULT_LEASE_DURATION
+            ),
             resource_version=obj.get("metadata", {}).get("resourceVersion", ""),
         )
 

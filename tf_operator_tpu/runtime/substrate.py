@@ -49,6 +49,11 @@ class NotFound(KeyError):
     pass
 
 
+# single source for the default lease duration (reference server.go:53);
+# leader.py and kube.py must not restate the number
+DEFAULT_LEASE_DURATION = 15.0
+
+
 @dataclasses.dataclass
 class Lease:
     """Coordination lease record (k8s coordination.k8s.io/v1 Lease
@@ -60,7 +65,7 @@ class Lease:
     holder: str = ""
     acquire_time: float = 0.0
     renew_time: float = 0.0
-    lease_duration_seconds: float = 15.0
+    lease_duration_seconds: float = DEFAULT_LEASE_DURATION
     resource_version: str = ""
 
     def expired(self, now: float) -> bool:

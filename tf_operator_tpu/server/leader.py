@@ -17,11 +17,11 @@ import threading
 import time
 from typing import Callable, Optional
 
-from ..runtime.substrate import Lease
+from ..runtime.substrate import DEFAULT_LEASE_DURATION, Lease
 
 logger = logging.getLogger("tf_operator_tpu.leader")
 
-LEASE_DURATION = 15.0
+LEASE_DURATION = DEFAULT_LEASE_DURATION
 RENEW_DEADLINE = 5.0
 RETRY_PERIOD = 3.0
 
@@ -181,9 +181,12 @@ class LeaderElector:
                 f"lease_duration ({lease_duration}) must exceed "
                 f"renew_deadline ({renew_deadline})"
             )
-        if renew_deadline < retry_period:
+        if renew_deadline <= retry_period:
+            # strictly greater (client-go): at equality the FIRST failed
+            # renewal attempt already exceeds the deadline, so one
+            # transient error surrenders leadership
             raise ValueError(
-                f"renew_deadline ({renew_deadline}) must be >= "
+                f"renew_deadline ({renew_deadline}) must exceed "
                 f"retry_period ({retry_period})"
             )
         self.lock = lock

@@ -99,6 +99,13 @@ class OperatorServer:
 
     def run(self) -> int:
         self.monitoring.start()
+        try:
+            return self._run()
+        finally:
+            # error returns must not leak the bound monitoring socket
+            self.monitoring.stop()
+
+    def _run(self) -> int:
         logger.info("monitoring on :%d", self.monitoring.port)
         if not check_crd_exists(self.substrate):
             return 1

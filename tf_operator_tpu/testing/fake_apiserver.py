@@ -1,0 +1,319 @@
+"""Fake Kubernetes apiserver speaking the REST subset KubeSubstrate
+uses.
+
+The reference tests its controller against fake clientsets
+(controller_test.go:44-64) and its E2E suite against a real cluster;
+this sits in between — a real HTTP wire with in-memory storage, so the
+KubeSubstrate client (paths, verbs, selectors, conflict handling,
+chunked watch streams) is exercised without a cluster.
+
+Supports:
+- CRUD on tfjobs (incl. /status subresource), pods, services, events,
+  podgroups, coordination.k8s.io leases
+- labelSelector= query on list
+- optimistic concurrency: PUT with a stale metadata.resourceVersion
+  returns 409 Conflict; duplicate POST returns 409 AlreadyExists
+- ?watch=true chunked streaming of ADDED/MODIFIED/DELETED events
+
+Usage:
+    server = FakeApiServer()
+    port = server.start()
+    substrate = KubeSubstrate(f"http://127.0.0.1:{port}")
+"""
+
+from __future__ import annotations
+
+import itertools
+import json
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Dict, List, Optional, Tuple
+from urllib.parse import parse_qs, urlparse
+
+
+class _Store:
+    """All resources, keyed by (collection_path, namespace, name)."""
+
+    def __init__(self) -> None:
+        self.lock = threading.RLock()
+        self.objects: Dict[Tuple[str, str, str], dict] = {}
+        self.rv = itertools.count(1)
+        self.uid = itertools.count(1)
+        self.watchers: Dict[str, List] = {}  # collection kind -> queues
+
+    def stamp(self, obj: dict) -> None:
+        meta = obj.setdefault("metadata", {})
+        if not meta.get("uid"):
+            meta["uid"] = f"uid-{next(self.uid)}"
+        meta["resourceVersion"] = str(next(self.rv))
+
+    def notify(self, collection: str, verb: str, obj: dict) -> None:
+        # serialize NOW, under the store lock: queues must hold frozen
+        # bytes, not live dict references a later mutation could change
+        # (or crash json.dumps) while the watch thread drains
+        line = json.dumps({"type": verb, "object": obj}).encode() + b"\n"
+        for queue in self.watchers.get(collection, []):
+            queue.append(line)
+
+
+def _split(path: str):
+    """-> (collection_path, namespace, name, subresource).
+
+    Handles:
+      /api/v1/namespaces/{ns}/{plural}[/{name}]
+      /apis/{group}/{version}[/namespaces/{ns}]/{plural}[/{name}[/status]]
+    """
+    parts = [p for p in path.split("/") if p]
+    subresource = None
+    if parts and parts[-1] == "status":
+        subresource = parts.pop()
+    if "namespaces" in parts:
+        idx = parts.index("namespaces")
+        namespace = parts[idx + 1]
+        rest = parts[idx + 2 :]
+        plural = rest[0]
+        name = rest[1] if len(rest) > 1 else None
+    else:
+        # cluster-scoped list (e.g. GET /apis/kubeflow.org/v1/tfjobs)
+        namespace = None
+        plural = parts[-1]
+        name = None
+    return plural, namespace, name, subresource
+
+
+def _matches_selector(obj: dict, selector: str) -> bool:
+    labels = obj.get("metadata", {}).get("labels", {}) or {}
+    for clause in selector.split(","):
+        if not clause:
+            continue
+        key, _, value = clause.partition("=")
+        if labels.get(key) != value:
+            return False
+    return True
+
+
+class _Server(ThreadingHTTPServer):
+    # watch handlers hold connections open; never block shutdown on them
+    daemon_threads = True
+
+
+class FakeApiServer:
+    def __init__(self) -> None:
+        self.store = _Store()
+        self._httpd: Optional[ThreadingHTTPServer] = None
+        self._thread: Optional[threading.Thread] = None
+        self._closing = threading.Event()
+        self.port = 0
+
+    # -- lifecycle ---------------------------------------------------------
+
+    def start(self) -> int:
+        store = self.store
+        closing = self._closing
+
+        class Handler(BaseHTTPRequestHandler):
+            protocol_version = "HTTP/1.1"
+
+            def log_message(self, *args) -> None:
+                pass
+
+            def _reply(self, code: int, payload: Optional[dict]) -> None:
+                body = json.dumps(payload).encode() if payload is not None else b""
+                self.send_response(code)
+                self.send_header("Content-Type", "application/json")
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
+            def _error(self, code: int, reason: str, message: str) -> None:
+                self._reply(code, {"kind": "Status", "reason": reason,
+                                   "message": message, "code": code})
+
+            def _read_body(self) -> dict:
+                length = int(self.headers.get("Content-Length") or 0)
+                if not length:
+                    return {}
+                return json.loads(self.rfile.read(length))
+
+            # -- verbs ----------------------------------------------------
+
+            def do_GET(self) -> None:  # noqa: N802
+                url = urlparse(self.path)
+                params = parse_qs(url.query)
+                plural, namespace, name, _ = _split(url.path)
+                if params.get("watch") == ["true"]:
+                    return self._watch(plural)
+                with store.lock:
+                    if name is not None:
+                        obj = store.objects.get((plural, namespace, name))
+                        if obj is None:
+                            return self._error(404, "NotFound", f"{plural} {name}")
+                        return self._reply(200, obj)
+                    selector = params.get("labelSelector", [""])[0]
+                    items = [
+                        obj
+                        for (pl, ns, _), obj in store.objects.items()
+                        if pl == plural
+                        and (namespace is None or ns == namespace)
+                        and (not selector or _matches_selector(obj, selector))
+                    ]
+                    return self._reply(200, {"items": items})
+
+            def _watch(self, plural: str) -> None:
+                queue: list = []
+                with store.lock:
+                    store.watchers.setdefault(plural, []).append(queue)
+                try:
+                    self.send_response(200)
+                    self.send_header("Content-Type", "application/json")
+                    self.send_header("Transfer-Encoding", "chunked")
+                    self.end_headers()
+                    sent = 0
+                    import time as _time
+
+                    deadline = _time.monotonic() + 300
+                    while _time.monotonic() < deadline and not closing.is_set():
+                        while sent < len(queue):
+                            line = queue[sent]
+                            self.wfile.write(
+                                f"{len(line):x}\r\n".encode() + line + b"\r\n"
+                            )
+                            self.wfile.flush()
+                            sent += 1
+                        _time.sleep(0.02)
+                except (BrokenPipeError, ConnectionResetError):
+                    pass
+                finally:
+                    with store.lock:
+                        if queue in store.watchers.get(plural, []):
+                            store.watchers[plural].remove(queue)
+
+            def do_POST(self) -> None:  # noqa: N802
+                url = urlparse(self.path)
+                plural, namespace, _, _ = _split(url.path)
+                obj = self._read_body()
+                meta = obj.setdefault("metadata", {})
+                if meta.get("generateName") and not meta.get("name"):
+                    meta["name"] = meta["generateName"] + f"{next(store.uid)}"
+                name = meta.get("name")
+                meta.setdefault("namespace", namespace)
+                with store.lock:
+                    key = (plural, meta["namespace"], name)
+                    if key in store.objects:
+                        return self._error(
+                            409, "AlreadyExists", f"{plural} {name} exists"
+                        )
+                    store.stamp(obj)
+                    store.objects[key] = obj
+                    store.notify(plural, "ADDED", obj)
+                    return self._reply(201, obj)
+
+            def do_PUT(self) -> None:  # noqa: N802
+                url = urlparse(self.path)
+                plural, namespace, name, subresource = _split(url.path)
+                obj = self._read_body()
+                with store.lock:
+                    key = (plural, namespace, name)
+                    stored = store.objects.get(key)
+                    if stored is None:
+                        return self._error(404, "NotFound", f"{plural} {name}")
+                    sent_rv = obj.get("metadata", {}).get("resourceVersion")
+                    if sent_rv and sent_rv != stored["metadata"]["resourceVersion"]:
+                        return self._error(
+                            409, "Conflict", f"{plural} {name}: stale resourceVersion"
+                        )
+                    if subresource == "status":
+                        stored["status"] = obj.get("status", {})
+                        store.stamp(stored)
+                        store.notify(plural, "MODIFIED", stored)
+                        return self._reply(200, stored)
+                    obj.setdefault("metadata", {})["namespace"] = namespace
+                    obj["metadata"]["name"] = name
+                    obj["metadata"]["uid"] = stored["metadata"]["uid"]
+                    store.stamp(obj)
+                    store.objects[key] = obj
+                    store.notify(plural, "MODIFIED", obj)
+                    return self._reply(200, obj)
+
+            def do_PATCH(self) -> None:  # noqa: N802
+                url = urlparse(self.path)
+                plural, namespace, name, _ = _split(url.path)
+                patch = self._read_body()
+                with store.lock:
+                    key = (plural, namespace, name)
+                    stored = store.objects.get(key)
+                    if stored is None:
+                        return self._error(404, "NotFound", f"{plural} {name}")
+                    _merge(stored, patch)
+                    store.stamp(stored)
+                    store.notify(plural, "MODIFIED", stored)
+                    return self._reply(200, stored)
+
+            def do_DELETE(self) -> None:  # noqa: N802
+                url = urlparse(self.path)
+                plural, namespace, name, _ = _split(url.path)
+                with store.lock:
+                    key = (plural, namespace, name)
+                    obj = store.objects.pop(key, None)
+                    if obj is None:
+                        return self._error(404, "NotFound", f"{plural} {name}")
+                    store.notify(plural, "DELETED", obj)
+                    # cascade: children owned by the deleted object (the
+                    # k8s GC controller's role)
+                    uid = obj.get("metadata", {}).get("uid")
+                    doomed = [
+                        k
+                        for k, child in store.objects.items()
+                        if any(
+                            ref.get("uid") == uid
+                            for ref in child.get("metadata", {}).get(
+                                "ownerReferences", []
+                            )
+                        )
+                    ]
+                    for k in doomed:
+                        child = store.objects.pop(k)
+                        store.notify(k[0], "DELETED", child)
+                    return self._reply(200, {"kind": "Status", "status": "Success"})
+
+        self._httpd = _Server(("127.0.0.1", 0), Handler)
+        self.port = self._httpd.server_address[1]
+        self._thread = threading.Thread(
+            target=self._httpd.serve_forever, name="fake-apiserver", daemon=True
+        )
+        self._thread.start()
+        return self.port
+
+    def stop(self) -> None:
+        self._closing.set()
+        if self._httpd is not None:
+            self._httpd.shutdown()
+            self._httpd.server_close()
+
+    # -- kubelet simulator over the store ----------------------------------
+
+    def set_pod_phase(
+        self, namespace: str, name: str, phase: str, exit_code: Optional[int] = None
+    ) -> None:
+        with self.store.lock:
+            pod = self.store.objects[("pods", namespace, name)]
+            status = pod.setdefault("status", {})
+            status["phase"] = phase
+            if exit_code is not None:
+                container = pod.get("spec", {}).get("containers", [{}])[0]
+                status["containerStatuses"] = [
+                    {
+                        "name": container.get("name", "tensorflow"),
+                        "state": {"terminated": {"exitCode": exit_code}},
+                    }
+                ]
+            self.store.stamp(pod)
+            self.store.notify("pods", "MODIFIED", pod)
+
+
+def _merge(base: dict, patch: dict) -> None:
+    for key, value in patch.items():
+        if isinstance(value, dict) and isinstance(base.get(key), dict):
+            _merge(base[key], value)
+        else:
+            base[key] = value
