@@ -374,11 +374,39 @@ class TestLeaseLock:
     def test_takeover_after_expiry(self):
         a, b, clock = self._locks(duration=15.0)
         assert a.try_acquire()
-        clock["now"] += 16.0  # a's lease expires un-renewed
+        # b must first OBSERVE the record, then see it sit unchanged for
+        # a full lease_duration of b's own local time (client-go
+        # semantics: remote renewTime is never trusted against the local
+        # clock, so a one-shot reader can never steal)
+        assert not b.try_acquire()
+        clock["now"] += 16.0  # a never renews; b's observation goes stale
         assert b.try_acquire()
         # a discovers it lost on its next renewal
         assert not a.renew()
         assert b.renew()
+
+    def test_clock_skew_does_not_steal_healthy_lease(self):
+        """A follower whose wall clock runs far ahead of the leader's
+        must not steal while the leader keeps renewing (ADVICE r1:
+        expiry must be judged by locally-observed change, not by
+        comparing local time against the remote renewTime)."""
+        sub = InMemorySubstrate()
+        from tf_operator_tpu.server import LeaseLock
+
+        leader_clock = {"now": 1000.0}
+        skewed_clock = {"now": 1020.0}  # 20s ahead of a 15s lease
+        leader = LeaseLock(sub, identity="leader", lease_duration=15.0,
+                           clock=lambda: leader_clock["now"])
+        skewed = LeaseLock(sub, identity="skewed", lease_duration=15.0,
+                           clock=lambda: skewed_clock["now"])
+        assert leader.try_acquire()
+        # the skewed follower polls; the leader renews in between — every
+        # poll sees a CHANGED record, so the observation never goes stale
+        for _ in range(5):
+            assert not skewed.try_acquire()
+            leader_clock["now"] += 3.0
+            skewed_clock["now"] += 3.0
+            assert leader.renew()
 
     def test_release_frees_immediately(self):
         a, b, _ = self._locks()
@@ -431,11 +459,20 @@ class TestLeaseLock:
         thread.start()
         _time.sleep(0.3)  # leading, renewing fine
         assert not stopped.is_set()
-        # another replica steals after expiry
-        clock["now"] += 2.0
-        thief = LeaseLock(sub, identity="thief", lease_duration=1.0,
-                          clock=lambda: clock["now"])
-        assert thief.try_acquire()
+        # the lease changes hands (e.g. stolen after a real expiry,
+        # written here directly; CAS-retry against the renew thread)
+        from tf_operator_tpu.runtime.substrate import Conflict
+
+        for _ in range(50):
+            stolen = sub.get_lease("default", "tfjob-tpu-operator")
+            stolen.holder = "thief"
+            try:
+                sub.update_lease(stolen)
+                break
+            except Conflict:
+                _time.sleep(0.01)
+        else:
+            raise AssertionError("could not steal the lease")
         assert stopped.wait(5.0), "elector never noticed the lost lease"
         done.set()
         thread.join(timeout=5.0)

@@ -14,11 +14,12 @@ from typing import List, Optional
 
 from ..api import k8s, set_defaults, validate
 from ..api.serde import to_jsonable
-from ..api.types import ConditionType, TFJob, gen_labels
+from ..api.types import LABEL_JOB_NAME, ConditionType, TFJob, gen_labels
 from ..api.validation import ValidationError
 from ..utils.logger import logger_for_job
 from ..runtime import (
     ADDED,
+    Conflict,
     DELETED,
     MODIFIED,
     EventRecorder,
@@ -86,6 +87,7 @@ class TFJobController:
             delete_job=self._delete_job,
             gang=gang,
             metrics=metrics,
+            fresh_job=self._fresh_job,
         )
         self._stop = threading.Event()
         self._workers: List[threading.Thread] = []
@@ -158,7 +160,15 @@ class TFJobController:
         if not self._in_scope(pod.metadata.namespace):
             return
         owner = _controller_owner(pod.metadata)
-        if owner is None or owner.kind != "TFJob":
+        if owner is None:
+            # orphan: enqueue the label-matched job so it can adopt
+            # promptly (reference AddPod resolving by labels,
+            # jobcontroller/pod.go:20-64)
+            job_name = pod.metadata.labels.get(LABEL_JOB_NAME)
+            if job_name:
+                self.enqueue(f"{pod.metadata.namespace}/{job_name}")
+            return
+        if owner.kind != "TFJob":
             return
         job_key = f"{pod.metadata.namespace}/{owner.name}"
         rt = pod.metadata.labels.get("tf-replica-type", "")
@@ -172,7 +182,12 @@ class TFJobController:
         if not self._in_scope(svc.metadata.namespace):
             return
         owner = _controller_owner(svc.metadata)
-        if owner is None or owner.kind != "TFJob":
+        if owner is None:
+            job_name = svc.metadata.labels.get(LABEL_JOB_NAME)
+            if job_name:
+                self.enqueue(f"{svc.metadata.namespace}/{job_name}")
+            return
+        if owner.kind != "TFJob":
             return
         job_key = f"{svc.metadata.namespace}/{owner.name}"
         rt = svc.metadata.labels.get("tf-replica-type", "")
@@ -219,17 +234,46 @@ class TFJobController:
             return
 
         old_status = to_jsonable(job.status)
+        # The selector-filtered LIST covers both our children and
+        # adoptable orphans (an adoptable orphan is by definition
+        # label-matched). The reference lists the whole namespace
+        # (labels.Everything(), jobcontroller/pod.go:165-196) but
+        # against an in-memory informer cache; doing that over HTTP
+        # would transfer every pod in the namespace on every sync.
+        # Release-on-mismatch still happens in the claim step for any
+        # mislabeled child that reaches it.
         pods = self.substrate.list_pods(namespace, gen_labels(name))
         services = self.substrate.list_services(namespace, gen_labels(name))
         self.reconciler.reconcile(job, pods, services)
         if to_jsonable(job.status) != old_status:
             self._update_status(job)
 
+    def _fresh_job(self, namespace: str, name: str) -> Optional[TFJob]:
+        """Live job read for the adoption re-check (reference
+        RecheckDeletionTimestamp, jobcontroller.go canAdoptFunc)."""
+        try:
+            return self.substrate.get_job(namespace, name)
+        except NotFound:
+            return None
+
     def _update_status(self, job: TFJob) -> None:
         try:
             self.substrate.update_job_status(job)
         except NotFound:
             pass  # job deleted mid-sync; nothing to persist
+        except Conflict:
+            # normal contention (admission vs sync, adoption bumping the
+            # job): retry once onto the fresh resourceVersion; a second
+            # conflict falls through to the workqueue's rate-limited
+            # requeue like the reference's UpdateStatus error path
+            try:
+                fresh = self.substrate.get_job(job.namespace, job.name)
+            except NotFound:
+                return
+            if fresh.metadata.uid != job.metadata.uid:
+                return  # name reused by a NEW job; our status is not its
+            fresh.status = job.status
+            self.substrate.update_job_status(fresh)
 
     def _delete_job(self, job: TFJob) -> None:
         """TTL-driven deletion (reference job.go:236-254)."""

@@ -34,7 +34,12 @@ from ..api.types import (
     is_retryable_exit_code,
     replica_name,
 )
-from ..runtime.control import PodControl, ServiceControl, is_controlled_by
+from ..runtime.control import (
+    PodControl,
+    ServiceControl,
+    is_controlled_by,
+    owner_reference as _owner_reference,
+)
 from ..runtime.expectations import ControllerExpectations
 from .clock import Clock
 from . import cluster_spec
@@ -112,6 +117,7 @@ class Reconciler:
         delete_job: Callable[[TFJob], None] = lambda job: None,
         gang: Optional[object] = None,
         metrics=None,
+        fresh_job: Optional[Callable[[str, str], Optional[TFJob]]] = None,
     ) -> None:
         self.pod_control = pod_control
         self.service_control = service_control
@@ -123,6 +129,7 @@ class Reconciler:
         self.schedule_resync = schedule_resync
         self.delete_job = delete_job
         self.gang = gang
+        self.fresh_job = fresh_job
         self.status_updater = StatusUpdater(
             now=self.clock.now_iso,
             record_event=self._job_event,
@@ -142,16 +149,93 @@ class Reconciler:
 
     # -- child ownership ---------------------------------------------------
 
+    def _job_is_live(self, job: TFJob) -> bool:
+        """Live re-check before adoption (reference ControllerRefManager
+        canAdoptFunc + RecheckDeletionTimestamp, service_ref_manager.go:
+        32-60): a fresh read must show the same job (uid match) and no
+        pending deletion — adopting on a stale cache could graft an
+        ownerRef pointing at a gone controller."""
+        if self.fresh_job is None:
+            return True  # no live source injected (pure unit harness)
+        try:
+            fresh = self.fresh_job(job.namespace, job.name)
+        except Exception:
+            return False
+        return (
+            fresh is not None
+            and fresh.metadata.uid == job.metadata.uid
+            and fresh.metadata.deletion_timestamp is None
+        )
+
+    def _claim(self, job: TFJob, objs: List, patch_refs: Callable) -> List:
+        """Full ref-manager claim semantics (reference
+        service_ref_manager.go:32-60, jobcontroller/pod.go:165-196):
+
+        - controlled by us + selector matches  -> keep
+        - controlled by us + selector mismatch -> RELEASE (drop our ref)
+        - another controller owns it           -> never touch (no co-claim)
+        - orphan + selector matches            -> ADOPT (patch our
+          controller ownerRef on, after a live job re-check) so cascade
+          GC and CleanPodPolicy see it as ours
+        """
+        selector = gen_labels(job.name)
+        claimed: List = []
+        # one live re-check per claim pass, not per orphan (the
+        # reference memoizes the same way: RecheckDeletionTimestamp
+        # wraps canAdoptFunc in sync.Once per claim manager)
+        job_live: Optional[bool] = None
+        for obj in objs:
+            meta = obj.metadata
+            matches = all(
+                meta.labels.get(key) == value for key, value in selector.items()
+            )
+            if is_controlled_by(meta, job):
+                if matches:
+                    claimed.append(obj)
+                    continue
+                released = [
+                    ref for ref in meta.owner_references
+                    if ref.uid != job.metadata.uid
+                ]
+                try:
+                    patch_refs(meta.namespace, meta.name, released, meta.uid)
+                except Exception as err:
+                    logger.warning(
+                        "job %s: failed to release %s: %s",
+                        job.name, meta.name, err,
+                    )
+                continue
+            if not matches or any(ref.controller for ref in meta.owner_references):
+                continue
+            if meta.deletion_timestamp is not None:
+                # never adopt a terminating orphan (client-go ClaimPods):
+                # it is guaranteed to disappear; counting it as a live
+                # replica would stall the replacement create
+                continue
+            if job_live is None:
+                job_live = self._job_is_live(job)
+            if not job_live:
+                continue
+            adopted = [deep_copy(ref) for ref in meta.owner_references]
+            adopted.append(_owner_reference(job))
+            try:
+                # meta.uid in the patch: if the name was reused by a new
+                # object between LIST and patch, the write 409s instead
+                # of grafting our ref onto someone else's child
+                patch_refs(meta.namespace, meta.name, adopted, meta.uid)
+            except Exception as err:
+                logger.warning(
+                    "job %s: failed to adopt %s: %s", job.name, meta.name, err
+                )
+                continue
+            meta.owner_references = adopted  # act on the fresh truth now
+            claimed.append(obj)
+        return claimed
+
     def claim_pods(self, job: TFJob, pods: List[k8s.Pod]) -> List[k8s.Pod]:
-        """Selector-matched pods that this job controls, or that no
-        controller owns (light-weight adoption; reference
+        """Adopt/release/filter pods for this job (reference
         GetPodsForJob + ClaimPods, jobcontroller/pod.go:165-196)."""
-        return [
-            p
-            for p in pods
-            if is_controlled_by(p.metadata, job)
-            or not any(ref.controller for ref in p.metadata.owner_references)
-        ]
+        return self._claim(job, pods, self.pod_control.patch_pod_owner_references)
 
     # -- top-level reconcile ----------------------------------------------
 
@@ -491,12 +575,9 @@ class Reconciler:
     # -- services ----------------------------------------------------------
 
     def claim_services(self, job: TFJob, services: List[k8s.Service]) -> List[k8s.Service]:
-        return [
-            s
-            for s in services
-            if is_controlled_by(s.metadata, job)
-            or not any(ref.controller for ref in s.metadata.owner_references)
-        ]
+        return self._claim(
+            job, services, self.service_control.patch_service_owner_references
+        )
 
     def reconcile_services(
         self, job: TFJob, services: List[k8s.Service], rtype: ReplicaType, spec: ReplicaSpec

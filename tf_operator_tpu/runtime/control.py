@@ -46,11 +46,19 @@ class PodControl(Protocol):
     def create_pod(self, namespace: str, pod: k8s.Pod, job: TFJob) -> None: ...
     def delete_pod(self, namespace: str, name: str, job: TFJob) -> None: ...
     def patch_pod_labels(self, namespace: str, name: str, labels: dict) -> None: ...
+    def patch_pod_owner_references(
+        self, namespace: str, name: str, refs: List[k8s.OwnerReference],
+        expected_uid: str = "",
+    ) -> None: ...
 
 
 class ServiceControl(Protocol):
     def create_service(self, namespace: str, service: k8s.Service, job: TFJob) -> None: ...
     def delete_service(self, namespace: str, name: str, job: TFJob) -> None: ...
+    def patch_service_owner_references(
+        self, namespace: str, name: str, refs: List[k8s.OwnerReference],
+        expected_uid: str = "",
+    ) -> None: ...
 
 
 class RealPodControl:
@@ -79,6 +87,14 @@ class RealPodControl:
     def patch_pod_labels(self, namespace: str, name: str, labels: dict) -> None:
         self._substrate.patch_pod_labels(namespace, name, labels)
 
+    def patch_pod_owner_references(
+        self, namespace: str, name: str, refs: List[k8s.OwnerReference],
+        expected_uid: str = "",
+    ) -> None:
+        self._substrate.patch_pod_owner_references(
+            namespace, name, refs, expected_uid
+        )
+
 
 class RealServiceControl:
     def __init__(self, substrate: Substrate, recorder: Recorder) -> None:
@@ -103,6 +119,14 @@ class RealServiceControl:
             f"Deleted service: {name}",
         )
 
+    def patch_service_owner_references(
+        self, namespace: str, name: str, refs: List[k8s.OwnerReference],
+        expected_uid: str = "",
+    ) -> None:
+        self._substrate.patch_service_owner_references(
+            namespace, name, refs, expected_uid
+        )
+
 
 class FakePodControl:
     """Records intents; used by table-driven reconciler tests the way the
@@ -112,6 +136,7 @@ class FakePodControl:
         self.created: List[k8s.Pod] = []
         self.deleted: List[str] = []
         self.patched: List[tuple] = []
+        self.owner_patched: List[tuple] = []  # (name, refs)
         self.create_error: Optional[Exception] = None
 
     def create_pod(self, namespace: str, pod: k8s.Pod, job: TFJob) -> None:
@@ -127,11 +152,18 @@ class FakePodControl:
     def patch_pod_labels(self, namespace: str, name: str, labels: dict) -> None:
         self.patched.append((name, labels))
 
+    def patch_pod_owner_references(
+        self, namespace: str, name: str, refs: List[k8s.OwnerReference],
+        expected_uid: str = "",
+    ) -> None:
+        self.owner_patched.append((name, [deep_copy(r) for r in refs]))
+
 
 class FakeServiceControl:
     def __init__(self) -> None:
         self.created: List[k8s.Service] = []
         self.deleted: List[str] = []
+        self.owner_patched: List[tuple] = []
 
     def create_service(self, namespace: str, service: k8s.Service, job: TFJob) -> None:
         service = deep_copy(service)
@@ -140,3 +172,9 @@ class FakeServiceControl:
 
     def delete_service(self, namespace: str, name: str, job: TFJob) -> None:
         self.deleted.append(name)
+
+    def patch_service_owner_references(
+        self, namespace: str, name: str, refs: List[k8s.OwnerReference],
+        expected_uid: str = "",
+    ) -> None:
+        self.owner_patched.append((name, [deep_copy(r) for r in refs]))

@@ -49,6 +49,10 @@ class ApiError(RuntimeError):
         self.status = status
 
 
+class _WatchGone(Exception):
+    """Watch resourceVersion expired (410): relist required."""
+
+
 def _raise_for_status(status: int, body: str) -> None:
     if status == 404:
         raise NotFound(body)
@@ -75,7 +79,13 @@ class KubeSubstrate:
         self._token = token
         self._ssl = ssl_context
         self._subscribers: Dict[str, List[Callable]] = {}
+        self._sub_lock = threading.Lock()
         self._watch_threads: List[threading.Thread] = []
+        self._watch_rv: Dict[str, str] = {}  # last delivered resourceVersion
+        # last raw object per (kind, ns/name), so a relist after 410 can
+        # synthesize DELETED events for objects that vanished during the
+        # outage (the informer store's role)
+        self._watch_known: Dict[str, Dict[str, dict]] = {}
         self._stop = threading.Event()
 
     # -- construction ------------------------------------------------------
@@ -254,6 +264,26 @@ class KubeSubstrate:
         )
         return from_jsonable(data, k8s.Pod)
 
+    def patch_pod_owner_references(
+        self, namespace: str, name: str, refs: List[k8s.OwnerReference],
+        expected_uid: str = "",
+    ) -> k8s.Pod:
+        """Adoption/release patch (reference ControllerRefManager's
+        ownerReferences patch, service_ref_manager.go:32-60). The
+        object's uid rides in the patch body so the apiserver rejects
+        the write if the name was reused by a different object between
+        our LIST and this patch (uid is immutable -> 409/422)."""
+        meta: dict = {"ownerReferences": [to_jsonable(r) for r in refs]}
+        if expected_uid:
+            meta["uid"] = expected_uid
+        data = self._request(
+            "PATCH",
+            self._core_path("pods", namespace, name),
+            {"metadata": meta},
+            content_type="application/merge-patch+json",
+        )
+        return from_jsonable(data, k8s.Pod)
+
     # -- Services ----------------------------------------------------------
 
     def create_service(self, service: k8s.Service) -> k8s.Service:
@@ -273,6 +303,21 @@ class KubeSubstrate:
 
     def delete_service(self, namespace: str, name: str) -> None:
         self._request("DELETE", self._core_path("services", namespace, name))
+
+    def patch_service_owner_references(
+        self, namespace: str, name: str, refs: List[k8s.OwnerReference],
+        expected_uid: str = "",
+    ) -> k8s.Service:
+        meta: dict = {"ownerReferences": [to_jsonable(r) for r in refs]}
+        if expected_uid:
+            meta["uid"] = expected_uid
+        data = self._request(
+            "PATCH",
+            self._core_path("services", namespace, name),
+            {"metadata": meta},
+            content_type="application/merge-patch+json",
+        )
+        return from_jsonable(data, k8s.Service)
 
     # -- PodGroups ---------------------------------------------------------
 
@@ -425,8 +470,10 @@ class KubeSubstrate:
     # -- Watches -----------------------------------------------------------
 
     def subscribe(self, kind: str, callback: Callable) -> None:
-        self._subscribers.setdefault(kind, []).append(callback)
-        if len(self._subscribers[kind]) == 1:
+        with self._sub_lock:
+            self._subscribers.setdefault(kind, []).append(callback)
+            first = len(self._subscribers[kind]) == 1
+        if first:
             thread = threading.Thread(
                 target=self._watch_loop, args=(kind,),
                 name=f"watch-{kind}", daemon=True,
@@ -438,21 +485,59 @@ class KubeSubstrate:
         """Remove a watch callback. The kind's watch thread is left
         running (it is shared and cheap when idle); only the callback
         stops receiving events."""
-        callbacks = self._subscribers.get(kind, [])
-        if callback in callbacks:
-            callbacks.remove(callback)
+        with self._sub_lock:
+            callbacks = self._subscribers.get(kind, [])
+            if callback in callbacks:
+                callbacks.remove(callback)
+
+    def _list_path(self, kind: str) -> str:
+        if kind == "tfjob":
+            return f"/apis/{GROUP_NAME}/{VERSION}/{PLURAL}"
+        return f"/api/v1/{kind}s"
 
     def _watch_path(self, kind: str) -> str:
-        if kind == "tfjob":
-            return f"/apis/{GROUP_NAME}/{VERSION}/{PLURAL}?watch=true"
-        return f"/api/v1/{kind}s?watch=true"
+        return self._list_path(kind) + "?watch=true"
+
+    def _relist(self, kind: str) -> str:
+        """LIST to (re)establish a watch position: record the collection
+        resourceVersion, replay every live object as a synthetic
+        MODIFIED, and synthesize DELETED for previously-seen objects the
+        list no longer contains — the reflector + informer-store
+        relist-after-410 (client-go semantics; reference
+        unstructured/informer.go:25-63 inherits it). Without the
+        DELETED side, delete-driven cleanup (port release, expectation
+        teardown) would silently never fire for objects removed during
+        the outage."""
+        data = self._request("GET", self._list_path(kind))
+        items = data.get("items", [])
+        rv = data.get("metadata", {}).get("resourceVersion") or "0"
+        listed_keys = {_obj_key(item) for item in items}
+        known = self._watch_known.setdefault(kind, {})
+        for key, stale in list(known.items()):
+            if key not in listed_keys:
+                self._deliver(kind, DELETED, stale, update_rv=False)
+        for item in items:
+            self._deliver(kind, MODIFIED, item, update_rv=False)
+        self._watch_rv[kind] = rv
+        return rv
 
     def _watch_loop(self, kind: str) -> None:
-        """Chunked watch stream with reconnect — the informer ListWatch
-        role (reference unstructured/informer.go:50-62)."""
+        """Chunked watch stream with resourceVersion resume — the
+        informer ListWatch + reflector role (reference
+        unstructured/informer.go:50-62). Reconnects resume from the last
+        delivered resourceVersion so no events are lost during a
+        disconnect; a 410 Gone (expired version) triggers a full relist.
+        """
         while not self._stop.is_set():
             try:
-                req = urllib.request.Request(self.base_url + self._watch_path(kind))
+                rv = self._watch_rv.get(kind)
+                if rv is None:
+                    rv = self._relist(kind)
+                path = (
+                    self._watch_path(kind)
+                    + f"&resourceVersion={rv}&allowWatchBookmarks=true"
+                )
+                req = urllib.request.Request(self.base_url + path)
                 req.add_header("Accept", "application/json")
                 if self._token:
                     req.add_header("Authorization", f"Bearer {self._token}")
@@ -463,8 +548,27 @@ class KubeSubstrate:
                         if self._stop.is_set():
                             return
                         self._dispatch(kind, line)
+            except _WatchGone:
+                logger.warning(
+                    "watch %s: resourceVersion expired (410 Gone); relisting",
+                    kind,
+                )
+                self._watch_rv.pop(kind, None)
+            except urllib.error.HTTPError as err:
+                if err.code == 410:
+                    self._watch_rv.pop(kind, None)
+                    continue
+                logger.warning("watch %s failed: %s; reconnecting", kind, err)
+                self._stop.wait(2.0)
             except Exception as err:
-                logger.warning("watch %s disconnected: %s; reconnecting", kind, err)
+                # connection-level failure (apiserver down): back off —
+                # a 0.2s loop would hammer a recovering apiserver with a
+                # relist per retry. Clean mid-stream EOFs don't raise and
+                # reconnect immediately with the resume rv.
+                logger.warning(
+                    "watch %s disconnected: %s; resuming from rv %s",
+                    kind, err, self._watch_rv.get(kind),
+                )
                 self._stop.wait(2.0)
 
     def _dispatch(self, kind: str, line: bytes) -> None:
@@ -474,22 +578,53 @@ class KubeSubstrate:
             return
         verb = event.get("type")
         obj = event.get("object", {})
+        if verb == "ERROR":
+            if isinstance(obj, dict) and obj.get("code") == 410:
+                raise _WatchGone()
+            logger.warning("watch %s error event: %s", kind, obj)
+            return
+        if verb == "BOOKMARK":
+            rv = obj.get("metadata", {}).get("resourceVersion")
+            if rv:
+                self._watch_rv[kind] = rv
+            return
         if verb not in (ADDED, MODIFIED, DELETED):
             return
-        if kind == "tfjob":
-            try:
-                parsed: Any = TFJob.from_dict(obj)
-            except (TypeError, ValueError) as err:
-                # bad specs must not kill the watch (kubeflow#561)
-                logger.warning("ignoring malformed TFJob event: %s", err)
-                return
-        elif kind == "pod":
-            parsed = from_jsonable(obj, k8s.Pod)
-        elif kind == "service":
-            parsed = from_jsonable(obj, k8s.Service)
+        self._deliver(kind, verb, obj)
+
+    def _deliver(
+        self, kind: str, verb: str, obj: dict, update_rv: bool = True
+    ) -> None:
+        # Advance the resume position and the known-object store BEFORE
+        # parsing: with resourceVersion resume, a parse failure that left
+        # the rv behind would replay the same malformed event on every
+        # reconnect — a permanent poison pill.
+        if update_rv:
+            rv = obj.get("metadata", {}).get("resourceVersion")
+            if rv:
+                self._watch_rv[kind] = rv
+        known = self._watch_known.setdefault(kind, {})
+        key = _obj_key(obj)
+        if verb == DELETED:
+            known.pop(key, None)
         else:
-            parsed = obj
-        for callback in self._subscribers.get(kind, []):
+            known[key] = obj
+        try:
+            if kind == "tfjob":
+                parsed: Any = TFJob.from_dict(obj)
+            elif kind == "pod":
+                parsed = from_jsonable(obj, k8s.Pod)
+            elif kind == "service":
+                parsed = from_jsonable(obj, k8s.Service)
+            else:
+                parsed = obj
+        except (TypeError, ValueError, KeyError) as err:
+            # bad specs must not kill (or wedge) the watch (kubeflow#561)
+            logger.warning("ignoring malformed %s event: %s", kind, err)
+            return
+        with self._sub_lock:
+            callbacks = list(self._subscribers.get(kind, []))
+        for callback in callbacks:
             try:
                 callback(verb, parsed)
             except Exception:
@@ -497,6 +632,11 @@ class KubeSubstrate:
 
     def close(self) -> None:
         self._stop.set()
+
+
+def _obj_key(obj: dict) -> str:
+    meta = obj.get("metadata", {})
+    return f"{meta.get('namespace', '')}/{meta.get('name', '')}"
 
 
 def _selector_query(selector: Optional[Dict[str, str]]) -> str:

@@ -68,8 +68,10 @@ class Lease:
     lease_duration_seconds: float = DEFAULT_LEASE_DURATION
     resource_version: str = ""
 
-    def expired(self, now: float) -> bool:
-        return now > self.renew_time + self.lease_duration_seconds
+    # NOTE: deliberately no expired(now) helper — judging expiry by
+    # comparing a local clock against the holder's written renewTime is
+    # skew-unsafe; LeaseLock tracks locally-observed change instead
+    # (see server/leader.py and test_clock_skew_does_not_steal_healthy_lease).
 
     def copy(self) -> "Lease":
         return dataclasses.replace(self)
@@ -103,6 +105,10 @@ class Substrate(Protocol):
     def patch_pod_labels(
         self, namespace: str, name: str, labels: Dict[str, str]
     ) -> k8s.Pod: ...
+    def patch_pod_owner_references(
+        self, namespace: str, name: str, refs: List[k8s.OwnerReference],
+        expected_uid: str = "",
+    ) -> k8s.Pod: ...
 
     # Services
     def create_service(self, service: k8s.Service) -> k8s.Service: ...
@@ -110,6 +116,10 @@ class Substrate(Protocol):
         self, namespace: str, selector: Optional[Dict[str, str]] = None
     ) -> List[k8s.Service]: ...
     def delete_service(self, namespace: str, name: str) -> None: ...
+    def patch_service_owner_references(
+        self, namespace: str, name: str, refs: List[k8s.OwnerReference],
+        expected_uid: str = "",
+    ) -> k8s.Service: ...
 
     # Events + watches
     def record_event(self, event: k8s.Event) -> None: ...
@@ -304,6 +314,29 @@ class InMemorySubstrate:
             self._notify("pod", MODIFIED, pod)
             return deep_copy(pod)
 
+    def patch_pod_owner_references(
+        self, namespace: str, name: str, refs: List[k8s.OwnerReference],
+        expected_uid: str = "",
+    ) -> k8s.Pod:
+        """Replace a pod's ownerReferences — the adoption/release patch
+        the reference's ControllerRefManager issues
+        (service_ref_manager.go:32-60). With expected_uid set, the patch
+        is rejected if the name now belongs to a different object (uid
+        is immutable; the apiserver behaves the same)."""
+        with self._lock:
+            pod = self._pods.get((namespace, name))
+            if pod is None:
+                raise NotFound(f"pod {namespace}/{name}")
+            if expected_uid and pod.metadata.uid != expected_uid:
+                raise Conflict(
+                    f"pod {namespace}/{name}: uid changed "
+                    f"({pod.metadata.uid} != {expected_uid})"
+                )
+            pod.metadata.owner_references = [deep_copy(r) for r in refs]
+            pod.metadata.resource_version = str(next(self._rv))
+            self._notify("pod", MODIFIED, pod)
+            return deep_copy(pod)
+
     # -- Services ----------------------------------------------------------
 
     def create_service(self, service: k8s.Service) -> k8s.Service:
@@ -334,6 +367,24 @@ class InMemorySubstrate:
             if svc is None:
                 raise NotFound(f"service {namespace}/{name}")
             self._notify("service", DELETED, svc)
+
+    def patch_service_owner_references(
+        self, namespace: str, name: str, refs: List[k8s.OwnerReference],
+        expected_uid: str = "",
+    ) -> k8s.Service:
+        with self._lock:
+            svc = self._services.get((namespace, name))
+            if svc is None:
+                raise NotFound(f"service {namespace}/{name}")
+            if expected_uid and svc.metadata.uid != expected_uid:
+                raise Conflict(
+                    f"service {namespace}/{name}: uid changed "
+                    f"({svc.metadata.uid} != {expected_uid})"
+                )
+            svc.metadata.owner_references = [deep_copy(r) for r in refs]
+            svc.metadata.resource_version = str(next(self._rv))
+            self._notify("service", MODIFIED, svc)
+            return deep_copy(svc)
 
     # -- PodGroups (gang scheduling) ---------------------------------------
 

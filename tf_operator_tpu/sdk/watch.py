@@ -16,7 +16,20 @@ import time
 from typing import Iterator, Optional
 
 from ..api import types as t
-from ..runtime.substrate import NotFound, Substrate
+from ..runtime.substrate import DELETED, NotFound, Substrate
+
+
+def _stale_vs_list(listed_rv: Optional[str], event_rv: str) -> bool:
+    """True when an event's resourceVersion is not newer than what the
+    initial list already yielded for that object. Numeric comparison
+    when both versions parse as integers (both substrates emit integer
+    versions); opaque versions degrade to exact-duplicate detection."""
+    if not listed_rv or not event_rv:
+        return False
+    try:
+        return int(event_rv) <= int(listed_rv)
+    except ValueError:
+        return event_rv == listed_rv
 
 
 @dataclasses.dataclass
@@ -75,12 +88,17 @@ def watch(
                     continue
                 if name is not None and job.name != name:
                     continue
-                if (
-                    verb == "ADDED"
-                    and listed_versions.get(job.key())
-                    == job.metadata.resource_version
+                if verb != DELETED and _stale_vs_list(
+                    listed_versions.get(job.key()),
+                    job.metadata.resource_version,
                 ):
-                    continue  # already yielded by the initial list
+                    # an ADDED/MODIFIED queued between subscribe() and
+                    # the LIST carries state the list already yielded (or
+                    # newer state superseded) — replaying it would hand
+                    # the consumer an out-of-order status regression.
+                    # DELETED is never dropped: a delete racing the list
+                    # can legitimately share the listed resourceVersion.
+                    continue
                 yield WatchEvent(verb, job)
                 if (
                     stop_at_terminal

@@ -90,9 +90,37 @@ class LeaseLock:
         self.clock = clock
         # rendered in "became leader (lock ...)" log lines
         self.path = f"lease:{namespace}/{name}"
+        # Expiry is judged by LOCAL observation time, never by comparing
+        # our clock against the holder's written renewTime: client-go
+        # leader election works the same way precisely because
+        # cross-replica wall-clock skew is common — a follower whose
+        # clock runs ahead of the leader's must not steal a healthy
+        # lease. We remember the last distinct lease record we saw and
+        # the local instant we saw it; the lease is "expired" only when
+        # that record has sat unchanged for longer than its duration.
+        # (A fresh candidate therefore waits a full lease_duration
+        # before its first steal — same as client-go.)
+        self._observed_record: Optional[tuple] = None
+        self._observed_at: float = 0.0
 
     def _read(self) -> Optional[Lease]:
         return self.substrate.get_lease(self.namespace, self.name)
+
+    def _observe(self, current: Lease) -> None:
+        record = (
+            current.holder,
+            current.renew_time,
+            current.acquire_time,
+            current.resource_version,
+        )
+        if record != self._observed_record:
+            self._observed_record = record
+            self._observed_at = self.clock()
+
+    def _locally_expired(self, current: Lease) -> bool:
+        return (
+            self.clock() - self._observed_at > current.lease_duration_seconds
+        )
 
     def try_acquire(self) -> bool:
         now = self.clock()
@@ -110,7 +138,10 @@ class LeaseLock:
                     )
                 )
                 return True
-            if current.holder not in ("", self.identity) and not current.expired(now):
+            self._observe(current)
+            if current.holder not in ("", self.identity) and not self._locally_expired(
+                current
+            ):
                 return False
             fresh = current.copy()
             if fresh.holder != self.identity:

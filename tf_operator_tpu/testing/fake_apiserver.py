@@ -31,6 +31,10 @@ from typing import Dict, List, Optional, Tuple
 from urllib.parse import parse_qs, urlparse
 
 
+_KILL = b"__KILL_WATCH__"
+_HISTORY_LIMIT = 1000
+
+
 class _Store:
     """All resources, keyed by (collection_path, namespace, name)."""
 
@@ -38,22 +42,51 @@ class _Store:
         self.lock = threading.RLock()
         self.objects: Dict[Tuple[str, str, str], dict] = {}
         self.rv = itertools.count(1)
+        self.last_rv = 0
         self.uid = itertools.count(1)
         self.watchers: Dict[str, List] = {}  # collection kind -> queues
+        # per-collection event history for resourceVersion-resumed
+        # watches (the apiserver's bounded watch cache): (rv, line)
+        self.history: Dict[str, List[Tuple[int, bytes]]] = {}
+        # smallest rv still replayable; resuming below it -> 410 Gone
+        self.oldest_rv: Dict[str, int] = {}
 
     def stamp(self, obj: dict) -> None:
         meta = obj.setdefault("metadata", {})
         if not meta.get("uid"):
             meta["uid"] = f"uid-{next(self.uid)}"
-        meta["resourceVersion"] = str(next(self.rv))
+        self.last_rv = next(self.rv)
+        meta["resourceVersion"] = str(self.last_rv)
 
     def notify(self, collection: str, verb: str, obj: dict) -> None:
         # serialize NOW, under the store lock: queues must hold frozen
         # bytes, not live dict references a later mutation could change
         # (or crash json.dumps) while the watch thread drains
         line = json.dumps({"type": verb, "object": obj}).encode() + b"\n"
+        rv = int(obj.get("metadata", {}).get("resourceVersion") or 0)
+        log = self.history.setdefault(collection, [])
+        log.append((rv, line))
+        if len(log) > _HISTORY_LIMIT:
+            dropped = log[: len(log) - _HISTORY_LIMIT]
+            del log[: len(log) - _HISTORY_LIMIT]
+            self.oldest_rv[collection] = max(
+                self.oldest_rv.get(collection, 0), dropped[-1][0]
+            )
         for queue in self.watchers.get(collection, []):
             queue.append(line)
+
+    def compact(self, collection: str) -> None:
+        """Drop the watch history — a client resuming from any rv seen
+        so far gets 410 Gone (apiserver watch-cache expiry)."""
+        with self.lock:
+            self.history[collection] = []
+            self.oldest_rv[collection] = self.last_rv
+
+    def kill_watchers(self, collection: str) -> None:
+        """Force-close every open watch stream on this collection."""
+        with self.lock:
+            for queue in list(self.watchers.get(collection, [])):
+                queue.append(_KILL)
 
 
 def _split(path: str):
@@ -142,7 +175,7 @@ class FakeApiServer:
                 params = parse_qs(url.query)
                 plural, namespace, name, _ = _split(url.path)
                 if params.get("watch") == ["true"]:
-                    return self._watch(plural)
+                    return self._watch(plural, params)
                 with store.lock:
                     if name is not None:
                         obj = store.objects.get((plural, namespace, name))
@@ -157,17 +190,68 @@ class FakeApiServer:
                         and (namespace is None or ns == namespace)
                         and (not selector or _matches_selector(obj, selector))
                     ]
-                    return self._reply(200, {"items": items})
+                    # lists carry the collection resourceVersion so a
+                    # client can start a watch from "now"
+                    return self._reply(
+                        200,
+                        {
+                            "metadata": {"resourceVersion": str(store.last_rv)},
+                            "items": items,
+                        },
+                    )
 
-            def _watch(self, plural: str) -> None:
+            def _watch(self, plural: str, params: dict) -> None:
                 queue: list = []
+                since = (params.get("resourceVersion") or [""])[0]
                 with store.lock:
+                    replay: List[bytes] = []
+                    gone = False
+                    if since:
+                        rv = int(since)
+                        if rv < store.oldest_rv.get(plural, 0):
+                            # watch cache no longer covers rv: stream a
+                            # single ERROR event (apiserver's 410 shape)
+                            gone = True
+                        else:
+                            replay = [
+                                line
+                                for (erv, line) in store.history.get(plural, [])
+                                if erv > rv
+                            ]
+                    # register under the same lock that notify() holds:
+                    # replay covers everything <= now, the queue covers
+                    # everything after — no gap, no duplicate
                     store.watchers.setdefault(plural, []).append(queue)
                 try:
                     self.send_response(200)
                     self.send_header("Content-Type", "application/json")
                     self.send_header("Transfer-Encoding", "chunked")
                     self.end_headers()
+
+                    def emit(line: bytes) -> None:
+                        self.wfile.write(
+                            f"{len(line):x}\r\n".encode() + line + b"\r\n"
+                        )
+                        self.wfile.flush()
+
+                    if gone:
+                        emit(
+                            json.dumps(
+                                {
+                                    "type": "ERROR",
+                                    "object": {
+                                        "kind": "Status",
+                                        "code": 410,
+                                        "reason": "Expired",
+                                        "message": "too old resource version",
+                                    },
+                                }
+                            ).encode()
+                            + b"\n"
+                        )
+                        return
+                    for line in replay:
+                        emit(line)
                     sent = 0
                     import time as _time
 
@@ -175,10 +259,9 @@ class FakeApiServer:
                     while _time.monotonic() < deadline and not closing.is_set():
                         while sent < len(queue):
                             line = queue[sent]
-                            self.wfile.write(
-                                f"{len(line):x}\r\n".encode() + line + b"\r\n"
-                            )
-                            self.wfile.flush()
+                            if line is _KILL:
+                                return  # forced disconnect (test hook)
+                            emit(line)
                             sent += 1
                         _time.sleep(0.02)
                 except (BrokenPipeError, ConnectionResetError):
@@ -244,6 +327,14 @@ class FakeApiServer:
                     stored = store.objects.get(key)
                     if stored is None:
                         return self._error(404, "NotFound", f"{plural} {name}")
+                    # uid is immutable: a patch carrying a different uid
+                    # is a stale-object write (adoption racing a
+                    # name-reuse) and must be rejected like the apiserver
+                    sent_uid = patch.get("metadata", {}).get("uid")
+                    if sent_uid and sent_uid != stored["metadata"].get("uid"):
+                        return self._error(
+                            409, "Conflict", f"{plural} {name}: uid mismatch"
+                        )
                     _merge(stored, patch)
                     store.stamp(stored)
                     store.notify(plural, "MODIFIED", stored)
@@ -257,6 +348,10 @@ class FakeApiServer:
                     obj = store.objects.pop(key, None)
                     if obj is None:
                         return self._error(404, "NotFound", f"{plural} {name}")
+                    # deletion advances the collection resourceVersion
+                    # (etcd semantics): the DELETED event carries a fresh
+                    # rv so resumed watches know they missed it
+                    store.stamp(obj)
                     store.notify(plural, "DELETED", obj)
                     # cascade: children owned by the deleted object (the
                     # k8s GC controller's role)
@@ -273,6 +368,7 @@ class FakeApiServer:
                     ]
                     for k in doomed:
                         child = store.objects.pop(k)
+                        store.stamp(child)
                         store.notify(k[0], "DELETED", child)
                     return self._reply(200, {"kind": "Status", "status": "Success"})
 
