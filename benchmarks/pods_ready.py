@@ -1,0 +1,111 @@
+"""Pods-ready latency harness (BASELINE.md row 1: p50 < 90 s target).
+
+Measures apply -> all-pods-Running over the live-process stack: real
+controller threads, real child processes (ProcessKubelet running the
+fake workload server, the analog of the reference's test-server
+containers), the full watch -> expectations -> reconcile path. The
+reference's equivalent number came from kubectl apply on a GKE cluster
+(py/kubeflow/tf_operator/tf_job_client.py wait loops); here the
+scheduling substrate is local, so this measures the CONTROLLER's
+contribution to readiness latency — the part this repo owns.
+
+Usage:  python benchmarks/pods_ready.py [--jobs 20] [--workers 2]
+Prints one JSON line and writes PODS_READY.json at the repo root.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import statistics
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from tf_operator_tpu.api import k8s, types as t
+from tf_operator_tpu.controller import TFJobController
+from tf_operator_tpu.runtime import InMemorySubstrate
+from tf_operator_tpu.runtime.process_kubelet import ProcessKubelet
+
+
+def make_job(name: str, workers: int) -> t.TFJob:
+    job = t.TFJob(metadata=k8s.ObjectMeta(name=name, namespace="default"))
+    job.spec.tf_replica_specs["Worker"] = t.ReplicaSpec(
+        replicas=workers,
+        template=k8s.PodTemplateSpec(
+            spec=k8s.PodSpec(
+                containers=[k8s.Container(name="tensorflow", image="local")]
+            )
+        ),
+    )
+    return job
+
+
+def measure_one(substrate, name: str, workers: int, timeout: float = 90.0) -> float:
+    """Seconds from create_job to every pod Running."""
+    start = time.monotonic()
+    substrate.create_job(make_job(name, workers))
+    deadline = start + timeout
+    while time.monotonic() < deadline:
+        pods = substrate.list_pods("default", t.gen_labels(name))
+        if (
+            len(pods) == workers
+            and all(p.status.phase == k8s.POD_RUNNING for p in pods)
+        ):
+            return time.monotonic() - start
+        time.sleep(0.01)
+    raise TimeoutError(f"job {name}: pods not ready within {timeout}s")
+
+
+def main() -> None:
+    parser = argparse.ArgumentParser()
+    parser.add_argument("--jobs", type=int, default=20)
+    parser.add_argument("--workers", type=int, default=2)
+    parser.add_argument("--out", default=None)
+    args = parser.parse_args()
+
+    substrate = InMemorySubstrate()
+    kubelet = ProcessKubelet(substrate)
+    controller = TFJobController(substrate)
+    controller.run(threadiness=2, resync_period=5.0)
+    samples = []
+    try:
+        for i in range(args.jobs):
+            name = f"ready-{i}"
+            samples.append(measure_one(substrate, name, args.workers))
+            substrate.delete_job("default", name)
+    finally:
+        controller.stop()
+        kubelet.shutdown()
+
+    samples.sort()
+    p50 = statistics.median(samples)
+    p95 = samples[min(len(samples) - 1, int(round(0.95 * len(samples))) )]
+    result = {
+        "metric": "tfjob_pods_ready_p50_seconds",
+        "value": round(p50, 3),
+        "unit": "seconds",
+        "p95": round(p95, 3),
+        "jobs": args.jobs,
+        "workers_per_job": args.workers,
+        "target_seconds": 90.0,
+        "vs_baseline": round(90.0 / p50, 2) if p50 > 0 else 0.0,
+        "note": (
+            "apply->all-Running over live controller + process kubelet; "
+            "local substrate, no cloud scheduler in the path"
+        ),
+    }
+    line = json.dumps(result)
+    print(line)
+    out = args.out or os.path.join(
+        os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+        "PODS_READY.json",
+    )
+    with open(out, "w") as handle:
+        handle.write(line + "\n")
+
+
+if __name__ == "__main__":
+    main()

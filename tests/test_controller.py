@@ -9,6 +9,7 @@ Two tiers, mirroring the reference's strategy (SURVEY.md §4):
 """
 
 import json
+import time
 
 import pytest
 
@@ -755,4 +756,190 @@ class TestAdoption:
         assert any(
             r.controller and r.uid == stored.metadata.uid
             for r in pods[0].metadata.owner_references
+        )
+
+
+class TestBackoffUnderSyncError:
+    """VERDICT r1 weak #5: prove the requeue-count arm of
+    _exceeds_limits (reference controller.go:405-430) actually fires
+    when syncs repeatedly ERROR (not just when pods fail): the
+    rate-limiter count grows on each errored sync and is only
+    forgotten AFTER a successful sync has already read it."""
+
+    class FlakySubstrate(InMemorySubstrate):
+        def __init__(self):
+            super().__init__()
+            self.fail_next_lists = 0
+
+        def list_pods(self, namespace, selector=None):
+            if self.fail_next_lists > 0:
+                self.fail_next_lists -= 1
+                raise RuntimeError("injected apiserver outage")
+            return super().list_pods(namespace, selector)
+
+    def test_backoff_limit_fires_from_requeue_count(self):
+        sub = self.FlakySubstrate()
+        controller = TFJobController(sub)
+        job = make_job({"Worker": 2}, name="flaky")
+        job.spec.run_policy.backoff_limit = 2
+        job.spec.tf_replica_specs["Worker"].restart_policy = (
+            t.RestartPolicy.EXIT_CODE
+        )
+        sub.create_job(job)
+        controller.run_until_quiet()
+        sub.run_all_pending()
+        controller.run_until_quiet()
+
+        # repeated sync errors: each one requeues rate-limited and
+        # grows num_requeues past the backoff limit
+        sub.fail_next_lists = 3
+        for _ in range(3):
+            controller.enqueue("default/flaky")
+            # drain until the errored key is consumed (backoff delays
+            # re-delivery, so poll the queue directly)
+            assert controller.process_next(timeout=5.0)
+        assert controller.queue.num_requeues("default/flaky") >= 3
+
+        # now a retryable pod failure arrives; the job is out of
+        # retries via the REQUEUE count (restarts never happened), so
+        # it must fail instead of restarting
+        sub.terminate_pod("default", "flaky-worker-1", exit_code=137)
+        deadline = time.monotonic() + 10
+        while time.monotonic() < deadline:
+            controller.process_next(timeout=0.5)
+            if sub.get_job("default", "flaky").has_condition(
+                t.ConditionType.FAILED
+            ):
+                break
+        stored = sub.get_job("default", "flaky")
+        assert stored.has_condition(t.ConditionType.FAILED), (
+            stored.status.conditions
+        )
+        assert "backoff limit" in stored.status.conditions[-1].message
+
+
+class TestTPUElasticity:
+    """Slice-granular TPU elasticity end-to-end (VERDICT r1 next #6):
+    a TPU replica-count change restarts the WHOLE slice — every host is
+    recreated wired for the new size — because an ICI mesh cannot be
+    resized in place (SURVEY.md §7 hard part #3). The workload half
+    (orbax resume from the last step) is tested in
+    test_workload.py::TestElasticResume."""
+
+    def _tpu_job(self, sub, replicas=4, name="slice"):
+        job = make_job({"TPU": replicas}, name=name)
+        job.spec.enable_dynamic_worker = True
+        sub.create_job(job)
+        return job
+
+    def _env(self, pod, key):
+        container = pod.spec.container("tensorflow")
+        return container.env_value(key)
+
+    def test_resize_restarts_whole_slice_with_new_env(self):
+        sub = InMemorySubstrate()
+        controller = TFJobController(sub)
+        self._tpu_job(sub, replicas=4)
+        controller.run_until_quiet()
+        assert len(sub.list_pods("default")) == 4
+        for pod in sub.list_pods("default"):
+            assert self._env(pod, t.ENV_NUM_PROCESSES) == "4"
+        sub.run_all_pending()
+        controller.run_until_quiet()
+
+        # resize the slice 4 -> 2
+        stored = sub.get_job("default", "slice")
+        stored.spec.tf_replica_specs["TPU"].replicas = 2
+        sub.update_job(stored)
+        controller.run_until_quiet()
+
+        pods = sub.list_pods("default")
+        assert len(pods) == 2, f"slice should re-form at 2 hosts, got {len(pods)}"
+        for pod in pods:
+            assert self._env(pod, t.ENV_NUM_PROCESSES) == "2"
+            hostnames = self._env(pod, t.ENV_TPU_WORKER_HOSTNAMES).split(",")
+            assert len(hostnames) == 2
+        assert any(
+            e.reason == "SliceResize" for e in sub.events_for("TFJob", "slice")
+        )
+
+    def test_resize_up_also_restarts_slice(self):
+        """Scale-UP must also re-form the slice: running hosts carry a
+        stale TPU_WORKER_HOSTNAMES list that does not include the new
+        hosts, so the old mesh could never absorb them."""
+        sub = InMemorySubstrate()
+        controller = TFJobController(sub)
+        self._tpu_job(sub, replicas=2, name="grow")
+        controller.run_until_quiet()
+        sub.run_all_pending()
+        controller.run_until_quiet()
+
+        stored = sub.get_job("default", "grow")
+        stored.spec.tf_replica_specs["TPU"].replicas = 4
+        sub.update_job(stored)
+        controller.run_until_quiet()
+
+        pods = sub.list_pods("default")
+        assert len(pods) == 4
+        for pod in pods:
+            assert self._env(pod, t.ENV_NUM_PROCESSES) == "4"
+
+    def test_no_resize_without_dynamic_worker_flag(self):
+        sub = InMemorySubstrate()
+        controller = TFJobController(sub)
+        job = make_job({"TPU": 2}, name="static")
+        sub.create_job(job)
+        controller.run_until_quiet()
+        sub.run_all_pending()
+        controller.run_until_quiet()
+        before = {p.metadata.name for p in sub.list_pods("default")}
+
+        stored = sub.get_job("default", "static")
+        stored.spec.tf_replica_specs["TPU"].replicas = 1
+        sub.update_job(stored)
+        controller.run_until_quiet()
+        # without enableDynamicWorker the running slice is left alone
+        after = {p.metadata.name for p in sub.list_pods("default")}
+        assert before == after
+
+
+class TestGangElasticExample:
+    """examples/v1/gang-elastic.yaml wired through the controller: the
+    gang PodGroup tracks the scaled worker count and out-of-range
+    workers are removed (BASELINE config #5)."""
+
+    def test_yaml_scales_with_podgroup(self):
+        import yaml as _yaml
+
+        manifest = _yaml.safe_load(open("examples/v1/gang-elastic.yaml"))
+        job = t.TFJob.from_dict(manifest)
+        sub = InMemorySubstrate()
+        controller = TFJobController(
+            sub, config=ReconcilerConfig(enable_gang_scheduling=True)
+        )
+        sub.create_job(job)
+        controller.run_until_quiet()
+        pods = sub.list_pods("kubeflow")
+        assert len(pods) == 7  # 1 PS + 6 workers
+        group = sub.get_pod_group("kubeflow", "elastic-train")
+        assert group is not None
+        # schedulingPolicy.minAvailable from the manifest
+        assert group.min_member == 4
+        for pod in pods:
+            assert pod.spec.scheduler_name == "volcano"
+
+        sub.run_all_pending()
+        controller.run_until_quiet()
+        stored = sub.get_job("kubeflow", "elastic-train")
+        stored.spec.tf_replica_specs["Worker"].replicas = 4
+        sub.update_job(stored)
+        controller.run_until_quiet()
+        workers = [
+            p for p in sub.list_pods("kubeflow")
+            if p.metadata.labels[t.LABEL_REPLICA_TYPE] == "worker"
+        ]
+        assert len(workers) == 4
+        assert any(
+            e.reason == "ScaleDown"
+            for e in sub.events_for("TFJob", "elastic-train")
         )

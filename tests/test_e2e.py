@@ -244,3 +244,26 @@ class TestPodNames:
             in client.get_logs("names", master=True)["names-worker-0"],
             message="logs captured",
         )
+
+
+class TestPodsReadyHarness:
+    """The pods-ready latency harness (benchmarks/pods_ready.py,
+    BASELINE.md row 1) must run end-to-end and report sane numbers."""
+
+    def test_harness_measures_three_jobs(self, tmp_path):
+        import subprocess
+        import sys
+        import os
+
+        out = tmp_path / "pods_ready.json"
+        repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+        proc = subprocess.run(
+            [sys.executable, os.path.join(repo, "benchmarks", "pods_ready.py"),
+             "--jobs", "3", "--workers", "1", "--out", str(out)],
+            capture_output=True, text=True, timeout=120,
+        )
+        assert proc.returncode == 0, proc.stderr
+        result = json.loads(out.read_text())
+        assert result["metric"] == "tfjob_pods_ready_p50_seconds"
+        assert 0 < result["value"] < 90.0
+        assert result["p95"] >= result["value"]

@@ -301,3 +301,84 @@ def test_allocate_registers_preexisting_annotations():
     ann = alloc.allocate(fresh)
     got = {int(p) for p in ann["ps"].split(",")}
     assert got == {20002, 20003}
+
+
+def test_allocate_replaces_conflicting_annotation():
+    """Annotations copied from another job (ports owned elsewhere) must
+    not be silently kept: the job gets fresh ports instead, so the true
+    owner's release can never hand the same ports to a third job
+    (ADVICE r1)."""
+    from tests.test_api import make_job
+
+    alloc = PortAllocator(20000, 20008)
+    owner = make_job({"PS": 2}, name="owner")
+    owner.spec.tf_replica_specs["PS"].template.spec.host_network = True
+    owner.metadata.annotations["ps"] = "20000,20001"
+    assert alloc.allocate(owner) == {}
+
+    thief = make_job({"PS": 2}, name="thief")
+    thief.spec.tf_replica_specs["PS"].template.spec.host_network = True
+    thief.metadata.annotations["ps"] = "20000,20001"  # copied, not owned
+    ann = alloc.allocate(thief)
+    got = {int(p) for p in ann["ps"].split(",")}
+    assert got.isdisjoint({20000, 20001}), f"thief kept stolen ports: {got}"
+    assert len(got) == 2
+    assert alloc.holdings("default/owner") == {20000, 20001}
+
+
+def test_sync_reclaims_ports_from_live_pod_host_ports():
+    """Reconstruction from live pods' hostPorts (reference
+    port.go:139-187): a pod bound to a port must keep that port
+    reserved even when the job's annotations were stripped."""
+    from tf_operator_tpu.api import k8s
+    from tests.test_api import make_job
+
+    alloc = PortAllocator(20000, 20004)
+    job = make_job({"Worker": 1}, name="stripped")
+    job.spec.tf_replica_specs["Worker"].template.spec.host_network = True
+    # no annotations — they were stripped by some external actor
+    pod = k8s.Pod(
+        metadata=k8s.ObjectMeta(
+            name="stripped-worker-0", namespace="default",
+            labels={"job-name": "stripped"},
+        ),
+        spec=k8s.PodSpec(
+            host_network=True,
+            containers=[k8s.Container(
+                name="tensorflow", image="x",
+                ports=[k8s.ContainerPort(
+                    name="tfjob-port", container_port=20001, host_port=20001,
+                )],
+            )],
+        ),
+    )
+    alloc.sync([job], [pod])
+    assert alloc.holdings("default/stripped") == {20001}
+    # a fresh allocation for another job cannot get 20001
+    other = make_job({"PS": 3}, name="other")
+    other.spec.tf_replica_specs["PS"].template.spec.host_network = True
+    ann = alloc.allocate(other)
+    assert 20001 not in {int(p) for p in ann["ps"].split(",")}
+
+
+def test_sync_gcs_allocations_of_gone_and_finished_jobs():
+    """Allocations held for jobs that no longer exist (deleted while
+    the operator was down) or that finished are garbage-collected at
+    sync (reference syncAll GC, port.go:106-134)."""
+    from tf_operator_tpu.api import types as t
+    from tests.test_api import make_job
+
+    alloc = PortAllocator(20000, 20004)
+    gone = make_job({"PS": 2}, name="gone")
+    gone.spec.tf_replica_specs["PS"].template.spec.host_network = True
+    alloc.allocate(gone)
+    assert alloc.in_use() == 2
+    done = make_job({"PS": 2}, name="done")
+    done.spec.tf_replica_specs["PS"].template.spec.host_network = True
+    alloc.allocate(done)
+    assert alloc.in_use() == 4
+    # "done" finished; "gone" vanished entirely
+    done.status.conditions.append(t.JobCondition(
+        type=t.ConditionType.SUCCEEDED, status="True"))
+    alloc.sync([done], [])
+    assert alloc.in_use() == 0

@@ -251,3 +251,52 @@ class TestSummaries:
         ])
         assert code == 0
         assert (tmp_path / "s" / "metrics.jsonl").exists()
+
+
+class TestElasticResume:
+    """The workload half of slice-granular TPU elasticity (VERDICT r1
+    next #6): a job checkpointed on an N-host slice is restored onto a
+    DIFFERENTLY-sized mesh and training continues from the saved step —
+    the controller restarts the slice (TestTPUElasticity), orbax
+    carries the state across the resize."""
+
+    def test_resume_on_resized_mesh(self, devices8, tmp_path):
+        model = mnist_lib.MnistCNN()
+        rng = jax.random.PRNGKey(3)
+        sample = mnist_lib.synthetic_batch(rng, 16)
+        ckpt = str(tmp_path / "elastic-ckpt")
+
+        # phase 1: an 8-device slice trains 3 steps and checkpoints
+        mesh8 = build_mesh(MeshConfig(dp=8), devices=devices8)
+        before = Trainer(
+            model, classification_task(model), optax.adam(1e-3),
+            mesh=mesh8, checkpoint_dir=ckpt,
+        )
+        state = before.init(rng, sample)
+        placed = before.place_batch(sample)
+        for _ in range(3):
+            state, metrics = before.step(state, placed)
+        before.save(state)
+        loss_at_save = float(metrics["loss"])
+
+        # phase 2: the slice is resized to 4 devices (a new trainer in
+        # a new process wiring, as after a SliceResize restart) and
+        # training resumes from step 3, not step 0
+        mesh4 = build_mesh(MeshConfig(dp=4), devices=devices8[:4])
+        after = Trainer(
+            model, classification_task(model), optax.adam(1e-3),
+            mesh=mesh4, checkpoint_dir=ckpt,
+        )
+        fresh = after.init(jax.random.PRNGKey(0), sample)
+        restored = after.restore(fresh)
+        assert restored is not None
+        assert int(restored.step) == 3, "resume must continue from the saved step"
+
+        state2, metrics2 = after.step(restored, after.place_batch(sample))
+        assert int(state2.step) == 4
+        loss_after = float(metrics2["loss"])
+        assert loss_after == loss_after, "NaN loss after elastic resume"
+        # the restored params are the trained ones, not a re-init: one
+        # more step keeps the loss in the same neighborhood, far below
+        # a from-scratch first-step loss
+        assert loss_after < loss_at_save * 1.5

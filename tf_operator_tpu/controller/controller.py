@@ -91,6 +91,7 @@ class TFJobController:
         )
         self._stop = threading.Event()
         self._workers: List[threading.Thread] = []
+        self._ports_synced = False
 
         substrate.subscribe("tfjob", self._on_job)
         substrate.subscribe("pod", self._on_pod)
@@ -247,6 +248,11 @@ class TFJobController:
         self.reconciler.reconcile(job, pods, services)
         if to_jsonable(job.status) != old_status:
             self._update_status(job)
+        if self.port_allocator is not None and job.is_finished():
+            # terminal jobs keep their record (TTL may retain it) but
+            # their pods are gone: the host ports go back to the pool
+            # (reference DeAllocate on pod deletion, port.go:258-295)
+            self.port_allocator.release(job.key())
 
     def _fresh_job(self, namespace: str, name: str) -> Optional[TFJob]:
         """Live job read for the adoption re-check (reference
@@ -293,9 +299,22 @@ class TFJobController:
         Jobs that never went through admission get admitted now."""
         jobs = self.substrate.list_jobs(self.namespace)
         if self.port_allocator is not None:
-            # re-register persisted host-port allocations before any new
-            # allocation can double-assign (reference port.go:106-134)
-            self.port_allocator.register_existing(jobs)
+            if not self._ports_synced:
+                # ONE-TIME full reconstruction at startup, before any
+                # worker can allocate: annotations + live pods'
+                # hostPorts, with GC of gone/finished jobs' holdings
+                # (reference syncAll runs once at Run, port.go:106-187).
+                # Periodic resyncs must not repeat the destructive GC:
+                # its list_jobs snapshot races concurrent admission and
+                # could free a just-allocated port for double-assignment.
+                pods: List[k8s.Pod] = []
+                for ns in sorted({job.namespace for job in jobs}):
+                    pods.extend(self.substrate.list_pods(ns))
+                self.port_allocator.sync(jobs, pods)
+                self._ports_synced = True
+            else:
+                # additive + idempotent: safe to repeat
+                self.port_allocator.register_existing(jobs)
         for job in jobs:
             if not job.status.conditions and not job.is_finished():
                 self._admit(job)

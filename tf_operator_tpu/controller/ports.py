@@ -17,10 +17,14 @@ native/src/portalloc.cc is used when libtfoprt.so loads, with
 
 from __future__ import annotations
 
+import logging
 import threading
 from typing import Dict, Iterable, List, Set
 
-from ..api.types import TFJob
+from ..api import k8s
+from ..api.types import LABEL_JOB_NAME, TFJob
+
+logger = logging.getLogger("tf_operator_tpu.ports")
 
 
 class PortRangeExhausted(RuntimeError):
@@ -107,13 +111,24 @@ class PortAllocator:
         self.bport = bport
         self.eport = eport
         self._bitmap = _make_bitmap(bport, eport)
+        # allocator-level mirror of per-job holdings: the bitmap ABI
+        # cannot distinguish "already mine" (benign) from "owned by
+        # another job" (conflict), and GC needs to enumerate job keys
+        self._held: Dict[str, Set[int]] = {}
+        self._lock = threading.Lock()
 
     # -- allocation --------------------------------------------------------
 
     def allocate(self, job: TFJob) -> Dict[str, str]:
         """Allocate ports for every hostNetwork replica set of the job.
-        Returns the annotations to persist ({} when none needed);
-        idempotent for jobs that already carry allocations."""
+        Returns the annotations to persist ({} when no replica set
+        needs ports or every annotation re-registered cleanly);
+        idempotent for jobs that already carry valid allocations. A
+        pre-existing annotation whose ports belong to ANOTHER job (a
+        manifest re-applied with annotations copied across jobs) is
+        replaced with a fresh allocation instead of being silently
+        kept — keeping it would let the true owner's release hand the
+        same ports to a third job."""
         annotations: Dict[str, str] = {}
         taken_this_call: List[int] = []
         for rtype_key, spec in job.spec.tf_replica_specs.items():
@@ -125,12 +140,28 @@ class PortAllocator:
                 # already allocated (controller restart, or a manifest
                 # re-applied with its annotations): claim the ports in
                 # the bitmap so they can't be handed out again
-                self._register_ports(job.key(), existing)
-                continue
+                claimed, conflicts = self._claim_annotation(
+                    job.key(), existing
+                )
+                if not conflicts:
+                    # fully ours (malformed tokens, if any, are logged
+                    # by _claim_annotation but do NOT rewire a running
+                    # job away from ports its pods are bound to)
+                    continue
+                # a conflict means the annotation was copied from a
+                # different job: roll back what this pass claimed and
+                # allocate a disjoint fresh set
+                for port in claimed:
+                    self._free_port(job.key(), port)
+                logger.warning(
+                    "job %s: annotation %s=%r holds ports owned by "
+                    "another job; allocating fresh ports",
+                    job.key(), rt, existing,
+                )
             replicas = spec.replicas if spec.replicas is not None else 1
             ports = []
             for _ in range(replicas):
-                port = self._bitmap.take(job.key())
+                port = self._take(job.key())
                 if port < 0:
                     # roll back only the ports taken in THIS call
                     # (across all its replica types — none were
@@ -138,7 +169,7 @@ class PortAllocator:
                     # in annotations with live pods bound to them and
                     # must survive
                     for taken in taken_this_call:
-                        self._bitmap.free_port(job.key(), taken)
+                        self._free_port(job.key(), taken)
                     raise PortRangeExhausted(
                         f"no free host ports in [{self.bport}, {self.eport})"
                     )
@@ -147,12 +178,30 @@ class PortAllocator:
             annotations[rt] = ",".join(str(p) for p in ports)
         return annotations
 
+    def _take(self, job_key: str) -> int:
+        port = self._bitmap.take(job_key)
+        if port >= 0:
+            with self._lock:
+                self._held.setdefault(job_key, set()).add(port)
+        return port
+
+    def _free_port(self, job_key: str, port: int) -> None:
+        self._bitmap.free_port(job_key, port)
+        with self._lock:
+            held = self._held.get(job_key)
+            if held is not None:
+                held.discard(port)
+                if not held:
+                    del self._held[job_key]
+
     # -- release -----------------------------------------------------------
 
     def release(self, job_key: str) -> None:
         self._bitmap.release(job_key)
+        with self._lock:
+            self._held.pop(job_key, None)
 
-    # -- startup GC --------------------------------------------------------
+    # -- state reconstruction + GC -----------------------------------------
 
     def register_existing(self, jobs: Iterable[TFJob]) -> None:
         """Re-register allocations persisted in live jobs' annotations so
@@ -166,13 +215,89 @@ class PortAllocator:
                 if raw:
                     self._register_ports(job.key(), raw)
 
-    def _register_ports(self, job_key: str, raw: str) -> None:
+    def sync(
+        self,
+        jobs: Iterable[TFJob],
+        pods: Iterable[k8s.Pod] = (),
+    ) -> None:
+        """Full state reconstruction (reference syncAll + the node/pod
+        informer walk, port.go:106-187): re-register live jobs'
+        annotation allocations, reclaim ports actually bound by live
+        hostNetwork pods (the pod's hostPort is the ground truth even
+        when job annotations were stripped), and GC allocations whose
+        jobs are gone or finished (leaked while the operator was down
+        or by a missed delete event)."""
+        live: Dict[str, TFJob] = {}
+        for job in jobs:
+            if not job.is_finished():
+                live[job.key()] = job
+        with self._lock:
+            stale = [key for key in self._held if key not in live]
+        for key in stale:
+            self.release(key)
+        self.register_existing(live.values())
+        for pod in pods:
+            meta = pod.metadata
+            if not pod.spec.host_network:
+                continue
+            job_name = meta.labels.get(LABEL_JOB_NAME)
+            if not job_name:
+                continue
+            key = f"{meta.namespace}/{job_name}"
+            if key not in live:
+                continue
+            for container in pod.spec.containers:
+                for cport in container.ports:
+                    host_port = cport.host_port or 0
+                    if host_port > 0:
+                        self._register(key, host_port)
+
+    def _register(self, job_key: str, port: int) -> bool:
+        """True when the port is (now) held by job_key — freshly claimed
+        or already ours; False on range errors and cross-job conflicts."""
+        with self._lock:
+            if port in self._held.get(job_key, set()):
+                return True  # idempotent: already ours
+        if self._bitmap.register(job_key, port):
+            with self._lock:
+                self._held.setdefault(job_key, set()).add(port)
+            return True
+        return False
+
+    def _claim_annotation(self, job_key: str, raw: str):
+        """Claim every parseable port in an annotation string. Returns
+        (freshly_claimed_ports, conflict_count): conflicts are ports
+        owned by ANOTHER job; malformed tokens are logged but are not
+        conflicts — they must not trigger a reallocation that rewires a
+        running job away from ports its pods are bound to."""
+        claimed: List[int] = []
+        conflicts = 0
         for part in raw.split(","):
             try:
                 port = int(part)
             except ValueError:
+                logger.warning(
+                    "job %s: unparseable port token %r in annotation",
+                    job_key, part,
+                )
                 continue
-            self._bitmap.register(job_key, port)
+            already_ours = port in self.holdings(job_key)
+            if self._register(job_key, port):
+                if not already_ours:
+                    claimed.append(port)
+            else:
+                conflicts += 1
+        return claimed, conflicts
+
+    def _register_ports(self, job_key: str, raw: str) -> bool:
+        """Claim every port in an annotation string; True when no port
+        was owned by another job."""
+        _, conflicts = self._claim_annotation(job_key, raw)
+        return conflicts == 0
+
+    def holdings(self, job_key: str) -> Set[int]:
+        with self._lock:
+            return set(self._held.get(job_key, set()))
 
     def in_use(self) -> int:
         return self._bitmap.in_use()

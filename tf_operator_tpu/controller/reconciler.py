@@ -21,6 +21,7 @@ from ..api.types import (
     ANNOTATION_GANG_GROUP,
     CHIEF_LIKE,
     DEFAULT_CONTAINER_NAME,
+    ENV_NUM_PROCESSES,
     LABEL_JOB_ROLE,
     LABEL_REPLICA_INDEX,
     LABEL_REPLICA_TYPE,
@@ -41,6 +42,7 @@ from ..runtime.control import (
     owner_reference as _owner_reference,
 )
 from ..runtime.expectations import ControllerExpectations
+from ..runtime.substrate import NotFound
 from .clock import Clock
 from . import cluster_spec
 from .status import (
@@ -59,6 +61,23 @@ logger = logging.getLogger("tf_operator_tpu.reconciler")
 EVENT_EXITED_WITH_CODE = "ExitedWithCode"
 EVENT_SCALE_DOWN = "ScaleDown"
 EVENT_SLICE_RESTART = "SliceRestart"
+EVENT_SLICE_RESIZE = "SliceResize"
+
+
+def _pod_slice_size(pod: k8s.Pod) -> Optional[int]:
+    """The slice size a TPU pod was wired for, from its injected
+    bootstrap env (cluster_spec.set_tpu_env); None when the pod carries
+    no TPU bootstrap env."""
+    container = pod.spec.container(DEFAULT_CONTAINER_NAME)
+    if container is None:
+        return None
+    raw = container.env_value(ENV_NUM_PROCESSES)
+    if raw is None:
+        return None
+    try:
+        return int(raw)
+    except ValueError:
+        return None
 
 
 @dataclasses.dataclass
@@ -379,6 +398,38 @@ class Reconciler:
         initialize_replica_statuses(job, rtype)
         slices, out_of_range = slices_by_index(typed_pods, replicas)
 
+        if (
+            rtype == ReplicaType.TPU
+            and job.spec.enable_dynamic_worker
+            and typed_pods
+            and any(
+                _pod_slice_size(p) not in (None, replicas) for p in typed_pods
+            )
+        ):
+            # TPU elasticity is SLICE-granular (SURVEY.md §7 hard part
+            # #3): an ICI mesh is not resizable in place, and every host
+            # bakes the slice size into its bootstrap env
+            # (TPU_WORKER_HOSTNAMES / JAX_NUM_PROCESSES). A replica-count
+            # change therefore restarts the whole slice — all hosts are
+            # recreated wired for the new size, and training resumes
+            # from the last orbax checkpoint (trainer.restore), the
+            # workload-plane half of elasticity the reference delegates
+            # (contrast its sparse-TF_CONFIG mutation, tensorflow.go:64-83).
+            for pod in typed_pods:
+                if pod.metadata.deletion_timestamp is not None:
+                    continue  # already terminating: don't re-delete or
+                    # re-emit events on every informer-lagged sync
+                self._delete_pod(job, pod, rt)
+                self._job_event(
+                    job, "Normal", EVENT_SLICE_RESIZE,
+                    f"Pod {pod.metadata.name} is being replaced to resize "
+                    f"the slice to {replicas} hosts",
+                )
+            self.status_updater.update_status_single(
+                job, rtype, replicas, True, False
+            )
+            return
+
         if job.spec.enable_dynamic_worker and out_of_range:
             if rtype == ReplicaType.WORKER:
                 for pod in out_of_range:
@@ -518,11 +569,16 @@ class Reconciler:
     def _delete_pod(self, job: TFJob, pod: k8s.Pod, rt: str) -> None:
         """Delete with deletion-expectation accounting, the mirror of the
         create path: under an informer-lagged substrate the next sync
-        must not act on a cache that still lists this pod."""
+        must not act on a cache that still lists this pod. NotFound is
+        success — the pod is already gone (a lagged cache listed it
+        twice); the reference's PodControl treats IsNotFound the same."""
         key = expectation_pods_key(job.key(), rt)
         self.expectations.raise_expectations(key, 0, 1)
         try:
             self.pod_control.delete_pod(job.namespace, pod.metadata.name, job)
+        except NotFound:
+            # no DELETED event will come for this expectation
+            self.expectations.deletion_observed(key)
         except Exception:
             self.expectations.deletion_observed(key)
             raise
@@ -532,6 +588,8 @@ class Reconciler:
         self.expectations.raise_expectations(key, 0, 1)
         try:
             self.service_control.delete_service(job.namespace, svc.metadata.name, job)
+        except NotFound:
+            self.expectations.deletion_observed(key)
         except Exception:
             self.expectations.deletion_observed(key)
             raise
