@@ -1,0 +1,74 @@
+"""Flash-attention vs XLA-attention train-step timing (VERDICT r1 #2).
+
+Measures a full fwd+bwd attention step (the gradient w.r.t. q, k, v of
+a scalar loss) for the pallas flash kernels vs the XLA
+dot_product_attention path, across sequence lengths, at head_dim 128
+(native) and 64 (lane-padded, the BERT-base shape).
+
+Run on the round's TPU:  python benchmarks/flash_vs_xla.py
+Writes FLASH_BENCH.json at the repo root; paste the table into the
+flash_attention.py module header.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import jax
+import jax.numpy as jnp
+
+
+def time_grad(fn, q, k, v, iters: int = 10) -> float:
+    grad_fn = jax.jit(jax.grad(
+        lambda q, k, v: (fn(q, k, v) ** 2).sum(), argnums=(0, 1, 2)
+    ))
+    out = grad_fn(q, k, v)  # compile
+    jax.block_until_ready(out)
+    start = time.perf_counter()
+    for _ in range(iters):
+        out = grad_fn(q, k, v)
+    jax.block_until_ready(out)
+    return (time.perf_counter() - start) / iters
+
+
+def main() -> None:
+    from tf_operator_tpu.ops.attention import dot_product_attention
+    from tf_operator_tpu.ops.pallas.flash_attention import flash_attention
+
+    on_tpu = jax.devices()[0].platform == "tpu"
+    rows = []
+    seqs = (2048, 4096, 8192) if on_tpu else (256,)
+    for d in (128, 64):
+        for seq in seqs:
+            b, h = 4, 6 if d == 128 else 12
+            rng = jax.random.PRNGKey(0)
+            q, k, v = (
+                jax.random.normal(key, (b, seq, h, d), jnp.bfloat16)
+                for key in jax.random.split(rng, 3)
+            )
+            t_flash = time_grad(flash_attention, q, k, v)
+            t_xla = time_grad(dot_product_attention, q, k, v)
+            rows.append({
+                "head_dim": d, "seq": seq,
+                "flash_ms": round(t_flash * 1e3, 3),
+                "xla_ms": round(t_xla * 1e3, 3),
+                "speedup": round(t_xla / t_flash, 2),
+            })
+            print(rows[-1], flush=True)
+    out = os.path.join(
+        os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+        "FLASH_BENCH.json",
+    )
+    with open(out, "w") as handle:
+        json.dump({"train_step_fwd_bwd": rows, "on_tpu": on_tpu}, handle,
+                  indent=1)
+    print("wrote", out)
+
+
+if __name__ == "__main__":
+    main()

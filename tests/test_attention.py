@@ -198,3 +198,91 @@ class TestRingAttention:
         np.testing.assert_allclose(
             np.asarray(logits_ring), np.asarray(logits_ref), atol=3e-3
         )
+
+
+class TestFlashNarrowHead:
+    """head_dim 64 (BERT-base) through lane padding (VERDICT r1 next
+    #2): the kernel — not the fallback — must run, and all-input
+    gradients must match the XLA reference."""
+
+    @pytest.fixture(scope="class")
+    def qkv64(self):
+        rng = jax.random.PRNGKey(5)
+        b, s, h, d = 2, 256, 4, 64
+        return tuple(
+            jax.random.normal(key, (b, s, h, d), jnp.float32)
+            for key in jax.random.split(rng, 3)
+        )
+
+    def test_head_dim_64_is_flash_eligible(self):
+        assert supports(256, 256, 64)
+        assert supports(512, 512, 64)
+        assert not supports(256, 256, 48)  # not a lane-paddable width
+
+    def test_matches_reference(self, qkv64):
+        q, k, v = qkv64
+        ref = dot_product_attention(q, k, v)
+        out = flash_attention(q, k, v)
+        np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=2e-6)
+
+    def test_gradients_all_inputs(self, qkv64):
+        q, k, v = qkv64
+        ref_grads = jax.grad(
+            lambda q, k, v: (dot_product_attention(q, k, v) ** 2).sum(),
+            argnums=(0, 1, 2),
+        )(q, k, v)
+        out_grads = jax.grad(
+            lambda q, k, v: (flash_attention(q, k, v) ** 2).sum(),
+            argnums=(0, 1, 2),
+        )(q, k, v)
+        for name, got, want in zip("qkv", out_grads, ref_grads):
+            np.testing.assert_allclose(
+                np.asarray(got), np.asarray(want), atol=1e-4,
+                err_msg=f"d{name} mismatch (head_dim 64)",
+            )
+
+    def test_causal_gradients(self, qkv64):
+        q, k, v = qkv64
+        s = q.shape[1]
+        mask = jnp.tril(jnp.ones((s, s), bool))[None, None]
+        ref_grads = jax.grad(
+            lambda q, k, v: (dot_product_attention(q, k, v, mask) ** 2).sum(),
+            argnums=(0, 1, 2),
+        )(q, k, v)
+        out_grads = jax.grad(
+            lambda q, k, v: (flash_attention(q, k, v, causal=True) ** 2).sum(),
+            argnums=(0, 1, 2),
+        )(q, k, v)
+        for name, got, want in zip("qkv", out_grads, ref_grads):
+            np.testing.assert_allclose(
+                np.asarray(got), np.asarray(want), atol=1e-4,
+                err_msg=f"d{name} mismatch (causal, head_dim 64)",
+            )
+
+    def test_bert_base_head_dim_trains(self):
+        """BERT-base geometry (hidden 768 = 12 x 64) through the flash
+        path end to end: one MLM train step, finite loss and grads."""
+        import optax as _optax
+
+        cfg = bert_lib.BertConfig(
+            vocab_size=512, hidden_size=256, num_layers=1, num_heads=4,
+            intermediate_size=512, max_position_embeddings=256,
+            dtype=jnp.float32,
+        )  # head_dim 64: the BERT-base shape class
+        model = bert_lib.BertForMLM(cfg, attention_fn=flash_attention)
+        rng = jax.random.PRNGKey(2)
+        batch = bert_lib.synthetic_batch(rng, 2, 256, cfg)
+        # the flash path takes no padding mask: drop it (packed batch)
+        batch.pop("attention_mask")
+        params = model.init(rng, batch["input_ids"], None)["params"]
+
+        def loss_fn(p):
+            logits = model.apply({"params": p}, batch["input_ids"], None)
+            return bert_lib.mlm_loss(
+                logits, batch["labels"], batch["mlm_weights"]
+            )
+
+        loss, grads = jax.value_and_grad(loss_fn)(params)
+        assert float(loss) == float(loss)
+        flat = jax.tree_util.tree_leaves(grads)
+        assert all(bool(jnp.all(jnp.isfinite(g))) for g in flat)

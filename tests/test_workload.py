@@ -300,3 +300,43 @@ class TestElasticResume:
         # more step keeps the loss in the same neighborhood, far below
         # a from-scratch first-step loss
         assert loss_after < loss_at_save * 1.5
+
+
+class TestMultiStep:
+    """run_steps(n) fuses n train steps into one device computation
+    (lax.scan) — it must advance the step counter by n and land in the
+    same numerical neighborhood as n single steps."""
+
+    def test_scan_matches_single_steps(self, devices8):
+        mesh = build_mesh(MeshConfig(dp=8))
+        model = mnist_lib.MnistCNN()
+
+        def fresh_trainer():
+            return Trainer(
+                model, classification_task(model), optax.sgd(0.05), mesh=mesh
+            )
+
+        rng = jax.random.PRNGKey(7)
+        sample = mnist_lib.synthetic_batch(rng, 16)
+
+        one = fresh_trainer()
+        state_a = one.init(rng, sample)
+        placed = one.place_batch(sample)
+        for _ in range(4):
+            state_a, metrics_a = one.step(state_a, placed)
+
+        many = fresh_trainer()
+        state_b = many.init(rng, sample)
+        state_b, metrics_b = many.run_steps(state_b, many.place_batch(sample), 4)
+
+        assert int(state_a.step) == int(state_b.step) == 4
+        np.testing.assert_allclose(
+            float(metrics_a["loss"]), float(metrics_b["loss"]),
+            rtol=1e-4, atol=1e-5,
+        )
+        leaves_a = jax.tree_util.tree_leaves(state_a.params)
+        leaves_b = jax.tree_util.tree_leaves(state_b.params)
+        for a, b in zip(leaves_a, leaves_b):
+            np.testing.assert_allclose(
+                np.asarray(a), np.asarray(b), rtol=1e-4, atol=1e-5
+            )

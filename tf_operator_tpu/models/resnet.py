@@ -97,10 +97,15 @@ ResNet50 = partial(ResNet, stage_sizes=(3, 4, 6, 3))
 ResNet18ish = partial(ResNet, stage_sizes=(2, 2, 2, 2))  # small test variant
 
 
-def synthetic_batch(rng: jax.Array, batch_size: int, image_size: int = 224):
+def synthetic_batch(
+    rng: jax.Array, batch_size: int, image_size: int = 224,
+    num_classes: int = 1000,
+):
     image_rng, label_rng = jax.random.split(rng)
     images = jax.random.normal(
         image_rng, (batch_size, image_size, image_size, 3), jnp.float32
     )
-    labels = jax.random.randint(label_rng, (batch_size,), 0, 1000)
+    # labels must lie inside the model's class range: out-of-range
+    # labels one-hot to all-zero rows, silently zeroing the loss
+    labels = jax.random.randint(label_rng, (batch_size,), 0, num_classes)
     return {"image": images, "label": labels}

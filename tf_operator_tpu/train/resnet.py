@@ -67,7 +67,10 @@ def main(argv=None) -> int:
     rng = jax.random.PRNGKey(0)
     global_batch = args.per_chip_batch * n_chips
     batch = trainer.place_batch(
-        resnet_lib.synthetic_batch(rng, global_batch, args.image_size)
+        resnet_lib.synthetic_batch(
+            rng, global_batch, args.image_size,
+            num_classes=10 if args.small else 1000,
+        )
     )
     state = trainer.init(rng, batch)
     if args.checkpoint_dir:
