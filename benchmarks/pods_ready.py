@@ -90,11 +90,23 @@ def main() -> None:
         "p95": round(p95, 3),
         "jobs": args.jobs,
         "workers_per_job": args.workers,
+        # The 90 s target from BASELINE.md row 1 is a GKE number that
+        # includes real scheduling, image pulls, and TPU node-pool
+        # binding — none of which are in this substrate-local path, so
+        # scoring p50 against it would flatter the harness (VERDICT r2
+        # weak #4). vs_baseline stays null until a real-scheduler run
+        # exists; substrate_local_vs_target records the local ratio
+        # explicitly labeled as such.
         "target_seconds": 90.0,
-        "vs_baseline": round(90.0 / p50, 2) if p50 > 0 else 0.0,
+        "vs_baseline": None,
+        "substrate_local_vs_target": round(90.0 / p50, 2) if p50 > 0 else 0.0,
         "note": (
             "apply->all-Running over live controller + process kubelet; "
-            "local substrate, no cloud scheduler in the path"
+            "local substrate, no cloud scheduler in the path. "
+            "vs_baseline deliberately null: the 90s target assumes a "
+            "real cluster scheduler (image pull, node binding); the "
+            "comparable number awaits the kind/GKE path "
+            "(E2E_APISERVER.json records why none can run here)"
         ),
     }
     line = json.dumps(result)

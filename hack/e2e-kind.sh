@@ -58,11 +58,10 @@ OPERATOR_PID=$!
 sleep 3
 kill -0 "$OPERATOR_PID" || { echo "operator failed to start" >&2; exit 1; }
 
-echo "==> applying dist-mnist with the fake workload image"
-# the fake workload exits 0 after echoing its env, driving the job to
-# Succeeded without TPUs in the cluster
-sed 's#image: .*#image: python:3.12-slim#; s#command: .*#command: ["python", "-c", "import os; print(os.environ.get(\"TF_CONFIG\")); "]#' \
-  "$REPO/examples/v1/dist-mnist.yaml" | kubectl apply -f -
+echo "==> applying the dist-mnist e2e overlay (fake workload)"
+# committed overlay manifest: stock python image that echoes TF_CONFIG
+# and exits 0, driving the job to Succeeded without TPUs in the cluster
+kubectl apply -f "$REPO/examples/e2e/dist-mnist-fake.yaml"
 
 echo "==> waiting for Succeeded"
 for _ in $(seq 1 120); do

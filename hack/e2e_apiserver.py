@@ -1,0 +1,235 @@
+"""Real-apiserver E2E: execute the wire-level end-to-end path and
+record the evidence artifact E2E_APISERVER.json (VERDICT r2 next #3).
+
+What the reference proves on GKE (e2e_testing.md:9-14): apply a TFJob
+through a real apiserver, a real controller process reconciles it, real
+kubelets run the containers, and the job reaches Succeeded. This
+environment ships NO kubernetes binaries and has no network egress, so
+a kind/k3s cluster cannot exist here — this harness:
+
+1. PROBES for every way a real apiserver could run (kind, k3s,
+   minikube, kubectl, kube-apiserver+etcd, network egress to fetch
+   them, and a Go toolchain to build them) and records each failure
+   mode in the artifact;
+2. if a real path exists, defers to hack/e2e-kind.sh;
+3. otherwise runs the strongest in-environment equivalent, with every
+   boundary that CAN be real, real:
+     - the apiserver is a separate HTTP server speaking the k8s REST
+       wire (testing/fake_apiserver.py) over a TCP socket,
+     - the operator is a SEPARATE OS PROCESS (python -m
+       tf_operator_tpu.server) configured via a kubeconfig file, doing
+       watches / CRUD / status PATCHes over HTTP,
+     - pods are REAL child processes launched by ProcessKubelet acting
+       as a node agent with its own client connection, reporting phase
+       through pod /status merge-PATCHes on the wire,
+     - the workload is the committed overlay manifest
+       examples/e2e/dist-mnist-fake.yaml (its python -c containers
+       assert TF_CONFIG was injected with the right task type),
+     - the driver is the SDK client (create + wait_for_condition).
+
+Usage: python hack/e2e_apiserver.py  (writes E2E_APISERVER.json)
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import shutil
+import socket
+import subprocess
+import sys
+import tempfile
+import time
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+BINARIES = ["kind", "kubectl", "k3s", "minikube", "kube-apiserver", "etcd", "go"]
+EGRESS_PROBES = [("dl.k8s.io", 443), ("github.com", 443), ("8.8.8.8", 53)]
+
+
+def probe_environment() -> dict:
+    report = {"binaries": {}, "egress": {}}
+    for binary in BINARIES:
+        path = shutil.which(binary)
+        report["binaries"][binary] = path or "absent"
+    for host, port in EGRESS_PROBES:
+        try:
+            with socket.create_connection((host, port), timeout=3):
+                report["egress"][f"{host}:{port}"] = "reachable"
+        except OSError as err:
+            report["egress"][f"{host}:{port}"] = f"unreachable ({err})"
+    return report
+
+
+def real_cluster_possible(report: dict) -> bool:
+    """Only kind+kubectl is a path this harness can actually drive
+    (hack/e2e-kind.sh): bare kube-apiserver+etcd binaries would SKIP
+    inside e2e-kind.sh and yield a false-positive artifact, so their
+    presence is recorded in environment_probe but routes to the
+    hermetic mode, which genuinely executes."""
+    return (
+        report["binaries"]["kind"] != "absent"
+        and report["binaries"]["kubectl"] != "absent"
+    )
+
+
+def write_kubeconfig(directory: str, port: int) -> str:
+    path = os.path.join(directory, "kubeconfig")
+    config = {
+        "apiVersion": "v1",
+        "kind": "Config",
+        "current-context": "e2e",
+        "contexts": [{"name": "e2e", "context": {"cluster": "e2e", "user": "e2e"}}],
+        "clusters": [{"name": "e2e", "cluster": {"server": f"http://127.0.0.1:{port}"}}],
+        "users": [{"name": "e2e", "user": {}}],
+    }
+    with open(path, "w") as handle:
+        json.dump(config, handle)
+    return path
+
+
+def load_overlay() -> dict:
+    import yaml
+
+    with open(os.path.join(REPO, "examples", "e2e", "dist-mnist-fake.yaml")) as f:
+        return yaml.safe_load(f)
+
+
+def run_hermetic_e2e() -> dict:
+    from tf_operator_tpu.runtime.kube import KubeSubstrate
+    from tf_operator_tpu.runtime.process_kubelet import ProcessKubelet
+    from tf_operator_tpu.sdk import TFJobClient
+    from tf_operator_tpu.testing.fake_apiserver import FakeApiServer
+
+    timings: dict = {}
+    server = FakeApiServer()
+    port = server.start()
+    tmpdir = tempfile.mkdtemp(prefix="e2e-apiserver-")
+    kubeconfig = write_kubeconfig(tmpdir, port)
+
+    env = dict(os.environ)
+    env["PYTHONUNBUFFERED"] = "1"
+    # log to a file, not a PIPE: nobody drains a pipe while the E2E
+    # runs, and a chatty error loop would fill the 64 KB buffer and
+    # freeze the operator on a blocked stdout write
+    log_path = os.path.join(tmpdir, "operator.log")
+    log_file = open(log_path, "w")
+    operator = subprocess.Popen(
+        [
+            sys.executable, "-m", "tf_operator_tpu.server",
+            "--kubeconfig", kubeconfig,
+            "--namespace", "kubeflow",
+            "--leader-lock", "file",
+            "--leader-lock-path", os.path.join(tmpdir, "leader.lock"),
+            "--monitoring-port", "0",
+            "--resync-period", "2",
+            "--no-json-log-format",
+        ],
+        cwd=REPO,
+        env=env,
+        stdout=log_file,
+        stderr=subprocess.STDOUT,
+        text=True,
+    )
+
+    kubelet = None
+    result: dict = {"mode": "hermetic-wire", "passed": False}
+    start = time.monotonic()
+    try:
+        kubelet_client = KubeSubstrate(f"http://127.0.0.1:{port}")
+        # the overlay's containers are plain `python -c` scripts, not
+        # the workload server — nothing serves /healthz, don't wait on it
+        kubelet = ProcessKubelet(kubelet_client, wait_ready=False)
+        sdk = TFJobClient(
+            KubeSubstrate(f"http://127.0.0.1:{port}"), namespace="kubeflow"
+        )
+        start = time.monotonic()
+        job = sdk.create(load_overlay())
+        final = sdk.wait_for_job(
+            job.name, timeout_seconds=120, polling_interval=0.25
+        )
+        timings["terminal_condition_seconds"] = round(time.monotonic() - start, 3)
+        result["condition"] = final.status.conditions[-1].type.value
+        result["conditions"] = [
+            {"type": str(c.type), "status": c.status, "reason": c.reason}
+            for c in final.status.conditions
+        ]
+        result["replica_statuses"] = {
+            rtype: {"succeeded": rs.succeeded, "failed": rs.failed, "active": rs.active}
+            for rtype, rs in final.status.replica_statuses.items()
+        }
+        result["passed"] = result["condition"] == "Succeeded"
+    except Exception as err:  # failures must still produce the artifact
+        result["error"] = f"{type(err).__name__}: {err}"
+        try:
+            final = sdk.get("dist-mnist")
+            result["conditions"] = [
+                {"type": str(c.type), "status": c.status, "reason": c.reason}
+                for c in final.status.conditions
+            ]
+        except Exception:
+            pass
+    finally:
+        timings.setdefault(
+            "terminal_condition_seconds", round(time.monotonic() - start, 3)
+        )
+        result["timings"] = timings
+        operator.terminate()
+        try:
+            operator.wait(timeout=10)
+        except subprocess.TimeoutExpired:
+            operator.kill()
+            operator.wait()
+        log_file.close()
+        if kubelet is not None:
+            kubelet.shutdown()
+        server.stop()
+        with open(log_path) as handle:
+            result["operator_log_tail"] = handle.read().splitlines()[-15:]
+    return result
+
+
+def main() -> int:
+    report = probe_environment()
+    artifact = {
+        "goal": "apply dist-mnist -> Succeeded through a real apiserver "
+                "(reference e2e_testing.md:9-14)",
+        "environment_probe": report,
+    }
+    if real_cluster_possible(report):
+        artifact["mode"] = "real-cluster"
+        rc = subprocess.call(["bash", os.path.join(REPO, "hack", "e2e-kind.sh")])
+        artifact["e2e_kind_rc"] = rc
+        artifact["passed"] = rc == 0
+    else:
+        artifact["real_cluster_blocked_because"] = (
+            "no kubernetes binaries in the image (kind/kubectl/k3s/"
+            "minikube/kube-apiserver/etcd all absent), no Go toolchain "
+            "to build them from source, and no network egress to "
+            "download them — see environment_probe for each attempt"
+        )
+        try:
+            artifact.update(run_hermetic_e2e())
+        except Exception as err:  # harness crash: record it, still emit
+            artifact["mode"] = "hermetic-wire"
+            artifact["passed"] = False
+            artifact["harness_error"] = f"{type(err).__name__}: {err}"
+
+    artifact["note"] = (
+        "hermetic-wire mode: separate operator OS process <-HTTP-> "
+        "apiserver process boundary <-HTTP-> kubelet running pods as "
+        "real child processes; every k8s interaction crosses a real "
+        "TCP wire. The only fake piece is the apiserver's storage "
+        "(testing/fake_apiserver.py). Auth/RBAC/CRD schema pruning "
+        "remain unproven until a real cluster exists."
+    )
+    line = json.dumps(artifact, indent=1)
+    print(line)
+    with open(os.path.join(REPO, "E2E_APISERVER.json"), "w") as handle:
+        handle.write(line + "\n")
+    return 0 if artifact.get("passed") else 1
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

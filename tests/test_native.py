@@ -11,7 +11,11 @@ import time
 
 import pytest
 
-from tf_operator_tpu.controller.ports import PortAllocator, _PyPortBitmap
+from tf_operator_tpu.controller.ports import (
+    PortAllocator,
+    PortRangeExhausted,
+    _PyPortBitmap,
+)
 from tf_operator_tpu.runtime import _native
 from tf_operator_tpu.runtime import native_queue as nq
 from tf_operator_tpu.runtime.expectations import ControllerExpectations
@@ -382,3 +386,46 @@ def test_sync_gcs_allocations_of_gone_and_finished_jobs():
         type=t.ConditionType.SUCCEEDED, status="True"))
     alloc.sync([done], [])
     assert alloc.in_use() == 0
+
+
+def test_sync_reserves_terminating_pod_ports_until_pod_deletion():
+    """A hostNetwork pod whose job is gone/finished still binds its
+    hostPort until the pod object disappears: sync must reserve it
+    (pod-scoped) so a new job can't be handed a still-bound port, and
+    release_pod must free it when the pod's deletion is observed
+    (ADVICE r2; reference reclaims from any observed pod's hostPort,
+    port.go:139-187)."""
+    from tf_operator_tpu.api import k8s
+    from tests.test_api import make_job
+
+    alloc = PortAllocator(20000, 20002)  # range of exactly two ports
+    terminating = k8s.Pod(
+        metadata=k8s.ObjectMeta(
+            name="dead-worker-0", namespace="default",
+            labels={"job-name": "dead"},  # job no longer exists
+        ),
+        spec=k8s.PodSpec(
+            host_network=True,
+            containers=[k8s.Container(
+                name="tensorflow", image="x",
+                ports=[k8s.ContainerPort(
+                    name="tfjob-port", container_port=20000, host_port=20000,
+                )],
+            )],
+        ),
+    )
+    alloc.sync([], [terminating])
+    assert alloc.in_use() == 1
+
+    fresh = make_job({"Worker": 2}, name="fresh")
+    fresh.spec.tf_replica_specs["Worker"].template.spec.host_network = True
+    try:
+        alloc.allocate(fresh)
+        raise AssertionError("expected PortRangeExhausted: 20000 is "
+                             "still bound by the terminating pod")
+    except PortRangeExhausted:
+        pass
+
+    alloc.release_pod("default", "dead-worker-0")
+    ann = alloc.allocate(fresh)
+    assert {int(p) for p in ann["worker"].split(",")} == {20000, 20001}

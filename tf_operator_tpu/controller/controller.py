@@ -16,6 +16,7 @@ from ..api import k8s, set_defaults, validate
 from ..api.serde import to_jsonable
 from ..api.types import LABEL_JOB_NAME, ConditionType, TFJob, gen_labels
 from ..api.validation import ValidationError
+from .ports import PortRangeExhausted
 from ..utils.logger import logger_for_job
 from ..runtime import (
     ADDED,
@@ -39,6 +40,9 @@ from .status import REASON_CREATED, set_condition
 logger = logging.getLogger("tf_operator_tpu.controller")
 
 REASON_FAILED_VALIDATION = "TFJobFailedValidation"
+# retry cadence for admission blocked on transient causes (port range
+# exhausted); resync() also re-admits condition-less jobs as a backstop
+ADMIT_RETRY_SECONDS = 5.0
 
 
 def _controller_owner(meta: k8s.ObjectMeta) -> Optional[k8s.OwnerReference]:
@@ -92,6 +96,9 @@ class TFJobController:
         self._stop = threading.Event()
         self._workers: List[threading.Thread] = []
         self._ports_synced = False
+        # jobs that already emitted a PortAllocationFailed event, so
+        # retry loops warn once per exhaustion episode, not per attempt
+        self._port_wait: set = set()
 
         substrate.subscribe("tfjob", self._on_job)
         substrate.subscribe("pod", self._on_pod)
@@ -117,6 +124,7 @@ class TFJobController:
             self.enqueue(job.key())
         elif verb == DELETED:
             self.expectations.delete_expectations(job.key())
+            self._port_wait.discard(job.key())
             if self.port_allocator is not None:
                 self.port_allocator.release(job.key())
             if self.metrics is not None:
@@ -143,7 +151,36 @@ class TFJobController:
             self._update_status(job)
             return
         if self.port_allocator is not None:
-            annotations = self.port_allocator.allocate(job)
+            try:
+                annotations = self.port_allocator.allocate(job)
+            except PortRangeExhausted as err:
+                # transient by nature (ports free when other jobs/pods
+                # end): warn and retry admission with the workqueue's
+                # per-key exponential backoff — never let the exception
+                # poison the event dispatcher or fail the job
+                # permanently (reference addTFJob logs allocator errors
+                # and moves on, job.go:96-115). The Warning event fires
+                # only on the FIRST failure per job so an hour of
+                # exhaustion doesn't write thousands of Event objects.
+                logger_for_job(job, logger).warning(
+                    "port allocation failed: %s; retrying", err
+                )
+                key = job.key()
+                if key not in self._port_wait:
+                    self._port_wait.add(key)
+                    self.recorder.event(
+                        job.kind, job.name, job.namespace, "Warning",
+                        "PortAllocationFailed", str(err),
+                    )
+                # fixed-delay retry, NOT add_rate_limited: sync()
+                # returns normally after this, so process_next would
+                # forget() the key and reset the exponential counter —
+                # rate-limited retries here degenerate to the base
+                # (milliseconds) delay, a hot loop for the whole
+                # exhaustion episode
+                self.queue.add_after(key, ADMIT_RETRY_SECONDS)
+                return
+            self._port_wait.discard(job.key())
             if annotations:
                 stored = self.substrate.get_job(job.namespace, job.name)
                 stored.metadata.annotations.update(annotations)
@@ -160,6 +197,12 @@ class TFJobController:
     def _on_pod(self, verb: str, pod: k8s.Pod) -> None:
         if not self._in_scope(pod.metadata.namespace):
             return
+        if verb == DELETED and self.port_allocator is not None:
+            # drop any pod-scoped hostPort reservation (sync() holds
+            # ports of terminating pods whose job is already gone)
+            self.port_allocator.release_pod(
+                pod.metadata.namespace, pod.metadata.name
+            )
         owner = _controller_owner(pod.metadata)
         if owner is None:
             # orphan: enqueue the label-matched job so it can adopt
@@ -227,11 +270,27 @@ class TFJobController:
             job = self.substrate.get_job(namespace, name)
         except NotFound:
             self.expectations.delete_expectations(key)
+            self._port_wait.discard(key)
             return
         set_defaults(job)
 
+        if job.metadata.deletion_timestamp is not None:
+            # checked BEFORE the re-admission path: a job already being
+            # deleted (finalizer holding it) must never be admitted or
+            # allocated ports — a doomed job could consume the range's
+            # last free ports and starve live jobs
+            return
+
+        if not job.status.conditions:
+            # never admitted (admission raced the informer, or port
+            # allocation failed and scheduled this retry): admission
+            # must run before reconcile so pods aren't created without
+            # their hostNetwork ports
+            self._admit(job)
+            return
+
         needs_sync = job.spec.enable_dynamic_worker or self._satisfied_expectations(job)
-        if not needs_sync or job.metadata.deletion_timestamp is not None:
+        if not needs_sync:
             return
 
         old_status = to_jsonable(job.status)
@@ -307,9 +366,11 @@ class TFJobController:
                 # Periodic resyncs must not repeat the destructive GC:
                 # its list_jobs snapshot races concurrent admission and
                 # could free a just-allocated port for double-assignment.
-                pods: List[k8s.Pod] = []
-                for ns in sorted({job.namespace for job in jobs}):
-                    pods.extend(self.substrate.list_pods(ns))
+                # scope-wide pod list, NOT just namespaces that still
+                # have jobs: a terminating orphan pod in a namespace
+                # whose last job was deleted still binds its hostPort
+                # and must be visible to sync's pod-scoped reservation
+                pods = self.substrate.list_pods(self.namespace)
                 self.port_allocator.sync(jobs, pods)
                 self._ports_synced = True
             else:

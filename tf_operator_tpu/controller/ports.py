@@ -226,7 +226,15 @@ class PortAllocator:
         hostNetwork pods (the pod's hostPort is the ground truth even
         when job annotations were stripped), and GC allocations whose
         jobs are gone or finished (leaked while the operator was down
-        or by a missed delete event)."""
+        or by a missed delete event).
+
+        A hostNetwork pod whose job is gone/finished still physically
+        holds its hostPort until the pod object disappears (it may be
+        terminating); the reference reclaims from ANY observed pod's
+        hostPort (port.go:139-187). Those ports are reserved under a
+        pod-scoped key ("pod:{ns}/{name}") and released when the pod's
+        deletion is observed (release_pod) — never handed to a new job
+        while the old binding can still exist."""
         live: Dict[str, TFJob] = {}
         for job in jobs:
             if not job.is_finished():
@@ -241,16 +249,26 @@ class PortAllocator:
             if not pod.spec.host_network:
                 continue
             job_name = meta.labels.get(LABEL_JOB_NAME)
-            if not job_name:
-                continue
-            key = f"{meta.namespace}/{job_name}"
-            if key not in live:
-                continue
+            key = f"{meta.namespace}/{job_name}" if job_name else None
+            if key is None or key not in live:
+                # terminating orphan: hold the port for the pod's
+                # remaining lifetime rather than the (gone) job's
+                key = self._pod_key(meta.namespace, meta.name)
             for container in pod.spec.containers:
                 for cport in container.ports:
                     host_port = cport.host_port or 0
                     if host_port > 0:
                         self._register(key, host_port)
+
+    @staticmethod
+    def _pod_key(namespace: str, name: str) -> str:
+        return f"pod:{namespace}/{name}"
+
+    def release_pod(self, namespace: str, name: str) -> None:
+        """Release any pod-scoped reservation (taken by sync for
+        hostNetwork pods whose job was already gone) once the pod's
+        deletion is observed — the kernel port binding is gone with it."""
+        self.release(self._pod_key(namespace, name))
 
     def _register(self, job_key: str, port: int) -> bool:
         """True when the port is (now) held by job_key — freshly claimed

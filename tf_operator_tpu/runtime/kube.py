@@ -244,14 +244,62 @@ class KubeSubstrate:
         )
 
     def list_pods(
-        self, namespace: str, selector: Optional[Dict[str, str]] = None
+        self, namespace: Optional[str], selector: Optional[Dict[str, str]] = None
     ) -> List[k8s.Pod]:
-        path = self._core_path("pods", namespace) + _selector_query(selector)
+        """namespace=None is the cluster-scoped GET /api/v1/pods."""
+        path = (
+            self._core_path("pods", namespace) if namespace else "/api/v1/pods"
+        ) + _selector_query(selector)
         data = self._request("GET", path)
         return [from_jsonable(item, k8s.Pod) for item in data.get("items", [])]
 
     def delete_pod(self, namespace: str, name: str) -> None:
         self._request("DELETE", self._core_path("pods", namespace, name))
+
+    def update_pod_status(
+        self, namespace: str, name: str, status: k8s.PodStatus
+    ) -> k8s.Pod:
+        """Kubelet-style status write: merge-PATCH against the pod's
+        /status subresource (what a node agent does after phase
+        transitions). Lets ProcessKubelet drive pods through a real
+        apiserver wire, completing the E2E loop the reference gets from
+        GKE kubelets (e2e_testing.md:9-14)."""
+        data = self._request(
+            "PATCH",
+            self._core_path("pods", namespace, name) + "/status",
+            {"status": to_jsonable(status)},
+            content_type="application/merge-patch+json",
+        )
+        return from_jsonable(data, k8s.Pod)
+
+    def mark_pod_running(self, namespace: str, name: str) -> None:
+        self.update_pod_status(
+            namespace, name, k8s.PodStatus(phase=k8s.POD_RUNNING)
+        )
+
+    def terminate_pod(self, namespace: str, name: str, exit_code: int = 0) -> None:
+        pod = self.get_pod(namespace, name)
+        phase = k8s.POD_SUCCEEDED if exit_code == 0 else k8s.POD_FAILED
+        container_name = (
+            pod.spec.containers[0].name if pod.spec.containers else "tensorflow"
+        )
+        self.update_pod_status(
+            namespace,
+            name,
+            k8s.PodStatus(
+                phase=phase,
+                container_statuses=[
+                    k8s.ContainerStatus(
+                        name=container_name,
+                        state=k8s.ContainerState(
+                            terminated=k8s.ContainerStateTerminated(
+                                exit_code=exit_code
+                            )
+                        ),
+                    )
+                ],
+            ),
+        )
 
     def patch_pod_labels(
         self, namespace: str, name: str, labels: Dict[str, str]

@@ -99,7 +99,7 @@ class Substrate(Protocol):
     def create_pod(self, pod: k8s.Pod) -> k8s.Pod: ...
     def get_pod(self, namespace: str, name: str) -> k8s.Pod: ...
     def list_pods(
-        self, namespace: str, selector: Optional[Dict[str, str]] = None
+        self, namespace: Optional[str], selector: Optional[Dict[str, str]] = None
     ) -> List[k8s.Pod]: ...
     def delete_pod(self, namespace: str, name: str) -> None: ...
     def patch_pod_labels(
@@ -283,13 +283,15 @@ class InMemorySubstrate:
             return deep_copy(pod)
 
     def list_pods(
-        self, namespace: str, selector: Optional[Dict[str, str]] = None
+        self, namespace: Optional[str], selector: Optional[Dict[str, str]] = None
     ) -> List[k8s.Pod]:
+        """namespace=None lists across all namespaces (the apiserver's
+        cluster-scoped GET /api/v1/pods)."""
         with self._lock:
             return [
                 deep_copy(pod)
                 for (ns, _), pod in self._pods.items()
-                if ns == namespace
+                if (namespace is None or ns == namespace)
                 and (selector is None or match_labels(selector, pod.metadata.labels))
             ]
 
