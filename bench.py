@@ -94,20 +94,31 @@ def time_fused_steps(trainer, state, batch, steps: int) -> tuple:
     return state, elapsed
 
 
-def bench_resnet(on_tpu: bool, n_chips: int) -> dict:
+def bench_resnet(
+    on_tpu: bool, n_chips: int, norm_impl: str = "tpu",
+    steps: int | None = None, fed: bool = False,
+) -> dict:
+    """norm_impl: "tpu" (TpuBatchNorm, the default) or "flax"
+    (nn.BatchNorm) — benched both ways so the r3 BN rework's effect is
+    attributable (PROFILE.md). fed=True measures with a host input
+    pipeline (fresh per-step device_put, double-buffered) instead of a
+    resident batch — VERDICT r2 weak #5."""
     from tf_operator_tpu.models import resnet as resnet_lib
     from tf_operator_tpu.parallel.mesh import MeshConfig, build_mesh
     from tf_operator_tpu.parallel.sharding import CONV_RULES
     from tf_operator_tpu.train import Trainer, classification_task
 
     if on_tpu:
-        model = resnet_lib.ResNet50(num_classes=1000)
-        per_chip_batch, image_size, steps, classes = 256, 224, 30, 1000
+        model = resnet_lib.ResNet50(num_classes=1000, norm_impl=norm_impl)
+        per_chip_batch, image_size, classes = 256, 224, 1000
+        steps = steps if steps is not None else 30
     else:  # CPU smoke: tiny shapes, same code path
         model = resnet_lib.ResNet(
-            stage_sizes=(1, 1), num_classes=10, width=8, dtype=jnp.float32
+            stage_sizes=(1, 1), num_classes=10, width=8, dtype=jnp.float32,
+            norm_impl=norm_impl,
         )
-        per_chip_batch, image_size, steps, classes = 8, 64, 3, 10
+        per_chip_batch, image_size, classes = 8, 64, 10
+        steps = steps if steps is not None else 3
 
     mesh = build_mesh(MeshConfig(dp=-1))
     trainer = Trainer(
@@ -123,7 +134,13 @@ def bench_resnet(on_tpu: bool, n_chips: int) -> dict:
     # model-math FLOPs only apply to the real ResNet-50 config; the CPU
     # smoke model reports mfu 0 regardless (no peak for cpu)
     flops = resnet50_step_flops(global_batch) if on_tpu else 0.0
-    state, elapsed = time_fused_steps(trainer, state, batch, steps)
+    if fed:
+        state, elapsed = time_fed_steps(
+            trainer, state, rng, global_batch, image_size, classes, steps,
+            resnet_lib,
+        )
+    else:
+        state, elapsed = time_fused_steps(trainer, state, batch, steps)
 
     images_per_sec_chip = global_batch * steps / elapsed / n_chips
     achieved = flops * steps / elapsed / n_chips
@@ -135,6 +152,43 @@ def bench_resnet(on_tpu: bool, n_chips: int) -> dict:
         "steps": steps,
         "global_batch": global_batch,
     }
+
+
+def time_fed_steps(
+    trainer, state, rng, global_batch, image_size, classes, steps, resnet_lib
+) -> tuple:
+    """Per-step dispatch with a host feed: batches prepared as numpy on
+    the host, the NEXT batch device_put while the current step runs
+    (double buffering — jax dispatch is async, so the transfer overlaps
+    device compute). Includes host->device bytes in the measured time,
+    which the resident-batch number deliberately excludes."""
+    import numpy as np
+
+    host_batches = []
+    for i in range(4):  # distinct batches so no transfer is a no-op
+        b = resnet_lib.synthetic_batch(
+            jax.random.fold_in(rng, i), global_batch, image_size, classes
+        )
+        host_batches.append(
+            {k: np.asarray(v) for k, v in jax.device_get(b).items()}
+        )
+
+    def run(n):
+        nonlocal state
+        nxt = trainer.place_batch(host_batches[0])
+        last = None
+        for i in range(n):
+            cur = nxt
+            if i + 1 < n:
+                nxt = trainer.place_batch(host_batches[(i + 1) % 4])
+            state, last = trainer.step(state, cur)
+        float(last["loss"])  # drain
+
+    run(2)  # compile + warm
+    start = time.perf_counter()
+    run(steps)
+    elapsed = time.perf_counter() - start
+    return state, elapsed
 
 
 def bench_bert(on_tpu: bool, n_chips: int) -> dict:
@@ -200,6 +254,78 @@ def _maybe_force_cpu() -> None:
     jax.config.update("jax_platforms", "cpu")
 
 
+def run_extras(on_tpu: bool, n_chips: int, line: dict) -> None:
+    """Secondary measurements + side artifacts, each individually
+    guarded so a failure (or an interrupted bench) can never cost the
+    headline numbers already in `line`:
+
+    - flax-BN A/B (attributes the BN rework's effect, PROFILE.md)
+    - fed_images_per_sec (host input pipeline, VERDICT r2 weak #5)
+    - FLASH_BENCH.json (flash vs XLA attention, VERDICT r2 next #2/#6)
+    - MNIST_ACC.json (BASELINE row 3 accuracy artifact)
+
+    Disable with BENCH_EXTRAS=0.
+    """
+    import io
+    import os
+    import sys
+    from contextlib import redirect_stdout
+
+    if os.environ.get("BENCH_EXTRAS") == "0":
+        return
+
+    def extra(name, fn):
+        try:
+            fn()
+        except Exception as err:  # noqa: BLE001 — extras must not kill bench
+            line[name + "_error"] = f"{type(err).__name__}: {err}"[:200]
+
+    def flax_ab():
+        r = bench_resnet(on_tpu, n_chips, norm_impl="flax", steps=15)
+        line["resnet_flax_bn_mfu"] = r["mfu"]
+        line["resnet_flax_bn_images_per_sec_per_chip"] = r[
+            "images_per_sec_per_chip"
+        ]
+
+    def fed():
+        r = bench_resnet(on_tpu, n_chips, steps=15, fed=True)
+        line["fed_images_per_sec_per_chip"] = r["images_per_sec_per_chip"]
+
+    def flash():
+        from benchmarks.flash_vs_xla import run as flash_run
+
+        rows = flash_run()
+        line["flash_speedup_seq2048_hd128"] = next(
+            (r["speedup"] for r in rows
+             if r["seq"] == 2048 and r["head_dim"] == 128), None,
+        )
+        line["flash_max_seq_measured"] = max(r["seq"] for r in rows)
+
+    def mnist():
+        from tf_operator_tpu.train import mnist as mnist_main
+
+        buf = io.StringIO()
+        with redirect_stdout(buf):  # nothing may print before our line
+            rc = mnist_main.main([
+                "--steps", "1000", "--batch-size", "512",
+                "--target-accuracy", "0.99", "--acc-json", "MNIST_ACC.json",
+                "--log-every", "500",
+            ])
+        line["mnist_target_reached"] = rc == 0
+        if os.path.exists("MNIST_ACC.json"):
+            with open("MNIST_ACC.json") as handle:
+                line["mnist_eval_accuracy"] = json.load(handle).get(
+                    "eval_accuracy"
+                )
+
+    extra("resnet_flax_bn", flax_ab)
+    extra("fed", fed)
+    if on_tpu:  # kernels + accuracy targets are TPU-only claims
+        extra("flash", flash)
+        extra("mnist", mnist)
+    print("extras done", file=sys.stderr, flush=True)
+
+
 def main() -> None:
     _maybe_force_cpu()
     devices = jax.devices()
@@ -213,28 +339,31 @@ def main() -> None:
     vs_baseline = (
         round(resnet["mfu"] / TARGET_MFU, 4) if on_tpu else 0.0
     )
-    print(
-        json.dumps(
-            {
-                "metric": "resnet50_train_images_per_sec_per_chip"
-                if on_tpu
-                else "resnet_smoke_images_per_sec_per_chip_cpu",
-                "value": headline_value,
-                "unit": "images/sec/chip",
-                "vs_baseline": vs_baseline,
-                "resnet_mfu": resnet["mfu"],
-                "bert_tokens_per_sec_per_chip": bert["tokens_per_sec_per_chip"],
-                "bert_mfu": bert["mfu"],
-                "bert_seq_len": bert["seq_len"],
-                "chip": getattr(devices[0], "device_kind", devices[0].platform),
-                "n_chips": n_chips,
-                "target_mfu": TARGET_MFU,
-                "formula": "vs_baseline = resnet_mfu / target_mfu; "
-                "mfu = model_math_flops(global) * steps / elapsed / "
-                "n_chips / bf16_peak",
-            }
-        )
-    )
+    line = {
+        "metric": "resnet50_train_images_per_sec_per_chip"
+        if on_tpu
+        else "resnet_smoke_images_per_sec_per_chip_cpu",
+        "value": headline_value,
+        "unit": "images/sec/chip",
+        "vs_baseline": vs_baseline,
+        "resnet_mfu": resnet["mfu"],
+        "bert_tokens_per_sec_per_chip": bert["tokens_per_sec_per_chip"],
+        "bert_mfu": bert["mfu"],
+        "bert_seq_len": bert["seq_len"],
+        "chip": getattr(devices[0], "device_kind", devices[0].platform),
+        "n_chips": n_chips,
+        "target_mfu": TARGET_MFU,
+        "formula": "vs_baseline = resnet_mfu / target_mfu; "
+        "mfu = model_math_flops(global) * steps / elapsed / "
+        "n_chips / bf16_peak",
+    }
+    # headline FIRST: if extras hang or the process is killed mid-way,
+    # stdout already carries the measured numbers; the enriched line
+    # re-printed after extras supersedes it (the driver parses the
+    # LAST JSON line on stdout)
+    print(json.dumps(line), flush=True)
+    run_extras(on_tpu, n_chips, line)
+    print(json.dumps(line))
 
 
 if __name__ == "__main__":

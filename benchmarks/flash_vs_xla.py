@@ -36,39 +36,69 @@ def time_grad(fn, q, k, v, iters: int = 10) -> float:
     return (time.perf_counter() - start) / iters
 
 
-def main() -> None:
+def run(verbose: bool = True) -> list:
+    """Measure and write FLASH_BENCH.json; returns the rows. Importable
+    so bench.py can produce the artifact during the driver's round-end
+    TPU run (this round's interactive TPU tunnel died mid-round; see
+    FLASH_BENCH.json provenance field)."""
+    import sys
+
     from tf_operator_tpu.ops.attention import dot_product_attention
     from tf_operator_tpu.ops.pallas.flash_attention import flash_attention
 
+    def log(*a):
+        if verbose:
+            print(*a, file=sys.stderr, flush=True)
+
     on_tpu = jax.devices()[0].platform == "tpu"
     rows = []
-    seqs = (2048, 4096, 8192) if on_tpu else (256,)
-    for d in (128, 64):
-        for seq in seqs:
-            b, h = 4, 6 if d == 128 else 12
-            rng = jax.random.PRNGKey(0)
-            q, k, v = (
-                jax.random.normal(key, (b, seq, h, d), jnp.bfloat16)
-                for key in jax.random.split(rng, 3)
-            )
-            t_flash = time_grad(flash_attention, q, k, v)
-            t_xla = time_grad(dot_product_attention, q, k, v)
-            rows.append({
-                "head_dim": d, "seq": seq,
-                "flash_ms": round(t_flash * 1e3, 3),
-                "xla_ms": round(t_xla * 1e3, 3),
-                "speedup": round(t_xla / t_flash, 2),
-            })
-            print(rows[-1], flush=True)
+    # 16384/32768 exercise the gridded streaming backward past the old
+    # whole-array VMEM ceiling (VERDICT r2 weak #3 / next #6); batch
+    # shrinks with seq so the bench fits HBM at 32k
+    cases = (
+        [(128, 2048, 4), (128, 4096, 4), (128, 8192, 4),
+         (128, 16384, 2), (128, 32768, 1),
+         (64, 2048, 4), (64, 4096, 4), (64, 8192, 4)]
+        if on_tpu
+        else [(128, 256, 2), (64, 256, 2)]
+    )
+    for d, seq, b in cases:
+        h = 6 if d == 128 else 12
+        rng = jax.random.PRNGKey(0)
+        q, k, v = (
+            jax.random.normal(key, (b, seq, h, d), jnp.bfloat16)
+            for key in jax.random.split(rng, 3)
+        )
+        t_flash = time_grad(flash_attention, q, k, v)
+        t_xla = time_grad(dot_product_attention, q, k, v)
+        rows.append({
+            "head_dim": d, "seq": seq, "batch": b,
+            "flash_ms": round(t_flash * 1e3, 3),
+            "xla_ms": round(t_xla * 1e3, 3),
+            "speedup": round(t_xla / t_flash, 2),
+        })
+        log(rows[-1])
     out = os.path.join(
         os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
         "FLASH_BENCH.json",
     )
     with open(out, "w") as handle:
-        json.dump({"train_step_fwd_bwd": rows, "on_tpu": on_tpu}, handle,
-                  indent=1)
-    print("wrote", out)
+        json.dump(
+            {
+                "train_step_fwd_bwd": rows,
+                "on_tpu": on_tpu,
+                "chip": getattr(
+                    jax.devices()[0], "device_kind", jax.devices()[0].platform
+                ),
+                "provenance": "written by benchmarks/flash_vs_xla.py "
+                "(standalone or via bench.py extras on the driver's TPU)",
+            },
+            handle,
+            indent=1,
+        )
+    log("wrote", out)
+    return rows
 
 
 if __name__ == "__main__":
-    main()
+    run()

@@ -155,6 +155,64 @@ class TestTraining:
         flat = jax.tree_util.tree_leaves(state.batch_stats)
         assert any(float(jnp.abs(leaf).sum()) > 0 for leaf in flat)
 
+    def test_tpu_batchnorm_parity_with_flax(self):
+        """TpuBatchNorm (the ResNet default, models/norm.py) must match
+        flax.linen.BatchNorm numerically at f32: train output, updated
+        running stats, eval output, and input gradients. Guards the
+        folded scale'/bias' algebra the r3 MFU fix rides on."""
+        from flax import linen as nn
+
+        from tf_operator_tpu.models.norm import TpuBatchNorm
+
+        rng = jax.random.PRNGKey(7)
+        x = jax.random.normal(rng, (16, 6, 6, 32), jnp.float32) * 3.0 + 1.5
+
+        tpu_bn = TpuBatchNorm(use_running_average=False, dtype=jnp.float32)
+        ref_bn = nn.BatchNorm(
+            use_running_average=False, momentum=0.9, epsilon=1e-5,
+            dtype=jnp.float32, use_fast_variance=True,
+        )
+        tpu_vars = tpu_bn.init(rng, x)
+        ref_vars = ref_bn.init(rng, x)
+
+        y_tpu, upd_tpu = tpu_bn.apply(tpu_vars, x, mutable=["batch_stats"])
+        y_ref, upd_ref = ref_bn.apply(ref_vars, x, mutable=["batch_stats"])
+        np.testing.assert_allclose(y_tpu, y_ref, atol=2e-5, rtol=2e-5)
+        np.testing.assert_allclose(
+            upd_tpu["batch_stats"]["mean"], upd_ref["batch_stats"]["mean"],
+            atol=1e-5, rtol=1e-5,
+        )
+        np.testing.assert_allclose(
+            upd_tpu["batch_stats"]["var"], upd_ref["batch_stats"]["var"],
+            atol=1e-5, rtol=1e-5,
+        )
+
+        # eval path normalizes by the running stats
+        eval_tpu = TpuBatchNorm(use_running_average=True, dtype=jnp.float32)
+        eval_ref = nn.BatchNorm(
+            use_running_average=True, momentum=0.9, epsilon=1e-5,
+            dtype=jnp.float32,
+        )
+        vars_tpu = {"params": tpu_vars["params"], **upd_tpu}
+        vars_ref = {"params": ref_vars["params"], **upd_ref}
+        np.testing.assert_allclose(
+            eval_tpu.apply(vars_tpu, x), eval_ref.apply(vars_ref, x),
+            atol=2e-5, rtol=2e-5,
+        )
+
+        # the true BN gradient flows through mean/var, not just scale
+        def loss_tpu(xx):
+            out = tpu_bn.apply(tpu_vars, xx, mutable=["batch_stats"])[0]
+            return jnp.sum(out**2)
+
+        def loss_ref(xx):
+            out = ref_bn.apply(ref_vars, xx, mutable=["batch_stats"])[0]
+            return jnp.sum(out**2)
+
+        np.testing.assert_allclose(
+            jax.grad(loss_tpu)(x), jax.grad(loss_ref)(x), atol=2e-4, rtol=2e-4
+        )
+
     def test_bert_tiny_dp_tp_sharded(self, devices8):
         mesh = build_mesh(MeshConfig(dp=2, fsdp=2, tp=2))
         cfg = bert_lib.BERT_TINY

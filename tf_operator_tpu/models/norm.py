@@ -1,0 +1,95 @@
+"""TPU-native BatchNorm: bf16 full-shape math, f32 per-channel math.
+
+Why not `flax.linen.BatchNorm`: its normalize path promotes the
+activation-shaped intermediates to f32 (`(x - mean) * inv` with f32
+mean/inv broadcasts f32 over the full [N,H,W,C] tensor before the
+final downcast). On TPU the BN chain is HBM-bandwidth-bound, so every
+full-shape f32 intermediate doubles the bytes through the fusion.
+Profiling the ResNet-50 train step on v5e (benchmarks/resnet_profile.py)
+showed f32 `convert`/`mul`/`sub` at [256,28,28,512] inside the conv
+fusions and 13.7% of device time in pure-elementwise loop fusions —
+together the difference between 29.6% and ~40% MFU.
+
+The TPU formulation keeps every tensor at activation shape in bf16 and
+does all f32 math at [C] instead:
+
+    mean, mean_sq = reduce(x, f32 accumulation)      # fuses into the
+    var   = mean_sq - mean**2                        # producer; no f32
+    inv   = rsqrt(var + eps) * scale                 # tensor material-
+    bias' = bias - mean * inv                        # izes at [N,H,W,C]
+    y     = x * bf16(inv) + bf16(bias')              # pure bf16
+
+Statistics still accumulate in f32 (the reduce converts per-element
+inside the fusion — XLA's convert_reduce pattern), running stats stay
+f32, and under jit-with-shardings the batch reduce is a global mean:
+GSPMD turns it into an all-reduce, i.e. sync-BN across the mesh for
+free (reference parity note: MultiWorkerMirrored needs NCCL plumbing
+for the same thing, SURVEY.md §2.3).
+
+Same variable layout as flax BatchNorm ("batch_stats": mean/var,
+"params": scale/bias) so checkpoints and Trainer code are unchanged.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable
+
+import jax
+import jax.numpy as jnp
+from flax import linen as nn
+
+Initializer = Callable[..., Any]
+
+
+class TpuBatchNorm(nn.Module):
+    """Drop-in BatchNorm over the channel-last axis.
+
+    use_running_average=False: normalize by batch statistics and update
+    running stats (training); True: normalize by running stats (eval).
+    """
+
+    use_running_average: bool = False
+    momentum: float = 0.9
+    epsilon: float = 1e-5
+    dtype: Any = jnp.bfloat16
+    param_dtype: Any = jnp.float32
+    scale_init: Initializer = nn.initializers.ones
+    bias_init: Initializer = nn.initializers.zeros
+
+    @nn.compact
+    def __call__(self, x: jax.Array) -> jax.Array:
+        features = x.shape[-1]
+        scale = self.param("scale", self.scale_init, (features,), self.param_dtype)
+        bias = self.param("bias", self.bias_init, (features,), self.param_dtype)
+        ra_mean = self.variable(
+            "batch_stats", "mean", lambda: jnp.zeros((features,), jnp.float32)
+        )
+        ra_var = self.variable(
+            "batch_stats", "var", lambda: jnp.ones((features,), jnp.float32)
+        )
+
+        if self.use_running_average:
+            mean, var = ra_mean.value, ra_var.value
+        else:
+            reduce_axes = tuple(range(x.ndim - 1))
+            n = x.size // features
+            # f32 accumulation via convert-inside-reduce: fuses into the
+            # producer, never materializes an f32 tensor at x.shape
+            total = jnp.sum(x, axis=reduce_axes, dtype=jnp.float32)
+            total_sq = jnp.sum(
+                jnp.square(x.astype(jnp.float32)), axis=reduce_axes,
+                dtype=jnp.float32,
+            )
+            mean = total / n
+            var = jnp.maximum(total_sq / n - jnp.square(mean), 0.0)
+            if not self.is_initializing():
+                m = self.momentum
+                ra_mean.value = m * ra_mean.value + (1.0 - m) * mean
+                ra_var.value = m * ra_var.value + (1.0 - m) * var
+
+        inv = jax.lax.rsqrt(var + self.epsilon) * scale.astype(jnp.float32)
+        fused_bias = bias.astype(jnp.float32) - mean * inv
+        y = x.astype(self.dtype) * inv.astype(self.dtype) + fused_bias.astype(
+            self.dtype
+        )
+        return y

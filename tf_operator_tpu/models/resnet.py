@@ -63,12 +63,18 @@ class ResNet(nn.Module):
     # keep exact-f32 norms; stats/scale/bias always stay f32
     # (param_dtype). On bf16 this is +~20% step throughput on v5e.
     norm_dtype: Optional[jnp.dtype] = None
+    # "tpu": TpuBatchNorm (bf16 full-shape math, f32 [C] math — see
+    # models/norm.py; profile-backed, the r2→r3 MFU fix); "flax":
+    # flax.linen.BatchNorm, kept for A/B comparison
+    norm_impl: str = "tpu"
 
     @nn.compact
     def __call__(self, x: jax.Array, train: bool = True) -> jax.Array:
+        from .norm import TpuBatchNorm
+
         conv = partial(nn.Conv, use_bias=False, dtype=self.dtype)
         norm = partial(
-            nn.BatchNorm,
+            TpuBatchNorm if self.norm_impl == "tpu" else nn.BatchNorm,
             use_running_average=not train,
             momentum=0.9,
             epsilon=1e-5,
