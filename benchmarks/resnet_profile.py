@@ -130,7 +130,11 @@ def walk_op_profile(profile: dict) -> tuple:
 def main() -> None:
     ap = argparse.ArgumentParser()
     ap.add_argument("--batch", type=int, default=256)
-    ap.add_argument("--steps", type=int, default=8)
+    ap.add_argument(
+        "--steps", type=int, default=None,
+        help="steps to capture (default 8); with --trace-dir, the step "
+        "count the existing trace covers (omit if unknown)",
+    )
     ap.add_argument("--out", default="PROFILE_OPS.json")
     ap.add_argument(
         "--trace-dir", default=None,
@@ -142,10 +146,10 @@ def main() -> None:
         # parsing a foreign trace: we don't know how many steps it
         # covers unless the caller says so — never silently assume 8
         trace_dir, step_time = args.trace_dir, None
-        steps = args.steps if "--steps" in sys.argv else None
+        steps = args.steps
     else:
         trace_dir = tempfile.mkdtemp(prefix="resnet_trace_")
-        steps = args.steps
+        steps = args.steps if args.steps is not None else 8
         step_time = capture(args.batch, steps, trace_dir)
         print(f"step_time_ms={step_time * 1e3:.2f}  "
               f"images_per_sec={args.batch / step_time:.1f}")

@@ -77,6 +77,45 @@ class TestFlashAttention:
                 err_msg=f"d{name} mismatch",
             )
 
+    @pytest.mark.parametrize("causal", [False, True])
+    def test_multi_block_streaming_gradients(self, causal):
+        """seq 512 with 128/256 blocks: 4 q-blocks x 2 kv-blocks, so the
+        r3 gridded streaming actually iterates — scratch init at step 0,
+        accumulation across the sequential axis, write-out at the last
+        step, and the causal block-skip predicate all execute. The
+        module-scope qkv fixture (seq 256) collapses to one block per
+        axis and exercises none of that."""
+        rng = jax.random.PRNGKey(3)
+        b, s, h, d = 2, 512, 2, 128
+        q, k, v = (
+            jax.random.normal(key, (b, s, h, d), jnp.float32)
+            for key in jax.random.split(rng, 3)
+        )
+        flash = lambda q, k, v: flash_attention(  # noqa: E731
+            q, k, v, causal=causal, block_q=128, block_kv=256
+        )
+        ref_mask = None
+        if causal:
+            ref_mask = (
+                jnp.arange(s)[:, None] >= jnp.arange(s)[None, :]
+            )[None, None]
+        ref = lambda q, k, v: dot_product_attention(q, k, v, ref_mask)  # noqa: E731
+
+        np.testing.assert_allclose(
+            np.asarray(flash(q, k, v)), np.asarray(ref(q, k, v)), atol=1e-4
+        )
+        got = jax.grad(
+            lambda q, k, v: (flash(q, k, v) ** 2).sum(), argnums=(0, 1, 2)
+        )(q, k, v)
+        want = jax.grad(
+            lambda q, k, v: (ref(q, k, v) ** 2).sum(), argnums=(0, 1, 2)
+        )(q, k, v)
+        for name, g, w in zip("qkv", got, want):
+            np.testing.assert_allclose(
+                np.asarray(g), np.asarray(w), atol=2e-4,
+                err_msg=f"d{name} mismatch (causal={causal})",
+            )
+
     def test_fallback_on_mask_or_misaligned(self, qkv):
         q, k, v = qkv
         # padding mask -> reference path, still correct

@@ -3,39 +3,42 @@
 Net-new data-plane capability (the reference ships no kernels). Design
 per the TPU pallas playbook:
 
-- forward: grid over (batch*heads, q blocks); each program streams KV
-  blocks from VMEM through the MXU with online-softmax accumulation, so
-  the [seq, seq] score matrix never materializes in HBM. The per-row
-  log-sum-exp (lse) is written as a second output — the residual that
-  makes the backward single-pass.
-- backward: two fused kernels (the FlashAttention-2 split):
-  - dKV: grid over (batch*heads, kv blocks); each program owns one
-    K/V block and streams Q/dO blocks, accumulating dK/dV.
-  - dQ: grid over (batch*heads, q blocks); each program owns one
-    Q/dO block and streams K/V blocks, accumulating dQ.
+- forward: grid (batch*heads, q blocks, kv blocks). The kv axis is a
+  sequential reduction: pallas pipelines K/V block fetches while VMEM
+  scratch carries the online-softmax state (acc, m, l), so the
+  [seq, seq] score matrix never materializes in HBM AND no whole-
+  sequence operand is ever VMEM-resident. The per-row log-sum-exp
+  (lse) is written as a second output — the residual that makes the
+  backward single-pass.
+- backward: two fused kernels (the FlashAttention-2 split), same
+  gridded-streaming structure (r3 redesign — the r2 kernels pinned
+  full Q/dO or K/V per program, capping sequence length at VMEM;
+  now every operand moves through block-sized pipeline windows):
+  - dKV: grid (bh, kv blocks, q blocks); each (b, kv) owns one K/V
+    block, streams Q/dO/lse/delta blocks, accumulates dK/dV in f32
+    VMEM scratch across the sequential q axis.
+  - dQ: grid (bh, q blocks, kv blocks); each (b, q) owns one Q/dO
+    block, streams K/V, accumulates dQ in scratch.
   Both rebuild probabilities as exp(s - lse) (exact, no second
   softmax pass) and use delta = rowsum(dO * O) for the softmax
   Jacobian, so nothing quadratic in sequence length ever hits HBM.
 - scores/statistics accumulate in f32 (VPU), matmuls run in the input
-  dtype (bf16 -> MXU native); causal programs skip blocks past the
-  diagonal in both directions.
+  dtype (bf16 -> MXU native); causal programs skip the matmuls of
+  blocks past the diagonal in both directions.
 - head_dim 64 (BERT-base) is flash-eligible through lane padding:
   Q/K/V are zero-padded to the 128-lane MXU tile (zero lanes add
   nothing to scores; the padded output/gradient lanes are sliced off).
   This spends 2x the ideal FLOPs of a native-64 kernel but keeps the
   O(seq) memory scaling, which is what matters at long sequence.
 
-Block sizes default to 512/1024 (measured on v5e, r1 header) and are
-clamped to the sequence length so any 128-multiple sequence takes the
-kernel; callers fall back to ops.attention otherwise.
-
-Measured (v5e-1, bf16, b=4 h=6 d=128, fwd+bwd train-step shape,
-vs the XLA dot_product_attention path — see bench note in r1 header
-for forward-only):
-  seq 2048: kernel 1.0x fwd / ~parity bwd (XLA still in-VMEM here)
-  seq 4096+: XLA path hits its O(seq^2) materialization cliff; the
-  fused bwd keeps dq/dk/dv single-pass and stays flat like the fwd.
-(Re-measured numbers are appended when the round's TPU bench runs.)
+Block sizes default to 512/1024 and are clamped to the sequence
+length so any 128-multiple sequence takes the kernel; callers fall
+back to ops.attention otherwise. Sequence length is now bounded by
+HBM, not VMEM: FLASH_BENCH.json (written by benchmarks/flash_vs_xla.py
+standalone or via bench.py's round-end TPU run) carries the measured
+fwd+bwd train-step timings vs the XLA path at seq 2048-32768,
+head_dim 128 and 64 — the r1/r2 header tables were forward-only or
+placeholder and are superseded by that artifact.
 """
 
 from __future__ import annotations
@@ -78,66 +81,71 @@ def _warn_fallback(sq: int, sk: int, d: int) -> None:
 
 
 def _fwd_kernel(
-    q_ref, k_ref, v_ref, o_ref, lse_ref, *,
+    q_ref, k_ref, v_ref, o_ref, lse_ref, acc_ref, m_ref, l_ref, *,
     block_q: int, block_kv: int, causal: bool, sm_scale: float,
 ):
-    q_block = pl.program_id(1)
-    seq_kv = k_ref.shape[1]
-    num_kv = seq_kv // block_kv
+    """Grid (bh, q blocks, kv blocks): the kv axis is the sequential
+    reduction — pallas pipelines the K/V block fetches while VMEM
+    scratch carries the online-softmax state (acc, m, l) across kv
+    steps. Nothing larger than one block is ever VMEM-resident, so
+    sequence length is HBM-bound, not VMEM-bound."""
+    i = pl.program_id(1)
+    j = pl.program_id(2)
+    num_kv = pl.num_programs(2)
 
-    q = q_ref[0].astype(jnp.float32) * sm_scale  # [block_q, d]
+    @pl.when(j == 0)
+    def _init():
+        acc_ref[...] = jnp.zeros_like(acc_ref)
+        m_ref[...] = jnp.full_like(m_ref, NEG_INF)
+        l_ref[...] = jnp.zeros_like(l_ref)
 
-    if causal:
-        # only KV blocks at or before this Q block's diagonal matter
-        last = ((q_block + 1) * block_q + block_kv - 1) // block_kv
-        num_kv_run = jnp.minimum(num_kv, last)
-    else:
-        num_kv_run = num_kv
-
-    def body(j, carry):
-        acc, m, l = carry
-        k = k_ref[0, pl.ds(j * block_kv, block_kv), :]
-        v = v_ref[0, pl.ds(j * block_kv, block_kv), :]
+    def compute():
+        q = q_ref[0].astype(jnp.float32) * sm_scale  # [block_q, d]
+        k = k_ref[0]
+        v = v_ref[0]
         s = jax.lax.dot_general(
             q, k.astype(jnp.float32),
             dimension_numbers=(((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32,
         )  # [block_q, block_kv]
         if causal:
-            q_pos = q_block * block_q + jax.lax.broadcasted_iota(
+            q_pos = i * block_q + jax.lax.broadcasted_iota(
                 jnp.int32, (block_q, block_kv), 0
             )
             k_pos = j * block_kv + jax.lax.broadcasted_iota(
                 jnp.int32, (block_q, block_kv), 1
             )
             s = jnp.where(q_pos >= k_pos, s, NEG_INF)
-        m_new = jnp.maximum(m, jnp.max(s, axis=-1))
-        p = jnp.exp(s - m_new[:, None])
-        alpha = jnp.exp(m - m_new)
-        l_new = l * alpha + jnp.sum(p, axis=-1)
-        acc_new = acc * alpha[:, None] + jax.lax.dot_general(
+        # m/l scratch is (block_q, LANE) with all lanes equal — the VPU
+        # register shape; column [:, :1] is the value
+        m_prev = m_ref[...]
+        l_prev = l_ref[...]
+        m_new = jnp.maximum(m_prev, jnp.max(s, axis=-1, keepdims=True))
+        p = jnp.exp(s - m_new[:, :1])
+        alpha = jnp.exp(m_prev - m_new)
+        l_ref[...] = l_prev * alpha + jnp.sum(p, axis=-1, keepdims=True)
+        m_ref[...] = m_new
+        acc_ref[...] = acc_ref[...] * alpha[:, :1] + jax.lax.dot_general(
             p, v.astype(jnp.float32),
             dimension_numbers=(((1,), (0,)), ((), ())),
             preferred_element_type=jnp.float32,
         )
-        return acc_new, m_new, l_new
 
-    d = q_ref.shape[-1]
-    acc, m, l = jax.lax.fori_loop(
-        0,
-        num_kv_run,
-        body,
-        (
-            jnp.zeros((block_q, d), jnp.float32),
-            jnp.full((block_q,), NEG_INF, jnp.float32),
-            jnp.zeros((block_q,), jnp.float32),
-        ),
-    )
-    l_safe = jnp.maximum(l, 1e-30)
-    o_ref[0] = (acc / l_safe[:, None]).astype(o_ref.dtype)
-    # log-sum-exp of the SCALED scores: p = exp(s - lse) is the exact
-    # softmax probability the backward kernels rebuild from
-    lse_ref[0] = m + jnp.log(l_safe)
+    if causal:
+        # KV blocks entirely past this Q block's diagonal contribute
+        # nothing: skip the matmuls (blocks are still fetched by the
+        # pipeline; the win is compute, ~2x on causal)
+        pl.when(j * block_kv < (i + 1) * block_q)(compute)
+    else:
+        compute()
+
+    @pl.when(j == num_kv - 1)
+    def _finalize():
+        l_safe = jnp.maximum(l_ref[...][:, :1], 1e-30)
+        o_ref[0] = (acc_ref[...] / l_safe).astype(o_ref.dtype)
+        # log-sum-exp of the SCALED scores: p = exp(s - lse) is the
+        # exact softmax probability the backward kernels rebuild from
+        lse_ref[0] = m_ref[...][:, 0] + jnp.log(l_safe[:, 0])
 
 
 def _flash_forward(
@@ -147,7 +155,7 @@ def _flash_forward(
     """q/k/v: [bh, seq, d] -> (out [bh, seq, d], lse [bh, seq])."""
     bh, seq_q, d = q.shape
     seq_kv = k.shape[1]
-    grid = (bh, seq_q // block_q)
+    grid = (bh, seq_q // block_q, seq_kv // block_kv)
     kernel = functools.partial(
         _fwd_kernel,
         block_q=block_q,
@@ -163,22 +171,34 @@ def _flash_forward(
         ),
         grid=grid,
         in_specs=[
-            pl.BlockSpec((1, block_q, d), lambda b, i: (b, i, 0),
+            pl.BlockSpec((1, block_q, d), lambda b, i, j: (b, i, 0),
                          memory_space=pltpu.VMEM),
-            pl.BlockSpec((1, seq_kv, d), lambda b, i: (b, 0, 0),
+            pl.BlockSpec((1, block_kv, d), lambda b, i, j: (b, j, 0),
                          memory_space=pltpu.VMEM),
-            pl.BlockSpec((1, seq_kv, d), lambda b, i: (b, 0, 0),
+            pl.BlockSpec((1, block_kv, d), lambda b, i, j: (b, j, 0),
                          memory_space=pltpu.VMEM),
         ],
         out_specs=(
-            pl.BlockSpec((1, block_q, d), lambda b, i: (b, i, 0),
+            pl.BlockSpec((1, block_q, d), lambda b, i, j: (b, i, 0),
                          memory_space=pltpu.VMEM),
-            pl.BlockSpec((1, block_q), lambda b, i: (b, i),
+            pl.BlockSpec((1, block_q), lambda b, i, j: (b, i),
                          memory_space=pltpu.VMEM),
         ),
+        scratch_shapes=[
+            pltpu.VMEM((block_q, d), jnp.float32),     # acc
+            pltpu.VMEM((block_q, LANE), jnp.float32),  # running max
+            pltpu.VMEM((block_q, LANE), jnp.float32),  # running sum
+        ],
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("parallel", "parallel", "arbitrary"),
+        ),
         cost_estimate=pl.CostEstimate(
+            # K/V re-stream once per Q block (gridded streaming), so
+            # their HBM traffic scales with the q-block count
             flops=4 * bh * seq_q * seq_kv * d,
-            bytes_accessed=2 * bh * (seq_q + 2 * seq_kv) * d,
+            bytes_accessed=2 * bh * d
+            * (2 * seq_q + 2 * (seq_q // block_q) * seq_kv)
+            + 4 * bh * seq_q,
             transcendentals=bh * seq_q * seq_kv,
         ),
         interpret=interpret,
@@ -189,31 +209,30 @@ def _flash_forward(
 
 
 def _bwd_dkv_kernel(
-    q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dk_ref, dv_ref, *,
+    q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dk_ref, dv_ref,
+    dk_acc, dv_acc, *,
     block_q: int, block_kv: int, causal: bool, sm_scale: float,
 ):
-    """One program owns one KV block; streams Q/dO blocks, accumulating
-    dK = sum_i ds_i^T q_i * scale and dV = sum_i p_i^T do_i."""
-    kv_block = pl.program_id(1)
-    seq_q = q_ref.shape[1]
-    num_q = seq_q // block_q
+    """Grid (bh, kv blocks, q blocks): each (b, j) owns one K/V block;
+    the q axis is the sequential reduction streaming Q/dO/lse/delta
+    blocks through VMEM scratch accumulators —
+    dK = sum_i ds_i^T q_i * scale, dV = sum_i p_i^T do_i."""
+    j = pl.program_id(1)
+    i = pl.program_id(2)
+    num_q = pl.num_programs(2)
 
-    k = k_ref[0].astype(jnp.float32)  # [block_kv, d]
-    v = v_ref[0].astype(jnp.float32)
+    @pl.when(i == 0)
+    def _init():
+        dk_acc[...] = jnp.zeros_like(dk_acc)
+        dv_acc[...] = jnp.zeros_like(dv_acc)
 
-    if causal:
-        # Q blocks strictly above this KV block's diagonal see none of
-        # it: start at the first intersecting Q block
-        first = (kv_block * block_kv) // block_q
-    else:
-        first = 0
-
-    def body(i, carry):
-        dk, dv = carry
-        qb = q_ref[0, pl.ds(i * block_q, block_q), :].astype(jnp.float32)
-        dob = do_ref[0, pl.ds(i * block_q, block_q), :].astype(jnp.float32)
-        lse_b = lse_ref[0, pl.ds(i * block_q, block_q)]
-        delta_b = delta_ref[0, pl.ds(i * block_q, block_q)]
+    def compute():
+        k = k_ref[0].astype(jnp.float32)  # [block_kv, d]
+        v = v_ref[0].astype(jnp.float32)
+        qb = q_ref[0].astype(jnp.float32)   # [block_q, d]
+        dob = do_ref[0].astype(jnp.float32)
+        lse_b = lse_ref[0]
+        delta_b = delta_ref[0]
         s = jax.lax.dot_general(
             qb, k, dimension_numbers=(((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32,
@@ -222,12 +241,12 @@ def _bwd_dkv_kernel(
             q_pos = i * block_q + jax.lax.broadcasted_iota(
                 jnp.int32, (block_q, block_kv), 0
             )
-            k_pos = kv_block * block_kv + jax.lax.broadcasted_iota(
+            k_pos = j * block_kv + jax.lax.broadcasted_iota(
                 jnp.int32, (block_q, block_kv), 1
             )
             s = jnp.where(q_pos >= k_pos, s, NEG_INF)
         p = jnp.exp(s - lse_b[:, None])  # exact probs via saved lse
-        dv_new = dv + jax.lax.dot_general(
+        dv_acc[...] += jax.lax.dot_general(
             p, dob, dimension_numbers=(((0,), (0,)), ((), ())),
             preferred_element_type=jnp.float32,
         )
@@ -236,52 +255,52 @@ def _bwd_dkv_kernel(
             preferred_element_type=jnp.float32,
         )
         ds = p * (dp - delta_b[:, None])
-        dk_new = dk + jax.lax.dot_general(
+        dk_acc[...] += jax.lax.dot_general(
             ds, qb, dimension_numbers=(((0,), (0,)), ((), ())),
             preferred_element_type=jnp.float32,
         ) * sm_scale
-        return dk_new, dv_new
 
-    d = q_ref.shape[-1]
-    dk, dv = jax.lax.fori_loop(
-        first, num_q, body,
-        (jnp.zeros((block_kv, d), jnp.float32),
-         jnp.zeros((block_kv, d), jnp.float32)),
-    )
-    dk_ref[0] = dk.astype(dk_ref.dtype)
-    dv_ref[0] = dv.astype(dv_ref.dtype)
+    if causal:
+        # Q blocks strictly above this KV block's diagonal see none of
+        # it: skip their matmuls
+        pl.when((i + 1) * block_q > j * block_kv)(compute)
+    else:
+        compute()
+
+    @pl.when(i == num_q - 1)
+    def _finalize():
+        dk_ref[0] = dk_acc[...].astype(dk_ref.dtype)
+        dv_ref[0] = dv_acc[...].astype(dv_ref.dtype)
 
 
 def _bwd_dq_kernel(
-    q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref, *,
+    q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref, dq_acc, *,
     block_q: int, block_kv: int, causal: bool, sm_scale: float,
 ):
-    """One program owns one Q/dO block; streams K/V blocks, accumulating
-    dQ = sum_j ds_j k_j * scale."""
-    q_block = pl.program_id(1)
-    seq_kv = k_ref.shape[1]
-    num_kv = seq_kv // block_kv
+    """Grid (bh, q blocks, kv blocks): each (b, i) owns one Q/dO block;
+    the kv axis is the sequential reduction streaming K/V blocks,
+    accumulating dQ = sum_j ds_j k_j * scale in VMEM scratch."""
+    i = pl.program_id(1)
+    j = pl.program_id(2)
+    num_kv = pl.num_programs(2)
 
-    qb = q_ref[0].astype(jnp.float32)   # [block_q, d]
-    dob = do_ref[0].astype(jnp.float32)
-    lse_b = lse_ref[0]
-    delta_b = delta_ref[0]
+    @pl.when(j == 0)
+    def _init():
+        dq_acc[...] = jnp.zeros_like(dq_acc)
 
-    if causal:
-        last = ((q_block + 1) * block_q + block_kv - 1) // block_kv
-        num_kv_run = jnp.minimum(num_kv, last)
-    else:
-        num_kv_run = num_kv
-
-    def body(j, dq):
-        k = k_ref[0, pl.ds(j * block_kv, block_kv), :].astype(jnp.float32)
-        v = v_ref[0, pl.ds(j * block_kv, block_kv), :].astype(jnp.float32)
+    def compute():
+        qb = q_ref[0].astype(jnp.float32)   # [block_q, d]
+        dob = do_ref[0].astype(jnp.float32)
+        lse_b = lse_ref[0]
+        delta_b = delta_ref[0]
+        k = k_ref[0].astype(jnp.float32)
+        v = v_ref[0].astype(jnp.float32)
         s = jax.lax.dot_general(
             qb, k, dimension_numbers=(((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32,
         ) * sm_scale
         if causal:
-            q_pos = q_block * block_q + jax.lax.broadcasted_iota(
+            q_pos = i * block_q + jax.lax.broadcasted_iota(
                 jnp.int32, (block_q, block_kv), 0
             )
             k_pos = j * block_kv + jax.lax.broadcasted_iota(
@@ -294,16 +313,19 @@ def _bwd_dq_kernel(
             preferred_element_type=jnp.float32,
         )
         ds = p * (dp - delta_b[:, None])
-        return dq + jax.lax.dot_general(
+        dq_acc[...] += jax.lax.dot_general(
             ds, k, dimension_numbers=(((1,), (0,)), ((), ())),
             preferred_element_type=jnp.float32,
         ) * sm_scale
 
-    d = q_ref.shape[-1]
-    dq = jax.lax.fori_loop(
-        0, num_kv_run, body, jnp.zeros((block_q, d), jnp.float32)
-    )
-    dq_ref[0] = dq.astype(dq_ref.dtype)
+    if causal:
+        pl.when(j * block_kv < (i + 1) * block_q)(compute)
+    else:
+        compute()
+
+    @pl.when(j == num_kv - 1)
+    def _finalize():
+        dq_ref[0] = dq_acc[...].astype(dq_ref.dtype)
 
 
 def _flash_backward(
@@ -316,19 +338,17 @@ def _flash_backward(
     # this elementwise reduce — no need for a kernel
     delta = jnp.sum(g.astype(jnp.float32) * out.astype(jnp.float32), axis=-1)
 
-    full_q = pl.BlockSpec((1, seq_q, d), lambda b, i: (b, 0, 0),
-                          memory_space=pltpu.VMEM)
-    full_kv = pl.BlockSpec((1, seq_kv, d), lambda b, i: (b, 0, 0),
-                           memory_space=pltpu.VMEM)
-    full_row = pl.BlockSpec((1, seq_q), lambda b, i: (b, 0),
-                            memory_space=pltpu.VMEM)
-    blk_q = pl.BlockSpec((1, block_q, d), lambda b, i: (b, i, 0),
-                         memory_space=pltpu.VMEM)
-    blk_kv = pl.BlockSpec((1, block_kv, d), lambda b, i: (b, i, 0),
-                          memory_space=pltpu.VMEM)
-    blk_row = pl.BlockSpec((1, block_q), lambda b, i: (b, i),
-                           memory_space=pltpu.VMEM)
+    seq_params = pltpu.CompilerParams(
+        dimension_semantics=("parallel", "parallel", "arbitrary"),
+    )
 
+    # dKV grid: (b, kv block, streamed q block)
+    q_by_i = pl.BlockSpec((1, block_q, d), lambda b, j, i: (b, i, 0),
+                          memory_space=pltpu.VMEM)
+    kv_by_j = pl.BlockSpec((1, block_kv, d), lambda b, j, i: (b, j, 0),
+                           memory_space=pltpu.VMEM)
+    row_by_i = pl.BlockSpec((1, block_q), lambda b, j, i: (b, i),
+                            memory_space=pltpu.VMEM)
     dk, dv = pl.pallas_call(
         functools.partial(
             _bwd_dkv_kernel, block_q=block_q, block_kv=block_kv,
@@ -338,29 +358,54 @@ def _flash_backward(
             jax.ShapeDtypeStruct(k.shape, k.dtype),
             jax.ShapeDtypeStruct(v.shape, v.dtype),
         ),
-        grid=(bh, seq_kv // block_kv),
-        in_specs=[full_q, blk_kv, blk_kv, full_q, full_row, full_row],
-        out_specs=(blk_kv, blk_kv),
+        grid=(bh, seq_kv // block_kv, seq_q // block_q),
+        in_specs=[q_by_i, kv_by_j, kv_by_j, q_by_i, row_by_i, row_by_i],
+        out_specs=(kv_by_j, kv_by_j),
+        scratch_shapes=[
+            pltpu.VMEM((block_kv, d), jnp.float32),  # dk accumulator
+            pltpu.VMEM((block_kv, d), jnp.float32),  # dv accumulator
+        ],
+        compiler_params=seq_params,
         cost_estimate=pl.CostEstimate(
+            # Q/dO/lse/delta re-stream once per KV block; K/V and
+            # dK/dV cross HBM once
             flops=8 * bh * seq_q * seq_kv * d,
-            bytes_accessed=4 * bh * (2 * seq_q + 2 * seq_kv) * d,
+            bytes_accessed=2 * bh * d
+            * (4 * seq_kv + 2 * (seq_kv // block_kv) * seq_q)
+            + 8 * bh * (seq_kv // block_kv) * seq_q,
             transcendentals=bh * seq_q * seq_kv,
         ),
         interpret=interpret,
     )(q, k, v, g, lse, delta)
 
+    # dQ grid: (b, q block, streamed kv block)
+    q_by_own = pl.BlockSpec((1, block_q, d), lambda b, i, j: (b, i, 0),
+                            memory_space=pltpu.VMEM)
+    kv_by_stream = pl.BlockSpec((1, block_kv, d), lambda b, i, j: (b, j, 0),
+                                memory_space=pltpu.VMEM)
+    row_by_own = pl.BlockSpec((1, block_q), lambda b, i, j: (b, i),
+                              memory_space=pltpu.VMEM)
     dq = pl.pallas_call(
         functools.partial(
             _bwd_dq_kernel, block_q=block_q, block_kv=block_kv,
             causal=causal, sm_scale=sm_scale,
         ),
         out_shape=jax.ShapeDtypeStruct(q.shape, q.dtype),
-        grid=(bh, seq_q // block_q),
-        in_specs=[blk_q, full_kv, full_kv, blk_q, blk_row, blk_row],
-        out_specs=blk_q,
+        grid=(bh, seq_q // block_q, seq_kv // block_kv),
+        in_specs=[q_by_own, kv_by_stream, kv_by_stream, q_by_own,
+                  row_by_own, row_by_own],
+        out_specs=q_by_own,
+        scratch_shapes=[
+            pltpu.VMEM((block_q, d), jnp.float32),  # dq accumulator
+        ],
+        compiler_params=seq_params,
         cost_estimate=pl.CostEstimate(
+            # K/V re-stream once per Q block; Q/dO/dQ/lse/delta cross
+            # HBM once
             flops=4 * bh * seq_q * seq_kv * d,
-            bytes_accessed=2 * bh * (2 * seq_q + 2 * seq_kv) * d,
+            bytes_accessed=2 * bh * d
+            * (3 * seq_q + 2 * (seq_q // block_q) * seq_kv)
+            + 8 * bh * seq_q,
             transcendentals=bh * seq_q * seq_kv,
         ),
         interpret=interpret,

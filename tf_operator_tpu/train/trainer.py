@@ -93,6 +93,7 @@ class Trainer:
         mesh: Optional[Mesh] = None,
         rules: sharding_lib.Rules = sharding_lib.TRANSFORMER_RULES,
         shard_sequence: bool = False,
+        packed: bool = False,
         checkpoint_dir: Optional[str] = None,
     ) -> None:
         self.model = model
@@ -101,6 +102,7 @@ class Trainer:
         self.mesh = mesh if mesh is not None else mesh_lib.build_mesh()
         self.rules = rules
         self.shard_sequence = shard_sequence
+        self.packed = packed
         self._ckpt = (
             Checkpointer(checkpoint_dir) if checkpoint_dir is not None else None
         )
@@ -111,10 +113,11 @@ class Trainer:
     # -- init --------------------------------------------------------------
 
     def _prepare_batch(self, batch):
-        """Sequence-parallel (ring attention) training runs on packed,
-        unpadded batches: the padding mask is dropped HERE, at the
-        mechanism, so callers don't each have to remember to."""
-        if self.shard_sequence and "attention_mask" in batch:
+        """Packed/unpadded training (sequence-parallel ring attention,
+        or the flash kernel which falls back whenever a mask is
+        supplied): the padding mask is dropped HERE, at the mechanism,
+        so callers don't each have to remember to."""
+        if (self.shard_sequence or self.packed) and "attention_mask" in batch:
             batch = {k: v for k, v in batch.items() if k != "attention_mask"}
         return batch
 
