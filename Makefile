@@ -23,7 +23,7 @@ DOCKER    := $(shell command -v docker || command -v podman)
 IMAGE_DIR := build/images
 DIST      := build/dist
 
-.PHONY: ci lint native native-test test wire-test e2e e2e-kind bench \
+.PHONY: ci presubmit lint native native-test test wire-test e2e e2e-kind bench \
         images release mnist-acc clean
 
 # `test` already runs the whole tests/ tree (native bindings, wire,
@@ -31,6 +31,12 @@ DIST      := build/dist
 # not as ci prerequisites, so ci doesn't pay for the slow suites twice
 ci: lint native test e2e
 	@echo "CI PASSED (tag $(TAG))"
+
+# The full presubmit DAG (ci/presubmit.yaml) with per-step JUnit XML +
+# CI_RUN.json artifacts — the Prow+Argo workflow analog; `ci` is the
+# quick sequential equivalent
+presubmit:
+	$(PY) hack/run_workflow.py ci/presubmit.yaml --artifacts _artifacts
 
 lint:
 	$(PY) -m compileall -q tf_operator_tpu tests benchmarks hack bench.py __graft_entry__.py

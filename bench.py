@@ -96,7 +96,7 @@ def time_fused_steps(trainer, state, batch, steps: int) -> tuple:
 
 def bench_resnet(
     on_tpu: bool, n_chips: int, norm_impl: str = "tpu",
-    steps: int | None = None, fed: bool = False,
+    steps: int | None = None, fed: bool = False, stem: str = "conv7",
 ) -> dict:
     """norm_impl: "tpu" (TpuBatchNorm, the default) or "flax"
     (nn.BatchNorm) — benched both ways so the r3 BN rework's effect is
@@ -109,13 +109,15 @@ def bench_resnet(
     from tf_operator_tpu.train import Trainer, classification_task
 
     if on_tpu:
-        model = resnet_lib.ResNet50(num_classes=1000, norm_impl=norm_impl)
+        model = resnet_lib.ResNet50(
+            num_classes=1000, norm_impl=norm_impl, stem=stem
+        )
         per_chip_batch, image_size, classes = 256, 224, 1000
         steps = steps if steps is not None else 30
     else:  # CPU smoke: tiny shapes, same code path
         model = resnet_lib.ResNet(
             stage_sizes=(1, 1), num_classes=10, width=8, dtype=jnp.float32,
-            norm_impl=norm_impl,
+            norm_impl=norm_impl, stem=stem,
         )
         per_chip_batch, image_size, classes = 8, 64, 10
         steps = steps if steps is not None else 3
@@ -319,6 +321,13 @@ def run_extras(on_tpu: bool, n_chips: int, line: dict) -> None:
             "tokens_per_sec_per_chip"
         ]
 
+    def s2d():
+        r = bench_resnet(on_tpu, n_chips, steps=15, stem="s2d")
+        line["resnet_s2d_stem_mfu"] = r["mfu"]
+        line["resnet_s2d_stem_images_per_sec_per_chip"] = r[
+            "images_per_sec_per_chip"
+        ]
+
     def flash():
         from benchmarks.flash_vs_xla import run as flash_run
 
@@ -349,6 +358,8 @@ def run_extras(on_tpu: bool, n_chips: int, line: dict) -> None:
     extra("resnet_flax_bn", flax_ab)
     extra("fed", fed)
     extra("bert_xla", bert_xla)
+    if on_tpu:  # stem A/B only meaningful at the real 224/3-channel shape
+        extra("resnet_s2d", s2d)
     if on_tpu:  # kernels + accuracy targets are TPU-only claims
         extra("flash", flash)
         extra("mnist", mnist)

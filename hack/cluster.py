@@ -1,0 +1,150 @@
+"""Cluster lifecycle helper — the analog of the reference E2E infra's
+GKE cluster create/delete (reference py/kubeflow/tf_operator/
+util.py:203-256: gcloud container clusters create/delete with
+scopes/machine-type, used by deploy.py before each Argo E2E run).
+
+Backends:
+  kind  — local cluster via `kind create/delete cluster` (the path
+          hack/e2e-kind.sh drives)
+  gke   — `gcloud container clusters create` with an optional TPU
+          node pool (what a real v5e run needs)
+
+Every action probes its tooling first and exits with a loud,
+machine-readable explanation when the backend can't run here (this
+repo's CI image has neither kind nor gcloud and no egress), rather
+than pretending: `status` reports what exists.
+
+Usage:
+  python hack/cluster.py status
+  python hack/cluster.py create --backend kind --name tfjob-e2e
+  python hack/cluster.py create --backend gke --name tfjob-bench \
+      --zone us-central2-b --tpu-topology 2x4 --tpu-type v5litepod-8
+  python hack/cluster.py delete --backend kind --name tfjob-e2e
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import shutil
+import subprocess
+import sys
+
+
+def _need(binary: str, action: str) -> None:
+    if shutil.which(binary) is None:
+        print(json.dumps({
+            "action": action,
+            "ok": False,
+            "reason": f"'{binary}' not on PATH — install it or run on a "
+                      "workstation/CI pool that has it (this zero-egress "
+                      "image cannot)",
+        }))
+        raise SystemExit(2)
+
+
+def _run(cmd: list, action: str) -> None:
+    print("+", " ".join(cmd), file=sys.stderr, flush=True)
+    rc = subprocess.call(cmd)
+    print(json.dumps({"action": action, "ok": rc == 0, "rc": rc}))
+    raise SystemExit(0 if rc == 0 else 1)
+
+
+def create(args: argparse.Namespace) -> None:
+    if args.backend == "kind":
+        _need("kind", "create")
+        _run(
+            ["kind", "create", "cluster", "--name", args.name,
+             "--wait", "120s"],
+            "create",
+        )
+    else:
+        _need("gcloud", "create")
+        cmd = [
+            "gcloud", "container", "clusters", "create", args.name,
+            "--zone", args.zone,
+            "--machine-type", args.machine_type,
+            "--num-nodes", str(args.num_nodes),
+            # the scopes the reference grants its E2E clusters
+            # (util.py:227-233): storage + logging + monitoring
+            "--scopes", "storage-rw,logging-write,monitoring",
+        ]
+        _print_then = [cmd]
+        if args.tpu_type:
+            # TPU slice node pool: all hosts of one v5e slice land in
+            # one pool so gang slice-binding is atomic. Node count is
+            # derived from the slice size (v5e packs 8 chips per host:
+            # v5litepod-8 = 1 host, v5litepod-256 = 32 hosts) — NOT
+            # from the CPU pool's --num-nodes.
+            chips = int(args.tpu_type.split("-")[-1])
+            hosts = max(1, chips // 8)
+            _print_then.append([
+                "gcloud", "container", "node-pools", "create",
+                f"{args.name}-tpu",
+                "--cluster", args.name, "--zone", args.zone,
+                "--machine-type", f"ct5lp-hightpu-{min(chips, 8)}t",
+                "--tpu-topology", args.tpu_topology,
+                "--num-nodes", str(hosts),
+            ])
+        for i, c in enumerate(_print_then):
+            print("+", " ".join(c), file=sys.stderr, flush=True)
+            rc = subprocess.call(c)
+            if rc != 0:
+                print(json.dumps({"action": "create", "ok": False, "rc": rc,
+                                  "step": i}))
+                raise SystemExit(1)
+        print(json.dumps({"action": "create", "ok": True}))
+
+
+def delete(args: argparse.Namespace) -> None:
+    if args.backend == "kind":
+        _need("kind", "delete")
+        _run(["kind", "delete", "cluster", "--name", args.name], "delete")
+    else:
+        _need("gcloud", "delete")
+        _run(
+            ["gcloud", "container", "clusters", "delete", args.name,
+             "--zone", args.zone, "--quiet"],
+            "delete",
+        )
+
+
+def status(_: argparse.Namespace) -> None:
+    report = {
+        binary: shutil.which(binary) or "absent"
+        for binary in ("kind", "kubectl", "gcloud", "docker", "podman")
+    }
+    clusters = None
+    if report["kind"] != "absent":
+        try:
+            clusters = subprocess.run(
+                ["kind", "get", "clusters"], capture_output=True, text=True,
+                timeout=30,
+            ).stdout.split()
+        except (OSError, subprocess.SubprocessError):
+            clusters = ["<kind hung/errored>"]
+    print(json.dumps({"tooling": report, "kind_clusters": clusters}))
+
+
+def main() -> None:
+    parser = argparse.ArgumentParser()
+    sub = parser.add_subparsers(dest="cmd", required=True)
+    for name, fn in (("create", create), ("delete", delete)):
+        p = sub.add_parser(name)
+        p.add_argument("--backend", choices=["kind", "gke"], default="kind")
+        p.add_argument("--name", default="tfjob-e2e")
+        p.add_argument("--zone", default="us-central2-b")
+        p.add_argument("--machine-type", default="e2-standard-8")
+        p.add_argument("--num-nodes", type=int, default=2)
+        p.add_argument("--tpu-type", default=None,
+                       help="e.g. v5litepod-8; adds a TPU node pool")
+        p.add_argument("--tpu-topology", default="2x4")
+        p.set_defaults(fn=fn)
+    p = sub.add_parser("status")
+    p.set_defaults(fn=status)
+    args = parser.parse_args()
+    args.fn(args)
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,244 @@
+"""Workflow DAG executor — the in-repo analog of the reference's Argo
+workflow engine + Prow artifact plumbing (reference
+test/workflows/components/workflows.libsonnet:238-300 defines the DAG;
+py/kubeflow/tf_operator/test_runner.py:78-82 writes JUnit XML to GCS
+for the dashboard).
+
+Reads a YAML DAG (see ci/presubmit.yaml), topo-sorts, executes steps as
+subprocesses with per-step timeout and flake retries, streams each
+step's output to the artifacts dir, emits one JUnit XML per step plus a
+CI_RUN.json summary, and exits nonzero if any step failed. Independent
+steps can run concurrently with --parallel N (default 1: the CI box has
+one core).
+
+Usage:
+    python hack/run_workflow.py ci/presubmit.yaml [--artifacts DIR]
+        [--parallel N] [--only step1,step2]
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import shlex
+import subprocess
+import sys
+import threading
+import time
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+
+@dataclass
+class Step:
+    name: str
+    command: str
+    deps: List[str] = field(default_factory=list)
+    retries: int = 0
+    timeout: float = 1800.0
+    # outcome
+    status: str = "pending"  # pending | running | passed | failed | skipped
+    attempts: int = 0
+    elapsed: float = 0.0
+    log_path: str = ""
+
+
+def load_workflow(path: str, only: Optional[List[str]] = None):
+    import yaml
+
+    with open(path) as handle:
+        doc = yaml.safe_load(handle)
+    steps = [
+        Step(
+            name=s["name"],
+            command=s["command"],
+            deps=list(s.get("deps", [])),
+            retries=int(s.get("retries", 0)),
+            timeout=float(s.get("timeout", 1800)),
+        )
+        for s in doc["steps"]
+    ]
+    names = {s.name for s in steps}
+    for step in steps:
+        unknown = [d for d in step.deps if d not in names]
+        if unknown:
+            raise SystemExit(f"step {step.name}: unknown deps {unknown}")
+    if only:
+        # keep the requested steps plus their transitive deps
+        by_name = {s.name: s for s in steps}
+        keep: set = set()
+
+        def add(name: str) -> None:
+            if name in keep:
+                return
+            keep.add(name)
+            for dep in by_name[name].deps:
+                add(dep)
+
+        for name in only:
+            if name not in by_name:
+                raise SystemExit(f"--only: unknown step {name}")
+            add(name)
+        steps = [s for s in steps if s.name in keep]
+    # cycle check via Kahn's algorithm
+    remaining = {s.name: set(s.deps) for s in steps}
+    order = []
+    while remaining:
+        ready = [n for n, deps in remaining.items() if not deps]
+        if not ready:
+            raise SystemExit(f"dependency cycle among {sorted(remaining)}")
+        for name in ready:
+            del remaining[name]
+            order.append(name)
+        for deps in remaining.values():
+            deps.difference_update(ready)
+    return doc.get("name", os.path.basename(path)), steps
+
+
+def run_step(step: Step, artifacts: str) -> None:
+    step.log_path = os.path.join(artifacts, f"{step.name}.log")
+    start = time.monotonic()
+    # keep status 'running' until ALL attempts are exhausted: setting
+    # 'failed' between retries races the scheduler, which would skip
+    # dependents (and even finalize the run) while a retry that might
+    # pass is still executing
+    outcome = "failed"
+    for attempt in range(step.retries + 1):
+        step.attempts = attempt + 1
+        with open(step.log_path, "a") as log:
+            log.write(f"=== attempt {attempt + 1}: {step.command}\n")
+            log.flush()
+            try:
+                proc = subprocess.run(
+                    step.command if any(c in step.command for c in "|&><$")
+                    else shlex.split(step.command),
+                    shell=any(c in step.command for c in "|&><$"),
+                    cwd=REPO,
+                    stdout=log,
+                    stderr=subprocess.STDOUT,
+                    timeout=step.timeout,
+                )
+                rc: Optional[int] = proc.returncode
+            except subprocess.TimeoutExpired:
+                log.write(f"\n=== TIMEOUT after {step.timeout}s\n")
+                rc = None
+        if rc == 0:
+            outcome = "passed"
+            break
+    step.elapsed = time.monotonic() - start
+    step.status = outcome
+
+
+def write_junit(step: Step, artifacts: str, workflow: str) -> None:
+    import xml.etree.ElementTree as ET
+
+    suite = ET.Element(
+        "testsuite", name=f"{workflow}.{step.name}", tests="1",
+        failures="0" if step.status == "passed" else "1",
+        time=f"{step.elapsed:.2f}",
+    )
+    case = ET.SubElement(
+        suite, "testcase", classname=workflow, name=step.name,
+        time=f"{step.elapsed:.2f}",
+    )
+    if step.status != "passed":
+        failure = ET.SubElement(
+            case, "failure", message=f"step {step.status} "
+            f"after {step.attempts} attempt(s)",
+        )
+        try:
+            with open(step.log_path) as handle:
+                failure.text = handle.read()[-4000:]
+        except OSError:
+            pass
+    path = os.path.join(artifacts, f"junit_{step.name}.xml")
+    ET.ElementTree(suite).write(path, xml_declaration=True, encoding="unicode")
+
+
+def execute(workflow: str, steps: List[Step], artifacts: str, parallel: int) -> bool:
+    os.makedirs(artifacts, exist_ok=True)
+    by_name = {s.name: s for s in steps}
+    lock = threading.Lock()
+    done = threading.Condition(lock)
+
+    def runnable() -> List[Step]:
+        out = []
+        for step in steps:
+            if step.status != "pending":
+                continue
+            dep_status = [by_name[d].status for d in step.deps]
+            if any(st in ("failed", "skipped") for st in dep_status):
+                step.status = "skipped"
+                write_junit(step, artifacts, workflow)  # contract:
+                # one junit per step, skipped included
+                print(f"SKIP  {step.name} (failed dep)", flush=True)
+            elif all(st == "passed" for st in dep_status):
+                out.append(step)
+        return out
+
+    def worker(step: Step) -> None:
+        run_step(step, artifacts)
+        write_junit(step, artifacts, workflow)
+        with done:
+            print(
+                f"{'PASS' if step.status == 'passed' else 'FAIL'}  "
+                f"{step.name} ({step.elapsed:.1f}s, "
+                f"{step.attempts} attempt(s))",
+                flush=True,
+            )
+            done.notify_all()
+
+    with done:
+        while True:
+            for step in runnable():
+                if sum(1 for s in steps if s.status == "running") >= parallel:
+                    break
+                step.status = "running"
+                print(f"RUN   {step.name}: {step.command}", flush=True)
+                threading.Thread(target=worker, args=(step,), daemon=True).start()
+            if all(
+                s.status in ("passed", "failed", "skipped") for s in steps
+            ):
+                break
+            done.wait(timeout=1.0)
+
+    ok = all(s.status == "passed" for s in steps)
+    summary = {
+        "workflow": workflow,
+        "passed": ok,
+        "steps": [
+            {
+                "name": s.name,
+                "status": s.status,
+                "attempts": s.attempts,
+                "elapsed_seconds": round(s.elapsed, 2),
+                "log": s.log_path,
+            }
+            for s in steps
+        ],
+    }
+    with open(os.path.join(artifacts, "CI_RUN.json"), "w") as handle:
+        json.dump(summary, handle, indent=1)
+    print(json.dumps({k: summary[k] for k in ("workflow", "passed")}))
+    return ok
+
+
+def main() -> int:
+    parser = argparse.ArgumentParser()
+    parser.add_argument("workflow")
+    parser.add_argument("--artifacts", default=os.path.join(REPO, "_artifacts"))
+    parser.add_argument("--parallel", type=int, default=1)
+    parser.add_argument("--only", default=None,
+                        help="comma-separated step names (plus their deps)")
+    args = parser.parse_args()
+    only = args.only.split(",") if args.only else None
+    name, steps = load_workflow(args.workflow, only)
+    return 0 if execute(name, steps, args.artifacts, args.parallel) else 1
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

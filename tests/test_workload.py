@@ -155,6 +155,48 @@ class TestTraining:
         flat = jax.tree_util.tree_leaves(state.batch_stats)
         assert any(float(jnp.abs(leaf).sum()) > 0 for leaf in flat)
 
+    def test_s2d_stem_reparameterizes_conv7(self):
+        """The space-to-depth stem is exactly as expressive as the
+        canonical 7x7/s2 stem: mapping any 7x7 kernel through
+        conv7_to_s2d_kernel and running the 4x4/s1 conv on the s2d
+        input reproduces the conv7 output bit-for-bit structure
+        (PROFILE.md structural item; MLPerf TPU stem remedy)."""
+        import jax.numpy as jnp
+
+        rng = jax.random.PRNGKey(11)
+        x = jax.random.normal(rng, (2, 32, 32, 3), jnp.float32)
+        w7 = jax.random.normal(jax.random.PRNGKey(12), (7, 7, 3, 16))
+
+        ref = jax.lax.conv_general_dilated(
+            x, w7, window_strides=(2, 2), padding=[(3, 3), (3, 3)],
+            dimension_numbers=("NHWC", "HWIO", "NHWC"),
+        )
+        y = resnet_lib.space_to_depth(x, 2)
+        w4 = resnet_lib.conv7_to_s2d_kernel(w7)
+        got = jax.lax.conv_general_dilated(
+            y, w4, window_strides=(1, 1), padding=[(2, 1), (2, 1)],
+            dimension_numbers=("NHWC", "HWIO", "NHWC"),
+        )
+        np.testing.assert_allclose(got, ref, atol=1e-5, rtol=1e-5)
+
+    def test_s2d_resnet_trains(self, devices8):
+        model = resnet_lib.ResNet(
+            stage_sizes=(1, 1), num_classes=10, width=8,
+            dtype=jnp.float32, stem="s2d",
+        )
+        trainer = Trainer(
+            model, classification_task(model), optax.sgd(0.1),
+            mesh=build_mesh(MeshConfig(dp=8)), rules=(),
+        )
+        rng = jax.random.PRNGKey(2)
+        sample = {
+            "image": jnp.ones((8, 32, 32, 3)),
+            "label": jnp.zeros((8,), jnp.int32),
+        }
+        state = trainer.init(rng, sample)
+        state, metrics = trainer.step(state, sample)
+        assert np.isfinite(metrics["loss"])
+
     def test_tpu_batchnorm_parity_with_flax(self):
         """TpuBatchNorm (the ResNet default, models/norm.py) must match
         flax.linen.BatchNorm numerically at f32: train output, updated
