@@ -116,10 +116,86 @@ class TestFlashAttention:
                 err_msg=f"d{name} mismatch (causal={causal})",
             )
 
+    @pytest.mark.parametrize("causal", [False, True])
+    def test_key_padding_mask_in_kernel(self, causal):
+        """A [batch, seq_kv] key-padding mask runs IN-KERNEL (r3: no
+        more fallback for padded batches): outputs at valid query rows
+        and gradients under a padded-row-zeroing loss must match the
+        reference path given the equivalent broadcast mask."""
+        rng = jax.random.PRNGKey(5)
+        b, s, h, d = 2, 512, 2, 128
+        q, k, v = (
+            jax.random.normal(key, (b, s, h, d), jnp.float32)
+            for key in jax.random.split(rng, 3)
+        )
+        lengths = jnp.array([384, 512])
+        pad = jnp.arange(s)[None, :] < lengths[:, None]  # [b, s]
+
+        flash = lambda q, k, v: flash_attention(  # noqa: E731
+            q, k, v, mask=pad, causal=causal, block_q=128, block_kv=256
+        )
+        ref_mask = pad[:, None, None, :]
+        if causal:
+            ref_mask = jnp.logical_and(
+                ref_mask,
+                (jnp.arange(s)[:, None] >= jnp.arange(s)[None, :])[None, None],
+            )
+        ref = lambda q, k, v: dot_product_attention(q, k, v, ref_mask)  # noqa: E731
+
+        # padded QUERY rows carry unused values on the kernel path —
+        # compare valid rows only (every caller zero-weights the rest)
+        valid = np.asarray(pad)[:, :, None, None]
+        np.testing.assert_allclose(
+            np.asarray(flash(q, k, v)) * valid,
+            np.asarray(ref(q, k, v)) * valid,
+            atol=1e-4,
+        )
+
+        w = pad[:, :, None, None].astype(jnp.float32)
+        got = jax.grad(
+            lambda q, k, v: ((flash(q, k, v) * w) ** 2).sum(),
+            argnums=(0, 1, 2),
+        )(q, k, v)
+        want = jax.grad(
+            lambda q, k, v: ((ref(q, k, v) * w) ** 2).sum(),
+            argnums=(0, 1, 2),
+        )(q, k, v)
+        for name, g_, w_ in zip("qkv", got, want):
+            np.testing.assert_allclose(
+                np.asarray(g_), np.asarray(w_), atol=2e-4,
+                err_msg=f"d{name} mismatch (causal={causal})",
+            )
+
+    def test_query_independent_4d_mask_routes_in_kernel(self, qkv):
+        """[b, 1, 1, sk] (the form models pass) is recognized as a
+        key-padding mask and handled in-kernel, matching the
+        reference broadcast semantics."""
+        q, k, v = qkv
+        pad = (jnp.arange(256)[None, :] < jnp.array([200, 256])[:, None])
+        out = flash_attention(q, k, v, mask=pad[:, None, None, :])
+        ref = dot_product_attention(q, k, v, pad[:, None, None, :])
+        valid = np.asarray(pad)[:, :, None, None]
+        np.testing.assert_allclose(
+            np.asarray(out) * valid, np.asarray(ref) * valid, atol=1e-4
+        )
+
+    def test_2d_broadcast_mask_keeps_reference_semantics(self, qkv):
+        """A [sq, sk] broadcastable mask (e.g. a tril causal mask) is
+        NOT a key-padding mask: it must take the reference path with
+        plain jnp broadcast semantics, not be reinterpreted as
+        [batch, keys]."""
+        q, k, v = qkv
+        tril = jnp.tril(jnp.ones((256, 256), bool))
+        out = flash_attention(q, k, v, mask=tril)
+        ref = flash_attention(q, k, v, causal=True)
+        np.testing.assert_allclose(
+            np.asarray(out), np.asarray(ref), atol=1e-4
+        )
+
     def test_fallback_on_mask_or_misaligned(self, qkv):
         q, k, v = qkv
-        # padding mask -> reference path, still correct
-        mask = jnp.ones((2, 1, 1, 256), bool)
+        # query-dependent mask -> reference path, still correct
+        mask = jnp.ones((2, 1, 256, 256), bool)
         out = flash_attention(q, k, v, mask=mask)
         ref = dot_product_attention(q, k, v, mask)
         np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=1e-6)

@@ -95,7 +95,10 @@ class BertEncoder(nn.Module):
         x = tokens + positions
         attn_mask = None
         if mask is not None:
-            # [batch, 1, 1, keys]: broadcast over heads and queries
+            # [batch, 1, 1, keys]: broadcast over heads and queries.
+            # The flash kernel recognizes this query-independent shape
+            # and masks kv columns IN-KERNEL instead of falling back
+            # (r3); the XLA path broadcasts it as before.
             attn_mask = mask[:, None, None, :].astype(bool)
         for layer in range(cfg.num_layers):
             x = TransformerBlock(

@@ -25,6 +25,11 @@ per the TPU pallas playbook:
 - scores/statistics accumulate in f32 (VPU), matmuls run in the input
   dtype (bf16 -> MXU native); causal programs skip the matmuls of
   blocks past the diagonal in both directions.
+- key-padding masks ([batch, seq_kv], the form BERT passes) are
+  handled IN-KERNEL in forward and both backward kernels (invalid
+  columns score NEG_INF, exactly like causal masking), so padded
+  batches keep O(seq) memory; only full [b, 1, sq, sk] bias-style
+  masks fall back to the XLA path.
 - head_dim 64 (BERT-base) is flash-eligible through lane padding:
   Q/K/V are zero-padded to the 128-lane MXU tile (zero lanes add
   nothing to scores; the padded output/gradient lanes are sliced off).
@@ -81,14 +86,24 @@ def _warn_fallback(sq: int, sk: int, d: int) -> None:
 
 
 def _fwd_kernel(
-    q_ref, k_ref, v_ref, o_ref, lse_ref, acc_ref, m_ref, l_ref, *,
+    *refs,
     block_q: int, block_kv: int, causal: bool, sm_scale: float,
+    has_mask: bool,
 ):
     """Grid (bh, q blocks, kv blocks): the kv axis is the sequential
     reduction — pallas pipelines the K/V block fetches while VMEM
     scratch carries the online-softmax state (acc, m, l) across kv
     steps. Nothing larger than one block is ever VMEM-resident, so
-    sequence length is HBM-bound, not VMEM-bound."""
+    sequence length is HBM-bound, not VMEM-bound.
+
+    With has_mask, refs carry a [1, block_kv] f32 key-validity block
+    (1=attend, 0=padding) after v_ref; invalid columns score NEG_INF
+    exactly like causal masking."""
+    if has_mask:
+        q_ref, k_ref, v_ref, mask_ref, o_ref, lse_ref, acc_ref, m_ref, l_ref = refs
+    else:
+        q_ref, k_ref, v_ref, o_ref, lse_ref, acc_ref, m_ref, l_ref = refs
+        mask_ref = None
     i = pl.program_id(1)
     j = pl.program_id(2)
     num_kv = pl.num_programs(2)
@@ -116,6 +131,8 @@ def _fwd_kernel(
                 jnp.int32, (block_q, block_kv), 1
             )
             s = jnp.where(q_pos >= k_pos, s, NEG_INF)
+        if mask_ref is not None:
+            s = jnp.where(mask_ref[0][None, :] > 0, s, NEG_INF)
         # m/l scratch is (block_q, LANE) with all lanes equal — the VPU
         # register shape; column [:, :1] is the value
         m_prev = m_ref[...]
@@ -149,10 +166,11 @@ def _fwd_kernel(
 
 
 def _flash_forward(
-    q: jax.Array, k: jax.Array, v: jax.Array, causal: bool, sm_scale: float,
-    block_q: int, block_kv: int, interpret: bool,
+    q: jax.Array, k: jax.Array, v: jax.Array, kv_mask, causal: bool,
+    sm_scale: float, block_q: int, block_kv: int, interpret: bool,
 ):
-    """q/k/v: [bh, seq, d] -> (out [bh, seq, d], lse [bh, seq])."""
+    """q/k/v: [bh, seq, d]; kv_mask: [bh, seq_kv] f32 validity or None
+    -> (out [bh, seq, d], lse [bh, seq])."""
     bh, seq_q, d = q.shape
     seq_kv = k.shape[1]
     grid = (bh, seq_q // block_q, seq_kv // block_kv)
@@ -162,7 +180,27 @@ def _flash_forward(
         block_kv=block_kv,
         causal=causal,
         sm_scale=sm_scale,
+        has_mask=kv_mask is not None,
     )
+    in_specs = [
+        pl.BlockSpec((1, block_q, d), lambda b, i, j: (b, i, 0),
+                     memory_space=pltpu.VMEM),
+        pl.BlockSpec((1, block_kv, d), lambda b, i, j: (b, j, 0),
+                     memory_space=pltpu.VMEM),
+        pl.BlockSpec((1, block_kv, d), lambda b, i, j: (b, j, 0),
+                     memory_space=pltpu.VMEM),
+    ]
+    operands = [q, k, v]
+    if kv_mask is not None:
+        # mask is [batch, seq_kv] while the grid's first dim is
+        # batch*heads: the index map reads row b'//heads, so the mask
+        # is shared across heads instead of duplicated
+        heads = bh // kv_mask.shape[0]
+        in_specs.append(
+            pl.BlockSpec((1, block_kv), lambda b, i, j: (b // heads, j),
+                         memory_space=pltpu.VMEM)
+        )
+        operands.append(kv_mask)
     return pl.pallas_call(
         kernel,
         out_shape=(
@@ -170,14 +208,7 @@ def _flash_forward(
             jax.ShapeDtypeStruct((bh, seq_q), jnp.float32),
         ),
         grid=grid,
-        in_specs=[
-            pl.BlockSpec((1, block_q, d), lambda b, i, j: (b, i, 0),
-                         memory_space=pltpu.VMEM),
-            pl.BlockSpec((1, block_kv, d), lambda b, i, j: (b, j, 0),
-                         memory_space=pltpu.VMEM),
-            pl.BlockSpec((1, block_kv, d), lambda b, i, j: (b, j, 0),
-                         memory_space=pltpu.VMEM),
-        ],
+        in_specs=in_specs,
         out_specs=(
             pl.BlockSpec((1, block_q, d), lambda b, i, j: (b, i, 0),
                          memory_space=pltpu.VMEM),
@@ -202,21 +233,28 @@ def _flash_forward(
             transcendentals=bh * seq_q * seq_kv,
         ),
         interpret=interpret,
-    )(q, k, v)
+    )(*operands)
 
 
 # -- backward --------------------------------------------------------------
 
 
 def _bwd_dkv_kernel(
-    q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dk_ref, dv_ref,
-    dk_acc, dv_acc, *,
+    *refs,
     block_q: int, block_kv: int, causal: bool, sm_scale: float,
+    has_mask: bool,
 ):
     """Grid (bh, kv blocks, q blocks): each (b, j) owns one K/V block;
     the q axis is the sequential reduction streaming Q/dO/lse/delta
     blocks through VMEM scratch accumulators —
     dK = sum_i ds_i^T q_i * scale, dV = sum_i p_i^T do_i."""
+    if has_mask:
+        (q_ref, k_ref, v_ref, mask_ref, do_ref, lse_ref, delta_ref,
+         dk_ref, dv_ref, dk_acc, dv_acc) = refs
+    else:
+        (q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
+         dk_ref, dv_ref, dk_acc, dv_acc) = refs
+        mask_ref = None
     j = pl.program_id(1)
     i = pl.program_id(2)
     num_q = pl.num_programs(2)
@@ -245,6 +283,8 @@ def _bwd_dkv_kernel(
                 jnp.int32, (block_q, block_kv), 1
             )
             s = jnp.where(q_pos >= k_pos, s, NEG_INF)
+        if mask_ref is not None:
+            s = jnp.where(mask_ref[0][None, :] > 0, s, NEG_INF)
         p = jnp.exp(s - lse_b[:, None])  # exact probs via saved lse
         dv_acc[...] += jax.lax.dot_general(
             p, dob, dimension_numbers=(((0,), (0,)), ((), ())),
@@ -274,12 +314,20 @@ def _bwd_dkv_kernel(
 
 
 def _bwd_dq_kernel(
-    q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref, dq_acc, *,
+    *refs,
     block_q: int, block_kv: int, causal: bool, sm_scale: float,
+    has_mask: bool,
 ):
     """Grid (bh, q blocks, kv blocks): each (b, i) owns one Q/dO block;
     the kv axis is the sequential reduction streaming K/V blocks,
     accumulating dQ = sum_j ds_j k_j * scale in VMEM scratch."""
+    if has_mask:
+        (q_ref, k_ref, v_ref, mask_ref, do_ref, lse_ref, delta_ref,
+         dq_ref, dq_acc) = refs
+    else:
+        (q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
+         dq_ref, dq_acc) = refs
+        mask_ref = None
     i = pl.program_id(1)
     j = pl.program_id(2)
     num_kv = pl.num_programs(2)
@@ -307,6 +355,8 @@ def _bwd_dq_kernel(
                 jnp.int32, (block_q, block_kv), 1
             )
             s = jnp.where(q_pos >= k_pos, s, NEG_INF)
+        if mask_ref is not None:
+            s = jnp.where(mask_ref[0][None, :] > 0, s, NEG_INF)
         p = jnp.exp(s - lse_b[:, None])
         dp = jax.lax.dot_general(
             dob, v, dimension_numbers=(((1,), (1,)), ((), ())),
@@ -329,11 +379,12 @@ def _bwd_dq_kernel(
 
 
 def _flash_backward(
-    q, k, v, out, lse, g, causal: bool, sm_scale: float,
+    q, k, v, kv_mask, out, lse, g, causal: bool, sm_scale: float,
     block_q: int, block_kv: int, interpret: bool,
 ):
     bh, seq_q, d = q.shape
     seq_kv = k.shape[1]
+    has_mask = kv_mask is not None
     # softmax-Jacobian row correction, one f32 scalar per row; XLA fuses
     # this elementwise reduce — no need for a kernel
     delta = jnp.sum(g.astype(jnp.float32) * out.astype(jnp.float32), axis=-1)
@@ -349,17 +400,27 @@ def _flash_backward(
                            memory_space=pltpu.VMEM)
     row_by_i = pl.BlockSpec((1, block_q), lambda b, j, i: (b, i),
                             memory_space=pltpu.VMEM)
+    heads = bh // kv_mask.shape[0] if has_mask else 1
+    mask_by_j = pl.BlockSpec((1, block_kv), lambda b, j, i: (b // heads, j),
+                             memory_space=pltpu.VMEM)
+    dkv_specs = [q_by_i, kv_by_j, kv_by_j]
+    dkv_operands = [q, k, v]
+    if has_mask:
+        dkv_specs.append(mask_by_j)
+        dkv_operands.append(kv_mask)
+    dkv_specs += [q_by_i, row_by_i, row_by_i]
+    dkv_operands += [g, lse, delta]
     dk, dv = pl.pallas_call(
         functools.partial(
             _bwd_dkv_kernel, block_q=block_q, block_kv=block_kv,
-            causal=causal, sm_scale=sm_scale,
+            causal=causal, sm_scale=sm_scale, has_mask=has_mask,
         ),
         out_shape=(
             jax.ShapeDtypeStruct(k.shape, k.dtype),
             jax.ShapeDtypeStruct(v.shape, v.dtype),
         ),
         grid=(bh, seq_kv // block_kv, seq_q // block_q),
-        in_specs=[q_by_i, kv_by_j, kv_by_j, q_by_i, row_by_i, row_by_i],
+        in_specs=dkv_specs,
         out_specs=(kv_by_j, kv_by_j),
         scratch_shapes=[
             pltpu.VMEM((block_kv, d), jnp.float32),  # dk accumulator
@@ -376,7 +437,7 @@ def _flash_backward(
             transcendentals=bh * seq_q * seq_kv,
         ),
         interpret=interpret,
-    )(q, k, v, g, lse, delta)
+    )(*dkv_operands)
 
     # dQ grid: (b, q block, streamed kv block)
     q_by_own = pl.BlockSpec((1, block_q, d), lambda b, i, j: (b, i, 0),
@@ -385,15 +446,23 @@ def _flash_backward(
                                 memory_space=pltpu.VMEM)
     row_by_own = pl.BlockSpec((1, block_q), lambda b, i, j: (b, i),
                               memory_space=pltpu.VMEM)
+    mask_by_stream = pl.BlockSpec((1, block_kv), lambda b, i, j: (b // heads, j),
+                                  memory_space=pltpu.VMEM)
+    dq_specs = [q_by_own, kv_by_stream, kv_by_stream]
+    dq_operands = [q, k, v]
+    if has_mask:
+        dq_specs.append(mask_by_stream)
+        dq_operands.append(kv_mask)
+    dq_specs += [q_by_own, row_by_own, row_by_own]
+    dq_operands += [g, lse, delta]
     dq = pl.pallas_call(
         functools.partial(
             _bwd_dq_kernel, block_q=block_q, block_kv=block_kv,
-            causal=causal, sm_scale=sm_scale,
+            causal=causal, sm_scale=sm_scale, has_mask=has_mask,
         ),
         out_shape=jax.ShapeDtypeStruct(q.shape, q.dtype),
         grid=(bh, seq_q // block_q, seq_kv // block_kv),
-        in_specs=[q_by_own, kv_by_stream, kv_by_stream, q_by_own,
-                  row_by_own, row_by_own],
+        in_specs=dq_specs,
         out_specs=q_by_own,
         scratch_shapes=[
             pltpu.VMEM((block_q, d), jnp.float32),  # dq accumulator
@@ -409,36 +478,50 @@ def _flash_backward(
             transcendentals=bh * seq_q * seq_kv,
         ),
         interpret=interpret,
-    )(q, k, v, g, lse, delta)
+    )(*dq_operands)
     return dq, dk, dv
 
 
 # -- custom VJP ------------------------------------------------------------
+# kv_mask rides as a differentiable-position arg (custom_vjp cannot
+# mark arrays nondiff) with a symbolically-zero cotangent; _HAS_MASK /
+# _NO_MASK are separate customs because `kv_mask is None` must be
+# static at trace time.
 
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7))
-def _flash(q, k, v, causal, sm_scale, block_q, block_kv, interpret):
-    out, _ = _flash_forward(
-        q, k, v, causal, sm_scale, block_q, block_kv, interpret
-    )
-    return out
+def _make_flash_vjp(has_mask: bool):
+    @functools.partial(jax.custom_vjp, nondiff_argnums=(4, 5, 6, 7, 8))
+    def flash(q, k, v, kv_mask, causal, sm_scale, block_q, block_kv,
+              interpret):
+        out, _ = _flash_forward(
+            q, k, v, kv_mask if has_mask else None, causal, sm_scale,
+            block_q, block_kv, interpret,
+        )
+        return out
+
+    def fwd(q, k, v, kv_mask, causal, sm_scale, block_q, block_kv,
+            interpret):
+        out, lse = _flash_forward(
+            q, k, v, kv_mask if has_mask else None, causal, sm_scale,
+            block_q, block_kv, interpret,
+        )
+        return out, (q, k, v, kv_mask, out, lse)
+
+    def bwd(causal, sm_scale, block_q, block_kv, interpret, residuals, g):
+        q, k, v, kv_mask, out, lse = residuals
+        dq, dk, dv = _flash_backward(
+            q, k, v, kv_mask if has_mask else None, out, lse, g, causal,
+            sm_scale, block_q, block_kv, interpret,
+        )
+        dmask = jnp.zeros_like(kv_mask) if has_mask else None
+        return dq, dk, dv, dmask
+
+    flash.defvjp(fwd, bwd)
+    return flash
 
 
-def _flash_fwd(q, k, v, causal, sm_scale, block_q, block_kv, interpret):
-    out, lse = _flash_forward(
-        q, k, v, causal, sm_scale, block_q, block_kv, interpret
-    )
-    return out, (q, k, v, out, lse)
-
-
-def _flash_bwd(causal, sm_scale, block_q, block_kv, interpret, residuals, g):
-    q, k, v, out, lse = residuals
-    return _flash_backward(
-        q, k, v, out, lse, g, causal, sm_scale, block_q, block_kv, interpret
-    )
-
-
-_flash.defvjp(_flash_fwd, _flash_bwd)
+_FLASH_NO_MASK = _make_flash_vjp(has_mask=False)
+_FLASH_HAS_MASK = _make_flash_vjp(has_mask=True)
 
 
 # -- public API ------------------------------------------------------------
@@ -482,16 +565,42 @@ def flash_attention(
     interpret: Optional[bool] = None,
 ) -> jax.Array:
     """Drop-in for ops.attention.dot_product_attention
-    ([batch, seq, heads, head_dim] in/out). Falls back to the reference
-    path when a padding mask is supplied or shapes don't block-align.
+    ([batch, seq, heads, head_dim] in/out).
+
+    mask handling:
+    - None: dense (packed) attention, fully in-kernel;
+    - a KEY-PADDING mask — [batch, seq_kv], or the equivalent
+      query-independent broadcast form [batch, 1, 1, seq_kv] models
+      pass (truthy = attend): handled in-kernel — invalid kv columns
+      score NEG_INF in the forward and in both backward kernels, so
+      padded batches keep the O(seq) flash memory behavior (padded
+      QUERY rows produce unused finite outputs; their loss weights are
+      zero in every caller, so dO is zero there and every gradient
+      contribution vanishes);
+    - any other mask (query-dependent [b, 1, sq, sk], [sq, sk]
+      broadcasts, ...): falls back to the XLA reference path, which
+      keeps plain jnp broadcast semantics.
     """
     from ..attention import dot_product_attention
 
     b, sq, h, d = query.shape
     sk = key.shape[1]
-    if mask is not None or not supports(sq, sk, d, block_q, block_kv):
+    kv_mask = None  # [b, sk] kernel form
+    if mask is not None and getattr(mask, "ndim", 0) == 2 and mask.shape == (b, sk):
+        kv_mask = mask
+    elif mask is not None and getattr(mask, "ndim", 0) == 4 and mask.shape == (
+        b, 1, 1, sk,
+    ):
+        kv_mask = mask[:, 0, 0, :]
+    if (mask is not None and kv_mask is None) or not supports(
+        sq, sk, d, block_q, block_kv
+    ):
         if mask is None:
             _warn_fallback(sq, sk, d)
+        if mask is not None and mask.ndim == 2 and mask.shape == (b, sk):
+            # key-padding mask for a shape the kernel can't take:
+            # expand to the reference path's [b, 1, 1, sk] broadcast
+            mask = mask[:, None, None, :].astype(bool)
         if causal:
             # the fallback must honor causality too
             causal_mask = (
@@ -514,10 +623,20 @@ def flash_attention(
             folded = jnp.pad(folded, ((0, 0), (0, 0), (0, LANE - d % LANE)))
         return folded
 
-    out = _flash(
-        fold(query), fold(key), fold(value),
-        causal, sm_scale, block_q, block_kv, interpret,
-    )
+    if kv_mask is not None:
+        # stays [b, sk] f32 — the kernels' BlockSpec index maps read
+        # row b'//h for folded program b', so the mask is never
+        # h-fold duplicated in HBM
+        out = _FLASH_HAS_MASK(
+            fold(query), fold(key), fold(value),
+            (kv_mask > 0).astype(jnp.float32),
+            causal, sm_scale, block_q, block_kv, interpret,
+        )
+    else:
+        out = _FLASH_NO_MASK(
+            fold(query), fold(key), fold(value), None,
+            causal, sm_scale, block_q, block_kv, interpret,
+        )
     if d % LANE:
         out = out[..., :d]
     return out.reshape(b, h, sq, d).transpose(0, 2, 1, 3)

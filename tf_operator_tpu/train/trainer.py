@@ -113,10 +113,12 @@ class Trainer:
     # -- init --------------------------------------------------------------
 
     def _prepare_batch(self, batch):
-        """Packed/unpadded training (sequence-parallel ring attention,
-        or the flash kernel which falls back whenever a mask is
-        supplied): the padding mask is dropped HERE, at the mechanism,
-        so callers don't each have to remember to."""
+        """Packed/unpadded training (sequence-parallel ring attention
+        rejects masks by design; on genuinely unpadded data an
+        all-ones mask is pure overhead even for the flash kernel,
+        which handles key-padding masks in-kernel): the mask is
+        dropped HERE, at the mechanism, so callers don't each have to
+        remember to."""
         if (self.shard_sequence or self.packed) and "attention_mask" in batch:
             batch = {k: v for k, v in batch.items() if k != "attention_mask"}
         return batch
