@@ -97,6 +97,7 @@ def time_fused_steps(trainer, state, batch, steps: int) -> tuple:
 def bench_resnet(
     on_tpu: bool, n_chips: int, norm_impl: str = "tpu",
     steps: int | None = None, fed: bool = False, stem: str = "conv7",
+    batch_override: int | None = None,
 ) -> dict:
     """norm_impl: "tpu" (TpuBatchNorm, the default) or "flax"
     (nn.BatchNorm) — benched both ways so the r3 BN rework's effect is
@@ -122,6 +123,8 @@ def bench_resnet(
         per_chip_batch, image_size, classes = 8, 64, 10
         steps = steps if steps is not None else 3
 
+    if batch_override is not None:
+        per_chip_batch = batch_override
     mesh = build_mesh(MeshConfig(dp=-1))
     trainer = Trainer(
         model, classification_task(model), optax.sgd(0.1, momentum=0.9),
@@ -328,6 +331,13 @@ def run_extras(on_tpu: bool, n_chips: int, line: dict) -> None:
             "images_per_sec_per_chip"
         ]
 
+    def bs512():
+        # occupancy probe: does 2x the per-chip batch lift MXU
+        # utilization? (guarded: an HBM OOM lands in bs512_error,
+        # never in the headline)
+        r = bench_resnet(on_tpu, n_chips, steps=10, batch_override=512)
+        line["resnet_bs512_mfu"] = r["mfu"]
+
     def flash():
         from benchmarks.flash_vs_xla import run as flash_run
 
@@ -366,6 +376,7 @@ def run_extras(on_tpu: bool, n_chips: int, line: dict) -> None:
     extra("resnet_flax_bn", flax_ab)
     if on_tpu:  # stem A/B only meaningful at the real 224/3-channel shape
         extra("resnet_s2d", s2d)
+        extra("resnet_bs512", bs512)
     extra("fed", fed)
     print("extras done", file=sys.stderr, flush=True)
 

@@ -90,6 +90,7 @@ def run(verbose: bool = True, quick: bool = False) -> list:
         json.dump(
             {
                 "train_step_fwd_bwd": rows,
+                "sweep": "quick" if quick else "full",
                 "on_tpu": on_tpu,
                 "chip": getattr(
                     jax.devices()[0], "device_kind", jax.devices()[0].platform

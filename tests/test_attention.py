@@ -118,10 +118,10 @@ class TestFlashAttention:
 
     @pytest.mark.parametrize("causal", [False, True])
     def test_key_padding_mask_in_kernel(self, causal):
-        """A [batch, seq_kv] key-padding mask runs IN-KERNEL (r3: no
-        more fallback for padded batches): outputs at valid query rows
-        and gradients under a padded-row-zeroing loss must match the
-        reference path given the equivalent broadcast mask."""
+        """A [batch, 1, 1, seq_kv] key-padding mask runs IN-KERNEL
+        (r3: no more fallback for padded batches): outputs at valid
+        query rows and gradients under a padded-row-zeroing loss must
+        match the reference path given the equivalent mask."""
         rng = jax.random.PRNGKey(5)
         b, s, h, d = 2, 512, 2, 128
         q, k, v = (
@@ -132,7 +132,8 @@ class TestFlashAttention:
         pad = jnp.arange(s)[None, :] < lengths[:, None]  # [b, s]
 
         flash = lambda q, k, v: flash_attention(  # noqa: E731
-            q, k, v, mask=pad, causal=causal, block_q=128, block_kv=256
+            q, k, v, mask=pad[:, None, None, :], causal=causal,
+            block_q=128, block_kv=256,
         )
         ref_mask = pad[:, None, None, :]
         if causal:

@@ -943,3 +943,174 @@ class TestGangElasticExample:
             e.reason == "ScaleDown"
             for e in sub.events_for("TFJob", "elastic-train")
         )
+
+
+class TestRandomizedSoak:
+    """Property-style soak of the whole controller: a seeded random
+    interleaving of user/kubelet actions with reconcile syncs must
+    never violate the core invariants — the reference's subtlest logic
+    (expectations/cache coherence, SURVEY §7 hard part #2) fails
+    exactly here, as duplicate child pods or a wedged queue.
+
+    Invariants checked after every burst:
+      1. at most ONE active pod per (job, rtype, index) — double
+         creation is the canonical expectations bug;
+      2. the queue always drains (run_until_quiet terminates);
+      3. at quiescence, every Running job has exactly one active pod
+         per expected index, and finished jobs (CleanPodPolicy
+         Running, the default) keep no active pods.
+    """
+
+    @pytest.mark.parametrize("seed", [0, 1, 2, 3, 4, 5, 6, 7])
+    def test_random_interleaving_preserves_invariants(self, seed):
+        import random
+
+        rng = random.Random(seed)
+        sub = InMemorySubstrate()
+        controller = TFJobController(sub)
+        live_jobs: dict = {}  # name -> spec dict of replica counts
+        counter = 0
+
+        def assert_no_duplicate_active_pods():
+            seen = {}
+            for pod in sub.list_pods("default"):
+                if not pod.is_active():
+                    continue
+                key = (
+                    pod.metadata.labels.get(t.LABEL_JOB_NAME),
+                    pod.metadata.labels.get(t.LABEL_REPLICA_TYPE),
+                    pod.metadata.labels.get(t.LABEL_REPLICA_INDEX),
+                )
+                assert key not in seen, (
+                    f"duplicate active pod for {key}: "
+                    f"{pod.metadata.name} and {seen[key]} (seed={seed})"
+                )
+                seen[key] = pod.metadata.name
+
+        actions = ["create", "advance", "terminate", "kill_pod",
+                   "delete_job", "scale", "sync"]
+        for step in range(60):
+            action = rng.choice(actions)
+            if action == "create" and len(live_jobs) < 4:
+                counter += 1
+                name = f"soak-{counter}"
+                spec = {"Worker": rng.randint(1, 3)}
+                if rng.random() < 0.5:
+                    spec["PS"] = rng.randint(1, 2)
+                job = make_job(spec, name=name)
+                job.spec.enable_dynamic_worker = rng.random() < 0.5
+                policy = rng.choice([
+                    t.RestartPolicy.NEVER, t.RestartPolicy.EXIT_CODE,
+                ])
+                for rspec in job.spec.tf_replica_specs.values():
+                    rspec.restart_policy = policy
+                sub.create_job(job)
+                live_jobs[name] = spec
+            elif action == "scale" and live_jobs:
+                # elastic resize mid-flight (dynamic workers only —
+                # scale events race reconciles, SURVEY §7 hard part #3)
+                name = rng.choice(sorted(live_jobs))
+                try:
+                    stored = sub.get_job("default", name)
+                except Exception:
+                    continue
+                if not stored.spec.enable_dynamic_worker or stored.is_finished():
+                    continue
+                new_count = rng.randint(1, 4)
+                stored.spec.tf_replica_specs["Worker"].replicas = new_count
+                try:
+                    sub.update_job(stored)
+                    live_jobs[name]["Worker"] = new_count
+                except Exception:
+                    pass  # conflict with a concurrent status write
+            elif action == "advance":
+                sub.run_all_pending()
+            elif action == "terminate" and live_jobs:
+                name = rng.choice(sorted(live_jobs))
+                pods = [
+                    p for p in sub.list_pods("default", t.gen_labels(name))
+                    if p.is_active()
+                ]
+                if pods:
+                    pod = rng.choice(pods)
+                    code = rng.choice([0, 1, 137])
+                    try:
+                        sub.terminate_pod(
+                            "default", pod.metadata.name, exit_code=code
+                        )
+                    except Exception:
+                        pass  # pod raced away: the controller must cope
+            elif action == "kill_pod" and live_jobs:
+                # kubelet/node loss: pod object disappears entirely
+                name = rng.choice(sorted(live_jobs))
+                pods = sub.list_pods("default", t.gen_labels(name))
+                if pods:
+                    try:
+                        sub.delete_pod(
+                            "default", rng.choice(pods).metadata.name
+                        )
+                    except Exception:
+                        pass
+            elif action == "delete_job" and live_jobs and rng.random() < 0.3:
+                name = rng.choice(sorted(live_jobs))
+                sub.delete_job("default", name)
+                del live_jobs[name]
+            # interleave a partial sync burst — NOT always to
+            # quiescence, so actions land mid-reconcile
+            for _ in range(rng.randint(0, 3)):
+                controller.process_next(timeout=0.01)
+            assert_no_duplicate_active_pods()
+
+        # drive to quiescence — and PROVE it: a wedged/hot-requeueing
+        # queue must fail the test, not just exhaust the loop
+        for _ in range(10):
+            sub.run_all_pending()
+            if controller.run_until_quiet(max_steps=200) == 0:
+                break
+        assert controller.run_until_quiet(max_steps=200) == 0, (
+            f"queue never drained (seed={seed})"
+        )
+        assert_no_duplicate_active_pods()
+
+        for name in list(live_jobs):
+            stored = sub.get_job("default", name)
+            active = [
+                p for p in sub.list_pods("default", t.gen_labels(name))
+                if p.is_active()
+            ]
+            if stored.is_finished():
+                assert not active, (
+                    f"{name} finished but keeps active pods "
+                    f"{[p.metadata.name for p in active]} (seed={seed})"
+                )
+            else:
+                expected = set()
+                for rtype, count in live_jobs[name].items():
+                    for index in range(count):
+                        expected.add((rtype.lower(), str(index)))
+
+                def index_of(p):
+                    return (
+                        p.metadata.labels.get(t.LABEL_REPLICA_TYPE),
+                        p.metadata.labels.get(t.LABEL_REPLICA_INDEX),
+                    )
+
+                got = {index_of(p) for p in active}
+                terminal = {
+                    index_of(p)
+                    for p in sub.list_pods("default", t.gen_labels(name))
+                    if not p.is_active()
+                }
+                # every expected index is covered by an active pod OR a
+                # terminal pod the policy correctly does not restart
+                # (e.g. a PS that exited 0 under RestartPolicy.NEVER
+                # while workers keep running); active pods never exceed
+                # the spec (scale-down deletes out-of-range actives)
+                assert expected <= (got | terminal), (
+                    f"{name}: uncovered indexes "
+                    f"{sorted(expected - got - terminal)} (seed={seed})"
+                )
+                assert got <= expected, (
+                    f"{name}: out-of-spec active pods "
+                    f"{sorted(got - expected)} (seed={seed})"
+                )

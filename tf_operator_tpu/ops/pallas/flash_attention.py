@@ -25,11 +25,11 @@ per the TPU pallas playbook:
 - scores/statistics accumulate in f32 (VPU), matmuls run in the input
   dtype (bf16 -> MXU native); causal programs skip the matmuls of
   blocks past the diagonal in both directions.
-- key-padding masks ([batch, seq_kv], the form BERT passes) are
-  handled IN-KERNEL in forward and both backward kernels (invalid
-  columns score NEG_INF, exactly like causal masking), so padded
-  batches keep O(seq) memory; only full [b, 1, sq, sk] bias-style
-  masks fall back to the XLA path.
+- key-padding masks (the [batch, 1, 1, seq_kv] broadcast form BERT
+  passes) are handled IN-KERNEL in forward and both backward kernels
+  (invalid columns score NEG_INF, exactly like causal masking), so
+  padded batches keep O(seq) memory; 2-D broadcast masks and
+  query-dependent [b, 1, sq, sk] masks fall back to the XLA path.
 - head_dim 64 (BERT-base) is flash-eligible through lane padding:
   Q/K/V are zero-padded to the 128-lane MXU tile (zero lanes add
   nothing to scores; the padded output/gradient lanes are sliced off).
@@ -169,8 +169,9 @@ def _flash_forward(
     q: jax.Array, k: jax.Array, v: jax.Array, kv_mask, causal: bool,
     sm_scale: float, block_q: int, block_kv: int, interpret: bool,
 ):
-    """q/k/v: [bh, seq, d]; kv_mask: [bh, seq_kv] f32 validity or None
-    -> (out [bh, seq, d], lse [bh, seq])."""
+    """q/k/v: [bh, seq, d]; kv_mask: [batch, seq_kv] f32 validity or
+    None (the BlockSpec index map reads row b'//heads for folded
+    program b') -> (out [bh, seq, d], lse [bh, seq])."""
     bh, seq_q, d = q.shape
     seq_kv = k.shape[1]
     grid = (bh, seq_q // block_q, seq_kv // block_kv)
@@ -569,26 +570,28 @@ def flash_attention(
 
     mask handling:
     - None: dense (packed) attention, fully in-kernel;
-    - a KEY-PADDING mask — [batch, seq_kv], or the equivalent
-      query-independent broadcast form [batch, 1, 1, seq_kv] models
-      pass (truthy = attend): handled in-kernel — invalid kv columns
-      score NEG_INF in the forward and in both backward kernels, so
-      padded batches keep the O(seq) flash memory behavior (padded
-      QUERY rows produce unused finite outputs; their loss weights are
-      zero in every caller, so dO is zero there and every gradient
-      contribution vanishes);
-    - any other mask (query-dependent [b, 1, sq, sk], [sq, sk]
-      broadcasts, ...): falls back to the XLA reference path, which
-      keeps plain jnp broadcast semantics.
+    - a KEY-PADDING mask in the explicit query-independent broadcast
+      form [batch, 1, 1, seq_kv] (truthy = attend — the form models
+      pass): handled in-kernel — invalid kv columns score NEG_INF in
+      the forward and in both backward kernels, so padded batches keep
+      the O(seq) flash memory behavior (padded QUERY rows produce
+      unused finite outputs; their loss weights are zero in every
+      caller, so dO is zero there and every gradient contribution
+      vanishes). The 4-D form is required precisely because it is
+      unambiguous: a 2-D [batch, seq_kv] mask is indistinguishable
+      from a broadcastable [seq_q, seq_kv] mask whenever
+      batch == seq_q, and silently misrouting a causal tril would be
+      far worse than asking callers for one [:, None, None, :];
+    - any other mask (2-D broadcasts, query-dependent
+      [b, 1, sq, sk], ...): falls back to the XLA reference path,
+      which keeps plain jnp broadcast semantics.
     """
     from ..attention import dot_product_attention
 
     b, sq, h, d = query.shape
     sk = key.shape[1]
     kv_mask = None  # [b, sk] kernel form
-    if mask is not None and getattr(mask, "ndim", 0) == 2 and mask.shape == (b, sk):
-        kv_mask = mask
-    elif mask is not None and getattr(mask, "ndim", 0) == 4 and mask.shape == (
+    if mask is not None and getattr(mask, "ndim", 0) == 4 and mask.shape == (
         b, 1, 1, sk,
     ):
         kv_mask = mask[:, 0, 0, :]
@@ -597,10 +600,6 @@ def flash_attention(
     ):
         if mask is None:
             _warn_fallback(sq, sk, d)
-        if mask is not None and mask.ndim == 2 and mask.shape == (b, sk):
-            # key-padding mask for a shape the kernel can't take:
-            # expand to the reference path's [b, 1, 1, sk] broadcast
-            mask = mask[:, None, None, :].astype(bool)
         if causal:
             # the fallback must honor causality too
             causal_mask = (
