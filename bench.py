@@ -162,12 +162,14 @@ def bench_resnet(
 def time_fed_steps(
     trainer, state, rng, global_batch, image_size, classes, steps, resnet_lib
 ) -> tuple:
-    """Per-step dispatch with a host feed: batches prepared as numpy on
-    the host, the NEXT batch device_put while the current step runs
-    (double buffering — jax dispatch is async, so the transfer overlaps
-    device compute). Includes host->device bytes in the measured time,
-    which the resident-batch number deliberately excludes."""
+    """Per-step dispatch with a host feed through the framework's
+    InputPipeline (train/input_pipeline.py): background host batch
+    prep + double-buffered device placement. Includes host->device
+    bytes in the measured time, which the resident-batch number
+    deliberately excludes."""
     import numpy as np
+
+    from tf_operator_tpu.train import InputPipeline
 
     host_batches = []
     for i in range(4):  # distinct batches so no transfer is a no-op
@@ -180,13 +182,13 @@ def time_fed_steps(
 
     def run(n):
         nonlocal state
-        nxt = trainer.place_batch(host_batches[0])
         last = None
-        for i in range(n):
-            cur = nxt
-            if i + 1 < n:
-                nxt = trainer.place_batch(host_batches[(i + 1) % 4])
-            state, last = trainer.step(state, cur)
+        with InputPipeline(
+            source=lambda i: host_batches[i % 4], trainer=trainer,
+            depth=2, steps=n,
+        ) as pipe:
+            for batch in pipe:
+                state, last = trainer.step(state, batch)
         float(last["loss"])  # drain
 
     run(2)  # compile + warm

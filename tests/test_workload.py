@@ -5,6 +5,8 @@ and real train steps (MNIST / ResNet / BERT) with dp / fsdp / tp
 shardings — loss must decrease and params must land sharded as ruled.
 """
 
+import dataclasses
+
 import jax
 import jax.numpy as jnp
 import numpy as np
@@ -154,6 +156,81 @@ class TestTraining:
         # batch stats actually updated
         flat = jax.tree_util.tree_leaves(state.batch_stats)
         assert any(float(jnp.abs(leaf).sum()) > 0 for leaf in flat)
+
+    def test_input_pipeline_feeds_device_batches(self, devices8):
+        """InputPipeline must deliver exactly `steps` placed batches in
+        order, overlap-safe, and propagate producer errors."""
+        from tf_operator_tpu.train import InputPipeline
+
+        mesh = build_mesh(MeshConfig(dp=8))
+        model = mnist_lib.MnistCNN()
+        trainer = Trainer(
+            model, classification_task(model), optax.adam(1e-3), mesh=mesh
+        )
+        rng = jax.random.PRNGKey(0)
+        sample = mnist_lib.synthetic_batch(rng, 16)
+        state = trainer.init(rng, sample)
+
+        from tf_operator_tpu.train import synthetic_source
+
+        seen = []
+        pipe = InputPipeline(
+            source=synthetic_source(
+                lambda key: mnist_lib.synthetic_batch(key, 16)
+            ),
+            trainer=trainer, depth=2, steps=4,
+        )
+        with pipe:
+            for batch in pipe:
+                state, metrics = trainer.step(state, batch)
+                seen.append(float(metrics["loss"]))
+        assert len(seen) == 4 and all(np.isfinite(loss) for loss in seen)
+        assert int(state.step) == 4
+        # terminal: iterating a finished pipeline keeps raising
+        # StopIteration instead of blocking on the dead producer
+        with pytest.raises(StopIteration):
+            next(pipe)
+
+        # producer exceptions surface on the consumer side
+        def boom(i):
+            if i == 1:
+                raise RuntimeError("source failed")
+            return mnist_lib.synthetic_batch(rng, 16)
+
+        with InputPipeline(source=boom, trainer=trainer, depth=2) as pipe:
+            next(pipe)  # first batch fine
+            with pytest.raises(RuntimeError, match="source failed"):
+                for _ in range(3):
+                    next(pipe)
+
+    def test_bert_remat_matches_nonremat(self, devices8):
+        """Per-block remat (BertConfig.remat) is a pure memory/FLOPs
+        trade: the loss and gradients must be identical."""
+        cfg = bert_lib.BERT_TINY
+        cfg_remat = dataclasses.replace(cfg, remat=True)
+        rng = jax.random.PRNGKey(0)
+        batch = bert_lib.synthetic_batch(rng, 4, 128, cfg)
+
+        def loss_for(config):
+            model = bert_lib.BertForMLM(config)
+            variables = model.init(rng, batch["input_ids"])
+
+            def loss_fn(params):
+                logits = model.apply({"params": params}, batch["input_ids"])
+                return bert_lib.mlm_loss(
+                    logits, batch["labels"], batch["mlm_weights"]
+                )
+
+            loss, grads = jax.value_and_grad(loss_fn)(variables["params"])
+            return loss, grads
+
+        loss_a, grads_a = loss_for(cfg)
+        loss_b, grads_b = loss_for(cfg_remat)
+        np.testing.assert_allclose(loss_a, loss_b, rtol=1e-6)
+        jax.tree_util.tree_map(
+            lambda a, b: np.testing.assert_allclose(a, b, rtol=2e-5, atol=2e-5),
+            grads_a, grads_b,
+        )
 
     def test_s2d_stem_reparameterizes_conv7(self):
         """The space-to-depth stem is exactly as expressive as the

@@ -1,4 +1,5 @@
 from .bert import BERT_BASE, BERT_TINY, BertConfig, BertEncoder, BertForMLM, mlm_loss
+from .gpt import GPT, GPT_SMALL, GPT_TINY, GPTConfig, causal_lm_loss, generate
 from .mnist import MnistCNN
 from .moe import MOE_BASE, MOE_TINY, MoEConfig, MoELM, lm_loss, total_aux_loss
 from .resnet import ResNet, ResNet18ish, ResNet50
@@ -14,6 +15,12 @@ __all__ = [
     "BERT_BASE",
     "BERT_TINY",
     "mlm_loss",
+    "GPT",
+    "GPTConfig",
+    "GPT_SMALL",
+    "GPT_TINY",
+    "causal_lm_loss",
+    "generate",
     "MoEConfig",
     "MoELM",
     "MOE_BASE",

@@ -32,6 +32,13 @@ class BertConfig:
     intermediate_size: int = 3072
     max_position_embeddings: int = 512
     dtype: jnp.dtype = jnp.bfloat16
+    # per-block rematerialization: recompute each transformer block's
+    # forward during backward instead of keeping its activations
+    # resident — the standard HBM-for-FLOPs trade that buys longer
+    # sequences / bigger per-chip batches on TPU. Block granularity is
+    # the useful one: whole-model remat re-materializes everything at
+    # once during backward and saves nothing at peak.
+    remat: bool = False
 
     @property
     def head_dim(self) -> int:
@@ -100,8 +107,11 @@ class BertEncoder(nn.Module):
             # and masks kv columns IN-KERNEL instead of falling back
             # (r3); the XLA path broadcasts it as before.
             attn_mask = mask[:, None, None, :].astype(bool)
+        block_cls = TransformerBlock
+        if cfg.remat:
+            block_cls = nn.remat(TransformerBlock, static_argnums=())
         for layer in range(cfg.num_layers):
-            x = TransformerBlock(
+            x = block_cls(
                 cfg, attention_fn=self.attention_fn, name=f"layer_{layer}"
             )(x, attn_mask)
         return nn.LayerNorm(dtype=jnp.float32, name="ln_final")(x)

@@ -1,3 +1,4 @@
+from .input_pipeline import InputPipeline, synthetic_source
 from .trainer import (
     Checkpointer,
     Task,
@@ -14,4 +15,6 @@ __all__ = [
     "classification_task",
     "mlm_task",
     "Checkpointer",
+    "InputPipeline",
+    "synthetic_source",
 ]

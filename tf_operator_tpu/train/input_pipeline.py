@@ -1,0 +1,165 @@
+"""Host input pipeline: background batch preparation + double-buffered
+device placement.
+
+The reference delegates input entirely to tf.data inside user
+containers (SURVEY.md §2.3); this is the framework-native equivalent
+for JAX workloads. TPU-first design:
+
+- the host thread PREPARES batches (numpy/CPU augmentation) while the
+  device runs the current step;
+- `device_put` of the NEXT batch is issued before the current step's
+  results are consumed — jax dispatch is async, so the host->HBM
+  transfer overlaps device compute (double buffering);
+- placement goes through the same NamedSharding the Trainer uses, so
+  a global batch lands sharded across the mesh without a gather.
+
+Usage:
+    pipe = InputPipeline(source=my_batch_fn, trainer=trainer, depth=2)
+    for batch in pipe:          # batches already on device
+        state, metrics = trainer.step(state, batch)
+
+`bench.py`'s fed_images_per_sec_per_chip measures exactly this path.
+"""
+
+from __future__ import annotations
+
+import queue
+import threading
+from typing import Callable, Iterator, Optional
+
+import jax
+
+
+class InputPipeline:
+    """Wrap a host batch source into a device-fed iterator.
+
+    source: callable (step index) -> host batch (dict of arrays), or an
+    iterator/generator of host batches.
+    trainer: the Trainer whose mesh/sharding places the batch (its
+    `place_batch` applies the packed/sequence-parallel mask handling
+    too).
+    depth: how many prepared+placed batches may be in flight; 2 =
+    classic double buffering (one on device feeding the current step,
+    one in transfer).
+    """
+
+    def __init__(
+        self,
+        source,
+        trainer,
+        depth: int = 2,
+        steps: Optional[int] = None,
+    ) -> None:
+        if depth < 1:
+            raise ValueError("depth must be >= 1")
+        self.trainer = trainer
+        self.depth = depth
+        self.steps = steps
+        if callable(source) and not hasattr(source, "__next__"):
+            self._next_host = _counted(source)
+        else:
+            iterator = iter(source)
+            self._next_host = lambda: next(iterator)
+        self._queue: queue.Queue = queue.Queue(maxsize=depth)
+        self._stop = threading.Event()
+        self._done = False
+        self._error: Optional[BaseException] = None
+        self._thread = threading.Thread(
+            target=self._feed, name="input-pipeline", daemon=True
+        )
+        self._thread.start()
+
+    # -- producer ----------------------------------------------------------
+
+    def _feed(self) -> None:
+        produced = 0
+        try:
+            while not self._stop.is_set():
+                if self.steps is not None and produced >= self.steps:
+                    break
+                host_batch = self._next_host()
+                if host_batch is None:
+                    break
+                # place from the producer thread: the transfer is
+                # enqueued to the device while the consumer is still
+                # running the previous step
+                device_batch = self.trainer.place_batch(host_batch)
+                produced += 1
+                while not self._stop.is_set():
+                    try:
+                        self._queue.put(device_batch, timeout=0.1)
+                        break
+                    except queue.Full:
+                        continue
+        except StopIteration:
+            pass
+        except BaseException as err:  # surfaced on the consumer side
+            self._error = err
+        finally:
+            while not self._stop.is_set():
+                try:
+                    self._queue.put(_SENTINEL, timeout=0.1)
+                    break
+                except queue.Full:
+                    continue
+
+    # -- consumer ----------------------------------------------------------
+
+    def __iter__(self) -> Iterator:
+        return self
+
+    def __next__(self):
+        if self._done:
+            # terminal: the sentinel was already consumed (exhaustion,
+            # producer error, or close()) — keep raising instead of
+            # blocking forever on an empty queue with a dead producer
+            raise StopIteration
+        item = self._queue.get()
+        if item is _SENTINEL:
+            self._done = True
+            if self._error is not None:
+                error, self._error = self._error, None
+                raise error
+            raise StopIteration
+        return item
+
+    def close(self) -> None:
+        self._stop.set()
+        self._done = True
+        # unblock a producer stuck on a full queue
+        try:
+            while True:
+                self._queue.get_nowait()
+        except queue.Empty:
+            pass
+        self._thread.join(timeout=5)
+
+    def __enter__(self) -> "InputPipeline":
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.close()
+
+
+_SENTINEL = object()
+
+
+def _counted(fn: Callable[[int], dict]) -> Callable[[], Optional[dict]]:
+    state = {"i": 0}
+
+    def nxt():
+        batch = fn(state["i"])
+        state["i"] += 1
+        return batch
+
+    return nxt
+
+
+def synthetic_source(make_batch: Callable[[jax.Array], dict], seed: int = 0):
+    """Infinite host-batch source from a keyed synthetic generator
+    (models.*.synthetic_batch partials): each call gets a fresh fold of
+    the seed so batches differ — transfers are never no-ops."""
+    def source(step: int) -> dict:
+        return make_batch(jax.random.fold_in(jax.random.PRNGKey(seed), step))
+
+    return source

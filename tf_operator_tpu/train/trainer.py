@@ -126,7 +126,11 @@ class Trainer:
     def _model_inputs(self, batch):
         if "image" in batch:
             return (batch["image"],)
-        return (batch["input_ids"], batch.get("attention_mask"))
+        if "attention_mask" in batch:
+            return (batch["input_ids"], batch["attention_mask"])
+        # mask-free token batch: don't force a positional None on
+        # models (GPT) whose __call__ has no mask parameter
+        return (batch["input_ids"],)
 
     def init(self, rng: jax.Array, sample_batch: Dict[str, jax.Array]) -> TrainState:
         """Initialize the TrainState *already sharded*: abstract-eval the
