@@ -80,27 +80,56 @@ class TestTraining:
 
 class TestDecode:
     def test_cached_decode_matches_training_forward(self, cfg, trained):
-        """Greedy KV-cached generation must equal greedy decoding via
-        repeated full-sequence training forwards."""
+        """Teacher-forced parity: feeding a fixed sequence through the
+        KV-cached decode step must reproduce the TRAINING forward's
+        logits at every position (tight tolerance — the dataflow
+        differs, the math must not). Token-chain equality is
+        deliberately NOT asserted: autoregressive argmax amplifies
+        last-ulp reduction-order differences on near-tie logits of a
+        briefly-trained model into diverged suffixes."""
         model, state, _, _ = trained
+        params = state.params
+        seq = gpt_lib.synthetic_batch(
+            jax.random.PRNGKey(9), 2, 12, cfg
+        )["input_ids"]
+
+        train_logits = model.apply({"params": params}, seq)  # [2, 12, V]
+
+        dstep = gpt_lib.GPTDecodeStep(cfg, cache_len=12)
+        cache = jax.eval_shape(
+            lambda: dstep.init(
+                jax.random.PRNGKey(0), jnp.zeros((2,), jnp.int32),
+                jnp.int32(0),
+            )["cache"]
+        )
+        cache = jax.tree_util.tree_map(
+            lambda s: jnp.zeros(s.shape, s.dtype), cache
+        )
+        for i in range(12):
+            logits, updates = dstep.apply(
+                {"params": params, "cache": cache}, seq[:, i], jnp.int32(i),
+                mutable=["cache"],
+            )
+            cache = updates["cache"]
+            np.testing.assert_allclose(
+                np.asarray(logits), np.asarray(train_logits[:, i]),
+                atol=1e-3, rtol=1e-3,
+                err_msg=f"decode/train logit mismatch at position {i}",
+            )
+
+    def test_generate_prefix_and_shapes(self, cfg, trained):
+        _, state, _, _ = trained
         params = jax.device_get(state.params)
         prompt = gpt_lib.synthetic_batch(
             jax.random.PRNGKey(9), 2, 8, cfg
         )["input_ids"]
-
-        new = 6
-        got = gpt_lib.generate(cfg, params, prompt, max_new_tokens=new)
-        assert got.shape == (2, 8 + new)
-        np.testing.assert_array_equal(np.asarray(got[:, :8]), np.asarray(prompt))
-
-        # reference: grow the sequence one token at a time through the
-        # TRAINING forward (no cache), taking argmax of the last logit
-        seq = prompt
-        for _ in range(new):
-            logits = model.apply({"params": state.params}, seq)
-            nxt = jnp.argmax(logits[:, -1], axis=-1).astype(seq.dtype)
-            seq = jnp.concatenate([seq, nxt[:, None]], axis=1)
-        np.testing.assert_array_equal(np.asarray(got), np.asarray(seq))
+        got = gpt_lib.generate(cfg, params, prompt, max_new_tokens=6)
+        assert got.shape == (2, 14)
+        np.testing.assert_array_equal(
+            np.asarray(got[:, :8]), np.asarray(prompt)
+        )
+        arr = np.asarray(got)
+        assert ((arr >= 0) & (arr < cfg.vocab_size)).all()
 
     def test_sampled_decode_shapes_and_validity(self, cfg, trained):
         model, state, _, _ = trained

@@ -58,6 +58,19 @@ BERT_TINY = BertConfig(
 )
 
 
+def transformer_mlp(cfg, x: jax.Array) -> jax.Array:
+    """The LN'd-input MLP half of a transformer block. A free function
+    creating layers in the CALLER's scope (flax attaches them to the
+    calling module), so TransformerBlock and the GPT decode-path
+    _CachedBlock share one implementation with identical param paths
+    (mlp_in/mlp_out)."""
+    y = nn.Dense(cfg.intermediate_size, dtype=cfg.dtype, name="mlp_in")(
+        x.astype(cfg.dtype)
+    )
+    y = nn.gelu(y)
+    return nn.Dense(cfg.hidden_size, dtype=cfg.dtype, name="mlp_out")(y)
+
+
 class TransformerBlock(nn.Module):
     config: BertConfig
     attention_fn: object = None
@@ -75,12 +88,7 @@ class TransformerBlock(nn.Module):
         )(y.astype(cfg.dtype), mask)
         x = x + y
         y = nn.LayerNorm(dtype=jnp.float32, name="ln_mlp")(x)
-        y = nn.Dense(cfg.intermediate_size, dtype=cfg.dtype, name="mlp_in")(
-            y.astype(cfg.dtype)
-        )
-        y = nn.gelu(y)
-        y = nn.Dense(cfg.hidden_size, dtype=cfg.dtype, name="mlp_out")(y)
-        return x + y
+        return x + transformer_mlp(cfg, y)
 
 
 class BertEncoder(nn.Module):

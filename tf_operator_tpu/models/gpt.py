@@ -121,7 +121,7 @@ def synthetic_batch(rng: jax.Array, batch_size: int, seq_len: int,
     successor = jax.random.randint(
         jax.random.PRNGKey(7), (cfg.vocab_size,), 0, cfg.vocab_size
     )
-    start_rng, noise_rng = jax.random.split(rng)
+    start_rng, where_rng, what_rng = jax.random.split(rng, 3)
     start = jax.random.randint(start_rng, (batch_size,), 0, cfg.vocab_size)
 
     def step(tok, _):
@@ -131,9 +131,10 @@ def synthetic_batch(rng: jax.Array, batch_size: int, seq_len: int,
     _, seq = jax.lax.scan(step, start, None, length=seq_len - 1)
     tokens = jnp.concatenate([start[:, None], seq.T], axis=1)
     # 10% uniform corruption so the mapping isn't trivially memorized
-    # from one batch
-    corrupt = jax.random.bernoulli(noise_rng, 0.1, tokens.shape)
-    random_tok = jax.random.randint(noise_rng, tokens.shape, 0, cfg.vocab_size)
+    # from one batch; independent keys for WHERE vs WHAT, or the
+    # replacement values would be correlated with the corruption sites
+    corrupt = jax.random.bernoulli(where_rng, 0.1, tokens.shape)
+    random_tok = jax.random.randint(what_rng, tokens.shape, 0, cfg.vocab_size)
     tokens = jnp.where(corrupt, random_tok, tokens)
     return {"input_ids": tokens}
 
@@ -157,10 +158,11 @@ class CachedSelfAttention(nn.Module):
 
     @nn.compact
     def __call__(self, x: jax.Array, index: jax.Array) -> jax.Array:
+        from ..ops.attention import head_projection
+
         batch = x.shape[0]
-        dense = lambda name: nn.DenseGeneral(  # noqa: E731
-            features=(self.num_heads, self.head_dim), axis=-1,
-            dtype=self.dtype, name=name,
+        dense = lambda name: head_projection(  # noqa: E731
+            self.num_heads, self.head_dim, self.dtype, name
         )
         # x: [batch, hidden] — ONE new token per call
         query = dense("query")(x)[:, None]  # [b, 1, h, d]
@@ -203,9 +205,16 @@ class CachedSelfAttention(nn.Module):
 class GPTDecodeStep(nn.Module):
     """One-token forward reusing the training weight names, so trained
     `GPT` params load directly (same module/param paths; attention
-    projections share names via CachedSelfAttention)."""
+    projections share names via CachedSelfAttention).
+
+    cache_len sizes the KV cache and the per-step attention — the
+    DECODE length, not cfg.max_seq_len: the cache shape is a variable,
+    not a param, so a 14-token generate attends over 14 keys instead
+    of paying max_seq_len (2048) compute+HBM per step. The position
+    embedding table keeps cfg.max_seq_len (it IS a trained param)."""
 
     config: GPTConfig
+    cache_len: int = 0  # 0 -> cfg.max_seq_len
 
     @nn.compact
     def __call__(self, token: jax.Array, index: jax.Array) -> jax.Array:
@@ -218,8 +227,11 @@ class GPTDecodeStep(nn.Module):
             cfg.max_seq_len, cfg.hidden_size, dtype=cfg.dtype,
             name="position_embed",
         )(index)
+        cache_len = self.cache_len or cfg.max_seq_len
         for layer in range(cfg.num_layers):
-            x = _CachedBlock(cfg, name=f"layer_{layer}")(x, index)
+            x = _CachedBlock(
+                cfg, cache_len=cache_len, name=f"layer_{layer}"
+            )(x, index)
         x = nn.LayerNorm(dtype=jnp.float32, name="ln_final")(x)
         return nn.Dense(
             cfg.vocab_size, dtype=jnp.float32, name="lm_head"
@@ -228,23 +240,22 @@ class GPTDecodeStep(nn.Module):
 
 class _CachedBlock(nn.Module):
     config: GPTConfig
+    cache_len: int = 0
 
     @nn.compact
     def __call__(self, x: jax.Array, index: jax.Array) -> jax.Array:
+        from .bert import transformer_mlp
+
         cfg = self.config
         y = nn.LayerNorm(dtype=jnp.float32, name="ln_attn")(x)
         y = CachedSelfAttention(
             num_heads=cfg.num_heads, head_dim=cfg.head_dim,
-            max_len=cfg.max_seq_len, dtype=cfg.dtype, name="attention",
+            max_len=self.cache_len or cfg.max_seq_len, dtype=cfg.dtype,
+            name="attention",
         )(y.astype(cfg.dtype), index)
         x = x + y
         y = nn.LayerNorm(dtype=jnp.float32, name="ln_mlp")(x)
-        y = nn.Dense(cfg.intermediate_size, dtype=cfg.dtype, name="mlp_in")(
-            y.astype(cfg.dtype)
-        )
-        y = nn.gelu(y)
-        y = nn.Dense(cfg.hidden_size, dtype=cfg.dtype, name="mlp_out")(y)
-        return x + y
+        return x + transformer_mlp(cfg, y)
 
 
 @functools.lru_cache(maxsize=32)
@@ -252,14 +263,25 @@ def _compiled_decode(cfg: GPTConfig, temperature: float, batch: int,
                      prompt_len: int, total: int):
     """One compiled decode scan per (config, temperature, shape) —
     generate() calls with the same shapes reuse it instead of paying a
-    re-trace + XLA compile per call (the serving/eval loop pattern)."""
-    model = GPTDecodeStep(cfg)
-    cache0 = model.init(
-        jax.random.PRNGKey(0), jnp.zeros((batch,), jnp.int32), jnp.int32(0)
-    )["cache"]
+    re-trace + XLA compile per call (the serving/eval loop pattern).
+    The KV cache is sized to `total` (not cfg.max_seq_len) and created
+    as zeros INSIDE the jitted function from an abstract shape tree —
+    the executable carries no device-array constants, so cached
+    entries cost metadata, not HBM."""
+    model = GPTDecodeStep(cfg, cache_len=total)
+    cache_shapes = jax.eval_shape(
+        lambda: model.init(
+            jax.random.PRNGKey(0), jnp.zeros((batch,), jnp.int32),
+            jnp.int32(0),
+        )["cache"]
+    )
 
     @jax.jit
     def run(params, prompt, rng):
+        cache0 = jax.tree_util.tree_map(
+            lambda s: jnp.zeros(s.shape, s.dtype), cache_shapes
+        )
+
         def step(carry, index):
             cache, tok, rng = carry
             logits, updates = model.apply(

@@ -40,6 +40,17 @@ def dot_product_attention(
     return jnp.einsum("bhqk,bkhd->bqhd", weights, value)
 
 
+def head_projection(
+    num_heads: int, head_dim: int, dtype: jnp.dtype, name: str
+) -> nn.DenseGeneral:
+    """[..., features] -> [..., num_heads, head_dim] projection. Shared
+    by MultiHeadAttention and the GPT decode path's CachedSelfAttention
+    so both create identical param paths (query/key/value kernels)."""
+    return nn.DenseGeneral(
+        features=(num_heads, head_dim), axis=-1, dtype=dtype, name=name
+    )
+
+
 class MultiHeadAttention(nn.Module):
     num_heads: int
     head_dim: int
@@ -48,12 +59,8 @@ class MultiHeadAttention(nn.Module):
 
     @nn.compact
     def __call__(self, x: jax.Array, mask: Optional[jax.Array] = None) -> jax.Array:
-        features = self.num_heads * self.head_dim
-        dense = lambda name: nn.DenseGeneral(  # noqa: E731
-            features=(self.num_heads, self.head_dim),
-            axis=-1,
-            dtype=self.dtype,
-            name=name,
+        dense = lambda name: head_projection(  # noqa: E731
+            self.num_heads, self.head_dim, self.dtype, name
         )
         query = dense("query")(x)
         key = dense("key")(x)
