@@ -422,7 +422,18 @@ def main() -> None:
     on_tpu = devices[0].platform == "tpu"
 
     resnet = bench_resnet(on_tpu, n_chips)
-    bert = bench_bert(on_tpu, n_chips)
+    # headline BERT rides the pallas flash path; if the kernel fails on
+    # this chip/toolchain (r3's regridded kernels are validated in
+    # interpret mode but compile fresh here), fall back to the XLA
+    # path rather than losing every headline number
+    bert_attention = "flash(packed)" if on_tpu else "fallback(cpu)"
+    try:
+        bert = bench_bert(on_tpu, n_chips)
+    except Exception as err:  # noqa: BLE001
+        bert = bench_bert(on_tpu, n_chips, attention="xla")
+        bert_attention = (
+            f"xla (flash path failed: {type(err).__name__}: {err})"[:160]
+        )
 
     headline_value = resnet["images_per_sec_per_chip"]
     vs_baseline = (
@@ -439,7 +450,7 @@ def main() -> None:
         "bert_tokens_per_sec_per_chip": bert["tokens_per_sec_per_chip"],
         "bert_mfu": bert["mfu"],
         "bert_seq_len": bert["seq_len"],
-        "bert_attention": "flash(packed)" if on_tpu else "fallback(cpu)",
+        "bert_attention": bert_attention,
         "chip": getattr(devices[0], "device_kind", devices[0].platform),
         "n_chips": n_chips,
         "target_mfu": TARGET_MFU,

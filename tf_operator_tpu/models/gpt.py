@@ -25,7 +25,7 @@ import jax
 import jax.numpy as jnp
 from flax import linen as nn
 
-from ..ops.attention import dot_product_attention
+from ..ops.attention import dot_product_attention, head_projection
 
 
 @dataclasses.dataclass(frozen=True)
@@ -158,8 +158,6 @@ class CachedSelfAttention(nn.Module):
 
     @nn.compact
     def __call__(self, x: jax.Array, index: jax.Array) -> jax.Array:
-        from ..ops.attention import head_projection
-
         batch = x.shape[0]
         dense = lambda name: head_projection(  # noqa: E731
             self.num_heads, self.head_dim, self.dtype, name

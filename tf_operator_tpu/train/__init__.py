@@ -4,6 +4,7 @@ from .trainer import (
     Task,
     Trainer,
     TrainState,
+    causal_lm_task,
     classification_task,
     mlm_task,
 )
@@ -14,6 +15,7 @@ __all__ = [
     "Task",
     "classification_task",
     "mlm_task",
+    "causal_lm_task",
     "Checkpointer",
     "InputPipeline",
     "synthetic_source",

@@ -55,7 +55,7 @@ def main(argv=None) -> int:
 
     from ..models import gpt as gpt_lib
     from ..parallel.mesh import MeshConfig, build_mesh, mesh_summary
-    from ..train.trainer import Task, Trainer
+    from ..train.trainer import Trainer, causal_lm_task
 
     cfg = {"small": gpt_lib.GPT_SMALL, "tiny": gpt_lib.GPT_TINY}[args.preset]
     if args.seq_len > cfg.max_seq_len or args.remat:
@@ -74,15 +74,8 @@ def main(argv=None) -> int:
         attention_fn = make_ring_attention(mesh, causal=True)
         logger.info("causal ring attention over sp=%d", args.sp)
     model = gpt_lib.GPT(cfg, attention_fn=attention_fn)
-
-    def loss_fn(variables, batch, train=True):
-        logits = model.apply(variables, batch["input_ids"])
-        return gpt_lib.causal_lm_loss(logits, batch["input_ids"]), {
-            "batch_stats": None
-        }
-
     trainer = Trainer(
-        model, Task(apply_fn=model.apply, loss_fn=loss_fn),
+        model, causal_lm_task(model),
         optax.adamw(args.learning_rate, weight_decay=0.01), mesh=mesh,
         shard_sequence=args.sp > 1, checkpoint_dir=args.checkpoint_dir,
     )
@@ -121,7 +114,11 @@ def main(argv=None) -> int:
     if args.checkpoint_dir:
         trainer.save(state)
 
-    if args.generate > 0 and proc.process_id == 0:
+    if args.generate > 0 and proc.num_processes > 1:
+        # params sharded across hosts are not fully addressable from
+        # one process; the decode demo is a single-host convenience
+        logger.info("--generate skipped on multi-host runs")
+    elif args.generate > 0:
         prompt = jax.device_get(sample["input_ids"][:1, :8])
         out = gpt_lib.generate(
             cfg, jax.device_get(state.params), jax.numpy.asarray(prompt),

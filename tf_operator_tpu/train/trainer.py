@@ -84,6 +84,18 @@ def mlm_task(model) -> Task:
     return Task(apply_fn=model.apply, loss_fn=loss_fn)
 
 
+def causal_lm_task(model) -> Task:
+    """Next-token prediction on mask-free token batches (GPT)."""
+    from ..models.gpt import causal_lm_loss
+
+    def loss_fn(variables, batch, train=True):
+        logits = model.apply(variables, batch["input_ids"])
+        loss = causal_lm_loss(logits, batch["input_ids"])
+        return loss, {"batch_stats": None}
+
+    return Task(apply_fn=model.apply, loss_fn=loss_fn)
+
+
 class Trainer:
     def __init__(
         self,
