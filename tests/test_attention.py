@@ -402,3 +402,41 @@ class TestFlashNarrowHead:
         assert float(loss) == float(loss)
         flat = jax.tree_util.tree_leaves(grads)
         assert all(bool(jnp.all(jnp.isfinite(g))) for g in flat)
+
+
+class TestMosaicLowering:
+    """AOT-lower the kernels for the TPU platform (no TPU needed): the
+    Mosaic block-mapping validation — e.g. the (8, 128) divisibility
+    rule on the last two block dims — runs client-side during MLIR
+    lowering, so this catches TPU-only compile failures that interpret
+    mode silently skips (which is exactly how the r3 regrid shipped a
+    kernel whose [bh, seq]-blocked lse output could not lower)."""
+
+    @pytest.mark.parametrize(
+        "b,h,d,seq,masked,causal",
+        [
+            (2, 4, 128, 1024, False, False),  # native head_dim, multi-block
+            (2, 4, 64, 512, True, False),     # BERT shape: lane pad + mask
+            (2, 4, 128, 2048, False, True),   # causal skip path
+            (2, 12, 64, 512, False, False),   # packed BERT headline shape
+        ],
+    )
+    def test_grad_lowers_for_tpu(self, b, h, d, seq, masked, causal):
+        q = jax.ShapeDtypeStruct((b, seq, h, d), jnp.bfloat16)
+        mask = (
+            jax.ShapeDtypeStruct((b, 1, 1, seq), jnp.bool_)
+            if masked else None
+        )
+
+        def loss(q, k, v, m):
+            out = flash_attention(
+                q, k, v, mask=m, causal=causal, interpret=False
+            )
+            return (out.astype(jnp.float32) ** 2).sum()
+
+        grad = jax.grad(
+            lambda *a: loss(a[0], a[1], a[2], a[3] if masked else None),
+            argnums=(0, 1, 2),
+        )
+        args = (q, q, q) + ((mask,) if masked else ())
+        jax.jit(grad).trace(*args).lower(lowering_platforms=("tpu",))

@@ -96,9 +96,16 @@ def _fwd_kernel(
     steps. Nothing larger than one block is ever VMEM-resident, so
     sequence length is HBM-bound, not VMEM-bound.
 
-    With has_mask, refs carry a [1, block_kv] f32 key-validity block
+    With has_mask, refs carry a [1, 1, block_kv] f32 key-validity block
     (1=attend, 0=padding) after v_ref; invalid columns score NEG_INF
-    exactly like causal masking."""
+    exactly like causal masking.
+
+    Row statistics (lse here, lse/delta in the backward kernels) ride
+    as [*, seq, 1] arrays blocked (1, block_q, 1): Mosaic requires the
+    last two block dims to be (8, 128)-divisible or equal to the array
+    dims, which a flat [bh, seq] row vector blocked (1, block_q)
+    violates; the explicit unit lane dim satisfies the rule AND hands
+    the kernel a ready (block_q, 1) column — no relayout."""
     if has_mask:
         q_ref, k_ref, v_ref, mask_ref, o_ref, lse_ref, acc_ref, m_ref, l_ref = refs
     else:
@@ -132,7 +139,7 @@ def _fwd_kernel(
             )
             s = jnp.where(q_pos >= k_pos, s, NEG_INF)
         if mask_ref is not None:
-            s = jnp.where(mask_ref[0][None, :] > 0, s, NEG_INF)
+            s = jnp.where(mask_ref[0] > 0, s, NEG_INF)  # (1, bkv) bcast
         # m/l scratch is (block_q, LANE) with all lanes equal — the VPU
         # register shape; column [:, :1] is the value
         m_prev = m_ref[...]
@@ -162,16 +169,16 @@ def _fwd_kernel(
         o_ref[0] = (acc_ref[...] / l_safe).astype(o_ref.dtype)
         # log-sum-exp of the SCALED scores: p = exp(s - lse) is the
         # exact softmax probability the backward kernels rebuild from
-        lse_ref[0] = m_ref[...][:, 0] + jnp.log(l_safe[:, 0])
+        lse_ref[0] = m_ref[...][:, :1] + jnp.log(l_safe)
 
 
 def _flash_forward(
     q: jax.Array, k: jax.Array, v: jax.Array, kv_mask, causal: bool,
     sm_scale: float, block_q: int, block_kv: int, interpret: bool,
 ):
-    """q/k/v: [bh, seq, d]; kv_mask: [batch, seq_kv] f32 validity or
+    """q/k/v: [bh, seq, d]; kv_mask: [batch, 1, seq_kv] f32 validity or
     None (the BlockSpec index map reads row b'//heads for folded
-    program b') -> (out [bh, seq, d], lse [bh, seq])."""
+    program b') -> (out [bh, seq, d], lse [bh, seq, 1])."""
     bh, seq_q, d = q.shape
     seq_kv = k.shape[1]
     grid = (bh, seq_q // block_q, seq_kv // block_kv)
@@ -198,7 +205,7 @@ def _flash_forward(
         # is shared across heads instead of duplicated
         heads = bh // kv_mask.shape[0]
         in_specs.append(
-            pl.BlockSpec((1, block_kv), lambda b, i, j: (b // heads, j),
+            pl.BlockSpec((1, 1, block_kv), lambda b, i, j: (b // heads, 0, j),
                          memory_space=pltpu.VMEM)
         )
         operands.append(kv_mask)
@@ -206,14 +213,14 @@ def _flash_forward(
         kernel,
         out_shape=(
             jax.ShapeDtypeStruct((bh, seq_q, d), q.dtype),
-            jax.ShapeDtypeStruct((bh, seq_q), jnp.float32),
+            jax.ShapeDtypeStruct((bh, seq_q, 1), jnp.float32),
         ),
         grid=grid,
         in_specs=in_specs,
         out_specs=(
             pl.BlockSpec((1, block_q, d), lambda b, i, j: (b, i, 0),
                          memory_space=pltpu.VMEM),
-            pl.BlockSpec((1, block_q), lambda b, i, j: (b, i),
+            pl.BlockSpec((1, block_q, 1), lambda b, i, j: (b, i, 0),
                          memory_space=pltpu.VMEM),
         ),
         scratch_shapes=[
@@ -270,8 +277,8 @@ def _bwd_dkv_kernel(
         v = v_ref[0].astype(jnp.float32)
         qb = q_ref[0].astype(jnp.float32)   # [block_q, d]
         dob = do_ref[0].astype(jnp.float32)
-        lse_b = lse_ref[0]
-        delta_b = delta_ref[0]
+        lse_b = lse_ref[0]      # [block_q, 1]
+        delta_b = delta_ref[0]  # [block_q, 1]
         s = jax.lax.dot_general(
             qb, k, dimension_numbers=(((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32,
@@ -285,8 +292,8 @@ def _bwd_dkv_kernel(
             )
             s = jnp.where(q_pos >= k_pos, s, NEG_INF)
         if mask_ref is not None:
-            s = jnp.where(mask_ref[0][None, :] > 0, s, NEG_INF)
-        p = jnp.exp(s - lse_b[:, None])  # exact probs via saved lse
+            s = jnp.where(mask_ref[0] > 0, s, NEG_INF)
+        p = jnp.exp(s - lse_b)  # exact probs via saved lse
         dv_acc[...] += jax.lax.dot_general(
             p, dob, dimension_numbers=(((0,), (0,)), ((), ())),
             preferred_element_type=jnp.float32,
@@ -295,7 +302,7 @@ def _bwd_dkv_kernel(
             dob, v, dimension_numbers=(((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32,
         )
-        ds = p * (dp - delta_b[:, None])
+        ds = p * (dp - delta_b)
         dk_acc[...] += jax.lax.dot_general(
             ds, qb, dimension_numbers=(((0,), (0,)), ((), ())),
             preferred_element_type=jnp.float32,
@@ -340,8 +347,8 @@ def _bwd_dq_kernel(
     def compute():
         qb = q_ref[0].astype(jnp.float32)   # [block_q, d]
         dob = do_ref[0].astype(jnp.float32)
-        lse_b = lse_ref[0]
-        delta_b = delta_ref[0]
+        lse_b = lse_ref[0]      # [block_q, 1]
+        delta_b = delta_ref[0]  # [block_q, 1]
         k = k_ref[0].astype(jnp.float32)
         v = v_ref[0].astype(jnp.float32)
         s = jax.lax.dot_general(
@@ -357,13 +364,13 @@ def _bwd_dq_kernel(
             )
             s = jnp.where(q_pos >= k_pos, s, NEG_INF)
         if mask_ref is not None:
-            s = jnp.where(mask_ref[0][None, :] > 0, s, NEG_INF)
-        p = jnp.exp(s - lse_b[:, None])
+            s = jnp.where(mask_ref[0] > 0, s, NEG_INF)
+        p = jnp.exp(s - lse_b)
         dp = jax.lax.dot_general(
             dob, v, dimension_numbers=(((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32,
         )
-        ds = p * (dp - delta_b[:, None])
+        ds = p * (dp - delta_b)
         dq_acc[...] += jax.lax.dot_general(
             ds, k, dimension_numbers=(((1,), (0,)), ((), ())),
             preferred_element_type=jnp.float32,
@@ -386,9 +393,13 @@ def _flash_backward(
     bh, seq_q, d = q.shape
     seq_kv = k.shape[1]
     has_mask = kv_mask is not None
-    # softmax-Jacobian row correction, one f32 scalar per row; XLA fuses
-    # this elementwise reduce — no need for a kernel
-    delta = jnp.sum(g.astype(jnp.float32) * out.astype(jnp.float32), axis=-1)
+    # softmax-Jacobian row correction, one f32 scalar per row, kept at
+    # [bh, seq, 1] like lse (see _fwd_kernel docstring on stat layout);
+    # XLA fuses this elementwise reduce — no need for a kernel
+    delta = jnp.sum(
+        g.astype(jnp.float32) * out.astype(jnp.float32), axis=-1,
+        keepdims=True,
+    )
 
     seq_params = pltpu.CompilerParams(
         dimension_semantics=("parallel", "parallel", "arbitrary"),
@@ -399,10 +410,10 @@ def _flash_backward(
                           memory_space=pltpu.VMEM)
     kv_by_j = pl.BlockSpec((1, block_kv, d), lambda b, j, i: (b, j, 0),
                            memory_space=pltpu.VMEM)
-    row_by_i = pl.BlockSpec((1, block_q), lambda b, j, i: (b, i),
+    row_by_i = pl.BlockSpec((1, block_q, 1), lambda b, j, i: (b, i, 0),
                             memory_space=pltpu.VMEM)
     heads = bh // kv_mask.shape[0] if has_mask else 1
-    mask_by_j = pl.BlockSpec((1, block_kv), lambda b, j, i: (b // heads, j),
+    mask_by_j = pl.BlockSpec((1, 1, block_kv), lambda b, j, i: (b // heads, 0, j),
                              memory_space=pltpu.VMEM)
     dkv_specs = [q_by_i, kv_by_j, kv_by_j]
     dkv_operands = [q, k, v]
@@ -445,9 +456,9 @@ def _flash_backward(
                             memory_space=pltpu.VMEM)
     kv_by_stream = pl.BlockSpec((1, block_kv, d), lambda b, i, j: (b, j, 0),
                                 memory_space=pltpu.VMEM)
-    row_by_own = pl.BlockSpec((1, block_q), lambda b, i, j: (b, i),
+    row_by_own = pl.BlockSpec((1, block_q, 1), lambda b, i, j: (b, i, 0),
                               memory_space=pltpu.VMEM)
-    mask_by_stream = pl.BlockSpec((1, block_kv), lambda b, i, j: (b // heads, j),
+    mask_by_stream = pl.BlockSpec((1, 1, block_kv), lambda b, i, j: (b // heads, 0, j),
                                   memory_space=pltpu.VMEM)
     dq_specs = [q_by_own, kv_by_stream, kv_by_stream]
     dq_operands = [q, k, v]
@@ -590,11 +601,11 @@ def flash_attention(
 
     b, sq, h, d = query.shape
     sk = key.shape[1]
-    kv_mask = None  # [b, sk] kernel form
+    kv_mask = None  # [b, 1, sk] kernel form
     if mask is not None and getattr(mask, "ndim", 0) == 4 and mask.shape == (
         b, 1, 1, sk,
     ):
-        kv_mask = mask[:, 0, 0, :]
+        kv_mask = mask[:, 0, :, :]
     if (mask is not None and kv_mask is None) or not supports(
         sq, sk, d, block_q, block_kv
     ):
@@ -623,7 +634,7 @@ def flash_attention(
         return folded
 
     if kv_mask is not None:
-        # stays [b, sk] f32 — the kernels' BlockSpec index maps read
+        # stays [b, 1, sk] f32 — the kernels' BlockSpec index maps read
         # row b'//h for folded program b', so the mask is never
         # h-fold duplicated in HBM
         out = _FLASH_HAS_MASK(
