@@ -69,14 +69,20 @@ def resnet50_step_flops(global_batch: int) -> float:
     return 3.0 * 7.7e9 * global_batch
 
 
-def bert_step_flops(params, global_batch: int, seq: int, cfg) -> float:
+def transformer_step_flops(
+    params, global_batch: int, seq: int, cfg, causal: bool = False,
+) -> float:
     """~6*P FLOPs/token for fwd+bwd of a dense transformer (P = total
     params) plus the attention quadratic term 12 * L * s * h per token
-    (fwd 2 matmuls of 2*s*h each, x3 for train). GLOBAL-batch FLOPs."""
+    (fwd 2 matmuls of 2*s*h each, x3 for train) — halved when causal
+    (the kernel skips blocks past the diagonal). GLOBAL-batch FLOPs."""
     import jax as _jax
 
     p_total = sum(x.size for x in _jax.tree_util.tree_leaves(params))
-    per_token = 6.0 * p_total + 12.0 * cfg.num_layers * seq * cfg.hidden_size
+    attn_coeff = 6.0 if causal else 12.0
+    per_token = (
+        6.0 * p_total + attn_coeff * cfg.num_layers * seq * cfg.hidden_size
+    )
     return per_token * global_batch * seq
 
 
@@ -249,7 +255,7 @@ def bench_bert(
         bert_lib.synthetic_batch(rng, global_batch, seq, cfg)
     )
     state = trainer.init(rng, batch)
-    flops = bert_step_flops(state.params, global_batch, seq, cfg)
+    flops = transformer_step_flops(state.params, global_batch, seq, cfg)
     state, elapsed = time_fused_steps(trainer, state, batch, steps)
 
     tokens_per_sec_chip = global_batch * seq * steps / elapsed / n_chips
@@ -258,6 +264,69 @@ def bench_bert(
     return {
         "tokens_per_sec_per_chip": round(tokens_per_sec_chip, 2),
         "step_flops": flops,
+        "mfu": round(achieved / peak, 4) if peak else 0.0,
+        "steps": steps,
+        "global_batch": global_batch,
+        "seq_len": seq,
+    }
+
+
+def bench_gpt(
+    on_tpu: bool, n_chips: int, attention: str = "flash",
+    steps: int | None = None,
+) -> dict:
+    """Long-context causal LM (GPT-small @ seq 4096): the shape class
+    where flash attention is load-bearing — the XLA path materializes
+    b*h*seq^2 f32 scores (≥ fwd+bwd residency of several GB at this
+    config) while the kernel stays O(seq). attention="xla" is the
+    guarded A/B; an OOM there is itself the measurement."""
+    from tf_operator_tpu.models import gpt as gpt_lib
+    from tf_operator_tpu.parallel.mesh import MeshConfig, build_mesh
+    from tf_operator_tpu.train import Trainer, causal_lm_task
+
+    if on_tpu:
+        cfg = gpt_lib.GPTConfig(max_seq_len=4096)  # GPT-small, hd 128
+        per_chip_batch, seq = 8, 4096
+        steps = steps if steps is not None else 15
+    else:
+        cfg = gpt_lib.GPT_TINY
+        per_chip_batch, seq = 2, 128
+        steps = steps if steps is not None else 3
+
+    if attention == "xla":
+        from tf_operator_tpu.ops.attention import dot_product_attention
+
+        def xla_causal(q, k, v, mask=None):
+            s = q.shape[1]
+            causal_mask = (
+                jnp.arange(s)[:, None] >= jnp.arange(s)[None, :]
+            )[None, None]
+            return dot_product_attention(q, k, v, causal_mask)
+
+        model = gpt_lib.GPT(cfg, attention_fn=xla_causal)
+    else:
+        model = gpt_lib.GPT(cfg)  # default: causal flash in-kernel
+    mesh = build_mesh(MeshConfig(dp=-1))
+    trainer = Trainer(
+        model, causal_lm_task(model),
+        optax.adamw(3e-4, weight_decay=0.01), mesh=mesh,
+    )
+    rng = jax.random.PRNGKey(0)
+    global_batch = per_chip_batch * n_chips
+    batch = trainer.place_batch(
+        gpt_lib.synthetic_batch(rng, global_batch, seq, cfg)
+    )
+    state = trainer.init(rng, batch)
+    flops = transformer_step_flops(
+        state.params, global_batch, seq, cfg, causal=True
+    )
+    state, elapsed = time_fused_steps(trainer, state, batch, steps)
+
+    tokens_per_sec_chip = global_batch * seq * steps / elapsed / n_chips
+    achieved = flops * steps / elapsed / n_chips
+    peak = peak_flops_per_chip(jax.devices()[0])
+    return {
+        "tokens_per_sec_per_chip": round(tokens_per_sec_chip, 2),
         "mfu": round(achieved / peak, 4) if peak else 0.0,
         "steps": steps,
         "global_batch": global_batch,
@@ -326,6 +395,22 @@ def run_extras(on_tpu: bool, n_chips: int, line: dict) -> None:
             "tokens_per_sec_per_chip"
         ]
 
+    def gpt_long():
+        r = bench_gpt(on_tpu, n_chips)
+        line["gpt_seq4096_tokens_per_sec_per_chip"] = r[
+            "tokens_per_sec_per_chip"
+        ]
+        line["gpt_seq4096_mfu"] = r["mfu"]
+
+    def gpt_long_xla():
+        # the A/B where the kernel is load-bearing: the XLA path's
+        # quadratic score materialization at seq 4096 — an OOM lands
+        # in gpt_long_xla_error and is itself the measurement
+        r = bench_gpt(on_tpu, n_chips, attention="xla", steps=10)
+        line["gpt_seq4096_xla_tokens_per_sec_per_chip"] = r[
+            "tokens_per_sec_per_chip"
+        ]
+
     def s2d():
         r = bench_resnet(on_tpu, n_chips, steps=15, stem="s2d")
         line["resnet_s2d_stem_mfu"] = r["mfu"]
@@ -374,12 +459,18 @@ def run_extras(on_tpu: bool, n_chips: int, line: dict) -> None:
     if on_tpu:  # kernels + accuracy targets are TPU-only claims
         extra("flash", flash)
         extra("mnist", mnist)
+        extra("gpt_long", gpt_long)
     extra("bert_xla", bert_xla)
     extra("resnet_flax_bn", flax_ab)
     if on_tpu:  # stem A/B only meaningful at the real 224/3-channel shape
         extra("resnet_s2d", s2d)
         extra("resnet_bs512", bs512)
     extra("fed", fed)
+    if on_tpu:
+        # LAST: this A/B is expected to OOM at seq 4096 (that is the
+        # measurement) — a hard abort or fragmented HBM must not cost
+        # any other extra
+        extra("gpt_long_xla", gpt_long_xla)
     print("extras done", file=sys.stderr, flush=True)
 
 

@@ -62,9 +62,20 @@ def _ring_shard(q, k, v, axis_name: str, causal: bool, n: int):
         )
         return (o, m_new, l)
 
+    # remat the fold: plain autodiff through the scan would save every
+    # step's [b, h, q_shard, k_shard] probability matrix as a residual
+    # — O(n * shard^2) = O(seq^2 / n) backward memory, quadratic again.
+    # Rematerializing recomputes the scores per step in the backward
+    # pass (the blockwise-attention backward), keeping residuals at
+    # O(shard^2) for one step at a time. ppermute is outside the
+    # remat'd fn, so no collective is replayed. prevent_cse=False: its
+    # CSE barriers are unnecessary under lax.scan (per the jax docs)
+    # and would fence the fold, defeating ppermute/compute overlap.
+    fold_remat = jax.checkpoint(fold, prevent_cse=False)
+
     def fold_and_rotate(carry, step):
         acc, k_blk, v_blk = carry
-        acc = fold(acc, step, k_blk, v_blk)
+        acc = fold_remat(acc, step, k_blk, v_blk)
         # rotate KV around the ring: neighbor exchange over ICI,
         # overlapped with the next block's compute by XLA latency hiding
         perm = [(i, (i + 1) % n) for i in range(n)]
