@@ -286,7 +286,11 @@ def bench_gpt(
 
     if on_tpu:
         cfg = gpt_lib.GPTConfig(max_seq_len=4096)  # GPT-small, hd 128
-        per_chip_batch, seq = 8, 4096
+        # batch 4/chip: the [b, s, vocab] logits (bf16 since the fused
+        # loss, f32 transients inside the loss fusion) plus 12 layers
+        # of activations at seq 4096 — batch 8 crowds the v5e's 16GB;
+        # 4 leaves headroom and 16k tokens/step is plenty for MFU
+        per_chip_batch, seq = 4, 4096
         steps = steps if steps is not None else 15
     else:
         cfg = gpt_lib.GPT_TINY
@@ -402,6 +406,36 @@ def run_extras(on_tpu: bool, n_chips: int, line: dict) -> None:
         ]
         line["gpt_seq4096_mfu"] = r["mfu"]
 
+    def gpt_decode():
+        # KV-cached autoregressive decode throughput (models/gpt.py
+        # generate: one jitted lax.scan over steps) — the serving-side
+        # number; decode is bandwidth-bound, so tokens/sec, not MFU
+        from tf_operator_tpu.models import gpt as gpt_lib
+
+        cfg = gpt_lib.GPTConfig(max_seq_len=1024)  # GPT-small
+        batch, prompt_len, new = 8, 128, 512
+        rng = jax.random.PRNGKey(0)
+        params = gpt_lib.GPT(cfg).init(
+            rng, jnp.zeros((1, 8), jnp.int32)
+        )["params"]
+        prompt = jax.random.randint(rng, (batch, prompt_len), 0,
+                                    cfg.vocab_size)
+        out = gpt_lib.generate(cfg, params, prompt, max_new_tokens=new)
+        jax.block_until_ready(out)  # compile + warm
+        start = time.perf_counter()
+        out = gpt_lib.generate(cfg, params, prompt, max_new_tokens=new)
+        jax.block_until_ready(out)
+        elapsed = time.perf_counter() - start
+        # generate() is a single-device jit (no mesh), so this is a
+        # one-chip number regardless of host chip count — not divided
+        # by n_chips. The scan runs prompt_len-1 prefill steps plus
+        # `new` generation steps, each one token through the cached
+        # model, so the rate counts ALL sequential token steps (the
+        # metric would otherwise shift with prompt_len alone)
+        line["gpt_decode_tokens_per_sec"] = round(
+            batch * (prompt_len - 1 + new) / elapsed, 2
+        )
+
     def gpt_long_xla():
         # the A/B where the kernel is load-bearing: the XLA path's
         # quadratic score materialization at seq 4096 — an OOM lands
@@ -460,6 +494,7 @@ def run_extras(on_tpu: bool, n_chips: int, line: dict) -> None:
         extra("flash", flash)
         extra("mnist", mnist)
         extra("gpt_long", gpt_long)
+        extra("gpt_decode", gpt_decode)
     extra("bert_xla", bert_xla)
     extra("resnet_flax_bn", flax_ab)
     if on_tpu:  # stem A/B only meaningful at the real 224/3-channel shape

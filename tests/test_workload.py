@@ -517,3 +517,72 @@ class TestMultiStep:
             np.testing.assert_allclose(
                 np.asarray(a), np.asarray(b), rtol=1e-4, atol=1e-5
             )
+
+
+class TestFusedCrossEntropy:
+    """ops/losses.py: the fused large-vocab cross-entropy must match
+    the naive f32 log_softmax formulation in value AND gradient (its
+    custom VJP rebuilds the softmax instead of saving f32 residuals)."""
+
+    @staticmethod
+    def _naive(logits, labels, weights):
+        log_probs = jax.nn.log_softmax(logits.astype(jnp.float32), axis=-1)
+        picked = jnp.take_along_axis(
+            log_probs, labels[..., None], axis=-1
+        )[..., 0]
+        w = (
+            jnp.ones_like(picked)
+            if weights is None else weights.astype(jnp.float32)
+        )
+        return -(picked * w).sum() / jnp.maximum(w.sum(), 1.0)
+
+    @pytest.mark.parametrize("dtype", [jnp.float32, jnp.bfloat16])
+    @pytest.mark.parametrize("weighted", [False, True])
+    def test_value_and_grad_parity(self, dtype, weighted):
+        from tf_operator_tpu.ops.losses import weighted_mean_xent
+
+        rng = jax.random.PRNGKey(0)
+        logits = (
+            jax.random.normal(rng, (4, 9, 257), jnp.float32) * 3.0
+        ).astype(dtype)
+        labels = jax.random.randint(
+            jax.random.PRNGKey(1), (4, 9), 0, 257
+        )
+        weights = (
+            jax.random.bernoulli(
+                jax.random.PRNGKey(2), 0.4, (4, 9)
+            ).astype(jnp.float32)
+            if weighted else None
+        )
+
+        fused_v, fused_g = jax.value_and_grad(
+            lambda x: weighted_mean_xent(x, labels, weights)
+        )(logits)
+        naive_v, naive_g = jax.value_and_grad(
+            lambda x: self._naive(x, labels, weights)
+        )(logits)
+        # both formulations do their math in f32; bf16 only quantizes
+        # the saved logits and the emitted gradient
+        tol = 1e-5 if dtype == jnp.float32 else 2e-2
+        assert np.allclose(float(fused_v), float(naive_v), rtol=tol, atol=tol)
+        np.testing.assert_allclose(
+            np.asarray(fused_g, np.float32), np.asarray(naive_g, np.float32),
+            rtol=tol, atol=tol,
+        )
+
+    def test_grad_is_softmax_minus_onehot(self):
+        from tf_operator_tpu.ops.losses import (
+            cross_entropy_with_integer_labels,
+        )
+
+        logits = jax.random.normal(jax.random.PRNGKey(3), (5, 11))
+        labels = jax.random.randint(jax.random.PRNGKey(4), (5,), 0, 11)
+        g = jax.grad(
+            lambda x: cross_entropy_with_integer_labels(x, labels).sum()
+        )(logits)
+        expected = jax.nn.softmax(logits, axis=-1) - jax.nn.one_hot(
+            labels, 11
+        )
+        np.testing.assert_allclose(
+            np.asarray(g), np.asarray(expected), rtol=1e-5, atol=1e-6
+        )

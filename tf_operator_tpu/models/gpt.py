@@ -92,8 +92,10 @@ class GPT(nn.Module):
                 cfg, attention_fn=attention_fn, name=f"layer_{layer}"
             )(x)
         x = nn.LayerNorm(dtype=jnp.float32, name="ln_final")(x)
+        # model-dtype head: bf16 MXU matmul + bf16 logits; the fused
+        # loss upcasts to f32 at reduced shapes (see models/bert.py)
         return nn.Dense(
-            cfg.vocab_size, dtype=jnp.float32, name="lm_head"
+            cfg.vocab_size, dtype=cfg.dtype, name="lm_head"
         )(x.astype(cfg.dtype))
 
 
@@ -101,16 +103,16 @@ def causal_lm_loss(
     logits: jax.Array, input_ids: jax.Array,
     weights: Optional[jax.Array] = None,
 ) -> jax.Array:
-    """Next-token cross-entropy: position t predicts token t+1."""
+    """Next-token cross-entropy: position t predicts token t+1. Fused
+    large-vocab formulation (ops/losses.py): f32 softmax math at
+    reduced shapes, no full-vocab log-probs materialized or saved."""
+    from ..ops.losses import weighted_mean_xent
+
     targets = input_ids[:, 1:]
     logits = logits[:, :-1]
-    log_probs = jax.nn.log_softmax(logits, axis=-1)
-    picked = jnp.take_along_axis(log_probs, targets[..., None], axis=-1)[..., 0]
-    if weights is None:
-        weights = jnp.ones_like(targets, jnp.float32)
-    else:
-        weights = weights[:, 1:].astype(jnp.float32)
-    return -(picked * weights).sum() / jnp.maximum(weights.sum(), 1.0)
+    if weights is not None:
+        weights = weights[:, 1:]
+    return weighted_mean_xent(logits, targets, weights)
 
 
 def synthetic_batch(rng: jax.Array, batch_size: int, seq_len: int,
@@ -231,8 +233,10 @@ class GPTDecodeStep(nn.Module):
                 cfg, cache_len=cache_len, name=f"layer_{layer}"
             )(x, index)
         x = nn.LayerNorm(dtype=jnp.float32, name="ln_final")(x)
+        # model-dtype head: bf16 MXU matmul + bf16 logits; the fused
+        # loss upcasts to f32 at reduced shapes (see models/bert.py)
         return nn.Dense(
-            cfg.vocab_size, dtype=jnp.float32, name="lm_head"
+            cfg.vocab_size, dtype=cfg.dtype, name="lm_head"
         )(x.astype(cfg.dtype))
 
 

@@ -264,8 +264,10 @@ class MoEHead(nn.Module):
     def __call__(self, x: jax.Array) -> jax.Array:
         cfg = self.config
         x = nn.LayerNorm(dtype=jnp.float32, name="ln_final")(x)
+        # model-dtype head: bf16 MXU matmul + bf16 logits; the fused
+        # loss upcasts to f32 at reduced shapes (see models/bert.py)
         return nn.Dense(
-            cfg.vocab_size, use_bias=False, dtype=jnp.float32, name="lm_head"
+            cfg.vocab_size, use_bias=False, dtype=cfg.dtype, name="lm_head"
         )(x.astype(cfg.dtype))
 
 
@@ -301,15 +303,15 @@ class MoELM(nn.Module):
 def lm_loss(
     logits: jax.Array, labels: jax.Array, weights: Optional[jax.Array] = None
 ) -> jax.Array:
-    """Next-token cross-entropy in f32 (shift happens here)."""
-    logits = logits[:, :-1].astype(jnp.float32)
+    """Next-token cross-entropy (shift happens here). Fused large-vocab
+    formulation — see ops/losses.py."""
+    from ..ops.losses import weighted_mean_xent
+
+    logits = logits[:, :-1]
     targets = labels[:, 1:]
-    log_probs = jax.nn.log_softmax(logits, axis=-1)
-    picked = jnp.take_along_axis(log_probs, targets[..., None], axis=-1)[..., 0]
-    if weights is None:
-        return -picked.mean()
-    w = weights[:, 1:].astype(jnp.float32)
-    return -(picked * w).sum() / jnp.maximum(w.sum(), 1.0)
+    if weights is not None:
+        weights = weights[:, 1:]
+    return weighted_mean_xent(logits, targets, weights)
 
 
 def total_aux_loss(losses_collection) -> jax.Array:

@@ -1,0 +1,97 @@
+"""Fused cross-entropy over large vocabularies.
+
+The data-plane analog of a loss the reference delegates to TF
+(`tf.nn.sparse_softmax_cross_entropy_with_logits` inside user
+containers, e.g. /root/reference/examples/v1/dist-mnist/dist_mnist.py).
+Built TPU-first for LM-scale vocabularies (30k-50k):
+
+- The naive formulation `take(log_softmax(logits.astype(f32)))`
+  materializes full-vocab f32 tensors twice (the upcast and the
+  log-probs) and autodiff saves a full-vocab f32 residual for the
+  backward — at [batch*seq, 32k] that is gigabytes of HBM traffic per
+  step, the same full-shape-f32 pattern the ResNet BatchNorm profile
+  showed starving the MXU (PROFILE.md).
+- Here the forward is `logsumexp(logits) - logits[label]`: f32 exists
+  only at reduced shapes ([tokens] rows), because XLA fuses the upcast
+  into the reduce and the gather reads the bf16 logits directly.
+- The custom VJP saves only the logits at the model's emitted
+  precision (bf16 for every LM head in this repo — already live as
+  the model's output activation, so the marginal residual cost is
+  zero) plus the [tokens] f32 lse row, and REBUILDS the softmax in
+  the backward:  d_logits = (p - onehot) * g. The naive autodiff
+  instead saves a SECOND full-vocab f32 tensor (the log-probs); that
+  residual is what this formulation eliminates. The subtraction at
+  the label position is an iota compare, not a materialized one-hot.
+
+Used by every LM family (models/bert.py mlm_loss, models/gpt.py
+causal_lm_loss, models/moe.py lm_loss). Gradient parity with the naive
+f32 formulation is pinned by tests/test_workload.py.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+
+def _lse(logits: jax.Array) -> jax.Array:
+    """Row logsumexp in f32; the max subtraction keeps exp in range.
+    stop_gradient-free: only used inside the custom-VJP pair below."""
+    x = logits.astype(jnp.float32)
+    m = jax.lax.stop_gradient(jnp.max(x, axis=-1, keepdims=True))
+    return jnp.log(jnp.sum(jnp.exp(x - m), axis=-1)) + m[..., 0]
+
+
+def _picked(logits: jax.Array, labels: jax.Array) -> jax.Array:
+    return jnp.take_along_axis(
+        logits, labels[..., None], axis=-1
+    )[..., 0].astype(jnp.float32)
+
+
+@jax.custom_vjp
+def cross_entropy_with_integer_labels(
+    logits: jax.Array, labels: jax.Array
+) -> jax.Array:
+    """Per-position cross-entropy, f32, shape = labels.shape.
+    logits: [..., vocab] (any float dtype); labels: [...] int."""
+    return _lse(logits) - _picked(logits, labels)
+
+
+def _xent_fwd(logits, labels):
+    lse = _lse(logits)
+    return lse - _picked(logits, labels), (logits, labels, lse)
+
+
+def _xent_bwd(residuals, g):
+    logits, labels, lse = residuals
+    # softmax rebuilt from the bf16 logits + f32 row lse: full-vocab
+    # f32 appears only inside this fusion, never as a saved residual.
+    # The one-hot subtraction is an iota compare — pure elementwise
+    # VPU work that fuses with the exp, not a scatter and not a
+    # materialized one-hot
+    p = jnp.exp(logits.astype(jnp.float32) - lse[..., None])
+    onehot = (
+        jax.lax.broadcasted_iota(labels.dtype, p.shape, p.ndim - 1)
+        == labels[..., None]
+    )
+    d_logits = (
+        (p - onehot) * g.astype(jnp.float32)[..., None]
+    ).astype(logits.dtype)
+    return d_logits, jnp.zeros(labels.shape, dtype=jax.dtypes.float0)
+
+
+cross_entropy_with_integer_labels.defvjp(_xent_fwd, _xent_bwd)
+
+
+def weighted_mean_xent(
+    logits: jax.Array,
+    labels: jax.Array,
+    weights: jax.Array | None = None,
+) -> jax.Array:
+    """Weighted-mean scalar cross-entropy — the reduction every LM loss
+    in this repo shares. weights None means uniform."""
+    xent = cross_entropy_with_integer_labels(logits, labels)
+    if weights is None:
+        return xent.mean()
+    w = weights.astype(jnp.float32)
+    return (xent * w).sum() / jnp.maximum(w.sum(), 1.0)
