@@ -586,3 +586,34 @@ class TestFusedCrossEntropy:
         np.testing.assert_allclose(
             np.asarray(g), np.asarray(expected), rtol=1e-5, atol=1e-6
         )
+
+
+class TestAsyncCheckpoint:
+    """Async (non-blocking) saves: fit overlaps orbax writes with the
+    next steps' compute and flushes in-flight saves on exit, so the
+    restore after fit always sees the newest complete checkpoint."""
+
+    def test_fit_async_saves_then_restore(self, tmp_path):
+        model = mnist_lib.MnistCNN()
+        rng = jax.random.PRNGKey(5)
+        sample = mnist_lib.synthetic_batch(rng, 16)
+        trainer = Trainer(
+            model, classification_task(model), optax.adam(1e-3),
+            checkpoint_dir=str(tmp_path / "async-ckpt"),
+        )
+        state = trainer.init(rng, sample)
+
+        def batches():
+            while True:
+                yield sample
+
+        state, _ = trainer.fit(
+            state, batches(), steps=4, log_every=4, checkpoint_every=2
+        )
+        fresh = trainer.init(jax.random.PRNGKey(0), sample)
+        restored = trainer.restore(fresh)
+        assert restored is not None
+        assert int(restored.step) == 4
+        orig = jax.tree_util.tree_leaves(state.params)[0]
+        back = jax.tree_util.tree_leaves(restored.params)[0]
+        np.testing.assert_allclose(np.asarray(orig), np.asarray(back))

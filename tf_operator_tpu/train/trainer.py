@@ -334,7 +334,9 @@ class Trainer:
                     ),
                 )
                 if checkpoint_every and (i + 1) % checkpoint_every == 0:
-                    self.save(state)
+                    # async: the write overlaps the next steps' compute;
+                    # the finally block flushes whatever is in flight
+                    self.save(state, block=False)
                 if (i + 1) % log_every == 0 or i + 1 == steps:
                     last_metrics = {
                         k: float(v) for k, v in metrics.items()
@@ -359,15 +361,22 @@ class Trainer:
             # an exception mid-loop must still stop the (process-global)
             # jax trace, or every later profiled run in this process
             # fails with "profiler is already active"
-            profiler.close()
+            try:
+                profiler.close()
+            finally:
+                if self._ckpt is not None:
+                    # settle any async save so the newest complete
+                    # checkpoint is durable even on an aborted run —
+                    # including when profiler.close() itself raises
+                    self._ckpt.wait()
         return state, last_metrics
 
     # -- checkpointing -----------------------------------------------------
 
-    def save(self, state: TrainState) -> None:
+    def save(self, state: TrainState, block: bool = True) -> None:
         if self._ckpt is None:
             raise ValueError("Trainer built without checkpoint_dir")
-        self._ckpt.save(int(state.step), state)
+        self._ckpt.save(int(state.step), state, block=block)
 
     def restore(self, state: TrainState) -> Optional[TrainState]:
         """Restore the latest checkpoint into the (sharded) structure of
@@ -419,11 +428,23 @@ class Checkpointer:
             directory, options=ocp.CheckpointManagerOptions(max_to_keep=keep)
         )
 
-    def save(self, step: int, state: TrainState) -> None:
+    def save(self, step: int, state: TrainState, block: bool = True) -> None:
+        """block=False runs the serialization in orbax's background
+        thread so the train loop overlaps the write with compute (the
+        device arrays are snapshotted before save() returns); a
+        subsequent save/restore/wait settles it. Mandatory posture on
+        preemptible slices: frequent async saves cost near-zero step
+        time."""
         self.manager.save(step, args=self._ocp.args.StandardSave(state))
+        if block:
+            self.manager.wait_until_finished()
+
+    def wait(self) -> None:
+        """Flush any in-flight async save."""
         self.manager.wait_until_finished()
 
     def restore_latest(self, target: TrainState) -> Optional[TrainState]:
+        self.manager.wait_until_finished()  # settle in-flight saves
         step = self.manager.latest_step()
         if step is None:
             return None
