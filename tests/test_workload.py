@@ -71,6 +71,23 @@ class TestShardingRules:
         assert sh["mlp_in"]["bias"].spec == PartitionSpec()
         assert sh["ln"]["scale"].spec == PartitionSpec()
 
+    def test_lm_head_vocab_on_tp(self, devices8):
+        """Output heads split the vocab dim on tp (Megatron output-
+        embedding split) instead of falling through to the generic
+        kernel rule."""
+        mesh = build_mesh(MeshConfig(dp=2, fsdp=2, tp=2))
+        sh = shardings_for_tree(
+            {
+                "lm_head": {"kernel": jnp.zeros((128, 512))},
+                "mlm_head": {"kernel": jnp.zeros((128, 512))},
+                "other": {"kernel": jnp.zeros((128, 512))},
+            },
+            mesh, TRANSFORMER_RULES,
+        )
+        assert sh["lm_head"]["kernel"].spec == PartitionSpec("fsdp", "tp")
+        assert sh["mlm_head"]["kernel"].spec == PartitionSpec("fsdp", "tp")
+        assert sh["other"]["kernel"].spec == PartitionSpec("fsdp", None)
+
     def test_indivisible_dims_fall_back(self, devices8):
         mesh = build_mesh(MeshConfig(dp=1, tp=8))
         sh = shardings_for_tree(

@@ -28,6 +28,10 @@ TRANSFORMER_RULES: Rules = (
     (r".*(attn_out|out_proj|attention_output).*kernel$", PartitionSpec("tp", "fsdp")),
     (r".*(mlp_in|intermediate|up_proj|gate_proj).*kernel$", PartitionSpec("fsdp", "tp")),
     (r".*(mlp_out|down_proj).*kernel$", PartitionSpec("tp", "fsdp")),
+    # output heads [hidden, vocab]: vocab on tp (Megatron output-
+    # embedding split — the largest single matmul in an LM); GSPMD
+    # inserts the collectives the loss's lse/gather then needs
+    (r".*(lm_head|mlm_head).*kernel$", PartitionSpec("fsdp", "tp")),
     (r".*embedding$", PartitionSpec("tp", "fsdp")),
     (r".*kernel$", PartitionSpec("fsdp", None)),
     (r".*", PartitionSpec()),
