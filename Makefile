@@ -23,14 +23,20 @@ DOCKER    := $(shell command -v docker || command -v podman)
 IMAGE_DIR := build/images
 DIST      := build/dist
 
-.PHONY: ci presubmit lint native native-test test wire-test e2e e2e-kind bench \
+.PHONY: ci presubmit lint native native-test native-race test wire-test e2e e2e-kind bench \
         images release mnist-acc clean
 
 # `test` already runs the whole tests/ tree (native bindings, wire,
 # E2E suites included) — native-test/wire-test exist for targeted runs,
-# not as ci prerequisites, so ci doesn't pay for the slow suites twice
-ci: lint native test e2e
+# not as ci prerequisites, so ci doesn't pay for the slow suites twice.
+# native-race (the TSAN/ASAN stress gate) IS a ci prerequisite: the
+# pytest native suite exercises the ctypes bindings, not the
+# sanitizers, and ci must match the presubmit DAG's coverage
+ci: lint native native-race test e2e
 	@echo "CI PASSED (tag $(TAG))"
+
+native-race: native
+	$(MAKE) -C native test
 
 # The full presubmit DAG (ci/presubmit.yaml) with per-step JUnit XML +
 # CI_RUN.json artifacts — the Prow+Argo workflow analog; `ci` is the
@@ -46,6 +52,7 @@ native:
 	$(MAKE) -C native
 
 native-test: native
+	$(MAKE) -C native test
 	$(PY) -m pytest tests/test_native.py -q
 
 test:

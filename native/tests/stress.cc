@@ -1,0 +1,170 @@
+// Concurrency stress driver for the native runtime core — the analog
+// of the reference's `go test -race` discipline (its only concurrency
+// safety net, SURVEY.md §5). Built and run under -fsanitize=thread
+// (and again under address) by `make -C native test`: producer/
+// consumer threads hammer the work queue, expectation observers race
+// against setters, and allocator threads fight over a deliberately
+// small port range. Invariant checks are asserted inline; the
+// sanitizers turn any data race / lifetime bug into a hard failure.
+//
+// Kept free of gtest (not in the image): plain asserts + exit code.
+
+#include <atomic>
+#include <cstdio>
+#include <cstdlib>
+#include <cstring>
+#include <set>
+#include <mutex>
+#include <string>
+#include <thread>
+#include <vector>
+
+#include "tfoprt.h"
+
+// NDEBUG-proof invariant check: a plain assert() would vanish under a
+// release build and take every verification (and the side effects
+// of checked calls) with it.
+#define CHECK(cond)                                                    \
+  do {                                                                 \
+    if (!(cond)) {                                                     \
+      fprintf(stderr, "CHECK failed at %s:%d: %s\n", __FILE__,        \
+              __LINE__, #cond);                                        \
+      abort();                                                         \
+    }                                                                  \
+  } while (0)
+
+static void stress_queue() {
+  tfoprt_queue_t q = tfoprt_queue_new(0.0005, 0.01);
+  constexpr int kProducers = 4, kConsumers = 4, kItems = 500;
+  std::atomic<int> consumed{0};
+  std::atomic<bool> done{false};
+  std::mutex seen_mu;
+  std::set<std::string> seen;  // dedup property: track distinct items
+
+  std::vector<std::thread> threads;
+  for (int p = 0; p < kProducers; ++p) {
+    threads.emplace_back([q, p] {
+      char item[64];
+      for (int i = 0; i < kItems; ++i) {
+        snprintf(item, sizeof item, "ns/job-%d-%d", p, i % 50);
+        tfoprt_queue_add(q, item);
+        if (i % 7 == 0) tfoprt_queue_add_rate_limited(q, item);
+        if (i % 11 == 0) tfoprt_queue_add_after(q, item, 0.001);
+      }
+    });
+  }
+  for (int c = 0; c < kConsumers; ++c) {
+    threads.emplace_back([q, &consumed, &seen, &seen_mu, &done] {
+      char buf[128];
+      for (;;) {
+        int32_t n = tfoprt_queue_get(q, 0.05, buf, sizeof buf);
+        CHECK(n >= -1);  // <= -2 is buffer-too-small: the item stays
+                         // queued, so retrying with the same buffer
+                         // would busy-spin and strand the drain loop
+        if (n < 0) {
+          // -1 means timeout OR shutdown-and-drained; only exit once
+          // the main thread says the run is over, so a transient
+          // timeout under sanitizer slowdowns can't strand the drain
+          if (done.load()) return;
+          continue;
+        }
+        {
+          std::lock_guard<std::mutex> lock(seen_mu);
+          seen.insert(std::string(buf, n));
+        }
+        if (consumed.fetch_add(1) % 3 == 0) tfoprt_queue_forget(q, buf);
+        tfoprt_queue_done(q, buf);
+      }
+    });
+  }
+  for (int i = 0; i < kProducers; ++i) threads[i].join();
+  // let consumers drain, then shut down
+  while (tfoprt_queue_len(q) > 0)
+    std::this_thread::sleep_for(std::chrono::milliseconds(5));
+  done.store(true);
+  tfoprt_queue_shutdown(q);
+  for (size_t i = kProducers; i < threads.size(); ++i) threads[i].join();
+  // dedup invariant: at most 50 distinct keys per producer
+  CHECK(seen.size() <= kProducers * 50);
+  CHECK(consumed.load() > 0);
+  tfoprt_queue_free(q);
+  printf("queue: %d gets, %zu distinct keys\n", consumed.load(), seen.size());
+}
+
+static void stress_expectations() {
+  tfoprt_exp_t e = tfoprt_exp_new(30.0);
+  constexpr int kKeys = 8, kIters = 2000;
+  std::vector<std::thread> threads;
+  for (int t = 0; t < 3; ++t) {
+    threads.emplace_back([e, t] {
+      char key[32];
+      for (int i = 0; i < kIters; ++i) {
+        snprintf(key, sizeof key, "ns/job-%d", (i + t) % kKeys);
+        tfoprt_exp_raise(e, key, 1, 0);
+        tfoprt_exp_creation_observed(e, key);
+      }
+    });
+  }
+  for (int t = 0; t < 2; ++t) {
+    threads.emplace_back([e, t] {
+      char key[32];
+      for (int i = 0; i < kIters; ++i) {
+        snprintf(key, sizeof key, "ns/job-%d", (i + t) % kKeys);
+        (void)tfoprt_exp_satisfied(e, key);
+        if (i % 97 == 0) tfoprt_exp_delete(e, key);
+      }
+    });
+  }
+  for (auto &th : threads) th.join();
+  // raises and observations were 1:1 per thread, so once quiescent
+  // every remaining entry must be satisfied
+  for (int k = 0; k < kKeys; ++k) {
+    char key[32];
+    snprintf(key, sizeof key, "ns/job-%d", k);
+    CHECK(tfoprt_exp_satisfied(e, key) == 1);
+  }
+  tfoprt_exp_free(e);
+  printf("expectations: quiescent and satisfied\n");
+}
+
+static void stress_ports() {
+  constexpr int32_t kB = 20000, kE = 20064;  // 64 ports, 8 threads fight
+  tfoprt_ports_t p = tfoprt_ports_new(kB, kE);
+  std::atomic<int> granted{0}, exhausted{0};
+  std::vector<std::thread> threads;
+  for (int t = 0; t < 8; ++t) {
+    threads.emplace_back([p, t, &granted, &exhausted] {
+      char key[32];
+      snprintf(key, sizeof key, "ns/job-%d", t);
+      for (int round = 0; round < 200; ++round) {
+        std::vector<int32_t> mine;
+        for (int i = 0; i < 12; ++i) {
+          int32_t port = tfoprt_ports_take(p, key);
+          if (port < 0) { exhausted.fetch_add(1); continue; }
+          CHECK(port >= kB && port < kE);
+          granted.fetch_add(1);
+          mine.push_back(port);
+        }
+        if (round % 2 == 0) {
+          for (int32_t port : mine) CHECK(tfoprt_ports_free_port(p, key, port));
+        } else {
+          (void)tfoprt_ports_release(p, key);
+        }
+      }
+    });
+  }
+  for (auto &th : threads) th.join();
+  CHECK(tfoprt_ports_in_use(p) == 0);  // everything returned
+  tfoprt_ports_free(p);
+  printf("ports: %d grants, %d exhaustions, 0 leaked\n",
+         granted.load(), exhausted.load());
+}
+
+int main() {
+  CHECK(tfoprt_abi_version() >= 1);
+  stress_queue();
+  stress_expectations();
+  stress_ports();
+  printf("native stress: OK\n");
+  return 0;
+}
