@@ -206,7 +206,7 @@ def time_fed_steps(
 
 def bench_bert(
     on_tpu: bool, n_chips: int, attention: str = "flash",
-    steps: int | None = None,
+    steps: int | None = None, num_heads: int | None = None,
 ) -> dict:
     """attention="flash" (headline): the pallas kernel on a packed
     batch — synthetic MLM batches are unpadded, so the all-ones mask
@@ -221,14 +221,16 @@ def bench_bert(
 
     if on_tpu:
         cfg = bert_lib.BertConfig(
-            vocab_size=30522, hidden_size=768, num_layers=12, num_heads=12,
+            vocab_size=30522, hidden_size=768, num_layers=12,
+            num_heads=num_heads if num_heads is not None else 12,
             intermediate_size=3072, max_position_embeddings=512,
         )
         per_chip_batch, seq = 32, 512
         steps = steps if steps is not None else 30
     else:
         cfg = bert_lib.BertConfig(
-            vocab_size=1024, hidden_size=128, num_layers=2, num_heads=4,
+            vocab_size=1024, hidden_size=128, num_layers=2,
+            num_heads=num_heads if num_heads is not None else 4,
             intermediate_size=256, max_position_embeddings=128,
         )
         per_chip_batch, seq = 4, 128
@@ -399,6 +401,17 @@ def run_extras(on_tpu: bool, n_chips: int, line: dict) -> None:
             "tokens_per_sec_per_chip"
         ]
 
+    def bert_wide():
+        # BERT_BASE_WIDE shape class (6 heads x 128 = same hidden/param
+        # count as base): head_dim 128 is MXU-native, so the flash
+        # kernel spends no lane-padding FLOPs — the A/B that shows what
+        # the 12x64 head split costs
+        r = bench_bert(on_tpu, n_chips, steps=15, num_heads=6)
+        line["bert_wide_heads_mfu"] = r["mfu"]
+        line["bert_wide_heads_tokens_per_sec_per_chip"] = r[
+            "tokens_per_sec_per_chip"
+        ]
+
     def gpt_long():
         r = bench_gpt(on_tpu, n_chips)
         line["gpt_seq4096_tokens_per_sec_per_chip"] = r[
@@ -496,6 +509,8 @@ def run_extras(on_tpu: bool, n_chips: int, line: dict) -> None:
         extra("gpt_long", gpt_long)
         extra("gpt_decode", gpt_decode)
     extra("bert_xla", bert_xla)
+    if on_tpu:
+        extra("bert_wide", bert_wide)
     extra("resnet_flax_bn", flax_ab)
     if on_tpu:  # stem A/B only meaningful at the real 224/3-channel shape
         extra("resnet_s2d", s2d)

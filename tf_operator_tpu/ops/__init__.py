@@ -1,3 +1,9 @@
 from .attention import MultiHeadAttention, dot_product_attention
+from .losses import cross_entropy_with_integer_labels, weighted_mean_xent
 
-__all__ = ["MultiHeadAttention", "dot_product_attention"]
+__all__ = [
+    "MultiHeadAttention",
+    "dot_product_attention",
+    "cross_entropy_with_integer_labels",
+    "weighted_mean_xent",
+]
