@@ -35,6 +35,10 @@ def main(argv=None) -> int:
     parser.add_argument("--flash", action="store_true", help="pallas flash attention")
     parser.add_argument("--checkpoint-dir", default=None)
     parser.add_argument(
+        "--accum-steps", type=int, default=1,
+        help="gradient-accumulation microbatches per optimizer step",
+    )
+    parser.add_argument(
         "--profile-dir", default=None,
         help="Capture an XLA/TPU profiler trace of steady-state steps",
     )
@@ -78,6 +82,7 @@ def main(argv=None) -> int:
     trainer = Trainer(
         model, mlm_task(model), optax.adamw(args.learning_rate), mesh=mesh,
         shard_sequence=args.sp > 1, checkpoint_dir=args.checkpoint_dir,
+        accum_steps=args.accum_steps,
     )
     rng = jax.random.PRNGKey(0)
     sample = bert_lib.synthetic_batch(rng, args.batch_size, args.seq_len, cfg)

@@ -41,6 +41,10 @@ def main(argv=None) -> int:
         help="after training, greedily decode N tokens from a prompt",
     )
     parser.add_argument("--checkpoint-dir", default=None)
+    parser.add_argument(
+        "--accum-steps", type=int, default=1,
+        help="gradient-accumulation microbatches per optimizer step",
+    )
     parser.add_argument("--log-every", type=int, default=20)
     args = parser.parse_args(argv)
     logging.basicConfig(level=logging.INFO, stream=sys.stderr)
@@ -78,6 +82,7 @@ def main(argv=None) -> int:
         model, causal_lm_task(model),
         optax.adamw(args.learning_rate, weight_decay=0.01), mesh=mesh,
         shard_sequence=args.sp > 1, checkpoint_dir=args.checkpoint_dir,
+        accum_steps=args.accum_steps,
     )
     rng = jax.random.PRNGKey(0)
     sample = gpt_lib.synthetic_batch(rng, args.batch_size, args.seq_len, cfg)

@@ -107,7 +107,10 @@ class Trainer:
         shard_sequence: bool = False,
         packed: bool = False,
         checkpoint_dir: Optional[str] = None,
+        accum_steps: int = 1,
     ) -> None:
+        if accum_steps < 1:
+            raise ValueError(f"accum_steps must be >= 1, got {accum_steps}")
         self.model = model
         self.task = task
         self.optimizer = optimizer
@@ -115,6 +118,10 @@ class Trainer:
         self.rules = rules
         self.shard_sequence = shard_sequence
         self.packed = packed
+        # gradient accumulation: each step splits the batch into this
+        # many microbatches, scans them accumulating the mean gradient,
+        # and applies ONE optimizer update (see _train_step_fn)
+        self.accum_steps = accum_steps
         self._ckpt = (
             Checkpointer(checkpoint_dir) if checkpoint_dir is not None else None
         )
@@ -191,20 +198,91 @@ class Trainer:
 
     def _train_step_fn(self):
         """The raw (untraced) one-step function, shared by the single-
-        step jit and the scanned multi-step jit."""
+        step jit and the scanned multi-step jit.
+
+        With accum_steps > 1 the batch is split into that many
+        microbatches and gradients are accumulated over a lax.scan
+        before ONE optimizer update — the standard lever when the
+        target global batch's activations exceed HBM (e.g. long-
+        sequence LM training): activation memory is per-microbatch,
+        while the optimizer sees the full-batch mean gradient.
+        batch_stats (BatchNorm) thread through the scan, so each
+        microbatch's forward applies its EMA update exactly as k
+        separate steps would.
+
+        Exact for uniformly-weighted mean losses (matches the full-
+        batch gradient bit-for-bit up to float reassociation). For
+        weighted losses (MLM's sum/weight-sum) it is the standard
+        mean-of-microbatch-means approximation — exact only when the
+        weight mass per microbatch is equal."""
         task = self.task
         optimizer = self.optimizer
+        accum = self.accum_steps
 
-        def train_step(state: TrainState, batch):
+        def loss_and_grads(state, batch_stats, batch):
             def loss_of(params):
                 variables = {"params": params}
-                if state.batch_stats is not None:
-                    variables["batch_stats"] = state.batch_stats
+                if batch_stats is not None:
+                    variables["batch_stats"] = batch_stats
                 return task.loss_fn(variables, batch)
 
-            (loss, aux), grads = jax.value_and_grad(loss_of, has_aux=True)(
-                state.params
-            )
+            return jax.value_and_grad(loss_of, has_aux=True)(state.params)
+
+        def train_step(state: TrainState, batch):
+            if accum > 1:
+                from jax import lax
+
+                leading = jax.tree_util.tree_leaves(batch)[0].shape[0]
+                if leading % accum:
+                    raise ValueError(
+                        f"global batch {leading} is not divisible by "
+                        f"accum_steps {accum}"
+                    )
+                # after the reshape, pin the dp sharding to the PER-
+                # MICROBATCH batch axis (now axis 1): left to itself
+                # GSPMD may replicate the full batch or reshard per
+                # scan iteration, defeating the activation-memory bound
+                # this feature exists for
+                micro_spec = PartitionSpec(
+                    None, *mesh_lib.batch_spec(self.shard_sequence)
+                )
+                micro = jax.tree_util.tree_map(
+                    lambda x: jax.lax.with_sharding_constraint(
+                        x.reshape((accum, x.shape[0] // accum) + x.shape[1:]),
+                        NamedSharding(self.mesh, micro_spec),
+                    ),
+                    batch,
+                )
+
+                def body(carry, mb):
+                    grads_acc, loss_acc, bs = carry
+                    (loss, aux), grads = loss_and_grads(state, bs, mb)
+                    grads_acc = jax.tree_util.tree_map(
+                        jnp.add, grads_acc, grads
+                    )
+                    metrics_y = {
+                        k: v for k, v in aux.items() if k != "batch_stats"
+                    }
+                    carry = (grads_acc, loss_acc + loss, aux.get("batch_stats"))
+                    return carry, metrics_y
+
+                zeros = jax.tree_util.tree_map(jnp.zeros_like, state.params)
+                (grads, loss, new_bs), metrics_seq = lax.scan(
+                    body, (zeros, jnp.zeros((), jnp.float32), state.batch_stats),
+                    micro,
+                )
+                grads = jax.tree_util.tree_map(lambda g: g / accum, grads)
+                loss = loss / accum
+                # scalar aux metrics: mean over microbatches; the
+                # threaded batch_stats carry is the final one
+                aux = jax.tree_util.tree_map(
+                    lambda v: v.mean(axis=0), metrics_seq
+                )
+                aux["batch_stats"] = new_bs
+            else:
+                (loss, aux), grads = loss_and_grads(
+                    state, state.batch_stats, batch
+                )
             updates, new_opt_state = optimizer.update(
                 grads, state.opt_state, state.params
             )
