@@ -691,3 +691,25 @@ class TestGradientAccumulation:
             bool(jnp.all(jnp.isfinite(x)))
             for x in jax.tree_util.tree_leaves(state.batch_stats)
         )
+
+
+class TestEvaluate:
+    def test_eval_metrics_and_no_mutation(self):
+        from tf_operator_tpu.parallel.sharding import CONV_RULES
+
+        model = resnet_lib.ResNet(stage_sizes=(1,), num_classes=4, width=8)
+        rng = jax.random.PRNGKey(9)
+        sample = resnet_lib.synthetic_batch(rng, 8, 16, num_classes=4)
+        trainer = Trainer(
+            model, classification_task(model), optax.adam(1e-3),
+            rules=CONV_RULES,
+        )
+        state = trainer.init(rng, sample)
+        before = jax.tree_util.tree_leaves(state.batch_stats)[0].copy()
+
+        metrics = trainer.evaluate(state, trainer.place_batch(sample))
+        assert np.isfinite(float(metrics["loss"]))
+        assert 0.0 <= float(metrics["accuracy"]) <= 1.0
+        # eval must not touch the running stats (train=False path)
+        after = jax.tree_util.tree_leaves(state.batch_stats)[0]
+        np.testing.assert_array_equal(np.asarray(before), np.asarray(after))

@@ -39,6 +39,11 @@ def main(argv=None) -> int:
         help="gradient-accumulation microbatches per optimizer step",
     )
     parser.add_argument(
+        "--warmup-steps", type=int, default=0,
+        help="linear warmup to --learning-rate, then cosine decay "
+        "to 10%% over --steps (0 = constant lr)",
+    )
+    parser.add_argument(
         "--profile-dir", default=None,
         help="Capture an XLA/TPU profiler trace of steady-state steps",
     )
@@ -56,7 +61,7 @@ def main(argv=None) -> int:
 
     from ..models import bert as bert_lib
     from ..parallel.mesh import MeshConfig, build_mesh, mesh_summary
-    from ..train.trainer import Trainer, mlm_task
+    from ..train.trainer import Trainer, mlm_task, warmup_cosine_lr
 
     cfg = {
         "base": bert_lib.BERT_BASE,
@@ -80,7 +85,7 @@ def main(argv=None) -> int:
 
     model = bert_lib.BertForMLM(cfg, attention_fn=attention_fn)
     trainer = Trainer(
-        model, mlm_task(model), optax.adamw(args.learning_rate), mesh=mesh,
+        model, mlm_task(model), optax.adamw(warmup_cosine_lr(args.learning_rate, args.steps, args.warmup_steps)), mesh=mesh,
         shard_sequence=args.sp > 1, checkpoint_dir=args.checkpoint_dir,
         accum_steps=args.accum_steps,
     )

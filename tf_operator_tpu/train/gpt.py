@@ -45,6 +45,11 @@ def main(argv=None) -> int:
         "--accum-steps", type=int, default=1,
         help="gradient-accumulation microbatches per optimizer step",
     )
+    parser.add_argument(
+        "--warmup-steps", type=int, default=0,
+        help="linear warmup to --learning-rate, then cosine decay "
+        "to 10%% over --steps (0 = constant lr)",
+    )
     parser.add_argument("--log-every", type=int, default=20)
     args = parser.parse_args(argv)
     logging.basicConfig(level=logging.INFO, stream=sys.stderr)
@@ -59,7 +64,7 @@ def main(argv=None) -> int:
 
     from ..models import gpt as gpt_lib
     from ..parallel.mesh import MeshConfig, build_mesh, mesh_summary
-    from ..train.trainer import Trainer, causal_lm_task
+    from ..train.trainer import Trainer, causal_lm_task, warmup_cosine_lr
 
     cfg = {"small": gpt_lib.GPT_SMALL, "tiny": gpt_lib.GPT_TINY}[args.preset]
     if args.seq_len > cfg.max_seq_len or args.remat:
@@ -80,7 +85,10 @@ def main(argv=None) -> int:
     model = gpt_lib.GPT(cfg, attention_fn=attention_fn)
     trainer = Trainer(
         model, causal_lm_task(model),
-        optax.adamw(args.learning_rate, weight_decay=0.01), mesh=mesh,
+        optax.adamw(
+            warmup_cosine_lr(args.learning_rate, args.steps, args.warmup_steps),
+            weight_decay=0.01,
+        ), mesh=mesh,
         shard_sequence=args.sp > 1, checkpoint_dir=args.checkpoint_dir,
         accum_steps=args.accum_steps,
     )

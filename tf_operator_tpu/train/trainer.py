@@ -49,6 +49,20 @@ class Task:
     has_batch_stats: bool = False
 
 
+def warmup_cosine_lr(peak: float, steps: int, warmup_steps: int):
+    """Constant lr when warmup_steps == 0; otherwise linear warmup to
+    `peak` then cosine decay to 10% over the remaining steps (the
+    standard LM pretraining shape). decay_steps is clamped so
+    warmup_steps >= steps degrades to warmup-then-immediate-decay
+    instead of an optax ValueError."""
+    if not warmup_steps:
+        return peak
+    return optax.warmup_cosine_decay_schedule(
+        init_value=0.0, peak_value=peak, warmup_steps=warmup_steps,
+        decay_steps=max(steps, warmup_steps + 1), end_value=peak * 0.1,
+    )
+
+
 def classification_task(model) -> Task:
     """Softmax cross-entropy over logits; handles BatchNorm models."""
 
@@ -126,6 +140,7 @@ class Trainer:
             Checkpointer(checkpoint_dir) if checkpoint_dir is not None else None
         )
         self._train_step = None
+        self._eval_step = None
         self._multi_steps: Dict[int, Any] = {}
         self.state_shardings = None
 
@@ -349,6 +364,38 @@ class Trainer:
             self._train_step = self._build_train_step()
         with self.mesh:
             return self._train_step(state, batch)
+
+    def evaluate(
+        self, state: TrainState, batch
+    ) -> Dict[str, jax.Array]:
+        """One no-gradient eval pass: train=False (BatchNorm running
+        stats, no stat updates), returns the task's metrics including
+        loss. Jitted and cached like the train step."""
+        if self._eval_step is None:
+            task = self.task
+            batch_sharding = NamedSharding(
+                self.mesh, mesh_lib.batch_spec(self.shard_sequence)
+            )
+
+            def eval_step(state: TrainState, batch):
+                variables = {"params": state.params}
+                if state.batch_stats is not None:
+                    variables["batch_stats"] = state.batch_stats
+                loss, aux = task.loss_fn(variables, batch, train=False)
+                metrics = {
+                    k: v for k, v in aux.items()
+                    if k != "batch_stats" and v is not None
+                }
+                metrics["loss"] = loss
+                return metrics
+
+            self._eval_step = jax.jit(
+                eval_step,
+                in_shardings=(self.state_shardings, batch_sharding),
+                out_shardings=NamedSharding(self.mesh, PartitionSpec()),
+            )
+        with self.mesh:
+            return self._eval_step(state, self._prepare_batch(batch))
 
     def run_steps(
         self, state: TrainState, batch, n: int
