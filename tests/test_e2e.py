@@ -24,7 +24,7 @@ from tf_operator_tpu.sdk import TFJobClient
 from tests.test_api import make_job
 
 
-def wait_until(predicate, timeout=15.0, interval=0.1, message="condition"):
+def wait_until(predicate, timeout=30.0, interval=0.1, message="condition"):
     deadline = time.monotonic() + timeout
     while time.monotonic() < deadline:
         if predicate():
@@ -33,7 +33,7 @@ def wait_until(predicate, timeout=15.0, interval=0.1, message="condition"):
     raise AssertionError(f"timed out waiting for {message}")
 
 
-def http_json(url, timeout=3.0):
+def http_json(url, timeout=10.0):
     with urllib.request.urlopen(url, timeout=timeout) as resp:
         return json.loads(resp.read())
 

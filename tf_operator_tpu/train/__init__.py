@@ -7,6 +7,8 @@ from .trainer import (
     causal_lm_task,
     classification_task,
     mlm_task,
+    moe_task,
+    warmup_cosine_lr,
 )
 
 __all__ = [
@@ -16,6 +18,8 @@ __all__ = [
     "classification_task",
     "mlm_task",
     "causal_lm_task",
+    "moe_task",
+    "warmup_cosine_lr",
     "Checkpointer",
     "InputPipeline",
     "synthetic_source",

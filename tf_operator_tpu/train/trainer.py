@@ -110,6 +110,27 @@ def causal_lm_task(model) -> Task:
     return Task(apply_fn=model.apply, loss_fn=loss_fn)
 
 
+def moe_task(model) -> Task:
+    """Causal LM with router auxiliary losses: the MoE blocks sow their
+    (already cfg.router_aux_weight-scaled) load-balancing terms into
+    the "losses" collection; the task collects and adds them, and
+    reports the aux magnitude as a metric."""
+    from ..models.moe import lm_loss, total_aux_loss
+
+    def loss_fn(variables, batch, train=True):
+        mask = batch.get("attention_mask")
+        logits, mods = model.apply(
+            variables, batch["input_ids"], mask, mutable=["losses"]
+        )
+        aux = total_aux_loss(mods.get("losses", {}))
+        # the key-padding mask doubles as loss weights: pad positions
+        # neither attend nor contribute to the mean cross-entropy
+        loss = lm_loss(logits, batch["labels"], weights=mask) + aux
+        return loss, {"router_aux": aux, "batch_stats": None}
+
+    return Task(apply_fn=model.apply, loss_fn=loss_fn)
+
+
 class Trainer:
     def __init__(
         self,
