@@ -320,12 +320,21 @@ def generate(
     max_new_tokens: int,
     temperature: float = 0.0,
     rng: Optional[jax.Array] = None,
+    mesh=None,
+    rules=None,
 ) -> jax.Array:
     """Greedy (temperature 0) or sampled decode. prompt: [b, p_len].
     Returns [b, p_len + max_new_tokens]. The whole decode is ONE jitted
     lax.scan (compiled once per config/shape, cached) — prefill feeds
     prompt tokens through the cache, then new tokens feed back
-    autoregressively."""
+    autoregressively.
+
+    mesh (optional, a jax.sharding.Mesh): multi-chip decode. Params are
+    placed by `rules` (default TRANSFORMER_RULES: Megatron tp on the
+    projections + vocab-on-tp head) and the prompt batch-sharded on
+    dp/fsdp; jit follows the committed input shardings, so GSPMD
+    shards the KV cache and inserts the tp collectives without a
+    separate decode path."""
     batch, prompt_len = prompt.shape
     total = prompt_len + max_new_tokens
     if total > cfg.max_seq_len:
@@ -334,6 +343,27 @@ def generate(
         )
     if rng is None:
         rng = jax.random.PRNGKey(0)
+    if mesh is not None:
+        from jax.sharding import NamedSharding, PartitionSpec
+
+        from ..parallel import sharding as sharding_lib
+
+        shardings = sharding_lib.shardings_for_tree(
+            params, mesh,
+            rules if rules is not None else sharding_lib.TRANSFORMER_RULES,
+        )
+        params = jax.tree_util.tree_map(jax.device_put, params, shardings)
+        # batch-shard the prompt only when it divides the data axes —
+        # a single-prompt decode on a dp>1 mesh replicates instead of
+        # crashing in device_put (tp sharding still applies via params)
+        data_shards = mesh.shape.get("dp", 1) * mesh.shape.get("fsdp", 1)
+        batch_spec = (
+            PartitionSpec(("dp", "fsdp"), None)
+            if batch % data_shards == 0
+            else PartitionSpec()
+        )
+        prompt = jax.device_put(prompt, NamedSharding(mesh, batch_spec))
+        rng = jax.device_put(rng, NamedSharding(mesh, PartitionSpec()))
     run = _compiled_decode(cfg, float(temperature), batch, prompt_len, total)
     generated = run(params, prompt, rng)
     return jnp.concatenate([prompt[:, :1], generated], axis=1)

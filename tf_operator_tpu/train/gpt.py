@@ -132,10 +132,14 @@ def main(argv=None) -> int:
         # one process; the decode demo is a single-host convenience
         logger.info("--generate skipped on multi-host runs")
     elif args.generate > 0:
-        prompt = jax.device_get(sample["input_ids"][:1, :8])
+        # mesh-aware decode: params stay sharded (tp/fsdp rules), the
+        # prompt batch spans the dp axis when enough rows exist
+        # (generate replicates the batch otherwise)
+        batch_rows = min(args.batch_size, mesh.shape["dp"] * mesh.shape["fsdp"])
+        prompt = jax.device_get(sample["input_ids"][:batch_rows, :8])
         out = gpt_lib.generate(
-            cfg, jax.device_get(state.params), jax.numpy.asarray(prompt),
-            max_new_tokens=args.generate,
+            cfg, state.params, jax.numpy.asarray(prompt),
+            max_new_tokens=args.generate, mesh=mesh,
         )
         logger.info("generated: %s", jax.device_get(out)[0].tolist())
     return 0

@@ -99,7 +99,16 @@ def main(argv=None) -> int:
 
     start = time.perf_counter()
     for step in range(args.steps):
-        state, metrics = trainer.step(state, trainer.place_batch(sample))
+        # fresh synthetic batch per step (same pattern as train/gpt.py):
+        # loss tracks training progress, not single-batch memorization,
+        # and the router sees a changing token distribution
+        batch = trainer.place_batch(
+            moe_lib.synthetic_batch(
+                jax.random.fold_in(rng, step), args.batch_size, args.seq_len,
+                cfg,
+            )
+        )
+        state, metrics = trainer.step(state, batch)
         if (step + 1) % args.log_every == 0:
             logger.info(
                 "step %d loss=%.4f router_aux=%.5f",
