@@ -155,20 +155,35 @@ class TestDecode:
 class TestShardedDecode:
     def test_mesh_decode_matches_single_device(self, cfg, trained):
         """generate(mesh=...) shards params by rule (tp) and the prompt
-        batch on dp/fsdp; greedy decode must produce exactly the same
-        token chain as the unsharded path on the same params."""
-        from tf_operator_tpu.parallel.mesh import MeshConfig, build_mesh
-
-        _, state, _, _ = trained
+        batch on dp/fsdp; greedy decode must produce the same token
+        chain as the unsharded path on the same params. Token equality
+        is only meaningful when no argmax sits on a near-tie (tp
+        matmuls reassociate bf16 reductions), so the test first
+        teacher-forces the plain chain and skips if any top-2 logit
+        gap is within reassociation noise."""
+        model, state, _, _ = trained
         params = jax.device_get(state.params)
         prompt = gpt_lib.synthetic_batch(
             jax.random.PRNGKey(11), 4, 8, cfg
         )["input_ids"]
 
         plain = gpt_lib.generate(cfg, params, prompt, max_new_tokens=6)
+        logits = model.apply({"params": params}, plain[:, :-1])
+        top2 = jnp.sort(logits.astype(jnp.float32), axis=-1)[..., -2:]
+        min_gap = float(jnp.min(top2[..., 1] - top2[..., 0]))
+        if min_gap < 1e-3:
+            pytest.skip(f"argmax near-tie (gap {min_gap:.2e}): token "
+                        "equality would be ULP-sensitive")
+
         mesh = build_mesh(MeshConfig(dp=2, fsdp=2, tp=2))
         sharded = gpt_lib.generate(
             cfg, params, prompt, max_new_tokens=6, mesh=mesh
         )
         assert sharded.shape == plain.shape
         np.testing.assert_array_equal(np.asarray(sharded), np.asarray(plain))
+        # and a tp-only mesh (no data axes): prompt replicates, still runs
+        tp_mesh = build_mesh(MeshConfig(dp=1, tp=8))
+        tp_out = gpt_lib.generate(
+            cfg, params, prompt[:1], max_new_tokens=4, mesh=tp_mesh
+        )
+        assert tp_out.shape == (1, 8 + 4)

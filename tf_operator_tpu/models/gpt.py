@@ -352,14 +352,18 @@ def generate(
             params, mesh,
             rules if rules is not None else sharding_lib.TRANSFORMER_RULES,
         )
-        params = jax.tree_util.tree_map(jax.device_put, params, shardings)
-        # batch-shard the prompt only when it divides the data axes —
-        # a single-prompt decode on a dp>1 mesh replicates instead of
-        # crashing in device_put (tp sharding still applies via params)
-        data_shards = mesh.shape.get("dp", 1) * mesh.shape.get("fsdp", 1)
+        params = sharding_lib.place(params, shardings)
+        # batch-shard the prompt over whichever data axes the mesh has,
+        # and only when the batch divides them — a single-prompt decode
+        # on a dp>1 mesh (or a tp-only mesh) replicates instead of
+        # crashing in device_put; tp sharding still applies via params
+        data_axes = tuple(a for a in ("dp", "fsdp") if a in mesh.shape)
+        data_shards = 1
+        for axis in data_axes:
+            data_shards *= mesh.shape[axis]
         batch_spec = (
-            PartitionSpec(("dp", "fsdp"), None)
-            if batch % data_shards == 0
+            PartitionSpec(data_axes, None)
+            if data_axes and batch % data_shards == 0
             else PartitionSpec()
         )
         prompt = jax.device_put(prompt, NamedSharding(mesh, batch_spec))
