@@ -169,7 +169,10 @@ class TestShardedDecode:
 
         plain = gpt_lib.generate(cfg, params, prompt, max_new_tokens=6)
         logits = model.apply({"params": params}, plain[:, :-1])
-        top2 = jnp.sort(logits.astype(jnp.float32), axis=-1)[..., -2:]
+        # only positions prompt_len-1.. feed argmax back into the chain
+        # (earlier ones are overwritten by forced prompt tokens)
+        consumed = logits[:, prompt.shape[1] - 1:]
+        top2 = jnp.sort(consumed.astype(jnp.float32), axis=-1)[..., -2:]
         min_gap = float(jnp.min(top2[..., 1] - top2[..., 0]))
         if min_gap < 1e-3:
             pytest.skip(f"argmax near-tie (gap {min_gap:.2e}): token "
@@ -181,8 +184,16 @@ class TestShardedDecode:
         )
         assert sharded.shape == plain.shape
         np.testing.assert_array_equal(np.asarray(sharded), np.asarray(plain))
-        # and a tp-only mesh (no data axes): prompt replicates, still runs
-        tp_mesh = build_mesh(MeshConfig(dp=1, tp=8))
+        # indivisible batch (1 row over 4 data shards): the replicate
+        # fallback branch must run, not crash in device_put
+        one = gpt_lib.generate(
+            cfg, params, prompt[:1], max_new_tokens=4, mesh=mesh
+        )
+        assert one.shape == (1, 8 + 4)
+        # raw mesh with NO data axes at all: data_axes == () branch
+        from jax.sharding import Mesh as RawMesh
+
+        tp_mesh = RawMesh(np.array(jax.devices()), ("tp",))
         tp_out = gpt_lib.generate(
             cfg, params, prompt[:1], max_new_tokens=4, mesh=tp_mesh
         )

@@ -88,6 +88,19 @@ class TestShardingRules:
         assert sh["mlm_head"]["kernel"].spec == PartitionSpec("fsdp", "tp")
         assert sh["other"]["kernel"].spec == PartitionSpec("fsdp", None)
 
+    def test_absent_mesh_axes_dropped(self):
+        """Rules name the standard six axes; a user-supplied raw Mesh
+        with fewer must get those axes dropped, not a KeyError."""
+        from jax.sharding import Mesh as RawMesh
+
+        mesh = RawMesh(np.array(jax.devices()[:4]), ("tp",))
+        sh = shardings_for_tree(
+            {"mlp_in": {"kernel": jnp.zeros((8, 16))}}, mesh,
+            TRANSFORMER_RULES,
+        )
+        # rule says ("fsdp", "tp"); only tp exists on this mesh
+        assert sh["mlp_in"]["kernel"].spec == PartitionSpec(None, "tp")
+
     def test_indivisible_dims_fall_back(self, devices8):
         mesh = build_mesh(MeshConfig(dp=1, tp=8))
         sh = shardings_for_tree(

@@ -98,16 +98,23 @@ def shardings_for_tree(
 
 
 def _drop_indivisible(spec: PartitionSpec, shape, mesh: Mesh) -> PartitionSpec:
+    """Drop spec axes the mesh doesn't have (rules name the standard
+    six axes; user-supplied meshes may carry fewer) and axes that don't
+    divide the dimension evenly."""
     out: List[Optional[Any]] = []
     for dim, names in enumerate(spec):
         if names is None or dim >= len(shape):
             out.append(None)
             continue
         group = names if isinstance(names, tuple) else (names,)
+        group = tuple(name for name in group if name in mesh.shape)
         size = 1
         for name in group:
             size *= mesh.shape[name]
-        out.append(names if size and shape[dim] % size == 0 else None)
+        if not group or shape[dim] % size != 0:
+            out.append(None)
+        else:
+            out.append(group if isinstance(names, tuple) else group[0])
     return PartitionSpec(*out)
 
 

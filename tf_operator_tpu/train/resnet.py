@@ -25,6 +25,14 @@ def main(argv=None) -> int:
     parser.add_argument("--small", action="store_true", help="tiny variant (CPU smoke)")
     parser.add_argument("--checkpoint-dir", default=None)
     parser.add_argument(
+        "--accum-steps", type=int, default=1,
+        help="gradient-accumulation microbatches per optimizer step",
+    )
+    parser.add_argument(
+        "--warmup-steps", type=int, default=0,
+        help="linear warmup then cosine decay (0 = constant lr)",
+    )
+    parser.add_argument(
         "--profile-dir", default=None,
         help="Capture an XLA/TPU profiler trace of steady-state steps",
     )
@@ -44,7 +52,7 @@ def main(argv=None) -> int:
     from ..models import resnet as resnet_lib
     from ..parallel.mesh import MeshConfig, build_mesh, mesh_summary
     from ..parallel.sharding import CONV_RULES
-    from ..train.trainer import Trainer, classification_task
+    from ..train.trainer import Trainer, classification_task, warmup_cosine_lr
 
     n_chips = len(jax.devices())
     if args.small:
@@ -59,10 +67,14 @@ def main(argv=None) -> int:
     trainer = Trainer(
         model,
         classification_task(model),
-        optax.sgd(args.learning_rate, momentum=0.9),
+        optax.sgd(
+            warmup_cosine_lr(args.learning_rate, args.steps, args.warmup_steps),
+            momentum=0.9,
+        ),
         mesh=mesh,
         rules=CONV_RULES,
         checkpoint_dir=args.checkpoint_dir,
+        accum_steps=args.accum_steps,
     )
     rng = jax.random.PRNGKey(0)
     global_batch = args.per_chip_batch * n_chips
