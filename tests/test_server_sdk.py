@@ -610,3 +610,65 @@ class TestLeaseGuards:
         server = OperatorServer(options, substrate=NoLeaseSubstrate())
         assert server.run() == 1  # refuses instead of silent file lock
         # run() stops its own monitoring server on the error path
+
+
+class TestSdkCli:
+    """python -m tf_operator_tpu.sdk — the kubectl-style verbs over a
+    real HTTP apiserver boundary (reference users drive TFJobs with
+    kubectl + the python SDK; this is both in one tool)."""
+
+    def test_create_get_delete_over_the_wire(self, tmp_path, capsys):
+        import yaml
+
+        from tf_operator_tpu.sdk.__main__ import main
+        from tf_operator_tpu.testing.fake_apiserver import FakeApiServer
+
+        server = FakeApiServer()
+        port = server.start()
+        try:
+            kubeconfig = tmp_path / "kubeconfig.yaml"
+            kubeconfig.write_text(yaml.safe_dump({
+                "apiVersion": "v1", "kind": "Config",
+                "current-context": "fake",
+                "contexts": [{"name": "fake", "context": {
+                    "cluster": "fake", "user": "u"}}],
+                "clusters": [{"name": "fake", "cluster": {
+                    "server": f"http://127.0.0.1:{port}"}}],
+                "users": [{"name": "u", "user": {}}],
+            }))
+            base = ["-n", "kubeflow", "--kubeconfig", str(kubeconfig)]
+            assert main(base + [
+                "create", "-f", "examples/v1/mnist-tpu.yaml"
+            ]) == 0
+            assert main(base + ["get", "mnist-tpu"]) == 0
+            out = capsys.readouterr().out
+            assert '"name": "mnist-tpu"' in out
+            # logs: served from the apiserver's pod /log subresource
+            server.store.pod_logs[("kubeflow", "mnist-tpu-tpu-0")] = "hello\n"
+            with server.store.lock:
+                rv = next(server.store.rv)
+                server.store.objects[("pods", "kubeflow", "mnist-tpu-tpu-0")] = {
+                    "apiVersion": "v1", "kind": "Pod",
+                    "metadata": {
+                        "name": "mnist-tpu-tpu-0", "namespace": "kubeflow",
+                        "resourceVersion": str(rv),
+                        "labels": {
+                            **dict(t.gen_labels("mnist-tpu")),
+                            "tf-replica-type": "tpu",
+                            "tf-replica-index": "0",
+                            "job-role": "master",
+                        },
+                    },
+                    "spec": {}, "status": {"phase": "Running"},
+                }
+            assert main(base + ["logs", "mnist-tpu", "--master"]) == 0
+            out = capsys.readouterr().out
+            assert "hello" in out
+            assert main(base + ["delete", "mnist-tpu"]) == 0
+            assert main(base + ["get"]) == 0  # list: now empty
+            # kubectl-style single-line error + exit 1, not a traceback
+            assert main(base + ["get", "nosuchjob"]) == 1
+            err = capsys.readouterr().err
+            assert "error:" in err and "Traceback" not in err
+        finally:
+            server.stop()

@@ -256,6 +256,25 @@ class KubeSubstrate:
     def delete_pod(self, namespace: str, name: str) -> None:
         self._request("DELETE", self._core_path("pods", namespace, name))
 
+    def read_pod_log(self, namespace: str, name: str) -> str:
+        """GET .../pods/{name}/log — plain text, not JSON (the
+        reference SDK's read_namespaced_pod_log; feeds
+        TFJobClient.get_logs)."""
+        req = urllib.request.Request(
+            self.base_url + self._core_path("pods", namespace, name) + "/log",
+            method="GET",
+        )
+        if self._token:
+            req.add_header("Authorization", f"Bearer {self._token}")
+        try:
+            with urllib.request.urlopen(
+                req, timeout=30.0, context=self._ssl
+            ) as resp:
+                return resp.read().decode(errors="replace")
+        except urllib.error.HTTPError as err:
+            _raise_for_status(err.code, err.read().decode(errors="replace"))
+            raise  # unreachable
+
     def update_pod_status(
         self, namespace: str, name: str, status: k8s.PodStatus
     ) -> k8s.Pod:

@@ -1,0 +1,97 @@
+"""kubectl-style CLI over the SDK (reference users drive TFJobs with
+kubectl + the python client; this gives the same verbs in one tool):
+
+    python -m tf_operator_tpu.sdk create -f examples/v1/mnist-tpu.yaml
+    python -m tf_operator_tpu.sdk get mnist-tpu -n kubeflow
+    python -m tf_operator_tpu.sdk wait mnist-tpu --timeout 600
+    python -m tf_operator_tpu.sdk logs mnist-tpu --master
+    python -m tf_operator_tpu.sdk delete mnist-tpu
+
+Talks to a real apiserver via the typed substrate (in-cluster or
+~/.kube/config), mirroring the reference SDK's client surface
+(sdk/python/.../tf_job_client.py:28-392).
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+
+
+def _client(args):
+    from ..runtime.kube import KubeSubstrate
+    from .client import TFJobClient
+
+    return TFJobClient(
+        KubeSubstrate.from_config(kubeconfig=args.kubeconfig),
+        namespace=args.namespace,
+    )
+
+
+def main(argv=None) -> int:
+    parser = argparse.ArgumentParser(prog="tf-operator-tpu sdk")
+    parser.add_argument("-n", "--namespace", default="default")
+    parser.add_argument("--kubeconfig", default=None)
+    sub = parser.add_subparsers(dest="verb", required=True)
+
+    p_create = sub.add_parser("create", help="create a TFJob from YAML")
+    p_create.add_argument("-f", "--filename", required=True)
+
+    p_get = sub.add_parser("get", help="print a TFJob (or all) as JSON")
+    p_get.add_argument("name", nargs="?")
+
+    p_wait = sub.add_parser("wait", help="wait for Succeeded/Failed")
+    p_wait.add_argument("name")
+    p_wait.add_argument("--timeout", type=float, default=600.0)
+
+    p_logs = sub.add_parser("logs", help="print replica logs")
+    p_logs.add_argument("name")
+    p_logs.add_argument("--master", action="store_true",
+                        help="only the master/chief/worker-0 replica")
+
+    p_delete = sub.add_parser("delete", help="delete a TFJob")
+    p_delete.add_argument("name")
+
+    args = parser.parse_args(argv)
+    try:
+        return _run(args)
+    except Exception as err:  # kubectl-style: one-line error, exit 1
+        print(f"error: {type(err).__name__}: {err}", file=sys.stderr)
+        return 1
+
+
+def _run(args) -> int:
+    client = _client(args)
+    if args.verb == "create":
+        import yaml
+
+        with open(args.filename) as handle:
+            job = client.create(yaml.safe_load(handle))
+        print(f"tfjob.kubeflow.org/{job.metadata.name} created")
+    elif args.verb == "get":
+        if args.name:
+            jobs = [client.get(args.name)]
+        else:
+            jobs = client.list()
+        for job in jobs:
+            print(json.dumps(job.to_dict(), indent=1, default=str))
+    elif args.verb == "wait":
+        job = client.wait_for_job(args.name, timeout_seconds=args.timeout)
+        conditions = job.status.conditions
+        status = conditions[-1].type.value if conditions else "Unknown"
+        print(f"{args.name}: {status}")
+    elif args.verb == "logs":
+        for name, text in client.get_logs(
+            args.name, master=args.master
+        ).items():
+            print(f"==> {name} <==")
+            print(text)
+    elif args.verb == "delete":
+        client.delete(args.name)
+        print(f"tfjob.kubeflow.org/{args.name} deleted")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -50,6 +50,8 @@ class _Store:
         self.history: Dict[str, List[Tuple[int, bytes]]] = {}
         # smallest rv still replayable; resuming below it -> 410 Gone
         self.oldest_rv: Dict[str, int] = {}
+        # (namespace, pod name) -> log text served at .../pods/{n}/log
+        self.pod_logs: Dict[Tuple[str, str], str] = {}
 
     def stamp(self, obj: dict) -> None:
         meta = obj.setdefault("metadata", {})
@@ -98,7 +100,7 @@ def _split(path: str):
     """
     parts = [p for p in path.split("/") if p]
     subresource = None
-    if parts and parts[-1] == "status":
+    if parts and parts[-1] in ("status", "log"):
         subresource = parts.pop()
     if "namespaces" in parts:
         idx = parts.index("namespaces")
@@ -173,10 +175,19 @@ class FakeApiServer:
             def do_GET(self) -> None:  # noqa: N802
                 url = urlparse(self.path)
                 params = parse_qs(url.query)
-                plural, namespace, name, _ = _split(url.path)
+                plural, namespace, name, subresource = _split(url.path)
                 if params.get("watch") == ["true"]:
                     return self._watch(plural, params)
                 with store.lock:
+                    if subresource == "log" and plural == "pods":
+                        if (plural, namespace, name) not in store.objects:
+                            return self._error(404, "NotFound", f"pod {name}")
+                        text = store.pod_logs.get((namespace, name), "")
+                        self.send_response(200)
+                        self.send_header("Content-Type", "text/plain")
+                        self.end_headers()
+                        self.wfile.write(text.encode())
+                        return None
                     if name is not None:
                         obj = store.objects.get((plural, namespace, name))
                         if obj is None:
