@@ -524,11 +524,13 @@ def run_extras(on_tpu: bool, n_chips: int, line: dict) -> None:
     print("extras done", file=sys.stderr, flush=True)
 
 
-def _backend_watchdog(seconds: float = 240.0):
-    """The TPU arrives through a tunnel that can wedge (observed r3:
-    backend init blocks forever at ~zero CPU). If jax.devices() doesn't
-    return in time, emit a diagnostic JSON line and hard-exit so the
-    driver records the failure mode instead of an empty timeout."""
+def _watchdog(seconds: float, what: str, likely: str):
+    """The TPU arrives through a tunnel that can wedge mid-call
+    (observed r3: backend init AND in-flight device calls block forever
+    at ~zero CPU). If `what` hasn't finished within `seconds`, emit a
+    diagnostic JSON line (with the caller's most-likely diagnosis) and
+    hard-exit so the driver records the failure mode instead of an
+    empty timeout. Cancel on success."""
     import os as _os
     import threading
 
@@ -540,8 +542,8 @@ def _backend_watchdog(seconds: float = 240.0):
                     "value": 0.0,
                     "unit": "none",
                     "vs_baseline": 0.0,
-                    "error": f"jax backend init did not return within "
-                    f"{seconds:.0f}s — TPU tunnel unreachable/wedged",
+                    "error": f"{what} did not finish within "
+                    f"{seconds:.0f}s — {likely}",
                 }
             ),
             flush=True,
@@ -556,12 +558,22 @@ def _backend_watchdog(seconds: float = 240.0):
 
 def main() -> None:
     _maybe_force_cpu()
-    watchdog = _backend_watchdog()
+    watchdog = _watchdog(
+        240.0, "jax backend init", "TPU tunnel unreachable/wedged"
+    )
     devices = jax.devices()
     watchdog.cancel()
     n_chips = len(devices)
     on_tpu = devices[0].platform == "tpu"
 
+    # headline phase gets its own deadline: until the first JSON line
+    # is printed, a wedged in-flight device call would otherwise leave
+    # the driver with an empty timeout and no diagnosis
+    watchdog = _watchdog(
+        1800.0, "headline benchmarks",
+        "in-flight device call wedged, or pathologically slow "
+        "compiles/reruns — check driver stderr for progress",
+    )
     resnet = bench_resnet(on_tpu, n_chips)
     # headline BERT rides the pallas flash path; if the kernel fails on
     # this chip/toolchain (r3's regridded kernels are validated in
@@ -602,7 +614,11 @@ def main() -> None:
     # headline FIRST: if extras hang or the process is killed mid-way,
     # stdout already carries the measured numbers; the enriched line
     # re-printed after extras supersedes it (the driver parses the
-    # LAST JSON line on stdout)
+    # LAST JSON line on stdout). The watchdog is cancelled BEFORE the
+    # print: no device call can wedge between here and the print, and
+    # cancelling after would race a near-deadline timer into
+    # overwriting the real last line with bench_unavailable
+    watchdog.cancel()
     print(json.dumps(line), flush=True)
     run_extras(on_tpu, n_chips, line)
     print(json.dumps(line))
