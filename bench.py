@@ -100,16 +100,14 @@ def time_fused_steps(trainer, state, batch, steps: int) -> tuple:
     return state, elapsed
 
 
-def bench_resnet(
-    on_tpu: bool, n_chips: int, norm_impl: str = "tpu",
-    steps: int | None = None, fed: bool = False, stem: str = "conv7",
+def setup_resnet(
+    on_tpu: bool, n_chips: int, norm_impl: str = "tpu", stem: str = "conv7",
     batch_override: int | None = None,
-) -> dict:
-    """norm_impl: "tpu" (TpuBatchNorm, the default) or "flax"
-    (nn.BatchNorm) — benched both ways so the r3 BN rework's effect is
-    attributable (PROFILE.md). fed=True measures with a host input
-    pipeline (fresh per-step device_put, double-buffered) instead of a
-    resident batch — VERDICT r2 weak #5."""
+):
+    """(trainer, state, placed_batch, meta) for the canonical ResNet
+    benchmark configuration — the ONE place its shape/config constants
+    live, shared by bench_resnet and benchmarks/model_profile.py so
+    the profile always describes the benchmarked workload."""
     from tf_operator_tpu.models import resnet as resnet_lib
     from tf_operator_tpu.parallel.mesh import MeshConfig, build_mesh
     from tf_operator_tpu.parallel.sharding import CONV_RULES
@@ -120,15 +118,12 @@ def bench_resnet(
             num_classes=1000, norm_impl=norm_impl, stem=stem
         )
         per_chip_batch, image_size, classes = 256, 224, 1000
-        steps = steps if steps is not None else 30
     else:  # CPU smoke: tiny shapes, same code path
         model = resnet_lib.ResNet(
             stage_sizes=(1, 1), num_classes=10, width=8, dtype=jnp.float32,
             norm_impl=norm_impl, stem=stem,
         )
         per_chip_batch, image_size, classes = 8, 64, 10
-        steps = steps if steps is not None else 3
-
     if batch_override is not None:
         per_chip_batch = batch_override
     mesh = build_mesh(MeshConfig(dp=-1))
@@ -142,13 +137,39 @@ def bench_resnet(
         resnet_lib.synthetic_batch(rng, global_batch, image_size, classes)
     )
     state = trainer.init(rng, batch)
+    meta = {
+        "global_batch": global_batch,
+        "image_size": image_size,
+        "classes": classes,
+        "resnet_lib": resnet_lib,
+    }
+    return trainer, state, batch, meta
+
+
+def bench_resnet(
+    on_tpu: bool, n_chips: int, norm_impl: str = "tpu",
+    steps: int | None = None, fed: bool = False, stem: str = "conv7",
+    batch_override: int | None = None,
+) -> dict:
+    """norm_impl: "tpu" (TpuBatchNorm, the default) or "flax"
+    (nn.BatchNorm) — benched both ways so the r3 BN rework's effect is
+    attributable (PROFILE.md). fed=True measures with a host input
+    pipeline (fresh per-step device_put, double-buffered) instead of a
+    resident batch — VERDICT r2 weak #5."""
+    steps = steps if steps is not None else (30 if on_tpu else 3)
+    trainer, state, batch, meta = setup_resnet(
+        on_tpu, n_chips, norm_impl=norm_impl, stem=stem,
+        batch_override=batch_override,
+    )
+    rng = jax.random.PRNGKey(0)
+    global_batch = meta["global_batch"]
     # model-math FLOPs only apply to the real ResNet-50 config; the CPU
     # smoke model reports mfu 0 regardless (no peak for cpu)
     flops = resnet50_step_flops(global_batch) if on_tpu else 0.0
     if fed:
         state, elapsed = time_fed_steps(
-            trainer, state, rng, global_batch, image_size, classes, steps,
-            resnet_lib,
+            trainer, state, rng, global_batch, meta["image_size"],
+            meta["classes"], steps, meta["resnet_lib"],
         )
     else:
         state, elapsed = time_fused_steps(trainer, state, batch, steps)
@@ -204,17 +225,13 @@ def time_fed_steps(
     return state, elapsed
 
 
-def bench_bert(
+def setup_bert(
     on_tpu: bool, n_chips: int, attention: str = "flash",
-    steps: int | None = None, num_heads: int | None = None,
-) -> dict:
-    """attention="flash" (headline): the pallas kernel on a packed
-    batch — synthetic MLM batches are unpadded, so the all-ones mask
-    carries no information and is dropped (the kernel handles real
-    key-padding masks in-kernel; a constant-true mask is just wasted
-    bandwidth). BERT-base head_dim is 64 → the lane-padded kernel.
-    "xla": the previous default, kept as an A/B extra so BENCH reports
-    the kernel's measured contribution (VERDICT r2 next #2)."""
+    num_heads: int | None = None,
+):
+    """(trainer, state, placed_batch, meta) for the canonical BERT MLM
+    benchmark configuration — shared with benchmarks/model_profile.py
+    (see setup_resnet)."""
     from tf_operator_tpu.models import bert as bert_lib
     from tf_operator_tpu.parallel.mesh import MeshConfig, build_mesh
     from tf_operator_tpu.train import Trainer, mlm_task
@@ -226,7 +243,6 @@ def bench_bert(
             intermediate_size=3072, max_position_embeddings=512,
         )
         per_chip_batch, seq = 32, 512
-        steps = steps if steps is not None else 30
     else:
         cfg = bert_lib.BertConfig(
             vocab_size=1024, hidden_size=128, num_layers=2,
@@ -234,7 +250,6 @@ def bench_bert(
             intermediate_size=256, max_position_embeddings=128,
         )
         per_chip_batch, seq = 4, 128
-        steps = steps if steps is not None else 3
 
     if attention == "flash":
         from tf_operator_tpu.ops.pallas.flash_attention import flash_attention
@@ -257,6 +272,26 @@ def bench_bert(
         bert_lib.synthetic_batch(rng, global_batch, seq, cfg)
     )
     state = trainer.init(rng, batch)
+    meta = {"global_batch": global_batch, "seq": seq, "cfg": cfg}
+    return trainer, state, batch, meta
+
+
+def bench_bert(
+    on_tpu: bool, n_chips: int, attention: str = "flash",
+    steps: int | None = None, num_heads: int | None = None,
+) -> dict:
+    """attention="flash" (headline): the pallas kernel on a packed
+    batch — synthetic MLM batches are unpadded, so the all-ones mask
+    carries no information and is dropped (the kernel handles real
+    key-padding masks in-kernel; a constant-true mask is just wasted
+    bandwidth). BERT-base head_dim is 64 → the lane-padded kernel.
+    "xla": the previous default, kept as an A/B extra so BENCH reports
+    the kernel's measured contribution (VERDICT r2 next #2)."""
+    steps = steps if steps is not None else (30 if on_tpu else 3)
+    trainer, state, batch, meta = setup_bert(
+        on_tpu, n_chips, attention=attention, num_heads=num_heads
+    )
+    global_batch, seq, cfg = meta["global_batch"], meta["seq"], meta["cfg"]
     flops = transformer_step_flops(state.params, global_batch, seq, cfg)
     state, elapsed = time_fused_steps(trainer, state, batch, steps)
 
@@ -273,15 +308,10 @@ def bench_bert(
     }
 
 
-def bench_gpt(
-    on_tpu: bool, n_chips: int, attention: str = "flash",
-    steps: int | None = None,
-) -> dict:
-    """Long-context causal LM (GPT-small @ seq 4096): the shape class
-    where flash attention is load-bearing — the XLA path materializes
-    b*h*seq^2 f32 scores (≥ fwd+bwd residency of several GB at this
-    config) while the kernel stays O(seq). attention="xla" is the
-    guarded A/B; an OOM there is itself the measurement."""
+def setup_gpt(on_tpu: bool, n_chips: int, attention: str = "flash"):
+    """(trainer, state, placed_batch, meta) for the canonical GPT
+    long-context benchmark configuration — shared with
+    benchmarks/model_profile.py (see setup_resnet)."""
     from tf_operator_tpu.models import gpt as gpt_lib
     from tf_operator_tpu.parallel.mesh import MeshConfig, build_mesh
     from tf_operator_tpu.train import Trainer, causal_lm_task
@@ -293,11 +323,9 @@ def bench_gpt(
         # of activations at seq 4096 — batch 8 crowds the v5e's 16GB;
         # 4 leaves headroom and 16k tokens/step is plenty for MFU
         per_chip_batch, seq = 4, 4096
-        steps = steps if steps is not None else 15
     else:
         cfg = gpt_lib.GPT_TINY
         per_chip_batch, seq = 2, 128
-        steps = steps if steps is not None else 3
 
     if attention == "xla":
         from tf_operator_tpu.ops.attention import dot_product_attention
@@ -323,6 +351,22 @@ def bench_gpt(
         gpt_lib.synthetic_batch(rng, global_batch, seq, cfg)
     )
     state = trainer.init(rng, batch)
+    meta = {"global_batch": global_batch, "seq": seq, "cfg": cfg}
+    return trainer, state, batch, meta
+
+
+def bench_gpt(
+    on_tpu: bool, n_chips: int, attention: str = "flash",
+    steps: int | None = None,
+) -> dict:
+    """Long-context causal LM (GPT-small @ seq 4096): the shape class
+    where flash attention is load-bearing — the XLA path materializes
+    b*h*seq^2 f32 scores (>= fwd+bwd residency of several GB at this
+    config) while the kernel stays O(seq). attention="xla" is the
+    guarded A/B; an OOM there is itself the measurement."""
+    steps = steps if steps is not None else (15 if on_tpu else 3)
+    trainer, state, batch, meta = setup_gpt(on_tpu, n_chips, attention)
+    global_batch, seq, cfg = meta["global_batch"], meta["seq"], meta["cfg"]
     flops = transformer_step_flops(
         state.params, global_batch, seq, cfg, causal=True
     )
@@ -534,26 +578,43 @@ def _watchdog(seconds: float, what: str, likely: str):
     import os as _os
     import threading
 
+    lock = threading.Lock()
+    cancelled = [False]
+
     def fire():
-        print(
-            json.dumps(
-                {
-                    "metric": "bench_unavailable",
-                    "value": 0.0,
-                    "unit": "none",
-                    "vs_baseline": 0.0,
-                    "error": f"{what} did not finish within "
-                    f"{seconds:.0f}s — {likely}",
-                }
-            ),
-            flush=True,
-        )
-        _os._exit(3)
+        with lock:
+            # Timer.cancel() cannot stop a fire() already started, so
+            # the flag (set under the same lock) is the real guard —
+            # after cancel() returns, fire can never print
+            if cancelled[0]:
+                return
+            print(
+                json.dumps(
+                    {
+                        "metric": "bench_unavailable",
+                        "value": 0.0,
+                        "unit": "none",
+                        "vs_baseline": 0.0,
+                        "error": f"{what} did not finish within "
+                        f"{seconds:.0f}s — {likely}",
+                    }
+                ),
+                flush=True,
+            )
+            _os._exit(3)
 
     timer = threading.Timer(seconds, fire)
     timer.daemon = True
     timer.start()
-    return timer
+
+    class _Handle:
+        @staticmethod
+        def cancel() -> None:
+            with lock:
+                cancelled[0] = True
+            timer.cancel()
+
+    return _Handle()
 
 
 def main() -> None:

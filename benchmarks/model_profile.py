@@ -1,0 +1,237 @@
+"""Per-op TPU time breakdown for any model family's train step.
+
+Generalizes the r3 ResNet profile harness (the machinery behind
+PROFILE.md): run the exact bench.py configuration of a family under
+`jax.profiler.trace`, parse the .xplane.pb with xprof's op_profile
+converter, print time-by-category + top ops, write PROFILE_OPS.json.
+
+Usage:
+    python benchmarks/model_profile.py --model resnet [--batch 256]
+    python benchmarks/model_profile.py --model bert
+    python benchmarks/model_profile.py --model gpt
+    python benchmarks/model_profile.py --trace-dir /tmp/some_trace
+
+The per-family configs mirror bench.py so a profile explains the
+benchmark number it sits next to.
+"""
+
+from __future__ import annotations
+
+import argparse
+import glob
+import json
+import os
+import sys
+import tempfile
+import time
+
+# repo root on sys.path without PYTHONPATH: this image registers the
+# TPU backend via a plugin whose discovery breaks under PYTHONPATH
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def _profile_steps(trainer, state, batch, steps: int, trace_dir: str) -> float:
+    """Warm outside the trace, then `steps` single-step dispatches
+    inside it (single steps so the trace shows HLO ops, not one opaque
+    scan). Returns seconds/step."""
+    import jax
+
+    for _ in range(2):
+        state, m = trainer.step(state, batch)
+    float(m["loss"])
+    with jax.profiler.trace(trace_dir):
+        start = time.perf_counter()
+        for _ in range(steps):
+            state, m = trainer.step(state, batch)
+        float(m["loss"])
+        elapsed = time.perf_counter() - start
+    return elapsed / steps
+
+
+def _device_ctx():
+    """(on_tpu, n_chips) — the same device accounting bench.py uses, so
+    the profiled setup IS the benchmarked setup (per-chip batch scales
+    with the host's chip count)."""
+    import jax
+
+    devices = jax.devices()
+    return devices[0].platform == "tpu", len(devices)
+
+
+def _capture(setup_name: str, batch_size, steps: int, trace_dir: str) -> tuple:
+    """Generic family capture: bench.py's setup_{family} on this host's
+    real device count, so the profiled step IS the benchmarked step.
+    batch_size (resnet only) is the PER-CHIP batch override, exactly
+    like bench_resnet's batch_override."""
+    import bench
+
+    on_tpu, n_chips = _device_ctx()
+    setup = getattr(bench, f"setup_{setup_name}")
+    if setup_name == "resnet":
+        trainer, state, batch, meta = setup(
+            on_tpu, n_chips, batch_override=batch_size
+        )
+    else:
+        if batch_size is not None:
+            raise SystemExit("--per-chip-batch applies to resnet only; "
+                             "bert/gpt profile the exact bench.py config")
+        trainer, state, batch, meta = setup(on_tpu, n_chips)
+    sec = _profile_steps(trainer, state, batch, steps, trace_dir)
+    gb = meta["global_batch"]
+    rates = {"batch": gb}
+    if "seq" in meta:
+        rates.update(seq=meta["seq"], tokens_per_sec=gb * meta["seq"] / sec)
+    else:
+        rates["images_per_sec"] = gb / sec
+    return sec, rates
+
+
+CAPTURES = {
+    name: (lambda b, s, d, _n=name: _capture(_n, b, s, d))
+    for name in ("resnet", "bert", "gpt")
+}
+
+
+def parse_trace(trace_dir: str) -> dict:
+    """Extract per-op self-time from the xplane via xprof's converter."""
+    xplanes = glob.glob(
+        os.path.join(trace_dir, "**", "*.xplane.pb"), recursive=True
+    )
+    if not xplanes:
+        raise SystemExit(f"no .xplane.pb under {trace_dir}")
+    xplane = max(xplanes, key=os.path.getsize)
+
+    try:
+        from xprof.convert import raw_to_tool_data as rtd
+    except ImportError:
+        from tensorboard_plugin_profile.convert import (  # type: ignore
+            raw_to_tool_data as rtd,
+        )
+
+    data, _ = rtd.xspace_to_tool_data([xplane], "op_profile", {})
+    return json.loads(data) if isinstance(data, (str, bytes)) else data
+
+
+def walk_op_profile(profile: dict) -> tuple:
+    """-> (total_time_ps, [op dicts]) from the xprof op_profile tree.
+
+    Shape (xprof ≥2.x): byProgramExcludeIdle -> program node ->
+    category nodes -> op/fusion nodes; each node's metrics carry
+    rawTime (ps, self+children), flops (0..1 utilization), occurrences.
+    We account at the per-op level directly under each category — leaf
+    recursion is wrong here because fusion interiors carry ~zero
+    rawTime while the fusion node owns the measured time.
+    """
+    root = profile.get("byProgramExcludeIdle") or profile.get("byProgram")
+    if not root or not root.get("children"):
+        raise SystemExit(
+            "op_profile shape not recognized (no byProgramExcludeIdle "
+            f"children); top-level keys: {sorted(profile)}"
+        )
+    program = max(
+        root["children"], key=lambda n: n.get("metrics", {}).get("rawTime", 0)
+    )
+    total = program.get("metrics", {}).get("rawTime", 0)
+    if not total:
+        raise SystemExit("op_profile program node has zero rawTime")
+    ops = []
+    for category in program.get("children", []):
+        cat_name = category.get("name", "?")
+        for op in category.get("children", []):
+            metrics = op.get("metrics", {})
+            ops.append(
+                {
+                    "name": op.get("name", ""),
+                    "category": cat_name,
+                    "time_frac": metrics.get("rawTime", 0) / total,
+                    "flops_util": metrics.get("flops", 0.0),
+                    "occurrences": metrics.get("occurrences", 0),
+                }
+            )
+    if not ops:
+        raise SystemExit("op_profile program node has no category children")
+    return total, ops
+
+
+def main(argv=None) -> None:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--model", choices=sorted(CAPTURES), default="resnet")
+    ap.add_argument(
+        "--batch", "--per-chip-batch", dest="batch", type=int, default=None,
+        help="PER-CHIP batch override (resnet only; global batch = this "
+        "x chip count, same as bench_resnet's batch_override); default: "
+        "the family's bench.py config",
+    )
+    ap.add_argument(
+        "--steps", type=int, default=None,
+        help="steps to capture (default 8); with --trace-dir, the step "
+        "count the existing trace covers (omit if unknown)",
+    )
+    ap.add_argument("--out", default="PROFILE_OPS.json")
+    ap.add_argument(
+        "--trace-dir", default=None,
+        help="parse an existing trace instead of capturing a new one",
+    )
+    args = ap.parse_args(argv)
+
+    rates: dict = {}
+    if args.trace_dir:
+        # parsing a foreign trace: we don't know how many steps it
+        # covers unless the caller says so — never silently assume 8
+        trace_dir, step_time = args.trace_dir, None
+        steps = args.steps
+    else:
+        trace_dir = tempfile.mkdtemp(prefix=f"{args.model}_trace_")
+        steps = args.steps if args.steps is not None else 8
+        step_time, rates = CAPTURES[args.model](args.batch, steps, trace_dir)
+        rate = " ".join(f"{k}={v:.1f}" if isinstance(v, float) else f"{k}={v}"
+                        for k, v in rates.items())
+        print(f"step_time_ms={step_time * 1e3:.2f}  {rate}")
+
+    profile = parse_trace(trace_dir)
+    total_ps, ops = walk_op_profile(profile)
+    ops.sort(key=lambda op: -op["time_frac"])
+
+    by_cat: dict = {}
+    for op in ops:
+        by_cat[op["category"]] = by_cat.get(op["category"], 0.0) + op["time_frac"]
+
+    if steps:
+        print(f"device busy total: {total_ps / 1e9 / steps:.2f} ms/step "
+              f"over {steps} steps")
+    else:
+        print(f"device busy total: {total_ps / 1e9:.2f} ms (step count "
+              "unknown — pass --steps with --trace-dir for per-step)")
+    print("\n== time by category ==")
+    for cat, frac in sorted(by_cat.items(), key=lambda kv: -kv[1]):
+        print(f"{frac * 100:6.2f}%  {cat}")
+    print("\n== top 25 ops by self time ==")
+    for op in ops[:25]:
+        print(
+            f"{op['time_frac'] * 100:6.2f}%  "
+            f"util={op['flops_util'] * 100:5.1f}%  "
+            f"x{op['occurrences']:4d}  [{op['category']}] {op['name'][:90]}"
+        )
+
+    with open(args.out, "w") as f:
+        json.dump(
+            {
+                "model": args.model if not args.trace_dir else None,
+                "steps": steps,
+                "device_busy_ms_total": total_ps / 1e9,
+                "device_busy_ms_per_step": (
+                    total_ps / 1e9 / steps if steps else None
+                ),
+                "step_time_ms": step_time * 1e3 if step_time else None,
+                **rates,
+                "by_category": by_cat,
+                "top_ops": ops[:40],
+            },
+            f,
+            indent=1,
+        )
+    print(f"\nwrote {args.out}; raw trace in {trace_dir}")
+
+
+if __name__ == "__main__":
+    main()

@@ -1,201 +1,20 @@
-"""Profile the ResNet-50 train step on the TPU and print a per-op
-time breakdown.
+"""Profile the ResNet-50 train step (back-compat shim).
 
-VERDICT r2 #1 asked for profile-backed analysis of the MFU gap
-(29.6% measured vs the 40% bar). This script:
-
-1. runs the exact bench.py ResNet configuration (batch 256 @ 224,
-   single chip) under `jax.profiler.trace`,
-2. parses the captured .xplane.pb with xprof's raw-to-tool converter
-   (the machinery behind TensorBoard's op_profile view),
-3. prints the top ops by self time, grouped by category, plus the
-   device busy fraction,
-4. writes the table to PROFILE_OPS.json for PROFILE.md.
-
-Usage:  python benchmarks/resnet_profile.py [--batch 256] [--steps 8]
+The r3 harness behind PROFILE.md / PROFILE_OPS.json; the machinery now
+lives in benchmarks/model_profile.py, which profiles every family
+(--model resnet|bert|gpt) with the exact bench.py configurations.
+This entrypoint keeps the documented `python benchmarks/
+resnet_profile.py` invocation working, forwarding all flags.
 """
 
 from __future__ import annotations
 
-import argparse
-import glob
-import json
 import os
 import sys
-import tempfile
 
-# repo root on sys.path without PYTHONPATH: this image registers the
-# TPU backend via a plugin whose discovery breaks under PYTHONPATH
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
-
-def capture(batch_size: int, steps: int, trace_dir: str) -> float:
-    import jax
-    import optax
-
-    from tf_operator_tpu.models import resnet as resnet_lib
-    from tf_operator_tpu.parallel.mesh import MeshConfig, build_mesh
-    from tf_operator_tpu.parallel.sharding import CONV_RULES
-    from tf_operator_tpu.train import Trainer, classification_task
-
-    model = resnet_lib.ResNet50(num_classes=1000)
-    mesh = build_mesh(MeshConfig(dp=-1))
-    trainer = Trainer(
-        model, classification_task(model), optax.sgd(0.1, momentum=0.9),
-        mesh=mesh, rules=CONV_RULES,
-    )
-    rng = jax.random.PRNGKey(0)
-    batch = trainer.place_batch(
-        resnet_lib.synthetic_batch(rng, batch_size, 224, 1000)
-    )
-    state = trainer.init(rng, batch)
-    # compile + warm up OUTSIDE the trace; profile single steps so the
-    # trace shows individual HLO ops rather than one opaque scan loop
-    for _ in range(2):
-        state, m = trainer.step(state, batch)
-    float(m["loss"])
-
-    import time
-
-    with jax.profiler.trace(trace_dir):
-        start = time.perf_counter()
-        for _ in range(steps):
-            state, m = trainer.step(state, batch)
-        float(m["loss"])
-        elapsed = time.perf_counter() - start
-    return elapsed / steps
-
-
-def parse_trace(trace_dir: str) -> dict:
-    """Extract per-op self-time from the xplane via xprof's converter."""
-    xplanes = glob.glob(
-        os.path.join(trace_dir, "**", "*.xplane.pb"), recursive=True
-    )
-    if not xplanes:
-        raise SystemExit(f"no .xplane.pb under {trace_dir}")
-    xplane = max(xplanes, key=os.path.getsize)
-
-    try:
-        from xprof.convert import raw_to_tool_data as rtd
-    except ImportError:
-        from tensorboard_plugin_profile.convert import (  # type: ignore
-            raw_to_tool_data as rtd,
-        )
-
-    data, _ = rtd.xspace_to_tool_data([xplane], "op_profile", {})
-    return json.loads(data) if isinstance(data, (str, bytes)) else data
-
-
-def walk_op_profile(profile: dict) -> tuple:
-    """-> (total_time_ps, [op dicts]) from the xprof op_profile tree.
-
-    Shape (xprof ≥2.x): byProgramExcludeIdle -> program node ->
-    category nodes -> op/fusion nodes; each node's metrics carry
-    rawTime (ps, self+children), flops (0..1 utilization), occurrences.
-    We account at the per-op level directly under each category — leaf
-    recursion is wrong here because fusion interiors carry ~zero
-    rawTime while the fusion node owns the measured time.
-    """
-    root = profile.get("byProgramExcludeIdle") or profile.get("byProgram")
-    if not root or not root.get("children"):
-        raise SystemExit(
-            "op_profile shape not recognized (no byProgramExcludeIdle "
-            f"children); top-level keys: {sorted(profile)}"
-        )
-    program = max(
-        root["children"], key=lambda n: n.get("metrics", {}).get("rawTime", 0)
-    )
-    total = program.get("metrics", {}).get("rawTime", 0)
-    if not total:
-        raise SystemExit("op_profile program node has zero rawTime")
-    ops = []
-    for category in program.get("children", []):
-        cat_name = category.get("name", "?")
-        for op in category.get("children", []):
-            metrics = op.get("metrics", {})
-            ops.append(
-                {
-                    "name": op.get("name", ""),
-                    "category": cat_name,
-                    "time_frac": metrics.get("rawTime", 0) / total,
-                    "flops_util": metrics.get("flops", 0.0),
-                    "occurrences": metrics.get("occurrences", 0),
-                }
-            )
-    if not ops:
-        raise SystemExit("op_profile program node has no category children")
-    return total, ops
-
-
-def main() -> None:
-    ap = argparse.ArgumentParser()
-    ap.add_argument("--batch", type=int, default=256)
-    ap.add_argument(
-        "--steps", type=int, default=None,
-        help="steps to capture (default 8); with --trace-dir, the step "
-        "count the existing trace covers (omit if unknown)",
-    )
-    ap.add_argument("--out", default="PROFILE_OPS.json")
-    ap.add_argument(
-        "--trace-dir", default=None,
-        help="parse an existing trace instead of capturing a new one",
-    )
-    args = ap.parse_args()
-
-    if args.trace_dir:
-        # parsing a foreign trace: we don't know how many steps it
-        # covers unless the caller says so — never silently assume 8
-        trace_dir, step_time = args.trace_dir, None
-        steps = args.steps
-    else:
-        trace_dir = tempfile.mkdtemp(prefix="resnet_trace_")
-        steps = args.steps if args.steps is not None else 8
-        step_time = capture(args.batch, steps, trace_dir)
-        print(f"step_time_ms={step_time * 1e3:.2f}  "
-              f"images_per_sec={args.batch / step_time:.1f}")
-
-    profile = parse_trace(trace_dir)
-    total_ps, ops = walk_op_profile(profile)
-    ops.sort(key=lambda op: -op["time_frac"])
-
-    by_cat: dict = {}
-    for op in ops:
-        by_cat[op["category"]] = by_cat.get(op["category"], 0.0) + op["time_frac"]
-
-    if steps:
-        print(f"device busy total: {total_ps / 1e9 / steps:.2f} ms/step "
-              f"over {steps} steps")
-    else:
-        print(f"device busy total: {total_ps / 1e9:.2f} ms (step count "
-              "unknown — pass --steps with --trace-dir for per-step)")
-    print("\n== time by category ==")
-    for cat, frac in sorted(by_cat.items(), key=lambda kv: -kv[1]):
-        print(f"{frac * 100:6.2f}%  {cat}")
-    print("\n== top 25 ops by self time ==")
-    for op in ops[:25]:
-        print(
-            f"{op['time_frac'] * 100:6.2f}%  "
-            f"util={op['flops_util'] * 100:5.1f}%  "
-            f"x{op['occurrences']:4d}  [{op['category']}] {op['name'][:90]}"
-        )
-
-    with open(args.out, "w") as f:
-        json.dump(
-            {
-                "batch": args.batch,
-                "steps": steps,
-                "device_busy_ms_total": total_ps / 1e9,
-                "device_busy_ms_per_step": total_ps / 1e9 / steps if steps else None,
-                "step_time_ms": step_time * 1e3 if step_time else None,
-                "images_per_sec": args.batch / step_time if step_time else None,
-                "by_category": by_cat,
-                "top_ops": ops[:40],
-            },
-            f,
-            indent=1,
-        )
-    print(f"\nwrote {args.out}; raw trace in {trace_dir}")
-
+from benchmarks.model_profile import main  # noqa: E402
 
 if __name__ == "__main__":
-    main()
+    main(["--model", "resnet"] + sys.argv[1:])
