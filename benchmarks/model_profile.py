@@ -86,10 +86,7 @@ def _capture(setup_name: str, batch_size, steps: int, trace_dir: str) -> tuple:
     return sec, rates
 
 
-CAPTURES = {
-    name: (lambda b, s, d, _n=name: _capture(_n, b, s, d))
-    for name in ("resnet", "bert", "gpt")
-}
+FAMILIES = ("bert", "gpt", "resnet")
 
 
 def parse_trace(trace_dir: str) -> dict:
@@ -155,7 +152,7 @@ def walk_op_profile(profile: dict) -> tuple:
 
 def main(argv=None) -> None:
     ap = argparse.ArgumentParser()
-    ap.add_argument("--model", choices=sorted(CAPTURES), default="resnet")
+    ap.add_argument("--model", choices=FAMILIES, default="resnet")
     ap.add_argument(
         "--batch", "--per-chip-batch", dest="batch", type=int, default=None,
         help="PER-CHIP batch override (resnet only; global batch = this "
@@ -183,7 +180,7 @@ def main(argv=None) -> None:
     else:
         trace_dir = tempfile.mkdtemp(prefix=f"{args.model}_trace_")
         steps = args.steps if args.steps is not None else 8
-        step_time, rates = CAPTURES[args.model](args.batch, steps, trace_dir)
+        step_time, rates = _capture(args.model, args.batch, steps, trace_dir)
         rate = " ".join(f"{k}={v:.1f}" if isinstance(v, float) else f"{k}={v}"
                         for k, v in rates.items())
         print(f"step_time_ms={step_time * 1e3:.2f}  {rate}")

@@ -5,6 +5,12 @@ lives in benchmarks/model_profile.py, which profiles every family
 (--model resnet|bert|gpt) with the exact bench.py configurations.
 This entrypoint keeps the documented `python benchmarks/
 resnet_profile.py` invocation working, forwarding all flags.
+
+Semantics change vs r3's standalone script: --batch is now the
+PER-CHIP batch (global = batch x chip count), matching bench_resnet's
+batch_override so the profile tracks the benchmark configuration.
+Identical on single-chip hosts — where every committed
+PROFILE_OPS.json so far was captured.
 """
 
 from __future__ import annotations

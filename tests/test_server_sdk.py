@@ -664,6 +664,17 @@ class TestSdkCli:
             assert main(base + ["logs", "mnist-tpu", "--master"]) == 0
             out = capsys.readouterr().out
             assert "hello" in out
+            # watch: polling path over the wire; a terminal condition
+            # ends the stream
+            with server.store.lock:
+                key = ("tfjobs", "kubeflow", "mnist-tpu")
+                obj = server.store.objects[key]
+                obj["status"] = {"conditions": [{
+                    "type": "Succeeded", "status": "True", "reason": "done",
+                }]}
+            assert main(base + ["watch", "mnist-tpu", "--timeout", "10"]) == 0
+            out = capsys.readouterr().out
+            assert "Succeeded" in out
             assert main(base + ["delete", "mnist-tpu"]) == 0
             assert main(base + ["get"]) == 0  # list: now empty
             # kubectl-style single-line error + exit 1, not a traceback

@@ -50,6 +50,12 @@ def main(argv=None) -> int:
     p_logs.add_argument("--master", action="store_true",
                         help="only the master/chief/worker-0 replica")
 
+    p_watch = sub.add_parser(
+        "watch", help="stream status transitions until terminal/timeout"
+    )
+    p_watch.add_argument("name", nargs="?")
+    p_watch.add_argument("--timeout", type=float, default=600.0)
+
     p_delete = sub.add_parser("delete", help="delete a TFJob")
     p_delete.add_argument("name")
 
@@ -81,6 +87,14 @@ def _run(args) -> int:
         conditions = job.status.conditions
         status = conditions[-1].type.value if conditions else "Unknown"
         print(f"{args.name}: {status}")
+    elif args.verb == "watch":
+        from .watch import format_event, watch
+
+        for event in watch(
+            client.substrate, namespace=args.namespace, name=args.name,
+            timeout_seconds=args.timeout,
+        ):
+            print(format_event(event), flush=True)
     elif args.verb == "logs":
         for name, text in client.get_logs(
             args.name, master=args.master
