@@ -440,3 +440,46 @@ class TestMosaicLowering:
         )
         args = (q, q, q) + ((mask,) if masked else ())
         jax.jit(grad).trace(*args).lower(lowering_platforms=("tpu",))
+
+
+class TestNonPowerOfTwoSeq:
+    """supports() promises ANY seq % 128 == 0 maps onto the grid via
+    _pick_block shrinking to a divisor (e.g. 640 -> block 128); pin
+    value+gradient parity at such a length so the claim stays true."""
+
+    def test_seq_640_matches_reference(self):
+        from tf_operator_tpu.ops.pallas.flash_attention import _pick_block
+
+        assert supports(640, 640, 128)
+        assert _pick_block(640, 512) == 128  # shrinks to a divisor
+
+        rng = jax.random.PRNGKey(3)
+        b, s, h, d = 2, 640, 2, 128
+        q, k, v = (
+            jax.random.normal(key, (b, s, h, d), jnp.float32)
+            for key in jax.random.split(rng, 3)
+        )
+
+        def flash_loss(q, k, v):
+            return (flash_attention(q, k, v) ** 2).sum()
+
+        def ref_loss(q, k, v):
+            return (dot_product_attention(q, k, v) ** 2).sum()
+
+        f_val, f_grads = jax.value_and_grad(flash_loss, argnums=(0, 1, 2))(q, k, v)
+        r_val, r_grads = jax.value_and_grad(ref_loss, argnums=(0, 1, 2))(q, k, v)
+        np.testing.assert_allclose(float(f_val), float(r_val), rtol=2e-4)
+        for fg, rg in zip(f_grads, r_grads):
+            np.testing.assert_allclose(
+                np.asarray(fg), np.asarray(rg), atol=2e-4, rtol=2e-4
+            )
+
+    def test_lowering_at_640(self):
+        q = jax.ShapeDtypeStruct((2, 640, 2, 128), jnp.bfloat16)
+
+        def loss(q, k, v):
+            out = flash_attention(q, k, v, interpret=False)
+            return (out.astype(jnp.float32) ** 2).sum()
+
+        grad = jax.grad(loss, argnums=(0, 1, 2))
+        jax.jit(grad).trace(q, q, q).lower(lowering_platforms=("tpu",))

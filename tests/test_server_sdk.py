@@ -664,8 +664,8 @@ class TestSdkCli:
             assert main(base + ["logs", "mnist-tpu", "--master"]) == 0
             out = capsys.readouterr().out
             assert "hello" in out
-            # watch: polling path over the wire; a terminal condition
-            # ends the stream
+            # watch over the wire (KubeSubstrate's subscribe path —
+            # a real chunked watch stream); a terminal condition ends it
             with server.store.lock:
                 key = ("tfjobs", "kubeflow", "mnist-tpu")
                 obj = server.store.objects[key]

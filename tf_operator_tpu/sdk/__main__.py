@@ -4,6 +4,7 @@ kubectl + the python client; this gives the same verbs in one tool):
     python -m tf_operator_tpu.sdk create -f examples/v1/mnist-tpu.yaml
     python -m tf_operator_tpu.sdk get mnist-tpu -n kubeflow
     python -m tf_operator_tpu.sdk wait mnist-tpu --timeout 600
+    python -m tf_operator_tpu.sdk watch mnist-tpu
     python -m tf_operator_tpu.sdk logs mnist-tpu --master
     python -m tf_operator_tpu.sdk delete mnist-tpu
 
@@ -90,6 +91,8 @@ def _run(args) -> int:
     elif args.verb == "watch":
         from .watch import format_event, watch
 
+        if args.name:
+            client.get(args.name)  # fail fast on a misspelled name
         for event in watch(
             client.substrate, namespace=args.namespace, name=args.name,
             timeout_seconds=args.timeout,
