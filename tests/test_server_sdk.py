@@ -681,5 +681,12 @@ class TestSdkCli:
             assert main(base + ["get", "nosuchjob"]) == 1
             err = capsys.readouterr().err
             assert "error:" in err and "Traceback" not in err
+            # watch fails fast on an unknown name (no 600s hang)...
+            assert main(base + ["watch", "nosuchjob", "--timeout", "30"]) == 1
+            assert "error:" in capsys.readouterr().err
+            # ...unless watch-before-create is requested explicitly
+            assert main(base + [
+                "watch", "nosuchjob", "--allow-missing", "--timeout", "1",
+            ]) == 0
         finally:
             server.stop()

@@ -56,6 +56,11 @@ def main(argv=None) -> int:
     )
     p_watch.add_argument("name", nargs="?")
     p_watch.add_argument("--timeout", type=float, default=600.0)
+    p_watch.add_argument(
+        "--allow-missing", action="store_true",
+        help="don't fail if the job doesn't exist yet — watch for its "
+        "creation (the library watch() semantics)",
+    )
 
     p_delete = sub.add_parser("delete", help="delete a TFJob")
     p_delete.add_argument("name")
@@ -91,8 +96,10 @@ def _run(args) -> int:
     elif args.verb == "watch":
         from .watch import format_event, watch
 
-        if args.name:
-            client.get(args.name)  # fail fast on a misspelled name
+        if args.name and not args.allow_missing:
+            # fail fast on a misspelled name (kubectl behavior);
+            # --allow-missing opts into watch-before-create instead
+            client.get(args.name)
         for event in watch(
             client.substrate, namespace=args.namespace, name=args.name,
             timeout_seconds=args.timeout,
