@@ -1,0 +1,138 @@
+"""hack/run_workflow.py — the CI DAG executor (the Argo/Prow analog).
+
+Hermetic: steps are tiny shell/python commands; asserts topo ordering,
+dep-failure skipping, flake retries, timeouts, --only closure, cycle
+detection, and the JUnit + CI_RUN.json artifact contract.
+"""
+
+import json
+import os
+import sys
+import xml.etree.ElementTree as ET
+
+import pytest
+import yaml
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+
+from hack.run_workflow import execute, load_workflow  # noqa: E402
+
+
+def make_workflow(tmp_path, steps):
+    path = tmp_path / "wf.yaml"
+    path.write_text(yaml.safe_dump({"name": "wf", "steps": steps}))
+    return str(path)
+
+
+def run(tmp_path, steps, only=None, parallel=1):
+    name, loaded = load_workflow(make_workflow(tmp_path, steps), only)
+    artifacts = str(tmp_path / "artifacts")
+    ok = execute(name, loaded, artifacts, parallel)
+    summary = json.load(open(os.path.join(artifacts, "CI_RUN.json")))
+    return ok, summary, artifacts
+
+
+class TestWorkflowRunner:
+    def test_deps_order_and_artifacts(self, tmp_path):
+        marker = tmp_path / "order.txt"
+        steps = [
+            {"name": "b", "command": f"sh -c 'echo b >> {marker}'",
+             "deps": ["a"]},
+            {"name": "a", "command": f"sh -c 'echo a >> {marker}'"},
+        ]
+        ok, summary, artifacts = run(tmp_path, steps)
+        assert ok and summary["passed"]
+        assert marker.read_text().split() == ["a", "b"]
+        for name in ("a", "b"):
+            suite = ET.parse(
+                os.path.join(artifacts, f"junit_{name}.xml")
+            ).getroot()
+            assert suite.get("failures") == "0"
+
+    def test_failed_dep_skips_dependents(self, tmp_path):
+        steps = [
+            {"name": "bad", "command": "sh -c 'exit 3'"},
+            {"name": "child", "command": "true", "deps": ["bad"]},
+            {"name": "grandchild", "command": "true", "deps": ["child"]},
+            {"name": "unrelated", "command": "true"},
+        ]
+        ok, summary, artifacts = run(tmp_path, steps)
+        assert not ok
+        status = {s["name"]: s["status"] for s in summary["steps"]}
+        assert status == {
+            "bad": "failed", "child": "skipped",
+            "grandchild": "skipped", "unrelated": "passed",
+        }
+        # skipped steps still get their junit (dashboard contract)
+        suite = ET.parse(
+            os.path.join(artifacts, "junit_child.xml")
+        ).getroot()
+        assert suite.get("failures") == "1"
+
+    def test_flake_retry_passes(self, tmp_path):
+        flag = tmp_path / "flaky.flag"
+        cmd = (
+            f"sh -c 'if [ -f {flag} ]; then exit 0; "
+            f"else touch {flag}; exit 1; fi'"
+        )
+        ok, summary, _ = run(
+            tmp_path, [{"name": "flaky", "command": cmd, "retries": 1}]
+        )
+        assert ok
+        step = summary["steps"][0]
+        assert step["status"] == "passed" and step["attempts"] == 2
+
+    def test_timeout_fails_step(self, tmp_path):
+        ok, summary, artifacts = run(
+            tmp_path,
+            [{"name": "slow", "command": "sleep 30", "timeout": 1}],
+        )
+        assert not ok
+        assert summary["steps"][0]["status"] == "failed"
+        log = open(os.path.join(artifacts, "slow.log")).read()
+        assert "TIMEOUT" in log
+
+    def test_only_keeps_transitive_deps(self, tmp_path):
+        steps = [
+            {"name": "base", "command": "true"},
+            {"name": "mid", "command": "true", "deps": ["base"]},
+            {"name": "leaf", "command": "true", "deps": ["mid"]},
+            {"name": "other", "command": "true"},
+        ]
+        ok, summary, _ = run(tmp_path, steps, only=["leaf"])
+        assert ok
+        assert {s["name"] for s in summary["steps"]} == {
+            "base", "mid", "leaf",
+        }
+
+    def test_cycle_rejected(self, tmp_path):
+        steps = [
+            {"name": "x", "command": "true", "deps": ["y"]},
+            {"name": "y", "command": "true", "deps": ["x"]},
+        ]
+        with pytest.raises(SystemExit, match="cycle"):
+            load_workflow(make_workflow(tmp_path, steps), None)
+
+    def test_unknown_dep_rejected(self, tmp_path):
+        with pytest.raises(SystemExit, match="unknown deps"):
+            load_workflow(
+                make_workflow(
+                    tmp_path,
+                    [{"name": "x", "command": "true", "deps": ["ghost"]}],
+                ),
+                None,
+            )
+
+    def test_parallel_independent_steps(self, tmp_path):
+        # two 1-second sleeps with --parallel 2 should overlap
+        import time
+
+        steps = [
+            {"name": "s1", "command": "sleep 1"},
+            {"name": "s2", "command": "sleep 1"},
+        ]
+        start = time.monotonic()
+        ok, _, _ = run(tmp_path, steps, parallel=2)
+        elapsed = time.monotonic() - start
+        assert ok
+        assert elapsed < 3.5, f"no overlap: {elapsed:.1f}s"
