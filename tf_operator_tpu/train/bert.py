@@ -102,17 +102,35 @@ def main(argv=None) -> int:
     state, metrics = trainer.step(state, trainer.place_batch(sample))
     float(metrics["loss"])
 
+    from .input_pipeline import InputPipeline, synthetic_source
     from .profiling import StepProfiler
 
     profiler = StepProfiler(args.profile_dir, args.steps, window=(0, 5))
     start = time.perf_counter()
     try:
-        for step in range(args.steps):
-            profiler.before_step(step)
-            state, metrics = trainer.step(state, trainer.place_batch(sample))
-            profiler.after_step(step, drain=lambda: float(metrics["loss"]))
-            if (step + 1) % args.log_every == 0:
-                logger.info("step %d loss=%.4f", int(state.step), float(metrics["loss"]))
+        # fresh per-step synthetic batches through the host input
+        # pipeline: prep + placement overlap the previous step's
+        # compute, and loss tracks progress rather than single-batch
+        # memorization
+        with InputPipeline(
+            source=synthetic_source(
+                lambda key: bert_lib.synthetic_batch(
+                    key, args.batch_size, args.seq_len, cfg
+                )
+            ),
+            trainer=trainer, depth=2, steps=args.steps,
+        ) as pipe:
+            for step, batch in enumerate(pipe):
+                profiler.before_step(step)
+                state, metrics = trainer.step(state, batch)
+                profiler.after_step(
+                    step, drain=lambda: float(metrics["loss"])
+                )
+                if (step + 1) % args.log_every == 0:
+                    logger.info(
+                        "step %d loss=%.4f", int(state.step),
+                        float(metrics["loss"]),
+                    )
         loss = float(metrics["loss"])  # forces the chain
     finally:
         profiler.close()

@@ -104,19 +104,27 @@ def main(argv=None) -> int:
     state, metrics = trainer.step(state, trainer.place_batch(sample))
     float(metrics["loss"])  # compile + warm
 
+    from .input_pipeline import InputPipeline, synthetic_source
+
     start = time.perf_counter()
-    for step in range(args.steps):
-        batch = trainer.place_batch(
-            gpt_lib.synthetic_batch(
-                jax.random.fold_in(rng, step), args.batch_size, args.seq_len,
-                cfg,
+    # host batch prep + device placement overlap the previous step's
+    # compute (train/input_pipeline.py: background producer, depth-2
+    # double buffering) instead of running synchronously between steps
+    with InputPipeline(
+        source=synthetic_source(
+            lambda key: gpt_lib.synthetic_batch(
+                key, args.batch_size, args.seq_len, cfg
             )
-        )
-        state, metrics = trainer.step(state, batch)
-        if (step + 1) % args.log_every == 0:
-            logger.info(
-                "step %d loss=%.4f", int(state.step), float(metrics["loss"])
-            )
+        ),
+        trainer=trainer, depth=2, steps=args.steps,
+    ) as pipe:
+        for step, batch in enumerate(pipe):
+            state, metrics = trainer.step(state, batch)
+            if (step + 1) % args.log_every == 0:
+                logger.info(
+                    "step %d loss=%.4f", int(state.step),
+                    float(metrics["loss"]),
+                )
     loss = float(metrics["loss"])
     elapsed = time.perf_counter() - start
     tokens = args.batch_size * args.seq_len * args.steps
