@@ -80,7 +80,12 @@ class KubeSubstrate:
         self._ssl = ssl_context
         self._subscribers: Dict[str, List[Callable]] = {}
         self._sub_lock = threading.Lock()
-        self._watch_threads: List[threading.Thread] = []
+        self._watch_threads: Dict[str, threading.Thread] = {}
+        # per-kind generation: bumped on each watch-thread start, so a
+        # stale thread (last subscriber left, then a new one arrived
+        # and started a replacement) can NEVER deliver or touch shared
+        # watch state again, even if it wakes mid-stream later
+        self._watch_gen: Dict[str, int] = {}
         self._watch_rv: Dict[str, str] = {}  # last delivered resourceVersion
         # last raw object per (kind, ns/name), so a relist after 410 can
         # synthesize DELETED events for objects that vanished during the
@@ -539,19 +544,27 @@ class KubeSubstrate:
     def subscribe(self, kind: str, callback: Callable) -> None:
         with self._sub_lock:
             self._subscribers.setdefault(kind, []).append(callback)
-            first = len(self._subscribers[kind]) == 1
-        if first:
+            existing = self._watch_threads.get(kind)
+            start = len(self._subscribers[kind]) == 1 and (
+                existing is None or not existing.is_alive()
+            )
+            if start:
+                self._watch_gen[kind] = self._watch_gen.get(kind, 0) + 1
+                gen = self._watch_gen[kind]
+        if start:
             thread = threading.Thread(
-                target=self._watch_loop, args=(kind,),
+                target=self._watch_loop, args=(kind, gen),
                 name=f"watch-{kind}", daemon=True,
             )
             thread.start()
-            self._watch_threads.append(thread)
+            with self._sub_lock:
+                self._watch_threads[kind] = thread
 
     def unsubscribe(self, kind: str, callback: Callable) -> None:
-        """Remove a watch callback. The kind's watch thread is left
-        running (it is shared and cheap when idle); only the callback
-        stops receiving events."""
+        """Remove a watch callback. When the last subscriber for a kind
+        goes away its watch thread exits at the next loop iteration
+        (instead of reconnect-retrying forever against a server that
+        may already be gone); a later subscribe starts a fresh one."""
         with self._sub_lock:
             callbacks = self._subscribers.get(kind, [])
             if callback in callbacks:
@@ -588,7 +601,14 @@ class KubeSubstrate:
         self._watch_rv[kind] = rv
         return rv
 
-    def _watch_loop(self, kind: str) -> None:
+    def _stale(self, kind: str, gen: int) -> bool:
+        with self._sub_lock:
+            return (
+                self._watch_gen.get(kind) != gen
+                or not self._subscribers.get(kind)
+            )
+
+    def _watch_loop(self, kind: str, gen: int) -> None:
         """Chunked watch stream with resourceVersion resume — the
         informer ListWatch + reflector role (reference
         unstructured/informer.go:50-62). Reconnects resume from the last
@@ -596,6 +616,11 @@ class KubeSubstrate:
         disconnect; a 410 Gone (expired version) triggers a full relist.
         """
         while not self._stop.is_set():
+            if self._stale(kind, gen):
+                # last subscriber gone (or a replacement thread was
+                # started): stop rather than retrying — and possibly
+                # double-delivering — forever
+                return
             try:
                 rv = self._watch_rv.get(kind)
                 if rv is None:
@@ -612,7 +637,7 @@ class KubeSubstrate:
                     req, timeout=330.0, context=self._ssl
                 ) as resp:
                     for line in resp:
-                        if self._stop.is_set():
+                        if self._stop.is_set() or self._stale(kind, gen):
                             return
                         self._dispatch(kind, line)
             except _WatchGone:
