@@ -603,10 +603,19 @@ class KubeSubstrate:
 
     def _stale(self, kind: str, gen: int) -> bool:
         with self._sub_lock:
-            return (
+            stale = (
                 self._watch_gen.get(kind) != gen
                 or not self._subscribers.get(kind)
             )
+            if stale and (
+                self._watch_threads.get(kind) is threading.current_thread()
+            ):
+                # commit to exiting UNDER the lock: a concurrent
+                # subscribe must never see a still-alive thread that
+                # has already decided to die (it would skip starting a
+                # replacement and the new subscriber would get nothing)
+                del self._watch_threads[kind]
+            return stale
 
     def _watch_loop(self, kind: str, gen: int) -> None:
         """Chunked watch stream with resourceVersion resume — the

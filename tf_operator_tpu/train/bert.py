@@ -61,7 +61,9 @@ def main(argv=None) -> int:
 
     from ..models import bert as bert_lib
     from ..parallel.mesh import MeshConfig, build_mesh, mesh_summary
-    from ..train.trainer import Trainer, mlm_task, warmup_cosine_lr
+    from ..train.trainer import (
+        Trainer, held_out_eval, mlm_task, warmup_cosine_lr,
+    )
 
     cfg = {
         "base": bert_lib.BERT_BASE,
@@ -140,6 +142,14 @@ def main(argv=None) -> int:
     logger.info(
         "tokens/sec/chip: %.1f (loss %.4f)", tokens / elapsed / n_chips, loss
     )
+    ev = held_out_eval(
+        trainer, state,
+        lambda key: bert_lib.synthetic_batch(
+            key, args.batch_size, args.seq_len, cfg
+        ),
+        rng,
+    )
+    logger.info("eval loss %.4f (ppl %.1f)", ev["loss"], ev["perplexity"])
     if args.checkpoint_dir:
         trainer.save(state)
     return 0

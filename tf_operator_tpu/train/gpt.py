@@ -64,7 +64,9 @@ def main(argv=None) -> int:
 
     from ..models import gpt as gpt_lib
     from ..parallel.mesh import MeshConfig, build_mesh, mesh_summary
-    from ..train.trainer import Trainer, causal_lm_task, warmup_cosine_lr
+    from ..train.trainer import (
+        Trainer, causal_lm_task, held_out_eval, warmup_cosine_lr,
+    )
 
     cfg = {"small": gpt_lib.GPT_SMALL, "tiny": gpt_lib.GPT_TINY}[args.preset]
     if args.seq_len > cfg.max_seq_len or args.remat:
@@ -132,6 +134,14 @@ def main(argv=None) -> int:
     logger.info(
         "tokens/sec/chip: %.1f (loss %.4f)", tokens / elapsed / n_chips, loss
     )
+    ev = held_out_eval(
+        trainer, state,
+        lambda key: gpt_lib.synthetic_batch(
+            key, args.batch_size, args.seq_len, cfg
+        ),
+        rng,
+    )
+    logger.info("eval loss %.4f (ppl %.1f)", ev["loss"], ev["perplexity"])
     if args.checkpoint_dir:
         trainer.save(state)
 

@@ -55,7 +55,9 @@ def main(argv=None) -> int:
     from ..models import moe as moe_lib
     from ..parallel.mesh import MeshConfig, build_mesh, mesh_summary
     from ..parallel.sharding import MOE_RULES
-    from ..train.trainer import Trainer, moe_task, warmup_cosine_lr
+    from ..train.trainer import (
+        Trainer, held_out_eval, moe_task, warmup_cosine_lr,
+    )
 
     cfg = {
         "tiny": moe_lib.MOE_TINY,
@@ -121,6 +123,17 @@ def main(argv=None) -> int:
     n_chips = len(jax.devices())
     logger.info(
         "tokens/sec/chip: %.1f (loss %.4f)", tokens / elapsed / n_chips, loss
+    )
+    ev = held_out_eval(
+        trainer, state,
+        lambda key: moe_lib.synthetic_batch(
+            key, args.batch_size, args.seq_len, cfg
+        ),
+        rng,
+    )
+    logger.info(
+        "eval loss %.4f (ppl %.1f, router_aux %.5f)",
+        ev["loss"], ev["perplexity"], ev["router_aux"],
     )
     if args.checkpoint_dir:
         trainer.save(state)

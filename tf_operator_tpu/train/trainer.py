@@ -110,6 +110,28 @@ def causal_lm_task(model) -> Task:
     return Task(apply_fn=model.apply, loss_fn=loss_fn)
 
 
+HELD_OUT_FOLD = 2**31 - 1
+
+
+def held_out_eval(trainer, state, make_batch, rng) -> Dict[str, float]:
+    """End-of-run eval on a batch the training stream never saw: the
+    batch key is fold_in(rng, HELD_OUT_FOLD), unreachable by per-step
+    folds 0..steps-1 for any practical step count. Returns the task's
+    eval metrics as floats plus 'perplexity' (clamped exp)."""
+    import math
+
+    import jax as _jax
+
+    batch = trainer.place_batch(
+        make_batch(_jax.random.fold_in(rng, HELD_OUT_FOLD))
+    )
+    metrics = {
+        k: float(v) for k, v in trainer.evaluate(state, batch).items()
+    }
+    metrics["perplexity"] = math.exp(min(metrics["loss"], 20.0))
+    return metrics
+
+
 def moe_task(model) -> Task:
     """Causal LM with router auxiliary losses: the MoE blocks sow their
     (already cfg.router_aux_weight-scaled) load-balancing terms into
