@@ -726,3 +726,42 @@ class TestEvaluate:
         # eval must not touch the running stats (train=False path)
         after = jax.tree_util.tree_leaves(state.batch_stats)[0]
         np.testing.assert_array_equal(np.asarray(before), np.asarray(after))
+
+
+class TestFusedCrossEntropyRobustness:
+    """Extreme-magnitude logits: the lse max-subtraction must keep the
+    fused loss and its gradients finite where a naive exp would
+    overflow, and still match the (float64-free) stable reference."""
+
+    @pytest.mark.parametrize("scale", [1e3, 1e4])
+    def test_large_logits_finite_and_correct(self, scale):
+        from tf_operator_tpu.ops.losses import weighted_mean_xent
+
+        rng = jax.random.PRNGKey(0)
+        logits = jax.random.normal(rng, (4, 7, 65), jnp.float32) * scale
+        labels = jax.random.randint(jax.random.PRNGKey(1), (4, 7), 0, 65)
+
+        loss, grads = jax.value_and_grad(
+            lambda x: weighted_mean_xent(x, labels)
+        )(logits)
+        assert np.isfinite(float(loss))
+        assert bool(jnp.all(jnp.isfinite(grads)))
+
+        # reference via log_softmax (also max-stabilized internally)
+        ref = -jnp.take_along_axis(
+            jax.nn.log_softmax(logits, axis=-1), labels[..., None], -1
+        )[..., 0].mean()
+        np.testing.assert_allclose(float(loss), float(ref), rtol=1e-5)
+
+    def test_onehot_certainty_zero_loss(self):
+        """A logit distribution fully committed to the label: loss -> 0
+        and gradient -> softmax - onehot -> 0 (no NaN from exp(0-0))."""
+        from tf_operator_tpu.ops.losses import weighted_mean_xent
+
+        labels = jnp.array([[2, 0]])
+        logits = jax.nn.one_hot(labels, 5) * 1e4
+        loss, grads = jax.value_and_grad(
+            lambda x: weighted_mean_xent(x, labels)
+        )(logits)
+        assert float(loss) == 0.0
+        np.testing.assert_allclose(np.asarray(grads), 0.0, atol=1e-6)
