@@ -765,3 +765,48 @@ class TestFusedCrossEntropyRobustness:
         )(logits)
         assert float(loss) == 0.0
         np.testing.assert_allclose(np.asarray(grads), 0.0, atol=1e-6)
+
+
+class TestStepProfiler:
+    """train/profiling.py: window clamping, trace capture on the CPU
+    backend, and the close() safety net for early-ending loops."""
+
+    def test_fit_profile_writes_trace(self, tmp_path):
+        model = mnist_lib.MnistCNN()
+        rng = jax.random.PRNGKey(12)
+        sample = mnist_lib.synthetic_batch(rng, 16)
+        trainer = Trainer(model, classification_task(model), optax.adam(1e-3))
+        state = trainer.init(rng, sample)
+
+        def batches():
+            while True:
+                yield sample
+
+        trace_dir = tmp_path / "trace"
+        state, _ = trainer.fit(
+            state, batches(), steps=5, log_every=5,
+            profile_dir=str(trace_dir), profile_window=(1, 3),
+        )
+        plane = list(trace_dir.rglob("*.xplane.pb"))
+        assert plane, f"no xplane under {trace_dir}"
+
+    def test_close_stops_early_ended_window(self, tmp_path):
+        from tf_operator_tpu.train.profiling import StepProfiler
+
+        prof = StepProfiler(str(tmp_path / "t"), total_steps=10, window=(0, 8))
+        prof.before_step(0)  # trace active
+        # loop aborts at step 1 — close() must stop the process-global
+        # trace, or every later profiled run raises "already active"
+        prof.close()
+        prof2 = StepProfiler(str(tmp_path / "t2"), total_steps=2, window=(0, 1))
+        prof2.before_step(0)  # would raise if the first trace leaked
+        prof2.after_step(0)
+        assert list((tmp_path / "t2").rglob("*.xplane.pb"))
+
+    def test_none_dir_noop(self):
+        from tf_operator_tpu.train.profiling import StepProfiler
+
+        prof = StepProfiler(None, total_steps=5)
+        prof.before_step(0)
+        prof.after_step(4)
+        prof.close()  # all no-ops, nothing raised
