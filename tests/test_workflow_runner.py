@@ -143,3 +143,37 @@ class TestWorkflowRunner:
         assert step_sum / wall > 1.5, (
             f"no overlap: steps sum {step_sum:.1f}s vs wall {wall:.1f}s"
         )
+
+
+class TestClusterHelper:
+    """hack/cluster.py: the GKE/kind lifecycle analog must probe its
+    tooling and explain machine-readably instead of pretending, and
+    `status` must always succeed."""
+
+    def _run(self, *argv):
+        import subprocess
+
+        proc = subprocess.run(
+            [sys.executable, os.path.join(
+                os.path.dirname(__file__), "..", "hack", "cluster.py",
+            ), *argv],
+            capture_output=True, text=True, timeout=60,
+        )
+        return proc
+
+    def test_status_reports_tooling(self):
+        proc = self._run("status")
+        assert proc.returncode == 0, proc.stderr
+        report = json.loads(proc.stdout)
+        # every backend's tooling is probed and reported
+        assert "kind" in json.dumps(report) and "gcloud" in json.dumps(report)
+
+    def test_create_without_tooling_explains(self):
+        import shutil as _shutil
+
+        if _shutil.which("kind"):
+            pytest.skip("kind present: the missing-tool path can't fire")
+        proc = self._run("create", "--backend", "kind", "--name", "x")
+        assert proc.returncode != 0
+        payload = json.loads(proc.stdout.strip().splitlines()[-1])
+        assert payload["ok"] is False and "kind" in payload["reason"]
