@@ -810,3 +810,32 @@ class TestStepProfiler:
         prof.before_step(0)
         prof.after_step(4)
         prof.close()  # all no-ops, nothing raised
+
+
+class TestAsyncCheckpointAbort:
+    def test_aborted_fit_still_flushes_async_save(self, tmp_path):
+        """An exception mid-loop AFTER an async save must not lose the
+        checkpoint: fit's finally block settles the in-flight write, so
+        restore sees the newest complete step."""
+        model = mnist_lib.MnistCNN()
+        rng = jax.random.PRNGKey(13)
+        sample = mnist_lib.synthetic_batch(rng, 16)
+        trainer = Trainer(
+            model, classification_task(model), optax.adam(1e-3),
+            checkpoint_dir=str(tmp_path / "abort-ckpt"),
+        )
+        state = trainer.init(rng, sample)
+
+        def batches():
+            yield sample
+            yield sample
+            raise RuntimeError("producer died")
+
+        with pytest.raises(RuntimeError, match="producer died"):
+            trainer.fit(
+                state, batches(), steps=5, log_every=5, checkpoint_every=2,
+            )
+        fresh = trainer.init(jax.random.PRNGKey(0), sample)
+        restored = trainer.restore(fresh)
+        assert restored is not None
+        assert int(restored.step) == 2  # the async save survived the abort
