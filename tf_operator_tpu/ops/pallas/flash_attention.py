@@ -553,12 +553,15 @@ def supports(seq_q: int, seq_kv: int, head_dim: int,
              block_q: int = DEFAULT_BLOCK_Q,
              block_kv: int = DEFAULT_BLOCK_KV) -> bool:
     """Shapes the kernel handles: any seq%128==0 (blocks shrink to a
-    divisor of the sequence), head_dim 64 through lane padding (see
-    module docstring), head_dim%128==0 native.
-    Measured on v5e at head_dim 128 with 512/1024 blocks: parity with
-    XLA at seq <= 4096, then the XLA path hits its O(seq^2)
-    materialization cliff while this kernel stays flat — 55x faster
-    non-causal and ~130x causal at seq 8192 (forward)."""
+    divisor of the sequence, tests/test_attention.py seq-640 case),
+    head_dim 64 through lane padding (see module docstring),
+    head_dim%128==0 native.
+    Early v5e forward-only measurements (r1, 512/1024 blocks, hd 128):
+    parity with XLA at seq <= 4096, then the XLA path hits its
+    O(seq^2) materialization cliff while this kernel stays flat (55x
+    non-causal / ~130x causal at seq 8192). Current fwd+bwd numbers
+    live in FLASH_BENCH.json (benchmarks/flash_vs_xla.py), refreshed
+    by each round's TPU bench run."""
     return (
         _pick_block(seq_q, block_q) > 0
         and _pick_block(seq_kv, block_kv) > 0
