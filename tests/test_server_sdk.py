@@ -7,11 +7,11 @@ import urllib.request
 
 import pytest
 
-from tf_operator_tpu.api import k8s, types as t
+from tf_operator_tpu.api import types as t
 from tf_operator_tpu.controller import ReconcilerConfig, TFJobController
 from tf_operator_tpu.controller.gang import GangScheduler
 from tf_operator_tpu.controller.ports import PortAllocator, PortRangeExhausted
-from tf_operator_tpu.runtime import InMemorySubstrate, NotFound
+from tf_operator_tpu.runtime import InMemorySubstrate
 from tf_operator_tpu.sdk import TFJobClient
 from tf_operator_tpu.server import (
     FileLock,
@@ -262,6 +262,28 @@ class TestSDK:
         sub.append_pod_log("default", "logs-worker-0", "step 1\n")
         logs = client.get_logs("logs", master=True)
         assert logs == {"logs-worker-0": "step 1\n"}
+
+    def test_logs_container_and_tail(self):
+        """ADVICE r3: the reference client's read_namespaced_pod_log
+        surface — ?container= (the apiserver 400s without it on
+        multi-container pods) and ?tailLines=."""
+        from tf_operator_tpu.runtime.substrate import BadRequest
+
+        sub, controller, client = self.setup_env()
+        client.create(make_job({"Worker": 1}, name="tailed"))
+        controller.run_until_quiet()
+        for i in range(5):
+            sub.append_pod_log("default", "tailed-worker-0", f"line {i}\n")
+        assert client.get_logs("tailed", tail_lines=2) == {
+            "tailed-worker-0": "line 3\nline 4\n"
+        }
+        # the pod's actual container name is accepted...
+        assert client.get_logs("tailed", container="tensorflow")[
+            "tailed-worker-0"
+        ].startswith("line 0")
+        # ...a bogus one is the apiserver's 400 class
+        with pytest.raises(BadRequest, match="not valid"):
+            client.get_logs("tailed", container="nope")
 
     def test_patch_merges_spec(self):
         sub, controller, client = self.setup_env()

@@ -21,6 +21,7 @@ import ssl
 import tempfile
 import threading
 import urllib.error
+import urllib.parse
 import urllib.request
 from typing import Any, Callable, Dict, List, Optional
 
@@ -30,6 +31,7 @@ from ..api.types import GROUP_NAME, PLURAL, TFJob, VERSION
 from .substrate import (
     ADDED,
     AlreadyExists,
+    BadRequest,
     Conflict,
     DEFAULT_LEASE_DURATION,
     DELETED,
@@ -65,6 +67,10 @@ def _raise_for_status(status: int, body: str) -> None:
             raise AlreadyExists(body)
         raise Conflict(body)
     if status >= 400:
+        # NOTE: 400 stays ApiError here — existing degrade-gracefully
+        # handlers (record_event's warn-and-continue, update_job_status's
+        # merge-patch fallback) catch ApiError; read_pod_log maps its
+        # own 400 to the typed BadRequest at the call site
         raise ApiError(status, body)
 
 
@@ -261,12 +267,28 @@ class KubeSubstrate:
     def delete_pod(self, namespace: str, name: str) -> None:
         self._request("DELETE", self._core_path("pods", namespace, name))
 
-    def read_pod_log(self, namespace: str, name: str) -> str:
+    def read_pod_log(
+        self,
+        namespace: str,
+        name: str,
+        container: Optional[str] = None,
+        tail_lines: Optional[int] = None,
+    ) -> str:
         """GET .../pods/{name}/log — plain text, not JSON (the
         reference SDK's read_namespaced_pod_log; feeds
-        TFJobClient.get_logs)."""
+        TFJobClient.get_logs). `container` is required by the apiserver
+        for multi-container pods (a bare GET 400s there); `tail_lines`
+        maps to ?tailLines= (ADVICE r3)."""
+        query = []
+        if container:
+            query.append("container=" + urllib.parse.quote(container))
+        if tail_lines is not None:
+            query.append(f"tailLines={int(tail_lines)}")
         req = urllib.request.Request(
-            self.base_url + self._core_path("pods", namespace, name) + "/log",
+            self.base_url
+            + self._core_path("pods", namespace, name)
+            + "/log"
+            + ("?" + "&".join(query) if query else ""),
             method="GET",
         )
         if self._token:
@@ -277,7 +299,13 @@ class KubeSubstrate:
             ) as resp:
                 return resp.read().decode(errors="replace")
         except urllib.error.HTTPError as err:
-            _raise_for_status(err.code, err.read().decode(errors="replace"))
+            body = err.read().decode(errors="replace")
+            if err.code == 400:
+                # the apiserver's "container required / not valid for
+                # pod" class — same typed error the in-memory twin
+                # raises, so SDK callers handle one exception
+                raise BadRequest(body) from None
+            _raise_for_status(err.code, body)
             raise  # unreachable
 
     def update_pod_status(
@@ -551,14 +579,19 @@ class KubeSubstrate:
             if start:
                 self._watch_gen[kind] = self._watch_gen.get(kind, 0) + 1
                 gen = self._watch_gen[kind]
-        if start:
-            thread = threading.Thread(
-                target=self._watch_loop, args=(kind, gen),
-                name=f"watch-{kind}", daemon=True,
-            )
-            thread.start()
-            with self._sub_lock:
+                # record the thread under the SAME lock hold that bumped
+                # the generation: an unsubscribe/resubscribe interleave
+                # can otherwise land a superseded thread's store after
+                # the replacement's, leaving a stale entry that permits
+                # a one-event duplicate delivery before its per-line
+                # generation check fires (ADVICE r3)
+                thread = threading.Thread(
+                    target=self._watch_loop, args=(kind, gen),
+                    name=f"watch-{kind}", daemon=True,
+                )
                 self._watch_threads[kind] = thread
+        if start:
+            thread.start()
 
     def unsubscribe(self, kind: str, callback: Callable) -> None:
         """Remove a watch callback. When the last subscriber for a kind

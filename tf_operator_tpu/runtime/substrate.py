@@ -24,7 +24,7 @@ import dataclasses
 import datetime
 import itertools
 import threading
-from typing import Any, Callable, Dict, Iterable, List, Optional, Protocol, Tuple
+from typing import Any, Callable, Dict, List, Optional, Protocol, Tuple
 
 from ..api import k8s
 from ..api.serde import deep_copy
@@ -47,6 +47,11 @@ def match_labels(selector: Dict[str, str], labels: Dict[str, str]) -> bool:
 
 class NotFound(KeyError):
     pass
+
+
+class BadRequest(ValueError):
+    """Client-side request error (the apiserver's 400 class — e.g. a
+    log read naming a container the pod does not have)."""
 
 
 # single source for the default lease duration (reference server.go:53);
@@ -472,11 +477,32 @@ class InMemorySubstrate:
                 self._pod_logs.get((namespace, name), "") + text
             )
 
-    def read_pod_log(self, namespace: str, name: str) -> str:
+    def read_pod_log(
+        self,
+        namespace: str,
+        name: str,
+        container: Optional[str] = None,
+        tail_lines: Optional[int] = None,
+    ) -> str:
+        """Signature mirrors KubeClient.read_pod_log (the apiserver
+        requires ?container= for multi-container pods and supports
+        ?tailLines=); the in-memory twin validates the container name
+        and honors the tail so SDK code exercises the same contract."""
         with self._lock:
-            if (namespace, name) not in self._pods:
+            pod = self._pods.get((namespace, name))
+            if pod is None:
                 raise NotFound(f"pod {namespace}/{name}")
-            return self._pod_logs.get((namespace, name), "")
+            if container is not None and container not in [
+                c.name for c in pod.spec.containers
+            ]:
+                raise BadRequest(
+                    f"container {container} is not valid for pod {name}"
+                )
+            text = self._pod_logs.get((namespace, name), "")
+        if tail_lines is not None:
+            lines = text.splitlines(keepends=True)
+            text = "".join(lines[-int(tail_lines):]) if tail_lines else ""
+        return text
 
     # -- Kubelet simulator -------------------------------------------------
 

@@ -163,8 +163,13 @@ class TFJobClient:
         master: bool = True,
         replica_type: Optional[str] = None,
         replica_index: Optional[int] = None,
+        container: Optional[str] = None,
+        tail_lines: Optional[int] = None,
     ) -> Dict[str, str]:
-        """Pod name -> log text, for substrates that expose logs."""
+        """Pod name -> log text, for substrates that expose logs.
+        `container`/`tail_lines` map to the apiserver's ?container=/
+        ?tailLines= (required for multi-container pods — the reference
+        client's read_namespaced_pod_log surface, ADVICE r3)."""
         namespace = namespace or self.namespace
         names = self.get_pod_names(
             name, namespace, master=master,
@@ -175,7 +180,13 @@ class TFJobClient:
             raise NotImplementedError(
                 f"substrate {type(self.substrate).__name__} does not expose logs"
             )
-        return {pod_name: reader(namespace, pod_name) for pod_name in names}
+        return {
+            pod_name: reader(
+                namespace, pod_name,
+                container=container, tail_lines=tail_lines,
+            )
+            for pod_name in names
+        }
 
 
 def _deep_merge(base: dict, patch: dict) -> dict:
