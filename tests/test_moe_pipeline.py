@@ -334,3 +334,40 @@ class TestMoeTask:
         assert np.isfinite(float(metrics["loss"]))
         # the router aux term must actually be present and positive
         assert float(metrics["router_aux"]) > 0.0
+
+    def test_eval_loss_excludes_router_aux(self):
+        """ADVICE r3: the router load-balancing term is a training
+        regularizer, not part of the modeling objective — eval loss
+        (the basis of reported perplexity) must be the pure LM loss,
+        while train loss includes the aux. Same params, same batch:
+        train_loss - eval_loss == router_aux."""
+        import optax
+
+        from tf_operator_tpu.parallel.mesh import MeshConfig, build_mesh
+        from tf_operator_tpu.parallel.sharding import MOE_RULES
+        from tf_operator_tpu.train import Trainer, moe_task
+
+        mesh = build_mesh(MeshConfig(dp=-1, ep=2))
+        model = m.MoELM(CFG)
+        task = moe_task(model)
+        trainer = Trainer(
+            model, task, optax.adam(1e-3), mesh=mesh, rules=MOE_RULES
+        )
+        rng = jax.random.PRNGKey(1)
+        sample = m.synthetic_batch(rng, 8, 32, CFG)
+        state = trainer.init(rng, sample)
+
+        variables = {"params": state.params}
+        train_loss, train_aux = task.loss_fn(variables, sample, train=True)
+        eval_loss, eval_aux = task.loss_fn(variables, sample, train=False)
+        assert float(train_aux["router_aux"]) > 0.0
+        np.testing.assert_allclose(
+            float(train_loss) - float(eval_loss),
+            float(train_aux["router_aux"]),
+            rtol=1e-5, atol=1e-7,
+        )
+        # the Trainer.evaluate path reports the pure-LM loss
+        metrics = trainer.evaluate(state, trainer.place_batch(sample))
+        np.testing.assert_allclose(
+            float(metrics["loss"]), float(eval_loss), rtol=1e-5, atol=1e-6
+        )

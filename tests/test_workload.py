@@ -680,6 +680,79 @@ class TestGradientAccumulation:
                 np.asarray(pf), np.asarray(pa), rtol=1e-4, atol=1e-5
             )
 
+    def test_weighted_loss_accum_exact_with_uneven_weight_mass(self):
+        """ADVICE r3: weighted losses (MLM mask) under accumulation.
+        The batch is built so microbatch 0 carries ~10x the mask mass
+        of microbatch 1 — the mean-of-microbatch-means approximation
+        would diverge visibly; the (w_i * g_i, w_i) accumulation must
+        reproduce the full-batch weighted-mean update exactly."""
+        from tf_operator_tpu.models import bert as bert_lib
+        from tf_operator_tpu.train import mlm_task
+
+        cfg = bert_lib.BertConfig(
+            vocab_size=128, hidden_size=32, num_layers=1, num_heads=2,
+            intermediate_size=64, max_position_embeddings=32,
+            # f32 end to end: at the default bf16, re-scaling the
+            # upstream gradient between the microbatch (1/W_i) and
+            # full-batch (1/W_total) formulations re-rounds d_logits
+            # at bf16 epsilon — real quantization noise, not an
+            # accumulation error; f32 isolates the math being pinned
+            dtype=jnp.float32,
+        )
+        model = bert_lib.BertForMLM(cfg)
+        rng = jax.random.PRNGKey(11)
+        batch_size, seq = 16, 16  # microbatches of 8 fit the dp=8 mesh
+        ids = jax.random.randint(rng, (batch_size, seq), 0, cfg.vocab_size)
+        # rows 0-7 (microbatch 0): dense mask; rows 8-15: one token each
+        weights = jnp.concatenate([
+            jnp.ones((8, seq), jnp.float32),
+            jnp.zeros((8, seq), jnp.float32).at[:, 0].set(1.0),
+        ])
+        batch = {
+            "input_ids": ids,
+            "labels": ids,
+            "mlm_weights": weights,
+            "attention_mask": jnp.ones((batch_size, seq), jnp.int32),
+        }
+        opt = optax.sgd(0.1)
+        full = Trainer(model, mlm_task(model), opt)
+        acc = Trainer(model, mlm_task(model), opt, accum_steps=2)
+        state_f = full.init(rng, batch)
+        state_a = acc.init(rng, batch)
+
+        state_f, m_f = full.step(state_f, full.place_batch(batch))
+        state_a, m_a = acc.step(state_a, acc.place_batch(batch))
+
+        np.testing.assert_allclose(
+            float(m_f["loss"]), float(m_a["loss"]), rtol=1e-5, atol=1e-6
+        )
+        for pf, pa in zip(
+            jax.tree_util.tree_leaves(state_f.params),
+            jax.tree_util.tree_leaves(state_a.params),
+        ):
+            np.testing.assert_allclose(
+                np.asarray(pf), np.asarray(pa), rtol=1e-4, atol=1e-5
+            )
+
+    def test_loss_weight_not_reported_as_metric(self):
+        from tf_operator_tpu.models import bert as bert_lib
+        from tf_operator_tpu.train import mlm_task
+
+        cfg = bert_lib.BertConfig(
+            vocab_size=64, hidden_size=32, num_layers=1, num_heads=2,
+            intermediate_size=64, max_position_embeddings=16,
+        )
+        model = bert_lib.BertForMLM(cfg)
+        rng = jax.random.PRNGKey(12)
+        batch = bert_lib.synthetic_batch(rng, 8, 16, cfg)
+        trainer = Trainer(model, mlm_task(model), optax.sgd(0.1))
+        state = trainer.init(rng, batch)
+        # step donates its input state; evaluate the returned one
+        state, metrics = trainer.step(state, trainer.place_batch(batch))
+        assert "loss_weight" not in metrics
+        eval_metrics = trainer.evaluate(state, trainer.place_batch(batch))
+        assert "loss_weight" not in eval_metrics
+
     def test_accum_with_batch_stats_threads_ema(self):
         """BatchNorm running stats under accumulation: k microbatch
         forwards each apply their EMA update (exactly what k separate

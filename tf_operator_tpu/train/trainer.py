@@ -93,7 +93,14 @@ def mlm_task(model) -> Task:
             variables, batch["input_ids"], batch.get("attention_mask")
         )
         loss = mlm_loss(logits, batch["labels"], batch["mlm_weights"])
-        return loss, {"batch_stats": None}
+        # weight mass of this (micro)batch — what the weighted-mean
+        # denominator saw; gradient accumulation re-weights with it so
+        # uneven mask counts per microbatch still yield the exact
+        # full-batch weighted-mean gradient (ADVICE r3)
+        return loss, {
+            "loss_weight": batch["mlm_weights"].sum(),
+            "batch_stats": None,
+        }
 
     return Task(apply_fn=model.apply, loss_fn=loss_fn)
 
@@ -147,8 +154,22 @@ def moe_task(model) -> Task:
         aux = total_aux_loss(mods.get("losses", {}))
         # the key-padding mask doubles as loss weights: pad positions
         # neither attend nor contribute to the mean cross-entropy
-        loss = lm_loss(logits, batch["labels"], weights=mask) + aux
-        return loss, {"router_aux": aux, "batch_stats": None}
+        lm = lm_loss(logits, batch["labels"], weights=mask)
+        # the router load-balancing term is a TRAINING regularizer: it
+        # shapes gradients but is not part of the modeling objective,
+        # so eval loss (what perplexity = exp(loss) is computed from)
+        # excludes it; it stays visible as the router_aux metric
+        # (ADVICE r3)
+        loss = lm + aux if train else lm
+        extras = {"router_aux": aux, "batch_stats": None}
+        if mask is not None:
+            # weight mass -> exact LM gradient under accumulation.
+            # Trade-off: the aux regularizer rides the same per-
+            # microbatch re-weighting (w_i/mean(w) scale instead of 1),
+            # acceptable for a heuristic whose global scale is already
+            # a free hyperparameter (cfg.router_aux_weight)
+            extras["loss_weight"] = mask[:, 1:].astype(jnp.float32).sum()
+        return loss, extras
 
     return Task(apply_fn=model.apply, loss_fn=loss_fn)
 
@@ -269,10 +290,19 @@ class Trainer:
         separate steps would.
 
         Exact for uniformly-weighted mean losses (matches the full-
-        batch gradient bit-for-bit up to float reassociation). For
-        weighted losses (MLM's sum/weight-sum) it is the standard
-        mean-of-microbatch-means approximation — exact only when the
-        weight mass per microbatch is equal."""
+        batch gradient bit-for-bit up to float reassociation). Weighted
+        losses (MLM's sum/weight-sum, MoE's padding weights) too:
+        tasks report their (micro)batch weight mass as
+        aux["loss_weight"], the scan accumulates (w_i * grads_i,
+        w_i * loss_i, w_i), and one normalization at the end recovers
+        the full-batch weighted mean — sum_i W_i g_i / sum_i W_i —
+        instead of the mean-of-microbatch-means approximation
+        (ADVICE r3). Scope note: the re-weighting applies to the WHOLE
+        microbatch gradient, so additive regularizers that are not
+        weighted sums (MoE's router aux) come out mass-weighted across
+        microbatches rather than uniformly averaged — the modeling
+        (LM) term is exact; the regularizer's effective scale shifts
+        by at most the microbatch mass imbalance (see moe_task)."""
         task = self.task
         optimizer = self.optimizer
         accum = self.accum_steps
@@ -313,24 +343,43 @@ class Trainer:
                 )
 
                 def body(carry, mb):
-                    grads_acc, loss_acc, bs = carry
+                    grads_acc, loss_acc, weight_acc, bs = carry
                     (loss, aux), grads = loss_and_grads(state, bs, mb)
+                    # microbatch weight mass: 1 for uniform-mean tasks,
+                    # the weighted-mean denominator for weighted ones
+                    w = aux.get(
+                        "loss_weight", jnp.asarray(1.0, jnp.float32)
+                    )
                     grads_acc = jax.tree_util.tree_map(
-                        jnp.add, grads_acc, grads
+                        # cast back: w is f32, and a promoted carry
+                        # dtype would break the lax.scan carry contract
+                        # for sub-f32 grads
+                        lambda a, g: a + (w * g).astype(a.dtype),
+                        grads_acc, grads,
                     )
                     metrics_y = {
-                        k: v for k, v in aux.items() if k != "batch_stats"
+                        k: v for k, v in aux.items()
+                        if k not in ("batch_stats", "loss_weight")
                     }
-                    carry = (grads_acc, loss_acc + loss, aux.get("batch_stats"))
+                    carry = (
+                        grads_acc, loss_acc + w * loss, weight_acc + w,
+                        aux.get("batch_stats"),
+                    )
                     return carry, metrics_y
 
                 zeros = jax.tree_util.tree_map(jnp.zeros_like, state.params)
-                (grads, loss, new_bs), metrics_seq = lax.scan(
-                    body, (zeros, jnp.zeros((), jnp.float32), state.batch_stats),
+                (grads, loss, weight, new_bs), metrics_seq = lax.scan(
+                    body,
+                    (
+                        zeros, jnp.zeros((), jnp.float32),
+                        jnp.zeros((), jnp.float32), state.batch_stats,
+                    ),
                     micro,
                 )
-                grads = jax.tree_util.tree_map(lambda g: g / accum, grads)
-                loss = loss / accum
+                grads = jax.tree_util.tree_map(
+                    lambda g: (g / weight).astype(g.dtype), grads
+                )
+                loss = loss / weight
                 # scalar aux metrics: mean over microbatches; the
                 # threaded batch_stats carry is the final one
                 aux = jax.tree_util.tree_map(
@@ -346,7 +395,9 @@ class Trainer:
             )
             new_params = optax.apply_updates(state.params, updates)
             metrics = {
-                k: v for k, v in aux.items() if k != "batch_stats" and v is not None
+                k: v
+                for k, v in aux.items()
+                if k not in ("batch_stats", "loss_weight") and v is not None
             }
             metrics["loss"] = loss
             return (
@@ -427,7 +478,8 @@ class Trainer:
                 loss, aux = task.loss_fn(variables, batch, train=False)
                 metrics = {
                     k: v for k, v in aux.items()
-                    if k != "batch_stats" and v is not None
+                    if k not in ("batch_stats", "loss_weight")
+                    and v is not None
                 }
                 metrics["loss"] = loss
                 return metrics
