@@ -1,0 +1,389 @@
+"""Minimal AST linter: undefined names + unused imports (VERDICT r3 #7).
+
+The reference runs real lint in its py-test CI step
+(/root/reference/py/kubeflow/tf_operator/py_checks.py); this image has
+no pyflakes/flake8/ruff, so this is a small, conservative
+reimplementation of the two highest-value checks:
+
+- F821 undefined-name: a Name load that no enclosing scope binds.
+- F401 unused-import: an import binding never referenced in the module.
+
+Conservative by construction — zero false positives matter more than
+coverage (a noisy lint gate gets deleted):
+
+- binding collection is whole-scope (no use-before-def analysis), so
+  ordering never trips it;
+- `from x import *` disables undefined-name checks for that file;
+- `__init__.py` files and `... as ...` self-re-exports (PEP 484 style,
+  `import x as x`) are exempt from unused-import;
+- a `# noqa` comment on the line suppresses findings on it;
+- names in `__all__` string lists count as uses.
+
+Exit 1 with file:line findings; exit 0 clean.
+
+    python hack/lint.py tf_operator_tpu tests bench.py
+"""
+
+from __future__ import annotations
+
+import ast
+import builtins
+import os
+import sys
+from typing import Dict, List, Optional, Set, Tuple
+
+BUILTIN_NAMES = set(dir(builtins)) | {
+    "__file__", "__name__", "__doc__", "__builtins__", "__spec__",
+    "__package__", "__loader__", "__debug__", "__path__", "__version__",
+    "__class__",  # zero-arg super() cell inside methods
+}
+
+
+class Scope:
+    __slots__ = ("node", "bindings", "kind", "parent")
+
+    def __init__(self, node, kind: str, parent: Optional["Scope"]):
+        self.node = node
+        self.kind = kind  # module | function | class | comprehension
+        self.parent = parent
+        self.bindings: Set[str] = set()
+
+
+def _bind_target(target, scope: Scope) -> None:
+    """Collect names bound by an assignment-like target."""
+    if isinstance(target, ast.Name):
+        scope.bindings.add(target.id)
+    elif isinstance(target, (ast.Tuple, ast.List)):
+        for elt in target.elts:
+            _bind_target(elt, scope)
+    elif isinstance(target, ast.Starred):
+        _bind_target(target.value, scope)
+    # Attribute/Subscript targets bind nothing new
+
+
+def _collect_bindings(body: List[ast.stmt], scope: Scope) -> None:
+    """Whole-scope binding pass: every name this scope's statements bind,
+    WITHOUT descending into nested function/class bodies (those are
+    their own scopes) but descending into control flow."""
+    for stmt in body:
+        _collect_stmt(stmt, scope)
+
+
+def _collect_stmt(stmt: ast.stmt, scope: Scope) -> None:
+    if isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef, ast.ClassDef)):
+        scope.bindings.add(stmt.name)
+        return  # nested body is its own scope
+    if isinstance(stmt, (ast.Import, ast.ImportFrom)):
+        for alias in stmt.names:
+            if alias.name == "*":
+                continue
+            name = alias.asname or alias.name.split(".")[0]
+            scope.bindings.add(name)
+        return
+    if isinstance(stmt, ast.Assign):
+        for target in stmt.targets:
+            _bind_target(target, scope)
+    elif isinstance(stmt, (ast.AugAssign, ast.AnnAssign)):
+        _bind_target(stmt.target, scope)
+    elif isinstance(stmt, (ast.For, ast.AsyncFor)):
+        _bind_target(stmt.target, scope)
+    elif isinstance(stmt, (ast.With, ast.AsyncWith)):
+        for item in stmt.items:
+            if item.optional_vars is not None:
+                _bind_target(item.optional_vars, scope)
+    elif isinstance(stmt, ast.Global):
+        # treat as bound here (actual binding is at module level; the
+        # module pass sees the assignment too when it exists)
+        scope.bindings.update(stmt.names)
+    elif isinstance(stmt, ast.Nonlocal):
+        scope.bindings.update(stmt.names)
+    elif isinstance(stmt, ast.Try):
+        for handler in stmt.handlers:
+            if handler.name:
+                scope.bindings.add(handler.name)
+    elif isinstance(stmt, ast.Match):
+        for case in stmt.cases:
+            _bind_pattern(case.pattern, scope)
+    # walrus operators anywhere in expressions of this statement bind
+    # into this scope (approximation: also true inside comprehensions,
+    # where the real target is the enclosing function — same set here)
+    for node in ast.walk(stmt):
+        if isinstance(node, ast.NamedExpr):
+            _bind_target(node.target, scope)
+        elif isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
+                               ast.ClassDef, ast.Lambda)):
+            # don't harvest walruses from nested scopes... except walrus
+            # technically escapes comprehensions; acceptable slack
+            continue
+    # descend into control-flow bodies
+    for field in ("body", "orelse", "finalbody"):
+        sub = getattr(stmt, field, None)
+        if isinstance(sub, list):
+            for child in sub:
+                if isinstance(child, ast.stmt):
+                    _collect_stmt(child, scope)
+    if isinstance(stmt, ast.Try):
+        for handler in stmt.handlers:
+            for child in handler.body:
+                _collect_stmt(child, scope)
+    if isinstance(stmt, ast.Match):
+        for case in stmt.cases:
+            for child in case.body:
+                _collect_stmt(child, scope)
+
+
+def _bind_pattern(pattern, scope: Scope) -> None:
+    """match-case capture names."""
+    for node in ast.walk(pattern):
+        if isinstance(node, (ast.MatchAs, ast.MatchStar)) and node.name:
+            scope.bindings.add(node.name)
+        elif isinstance(node, ast.MatchMapping) and node.rest:
+            scope.bindings.add(node.rest)
+
+
+def _visible(name: str, scope: Scope) -> bool:
+    cursor: Optional[Scope] = scope
+    while cursor is not None:
+        # class scopes are invisible to nested function scopes, but a
+        # load directly inside the class body DOES see them
+        if cursor is scope or cursor.kind != "class":
+            if name in cursor.bindings:
+                return True
+        cursor = cursor.parent
+    return name in BUILTIN_NAMES
+
+
+class Linter(ast.NodeVisitor):
+    def __init__(self, path: str, source: str, tree: ast.Module):
+        self.path = path
+        self.findings: List[Tuple[int, str]] = []
+        self.noqa_lines = {
+            i + 1
+            for i, line in enumerate(source.splitlines())
+            if "# noqa" in line
+        }
+        self.has_star_import = any(
+            isinstance(node, ast.ImportFrom)
+            and any(alias.name == "*" for alias in node.names)
+            for node in ast.walk(tree)
+        )
+        self.imports: Dict[str, Tuple[int, str]] = {}  # name -> (line, shown)
+        self.used_names: Set[str] = set()
+        self.scope = Scope(tree, "module", None)
+        _collect_bindings(tree.body, self.scope)
+        self.tree = tree
+
+    # -- scope machinery ---------------------------------------------------
+
+    def _enter(self, node, kind: str) -> Scope:
+        outer = self.scope
+        self.scope = Scope(node, kind, outer)
+        return outer
+
+    def _walk_function(self, node) -> None:
+        args = node.args
+        for default in args.defaults + [
+            d for d in args.kw_defaults if d is not None
+        ]:
+            self.visit(default)
+        for arg in (
+            args.posonlyargs + args.args + args.kwonlyargs
+            + ([args.vararg] if args.vararg else [])
+            + ([args.kwarg] if args.kwarg else [])
+        ):
+            if arg.annotation is not None:
+                self.visit(arg.annotation)
+        if getattr(node, "returns", None) is not None:
+            self.visit(node.returns)
+        for dec in getattr(node, "decorator_list", ()):  # Lambda has none
+            self.visit(dec)
+        outer = self._enter(node, "function")
+        for arg in (
+            args.posonlyargs + args.args + args.kwonlyargs
+            + ([args.vararg] if args.vararg else [])
+            + ([args.kwarg] if args.kwarg else [])
+        ):
+            self.scope.bindings.add(arg.arg)
+        body = node.body if isinstance(node.body, list) else [node.body]
+        if isinstance(node, ast.Lambda):
+            self.visit(node.body)
+        else:
+            _collect_bindings(node.body, self.scope)
+            for stmt in body:
+                self.visit(stmt)
+        self.scope = outer
+
+    def visit_FunctionDef(self, node) -> None:
+        self._walk_function(node)
+
+    def visit_AsyncFunctionDef(self, node) -> None:
+        self._walk_function(node)
+
+    def visit_Lambda(self, node) -> None:
+        self._walk_function(node)
+
+    def visit_ClassDef(self, node) -> None:
+        for base in node.bases + [kw.value for kw in node.keywords]:
+            self.visit(base)
+        for dec in node.decorator_list:
+            self.visit(dec)
+        outer = self._enter(node, "class")
+        _collect_bindings(node.body, self.scope)
+        for stmt in node.body:
+            self.visit(stmt)
+        self.scope = outer
+
+    def _walk_comprehension(self, node) -> None:
+        # first iterable evaluates in the ENCLOSING scope
+        self.visit(node.generators[0].iter)
+        outer = self._enter(node, "comprehension")
+        for gen in node.generators:
+            _bind_target(gen.target, self.scope)
+        for i, gen in enumerate(node.generators):
+            if i > 0:
+                self.visit(gen.iter)
+            for cond in gen.ifs:
+                self.visit(cond)
+        if isinstance(node, ast.DictComp):
+            self.visit(node.key)
+            self.visit(node.value)
+        else:
+            self.visit(node.elt)
+        self.scope = outer
+
+    visit_ListComp = _walk_comprehension
+    visit_SetComp = _walk_comprehension
+    visit_DictComp = _walk_comprehension
+    visit_GeneratorExp = _walk_comprehension
+
+    # -- checks ------------------------------------------------------------
+
+    def visit_Name(self, node: ast.Name) -> None:
+        if isinstance(node.ctx, ast.Load):
+            self.used_names.add(node.id)
+            if (
+                not self.has_star_import
+                and node.lineno not in self.noqa_lines
+                and not _visible(node.id, self.scope)
+            ):
+                self.findings.append(
+                    (node.lineno, f"undefined name '{node.id}'")
+                )
+        elif isinstance(node.ctx, (ast.Store, ast.Del)):
+            # walrus/loop binds inside comprehension visits land here;
+            # record so nested scopes resolving upward still see them
+            self.scope.bindings.add(node.id)
+        self.generic_visit(node)
+
+    def visit_NamedExpr(self, node) -> None:
+        self.visit(node.value)
+        # walrus target binds in the nearest function/module scope
+        target_scope = self.scope
+        while target_scope.kind == "comprehension" and target_scope.parent:
+            target_scope = target_scope.parent
+        if isinstance(node.target, ast.Name):
+            target_scope.bindings.add(node.target.id)
+            self.scope.bindings.add(node.target.id)
+
+    def visit_ExceptHandler(self, node) -> None:
+        if node.name:
+            self.scope.bindings.add(node.name)
+        self.generic_visit(node)
+
+    def visit_Constant(self, node: ast.Constant) -> None:
+        # quoted annotations / typing strings: harvest identifier-like
+        # tokens (incl. the base of dotted paths) as "uses" so
+        # `if TYPE_CHECKING:` imports referenced only in string
+        # annotations don't flag as unused (they are NOT name-checked —
+        # conservative)
+        if isinstance(node.value, str) and len(node.value) < 200:
+            import re
+
+            self.used_names.update(
+                re.findall(r"[A-Za-z_][A-Za-z0-9_]*", node.value)
+            )
+
+    # -- imports -----------------------------------------------------------
+
+    def collect_imports(self) -> None:
+        for node in ast.walk(self.tree):
+            if isinstance(node, ast.Import):
+                for alias in node.names:
+                    bound = alias.asname or alias.name.split(".")[0]
+                    if alias.asname == alias.name:
+                        continue  # `import x as x` re-export idiom
+                    if node.lineno in self.noqa_lines:
+                        continue
+                    self.imports[bound] = (node.lineno, alias.name)
+            elif isinstance(node, ast.ImportFrom):
+                if node.module == "__future__":
+                    continue  # compiler directive, not a binding to use
+                for alias in node.names:
+                    if alias.name == "*":
+                        continue
+                    if alias.asname == alias.name and alias.asname:
+                        continue  # `from m import x as x` re-export
+                    bound = alias.asname or alias.name
+                    if node.lineno in self.noqa_lines:
+                        continue
+                    self.imports[bound] = (node.lineno, alias.name)
+
+    def unused_imports(self) -> List[Tuple[int, str]]:
+        out = []
+        for bound, (lineno, shown) in self.imports.items():
+            if bound not in self.used_names:
+                out.append((lineno, f"'{shown}' imported but unused"))
+        return out
+
+
+def lint_file(path: str, check_unused_imports: bool = True) -> List[str]:
+    with open(path, encoding="utf-8") as handle:
+        source = handle.read()
+    try:
+        tree = ast.parse(source, filename=path)
+    except SyntaxError as err:
+        return [f"{path}:{err.lineno}: syntax error: {err.msg}"]
+    linter = Linter(path, source, tree)
+    for stmt in tree.body:
+        linter.visit(stmt)
+    findings = list(linter.findings)
+    if check_unused_imports and os.path.basename(path) != "__init__.py":
+        linter.collect_imports()
+        findings.extend(linter.unused_imports())
+    findings.sort()
+    return [f"{path}:{line}: {msg}" for line, msg in findings]
+
+
+def iter_py_files(paths: List[str]):
+    for path in paths:
+        if os.path.isfile(path):
+            yield path
+            continue
+        for root, dirs, files in os.walk(path):
+            dirs[:] = [
+                d for d in dirs
+                if d not in ("__pycache__", ".git", "build", "_artifacts")
+            ]
+            for name in sorted(files):
+                if name.endswith(".py"):
+                    yield os.path.join(root, name)
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    argv = argv if argv is not None else sys.argv[1:]
+    if not argv:
+        print("usage: lint.py PATH [PATH...]", file=sys.stderr)
+        return 2
+    total = 0
+    for path in iter_py_files(argv):
+        for finding in lint_file(path):
+            print(finding)
+            total += 1
+    if total:
+        print(f"lint: {total} finding(s)", file=sys.stderr)
+        return 1
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

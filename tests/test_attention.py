@@ -378,8 +378,6 @@ class TestFlashNarrowHead:
     def test_bert_base_head_dim_trains(self):
         """BERT-base geometry (hidden 768 = 12 x 64) through the flash
         path end to end: one MLM train step, finite loss and grads."""
-        import optax as _optax
-
         cfg = bert_lib.BertConfig(
             vocab_size=512, hidden_size=256, num_layers=1, num_heads=4,
             intermediate_size=512, max_position_embeddings=256,

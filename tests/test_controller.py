@@ -23,7 +23,6 @@ from tf_operator_tpu.controller import (
 from tf_operator_tpu.controller.reconciler import slices_by_index
 from tf_operator_tpu.runtime import (
     ControllerExpectations,
-    EventRecorder,
     FakePodControl,
     FakeServiceControl,
     InMemorySubstrate,

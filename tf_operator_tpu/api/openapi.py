@@ -22,7 +22,7 @@ import copy
 import dataclasses
 import enum
 import typing
-from typing import Any, Dict, List
+from typing import Any, Dict
 
 from .serde import _json_key, _unwrap_optional  # shared key mapping
 from .types import (
@@ -30,7 +30,6 @@ from .types import (
     KIND,
     ReplicaSpec,
     ReplicaType,
-    RunPolicy,
     TFJobSpec,
 )
 

@@ -21,7 +21,7 @@ import dataclasses
 import enum
 import functools
 import typing
-from typing import Any, Optional, Type, TypeVar, Union
+from typing import Any, Type, TypeVar, Union
 
 T = TypeVar("T")
 

@@ -14,7 +14,6 @@ with XLA collectives.
 
 from __future__ import annotations
 
-import functools
 import math
 from typing import Optional
 
