@@ -246,6 +246,81 @@ class TestPodNames:
         )
 
 
+class TestMultiProcessRendezvous:
+    """estimator_runconfig_tests.py analog, one level deeper (VERDICT
+    r3 next #4): the operator launches N worker *processes*; each feeds
+    its operator-injected TPU_WORKER_ID / TPU_WORKER_HOSTNAMES /
+    JAX_PROCESS_ID env into jax.distributed.initialize (CPU backend)
+    and verifies the resolved world FROM INSIDE — process_index ==
+    replica index, process_count == world size, and a cross-process
+    all-gather returning exactly {0..n-1}. Workers exit nonzero on any
+    mismatch; the TPU replica type succeeds only when ALL hosts exit 0
+    (controller/status.py), so job success == every worker proved its
+    membership."""
+
+    def test_workers_verify_world_from_inside(self):
+        import sys
+
+        from tf_operator_tpu.api import k8s
+        from tf_operator_tpu.runtime.process_kubelet import free_port
+
+        substrate = InMemorySubstrate()
+        # wait_ready=False: rendezvous workers serve no /healthz; the
+        # readiness poll would add its full 15s timeout per pod
+        kubelet = ProcessKubelet(substrate, wait_ready=False)
+        controller = TFJobController(substrate)
+        controller.run(threadiness=2, resync_period=0.5)
+        client = TFJobClient(substrate)
+        try:
+            job = make_job({"TPU": 2}, name="rdv")
+            job.spec.run_policy.clean_pod_policy = t.CleanPodPolicy.NONE
+            spec = job.spec.tf_replica_specs["TPU"]
+            container = spec.template.spec.containers[0]
+            container.command = [
+                sys.executable, "-m",
+                "tf_operator_tpu.testing.rendezvous_worker",
+            ]
+            # the injected JAX_COORDINATOR_ADDRESS is a headless-service
+            # DNS name; hermetically, remap ONLY the endpoint (identity
+            # env stays operator-injected)
+            container.env.append(k8s.EnvVar(
+                name="TFJOB_LOCAL_COORDINATOR",
+                value=f"127.0.0.1:{free_port()}",
+            ))
+            client.create(job)
+            # generous timeout: each worker imports jax (~10s on CPU)
+            # before the Gloo rendezvous
+            wait_until(
+                lambda: client.get("rdv").is_finished(),
+                timeout=180, message="rendezvous job finished",
+            )
+            assert client.is_job_succeeded("rdv"), (
+                client.get("rdv").status,
+                client.get_logs("rdv", master=False, replica_type="tpu"),
+            )
+            logs = client.get_logs("rdv", master=False, replica_type="tpu")
+            assert set(logs) == {"rdv-tpu-0", "rdv-tpu-1"}
+            for name, text in logs.items():
+                index = int(name.rsplit("-", 1)[1])
+                lines = [
+                    l for l in text.splitlines()
+                    if l.startswith("RENDEZVOUS ")
+                ]
+                assert lines, f"no rendezvous report in {name}: {text!r}"
+                report = json.loads(lines[-1].split(" ", 1)[1])
+                # the world as THIS worker resolved it, from its own env
+                assert report["ok"], report
+                assert report["jax_process_index"] == index
+                assert report["jax_process_count"] == 2
+                assert report["gathered_world"] == [0, 1]
+                assert report["hostnames"] == [
+                    "rdv-tpu-0.default.svc", "rdv-tpu-1.default.svc",
+                ]
+        finally:
+            controller.stop()
+            kubelet.shutdown()
+
+
 class TestPodsReadyHarness:
     """The pods-ready latency harness (benchmarks/pods_ready.py,
     BASELINE.md row 1) must run end-to-end and report sane numbers."""
