@@ -36,7 +36,7 @@ def time_grad(fn, q, k, v, iters: int = 10) -> float:
     return (time.perf_counter() - start) / iters
 
 
-def run(verbose: bool = True, quick: bool = False) -> list:
+def run(verbose: bool = True, quick: bool = False, write: bool = True) -> list:
     """Measure and write FLASH_BENCH.json; returns the rows. Importable
     so bench.py can produce the artifact during the driver's round-end
     TPU run (this round's interactive TPU tunnel died mid-round; see
@@ -82,6 +82,8 @@ def run(verbose: bool = True, quick: bool = False) -> list:
             "speedup": round(t_xla / t_flash, 2),
         })
         log(rows[-1])
+    if not write:  # CPU smoke must not clobber the TPU artifact
+        return rows
     out = os.path.join(
         os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
         "FLASH_BENCH.json",
