@@ -1,0 +1,26 @@
+#!/bin/sh
+# Round-4 TPU availability prober. The r3 round lost every hardware
+# artifact to a tunnel outage (TPU_OUTAGE_r03.json); this loop records
+# each probe attempt to TPU_PROBE_r04.jsonl and exits 0 the moment
+# jax.devices() answers with a TPU, so the bench can run immediately.
+LOG="${1:-/root/repo/TPU_PROBE_r04.jsonl}"
+DEADLINE_S="${2:-39600}"   # give up after 11h
+START=$(date +%s)
+while :; do
+  NOW=$(date -u +%Y-%m-%dT%H:%M:%SZ)
+  OUT=$(timeout 300 python -c "
+import jax
+ds = jax.devices()
+print(ds[0].platform, len(ds), getattr(ds[0], 'device_kind', ''))
+" 2>&1)
+  RC=$?
+  if [ $RC -eq 0 ] && echo "$OUT" | grep -q "^tpu"; then
+    printf '{"t":"%s","ok":true,"devices":"%s"}\n' "$NOW" "$(echo "$OUT" | tail -1)" >> "$LOG"
+    exit 0
+  fi
+  printf '{"t":"%s","ok":false,"rc":%d,"err":"%s"}\n' "$NOW" "$RC" \
+    "$(echo "$OUT" | tail -1 | tr -d '"' | cut -c1-120)" >> "$LOG"
+  ELAPSED=$(( $(date +%s) - START ))
+  [ "$ELAPSED" -gt "$DEADLINE_S" ] && exit 2
+  sleep 600
+done
