@@ -16,6 +16,15 @@ print(ds[0].platform, len(ds), getattr(ds[0], 'device_kind', ''))
   RC=$?
   if [ $RC -eq 0 ] && echo "$OUT" | grep -q "^tpu"; then
     printf '{"t":"%s","ok":true,"devices":"%s"}\n' "$NOW" "$(echo "$OUT" | tail -1)" >> "$LOG"
+    # seize the window: the tunnel has died mid-round before
+    # (TPU_OUTAGE_r03.json), so run the full bench IMMEDIATELY and
+    # capture stdout; the operator commits the artifacts after review
+    if [ "${PROBE_RUN_BENCH:-1}" = "1" ]; then
+      cd /root/repo && timeout 5400 python bench.py \
+        > /root/repo/BENCH_r04_probe.out 2> /root/repo/BENCH_r04_probe.err
+      BRC=$?  # captured BEFORE the date substitution (bash resets $?)
+      printf '{"t":"%s","bench_rc":%d}\n' "$(date -u +%Y-%m-%dT%H:%M:%SZ)" "$BRC" >> "$LOG"
+    fi
     exit 0
   fi
   printf '{"t":"%s","ok":false,"rc":%d,"err":"%s"}\n' "$NOW" "$RC" \

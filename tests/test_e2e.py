@@ -246,6 +246,49 @@ class TestPodNames:
         )
 
 
+class TestTpuSliceRestart:
+    """SURVEY §7 hard part #1 at the live-process tier: a multi-host
+    TPU slice is ONE logical accelerator — a retryable death of ANY
+    host must restart the WHOLE slice (every peer's ICI mesh is
+    broken), and count exactly one retry. The reference's per-pod
+    restart (pod.go:131-139) is the contrast; unit coverage lives in
+    the reconciler tests, this pins it with real processes."""
+
+    def test_one_dead_host_restarts_whole_slice(self, cluster):
+        substrate, kubelet, controller, client = cluster
+        job = make_job({"TPU": 2}, name="slice")
+        job.spec.tf_replica_specs["TPU"].restart_policy = (
+            t.RestartPolicy.EXIT_CODE
+        )
+        client.create(job)
+        wait_until(pod_running(substrate, "slice-tpu-0"), message="host0 up")
+        wait_until(pod_running(substrate, "slice-tpu-1"), message="host1 up")
+        port0 = kubelet.port_of("default", "slice-tpu-0")
+        port1 = kubelet.port_of("default", "slice-tpu-1")
+        # kill host 1 with a retryable code; host 0 is healthy
+        try:
+            http_json(
+                kubelet.url_of("default", "slice-tpu-1", "/exit?exitCode=137")
+            )
+        except OSError:
+            pass
+        # BOTH hosts come back as new processes (new ports) — the
+        # healthy host 0 was torn down with its slice
+        wait_until(
+            lambda: (
+                pod_running(substrate, "slice-tpu-0")()
+                and pod_running(substrate, "slice-tpu-1")()
+                and kubelet.port_of("default", "slice-tpu-0") != port0
+                and kubelet.port_of("default", "slice-tpu-1") != port1
+            ),
+            message="whole slice restarted as new processes",
+        )
+        stored = client.get("slice")
+        assert not stored.is_finished()
+        # one slice restart == ONE retry, however many hosts recycled
+        assert stored.status.replica_statuses["TPU"].restarts == 1
+
+
 class TestMultiProcessRendezvous:
     """estimator_runconfig_tests.py analog, one level deeper (VERDICT
     r3 next #4): the operator launches N worker *processes*; each feeds

@@ -686,6 +686,21 @@ class TestSdkCli:
             assert main(base + ["logs", "mnist-tpu", "--master"]) == 0
             out = capsys.readouterr().out
             assert "hello" in out
+            # --tail and --container ride the wire as ?tailLines=/
+            # ?container= (the real apiserver's /log contract, which
+            # the fake implements: bad container name -> 400)
+            server.store.pod_logs[("kubeflow", "mnist-tpu-tpu-0")] = (
+                "a\nb\nc\n"
+            )
+            assert main(base + [
+                "logs", "mnist-tpu", "--master", "--tail", "1",
+            ]) == 0
+            out = capsys.readouterr().out
+            assert "c" in out and "a\n" not in out
+            assert main(base + [
+                "logs", "mnist-tpu", "--master", "-c", "wrong",
+            ]) == 1
+            assert "error:" in capsys.readouterr().err
             # watch over the wire (KubeSubstrate's subscribe path —
             # a real chunked watch stream); a terminal condition ends it
             with server.store.lock:

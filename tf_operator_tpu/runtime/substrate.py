@@ -500,8 +500,13 @@ class InMemorySubstrate:
                 )
             text = self._pod_logs.get((namespace, name), "")
         if tail_lines is not None:
+            n = int(tail_lines)
+            if n < 0:  # matches the apiserver's Invalid class
+                raise BadRequest(
+                    f"tailLines must be a non-negative integer, got {n}"
+                )
             lines = text.splitlines(keepends=True)
-            text = "".join(lines[-int(tail_lines):]) if tail_lines else ""
+            text = "".join(lines[-n:]) if n else ""
         return text
 
     # -- Kubelet simulator -------------------------------------------------

@@ -50,6 +50,13 @@ def main(argv=None) -> int:
     p_logs.add_argument("name")
     p_logs.add_argument("--master", action="store_true",
                         help="only the master/chief/worker-0 replica")
+    p_logs.add_argument(
+        "-c", "--container", default=None,
+        help="container name (required by the apiserver for "
+        "multi-container pods)",
+    )
+    p_logs.add_argument("--tail", type=int, default=None,
+                        help="only the last N lines (tailLines)")
 
     p_watch = sub.add_parser(
         "watch", help="stream status transitions until terminal/timeout"
@@ -107,7 +114,8 @@ def _run(args) -> int:
             print(format_event(event), flush=True)
     elif args.verb == "logs":
         for name, text in client.get_logs(
-            args.name, master=args.master
+            args.name, master=args.master,
+            container=args.container, tail_lines=args.tail,
         ).items():
             print(f"==> {name} <==")
             print(text)

@@ -180,13 +180,50 @@ class FakeApiServer:
                     return self._watch(plural, params)
                 with store.lock:
                     if subresource == "log" and plural == "pods":
-                        if (plural, namespace, name) not in store.objects:
+                        pod = store.objects.get((plural, namespace, name))
+                        if pod is None:
                             return self._error(404, "NotFound", f"pod {name}")
+                        # the real apiserver's contract: ?container= must
+                        # name a container of the pod, and is REQUIRED
+                        # once the pod has more than one
+                        containers = [
+                            c.get("name", "")
+                            for c in pod.get("spec", {}).get("containers", [])
+                        ]
+                        requested = params.get("container", [None])[0]
+                        if requested is not None and requested not in containers:
+                            return self._error(
+                                400, "BadRequest",
+                                f"container {requested} is not valid for "
+                                f"pod {name}",
+                            )
+                        if requested is None and len(containers) > 1:
+                            return self._error(
+                                400, "BadRequest",
+                                f"a container name must be specified for "
+                                f"pod {name}, choose one of {containers}",
+                            )
                         text = store.pod_logs.get((namespace, name), "")
+                        if "tailLines" in params:
+                            raw = params["tailLines"][0]
+                            try:
+                                n = int(raw)
+                            except ValueError:
+                                n = -1
+                            if n < 0:  # the apiserver's Invalid class
+                                return self._error(
+                                    400, "BadRequest",
+                                    f"tailLines must be a non-negative "
+                                    f"integer, got {raw!r}",
+                                )
+                            lines = text.splitlines(keepends=True)
+                            text = "".join(lines[-n:]) if n else ""
+                        body = text.encode()
                         self.send_response(200)
                         self.send_header("Content-Type", "text/plain")
+                        self.send_header("Content-Length", str(len(body)))
                         self.end_headers()
-                        self.wfile.write(text.encode())
+                        self.wfile.write(body)
                         return None
                     if name is not None:
                         obj = store.objects.get((plural, namespace, name))
