@@ -649,6 +649,67 @@ class TestAsyncCheckpoint:
         np.testing.assert_allclose(np.asarray(orig), np.asarray(back))
 
 
+class TestPreemptionGuard:
+    """train/preemption.py: SIGTERM latches instead of killing; fit()
+    drains the step, checkpoints, and reports 'preempted'. The
+    process-level contract (exit 143 + resume) is pinned in
+    tests/test_e2e.py::TestPreemptionRecovery."""
+
+    def test_guard_latches_sigterm_and_restores_handler(self):
+        import os
+        import signal
+        import time as _time
+
+        from tf_operator_tpu.train.preemption import PreemptionGuard
+
+        before = signal.getsignal(signal.SIGTERM)
+        with PreemptionGuard() as guard:
+            assert not guard.triggered.is_set()
+            os.kill(os.getpid(), signal.SIGTERM)
+            deadline = _time.time() + 5
+            while not guard.triggered.is_set() and _time.time() < deadline:
+                _time.sleep(0.01)
+            assert guard.triggered.is_set(), "SIGTERM did not latch"
+        assert signal.getsignal(signal.SIGTERM) is before
+
+    def test_fit_checkpoints_on_sigterm(self, tmp_path):
+        import os
+        import signal
+        import threading
+
+        model = mnist_lib.MnistCNN()
+        rng = jax.random.PRNGKey(6)
+        sample = mnist_lib.synthetic_batch(rng, 16)
+        trainer = Trainer(
+            model, classification_task(model), optax.adam(1e-3),
+            checkpoint_dir=str(tmp_path / "preempt-ckpt"),
+        )
+        state = trainer.init(rng, sample)
+
+        fired = threading.Event()
+
+        def batches():
+            first = True
+            while True:
+                if not first and not fired.is_set():
+                    # preempt after the first step completed
+                    os.kill(os.getpid(), signal.SIGTERM)
+                    fired.set()
+                first = False
+                yield sample
+
+        state, metrics = trainer.fit(
+            state, batches(), steps=100000, log_every=10,
+        )
+        assert metrics.get("preempted") == 1.0
+        saved = int(state.step)
+        assert 0 < saved < 100000  # stopped early, not at the end
+        fresh = trainer.init(jax.random.PRNGKey(0), sample)
+        restored = trainer.restore(fresh)
+        assert restored is not None
+        assert int(restored.step) == saved
+
+
 class TestGradientAccumulation:
     """accum_steps=k must produce the same optimizer update as the
     full-batch step whenever the per-example losses weigh uniformly

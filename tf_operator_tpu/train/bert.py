@@ -105,11 +105,17 @@ def main(argv=None) -> int:
     float(metrics["loss"])
 
     from .input_pipeline import InputPipeline, synthetic_source
+    from .preemption import PreemptionGuard, maybe_preempt_exit
     from .profiling import StepProfiler
 
-    profiler = StepProfiler(args.profile_dir, args.steps, window=(0, 5))
+    # --steps is the TOTAL budget: a resumed process runs the remainder
+    remaining = max(0, args.steps - int(state.step))
+    profiler = StepProfiler(args.profile_dir, remaining, window=(0, 5))
+    guard = PreemptionGuard()
+    steps_run = 0
     start = time.perf_counter()
     try:
+        guard.__enter__()
         # fresh per-step synthetic batches through the host input
         # pipeline: prep + placement overlap the previous step's
         # compute, and loss tracks progress rather than single-batch
@@ -120,7 +126,7 @@ def main(argv=None) -> int:
                     key, args.batch_size, args.seq_len, cfg
                 )
             ),
-            trainer=trainer, depth=2, steps=args.steps,
+            trainer=trainer, depth=2, steps=remaining,
         ) as pipe:
             for step, batch in enumerate(pipe):
                 profiler.before_step(step)
@@ -128,6 +134,12 @@ def main(argv=None) -> int:
                 profiler.after_step(
                     step, drain=lambda: float(metrics["loss"])
                 )
+                steps_run += 1
+                rc = maybe_preempt_exit(
+                    guard, trainer, state, args.checkpoint_dir
+                )
+                if rc is not None:
+                    return rc
                 if (step + 1) % args.log_every == 0:
                     logger.info(
                         "step %d loss=%.4f", int(state.step),
@@ -135,9 +147,10 @@ def main(argv=None) -> int:
                     )
         loss = float(metrics["loss"])  # forces the chain
     finally:
+        guard.__exit__()
         profiler.close()
     elapsed = time.perf_counter() - start
-    tokens = args.batch_size * args.seq_len * args.steps
+    tokens = args.batch_size * args.seq_len * max(steps_run, 1)
     n_chips = len(jax.devices())
     logger.info(
         "tokens/sec/chip: %.1f (loss %.4f)", tokens / elapsed / n_chips, loss

@@ -107,21 +107,31 @@ def main(argv=None) -> int:
     float(metrics["loss"])  # compile + warm
 
     from .input_pipeline import InputPipeline, synthetic_source
+    from .preemption import PreemptionGuard, maybe_preempt_exit
 
+    # --steps is the TOTAL budget: a resumed process runs the remainder
+    remaining = max(0, args.steps - int(state.step))
+    steps_run = 0
     start = time.perf_counter()
     # host batch prep + device placement overlap the previous step's
     # compute (train/input_pipeline.py: background producer, depth-2
     # double buffering) instead of running synchronously between steps
-    with InputPipeline(
+    with PreemptionGuard() as guard, InputPipeline(
         source=synthetic_source(
             lambda key: gpt_lib.synthetic_batch(
                 key, args.batch_size, args.seq_len, cfg
             )
         ),
-        trainer=trainer, depth=2, steps=args.steps,
+        trainer=trainer, depth=2, steps=remaining,
     ) as pipe:
         for step, batch in enumerate(pipe):
             state, metrics = trainer.step(state, batch)
+            steps_run += 1
+            rc = maybe_preempt_exit(
+                guard, trainer, state, args.checkpoint_dir
+            )
+            if rc is not None:
+                return rc
             if (step + 1) % args.log_every == 0:
                 logger.info(
                     "step %d loss=%.4f", int(state.step),
@@ -129,7 +139,7 @@ def main(argv=None) -> int:
                 )
     loss = float(metrics["loss"])
     elapsed = time.perf_counter() - start
-    tokens = args.batch_size * args.seq_len * args.steps
+    tokens = args.batch_size * args.seq_len * max(steps_run, 1)
     n_chips = len(jax.devices())
     logger.info(
         "tokens/sec/chip: %.1f (loss %.4f)", tokens / elapsed / n_chips, loss

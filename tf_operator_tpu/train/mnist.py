@@ -102,6 +102,16 @@ def main(argv=None) -> int:
         )
     wall_seconds = _time.perf_counter() - train_start
     logger.info("final: %s", metrics)
+    if metrics.get("preempted"):
+        # graceful-preemption contract (train/preemption.py): the
+        # checkpoint is already written by fit(); exit with the
+        # RETRYABLE code so the operator's ExitCode policy restarts
+        # the slice and the relaunch resumes from the saved step
+        from .preemption import PREEMPTED_EXIT_CODE
+
+        logger.warning("exiting with retryable code %d after preemption",
+                       PREEMPTED_EXIT_CODE)
+        return PREEMPTED_EXIT_CODE
     if args.checkpoint_dir:
         trainer.save(state)
 

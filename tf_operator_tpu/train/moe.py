@@ -99,27 +99,40 @@ def main(argv=None) -> int:
     state, metrics = trainer.step(state, trainer.place_batch(sample))  # compile
     float(metrics["loss"])
 
+    from .preemption import PreemptionGuard, maybe_preempt_exit
+
+    # --steps is the TOTAL budget: a resumed process runs the remainder
+    remaining = max(0, args.steps - int(state.step))
+    steps_run = 0
     start = time.perf_counter()
-    for step in range(args.steps):
-        # fresh synthetic batch per step (same pattern as train/gpt.py):
-        # loss tracks training progress, not single-batch memorization,
-        # and the router sees a changing token distribution
-        batch = trainer.place_batch(
-            moe_lib.synthetic_batch(
-                jax.random.fold_in(rng, step), args.batch_size, args.seq_len,
-                cfg,
+    with PreemptionGuard() as guard:
+        for step in range(remaining):
+            # fresh synthetic batch per step (same pattern as
+            # train/gpt.py): loss tracks training progress, not single-
+            # batch memorization, and the router sees a changing token
+            # distribution
+            batch = trainer.place_batch(
+                moe_lib.synthetic_batch(
+                    jax.random.fold_in(rng, step), args.batch_size,
+                    args.seq_len, cfg,
+                )
             )
-        )
-        state, metrics = trainer.step(state, batch)
-        if (step + 1) % args.log_every == 0:
-            logger.info(
-                "step %d loss=%.4f router_aux=%.5f",
-                int(state.step), float(metrics["loss"]),
-                float(metrics["router_aux"]),
+            state, metrics = trainer.step(state, batch)
+            steps_run += 1
+            rc = maybe_preempt_exit(
+                guard, trainer, state, args.checkpoint_dir
             )
+            if rc is not None:
+                return rc
+            if (step + 1) % args.log_every == 0:
+                logger.info(
+                    "step %d loss=%.4f router_aux=%.5f",
+                    int(state.step), float(metrics["loss"]),
+                    float(metrics["router_aux"]),
+                )
     loss = float(metrics["loss"])
     elapsed = time.perf_counter() - start
-    tokens = args.batch_size * args.seq_len * args.steps
+    tokens = args.batch_size * args.seq_len * max(steps_run, 1)
     n_chips = len(jax.devices())
     logger.info(
         "tokens/sec/chip: %.1f (loss %.4f)", tokens / elapsed / n_chips, loss

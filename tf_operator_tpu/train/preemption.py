@@ -1,0 +1,85 @@
+"""SIGTERM-graceful checkpointing — the preemptible-slice contract.
+
+Preemptible/spot TPU slices get a SIGTERM + grace period before the
+host disappears; Kubernetes pod deletion delivers exactly the same
+signal (the reference's operator tears pods down through the apiserver
+and the kubelet SIGTERMs the container — reference pod.go:185-208 via
+CleanPodPolicy; our ProcessKubelet mirrors it with Popen.terminate).
+The reference framework leaves surviving a preemption entirely to user
+TF code (SURVEY.md §5: checkpointing is "the workload's job"); here it
+is first-class: `Trainer.fit` drains the in-flight step, writes a
+final checkpoint, and reports the preemption, so the CLI can exit with
+a RETRYABLE code (143 = 128+SIGTERM, in the operator's retryable set,
+util/train/train_util.go:18-53 semantics) — the controller restarts
+the whole slice and the relaunched processes resume from the saved
+step. Preemption recovery = slice restart + checkpoint resume, the
+TPU-native elasticity loop (SURVEY.md §7 hard part #3).
+"""
+
+from __future__ import annotations
+
+import logging
+import signal
+import threading
+
+logger = logging.getLogger("tf_operator_tpu.preemption")
+
+# 128 + SIGTERM: what the process would have exited with had it died
+# un-gracefully — and a code the operator classifies as retryable, so
+# the restart policy fires exactly as for a hard preemption
+PREEMPTED_EXIT_CODE = 143
+
+
+class PreemptionGuard:
+    """Context manager that latches SIGTERM instead of dying.
+
+    Inside the context, the first SIGTERM sets `triggered` (checked by
+    the train loop between steps); the previous handler is restored on
+    exit. Installing a handler is only possible on the main thread —
+    elsewhere (threaded tests, notebook executors) the guard degrades
+    to never-triggered rather than raising.
+    """
+
+    def __init__(self) -> None:
+        self.triggered = threading.Event()
+        self._prev = None
+        self._installed = False
+
+    def _handle(self, signum, frame) -> None:
+        logger.warning("SIGTERM received — draining step, then checkpoint")
+        self.triggered.set()
+
+    def __enter__(self) -> "PreemptionGuard":
+        try:
+            self._prev = signal.signal(signal.SIGTERM, self._handle)
+            self._installed = True
+        except ValueError:
+            logger.debug("not on main thread; preemption guard inactive")
+        return self
+
+    def __exit__(self, *exc) -> None:
+        if self._installed:
+            signal.signal(signal.SIGTERM, self._prev)
+            self._installed = False
+
+
+def maybe_preempt_exit(guard, trainer, state, checkpoint_dir):
+    """The CLI-side preemption epilogue, shared by every train CLI that
+    runs its own step loop (bert/gpt/moe/resnet; Trainer.fit embeds the
+    same logic): if the guard latched a SIGTERM, checkpoint (when
+    configured), log either way, and return PREEMPTED_EXIT_CODE for
+    the CLI to exit with; None means keep training."""
+    if not guard.triggered.is_set():
+        return None
+    if checkpoint_dir:
+        trainer.save(state)
+        logger.warning(
+            "preempted at step %d — checkpoint saved, resume will "
+            "continue from here", int(state.step),
+        )
+    else:
+        logger.warning(
+            "preempted at step %d with NO checkpoint_dir — progress "
+            "will be lost on restart", int(state.step),
+        )
+    return PREEMPTED_EXIT_CODE

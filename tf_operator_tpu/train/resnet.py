@@ -90,25 +90,39 @@ def main(argv=None) -> int:
         if restored is not None:
             state = restored
 
+    from .preemption import PreemptionGuard, maybe_preempt_exit
     from .profiling import StepProfiler
 
     state, metrics = trainer.step(state, batch)  # compile
     float(metrics["loss"])
-    profiler = StepProfiler(args.profile_dir, args.steps, window=(0, 5))
+    # --steps is the TOTAL budget: a resumed process runs the remainder
+    remaining = max(0, args.steps - int(state.step))
+    steps_run = 0
+    profiler = StepProfiler(args.profile_dir, remaining, window=(0, 5))
+    guard = PreemptionGuard()
     start = time.perf_counter()
     try:
-        for step in range(args.steps):
+        guard.__enter__()
+        for step in range(remaining):
             profiler.before_step(step)
             state, metrics = trainer.step(state, batch)
             profiler.after_step(step, drain=lambda: float(metrics["loss"]))
+            steps_run += 1
+            rc = maybe_preempt_exit(
+                guard, trainer, state, args.checkpoint_dir
+            )
+            if rc is not None:
+                return rc
             if (step + 1) % args.log_every == 0:
                 logger.info("step %d loss=%.4f", int(state.step), float(metrics["loss"]))
         float(metrics["loss"])
     finally:
+        guard.__exit__()
         profiler.close()
     elapsed = time.perf_counter() - start
     logger.info(
-        "images/sec/chip: %.1f", global_batch * args.steps / elapsed / n_chips
+        "images/sec/chip: %.1f",
+        global_batch * max(steps_run, 1) / elapsed / n_chips,
     )
     if args.checkpoint_dir:
         trainer.save(state)

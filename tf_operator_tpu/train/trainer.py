@@ -526,7 +526,12 @@ class Trainer:
         profile_dir: Optional[str] = None,
         profile_window: Tuple[int, int] = (3, 8),
     ) -> Tuple[TrainState, Dict[str, float]]:
-        """metrics_callback(step, metrics_dict) fires on every logging
+        """Run up to `steps` TOTAL optimizer steps: steps already in
+        state.step (a restored checkpoint) count toward the budget, so
+        a preempted-and-restarted job converges on `steps` instead of
+        running a full budget per restart.
+
+        metrics_callback(step, metrics_dict) fires on every logging
         interval — the hook summary writers attach to (the reference's
         mnist_with_summaries example plays this role with TF summaries).
 
@@ -534,15 +539,37 @@ class Trainer:
         TensorBoard or Perfetto) over profile_window's [start, stop)
         steps — the workload-layer half of the reference's pprof-style
         self-profiling (SURVEY.md §5, main.go:21), skipping the compile
-        step so the trace shows steady-state device time."""
+        step so the trace shows steady-state device time.
+
+        SIGTERM (preemptible-slice eviction, pod deletion) is handled
+        gracefully when a checkpoint_dir is configured: the in-flight
+        step drains, a final checkpoint is written, and the returned
+        metrics carry "preempted": 1.0 so the CLI can exit with the
+        retryable code 143 — slice restart + resume instead of lost
+        work (train/preemption.py)."""
+        from .preemption import PreemptionGuard
         from .profiling import StepProfiler
 
         last_metrics: Dict[str, float] = {}
         interval_start = time.perf_counter()
         interval_steps = 0
-        profiler = StepProfiler(profile_dir, steps, profile_window)
+        # `steps` is the TOTAL step budget, counting steps already in
+        # state.step: a restarted process that restored a checkpoint
+        # runs only the remainder, so repeated preemption restarts
+        # converge on the requested budget instead of inflating it by
+        # a full budget per restart
+        start_step = int(state.step)
+        remaining = max(0, steps - start_step)
+        if remaining < steps:
+            logger.info(
+                "step budget %d: resumed at %d, running %d more",
+                steps, start_step, remaining,
+            )
+        profiler = StepProfiler(profile_dir, remaining, profile_window)
+        guard = PreemptionGuard()
         try:
-            for i in range(steps):
+            guard.__enter__()
+            for i in range(remaining):
                 profiler.before_step(i)
                 batch = self.place_batch(next(batches))
                 state, metrics = self.step(state, batch)
@@ -553,11 +580,34 @@ class Trainer:
                         lambda x: x.block_until_ready(), metrics
                     ),
                 )
+                if guard.triggered.is_set():
+                    last_metrics = {k: float(v) for k, v in metrics.items()}
+                    last_metrics["preempted"] = 1.0
+                    if self._ckpt is not None:
+                        # blocking: the grace period is short and the
+                        # next thing this process does is exit
+                        self.save(state)
+                        logger.warning(
+                            "preempted at step %d — checkpoint saved, "
+                            "resume will continue from here",
+                            int(state.step),
+                        )
+                    else:
+                        logger.warning(
+                            "preempted at step %d with NO checkpoint_dir "
+                            "— progress will be lost on restart",
+                            int(state.step),
+                        )
+                    if metrics_callback is not None:
+                        # the summary stream records the preemption
+                        # point, not just the last log_every interval
+                        metrics_callback(int(state.step), dict(last_metrics))
+                    break
                 if checkpoint_every and (i + 1) % checkpoint_every == 0:
                     # async: the write overlaps the next steps' compute;
                     # the finally block flushes whatever is in flight
                     self.save(state, block=False)
-                if (i + 1) % log_every == 0 or i + 1 == steps:
+                if (i + 1) % log_every == 0 or i + 1 == remaining:
                     last_metrics = {
                         k: float(v) for k, v in metrics.items()
                     }
@@ -578,6 +628,7 @@ class Trainer:
                     if metrics_callback is not None:
                         metrics_callback(int(state.step), dict(last_metrics))
         finally:
+            guard.__exit__()
             # an exception mid-loop must still stop the (process-global)
             # jax trace, or every later profiled run in this process
             # fails with "profiler is already active"
