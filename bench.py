@@ -308,24 +308,35 @@ def bench_bert(
     }
 
 
-def setup_gpt(on_tpu: bool, n_chips: int, attention: str = "flash"):
+def setup_gpt(
+    on_tpu: bool, n_chips: int, attention: str = "flash",
+    remat: bool = False, batch_override: int | None = None,
+):
     """(trainer, state, placed_batch, meta) for the canonical GPT
     long-context benchmark configuration — shared with
-    benchmarks/model_profile.py (see setup_resnet)."""
+    benchmarks/model_profile.py (see setup_resnet). remat: per-block
+    rematerialization (activation memory ~1 block instead of all 12,
+    bought with an extra forward in the backward)."""
     from tf_operator_tpu.models import gpt as gpt_lib
     from tf_operator_tpu.parallel.mesh import MeshConfig, build_mesh
     from tf_operator_tpu.train import Trainer, causal_lm_task
 
     if on_tpu:
-        cfg = gpt_lib.GPTConfig(max_seq_len=4096)  # GPT-small, hd 128
+        cfg = gpt_lib.GPTConfig(max_seq_len=4096, remat=remat)  # GPT-small
         # batch 4/chip: the [b, s, vocab] logits (bf16 since the fused
         # loss, f32 transients inside the loss fusion) plus 12 layers
         # of activations at seq 4096 — batch 8 crowds the v5e's 16GB;
-        # 4 leaves headroom and 16k tokens/step is plenty for MFU
+        # 4 leaves headroom and 16k tokens/step is plenty for MFU.
+        # (The remat extra probes whether trading that recompute for
+        # batch 8 nets throughput — see gpt_remat in run_extras.)
         per_chip_batch, seq = 4, 4096
     else:
-        cfg = gpt_lib.GPT_TINY
+        import dataclasses as _dc
+
+        cfg = _dc.replace(gpt_lib.GPT_TINY, remat=remat)
         per_chip_batch, seq = 2, 128
+    if batch_override is not None:
+        per_chip_batch = batch_override
 
     if attention == "xla":
         from tf_operator_tpu.ops.attention import dot_product_attention
@@ -357,7 +368,8 @@ def setup_gpt(on_tpu: bool, n_chips: int, attention: str = "flash"):
 
 def bench_gpt(
     on_tpu: bool, n_chips: int, attention: str = "flash",
-    steps: int | None = None,
+    steps: int | None = None, remat: bool = False,
+    batch_override: int | None = None,
 ) -> dict:
     """Long-context causal LM (GPT-small @ seq 4096): the shape class
     where flash attention is load-bearing — the XLA path materializes
@@ -365,7 +377,10 @@ def bench_gpt(
     config) while the kernel stays O(seq). attention="xla" is the
     guarded A/B; an OOM there is itself the measurement."""
     steps = steps if steps is not None else (15 if on_tpu else 3)
-    trainer, state, batch, meta = setup_gpt(on_tpu, n_chips, attention)
+    trainer, state, batch, meta = setup_gpt(
+        on_tpu, n_chips, attention, remat=remat,
+        batch_override=batch_override,
+    )
     global_batch, seq, cfg = meta["global_batch"], meta["seq"], meta["cfg"]
     flops = transformer_step_flops(
         state.params, global_batch, seq, cfg, causal=True
@@ -551,6 +566,22 @@ def run_extras(on_tpu: bool, n_chips: int, line: dict) -> None:
             batch * (prompt_len - 1 + new) / elapsed, 2
         )
 
+    def gpt_remat():
+        # the HBM/FLOPs trade (jax.checkpoint): per-block remat frees
+        # ~11 layers of activations at seq 4096, buying per-chip batch
+        # 8 where the default config tops out at 4 — does the extra
+        # backward forward pay for itself in throughput? (an OOM lands
+        # in gpt_remat_error and is itself a measurement)
+        bs = 8 if on_tpu else 2
+        r = bench_gpt(
+            on_tpu, n_chips, steps=10 if on_tpu else None, remat=True,
+            batch_override=bs,
+        )
+        line[f"gpt_remat_bs{bs}_tokens_per_sec_per_chip"] = r[
+            "tokens_per_sec_per_chip"
+        ]
+        line[f"gpt_remat_bs{bs}_mfu"] = r["mfu"]
+
     def gpt_long_xla():
         # the A/B where the kernel is load-bearing: the XLA path's
         # quadratic score materialization at seq 4096 — an OOM lands
@@ -632,6 +663,7 @@ def run_extras(on_tpu: bool, n_chips: int, line: dict) -> None:
         extra("gpt_long", gpt_long)
         extra("gpt_decode", gpt_decode)
         extra("gpt_decode_tp", gpt_decode_tp)
+        extra("gpt_remat", gpt_remat)
         extra("bert_wide", bert_wide)
     extra("resnet_flax_bn", flax_ab)
     if gated:  # stem A/B only meaningful at the real 224/3-channel shape

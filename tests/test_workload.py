@@ -233,6 +233,76 @@ class TestTraining:
                 for _ in range(3):
                     next(pipe)
 
+    def test_shard_source_streams_files_and_trains(self, tmp_path, devices8):
+        """shard_source: on-disk .npz shards -> host batches -> device
+        via InputPipeline, with cross-shard batch stitching, per-epoch
+        reshuffle, and multi-host round-robin partitioning."""
+        import numpy as np_
+
+        from tf_operator_tpu.train import (
+            InputPipeline, shard_source, write_shards,
+        )
+
+        rng = jax.random.PRNGKey(3)
+        # 50 examples over shards of 16 -> batches of 8 must stitch
+        # across shard boundaries (50 = 3 shards of 16 + one of 2)
+        full = mnist_lib.synthetic_batch(rng, 50)
+        host = {k: np_.asarray(v) for k, v in jax.device_get(full).items()}
+        count = write_shards(tmp_path / "data", host, shard_size=16)
+        assert count == 4
+
+        # one epoch, batch 8, drop remainder -> exactly 6 batches
+        batches = list(
+            shard_source(tmp_path / "data", batch_size=8, epochs=1)
+        )
+        assert len(batches) == 6
+        assert all(b["image"].shape[0] == 8 for b in batches)
+        # every example appears at most once per epoch (shuffle is of
+        # shard ORDER, batches stitch in order within it)
+        labels = np_.concatenate([b["label"] for b in batches])
+        assert len(labels) == 48
+
+        # epoch boundaries reset the stitch buffer: 2 epochs yield
+        # exactly 2 x 6 batches (the 2-example tail drops EACH epoch,
+        # never leaking into the next epoch's shuffle)
+        two_epochs = list(
+            shard_source(tmp_path / "data", batch_size=8, epochs=2)
+        )
+        assert len(two_epochs) == 12
+
+        # multi-host SPMD discipline: every host yields the SAME batch
+        # count (truncated to the fleet-wide minimum, here proc1's
+        # 16+2 examples -> 2 batches), so no host stops stepping while
+        # peers wait in a collective
+        a = list(shard_source(tmp_path / "data", 8, epochs=1,
+                              process_id=0, num_processes=2))
+        b = list(shard_source(tmp_path / "data", 8, epochs=1,
+                              process_id=1, num_processes=2))
+        assert (len(a), len(b)) == (2, 2)
+        # different epochs reshuffle shard order
+        seed0 = list(shard_source(tmp_path / "data", 16, epochs=1))
+        seed0b = list(shard_source(tmp_path / "data", 16, epochs=1))
+        np_.testing.assert_array_equal(
+            seed0[0]["label"], seed0b[0]["label"]
+        )  # deterministic for the same seed/epoch
+
+        # and it trains through the pipeline
+        mesh = build_mesh(MeshConfig(dp=8))
+        model = mnist_lib.MnistCNN()
+        trainer = Trainer(
+            model, classification_task(model), optax.adam(1e-3), mesh=mesh
+        )
+        state = trainer.init(rng, mnist_lib.synthetic_batch(rng, 8))
+        with InputPipeline(
+            source=shard_source(tmp_path / "data", 8, epochs=1),
+            trainer=trainer, depth=2,
+        ) as pipe:
+            n = 0
+            for batch in pipe:
+                state, metrics = trainer.step(state, batch)
+                n += 1
+        assert n == 6 and np.isfinite(float(metrics["loss"]))
+
     def test_bert_remat_matches_nonremat(self, devices8):
         """Per-block remat (BertConfig.remat) is a pure memory/FLOPs
         trade: the loss and gradients must be identical."""

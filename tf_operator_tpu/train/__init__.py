@@ -1,4 +1,9 @@
-from .input_pipeline import InputPipeline, synthetic_source
+from .input_pipeline import (
+    InputPipeline,
+    shard_source,
+    synthetic_source,
+    write_shards,
+)
 from .trainer import (
     Checkpointer,
     Task,
@@ -23,4 +28,6 @@ __all__ = [
     "Checkpointer",
     "InputPipeline",
     "synthetic_source",
+    "shard_source",
+    "write_shards",
 ]

@@ -163,3 +163,142 @@ def synthetic_source(make_batch: Callable[[jax.Array], dict], seed: int = 0):
         return make_batch(jax.random.fold_in(jax.random.PRNGKey(seed), step))
 
     return source
+
+
+def shard_source(
+    directory,
+    batch_size: int,
+    shuffle_seed: Optional[int] = 0,
+    epochs: Optional[int] = None,
+    process_id: int = 0,
+    num_processes: int = 1,
+    drop_remainder: bool = True,
+):
+    """Host-batch source over on-disk .npz shards — the file-backed
+    counterpart of synthetic_source (the reference's workloads read
+    real data with tf.data inside the container; this is the
+    framework-native path: numpy shards + background prefetch via
+    InputPipeline, no TF dependency).
+
+    Layout: `directory/*.npz`, each file a dict of equal-leading-dim
+    arrays (e.g. {"image": [n, ...], "label": [n]}); write them with
+    `write_shards`. Multi-host: shards are partitioned round-robin by
+    (process_id, num_processes) — each host reads a disjoint subset,
+    which composes with the Trainer's dp sharding of the per-host
+    batch. Shard order reshuffles every epoch from shuffle_seed;
+    epochs=None streams forever. Batches may span shard boundaries;
+    with drop_remainder a final short batch is dropped (static shapes
+    for jit).
+    """
+    import os as _os
+
+    import numpy as np
+
+    all_paths = sorted(
+        _os.path.join(directory, f)
+        for f in _os.listdir(directory)
+        if f.endswith(".npz")
+    )
+    paths = all_paths[process_id::num_processes]
+    if not paths:
+        raise FileNotFoundError(
+            f"no .npz shards for process {process_id}/{num_processes} "
+            f"in {directory}"
+        )
+    # Multi-host SPMD discipline: every host must issue the SAME number
+    # of train steps per epoch, or the host with fewer batches stops
+    # stepping while its peers block in a collective. Shard sizes are
+    # read from the npy headers (no array data loaded), each host's
+    # per-epoch yield computed, and every host truncates to the
+    # fleet-wide minimum.
+    per_epoch = None
+    if num_processes > 1 and drop_remainder:
+        totals = [
+            sum(
+                _shard_len(p)
+                for p in all_paths[proc::num_processes]
+            )
+            for proc in range(num_processes)
+        ]
+        per_epoch = min(total // batch_size for total in totals)
+
+    def batches():
+        epoch = 0
+        while epochs is None or epoch < epochs:
+            order = list(paths)
+            if shuffle_seed is not None:
+                np.random.RandomState(shuffle_seed + epoch).shuffle(order)
+            # the stitch buffer resets every epoch: batches never mix
+            # examples from two different epoch shuffles
+            pending: Optional[dict] = None
+            yielded = 0
+            for path in order:
+                with np.load(path) as data:
+                    arrays = {key: data[key] for key in data.files}
+                if pending is not None:
+                    arrays = {
+                        key: np.concatenate([pending[key], arrays[key]])
+                        for key in arrays
+                    }
+                    pending = None
+                n = len(next(iter(arrays.values())))
+                start = 0
+                while n - start >= batch_size:
+                    if per_epoch is not None and yielded >= per_epoch:
+                        break
+                    yield {
+                        key: value[start:start + batch_size]
+                        for key, value in arrays.items()
+                    }
+                    yielded += 1
+                    start += batch_size
+                if start < n:
+                    pending = {
+                        key: value[start:] for key, value in arrays.items()
+                    }
+            if pending is not None and not drop_remainder:
+                yield pending
+            epoch += 1
+
+    return batches()
+
+
+def _shard_len(path) -> int:
+    """Leading-dim length of the first array in an .npz, read from the
+    npy header only (no decompression of array data)."""
+    import zipfile
+
+    import numpy as np
+
+    with zipfile.ZipFile(path) as zf:
+        name = sorted(zf.namelist())[0]
+        with zf.open(name) as handle:
+            version = np.lib.format.read_magic(handle)
+            reader = (
+                np.lib.format.read_array_header_1_0
+                if version == (1, 0)
+                else np.lib.format.read_array_header_2_0
+            )
+            shape, _, _ = reader(handle)
+            return shape[0]
+
+
+def write_shards(
+    directory, arrays: dict, shard_size: int, prefix: str = "shard"
+) -> int:
+    """Split a dict of equal-leading-dim arrays into .npz shard files
+    consumable by shard_source; returns the shard count."""
+    import os as _os
+
+    import numpy as np
+
+    _os.makedirs(directory, exist_ok=True)
+    total = len(next(iter(arrays.values())))
+    count = 0
+    for start in range(0, total, shard_size):
+        np.savez(
+            _os.path.join(directory, f"{prefix}-{count:05d}.npz"),
+            **{k: v[start:start + shard_size] for k, v in arrays.items()},
+        )
+        count += 1
+    return count
