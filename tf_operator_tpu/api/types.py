@@ -49,6 +49,11 @@ ENV_TPU_WORKER_HOSTNAMES = "TPU_WORKER_HOSTNAMES"
 ENV_TPU_TOPOLOGY = "TPU_TOPOLOGY"
 ENV_TPU_ACCELERATOR = "TPU_ACCELERATOR_TYPE"
 ENV_COORDINATOR_ADDRESS = "JAX_COORDINATOR_ADDRESS"
+# honored by parallel.distributed.read_process_env: remaps ONLY the
+# coordinator endpoint (identity env stays authoritative) — hermetic
+# E2Es and local repros rendezvous over 127.0.0.1 where the injected
+# headless-service DNS name cannot resolve
+ENV_COORDINATOR_OVERRIDE = "TFJOB_COORDINATOR_OVERRIDE"
 ENV_NUM_PROCESSES = "JAX_NUM_PROCESSES"
 ENV_PROCESS_ID = "JAX_PROCESS_ID"
 ENV_CUSTOM_CLUSTER_DOMAIN = "CUSTOM_CLUSTER_DOMAIN"

@@ -17,6 +17,7 @@ from typing import Optional
 
 from ..api.types import (
     ENV_COORDINATOR_ADDRESS,
+    ENV_COORDINATOR_OVERRIDE,
     ENV_NUM_PROCESSES,
     ENV_PROCESS_ID,
     ENV_TPU_ACCELERATOR,
@@ -54,7 +55,13 @@ def read_process_env(environ=None) -> ProcessEnv:
     hostnames = tuple(h for h in hostnames_raw.split(",") if h)
     process_id = int(env.get(ENV_PROCESS_ID, env.get(ENV_TPU_WORKER_ID, "0")))
     num_processes = int(env.get(ENV_NUM_PROCESSES, str(len(hostnames) or 1)))
-    coordinator = env.get(ENV_COORDINATOR_ADDRESS)
+    # the controller-injected coordinator is a headless-service DNS
+    # name, resolvable only inside a cluster; the override remaps JUST
+    # the endpoint (identity env stays authoritative) so hermetic E2Es
+    # and local repros can rendezvous over 127.0.0.1
+    coordinator = env.get(
+        ENV_COORDINATOR_OVERRIDE, env.get(ENV_COORDINATOR_ADDRESS)
+    )
     if coordinator is None and hostnames:
         coordinator = f"{hostnames[0]}:2222"
     return ProcessEnv(

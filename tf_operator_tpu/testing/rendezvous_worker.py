@@ -25,27 +25,23 @@ TPU replica type, job success is all-hosts-succeeded
 (controller/status.py TPU branch), so "the TFJob Succeeded" ==
 "every worker's in-process world view was correct".
 
-``TFJOB_LOCAL_COORDINATOR``: the operator injects the coordinator as a
-headless-service DNS name (cluster_spec.py set_tpu_env) which only
-resolves inside a real cluster; the hermetic E2E maps it to
-127.0.0.1:port via this test-only variable. Identity env is NOT
-overridden — only the unresolvable endpoint.
+The operator injects the coordinator as a headless-service DNS name
+(cluster_spec.py set_tpu_env) which only resolves inside a real
+cluster; the hermetic E2E maps it to 127.0.0.1:port via
+``TFJOB_COORDINATOR_OVERRIDE`` (honored by
+parallel.distributed.read_process_env for every workload, not just
+this one). Identity env is NOT overridden — only the unresolvable
+endpoint.
 """
 
 from __future__ import annotations
 
 import json
-import os
 import sys
 
 
 def main() -> int:
-    from ..api.types import ENV_COORDINATOR_ADDRESS
     from ..parallel import distributed
-
-    override = os.environ.get("TFJOB_LOCAL_COORDINATOR")
-    if override:
-        os.environ[ENV_COORDINATOR_ADDRESS] = override
 
     proc = distributed.initialize()
 
