@@ -399,6 +399,47 @@ def bench_gpt(
     }
 
 
+def bench_vit(on_tpu: bool, n_chips: int, steps: int | None = None) -> dict:
+    """ViT-B/16 @224 classification — the attention-side image model:
+    near-pure transformer GEMMs where ResNet is conv-tiling-limited
+    (PROFILE.md), so the pair brackets the image-model MFU range. MFU
+    uses the same stated transformer formula with seq = patch count."""
+    from tf_operator_tpu.models import vit as vit_lib
+    from tf_operator_tpu.parallel.mesh import MeshConfig, build_mesh
+    from tf_operator_tpu.parallel.sharding import TRANSFORMER_RULES
+    from tf_operator_tpu.train import Trainer, classification_task
+
+    steps = steps if steps is not None else (15 if on_tpu else 3)
+    cfg = vit_lib.VIT_B16 if on_tpu else vit_lib.VIT_TINY
+    per_chip_batch = 128 if on_tpu else 8
+    model = vit_lib.ViT(cfg)
+    mesh = build_mesh(MeshConfig(dp=-1))
+    trainer = Trainer(
+        model, classification_task(model),
+        optax.adamw(1e-3, weight_decay=0.05),
+        mesh=mesh, rules=TRANSFORMER_RULES,
+    )
+    rng = jax.random.PRNGKey(0)
+    global_batch = per_chip_batch * n_chips
+    batch = trainer.place_batch(
+        vit_lib.synthetic_batch(rng, global_batch, cfg)
+    )
+    state = trainer.init(rng, batch)
+    flops = transformer_step_flops(
+        state.params, global_batch, cfg.num_patches, cfg
+    )
+    state, elapsed = time_fused_steps(trainer, state, batch, steps)
+    images_per_sec_chip = global_batch * steps / elapsed / n_chips
+    achieved = flops * steps / elapsed / n_chips
+    peak = peak_flops_per_chip(jax.devices()[0])
+    return {
+        "images_per_sec_per_chip": round(images_per_sec_chip, 2),
+        "mfu": round(achieved / peak, 4) if peak else 0.0,
+        "steps": steps,
+        "global_batch": global_batch,
+    }
+
+
 def _maybe_force_cpu() -> None:
     """BENCH_CPU=1 runs the harness on a virtual 8-device CPU host —
     needed because this image pins JAX to the TPU plugin through
@@ -603,6 +644,13 @@ def run_extras(on_tpu: bool, n_chips: int, line: dict) -> None:
             "images_per_sec_per_chip"
         ]
 
+    def vit():
+        r = bench_vit(on_tpu, n_chips)
+        line["vit_b16_mfu"] = r["mfu"]
+        line["vit_b16_images_per_sec_per_chip"] = r[
+            "images_per_sec_per_chip"
+        ]
+
     def bs512():
         # occupancy probe: does 2x the per-chip batch lift MXU
         # utilization? (guarded: an HBM OOM lands in bs512_error,
@@ -665,6 +713,7 @@ def run_extras(on_tpu: bool, n_chips: int, line: dict) -> None:
         extra("gpt_decode_tp", gpt_decode_tp)
         extra("gpt_remat", gpt_remat)
         extra("bert_wide", bert_wide)
+        extra("vit", vit)
     extra("resnet_flax_bn", flax_ab)
     if gated:  # stem A/B only meaningful at the real 224/3-channel shape
         extra("resnet_s2d", s2d)

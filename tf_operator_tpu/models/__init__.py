@@ -3,6 +3,7 @@ from .gpt import GPT, GPT_SMALL, GPT_TINY, GPTConfig, causal_lm_loss, generate
 from .mnist import MnistCNN
 from .moe import MOE_BASE, MOE_TINY, MoEConfig, MoELM, lm_loss, total_aux_loss
 from .resnet import ResNet, ResNet18ish, ResNet50
+from .vit import VIT_B16, VIT_TINY, ViT, ViTConfig
 
 __all__ = [
     "MnistCNN",
@@ -27,4 +28,8 @@ __all__ = [
     "MOE_TINY",
     "lm_loss",
     "total_aux_loss",
+    "ViT",
+    "ViTConfig",
+    "VIT_B16",
+    "VIT_TINY",
 ]

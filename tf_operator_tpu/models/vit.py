@@ -1,0 +1,155 @@
+"""Vision Transformer — the attention-side image classifier.
+
+The reference ships only example workloads (MNIST CNNs, estimator
+examples — reference examples/v1/**); this framework's model families
+go wider. ViT earns its slot on TPU grounds: unlike ResNet's spatial
+convs (tiling-limited at 56/28/14/7 grids — PROFILE.md), a ViT step is
+almost entirely dense GEMMs at transformer shapes, the MXU's best
+case, and the whole encoder reuses the battle-tested BERT
+TransformerBlock (same param paths, so TRANSFORMER_RULES Megatron
+tp/fsdp sharding applies unchanged).
+
+TPU-first choices:
+- patchify as a Conv(kernel=patch, stride=patch) — one big MXU matmul
+  of [b*n_patches, p*p*3] @ [p*p*3, hidden], not a gather;
+- bf16 weights/activations, f32 layernorms and head (same discipline
+  as BERT/GPT);
+- global-average-pool head by default: static shapes, no ragged CLS
+  bookkeeping (cls pooling available for parity with the paper);
+- per-block remat via the shared BertConfig flag.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+import jax
+import jax.numpy as jnp
+from flax import linen as nn
+
+from .bert import BertConfig, TransformerBlock
+
+
+@dataclasses.dataclass(frozen=True)
+class ViTConfig:
+    image_size: int = 224
+    patch_size: int = 16
+    hidden_size: int = 768
+    num_layers: int = 12
+    num_heads: int = 12
+    intermediate_size: int = 3072
+    num_classes: int = 1000
+    dtype: jnp.dtype = jnp.bfloat16
+    pool: str = "gap"  # "gap" (default) or "cls"
+    remat: bool = False
+
+    def __post_init__(self) -> None:
+        # fail at construction, not by silently training the wrong
+        # architecture: any unknown pool value would otherwise fall
+        # through to gap pooling
+        if self.pool not in ("gap", "cls"):
+            raise ValueError(
+                f"pool must be 'gap' or 'cls', got {self.pool!r}"
+            )
+
+    @property
+    def num_patches(self) -> int:
+        if self.image_size % self.patch_size:
+            raise ValueError(
+                f"image_size {self.image_size} not divisible by "
+                f"patch_size {self.patch_size}"
+            )
+        return (self.image_size // self.patch_size) ** 2
+
+    def block_config(self) -> BertConfig:
+        """The encoder blocks are literally BERT's TransformerBlock —
+        this is the config view they consume."""
+        return BertConfig(
+            hidden_size=self.hidden_size,
+            num_layers=self.num_layers,
+            num_heads=self.num_heads,
+            intermediate_size=self.intermediate_size,
+            dtype=self.dtype,
+            remat=self.remat,
+        )
+
+
+# ViT-B/16 (the canonical config) and a tiny test variant.
+VIT_B16 = ViTConfig()
+VIT_TINY = ViTConfig(
+    image_size=32, patch_size=8, hidden_size=64, num_layers=2,
+    num_heads=4, intermediate_size=128, num_classes=10,
+)
+
+
+class ViT(nn.Module):
+    config: ViTConfig
+    attention_fn: object = None
+
+    @nn.compact
+    def __call__(self, images: jax.Array) -> jax.Array:
+        cfg = self.config
+        block_cfg = cfg.block_config()
+        x = nn.Conv(
+            cfg.hidden_size,
+            kernel_size=(cfg.patch_size, cfg.patch_size),
+            strides=(cfg.patch_size, cfg.patch_size),
+            padding="VALID",
+            dtype=cfg.dtype,
+            name="patch_embed",
+        )(images.astype(cfg.dtype))
+        batch = x.shape[0]
+        x = x.reshape(batch, -1, cfg.hidden_size)  # [b, n_patches, h]
+        tokens = x.shape[1]
+        if cfg.pool == "cls":
+            cls = self.param(
+                "cls_token", nn.initializers.zeros, (1, 1, cfg.hidden_size),
+                jnp.float32,
+            )
+            x = jnp.concatenate(
+                [jnp.broadcast_to(cls, (batch, 1, cfg.hidden_size)).astype(
+                    cfg.dtype
+                ), x],
+                axis=1,
+            )
+            tokens += 1
+        pos = self.param(
+            "position_embed",
+            nn.initializers.normal(stddev=0.02),
+            (1, tokens, cfg.hidden_size),
+            jnp.float32,
+        )
+        x = x + pos.astype(cfg.dtype)
+        block_cls = TransformerBlock
+        if cfg.remat:
+            block_cls = nn.remat(TransformerBlock, static_argnums=())
+        for layer in range(cfg.num_layers):
+            x = block_cls(
+                block_cfg, attention_fn=self.attention_fn,
+                name=f"layer_{layer}",
+            )(x, None)
+        x = nn.LayerNorm(dtype=jnp.float32, name="ln_final")(x)
+        pooled = x[:, 0] if cfg.pool == "cls" else jnp.mean(x, axis=1)
+        # small head: f32 costs nothing here and keeps logits exact
+        return nn.Dense(cfg.num_classes, dtype=jnp.float32, name="head")(
+            pooled.astype(jnp.float32)
+        )
+
+
+def synthetic_batch(
+    rng: jax.Array, batch_size: int, cfg: ViTConfig = VIT_TINY
+):
+    """Learnable synthetic classification data (same recipe as
+    models/resnet.py): class-conditional means so accuracy can rise
+    above chance — loss movement is meaningful, not noise-fitting."""
+    label_rng, image_rng = jax.random.split(rng)
+    labels = jax.random.randint(
+        label_rng, (batch_size,), 0, cfg.num_classes
+    )
+    means = jax.random.normal(
+        jax.random.PRNGKey(42), (cfg.num_classes, 1, 1, 1)
+    )
+    images = means[labels] + 0.5 * jax.random.normal(
+        image_rng, (batch_size, cfg.image_size, cfg.image_size, 3)
+    )
+    return {"image": images.astype(jnp.float32), "label": labels}

@@ -1,0 +1,137 @@
+"""ViT training entrypoint — the attention-side image classifier.
+
+    python -m tf_operator_tpu.train.vit --steps 100 --per-chip-batch 128
+    python -m tf_operator_tpu.train.vit --preset tiny --tp 2   # CPU smoke
+
+Same distributed shape as the other CLIs: bootstrap from the
+operator-injected env, one jit'd step over the mesh. Because the
+encoder reuses BERT's TransformerBlock param paths, TRANSFORMER_RULES
+Megatron tp/fsdp sharding applies unchanged (models/vit.py).
+"""
+
+from __future__ import annotations
+
+import argparse
+import logging
+import sys
+import time
+
+logger = logging.getLogger("tf_operator_tpu.train.vit")
+
+
+def main(argv=None) -> int:
+    parser = argparse.ArgumentParser()
+    parser.add_argument("--preset", choices=["tiny", "b16"], default="b16")
+    parser.add_argument("--steps", type=int, default=100)
+    parser.add_argument("--per-chip-batch", type=int, default=128)
+    parser.add_argument("--learning-rate", type=float, default=1e-3)
+    parser.add_argument("--fsdp", type=int, default=1)
+    parser.add_argument("--tp", type=int, default=1)
+    parser.add_argument("--remat", action="store_true")
+    parser.add_argument("--checkpoint-dir", default=None)
+    parser.add_argument(
+        "--accum-steps", type=int, default=1,
+        help="gradient-accumulation microbatches per optimizer step",
+    )
+    parser.add_argument(
+        "--warmup-steps", type=int, default=0,
+        help="linear warmup then cosine decay (0 = constant lr)",
+    )
+    parser.add_argument(
+        "--profile-dir", default=None,
+        help="Capture an XLA/TPU profiler trace of steady-state steps",
+    )
+    parser.add_argument("--log-every", type=int, default=20)
+    args = parser.parse_args(argv)
+    logging.basicConfig(level=logging.INFO, stream=sys.stderr)
+
+    from ..parallel import distributed
+
+    proc = distributed.initialize()
+    logger.info("process %d/%d", proc.process_id, proc.num_processes)
+
+    import dataclasses
+
+    import jax
+    import optax
+
+    from ..models import vit as vit_lib
+    from ..parallel.mesh import MeshConfig, build_mesh, mesh_summary
+    from ..parallel.sharding import TRANSFORMER_RULES
+    from ..train.trainer import Trainer, classification_task, warmup_cosine_lr
+
+    n_chips = len(jax.devices())
+    cfg = vit_lib.VIT_TINY if args.preset == "tiny" else vit_lib.VIT_B16
+    if args.remat:
+        cfg = dataclasses.replace(cfg, remat=True)
+    model = vit_lib.ViT(cfg)
+    mesh = build_mesh(MeshConfig(dp=-1, fsdp=args.fsdp, tp=args.tp))
+    logger.info("mesh: %s", mesh_summary(mesh))
+    trainer = Trainer(
+        model,
+        classification_task(model),
+        optax.adamw(
+            warmup_cosine_lr(args.learning_rate, args.steps, args.warmup_steps),
+            weight_decay=0.05,
+        ),
+        mesh=mesh,
+        rules=TRANSFORMER_RULES,
+        checkpoint_dir=args.checkpoint_dir,
+        accum_steps=args.accum_steps,
+    )
+    rng = jax.random.PRNGKey(0)
+    global_batch = args.per_chip_batch * n_chips
+    batch = trainer.place_batch(
+        vit_lib.synthetic_batch(rng, global_batch, cfg)
+    )
+    state = trainer.init(rng, batch)
+    if args.checkpoint_dir:
+        restored = trainer.restore(state)
+        if restored is not None:
+            state = restored
+            logger.info("resumed from step %d", int(state.step))
+
+    from .preemption import PreemptionGuard, maybe_preempt_exit
+    from .profiling import StepProfiler
+
+    state, metrics = trainer.step(state, batch)  # compile
+    float(metrics["loss"])
+    # --steps is the TOTAL budget: a resumed process runs the remainder
+    remaining = max(0, args.steps - int(state.step))
+    steps_run = 0
+    profiler = StepProfiler(args.profile_dir, remaining, window=(0, 5))
+    guard = PreemptionGuard()
+    start = time.perf_counter()
+    try:
+        guard.__enter__()
+        for step in range(remaining):
+            profiler.before_step(step)
+            state, metrics = trainer.step(state, batch)
+            profiler.after_step(step, drain=lambda: float(metrics["loss"]))
+            steps_run += 1
+            rc = maybe_preempt_exit(
+                guard, trainer, state, args.checkpoint_dir
+            )
+            if rc is not None:
+                return rc
+            if (step + 1) % args.log_every == 0:
+                logger.info(
+                    "step %d loss=%.4f acc=%.3f", int(state.step),
+                    float(metrics["loss"]), float(metrics["accuracy"]),
+                )
+        float(metrics["loss"])
+    finally:
+        guard.__exit__()
+        profiler.close()
+    elapsed = time.perf_counter() - start
+    logger.info(
+        "images/sec/chip: %.1f",
+        global_batch * max(steps_run, 1) / elapsed / n_chips,
+    )
+    if args.checkpoint_dir:
+        trainer.save(state)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
