@@ -316,6 +316,147 @@ class TestRingAttention:
         )
 
 
+class TestUlyssesAttention:
+    """parallel/ulysses.py: all-to-all sequence parallelism — exact
+    attention with seq sharded on sp, heads re-sharded for the local
+    full-sequence attention. Same seam as the ring, so the strategies
+    are drop-in interchangeable; parity is against the same reference."""
+
+    @pytest.fixture(scope="class")
+    def qkv8h(self):
+        rng = jax.random.PRNGKey(7)
+        b, s, h, d = 2, 256, 8, 64
+        return tuple(
+            jax.random.normal(key, (b, s, h, d), jnp.float32)
+            for key in jax.random.split(rng, 3)
+        )
+
+    def test_matches_reference(self, qkv8h):
+        from tf_operator_tpu.parallel.ulysses import make_ulysses_attention
+
+        q, k, v = qkv8h
+        mesh = build_mesh(MeshConfig(dp=2, sp=4))
+        uly = make_ulysses_attention(mesh)
+        ref = dot_product_attention(q, k, v)
+        np.testing.assert_allclose(
+            np.asarray(uly(q, k, v)), np.asarray(ref), atol=2e-6
+        )
+
+    def test_causal(self, qkv8h):
+        from tf_operator_tpu.parallel.ulysses import make_ulysses_attention
+
+        q, k, v = qkv8h
+        s = q.shape[1]
+        mesh = build_mesh(MeshConfig(dp=1, sp=8))
+        uly = make_ulysses_attention(mesh, causal=True)
+        mask = jnp.tril(jnp.ones((s, s), bool))[None, None]
+        ref = dot_product_attention(q, k, v, mask)
+        np.testing.assert_allclose(
+            np.asarray(uly(q, k, v)), np.asarray(ref), atol=2e-6
+        )
+
+    def test_gradients_all_inputs(self, qkv8h):
+        # gradients flow back through BOTH all_to_all re-shardings
+        from tf_operator_tpu.parallel.ulysses import make_ulysses_attention
+
+        q, k, v = qkv8h
+        mesh = build_mesh(MeshConfig(dp=2, sp=4))
+        uly = make_ulysses_attention(mesh)
+        ref_grads = jax.grad(
+            lambda q, k, v: (dot_product_attention(q, k, v) ** 2).sum(),
+            argnums=(0, 1, 2),
+        )(q, k, v)
+        uly_grads = jax.grad(
+            lambda q, k, v: (uly(q, k, v) ** 2).sum(), argnums=(0, 1, 2)
+        )(q, k, v)
+        for name, got, want in zip("qkv", uly_grads, ref_grads):
+            np.testing.assert_allclose(
+                np.asarray(got), np.asarray(want), atol=1e-4,
+                err_msg=f"d{name} mismatch",
+            )
+
+    def test_composes_with_megatron_tp(self, qkv8h):
+        """heads sharded on tp while the a2a runs over sp: the local
+        requirement is (H/tp) % sp == 0 (8/2 % 2)."""
+        from tf_operator_tpu.parallel.ulysses import make_ulysses_attention
+
+        q, k, v = qkv8h
+        mesh = build_mesh(MeshConfig(dp=2, sp=2, tp=2))
+        uly = make_ulysses_attention(mesh)
+        ref = dot_product_attention(q, k, v)
+        np.testing.assert_allclose(
+            np.asarray(uly(q, k, v)), np.asarray(ref), atol=2e-6
+        )
+
+    def test_flash_inner_kernel(self, qkv8h):
+        """flash=True: the pallas kernel as the inner full-sequence
+        attention (interpret mode on CPU) — the production long-context
+        pairing. head_dim 64/seq 256 keeps the kernel eligible."""
+        from tf_operator_tpu.parallel.ulysses import make_ulysses_attention
+
+        q, k, v = qkv8h
+        s = q.shape[1]
+        mesh = build_mesh(MeshConfig(dp=2, sp=4))
+        uly = make_ulysses_attention(mesh, causal=True, flash=True)
+        mask = jnp.tril(jnp.ones((s, s), bool))[None, None]
+        ref = dot_product_attention(q, k, v, mask)
+        np.testing.assert_allclose(
+            np.asarray(uly(q, k, v)), np.asarray(ref), atol=2e-3
+        )
+
+    def test_indivisible_heads_rejected(self, qkv8h):
+        from tf_operator_tpu.parallel.ulysses import make_ulysses_attention
+
+        q, k, v = (x[:, :, :6] for x in qkv8h)  # 6 heads
+        mesh = build_mesh(MeshConfig(dp=2, sp=4))
+        uly = make_ulysses_attention(mesh)
+        with pytest.raises(ValueError, match="divisible"):
+            uly(q, k, v)
+
+    def test_mask_rejected(self, qkv8h):
+        from tf_operator_tpu.parallel.ulysses import make_ulysses_attention
+
+        q, k, v = qkv8h
+        uly = make_ulysses_attention(build_mesh(MeshConfig(dp=2, sp=4)))
+        with pytest.raises(NotImplementedError, match="unpadded"):
+            uly(q, k, v, mask=jnp.ones((2, 1, 1, 256), bool))
+
+    def test_bert_trains_sequence_parallel(self):
+        """End-to-end: BERT with Ulysses attention over an sp=4 mesh —
+        drop-in where the ring test uses the ring."""
+        import optax
+
+        from tf_operator_tpu.parallel.ulysses import make_ulysses_attention
+        from tf_operator_tpu.train import Trainer, mlm_task
+
+        mesh = build_mesh(MeshConfig(dp=2, sp=4))
+        cfg = bert_lib.BertConfig(
+            vocab_size=512, hidden_size=128, num_layers=2, num_heads=4,
+            intermediate_size=256, max_position_embeddings=256,
+            dtype=jnp.float32,  # exact comparison (bf16 reorders rounding)
+        )
+        uly = make_ulysses_attention(mesh)
+        model = bert_lib.BertForMLM(cfg, attention_fn=uly)
+        trainer = Trainer(
+            model, mlm_task(model), optax.adamw(1e-3), mesh=mesh,
+            shard_sequence=True,
+        )
+        rng = jax.random.PRNGKey(2)
+        batch = bert_lib.synthetic_batch(rng, 4, 256, cfg)
+        state = trainer.init(rng, batch)
+        state, metrics = trainer.step(state, trainer.place_batch(batch))
+        assert np.isfinite(float(metrics["loss"]))
+
+        model_ref = bert_lib.BertForMLM(cfg)
+        logits_ref = model_ref.apply(
+            {"params": state.params}, batch["input_ids"]
+        )
+        logits_uly = model.apply({"params": state.params}, batch["input_ids"])
+        np.testing.assert_allclose(
+            np.asarray(logits_uly), np.asarray(logits_ref), atol=3e-3
+        )
+
+
 class TestFlashNarrowHead:
     """head_dim 64 (BERT-base) through lane padding (VERDICT r1 next
     #2): the kernel — not the fallback — must run, and all-input

@@ -11,6 +11,7 @@ from .mesh import (
     single_device_mesh,
 )
 from .ring_attention import make_ring_attention
+from .ulysses import make_ulysses_attention
 from .sharding import (
     CONV_RULES,
     MOE_RULES,
@@ -40,4 +41,5 @@ __all__ = [
     "shardings_for_tree",
     "place",
     "make_ring_attention",
+    "make_ulysses_attention",
 ]

@@ -1,0 +1,137 @@
+"""Ulysses sequence parallelism: all-to-all head/sequence re-sharding.
+
+The second first-class long-context strategy next to ring attention
+(parallel/ring_attention.py; both absent in the reference — SURVEY.md
+§5: its operator never sees sequence length). Where the ring keeps the
+sequence sharded and rotates KV blocks with n-1 ``ppermute`` rounds,
+Ulysses (DeepSpeed-Ulysses, Jacobs et al. 2023) re-shards ONCE each
+way with ``all_to_all``:
+
+    [b, s/n, H, d]  --a2a-->  [b, s, H/n, d]     (heads scatter,
+                                                  sequence gathers)
+    full-sequence attention on the local H/n heads — ANY inner
+    attention works unchanged here, including the pallas flash kernel
+    (the production long-context pairing: O(s) memory from flash,
+    O(s/n) activations elsewhere from the sp sharding)
+    [b, s, H/n, d]  --a2a-->  [b, s/n, H, d]     (back)
+
+Trade-offs vs the ring, honestly stated: communication is a constant
+FOUR all_to_all ops per attention call (q, k, v in; out back — each
+moving its full tensor once) vs the ring's n-1 KV neighbor exchanges,
+and the inner attention is completely reusable — but the head count
+bounds the parallel degree (H_local must divide by n), and peak
+memory during attention holds the FULL sequence for H/n heads (the
+ring never materializes full-sequence anything). Long sequences with
+few heads want the ring; many-head models at moderate lengths want
+Ulysses.
+
+Composes with Megatron tp on the same call: in_specs shard heads on
+``tp`` while the a2a runs over ``sp``, so the local requirement is
+(H / tp) % sp == 0.
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Optional
+
+from jax import lax
+from jax.sharding import Mesh, PartitionSpec as P
+
+from .compat import shard_map_norep
+
+
+def _ulysses_shard(
+    q, k, v, axis_name: str, n: int, inner: Callable
+):
+    """Per-device body. q/k/v: [batch, seq_shard, heads_local, d]."""
+    if n > 1:
+        # heads scatter across the axis, sequence shards gather:
+        # [b, s/n, h, d] -> [b, s, h/n, d]. tiled=True splits/concats
+        # in place instead of adding an axis.
+        q, k, v = (
+            lax.all_to_all(
+                x, axis_name, split_axis=2, concat_axis=1, tiled=True
+            )
+            for x in (q, k, v)
+        )
+    out = inner(q, k, v)
+    if n > 1:
+        out = lax.all_to_all(
+            out, axis_name, split_axis=1, concat_axis=2, tiled=True
+        )
+    return out
+
+
+def make_ulysses_attention(
+    mesh: Mesh,
+    axis_name: str = "sp",
+    causal: bool = False,
+    batch_axes=("dp", "fsdp"),
+    heads_axis: Optional[str] = "tp",
+    inner_attention: Optional[Callable] = None,
+    flash: bool = False,
+):
+    """Build an attention_fn (query, key, value, mask) -> out compatible
+    with ops.attention.MultiHeadAttention, with the sequence dimension
+    sharded over `axis_name` — same seam as make_ring_attention, so the
+    two strategies are drop-in interchangeable.
+
+    inner_attention: full-sequence attention fn([b, s, h_loc, d] x3)
+    run per device after the first a2a. Default: the XLA path
+    (ops.attention.dot_product_attention) with a causal mask when
+    causal=True; flash=True selects the pallas kernel (in-kernel
+    causal, O(s) memory) — the production long-context configuration.
+
+    Padding masks are rejected like the ring path (sequence-parallel
+    pretraining assumes packed batches).
+    """
+    n = mesh.shape[axis_name]
+
+    if inner_attention is None:
+        if flash:
+            from ..ops.pallas.flash_attention import flash_attention
+
+            def inner_attention(q, k, v):
+                return flash_attention(q, k, v, causal=causal)
+
+        else:
+            import jax.numpy as jnp
+
+            from ..ops.attention import dot_product_attention
+
+            def inner_attention(q, k, v):
+                mask = None
+                if causal:
+                    s = q.shape[1]
+                    mask = (
+                        jnp.arange(s)[:, None] >= jnp.arange(s)[None, :]
+                    )[None, None]
+                return dot_product_attention(q, k, v, mask)
+
+    spec = P(batch_axes, axis_name, heads_axis, None)
+
+    def sharded_body(q, k, v):
+        heads_local = q.shape[2]
+        if heads_local % n:
+            raise ValueError(
+                f"Ulysses needs local heads divisible by the {axis_name} "
+                f"axis: {heads_local} % {n} != 0 (tp-sharded heads count "
+                "as local — reduce sp or tp, or use ring attention)"
+            )
+        return _ulysses_shard(
+            q, k, v, axis_name=axis_name, n=n, inner=inner_attention
+        )
+
+    sharded = shard_map_norep(
+        sharded_body, mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec
+    )
+
+    def attention_fn(query, key, value, mask=None):
+        if mask is not None:
+            raise NotImplementedError(
+                "Ulysses attention requires unpadded (packed) batches; "
+                "drop the attention mask for sequence-parallel training"
+            )
+        return sharded(query, key, value)
+
+    return attention_fn

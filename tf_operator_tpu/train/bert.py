@@ -4,8 +4,9 @@
     python -m tf_operator_tpu.train.bert --preset base --tp 2 --sp 2
 
 Joins the slice from the operator-injected env, builds a dp/fsdp/sp/tp
-mesh, optionally runs ring attention (sequence parallelism) and the
-pallas flash-attention kernel, reports tokens/sec/chip.
+mesh, optionally runs sequence parallelism (--sp-strategy: ring, or
+ulysses which composes with --flash) and the pallas flash-attention
+kernel, reports tokens/sec/chip.
 """
 
 from __future__ import annotations
@@ -33,6 +34,12 @@ def main(argv=None) -> int:
     parser.add_argument("--tp", type=int, default=1)
     parser.add_argument("--sp", type=int, default=1)
     parser.add_argument("--flash", action="store_true", help="pallas flash attention")
+    parser.add_argument(
+        "--sp-strategy", choices=["ring", "ulysses"], default="ring",
+        help="sequence-parallel strategy when --sp > 1: ring (ppermute "
+        "KV rotation, O(s/n) memory) or ulysses (all-to-all head "
+        "re-sharding; composes with --flash for the inner attention)",
+    )
     parser.add_argument("--checkpoint-dir", default=None)
     parser.add_argument(
         "--accum-steps", type=int, default=1,
@@ -75,10 +82,23 @@ def main(argv=None) -> int:
 
     attention_fn = None
     if args.sp > 1:
-        from ..parallel.ring_attention import make_ring_attention
+        if args.sp_strategy == "ulysses":
+            from ..parallel.ulysses import make_ulysses_attention
 
-        attention_fn = make_ring_attention(mesh)
-        logger.info("ring attention over sp=%d", args.sp)
+            attention_fn = make_ulysses_attention(mesh, flash=args.flash)
+        else:
+            if args.flash:
+                logger.warning(
+                    "--flash has no effect with --sp-strategy ring "
+                    "(the ring computes its own blockwise fold); use "
+                    "--sp-strategy ulysses to pair sp with the kernel"
+                )
+            from ..parallel.ring_attention import make_ring_attention
+
+            attention_fn = make_ring_attention(mesh)
+        logger.info(
+            "%s attention over sp=%d", args.sp_strategy, args.sp
+        )
     elif args.flash:
         from ..ops.pallas.flash_attention import flash_attention
 

@@ -5,8 +5,10 @@
         --seq-len 4096 --remat
 
 Joins the slice from the operator-injected env, builds a dp/fsdp/sp/tp
-mesh; sp>1 runs CAUSAL ring attention (context parallelism over ICI),
-otherwise the causal pallas flash kernel; reports tokens/sec/chip.
+mesh; sp>1 runs CAUSAL sequence parallelism — ring attention by
+default, or Ulysses all-to-all with the flash kernel inner via
+--sp-strategy ulysses — otherwise the causal pallas flash kernel;
+reports tokens/sec/chip.
 --generate N decodes N tokens greedily from a training-batch prompt at
 the end (KV-cached, models/gpt.py generate).
 """
@@ -32,6 +34,12 @@ def main(argv=None) -> int:
     parser.add_argument("--fsdp", type=int, default=1)
     parser.add_argument("--tp", type=int, default=1)
     parser.add_argument("--sp", type=int, default=1)
+    parser.add_argument(
+        "--sp-strategy", choices=["ring", "ulysses"], default="ring",
+        help="sequence-parallel strategy when --sp > 1: ring (ppermute "
+        "KV rotation) or ulysses (all-to-all head re-sharding with the "
+        "flash kernel as the inner attention)",
+    )
     parser.add_argument(
         "--remat", action="store_true",
         help="per-block rematerialization (bigger batch / longer seq)",
@@ -80,10 +88,19 @@ def main(argv=None) -> int:
 
     attention_fn = None
     if args.sp > 1:
-        from ..parallel.ring_attention import make_ring_attention
+        if args.sp_strategy == "ulysses":
+            from ..parallel.ulysses import make_ulysses_attention
 
-        attention_fn = make_ring_attention(mesh, causal=True)
-        logger.info("causal ring attention over sp=%d", args.sp)
+            attention_fn = make_ulysses_attention(
+                mesh, causal=True, flash=True
+            )
+        else:
+            from ..parallel.ring_attention import make_ring_attention
+
+            attention_fn = make_ring_attention(mesh, causal=True)
+        logger.info(
+            "causal %s attention over sp=%d", args.sp_strategy, args.sp
+        )
     model = gpt_lib.GPT(cfg, attention_fn=attention_fn)
     trainer = Trainer(
         model, causal_lm_task(model),
