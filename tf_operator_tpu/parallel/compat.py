@@ -29,3 +29,22 @@ def shard_map_norep(body, mesh, in_specs, out_specs):
             body, mesh=mesh, in_specs=in_specs, out_specs=out_specs,
             check_rep=False,
         )
+
+
+def packed_only_attention(sharded, strategy: str):
+    """Wrap a sharded (q, k, v) attention body into the
+    MultiHeadAttention-compatible (query, key, value, mask) seam shared
+    by BOTH sequence-parallel strategies: sequence-parallel pretraining
+    assumes packed/unpadded batches, so a mask is rejected in one place
+    — ring and Ulysses cannot drift apart on the contract."""
+
+    def attention_fn(query, key, value, mask=None):
+        if mask is not None:
+            raise NotImplementedError(
+                f"{strategy} attention requires unpadded (packed) "
+                "batches; drop the attention mask for sequence-parallel "
+                "training"
+            )
+        return sharded(query, key, value)
+
+    return attention_fn

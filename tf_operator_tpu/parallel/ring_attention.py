@@ -22,7 +22,11 @@ import jax.numpy as jnp
 from jax import lax
 from jax.sharding import Mesh, PartitionSpec as P
 
-from .compat import shard_map, shard_map_norep  # noqa: F401  (re-export)
+from .compat import (  # noqa: F401  (shard_map re-exported)
+    packed_only_attention,
+    shard_map,
+    shard_map_norep,
+)
 
 NEG_INF = -1e30
 
@@ -125,13 +129,4 @@ def make_ring_attention(
     sharded = shard_map_norep(
         sharded_body, mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec
     )
-
-    def attention_fn(query, key, value, mask=None):
-        if mask is not None:
-            raise NotImplementedError(
-                "ring attention requires unpadded (packed) batches; "
-                "drop the attention mask for sequence-parallel training"
-            )
-        return sharded(query, key, value)
-
-    return attention_fn
+    return packed_only_attention(sharded, "ring")

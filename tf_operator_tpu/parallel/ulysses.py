@@ -37,7 +37,7 @@ from typing import Callable, Optional
 from jax import lax
 from jax.sharding import Mesh, PartitionSpec as P
 
-from .compat import shard_map_norep
+from .compat import packed_only_attention, shard_map_norep
 
 
 def _ulysses_shard(
@@ -125,13 +125,4 @@ def make_ulysses_attention(
     sharded = shard_map_norep(
         sharded_body, mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec
     )
-
-    def attention_fn(query, key, value, mask=None):
-        if mask is not None:
-            raise NotImplementedError(
-                "Ulysses attention requires unpadded (packed) batches; "
-                "drop the attention mask for sequence-parallel training"
-            )
-        return sharded(query, key, value)
-
-    return attention_fn
+    return packed_only_attention(sharded, "Ulysses")
