@@ -399,17 +399,15 @@ def bench_gpt(
     }
 
 
-def bench_vit(on_tpu: bool, n_chips: int, steps: int | None = None) -> dict:
-    """ViT-B/16 @224 classification — the attention-side image model:
-    near-pure transformer GEMMs where ResNet is conv-tiling-limited
-    (PROFILE.md), so the pair brackets the image-model MFU range. MFU
-    uses the same stated transformer formula with seq = patch count."""
+def setup_vit(on_tpu: bool, n_chips: int):
+    """(trainer, state, placed_batch, meta) for the canonical ViT-B/16
+    benchmark configuration — shared with benchmarks/model_profile.py
+    (see setup_resnet)."""
     from tf_operator_tpu.models import vit as vit_lib
     from tf_operator_tpu.parallel.mesh import MeshConfig, build_mesh
     from tf_operator_tpu.parallel.sharding import TRANSFORMER_RULES
     from tf_operator_tpu.train import Trainer, classification_task
 
-    steps = steps if steps is not None else (15 if on_tpu else 3)
     cfg = vit_lib.VIT_B16 if on_tpu else vit_lib.VIT_TINY
     per_chip_batch = 128 if on_tpu else 8
     model = vit_lib.ViT(cfg)
@@ -425,6 +423,18 @@ def bench_vit(on_tpu: bool, n_chips: int, steps: int | None = None) -> dict:
         vit_lib.synthetic_batch(rng, global_batch, cfg)
     )
     state = trainer.init(rng, batch)
+    meta = {"global_batch": global_batch, "cfg": cfg}
+    return trainer, state, batch, meta
+
+
+def bench_vit(on_tpu: bool, n_chips: int, steps: int | None = None) -> dict:
+    """ViT-B/16 @224 classification — the attention-side image model:
+    near-pure transformer GEMMs where ResNet is conv-tiling-limited
+    (PROFILE.md), so the pair brackets the image-model MFU range. MFU
+    uses the same stated transformer formula with seq = patch count."""
+    steps = steps if steps is not None else (15 if on_tpu else 3)
+    trainer, state, batch, meta = setup_vit(on_tpu, n_chips)
+    global_batch, cfg = meta["global_batch"], meta["cfg"]
     flops = transformer_step_flops(
         state.params, global_batch, cfg.num_patches, cfg
     )

@@ -74,7 +74,8 @@ def _capture(setup_name: str, batch_size, steps: int, trace_dir: str) -> tuple:
     else:
         if batch_size is not None:
             raise SystemExit("--per-chip-batch applies to resnet only; "
-                             "bert/gpt profile the exact bench.py config")
+                             "the other families profile the exact "
+                             "bench.py config")
         trainer, state, batch, meta = setup(on_tpu, n_chips)
     sec = _profile_steps(trainer, state, batch, steps, trace_dir)
     gb = meta["global_batch"]
@@ -86,7 +87,7 @@ def _capture(setup_name: str, batch_size, steps: int, trace_dir: str) -> tuple:
     return sec, rates
 
 
-FAMILIES = ("bert", "gpt", "resnet")
+FAMILIES = ("bert", "gpt", "resnet", "vit")
 
 
 def parse_trace(trace_dir: str) -> dict:
@@ -151,6 +152,12 @@ def walk_op_profile(profile: dict) -> tuple:
 
 
 def main(argv=None) -> None:
+    # honor BENCH_CPU=1 exactly like bench.py (must run before any jax
+    # backend init; the axon TPU plugin wedges when the tunnel is down)
+    import bench
+
+    bench._maybe_force_cpu()
+
     ap = argparse.ArgumentParser()
     ap.add_argument("--model", choices=FAMILIES, default="resnet")
     ap.add_argument(

@@ -170,26 +170,20 @@ class CachedSelfAttention(nn.Module):
     def _store(self, name: str, new, batch: int, index):
         """Write one token's K or V into its cache; returns the full
         cache dequantized to the compute dtype."""
+        store_dtype = jnp.int8 if self.kv_quant_int8 else self.dtype
+        cache = self.variable(
+            "cache", name,
+            lambda: jnp.zeros(
+                (batch, self.max_len, self.num_heads, self.head_dim),
+                store_dtype,
+            ),
+        )
         if not self.kv_quant_int8:
-            cache = self.variable(
-                "cache", name,
-                lambda: jnp.zeros(
-                    (batch, self.max_len, self.num_heads, self.head_dim),
-                    self.dtype,
-                ),
-            )
             cache.value = jax.lax.dynamic_update_slice(
                 cache.value, new[:, None].astype(self.dtype),
                 (0, index, 0, 0),
             )
             return cache.value
-        cache = self.variable(
-            "cache", name,
-            lambda: jnp.zeros(
-                (batch, self.max_len, self.num_heads, self.head_dim),
-                jnp.int8,
-            ),
-        )
         scale = self.variable(
             "cache", name + "_scale",
             lambda: jnp.zeros(

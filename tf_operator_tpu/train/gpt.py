@@ -48,6 +48,11 @@ def main(argv=None) -> int:
         "--generate", type=int, default=0, metavar="N",
         help="after training, greedily decode N tokens from a prompt",
     )
+    parser.add_argument(
+        "--kv-int8", action="store_true",
+        help="int8 KV cache for --generate (half the per-step cache "
+        "HBM traffic decode is bound by; models/gpt.py)",
+    )
     parser.add_argument("--checkpoint-dir", default=None)
     parser.add_argument(
         "--accum-steps", type=int, default=1,
@@ -185,6 +190,7 @@ def main(argv=None) -> int:
         out = gpt_lib.generate(
             cfg, state.params, jax.numpy.asarray(prompt),
             max_new_tokens=args.generate, mesh=mesh,
+            kv_quant_int8=args.kv_int8,
         )
         logger.info("generated: %s", jax.device_get(out)[0].tolist())
     return 0
