@@ -1,0 +1,130 @@
+"""The decode server (tf_operator_tpu/serve): checkpoint -> tokens over
+HTTP. In-process server with the tiny GPT; requests exercise the same
+models/gpt.py generate path the benchmarks measure."""
+
+import json
+import threading
+import urllib.error
+import urllib.request
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from tf_operator_tpu.models import gpt as gpt_lib
+from tf_operator_tpu.serve import make_server
+
+
+@pytest.fixture(scope="module")
+def server():
+    cfg = gpt_lib.GPT_TINY
+    rng = jax.random.PRNGKey(0)
+    params = gpt_lib.GPT(cfg).init(
+        rng, jnp.zeros((1, 8), jnp.int32)
+    )["params"]
+    srv = make_server(cfg, params, model_name="gpt-test", max_new_cap=64)
+    thread = threading.Thread(target=srv.serve_forever, daemon=True)
+    thread.start()
+    try:
+        yield cfg, srv.server_address[1]
+    finally:
+        srv.shutdown()
+
+
+def post(port, payload, path="/generate"):
+    req = urllib.request.Request(
+        f"http://127.0.0.1:{port}{path}",
+        data=json.dumps(payload).encode(),
+        headers={"Content-Type": "application/json"},
+        method="POST",
+    )
+    with urllib.request.urlopen(req, timeout=120) as resp:
+        return resp.status, json.loads(resp.read())
+
+
+def post_err(port, payload):
+    try:
+        post(port, payload)
+    except urllib.error.HTTPError as err:
+        return err.code, json.loads(err.read())
+    raise AssertionError("expected an HTTP error")
+
+
+class TestDecodeServer:
+    def test_generate_greedy(self, server):
+        cfg, port = server
+        prompt = [[1, 2, 3, 4], [5, 6, 7, 8]]
+        status, body = post(port, {
+            "input_ids": prompt, "max_new_tokens": 6,
+        })
+        assert status == 200
+        tokens = np.asarray(body["tokens"])
+        assert tokens.shape == (2, 4 + 6)
+        assert body["prompt_len"] == 4
+        # prompt is a prefix of the output
+        np.testing.assert_array_equal(tokens[:, :4], np.asarray(prompt))
+        assert ((tokens >= 0) & (tokens < cfg.vocab_size)).all()
+        # greedy is deterministic: same request, same tokens
+        _, again = post(port, {"input_ids": prompt, "max_new_tokens": 6})
+        assert again["tokens"] == body["tokens"]
+
+    def test_sampled_changes_with_seed(self, server):
+        _, port = server
+        prompt = [[9, 10, 11, 12]]
+        _, a = post(port, {
+            "input_ids": prompt, "max_new_tokens": 12,
+            "temperature": 1.0, "seed": 1,
+        })
+        _, b = post(port, {
+            "input_ids": prompt, "max_new_tokens": 12,
+            "temperature": 1.0, "seed": 2,
+        })
+        assert a["tokens"] != b["tokens"]
+
+    def test_healthz_counts_decodes(self, server):
+        _, port = server
+        with urllib.request.urlopen(
+            f"http://127.0.0.1:{port}/healthz", timeout=30
+        ) as resp:
+            body = json.loads(resp.read())
+        assert body["status"] == "ok"
+        assert body["model"] == "gpt-test"
+        assert body["decodes"] >= 1
+
+    @pytest.mark.parametrize("payload,fragment", [
+        ({"input_ids": []}, "non-empty"),
+        ({"input_ids": [[1, 2], [3]]}, "ragged"),
+        ({"input_ids": [[999999]]}, "token ids"),
+        ({"input_ids": [[1]], "max_new_tokens": 0}, "max_new_tokens"),
+        ({"input_ids": [[1]], "max_new_tokens": 10_000}, "max_new_tokens"),
+        ({"input_ids": [[1]], "temperature": -1}, "temperature"),
+        ({"input_ids": [[1] * 500], "max_new_tokens": 60}, "max_seq_len"),
+        # crash-class inputs: each must be a 400, never a dropped
+        # connection (valid JSON, wrong shapes/types)
+        (123, "JSON object"),
+        ([1, 2], "JSON object"),
+        ({"input_ids": [["a"]]}, "integer"),
+        ({"input_ids": [[[1]]]}, "integer"),
+        ({"input_ids": [[2 ** 40]]}, "token ids"),
+        ({"input_ids": [[True]]}, "integer"),
+        ({"input_ids": [[1]], "seed": "abc"}, "seed"),
+        ({"input_ids": [[1]], "max_new_tokens": True}, "max_new_tokens"),
+    ], ids=["empty", "ragged", "oov", "zero-new", "cap", "neg-temp",
+            "overflow", "int-body", "list-body", "str-token",
+            "nested-token", "huge-token", "bool-token", "str-seed",
+            "bool-new"])
+    def test_validation_is_400_not_500(self, server, payload, fragment):
+        _, port = server
+        status, body = post_err(port, payload)
+        assert status == 400
+        assert fragment in body["error"]
+
+    def test_unknown_route_404(self, server):
+        _, port = server
+        try:
+            post(port, {"input_ids": [[1]]}, path="/nope")
+        except urllib.error.HTTPError as err:
+            assert err.code == 404
+        else:
+            raise AssertionError("expected 404")

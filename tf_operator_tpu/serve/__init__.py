@@ -1,0 +1,3 @@
+from .server import DecodeHandlerFactory, main, make_server
+
+__all__ = ["make_server", "main", "DecodeHandlerFactory"]

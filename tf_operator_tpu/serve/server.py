@@ -1,0 +1,264 @@
+"""Minimal decode server: the serving side of the GPT family.
+
+The reference framework stops at training orchestration; a complete
+TPU framework owes its users the path from a trained checkpoint to
+tokens. This server is deliberately small — stdlib HTTP around the
+same ``models/gpt.py generate`` the benchmarks measure:
+
+    python -m tf_operator_tpu.serve --preset tiny --port 8600
+    python -m tf_operator_tpu.serve --preset small \
+        --checkpoint-dir /ckpt/gpt --kv-int8
+
+    POST /generate   {"input_ids": [[1,2,3], ...],
+                      "max_new_tokens": 32, "temperature": 0.0}
+                  -> {"tokens": [[...], ...], "prompt_len": 3}
+    GET  /healthz -> {"status": "ok", "model": "...", "decodes": N}
+
+TPU-first behavior worth naming:
+- the whole decode is ONE jitted lax.scan, compiled per
+  (batch, prompt_len, total) shape and cached (models/gpt.py
+  _compiled_decode) — repeat shapes are a single device dispatch;
+  distinct shapes pay one compile each, so production callers should
+  bucket their prompt lengths;
+- requests serialize through a lock: decode saturates the chip, so
+  concurrency buys queueing, not throughput (batching belongs in the
+  request: send [b, p] prompts);
+- --kv-int8 serves with the int8 KV cache (half the per-step cache
+  bandwidth — the decode bottleneck at long contexts).
+
+Checkpoints: --checkpoint-dir restores the newest step written by the
+train CLIs (same orbax layout); without one the server starts with
+random weights and says so loudly (smoke/demo mode).
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import logging
+import os
+import sys
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+logger = logging.getLogger("tf_operator_tpu.serve")
+
+MAX_BATCH = 64
+
+
+class _State:
+    """Model + params + decode bookkeeping shared by request threads."""
+
+    def __init__(self, cfg, params, kv_quant_int8: bool, model_name: str,
+                 max_new_cap: int):
+        self.cfg = cfg
+        self.params = params
+        self.kv_quant_int8 = kv_quant_int8
+        self.model_name = model_name
+        self.max_new_cap = max_new_cap
+        self.lock = threading.Lock()
+        self.decodes = 0
+
+
+def _bad(payload) -> tuple:
+    return 400, {"error": payload}
+
+
+def _validate(state: _State, body):
+    """-> (prompt array, max_new_tokens, temperature, seed) or
+    (status, err). Every malformed field is a 400, never a dropped
+    connection — the contract tests/test_serve.py pins."""
+    import numpy as np
+
+    if not isinstance(body, dict):
+        return _bad("request body must be a JSON object")
+    ids = body.get("input_ids")
+    if not isinstance(ids, list) or not ids:
+        return _bad("input_ids must be a non-empty list of token lists")
+    if not all(isinstance(row, list) and row for row in ids):
+        return _bad("every input_ids row must be a non-empty token list")
+    if not all(
+        isinstance(tok, int) and not isinstance(tok, bool)
+        for row in ids for tok in row
+    ):
+        return _bad("every token must be an integer")
+    lens = {len(row) for row in ids}
+    if len(lens) != 1:
+        return _bad(
+            f"ragged prompts not supported (lengths {sorted(lens)}); "
+            "pad client-side to one length per request"
+        )
+    if len(ids) > MAX_BATCH:
+        return _bad(f"batch {len(ids)} exceeds cap {MAX_BATCH}")
+    if any(
+        tok < 0 or tok >= state.cfg.vocab_size for row in ids for tok in row
+    ):
+        return _bad(f"token ids must be in [0, {state.cfg.vocab_size})")
+    prompt = np.asarray(ids, dtype=np.int32)
+    new = body.get("max_new_tokens", 16)
+    if not isinstance(new, int) or isinstance(new, bool) or not (
+        1 <= new <= state.max_new_cap
+    ):
+        return _bad(
+            f"max_new_tokens must be an int in [1, {state.max_new_cap}]"
+        )
+    if prompt.shape[1] + new > state.cfg.max_seq_len:
+        return _bad(
+            f"prompt_len {prompt.shape[1]} + max_new_tokens {new} "
+            f"exceeds max_seq_len {state.cfg.max_seq_len}"
+        )
+    temperature = body.get("temperature", 0.0)
+    if not isinstance(temperature, (int, float)) or isinstance(
+        temperature, bool
+    ) or temperature < 0:
+        return _bad("temperature must be a number >= 0")
+    seed = body.get("seed", 0)
+    if not isinstance(seed, int) or isinstance(seed, bool):
+        return _bad("seed must be an integer")
+    return prompt, new, float(temperature), seed
+
+
+def DecodeHandlerFactory(state: _State):
+    from ..models import gpt as gpt_lib
+
+    class Handler(BaseHTTPRequestHandler):
+        protocol_version = "HTTP/1.1"
+
+        def _reply(self, code: int, payload: dict) -> None:
+            body = json.dumps(payload).encode()
+            self.send_response(code)
+            self.send_header("Content-Type", "application/json")
+            self.send_header("Content-Length", str(len(body)))
+            self.end_headers()
+            self.wfile.write(body)
+
+        def do_GET(self) -> None:  # noqa: N802
+            if self.path == "/healthz":
+                self._reply(200, {
+                    "status": "ok",
+                    "model": state.model_name,
+                    "kv_int8": state.kv_quant_int8,
+                    "decodes": state.decodes,
+                })
+            else:
+                self._reply(404, {"error": f"no route {self.path}"})
+
+        def do_POST(self) -> None:  # noqa: N802
+            if self.path != "/generate":
+                return self._reply(404, {"error": f"no route {self.path}"})
+            try:
+                length = int(self.headers.get("Content-Length") or 0)
+                body = json.loads(self.rfile.read(length) or b"{}")
+            except (ValueError, json.JSONDecodeError) as err:
+                return self._reply(400, {"error": f"bad JSON: {err}"})
+            result = _validate(state, body)
+            if isinstance(result[0], int):  # (status, payload)
+                return self._reply(*result)
+            prompt, new, temperature, seed = result
+            import jax
+
+            rng = jax.random.PRNGKey(seed)
+            with state.lock:  # decode saturates the chip; serialize
+                out = gpt_lib.generate(
+                    state.cfg, state.params, prompt, max_new_tokens=new,
+                    temperature=temperature, rng=rng,
+                    kv_quant_int8=state.kv_quant_int8,
+                )
+                state.decodes += 1
+            self._reply(200, {
+                "tokens": jax.device_get(out).tolist(),
+                "prompt_len": int(prompt.shape[1]),
+            })
+
+        def log_message(self, *args) -> None:
+            pass
+
+    return Handler
+
+
+def make_server(
+    cfg,
+    params,
+    port: int = 0,
+    kv_quant_int8: bool = False,
+    model_name: str = "gpt",
+    max_new_cap: int = 1024,
+    host: str = "127.0.0.1",
+) -> ThreadingHTTPServer:
+    """In-process server (tests and embedders); caller owns
+    serve_forever/shutdown. The CLI binds 0.0.0.0 (pods must be
+    reachable on the pod IP); the in-process default stays loopback."""
+    state = _State(cfg, params, kv_quant_int8, model_name, max_new_cap)
+    return ThreadingHTTPServer((host, port), DecodeHandlerFactory(state))
+
+
+def main(argv=None) -> int:
+    parser = argparse.ArgumentParser()
+    parser.add_argument("--preset", choices=["tiny", "small"], default="small")
+    parser.add_argument(
+        "--port", type=int, default=int(os.environ.get("PORT", "8600"))
+    )
+    parser.add_argument(
+        "--host", default="0.0.0.0",
+        help="bind address (default 0.0.0.0: pods must answer on the "
+        "pod IP; use 127.0.0.1 for local-only)",
+    )
+    parser.add_argument("--checkpoint-dir", default=None)
+    parser.add_argument("--kv-int8", action="store_true")
+    parser.add_argument(
+        "--max-new-cap", type=int, default=1024,
+        help="upper bound a single request may ask for",
+    )
+    args = parser.parse_args(argv)
+    logging.basicConfig(level=logging.INFO, stream=sys.stderr)
+
+    import jax
+    import jax.numpy as jnp
+
+    from ..models import gpt as gpt_lib
+
+    cfg = gpt_lib.GPT_TINY if args.preset == "tiny" else gpt_lib.GPT_SMALL
+    rng = jax.random.PRNGKey(0)
+    if args.checkpoint_dir:
+        import optax
+
+        from ..train import Trainer, causal_lm_task
+
+        model = gpt_lib.GPT(cfg)
+        trainer = Trainer(
+            model, causal_lm_task(model), optax.adamw(1e-4),
+            checkpoint_dir=args.checkpoint_dir,
+        )
+        sample = gpt_lib.synthetic_batch(rng, 1, 8, cfg)
+        state = trainer.init(rng, sample)  # the ONE init; restore target
+        restored = trainer.restore(state)
+        if restored is None:
+            logger.warning(
+                "no checkpoint in %s — serving RANDOM weights",
+                args.checkpoint_dir,
+            )
+            params = state.params
+        else:
+            params = restored.params
+            logger.info("serving step-%d checkpoint", int(restored.step))
+    else:
+        logger.warning("no --checkpoint-dir — serving RANDOM weights")
+        params = gpt_lib.GPT(cfg).init(
+            rng, jnp.zeros((1, 8), jnp.int32)
+        )["params"]
+
+    server = make_server(
+        cfg, params, port=args.port, kv_quant_int8=args.kv_int8,
+        model_name=f"gpt-{args.preset}", max_new_cap=args.max_new_cap,
+        host=args.host,
+    )
+    logger.info("decode server on :%d", server.server_address[1])
+    try:
+        server.serve_forever()
+    except KeyboardInterrupt:
+        pass
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
