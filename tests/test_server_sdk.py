@@ -285,6 +285,25 @@ class TestSDK:
         with pytest.raises(BadRequest, match="not valid"):
             client.get_logs("tailed", container="nope")
 
+    def test_describe_renders_status_and_events(self):
+        """kubectl-describe analog: one text blob with spec summary,
+        conditions, replica statuses, and the recorded events."""
+        sub, controller, client = self.setup_env()
+        client.create(make_job({"Worker": 2}, name="desc"))
+        controller.run_until_quiet()
+        sub.run_all_pending()
+        controller.run_until_quiet()
+        text = client.describe("desc")
+        assert "Name:         desc" in text
+        assert "Worker: replicas=2" in text
+        assert "Running" in text          # condition reached
+        assert "active=2" in text         # replica status counters
+        assert "SuccessfulCreatePod" in text  # events section populated
+        # finish the job; terminal state shows up too
+        sub.terminate_pod("default", "desc-worker-0", exit_code=0)
+        controller.run_until_quiet()
+        assert "Succeeded" in client.describe("desc")
+
     def test_patch_merges_spec(self):
         sub, controller, client = self.setup_env()
         client.create(make_job({"Worker": 2}, name="patchy"))
@@ -686,6 +705,24 @@ class TestSdkCli:
             assert main(base + ["logs", "mnist-tpu", "--master"]) == 0
             out = capsys.readouterr().out
             assert "hello" in out
+            # describe over the wire (KubeSubstrate.events_for path)
+            with server.store.lock:
+                rv = next(server.store.rv)
+                server.store.objects[("events", "kubeflow", "ev1")] = {
+                    "apiVersion": "v1", "kind": "Event",
+                    "metadata": {"name": "ev1", "namespace": "kubeflow",
+                                 "resourceVersion": str(rv)},
+                    "type": "Normal", "reason": "SuccessfulCreatePod",
+                    "message": "Created pod: mnist-tpu-tpu-0",
+                    "involvedObject": {"kind": "TFJob",
+                                       "name": "mnist-tpu",
+                                       "namespace": "kubeflow"},
+                }
+            assert main(base + ["describe", "mnist-tpu"]) == 0
+            out = capsys.readouterr().out
+            assert "Name:         mnist-tpu" in out
+            assert "Replica Specs:" in out
+            assert "SuccessfulCreatePod" in out
             # --tail and --container ride the wire as ?tailLines=/
             # ?container= (the real apiserver's /log contract, which
             # the fake implements: bad container name -> 400)

@@ -484,6 +484,36 @@ class KubeSubstrate:
         except ApiError as err:
             logger.warning("failed to record event: %s", err)
 
+    def events_for(self, kind: str, name: str,
+                   namespace: Optional[str] = None) -> List[k8s.Event]:
+        """Events whose involvedObject matches (kind, name) — the read
+        side of record_event, mirroring InMemorySubstrate.events_for
+        (namespace=None means ALL namespaces on both substrates, so
+        code developed against the fake behaves identically here).
+        Filtered client-side (the fieldSelector index is an
+        apiserver-internal optimization this client doesn't require)."""
+        path = (
+            self._core_path("events", namespace)
+            if namespace
+            else "/api/v1/events"
+        )
+        data = self._request("GET", path)
+        out = []
+        for item in data.get("items", []):
+            involved = item.get("involvedObject", {})
+            if involved.get("kind") != kind or involved.get("name") != name:
+                continue
+            out.append(k8s.Event(
+                type=item.get("type", ""),
+                reason=item.get("reason", ""),
+                message=item.get("message", ""),
+                involved_object_kind=kind,
+                involved_object_name=name,
+                involved_object_namespace=involved.get("namespace", ""),
+                timestamp=item.get("metadata", {}).get("creationTimestamp"),
+            ))
+        return out
+
     # -- Leases (leader election, coordination.k8s.io/v1) ------------------
 
     @staticmethod

@@ -128,6 +128,9 @@ class Substrate(Protocol):
 
     # Events + watches
     def record_event(self, event: k8s.Event) -> None: ...
+    def events_for(
+        self, kind: str, name: str, namespace: Optional[str] = None
+    ) -> List[k8s.Event]: ...
     def subscribe(self, kind: str, callback: WatchCallback) -> None: ...
     def unsubscribe(self, kind: str, callback: WatchCallback) -> None: ...
 
@@ -461,12 +464,19 @@ class InMemorySubstrate:
                 event.timestamp = now_iso()
             self.events.append(event)
 
-    def events_for(self, kind: str, name: str) -> List[k8s.Event]:
+    def events_for(
+        self, kind: str, name: str, namespace: Optional[str] = None
+    ) -> List[k8s.Event]:
         with self._lock:
             return [
                 e
                 for e in self.events
-                if e.involved_object_kind == kind and e.involved_object_name == name
+                if e.involved_object_kind == kind
+                and e.involved_object_name == name
+                and (
+                    namespace is None
+                    or e.involved_object_namespace == namespace
+                )
             ]
 
     # -- Pod logs ----------------------------------------------------------

@@ -5,7 +5,8 @@ kubectl + the python client; this gives the same verbs in one tool):
     python -m tf_operator_tpu.sdk get mnist-tpu -n kubeflow
     python -m tf_operator_tpu.sdk wait mnist-tpu --timeout 600
     python -m tf_operator_tpu.sdk watch mnist-tpu
-    python -m tf_operator_tpu.sdk logs mnist-tpu --master
+    python -m tf_operator_tpu.sdk logs mnist-tpu --master --tail 50
+    python -m tf_operator_tpu.sdk describe mnist-tpu
     python -m tf_operator_tpu.sdk delete mnist-tpu
 
 Talks to a real apiserver via the typed substrate (in-cluster or
@@ -69,6 +70,11 @@ def main(argv=None) -> int:
         "creation (the library watch() semantics)",
     )
 
+    p_describe = sub.add_parser(
+        "describe", help="spec/conditions/replica-status/events summary"
+    )
+    p_describe.add_argument("name")
+
     p_delete = sub.add_parser("delete", help="delete a TFJob")
     p_delete.add_argument("name")
 
@@ -119,6 +125,8 @@ def _run(args) -> int:
         ).items():
             print(f"==> {name} <==")
             print(text)
+    elif args.verb == "describe":
+        print(client.describe(args.name))
     elif args.verb == "delete":
         client.delete(args.name)
         print(f"tfjob.kubeflow.org/{args.name} deleted")

@@ -188,6 +188,67 @@ class TFJobClient:
             for pod_name in names
         }
 
+    def describe(self, name: str, namespace: Optional[str] = None) -> str:
+        """kubectl-describe-style text: spec summary, conditions,
+        replica statuses, and recorded events — the at-a-glance debug
+        surface (`python -m tf_operator_tpu.sdk describe NAME`)."""
+        namespace = namespace or self.namespace
+        job = self.get(name, namespace)
+        lines = [
+            f"Name:         {job.name}",
+            f"Namespace:    {job.namespace}",
+            f"Created:      {job.metadata.creation_timestamp or '<none>'}",
+            "Replica Specs:",
+        ]
+        for rtype, spec in sorted(job.spec.tf_replica_specs.items()):
+            extra = ""
+            if getattr(spec, "tpu_accelerator", None):
+                extra = (
+                    f"  accelerator={spec.tpu_accelerator}"
+                    f" topology={spec.tpu_topology or '-'}"
+                )
+            # jobs stored outside the SDK may omit restartPolicy (the
+            # controller defaults a COPY at admission, never the store)
+            policy = (
+                spec.restart_policy.value
+                if spec.restart_policy is not None
+                else "<unset>"
+            )
+            lines.append(
+                f"  {rtype}: replicas={spec.replicas} "
+                f"restartPolicy={policy}{extra}"
+            )
+        lines.append("Conditions:")
+        if not job.status.conditions:
+            lines.append("  <none>")
+        for cond in job.status.conditions:
+            lines.append(
+                f"  {cond.type.value:<12} {cond.status:<6} "
+                f"{cond.reason:<22} {cond.message}"
+            )
+        lines.append("Replica Statuses:")
+        if not job.status.replica_statuses:
+            lines.append("  <none>")
+        for rtype, rs in sorted(job.status.replica_statuses.items()):
+            lines.append(
+                f"  {rtype}: active={rs.active} succeeded={rs.succeeded} "
+                f"failed={rs.failed} restarts={rs.restarts}"
+            )
+        lines.append("Events:")
+        events = self.substrate.events_for(
+            "TFJob", name, namespace=namespace
+        )
+        # chronological regardless of substrate list order (a real
+        # apiserver lists by name); None timestamps sort first
+        events = sorted(events, key=lambda e: e.timestamp or "")
+        if not events:
+            lines.append("  <none>")
+        for event in events[-20:]:  # newest last, kubectl-style tail
+            lines.append(
+                f"  {event.type:<8} {event.reason:<22} {event.message}"
+            )
+        return "\n".join(lines)
+
 
 def _deep_merge(base: dict, patch: dict) -> dict:
     out = dict(base)
