@@ -152,6 +152,49 @@ class TestDecode:
             gpt_lib.generate(cfg, state.params, prompt, max_new_tokens=1)
 
 
+class TestRaggedDecode:
+    def test_ragged_rows_match_their_solo_decodes(self, cfg, trained):
+        """prompt_lens: one right-padded batch with per-row prompt
+        boundaries. Every row's (len_i + new)-token answer must equal
+        the decode of that row alone with its exact prompt — proving
+        the pad region is never read and forcing respects each row's
+        own boundary."""
+        _, state, _, _ = trained
+        params = jax.device_get(state.params)
+        full = gpt_lib.synthetic_batch(
+            jax.random.PRNGKey(13), 2, 7, cfg
+        )["input_ids"]
+        lens = [4, 7]
+        new = 5
+        # right-pad row 0 past its 4 real tokens with junk the decode
+        # must never read
+        padded = np.asarray(full).copy()
+        padded[0, 4:] = 999 % cfg.vocab_size
+        ragged = gpt_lib.generate(
+            cfg, params, jnp.asarray(padded), max_new_tokens=new,
+            prompt_lens=jnp.asarray(lens),
+        )
+        for row, length in enumerate(lens):
+            solo = gpt_lib.generate(
+                cfg, params, jnp.asarray(padded[row:row + 1, :length]),
+                max_new_tokens=new,
+            )
+            np.testing.assert_array_equal(
+                np.asarray(ragged[row, :length + new]),
+                np.asarray(solo[0]),
+                err_msg=f"row {row} (len {length}) diverged",
+            )
+
+    def test_bad_lens_shape_rejected(self, cfg, trained):
+        _, state, _, _ = trained
+        prompt = jnp.zeros((2, 4), jnp.int32)
+        with pytest.raises(ValueError, match="prompt_lens"):
+            gpt_lib.generate(
+                cfg, state.params, prompt, max_new_tokens=2,
+                prompt_lens=jnp.asarray([4]),
+            )
+
+
 class TestInt8KvCache:
     """kv_quant_int8: decode over an int8 KV cache (per-position,
     per-head absmax scales). Decode is HBM-bandwidth-bound, so half

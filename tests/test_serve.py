@@ -61,7 +61,7 @@ class TestDecodeServer:
         assert status == 200
         tokens = np.asarray(body["tokens"])
         assert tokens.shape == (2, 4 + 6)
-        assert body["prompt_len"] == 4
+        assert body["prompt_lens"] == [4, 4]
         # prompt is a prefix of the output
         np.testing.assert_array_equal(tokens[:, :4], np.asarray(prompt))
         assert ((tokens >= 0) & (tokens < cfg.vocab_size)).all()
@@ -92,9 +92,25 @@ class TestDecodeServer:
         assert body["model"] == "gpt-test"
         assert body["decodes"] >= 1
 
+    def test_ragged_batch_per_row_answers(self, server):
+        """Mixed prompt lengths in one request: each row's answer is
+        its own prompt (as a prefix) plus exactly max_new tokens, and
+        matches the row decoded alone — the server's padding is
+        invisible."""
+        cfg, port = server
+        _, body = post(port, {
+            "input_ids": [[1, 2, 3, 4, 5, 6], [7, 8]],
+            "max_new_tokens": 4,
+        })
+        assert body["prompt_lens"] == [6, 2]
+        assert [len(t) for t in body["tokens"]] == [10, 6]
+        assert body["tokens"][0][:6] == [1, 2, 3, 4, 5, 6]
+        assert body["tokens"][1][:2] == [7, 8]
+        _, solo = post(port, {"input_ids": [[7, 8]], "max_new_tokens": 4})
+        assert solo["tokens"][0] == body["tokens"][1]
+
     @pytest.mark.parametrize("payload,fragment", [
         ({"input_ids": []}, "non-empty"),
-        ({"input_ids": [[1, 2], [3]]}, "ragged"),
         ({"input_ids": [[999999]]}, "token ids"),
         ({"input_ids": [[1]], "max_new_tokens": 0}, "max_new_tokens"),
         ({"input_ids": [[1]], "max_new_tokens": 10_000}, "max_new_tokens"),
@@ -110,7 +126,7 @@ class TestDecodeServer:
         ({"input_ids": [[True]]}, "integer"),
         ({"input_ids": [[1]], "seed": "abc"}, "seed"),
         ({"input_ids": [[1]], "max_new_tokens": True}, "max_new_tokens"),
-    ], ids=["empty", "ragged", "oov", "zero-new", "cap", "neg-temp",
+    ], ids=["empty", "oov", "zero-new", "cap", "neg-temp",
             "overflow", "int-body", "list-body", "str-token",
             "nested-token", "huge-token", "bool-token", "str-seed",
             "bool-new"])

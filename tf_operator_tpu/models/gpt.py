@@ -310,7 +310,7 @@ def _compiled_decode(cfg: GPTConfig, temperature: float, batch: int,
     )
 
     @jax.jit
-    def run(params, prompt, rng):
+    def run(params, prompt, rng, lens):
         cache0 = jax.tree_util.tree_map(
             lambda s: jnp.zeros(s.shape, s.dtype), cache_shapes
         )
@@ -328,9 +328,12 @@ def _compiled_decode(cfg: GPTConfig, temperature: float, batch: int,
                 )
             else:
                 nxt = jnp.argmax(logits, axis=-1)
-            # while still inside the prompt, the "generated" token is
-            # overridden by the actual next prompt token
-            in_prompt = index + 1 < prompt_len
+            # while still inside ITS prompt, each row's "generated"
+            # token is overridden by that row's actual next prompt
+            # token — `lens` is per-row, so a ragged (right-padded)
+            # batch starts generating at each row's own boundary and
+            # never reads the pad region
+            in_prompt = index + 1 < lens  # [b]
             forced = prompt[:, jnp.minimum(index + 1, prompt_len - 1)]
             nxt = jnp.where(in_prompt, forced, nxt).astype(jnp.int32)
             return (updates["cache"], nxt, rng), nxt
@@ -354,12 +357,23 @@ def generate(
     mesh=None,
     rules=None,
     kv_quant_int8: bool = False,
+    prompt_lens: Optional[jax.Array] = None,
 ) -> jax.Array:
     """Greedy (temperature 0) or sampled decode. prompt: [b, p_len].
     Returns [b, p_len + max_new_tokens]. The whole decode is ONE jitted
     lax.scan (compiled once per config/shape, cached) — prefill feeds
     prompt tokens through the cache, then new tokens feed back
     autoregressively.
+
+    prompt_lens (optional, [b] ints): RAGGED batches. prompt is
+    right-padded to p_len; row i's forcing window is its own
+    prompt_lens[i], so shorter rows start generating at their own
+    boundary and the pad region is never read — each row's stream is
+    dense (prompt tokens, then generated), and row i's first
+    prompt_lens[i] + max_new_tokens positions are its answer. Lengths
+    are a runtime argument: ragged batches of the same SHAPE reuse one
+    compiled decode. Shorter rows generate extra tokens past their
+    max_new_tokens promise (all rows run the same scan); callers slice.
 
     mesh (optional, a jax.sharding.Mesh): multi-chip decode. Params are
     placed by `rules` (default TRANSFORMER_RULES: Megatron tp on the
@@ -379,6 +393,23 @@ def generate(
         )
     if rng is None:
         rng = jax.random.PRNGKey(0)
+    if prompt_lens is None:
+        lens = jnp.full((batch,), prompt_len, jnp.int32)
+    else:
+        lens = jnp.asarray(prompt_lens, jnp.int32)
+        if lens.shape != (batch,):
+            raise ValueError(
+                f"prompt_lens shape {lens.shape} != ({batch},)"
+            )
+        # out-of-range lengths would silently emit clamped prompt
+        # tokens as "answers"; fail loudly instead (host-side check —
+        # lens is a concrete array at the generate() boundary)
+        lens_host = jax.device_get(lens)
+        if (lens_host < 1).any() or (lens_host > prompt_len).any():
+            raise ValueError(
+                f"prompt_lens must be in [1, {prompt_len}], got "
+                f"{lens_host.tolist()}"
+            )
     if mesh is not None:
         from jax.sharding import NamedSharding, PartitionSpec
 
@@ -404,9 +435,15 @@ def generate(
         )
         prompt = jax.device_put(prompt, NamedSharding(mesh, batch_spec))
         rng = jax.device_put(rng, NamedSharding(mesh, PartitionSpec()))
+        lens_spec = (
+            PartitionSpec(batch_spec[0])
+            if len(batch_spec) > 0
+            else PartitionSpec()
+        )
+        lens = jax.device_put(lens, NamedSharding(mesh, lens_spec))
     run = _compiled_decode(
         cfg, float(temperature), batch, prompt_len, total,
         kv_quant_int8=kv_quant_int8,
     )
-    generated = run(params, prompt, rng)
+    generated = run(params, prompt, rng, lens)
     return jnp.concatenate([prompt[:, :1], generated], axis=1)

@@ -9,10 +9,15 @@ same ``models/gpt.py generate`` the benchmarks measure:
     python -m tf_operator_tpu.serve --preset small \
         --checkpoint-dir /ckpt/gpt --kv-int8
 
-    POST /generate   {"input_ids": [[1,2,3], ...],
+    POST /generate   {"input_ids": [[1,2,3], [7,8], ...],   # ragged OK
                       "max_new_tokens": 32, "temperature": 0.0}
-                  -> {"tokens": [[...], ...], "prompt_len": 3}
+                  -> {"tokens": [[...], ...], "prompt_lens": [3, 2, ...]}
     GET  /healthz -> {"status": "ok", "model": "...", "decodes": N}
+
+Ragged batches are first-class: rows are right-padded server-side and
+decoded in one scan with per-row prompt boundaries
+(models/gpt.py generate prompt_lens) — each row's answer is its own
+prompt plus max_new_tokens.
 
 TPU-first behavior worth naming:
 - the whole decode is ONE jitted lax.scan, compiled per
@@ -65,9 +70,10 @@ def _bad(payload) -> tuple:
 
 
 def _validate(state: _State, body):
-    """-> (prompt array, max_new_tokens, temperature, seed) or
-    (status, err). Every malformed field is a 400, never a dropped
-    connection — the contract tests/test_serve.py pins."""
+    """-> (right-padded prompt array, per-row lens list,
+    max_new_tokens, temperature, seed) or (status, err). Every
+    malformed field is a 400, never a dropped connection — the
+    contract tests/test_serve.py pins."""
     import numpy as np
 
     if not isinstance(body, dict):
@@ -82,19 +88,19 @@ def _validate(state: _State, body):
         for row in ids for tok in row
     ):
         return _bad("every token must be an integer")
-    lens = {len(row) for row in ids}
-    if len(lens) != 1:
-        return _bad(
-            f"ragged prompts not supported (lengths {sorted(lens)}); "
-            "pad client-side to one length per request"
-        )
     if len(ids) > MAX_BATCH:
         return _bad(f"batch {len(ids)} exceeds cap {MAX_BATCH}")
     if any(
         tok < 0 or tok >= state.cfg.vocab_size for row in ids for tok in row
     ):
         return _bad(f"token ids must be in [0, {state.cfg.vocab_size})")
-    prompt = np.asarray(ids, dtype=np.int32)
+    # ragged batches are first-class: right-pad to the longest row;
+    # generate() takes the true per-row lengths and never reads the pad
+    lens = [len(row) for row in ids]
+    width = max(lens)
+    prompt = np.zeros((len(ids), width), dtype=np.int32)
+    for i, row in enumerate(ids):
+        prompt[i, :len(row)] = row
     new = body.get("max_new_tokens", 16)
     if not isinstance(new, int) or isinstance(new, bool) or not (
         1 <= new <= state.max_new_cap
@@ -102,9 +108,9 @@ def _validate(state: _State, body):
         return _bad(
             f"max_new_tokens must be an int in [1, {state.max_new_cap}]"
         )
-    if prompt.shape[1] + new > state.cfg.max_seq_len:
+    if width + new > state.cfg.max_seq_len:
         return _bad(
-            f"prompt_len {prompt.shape[1]} + max_new_tokens {new} "
+            f"prompt_len {width} + max_new_tokens {new} "
             f"exceeds max_seq_len {state.cfg.max_seq_len}"
         )
     temperature = body.get("temperature", 0.0)
@@ -115,7 +121,7 @@ def _validate(state: _State, body):
     seed = body.get("seed", 0)
     if not isinstance(seed, int) or isinstance(seed, bool):
         return _bad("seed must be an integer")
-    return prompt, new, float(temperature), seed
+    return prompt, lens, new, float(temperature), seed
 
 
 def DecodeHandlerFactory(state: _State):
@@ -154,8 +160,9 @@ def DecodeHandlerFactory(state: _State):
             result = _validate(state, body)
             if isinstance(result[0], int):  # (status, payload)
                 return self._reply(*result)
-            prompt, new, temperature, seed = result
+            prompt, lens, new, temperature, seed = result
             import jax
+            import jax.numpy as jnp
 
             rng = jax.random.PRNGKey(seed)
             with state.lock:  # decode saturates the chip; serialize
@@ -163,11 +170,20 @@ def DecodeHandlerFactory(state: _State):
                     state.cfg, state.params, prompt, max_new_tokens=new,
                     temperature=temperature, rng=rng,
                     kv_quant_int8=state.kv_quant_int8,
+                    prompt_lens=jnp.asarray(lens),
                 )
                 state.decodes += 1
+            chains = jax.device_get(out)
+            # each row's answer is its own prompt plus max_new tokens
+            # (the shared scan makes shorter rows generate further;
+            # that overrun is private to the server)
+            tokens = [
+                chains[i, :lens[i] + new].tolist()
+                for i in range(len(lens))
+            ]
             self._reply(200, {
-                "tokens": jax.device_get(out).tolist(),
-                "prompt_len": int(prompt.shape[1]),
+                "tokens": tokens,
+                "prompt_lens": lens,
             })
 
         def log_message(self, *args) -> None:
