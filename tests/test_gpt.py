@@ -152,6 +152,63 @@ class TestDecode:
             gpt_lib.generate(cfg, state.params, prompt, max_new_tokens=1)
 
 
+class TestSamplingFilters:
+    """_filter_logits: static-shape top-k / nucleus filtering."""
+
+    def test_top_k_keeps_exactly_k(self):
+        logits = jnp.asarray([[3.0, 1.0, 2.0, 0.0, -1.0]])
+        out = gpt_lib._filter_logits(logits, top_k=2, top_p=1.0)
+        finite = np.isfinite(np.asarray(out))[0]
+        np.testing.assert_array_equal(
+            finite, [True, False, True, False, False]
+        )
+        # surviving logits unchanged
+        assert float(out[0, 0]) == 3.0 and float(out[0, 2]) == 2.0
+
+    def test_top_p_keeps_nucleus_including_boundary_token(self):
+        # probs ~ [0.643, 0.237, 0.087, 0.032] for logits [3,2,1,0]
+        logits = jnp.asarray([[3.0, 2.0, 1.0, 0.0]])
+        out = gpt_lib._filter_logits(logits, top_k=0, top_p=0.7)
+        finite = np.isfinite(np.asarray(out))[0]
+        # 0.643 < 0.7 keeps the top token; the SECOND token crosses the
+        # boundary (preceding mass 0.643 < 0.7) and stays; the third's
+        # preceding mass 0.88 >= 0.7 drops
+        np.testing.assert_array_equal(finite, [True, True, False, False])
+
+    def test_top_p_always_keeps_argmax(self):
+        logits = jnp.asarray([[5.0, 0.0, 0.0, 0.0]])
+        out = gpt_lib._filter_logits(logits, top_k=0, top_p=0.01)
+        finite = np.isfinite(np.asarray(out))[0]
+        assert finite[0] and finite.sum() == 1
+
+    def test_sampled_decode_respects_top_k(self, cfg, trained):
+        """End to end: with top_k=1, sampling at ANY temperature
+        degenerates to greedy — the chains must match argmax decode."""
+        _, state, _, _ = trained
+        params = jax.device_get(state.params)
+        prompt = gpt_lib.synthetic_batch(
+            jax.random.PRNGKey(14), 2, 6, cfg
+        )["input_ids"]
+        greedy = gpt_lib.generate(cfg, params, prompt, max_new_tokens=8)
+        topk1 = gpt_lib.generate(
+            cfg, params, prompt, max_new_tokens=8,
+            temperature=5.0, top_k=1, rng=jax.random.PRNGKey(99),
+        )
+        np.testing.assert_array_equal(np.asarray(topk1), np.asarray(greedy))
+
+    def test_invalid_filters_rejected(self, cfg, trained):
+        _, state, _, _ = trained
+        prompt = jnp.zeros((1, 4), jnp.int32)
+        with pytest.raises(ValueError, match="top_k"):
+            gpt_lib.generate(
+                cfg, state.params, prompt, max_new_tokens=2, top_k=-1
+            )
+        with pytest.raises(ValueError, match="top_p"):
+            gpt_lib.generate(
+                cfg, state.params, prompt, max_new_tokens=2, top_p=0.0
+            )
+
+
 class TestRaggedDecode:
     def test_ragged_rows_match_their_solo_decodes(self, cfg, trained):
         """prompt_lens: one right-padded batch with per-row prompt

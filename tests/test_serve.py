@@ -82,6 +82,16 @@ class TestDecodeServer:
         })
         assert a["tokens"] != b["tokens"]
 
+    def test_top_k_one_is_greedy(self, server):
+        _, port = server
+        prompt = [[9, 10, 11, 12]]
+        _, greedy = post(port, {"input_ids": prompt, "max_new_tokens": 8})
+        _, filtered = post(port, {
+            "input_ids": prompt, "max_new_tokens": 8,
+            "temperature": 3.0, "top_k": 1, "seed": 7,
+        })
+        assert filtered["tokens"] == greedy["tokens"]
+
     def test_healthz_counts_decodes(self, server):
         _, port = server
         with urllib.request.urlopen(
@@ -126,10 +136,13 @@ class TestDecodeServer:
         ({"input_ids": [[True]]}, "integer"),
         ({"input_ids": [[1]], "seed": "abc"}, "seed"),
         ({"input_ids": [[1]], "max_new_tokens": True}, "max_new_tokens"),
+        ({"input_ids": [[1]], "top_k": -1}, "top_k"),
+        ({"input_ids": [[1]], "top_p": 0}, "top_p"),
+        ({"input_ids": [[1]], "top_p": 1.5}, "top_p"),
     ], ids=["empty", "oov", "zero-new", "cap", "neg-temp",
             "overflow", "int-body", "list-body", "str-token",
             "nested-token", "huge-token", "bool-token", "str-seed",
-            "bool-new"])
+            "bool-new", "neg-topk", "zero-topp", "big-topp"])
     def test_validation_is_400_not_500(self, server, payload, fragment):
         _, port = server
         status, body = post_err(port, payload)

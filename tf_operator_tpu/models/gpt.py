@@ -290,10 +290,34 @@ class _CachedBlock(nn.Module):
         return x + transformer_mlp(cfg, y)
 
 
+def _filter_logits(logits: jax.Array, top_k: int, top_p: float) -> jax.Array:
+    """Nucleus/top-k filtering for sampling: logits outside the keep
+    set drop to -inf. Static-shape TPU formulation — top_k via the
+    k-th value threshold (lax.top_k, no gather/scatter), top_p via the
+    sorted-cumulative-probability mask mapped back through argsort."""
+    if top_k > 0 and top_k < logits.shape[-1]:
+        kth = jax.lax.top_k(logits, top_k)[0][..., -1:]
+        logits = jnp.where(logits < kth, -jnp.inf, logits)
+    if 0.0 < top_p < 1.0:
+        order = jnp.argsort(logits, axis=-1)[..., ::-1]  # descending
+        sorted_logits = jnp.take_along_axis(logits, order, axis=-1)
+        probs = jax.nn.softmax(sorted_logits, axis=-1)
+        cum = jnp.cumsum(probs, axis=-1)
+        # keep every token whose PRECEDING mass is < top_p (the first
+        # token always survives; the one crossing the boundary stays)
+        keep_sorted = (cum - probs) < top_p
+        keep = jnp.take_along_axis(
+            keep_sorted, jnp.argsort(order, axis=-1), axis=-1
+        )
+        logits = jnp.where(keep, logits, -jnp.inf)
+    return logits
+
+
 @functools.lru_cache(maxsize=32)
 def _compiled_decode(cfg: GPTConfig, temperature: float, batch: int,
                      prompt_len: int, total: int,
-                     kv_quant_int8: bool = False):
+                     kv_quant_int8: bool = False,
+                     top_k: int = 0, top_p: float = 1.0):
     """One compiled decode scan per (config, temperature, shape) —
     generate() calls with the same shapes reuse it instead of paying a
     re-trace + XLA compile per call (the serving/eval loop pattern).
@@ -323,8 +347,15 @@ def _compiled_decode(cfg: GPTConfig, temperature: float, batch: int,
             )
             rng, sample_rng = jax.random.split(rng)
             if temperature > 0.0:
+                # temperature FIRST, then the filters (the standard
+                # order): the top_p nucleus must be taken from the
+                # tempered distribution, or high temperatures collapse
+                # to near-greedy
+                filtered = _filter_logits(
+                    logits / temperature, top_k, top_p
+                )
                 nxt = jax.random.categorical(
-                    sample_rng, logits / temperature, axis=-1
+                    sample_rng, filtered, axis=-1
                 )
             else:
                 nxt = jnp.argmax(logits, axis=-1)
@@ -358,6 +389,8 @@ def generate(
     rules=None,
     kv_quant_int8: bool = False,
     prompt_lens: Optional[jax.Array] = None,
+    top_k: int = 0,
+    top_p: float = 1.0,
 ) -> jax.Array:
     """Greedy (temperature 0) or sampled decode. prompt: [b, p_len].
     Returns [b, p_len + max_new_tokens]. The whole decode is ONE jitted
@@ -384,13 +417,26 @@ def generate(
 
     kv_quant_int8: int8 KV cache with per-(position, head) scales —
     halves the per-step cache HBM traffic decode is bound by (see
-    CachedSelfAttention)."""
+    CachedSelfAttention).
+
+    top_k / top_p (sampling only, temperature > 0): standard top-k and
+    nucleus filtering before the categorical draw; 0 / 1.0 disable.
+    Static-shape TPU formulations (threshold compare and sorted-
+    cumulative mask — no dynamic shapes inside the scan)."""
     batch, prompt_len = prompt.shape
     total = prompt_len + max_new_tokens
     if total > cfg.max_seq_len:
         raise ValueError(
             f"prompt+new = {total} exceeds max_seq_len {cfg.max_seq_len}"
         )
+    if top_k < 0:
+        raise ValueError(f"top_k must be >= 0, got {top_k}")
+    if not 0.0 < top_p <= 1.0:
+        raise ValueError(f"top_p must be in (0, 1], got {top_p}")
+    if top_k >= cfg.vocab_size:
+        # semantically disabled; normalize so every such value shares
+        # ONE compiled-decode cache entry instead of recompiling
+        top_k = 0
     if rng is None:
         rng = jax.random.PRNGKey(0)
     if prompt_lens is None:
@@ -444,6 +490,7 @@ def generate(
     run = _compiled_decode(
         cfg, float(temperature), batch, prompt_len, total,
         kv_quant_int8=kv_quant_int8,
+        top_k=int(top_k), top_p=float(top_p),
     )
     generated = run(params, prompt, rng, lens)
     return jnp.concatenate([prompt[:, :1], generated], axis=1)

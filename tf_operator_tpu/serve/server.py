@@ -10,7 +10,8 @@ same ``models/gpt.py generate`` the benchmarks measure:
         --checkpoint-dir /ckpt/gpt --kv-int8
 
     POST /generate   {"input_ids": [[1,2,3], [7,8], ...],   # ragged OK
-                      "max_new_tokens": 32, "temperature": 0.0}
+                      "max_new_tokens": 32, "temperature": 0.0,
+                      "top_k": 0, "top_p": 1.0, "seed": 0}
                   -> {"tokens": [[...], ...], "prompt_lens": [3, 2, ...]}
     GET  /healthz -> {"status": "ok", "model": "...", "decodes": N}
 
@@ -71,8 +72,8 @@ def _bad(payload) -> tuple:
 
 def _validate(state: _State, body):
     """-> (right-padded prompt array, per-row lens list,
-    max_new_tokens, temperature, seed) or (status, err). Every
-    malformed field is a 400, never a dropped connection — the
+    max_new_tokens, temperature, seed, top_k, top_p) or (status, err).
+    Every malformed field is a 400, never a dropped connection — the
     contract tests/test_serve.py pins."""
     import numpy as np
 
@@ -121,7 +122,15 @@ def _validate(state: _State, body):
     seed = body.get("seed", 0)
     if not isinstance(seed, int) or isinstance(seed, bool):
         return _bad("seed must be an integer")
-    return prompt, lens, new, float(temperature), seed
+    top_k = body.get("top_k", 0)
+    if not isinstance(top_k, int) or isinstance(top_k, bool) or top_k < 0:
+        return _bad("top_k must be an integer >= 0")
+    top_p = body.get("top_p", 1.0)
+    if not isinstance(top_p, (int, float)) or isinstance(top_p, bool) or (
+        not 0.0 < float(top_p) <= 1.0
+    ):
+        return _bad("top_p must be in (0, 1]")
+    return prompt, lens, new, float(temperature), seed, top_k, float(top_p)
 
 
 def DecodeHandlerFactory(state: _State):
@@ -160,7 +169,7 @@ def DecodeHandlerFactory(state: _State):
             result = _validate(state, body)
             if isinstance(result[0], int):  # (status, payload)
                 return self._reply(*result)
-            prompt, lens, new, temperature, seed = result
+            prompt, lens, new, temperature, seed, top_k, top_p = result
             import jax
             import jax.numpy as jnp
 
@@ -171,6 +180,7 @@ def DecodeHandlerFactory(state: _State):
                     temperature=temperature, rng=rng,
                     kv_quant_int8=state.kv_quant_int8,
                     prompt_lens=jnp.asarray(lens),
+                    top_k=top_k, top_p=top_p,
                 )
                 state.decodes += 1
             chains = jax.device_get(out)
