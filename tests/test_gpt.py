@@ -207,6 +207,133 @@ class TestSamplingFilters:
             gpt_lib.generate(
                 cfg, state.params, prompt, max_new_tokens=2, top_p=0.0
             )
+        with pytest.raises(ValueError, match="max_new_tokens"):
+            gpt_lib.generate(cfg, state.params, prompt, max_new_tokens=0)
+
+
+class TestPrefillPath:
+    """Uniform-prompt decode prefills the whole prompt in ONE batched
+    forward (GPTPrefill, param-path identical to GPTDecodeStep) and
+    scans only the new tokens; the ragged path steps every position.
+    The two must tell the same story."""
+
+    def test_uniform_lens_select_the_prefill_path(self, cfg, trained):
+        """Path selection is by VALUES: a caller that always passes
+        prompt_lens (the serving pattern) still gets the batched
+        prefill when the batch is uniform — both calls share one
+        compiled entry, so their chains are identical by construction."""
+        _, state, _, _ = trained
+        params = jax.device_get(state.params)
+        prompt = gpt_lib.synthetic_batch(
+            jax.random.PRNGKey(15), 2, 8, cfg
+        )["input_ids"]
+        bare = gpt_lib.generate(cfg, params, prompt, max_new_tokens=6)
+        with_lens = gpt_lib.generate(
+            cfg, params, prompt, max_new_tokens=6,
+            prompt_lens=jnp.full((2,), prompt.shape[1]),
+        )
+        np.testing.assert_array_equal(
+            np.asarray(bare), np.asarray(with_lens)
+        )
+
+    def test_prefill_chain_matches_scan_chain(self, cfg, trained):
+        """Same params, same prompt: the prefill-path greedy chain vs
+        the all-scan decode (driven through the ragged compile
+        directly — uniform lens now select prefill by design). bf16
+        batched-vs-sequential attention reassociates reductions, so
+        skip on argmax near-ties exactly like the sharded-decode
+        test."""
+        model, state, _, _ = trained
+        params = jax.device_get(state.params)
+        prompt = gpt_lib.synthetic_batch(
+            jax.random.PRNGKey(15), 2, 8, cfg
+        )["input_ids"]
+        new = 6
+        prefill = gpt_lib.generate(cfg, params, prompt, max_new_tokens=new)
+        logits = model.apply({"params": params}, prefill[:, :-1])
+        consumed = logits[:, prompt.shape[1] - 1:]
+        top2 = jnp.sort(consumed.astype(jnp.float32), axis=-1)[..., -2:]
+        min_gap = float(jnp.min(top2[..., 1] - top2[..., 0]))
+        if min_gap < 1e-3:
+            pytest.skip(f"argmax near-tie (gap {min_gap:.2e})")
+        run = gpt_lib._compiled_decode(
+            cfg, 0.0, 2, prompt.shape[1], prompt.shape[1] + new,
+            ragged=True,
+        )
+        scanned_tail = run(
+            params, jnp.asarray(prompt), jax.random.PRNGKey(0),
+            jnp.full((2,), prompt.shape[1]),
+        )
+        scanned = jnp.concatenate(
+            [prompt[:, :1], scanned_tail], axis=1
+        )
+        np.testing.assert_array_equal(
+            np.asarray(prefill), np.asarray(scanned)
+        )
+
+    def test_prefill_cache_matches_stepwise_cache(self, cfg, trained):
+        """The caches themselves: prefilling a prompt must leave the
+        SAME K/V (and int8+scale) contents as feeding it token by
+        token — the decode scan continues from either identically."""
+        _, state, _, _ = trained
+        params = state.params
+        seq = gpt_lib.synthetic_batch(
+            jax.random.PRNGKey(16), 2, 10, cfg
+        )["input_ids"]
+        for quant in (False, True):
+            pre = gpt_lib.GPTPrefill(cfg, cache_len=16, kv_quant_int8=quant)
+            _, updates = pre.apply(
+                {"params": params}, seq, mutable=["cache"]
+            )
+            prefill_cache = updates["cache"]
+
+            dstep = gpt_lib.GPTDecodeStep(
+                cfg, cache_len=16, kv_quant_int8=quant
+            )
+            cache = jax.tree_util.tree_map(
+                lambda s: jnp.zeros(s.shape, s.dtype),
+                jax.eval_shape(
+                    lambda: dstep.init(
+                        jax.random.PRNGKey(0), jnp.zeros((2,), jnp.int32),
+                        jnp.int32(0),
+                    )["cache"]
+                ),
+            )
+            for i in range(10):
+                _, upd = dstep.apply(
+                    {"params": params, "cache": cache}, seq[:, i],
+                    jnp.int32(i), mutable=["cache"],
+                )
+                cache = upd["cache"]
+            def dequantized_kv(tree):
+                """Compare what attention READS: bf16 caches directly;
+                int8 caches as code*scale (raw codes may differ by a
+                unit wherever upstream bf16 noise crosses a
+                quantization boundary — that's not a contract
+                violation, the reconstructed vector is)."""
+                out = {}
+                for layer, sub in tree.items():
+                    attn = sub["attention"]
+                    for name in ("k", "v"):
+                        val = np.asarray(attn[name], dtype=np.float32)
+                        if quant:
+                            val = val * np.asarray(
+                                attn[name + "_scale"], dtype=np.float32
+                            )[..., None]
+                        out[f"{layer}/{name}"] = val
+                return out
+
+            a_kv = dequantized_kv(prefill_cache)
+            b_kv = dequantized_kv(cache)
+            assert a_kv.keys() == b_kv.keys()
+            for key in a_kv:
+                # quant path: upstream bf16 noise can move a code by a
+                # couple of units; one unit is ~absmax/127 of the
+                # vector, so the envelope is wider than the bf16 one
+                np.testing.assert_allclose(
+                    a_kv[key], b_kv[key], atol=0.08 if quant else 0.03,
+                    err_msg=f"{key} quant={quant}",
+                )
 
 
 class TestRaggedDecode:
@@ -384,7 +511,7 @@ class TestShardedDecode:
         [b, len, heads] f32 scale variable; parity bar is agreement
         with the SINGLE-DEVICE int8 decode (quantization noise is
         identical — only the sharding differs)."""
-        _, state, _, _ = trained
+        model, state, _, _ = trained
         params = jax.device_get(state.params)
         prompt = gpt_lib.synthetic_batch(
             jax.random.PRNGKey(12), 4, 8, cfg
@@ -398,10 +525,43 @@ class TestShardedDecode:
             kv_quant_int8=True,
         )
         assert sharded.shape == plain.shape
-        agreement = float(
-            (np.asarray(sharded) == np.asarray(plain)).mean()
+        pa, sa = np.asarray(plain), np.asarray(sharded)
+        # prompts are forced: always identical
+        np.testing.assert_array_equal(pa[:, :8], sa[:, :8])
+        # chains may legitimately fork where tp reassociation crosses a
+        # quantization boundary — but ONLY at genuinely close calls.
+        # Teacher-force the plain chain through the int8 decode step
+        # and demand that each row's first divergence sits on a small
+        # top-2 logit gap; a fork at a decisive position = real bug.
+        dstep = gpt_lib.GPTDecodeStep(
+            cfg, cache_len=pa.shape[1], kv_quant_int8=True
         )
-        # tp reassociates bf16 reductions, which can flip near-tie
-        # argmaxes; quantized logits widen ties slightly, so exact
-        # equality is not guaranteed — near-total agreement is
-        assert agreement > 0.9, agreement
+        cache = jax.tree_util.tree_map(
+            lambda s: jnp.zeros(s.shape, s.dtype),
+            jax.eval_shape(
+                lambda: dstep.init(
+                    jax.random.PRNGKey(0), jnp.zeros((4,), jnp.int32),
+                    jnp.int32(0),
+                )["cache"]
+            ),
+        )
+        step_logits = []
+        for i in range(pa.shape[1] - 1):
+            logits, upd = dstep.apply(
+                {"params": params, "cache": cache},
+                jnp.asarray(pa[:, i]), jnp.int32(i), mutable=["cache"],
+            )
+            cache = upd["cache"]
+            step_logits.append(np.asarray(logits, dtype=np.float32))
+        gaps = []
+        for row in range(4):
+            forks = np.nonzero(pa[row] != sa[row])[0]
+            if not len(forks):
+                continue
+            logits_at_fork = step_logits[forks[0] - 1][row]
+            top2 = np.sort(logits_at_fork)[-2:]
+            gaps.append(float(top2[1] - top2[0]))
+        assert all(gap < 0.25 for gap in gaps), (
+            f"sharded int8 decode forked at decisive positions "
+            f"(top-2 gaps {gaps})"
+        )

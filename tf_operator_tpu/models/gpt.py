@@ -144,6 +144,59 @@ def synthetic_batch(rng: jax.Array, batch_size: int, seq_len: int,
 # -- KV-cached autoregressive decoding --------------------------------------
 
 
+def _absmax_quantize(x: jax.Array):
+    """Symmetric int8 quantization over the last axis: (int8 values,
+    scale/127 with shape x.shape[:-1]). Shared by the per-token decode
+    write and the batched prefill write so both paths produce
+    IDENTICAL cache contents for the same vectors."""
+    x32 = x.astype(jnp.float32)
+    s = jnp.maximum(jnp.max(jnp.abs(x32), axis=-1), 1e-8)
+    q = jnp.clip(
+        jnp.round(x32 / s[..., None] * 127.0), -127, 127
+    ).astype(jnp.int8)
+    return q, s / 127.0
+
+
+def _store_kv(
+    mod: nn.Module, name: str, new: jax.Array, max_len: int,
+    dtype, kv_quant_int8: bool, index,
+):
+    """THE cache write — one implementation for both phases (decode
+    passes a [b, 1, h, d] token at a dynamic index; prefill a
+    [b, p, h, d] block at 0), so the int8/bf16 cache layout can never
+    desynchronize between them. Returns the full cache dequantized to
+    the compute dtype."""
+    batch, _, heads, head_dim = new.shape
+    if kv_quant_int8:
+        cache = mod.variable(
+            "cache", name,
+            lambda: jnp.zeros((batch, max_len, heads, head_dim), jnp.int8),
+        )
+        scale = mod.variable(
+            "cache", name + "_scale",
+            lambda: jnp.zeros((batch, max_len, heads), jnp.float32),
+        )
+        quantized, scale_new = _absmax_quantize(new)
+        cache.value = jax.lax.dynamic_update_slice(
+            cache.value, quantized, (0, index, 0, 0)
+        )
+        scale.value = jax.lax.dynamic_update_slice(
+            scale.value, scale_new, (0, index, 0)
+        )
+        return (
+            cache.value.astype(dtype)
+            * scale.value[..., None].astype(dtype)
+        )
+    cache = mod.variable(
+        "cache", name,
+        lambda: jnp.zeros((batch, max_len, heads, head_dim), dtype),
+    )
+    cache.value = jax.lax.dynamic_update_slice(
+        cache.value, new.astype(dtype), (0, index, 0, 0)
+    )
+    return cache.value
+
+
 class CachedSelfAttention(nn.Module):
     """Single-token decode attention over a pre-allocated KV cache.
 
@@ -170,40 +223,9 @@ class CachedSelfAttention(nn.Module):
     def _store(self, name: str, new, batch: int, index):
         """Write one token's K or V into its cache; returns the full
         cache dequantized to the compute dtype."""
-        store_dtype = jnp.int8 if self.kv_quant_int8 else self.dtype
-        cache = self.variable(
-            "cache", name,
-            lambda: jnp.zeros(
-                (batch, self.max_len, self.num_heads, self.head_dim),
-                store_dtype,
-            ),
-        )
-        if not self.kv_quant_int8:
-            cache.value = jax.lax.dynamic_update_slice(
-                cache.value, new[:, None].astype(self.dtype),
-                (0, index, 0, 0),
-            )
-            return cache.value
-        scale = self.variable(
-            "cache", name + "_scale",
-            lambda: jnp.zeros(
-                (batch, self.max_len, self.num_heads), jnp.float32
-            ),
-        )
-        new32 = new.astype(jnp.float32)  # [b, h, d]
-        s = jnp.maximum(jnp.max(jnp.abs(new32), axis=-1), 1e-8)
-        quantized = jnp.clip(
-            jnp.round(new32 / s[..., None] * 127.0), -127, 127
-        ).astype(jnp.int8)
-        cache.value = jax.lax.dynamic_update_slice(
-            cache.value, quantized[:, None], (0, index, 0, 0)
-        )
-        scale.value = jax.lax.dynamic_update_slice(
-            scale.value, (s / 127.0)[:, None], (0, index, 0)
-        )
-        return (
-            cache.value.astype(self.dtype)
-            * scale.value[..., None].astype(self.dtype)
+        return _store_kv(
+            self, name, new[:, None], self.max_len, self.dtype,
+            self.kv_quant_int8, index,
         )
 
     @nn.compact
@@ -269,22 +291,32 @@ class GPTDecodeStep(nn.Module):
 
 
 class _CachedBlock(nn.Module):
+    """One decoder block for either cache phase: index=None selects the
+    whole-prompt prefill attention, an index the one-token step — the
+    two attention classes share param paths ("attention"), so the flag
+    only switches dataflow."""
+
     config: GPTConfig
     cache_len: int = 0
     kv_quant_int8: bool = False
 
     @nn.compact
-    def __call__(self, x: jax.Array, index: jax.Array) -> jax.Array:
+    def __call__(
+        self, x: jax.Array, index: Optional[jax.Array] = None
+    ) -> jax.Array:
         from .bert import transformer_mlp
 
         cfg = self.config
-        y = nn.LayerNorm(dtype=jnp.float32, name="ln_attn")(x)
-        y = CachedSelfAttention(
+        kwargs = dict(
             num_heads=cfg.num_heads, head_dim=cfg.head_dim,
             max_len=self.cache_len or cfg.max_seq_len, dtype=cfg.dtype,
-            kv_quant_int8=self.kv_quant_int8,
-            name="attention",
-        )(y.astype(cfg.dtype), index)
+            kv_quant_int8=self.kv_quant_int8, name="attention",
+        )
+        y = nn.LayerNorm(dtype=jnp.float32, name="ln_attn")(x)
+        if index is None:
+            y = PrefillSelfAttention(**kwargs)(y.astype(cfg.dtype))
+        else:
+            y = CachedSelfAttention(**kwargs)(y.astype(cfg.dtype), index)
         x = x + y
         y = nn.LayerNorm(dtype=jnp.float32, name="ln_mlp")(x)
         return x + transformer_mlp(cfg, y)
@@ -313,11 +345,85 @@ def _filter_logits(logits: jax.Array, top_k: int, top_p: float) -> jax.Array:
     return logits
 
 
+class PrefillSelfAttention(nn.Module):
+    """Whole-prompt attention + cache write — the batched twin of
+    CachedSelfAttention (identical child param paths: query/key/value/
+    attn_out under the same module name), turning prompt ingestion from
+    p sequential one-token steps into ONE forward of MXU-shaped
+    matmuls. Writes positions [0, p) of the same cache variables the
+    decode scan then continues from."""
+
+    num_heads: int
+    head_dim: int
+    max_len: int
+    dtype: jnp.dtype = jnp.bfloat16
+    kv_quant_int8: bool = False
+
+    @nn.compact
+    def __call__(self, x: jax.Array) -> jax.Array:
+        batch, p = x.shape[:2]
+        dense = lambda name: head_projection(  # noqa: E731
+            self.num_heads, self.head_dim, self.dtype, name
+        )
+        query = dense("query")(x)  # [b, p, h, d]
+        key = dense("key")(x)
+        value = dense("value")(x)
+
+        causal = (
+            jnp.arange(p)[:, None] >= jnp.arange(p)[None, :]
+        )[None, None]
+        out = dot_product_attention(query, key, value, causal)
+
+        for name, new in (("k", key), ("v", value)):
+            _store_kv(
+                self, name, new, self.max_len, self.dtype,
+                self.kv_quant_int8, 0,
+            )
+        return nn.DenseGeneral(
+            features=x.shape[-1], axis=(-2, -1), dtype=self.dtype,
+            name="attn_out",
+        )(out)
+
+
+class GPTPrefill(nn.Module):
+    """Whole-prompt forward that fills the KV cache and returns the
+    LAST position's logits — param-path identical to GPTDecodeStep, so
+    one set of trained weights drives both phases."""
+
+    config: GPTConfig
+    cache_len: int = 0
+    kv_quant_int8: bool = False
+
+    @nn.compact
+    def __call__(self, tokens: jax.Array) -> jax.Array:  # [b, p]
+        cfg = self.config
+        p = tokens.shape[1]
+        x = nn.Embed(
+            cfg.vocab_size, cfg.hidden_size, dtype=cfg.dtype,
+            name="token_embed",
+        )(tokens)
+        x = x + nn.Embed(
+            cfg.max_seq_len, cfg.hidden_size, dtype=cfg.dtype,
+            name="position_embed",
+        )(jnp.arange(p)[None, :])
+        cache_len = self.cache_len or cfg.max_seq_len
+        for layer in range(cfg.num_layers):
+            x = _CachedBlock(
+                cfg, cache_len=cache_len,
+                kv_quant_int8=self.kv_quant_int8, name=f"layer_{layer}",
+            )(x, index=None)  # None = whole-prompt prefill phase
+        x = nn.LayerNorm(dtype=jnp.float32, name="ln_final")(x)
+        return nn.Dense(
+            cfg.vocab_size, dtype=cfg.dtype, name="lm_head"
+        )(x[:, -1].astype(cfg.dtype))
+
+
 @functools.lru_cache(maxsize=32)
 def _compiled_decode(cfg: GPTConfig, temperature: float, batch: int,
                      prompt_len: int, total: int,
                      kv_quant_int8: bool = False,
-                     top_k: int = 0, top_p: float = 1.0):
+                     top_k: int = 0, top_p: float = 1.0,
+                     ragged: bool = False):
     """One compiled decode scan per (config, temperature, shape) —
     generate() calls with the same shapes reuse it instead of paying a
     re-trace + XLA compile per call (the serving/eval loop pattern).
@@ -333,11 +439,20 @@ def _compiled_decode(cfg: GPTConfig, temperature: float, batch: int,
         )["cache"]
     )
 
-    @jax.jit
-    def run(params, prompt, rng, lens):
-        cache0 = jax.tree_util.tree_map(
-            lambda s: jnp.zeros(s.shape, s.dtype), cache_shapes
-        )
+    def sample(logits, sample_rng):
+        if temperature > 0.0:
+            # temperature FIRST, then the filters (the standard
+            # order): the top_p nucleus must be taken from the
+            # tempered distribution, or high temperatures collapse
+            # to near-greedy
+            filtered = _filter_logits(logits / temperature, top_k, top_p)
+            return jax.random.categorical(sample_rng, filtered, axis=-1)
+        return jnp.argmax(logits, axis=-1)
+
+    def scan_steps(params, cache, tok, rng, prompt, lens, indices):
+        """The per-token decode scan over `indices`; forcing only
+        matters on the ragged path (the uniform path enters with the
+        whole prompt already prefilled)."""
 
         def step(carry, index):
             cache, tok, rng = carry
@@ -346,19 +461,7 @@ def _compiled_decode(cfg: GPTConfig, temperature: float, batch: int,
                 mutable=["cache"],
             )
             rng, sample_rng = jax.random.split(rng)
-            if temperature > 0.0:
-                # temperature FIRST, then the filters (the standard
-                # order): the top_p nucleus must be taken from the
-                # tempered distribution, or high temperatures collapse
-                # to near-greedy
-                filtered = _filter_logits(
-                    logits / temperature, top_k, top_p
-                )
-                nxt = jax.random.categorical(
-                    sample_rng, filtered, axis=-1
-                )
-            else:
-                nxt = jnp.argmax(logits, axis=-1)
+            nxt = sample(logits, sample_rng)
             # while still inside ITS prompt, each row's "generated"
             # token is overridden by that row's actual next prompt
             # token — `lens` is per-row, so a ragged (right-padded)
@@ -369,11 +472,51 @@ def _compiled_decode(cfg: GPTConfig, temperature: float, batch: int,
             nxt = jnp.where(in_prompt, forced, nxt).astype(jnp.int32)
             return (updates["cache"], nxt, rng), nxt
 
-        first = prompt[:, 0].astype(jnp.int32)
-        (_, _, _), toks = jax.lax.scan(
-            step, (cache0, first, rng), jnp.arange(total - 1)
+        (_, _, _), toks = jax.lax.scan(step, (cache, tok, rng), indices)
+        return toks.T  # [b, len(indices)]
+
+    if ragged:
+        # per-row prompt boundaries: every position goes through the
+        # one-token step so forcing can switch per row
+        @jax.jit
+        def run(params, prompt, rng, lens):
+            cache0 = jax.tree_util.tree_map(
+                lambda s: jnp.zeros(s.shape, s.dtype), cache_shapes
+            )
+            first = prompt[:, 0].astype(jnp.int32)
+            return scan_steps(
+                params, cache0, first, rng, prompt, lens,
+                jnp.arange(total - 1),
+            )
+
+        return run
+
+    # uniform path: ingest the WHOLE prompt in one batched forward
+    # (MXU-shaped matmuls instead of prompt_len sequential steps — the
+    # prefill/decode split every serving stack uses), then scan only
+    # over the genuinely sequential new tokens
+    prefill_model = GPTPrefill(
+        cfg, cache_len=total, kv_quant_int8=kv_quant_int8
+    )
+
+    @jax.jit
+    def run(params, prompt, rng, lens):
+        logits, updates = prefill_model.apply(
+            {"params": params}, prompt, mutable=["cache"]
         )
-        return toks.T  # [b, total-1]
+        rng, sample_rng = jax.random.split(rng)
+        first_new = sample(logits, sample_rng).astype(jnp.int32)  # pos p
+        if total - 1 > prompt_len:
+            toks = scan_steps(
+                params, updates["cache"], first_new, rng, prompt, lens,
+                jnp.arange(prompt_len, total - 1),
+            )
+            generated = jnp.concatenate([first_new[:, None], toks], axis=1)
+        else:
+            generated = first_new[:, None]
+        # run() returns positions 1..total-1: the known prompt tail
+        # plus the generated tokens
+        return jnp.concatenate([prompt[:, 1:], generated], axis=1)
 
     return run
 
@@ -425,6 +568,10 @@ def generate(
     cumulative mask — no dynamic shapes inside the scan)."""
     batch, prompt_len = prompt.shape
     total = prompt_len + max_new_tokens
+    if max_new_tokens < 1:
+        raise ValueError(
+            f"max_new_tokens must be >= 1, got {max_new_tokens}"
+        )
     if total > cfg.max_seq_len:
         raise ValueError(
             f"prompt+new = {total} exceeds max_seq_len {cfg.max_seq_len}"
@@ -439,6 +586,7 @@ def generate(
         top_k = 0
     if rng is None:
         rng = jax.random.PRNGKey(0)
+    ragged = False
     if prompt_lens is None:
         lens = jnp.full((batch,), prompt_len, jnp.int32)
     else:
@@ -456,6 +604,10 @@ def generate(
                 f"prompt_lens must be in [1, {prompt_len}], got "
                 f"{lens_host.tolist()}"
             )
+        # path selection by VALUES, not argument presence: a uniform
+        # batch (every serving batch of one, for a start) must get the
+        # batched prefill even when the caller always passes lens
+        ragged = bool((lens_host != prompt_len).any())
     if mesh is not None:
         from jax.sharding import NamedSharding, PartitionSpec
 
@@ -491,6 +643,7 @@ def generate(
         cfg, float(temperature), batch, prompt_len, total,
         kv_quant_int8=kv_quant_int8,
         top_k=int(top_k), top_p=float(top_p),
+        ragged=ragged,
     )
     generated = run(params, prompt, rng, lens)
     return jnp.concatenate([prompt[:, :1], generated], axis=1)
