@@ -25,6 +25,56 @@ def cfg():
     return gpt_lib.GPT_TINY
 
 
+def make_zero_cache(dstep, batch: int):
+    """Fresh zeros for a GPTDecodeStep's cache collection (shared by
+    every teacher-forcing test — ONE copy of the eval_shape dance)."""
+    return jax.tree_util.tree_map(
+        lambda s: jnp.zeros(s.shape, s.dtype),
+        jax.eval_shape(
+            lambda: dstep.init(
+                jax.random.PRNGKey(0), jnp.zeros((batch,), jnp.int32),
+                jnp.int32(0),
+            )["cache"]
+        ),
+    )
+
+
+def teacher_force(dstep, params, seq):
+    """Feed `seq` token by token through the decode step; returns
+    (per-position logits [b, len-? ...], final cache). Logits at index
+    i are produced AFTER consuming seq[:, i]."""
+    cache = make_zero_cache(dstep, seq.shape[0])
+    logits_out = []
+    for i in range(seq.shape[1]):
+        logits, updates = dstep.apply(
+            {"params": params, "cache": cache}, seq[:, i], jnp.int32(i),
+            mutable=["cache"],
+        )
+        cache = updates["cache"]
+        logits_out.append(np.asarray(logits, dtype=np.float32))
+    return np.stack(logits_out, axis=1), cache
+
+
+def sequential_decode(
+    cfg, params, prompt, new: int, lens=None, kv_quant_int8=False
+):
+    """Greedy decode through the ALL-SCAN compile (ragged=True) — the
+    per-token path, regardless of uniformity. The cross-path parity
+    tests need it now that uniform batches select the prefill path."""
+    batch, p = prompt.shape
+    run = gpt_lib._compiled_decode(
+        cfg, 0.0, batch, p, p + new, kv_quant_int8=kv_quant_int8,
+        ragged=True,
+    )
+    if lens is None:
+        lens = jnp.full((batch,), p)
+    tail = run(
+        params, jnp.asarray(prompt), jax.random.PRNGKey(0),
+        jnp.asarray(lens),
+    )
+    return jnp.concatenate([prompt[:, :1], tail], axis=1)
+
+
 @pytest.fixture(scope="module")
 def trained(cfg):
     """A briefly-trained tiny GPT (shared across tests)."""
@@ -96,26 +146,12 @@ class TestDecode:
         train_logits = model.apply({"params": params}, seq)  # [2, 12, V]
 
         dstep = gpt_lib.GPTDecodeStep(cfg, cache_len=12)
-        cache = jax.eval_shape(
-            lambda: dstep.init(
-                jax.random.PRNGKey(0), jnp.zeros((2,), jnp.int32),
-                jnp.int32(0),
-            )["cache"]
+        step_logits, _ = teacher_force(dstep, params, seq)
+        np.testing.assert_allclose(
+            step_logits, np.asarray(train_logits, dtype=np.float32),
+            atol=1e-3, rtol=1e-3,
+            err_msg="decode/train logit mismatch",
         )
-        cache = jax.tree_util.tree_map(
-            lambda s: jnp.zeros(s.shape, s.dtype), cache
-        )
-        for i in range(12):
-            logits, updates = dstep.apply(
-                {"params": params, "cache": cache}, seq[:, i], jnp.int32(i),
-                mutable=["cache"],
-            )
-            cache = updates["cache"]
-            np.testing.assert_allclose(
-                np.asarray(logits), np.asarray(train_logits[:, i]),
-                atol=1e-3, rtol=1e-3,
-                err_msg=f"decode/train logit mismatch at position {i}",
-            )
 
     def test_generate_prefix_and_shapes(self, cfg, trained):
         _, state, _, _ = trained
@@ -236,36 +272,38 @@ class TestPrefillPath:
             np.asarray(bare), np.asarray(with_lens)
         )
 
-    def test_prefill_chain_matches_scan_chain(self, cfg, trained):
+    @pytest.mark.parametrize("quant", [False, True], ids=["bf16", "int8"])
+    def test_prefill_chain_matches_scan_chain(self, cfg, trained, quant):
         """Same params, same prompt: the prefill-path greedy chain vs
         the all-scan decode (driven through the ragged compile
-        directly — uniform lens now select prefill by design). bf16
-        batched-vs-sequential attention reassociates reductions, so
-        skip on argmax near-ties exactly like the sharded-decode
-        test."""
-        model, state, _, _ = trained
+        directly — uniform lens now select prefill by design). Under
+        int8 the prefill attends over the SAME quantized cache the
+        stepwise path reads, so parity holds there too. Batched-vs-
+        sequential attention reassociates reductions, so skip on
+        argmax near-ties measured on the path's OWN decision logits
+        (the teacher-forced decode step)."""
+        _, state, _, _ = trained
         params = jax.device_get(state.params)
         prompt = gpt_lib.synthetic_batch(
             jax.random.PRNGKey(15), 2, 8, cfg
         )["input_ids"]
         new = 6
-        prefill = gpt_lib.generate(cfg, params, prompt, max_new_tokens=new)
-        logits = model.apply({"params": params}, prefill[:, :-1])
-        consumed = logits[:, prompt.shape[1] - 1:]
-        top2 = jnp.sort(consumed.astype(jnp.float32), axis=-1)[..., -2:]
-        min_gap = float(jnp.min(top2[..., 1] - top2[..., 0]))
+        prefill = gpt_lib.generate(
+            cfg, params, prompt, max_new_tokens=new, kv_quant_int8=quant
+        )
+        dstep = gpt_lib.GPTDecodeStep(
+            cfg, cache_len=prompt.shape[1] + new, kv_quant_int8=quant
+        )
+        step_logits, _ = teacher_force(
+            dstep, params, jnp.asarray(np.asarray(prefill)[:, :-1])
+        )
+        consumed = step_logits[:, prompt.shape[1] - 1:]
+        top2 = np.sort(consumed, axis=-1)[..., -2:]
+        min_gap = float(np.min(top2[..., 1] - top2[..., 0]))
         if min_gap < 1e-3:
             pytest.skip(f"argmax near-tie (gap {min_gap:.2e})")
-        run = gpt_lib._compiled_decode(
-            cfg, 0.0, 2, prompt.shape[1], prompt.shape[1] + new,
-            ragged=True,
-        )
-        scanned_tail = run(
-            params, jnp.asarray(prompt), jax.random.PRNGKey(0),
-            jnp.full((2,), prompt.shape[1]),
-        )
-        scanned = jnp.concatenate(
-            [prompt[:, :1], scanned_tail], axis=1
+        scanned = sequential_decode(
+            cfg, params, prompt, new, kv_quant_int8=quant
         )
         np.testing.assert_array_equal(
             np.asarray(prefill), np.asarray(scanned)
@@ -290,21 +328,7 @@ class TestPrefillPath:
             dstep = gpt_lib.GPTDecodeStep(
                 cfg, cache_len=16, kv_quant_int8=quant
             )
-            cache = jax.tree_util.tree_map(
-                lambda s: jnp.zeros(s.shape, s.dtype),
-                jax.eval_shape(
-                    lambda: dstep.init(
-                        jax.random.PRNGKey(0), jnp.zeros((2,), jnp.int32),
-                        jnp.int32(0),
-                    )["cache"]
-                ),
-            )
-            for i in range(10):
-                _, upd = dstep.apply(
-                    {"params": params, "cache": cache}, seq[:, i],
-                    jnp.int32(i), mutable=["cache"],
-                )
-                cache = upd["cache"]
+            _, cache = teacher_force(dstep, params, seq)
             def dequantized_kv(tree):
                 """Compare what attention READS: bf16 caches directly;
                 int8 caches as code*scale (raw codes may differ by a
@@ -359,9 +383,12 @@ class TestRaggedDecode:
             prompt_lens=jnp.asarray(lens),
         )
         for row, length in enumerate(lens):
-            solo = gpt_lib.generate(
-                cfg, params, jnp.asarray(padded[row:row + 1, :length]),
-                max_new_tokens=new,
+            # solo through the SAME sequential compile the ragged call
+            # used — generate() would route a uniform solo through the
+            # prefill path, whose bf16 reassociation noise is a
+            # different test's concern (TestPrefillPath)
+            solo = sequential_decode(
+                cfg, params, jnp.asarray(padded[row:row + 1, :length]), new
             )
             np.testing.assert_array_equal(
                 np.asarray(ragged[row, :length + new]),
@@ -423,24 +450,7 @@ class TestInt8KvCache:
             dstep = gpt_lib.GPTDecodeStep(
                 cfg, cache_len=12, kv_quant_int8=kv_quant
             )
-            cache = jax.tree_util.tree_map(
-                lambda s: jnp.zeros(s.shape, s.dtype),
-                jax.eval_shape(
-                    lambda: dstep.init(
-                        jax.random.PRNGKey(0), jnp.zeros((2,), jnp.int32),
-                        jnp.int32(0),
-                    )["cache"]
-                ),
-            )
-            out = []
-            for i in range(12):
-                logits, updates = dstep.apply(
-                    {"params": params, "cache": cache}, seq[:, i],
-                    jnp.int32(i), mutable=["cache"],
-                )
-                cache = updates["cache"]
-                out.append(np.asarray(logits))
-            return np.stack(out, axis=1)
+            return teacher_force(dstep, params, seq)[0]
 
         ref = teacher_forced_logits(False)
         quant = teacher_forced_logits(True)
@@ -511,7 +521,7 @@ class TestShardedDecode:
         [b, len, heads] f32 scale variable; parity bar is agreement
         with the SINGLE-DEVICE int8 decode (quantization noise is
         identical — only the sharding differs)."""
-        model, state, _, _ = trained
+        _, state, _, _ = trained
         params = jax.device_get(state.params)
         prompt = gpt_lib.synthetic_batch(
             jax.random.PRNGKey(12), 4, 8, cfg
@@ -531,34 +541,20 @@ class TestShardedDecode:
         # chains may legitimately fork where tp reassociation crosses a
         # quantization boundary — but ONLY at genuinely close calls.
         # Teacher-force the plain chain through the int8 decode step
-        # and demand that each row's first divergence sits on a small
-        # top-2 logit gap; a fork at a decisive position = real bug.
+        # (prefill now attends over the SAME quantized representation,
+        # so this oracle matches the decision logits) and demand that
+        # each row's first divergence sits on a small top-2 gap; a fork
+        # at a decisive position = real bug.
         dstep = gpt_lib.GPTDecodeStep(
             cfg, cache_len=pa.shape[1], kv_quant_int8=True
         )
-        cache = jax.tree_util.tree_map(
-            lambda s: jnp.zeros(s.shape, s.dtype),
-            jax.eval_shape(
-                lambda: dstep.init(
-                    jax.random.PRNGKey(0), jnp.zeros((4,), jnp.int32),
-                    jnp.int32(0),
-                )["cache"]
-            ),
-        )
-        step_logits = []
-        for i in range(pa.shape[1] - 1):
-            logits, upd = dstep.apply(
-                {"params": params, "cache": cache},
-                jnp.asarray(pa[:, i]), jnp.int32(i), mutable=["cache"],
-            )
-            cache = upd["cache"]
-            step_logits.append(np.asarray(logits, dtype=np.float32))
+        step_logits, _ = teacher_force(dstep, params, jnp.asarray(pa))
         gaps = []
         for row in range(4):
             forks = np.nonzero(pa[row] != sa[row])[0]
             if not len(forks):
                 continue
-            logits_at_fork = step_logits[forks[0] - 1][row]
+            logits_at_fork = step_logits[row, forks[0] - 1]
             top2 = np.sort(logits_at_fork)[-2:]
             gaps.append(float(top2[1] - top2[0]))
         assert all(gap < 0.25 for gap in gaps), (

@@ -92,6 +92,36 @@ class TestDecodeServer:
         })
         assert filtered["tokens"] == greedy["tokens"]
 
+    def test_metrics_endpoint(self, server):
+        """Prometheus text exposition, consistent with the operator's
+        /metrics: decode/token/latency/error counters move."""
+        _, port = server
+        post(port, {"input_ids": [[1, 2]], "max_new_tokens": 3})
+        post_err(port, {"input_ids": []})
+
+        def scrape():
+            with urllib.request.urlopen(
+                f"http://127.0.0.1:{port}/metrics", timeout=30
+            ) as resp:
+                assert "text/plain" in resp.headers["Content-Type"]
+                return {
+                    line.split()[0]: float(line.split()[1])
+                    for line in resp.read().decode().splitlines()
+                    if line and not line.startswith("#")
+                }
+
+        metrics = scrape()
+        assert metrics["tf_operator_tpu_serve_decodes_total"] >= 1
+        assert metrics["tf_operator_tpu_serve_generated_tokens_total"] >= 3
+        assert metrics["tf_operator_tpu_serve_decode_seconds_total"] > 0
+        assert metrics["tf_operator_tpu_serve_request_errors_total"] >= 1
+        before = metrics["tf_operator_tpu_serve_generated_tokens_total"]
+        post(port, {"input_ids": [[3, 4], [5, 6]], "max_new_tokens": 2})
+        assert (
+            scrape()["tf_operator_tpu_serve_generated_tokens_total"]
+            == before + 4
+        )
+
     def test_healthz_counts_decodes(self, server):
         _, port = server
         with urllib.request.urlopen(

@@ -369,16 +369,22 @@ class PrefillSelfAttention(nn.Module):
         key = dense("key")(x)
         value = dense("value")(x)
 
+        # write FIRST, then attend over what was stored: under int8 the
+        # stepwise decode attends over the quantized cache, so prefill
+        # must see the same representation or the two phases' logits
+        # diverge at quantization scale (not ULP scale) — a row's
+        # tokens must not depend on which phase ingested its prompt
+        stored = {
+            name: _store_kv(
+                self, name, new, self.max_len, self.dtype,
+                self.kv_quant_int8, 0,
+            )[:, :p]
+            for name, new in (("k", key), ("v", value))
+        }
         causal = (
             jnp.arange(p)[:, None] >= jnp.arange(p)[None, :]
         )[None, None]
-        out = dot_product_attention(query, key, value, causal)
-
-        for name, new in (("k", key), ("v", value)):
-            _store_kv(
-                self, name, new, self.max_len, self.dtype,
-                self.kv_quant_int8, 0,
-            )
+        out = dot_product_attention(query, stored["k"], stored["v"], causal)
         return nn.DenseGeneral(
             features=x.shape[-1], axis=(-2, -1), dtype=self.dtype,
             name="attn_out",

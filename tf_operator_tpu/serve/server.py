@@ -64,6 +64,25 @@ class _State:
         self.max_new_cap = max_new_cap
         self.lock = threading.Lock()
         self.decodes = 0
+        self.tokens_generated = 0
+        self.decode_seconds = 0.0
+        self.request_errors = 0
+
+    def render_metrics(self) -> str:
+        """Prometheus text format — same no-dependency exposition the
+        operator's /metrics uses (server/metrics.py), so one scrape
+        config covers both planes."""
+        prefix = "tf_operator_tpu_serve"
+        rows = []
+        for name, kind, value in (
+            ("decodes_total", "counter", self.decodes),
+            ("generated_tokens_total", "counter", self.tokens_generated),
+            ("decode_seconds_total", "counter", self.decode_seconds),
+            ("request_errors_total", "counter", self.request_errors),
+        ):
+            rows.append(f"# TYPE {prefix}_{name} {kind}")
+            rows.append(f"{prefix}_{name} {value}")
+        return "\n".join(rows) + "\n"
 
 
 def _bad(payload) -> tuple:
@@ -155,6 +174,15 @@ def DecodeHandlerFactory(state: _State):
                     "kv_int8": state.kv_quant_int8,
                     "decodes": state.decodes,
                 })
+            elif self.path == "/metrics":
+                body = state.render_metrics().encode()
+                self.send_response(200)
+                self.send_header(
+                    "Content-Type", "text/plain; version=0.0.4"
+                )
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
             else:
                 self._reply(404, {"error": f"no route {self.path}"})
 
@@ -165,16 +193,23 @@ def DecodeHandlerFactory(state: _State):
                 length = int(self.headers.get("Content-Length") or 0)
                 body = json.loads(self.rfile.read(length) or b"{}")
             except (ValueError, json.JSONDecodeError) as err:
+                with state.lock:
+                    state.request_errors += 1
                 return self._reply(400, {"error": f"bad JSON: {err}"})
             result = _validate(state, body)
             if isinstance(result[0], int):  # (status, payload)
+                with state.lock:  # += races other request threads
+                    state.request_errors += 1
                 return self._reply(*result)
             prompt, lens, new, temperature, seed, top_k, top_p = result
+            import time
+
             import jax
             import jax.numpy as jnp
 
             rng = jax.random.PRNGKey(seed)
             with state.lock:  # decode saturates the chip; serialize
+                start = time.perf_counter()
                 out = gpt_lib.generate(
                     state.cfg, state.params, prompt, max_new_tokens=new,
                     temperature=temperature, rng=rng,
@@ -182,7 +217,10 @@ def DecodeHandlerFactory(state: _State):
                     prompt_lens=jnp.asarray(lens),
                     top_k=top_k, top_p=top_p,
                 )
+                jax.block_until_ready(out)
+                state.decode_seconds += time.perf_counter() - start
                 state.decodes += 1
+                state.tokens_generated += new * len(lens)
             chains = jax.device_get(out)
             # each row's answer is its own prompt plus max_new tokens
             # (the shared scan makes shorter rows generate further;
