@@ -586,10 +586,12 @@ def run_extras(on_tpu: bool, n_chips: int, line: dict) -> None:
         elapsed = _time_decode(gpt_lib, cfg, params, prompt, new)
         # generate() is a single-device jit (no mesh), so this is a
         # one-chip number regardless of host chip count — not divided
-        # by n_chips. The scan runs prompt_len-1 prefill steps plus
-        # `new` generation steps, each one token through the cached
-        # model, so the rate counts ALL sequential token steps (the
-        # metric would otherwise shift with prompt_len alone)
+        # by n_chips. The rate counts ALL token positions processed
+        # (prompt_len-1 prefill + `new` generated): the denominator is
+        # one batched prefill forward plus `new` sequential steps, so
+        # the same metric directly shows what the prefill path buys on
+        # prompt-heavy shapes (the metric would otherwise shift with
+        # prompt_len alone)
         line["gpt_decode_tokens_per_sec"] = round(
             batch * (prompt_len - 1 + new) / elapsed, 2
         )
