@@ -179,6 +179,23 @@ class TestDecodeServer:
         assert status == 400
         assert fragment in body["error"]
 
+    def test_decode_client_round_trip(self, server):
+        """The stdlib client against the live server: chains, health,
+        metrics, and typed errors."""
+        from tf_operator_tpu.serve import DecodeClient, DecodeError
+
+        cfg, port = server
+        client = DecodeClient(f"http://127.0.0.1:{port}")
+        chains = client.generate([[1, 2, 3], [7, 8]], max_new_tokens=4)
+        assert [len(c) for c in chains] == [7, 6]
+        assert chains[0][:3] == [1, 2, 3]
+        assert client.healthy()["status"] == "ok"
+        assert client.metrics()["tf_operator_tpu_serve_decodes_total"] >= 1
+        with pytest.raises(DecodeError) as err:
+            client.generate([], max_new_tokens=4)
+        assert err.value.status == 400
+        assert "non-empty" in str(err.value)
+
     def test_unknown_route_404(self, server):
         _, port = server
         try:

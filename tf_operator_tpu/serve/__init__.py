@@ -1,3 +1,10 @@
+from .client import DecodeClient, DecodeError
 from .server import DecodeHandlerFactory, main, make_server
 
-__all__ = ["make_server", "main", "DecodeHandlerFactory"]
+__all__ = [
+    "make_server",
+    "main",
+    "DecodeHandlerFactory",
+    "DecodeClient",
+    "DecodeError",
+]

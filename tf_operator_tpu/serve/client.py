@@ -1,0 +1,83 @@
+"""Client for the decode server — the serving-side TFJobClient.
+
+    from tf_operator_tpu.serve import DecodeClient
+
+    client = DecodeClient("http://gpt-serve-tpu-0.kubeflow.svc:8600")
+    chains = client.generate([[1, 2, 3], [7, 8]], max_new_tokens=16)
+    client.healthy()      # -> dict from /healthz
+    client.metrics()      # -> {"tf_operator_tpu_serve_decodes_total": ...}
+
+Stdlib-only (urllib), mirroring the SDK's zero-dependency posture;
+ragged prompt batches are the server's job to pad.
+"""
+
+from __future__ import annotations
+
+import json
+import urllib.error
+import urllib.request
+from typing import Dict, List, Optional
+
+
+class DecodeError(RuntimeError):
+    """A 4xx/5xx from the server, carrying its error message."""
+
+    def __init__(self, status: int, message: str) -> None:
+        super().__init__(f"{status}: {message}")
+        self.status = status
+
+
+class DecodeClient:
+    def __init__(self, base_url: str, timeout: float = 300.0) -> None:
+        self.base_url = base_url.rstrip("/")
+        self.timeout = timeout
+
+    def _request(self, path: str, payload: Optional[dict] = None):
+        data = json.dumps(payload).encode() if payload is not None else None
+        req = urllib.request.Request(
+            self.base_url + path,
+            data=data,
+            headers={"Content-Type": "application/json"},
+            method="POST" if data is not None else "GET",
+        )
+        try:
+            with urllib.request.urlopen(req, timeout=self.timeout) as resp:
+                return resp.read()
+        except urllib.error.HTTPError as err:
+            body = err.read().decode(errors="replace")
+            try:
+                message = json.loads(body).get("error", body)
+            except json.JSONDecodeError:
+                message = body
+            raise DecodeError(err.code, message) from None
+
+    def generate(
+        self,
+        input_ids: List[List[int]],
+        max_new_tokens: int = 16,
+        temperature: float = 0.0,
+        top_k: int = 0,
+        top_p: float = 1.0,
+        seed: int = 0,
+    ) -> List[List[int]]:
+        """Each row's full chain: its own prompt + max_new_tokens."""
+        body = json.loads(self._request("/generate", {
+            "input_ids": input_ids,
+            "max_new_tokens": max_new_tokens,
+            "temperature": temperature,
+            "top_k": top_k,
+            "top_p": top_p,
+            "seed": seed,
+        }))
+        return body["tokens"]
+
+    def healthy(self) -> dict:
+        return json.loads(self._request("/healthz"))
+
+    def metrics(self) -> Dict[str, float]:
+        out = {}
+        for line in self._request("/metrics").decode().splitlines():
+            if line and not line.startswith("#"):
+                name, value = line.split()
+                out[name] = float(value)
+        return out
