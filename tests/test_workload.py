@@ -6,6 +6,7 @@ shardings — loss must decrease and params must land sharded as ruled.
 """
 
 import dataclasses
+import json
 
 import jax
 import jax.numpy as jnp
@@ -801,6 +802,77 @@ class TestAsyncCheckpoint:
         orig = jax.tree_util.tree_leaves(state.params)[0]
         back = jax.tree_util.tree_leaves(restored.params)[0]
         np.testing.assert_allclose(np.asarray(orig), np.asarray(back))
+
+
+class TestEvalLoop:
+    """train/eval_loop.py — the Evaluator replica's workload: restore
+    every new checkpoint step, run held-out eval, append a JSON line
+    (the reference's continuous estimator eval, SURVEY §2.3)."""
+
+    def test_evaluates_newest_checkpoint_and_exits(self, tmp_path):
+        from tf_operator_tpu.train import eval_loop
+        from tf_operator_tpu.train import mnist as mnist_cli
+
+        ckpt = str(tmp_path / "ckpt")
+        rc = mnist_cli.main([
+            "--steps", "6", "--batch-size", "64",
+            "--checkpoint-dir", ckpt, "--log-every", "3",
+        ])
+        assert rc == 0
+        out = tmp_path / "eval.jsonl"
+        rc = eval_loop.main([
+            "--task", "mnist", "--checkpoint-dir", ckpt,
+            "--batch-size", "64", "--out", str(out),
+            "--until-step", "1", "--poll-seconds", "0.1",
+            "--max-polls", "5",
+        ])
+        assert rc == 0
+        rows = [json.loads(line) for line in out.read_text().splitlines()]
+        assert rows and rows[-1]["step"] == 6
+        assert 0.0 <= rows[-1]["accuracy"] <= 1.0
+        assert "perplexity" in rows[-1]
+
+    def test_reload_sees_steps_written_by_another_manager(self, tmp_path):
+        """The stale-manager trap: orbax caches the step list at
+        construction, so a watcher whose Checkpointer was built against
+        an EMPTY dir must reload_checkpoints() to see steps another
+        process wrote later — restore() alone would return None
+        forever. (Verified cross-process too: the eval_loop drive in
+        CI starts the evaluator before the writer.)"""
+        ckpt = str(tmp_path / "shared")
+        model = mnist_lib.MnistCNN()
+        rng = jax.random.PRNGKey(3)
+        sample = mnist_lib.synthetic_batch(rng, 16)
+        watcher = Trainer(
+            model, classification_task(model), optax.adam(1e-3),
+            checkpoint_dir=ckpt,
+        )
+        state_w = watcher.init(rng, sample)  # manager built on empty dir
+        assert watcher.reload_checkpoints() is None
+
+        writer = Trainer(
+            model, classification_task(model), optax.adam(1e-3),
+            checkpoint_dir=ckpt,
+        )
+        state = writer.init(rng, sample)
+        state, _ = writer.step(state, writer.place_batch(sample))
+        writer.save(state)
+
+        assert watcher.reload_checkpoints() == 1
+        restored = watcher.restore(state_w)
+        assert restored is not None and int(restored.step) == 1
+
+    def test_gives_up_on_empty_dir(self, tmp_path):
+        from tf_operator_tpu.train import eval_loop
+
+        empty = tmp_path / "none"
+        empty.mkdir()
+        rc = eval_loop.main([
+            "--task", "mnist", "--checkpoint-dir", str(empty),
+            "--batch-size", "64", "--poll-seconds", "0.05",
+            "--max-polls", "3",
+        ])
+        assert rc == 1
 
 
 class TestPreemptionGuard:

@@ -1,0 +1,158 @@
+"""Evaluator workload: watch a checkpoint dir, evaluate each new step.
+
+The reference's Evaluator replica runs TF Estimator's continuous eval
+against the chief's checkpoint directory (SURVEY.md §2.3
+Chief/Master + Evaluator; reference types.go:100-110 defines the role,
+status.go keeps it out of success accounting). This is the JAX side of
+that contract: point it at the training job's --checkpoint-dir (shared
+PVC) and it restores every new orbax step, runs the task's held-out
+eval, appends a JSON line per evaluation, and exits once --until-step
+has been evaluated (or runs forever by default, like the reference's
+evaluator).
+
+    python -m tf_operator_tpu.train.eval_loop --task mnist \
+        --checkpoint-dir /ckpt/mnist --out /ckpt/eval.jsonl
+
+Used as the Evaluator replica's command in
+examples/v1/chief-evaluator.yaml.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import logging
+import sys
+import time
+
+logger = logging.getLogger("tf_operator_tpu.train.eval_loop")
+
+
+def _build(task: str, batch_size: int, checkpoint_dir: str,
+           preset: str, seq_len: int):
+    """(trainer, make_batch, rng) for the named task — the same model/
+    task wiring the train CLIs use, so restored checkpoints fit.
+    preset/seq_len MUST match the training CLI's, or the restore
+    target's tree mismatches the chief's checkpoints."""
+    import jax
+    import optax
+
+    from ..train.trainer import Trainer
+
+    rng = jax.random.PRNGKey(0)
+    if task == "mnist":
+        from ..models import mnist as mnist_lib
+        from ..parallel.sharding import REPLICATED_RULES
+        from ..train.trainer import classification_task
+
+        model = mnist_lib.MnistCNN()
+        trainer = Trainer(
+            model, classification_task(model), optax.adam(1e-3),
+            rules=REPLICATED_RULES, checkpoint_dir=checkpoint_dir,
+        )
+        make_batch = lambda key: mnist_lib.synthetic_batch(  # noqa: E731
+            key, batch_size
+        )
+    elif task == "gpt":
+        from ..models import gpt as gpt_lib
+        from ..train.trainer import causal_lm_task
+
+        cfg = gpt_lib.GPT_TINY if preset == "tiny" else gpt_lib.GPT_SMALL
+        model = gpt_lib.GPT(cfg)
+        trainer = Trainer(
+            model, causal_lm_task(model), optax.adamw(1e-4),
+            checkpoint_dir=checkpoint_dir,
+        )
+        make_batch = lambda key: gpt_lib.synthetic_batch(  # noqa: E731
+            key, batch_size, seq_len, cfg
+        )
+    else:
+        raise ValueError(f"unknown task {task!r}")
+    return trainer, make_batch, rng
+
+
+def main(argv=None) -> int:
+    parser = argparse.ArgumentParser()
+    parser.add_argument("--task", choices=["mnist", "gpt"], default="mnist")
+    parser.add_argument("--checkpoint-dir", required=True)
+    parser.add_argument("--batch-size", type=int, default=512)
+    parser.add_argument(
+        "--preset", choices=["tiny", "small"], default="small",
+        help="gpt task: MUST match the training CLI's --preset",
+    )
+    parser.add_argument(
+        "--seq-len", type=int, default=2048,
+        help="gpt task: MUST match the training CLI's --seq-len",
+    )
+    parser.add_argument("--poll-seconds", type=float, default=10.0)
+    parser.add_argument(
+        "--out", default=None,
+        help="append one JSON line per evaluation (step, metrics)",
+    )
+    parser.add_argument(
+        "--until-step", type=int, default=None,
+        help="exit 0 once a checkpoint at/after this step is evaluated "
+        "(default: run forever, the reference evaluator's behavior)",
+    )
+    parser.add_argument(
+        "--max-polls", type=int, default=None,
+        help="give up (exit 1) after this many empty polls in a row",
+    )
+    args = parser.parse_args(argv)
+    logging.basicConfig(level=logging.INFO, stream=sys.stderr)
+
+    from ..train.trainer import held_out_eval
+
+    trainer, make_batch, rng = _build(
+        args.task, args.batch_size, args.checkpoint_dir,
+        args.preset, args.seq_len,
+    )
+    # the evaluator's own state skeleton — the restore target
+    sample = make_batch(rng)
+    state = trainer.init(rng, sample)
+
+    last_evaluated = -1
+    empty_polls = 0
+    while True:
+        # ONE manager (the Trainer's): reload() re-scans for steps the
+        # chief wrote, so latest_step and restore see the same view —
+        # a second CheckpointManager on the dir would reload while the
+        # trainer's stayed stale, restoring startup-time steps forever
+        step = trainer.reload_checkpoints()
+        failed_restore = False
+        if step is not None and step > last_evaluated:
+            restored = trainer.restore(state)
+            if restored is None:  # vanished between list and restore
+                failed_restore = True
+            else:
+                state = restored
+        if step is None or step <= last_evaluated or failed_restore:
+            # a persistently un-restorable step must trip the watchdog
+            # too, not just an empty directory
+            empty_polls += 1
+            if args.max_polls is not None and empty_polls >= args.max_polls:
+                logger.error(
+                    "no new evaluable checkpoint after %d polls (last "
+                    "evaluated step %d)", empty_polls, last_evaluated,
+                )
+                return 1
+            time.sleep(args.poll_seconds)
+            continue
+        empty_polls = 0
+        step = int(state.step)
+        metrics = held_out_eval(trainer, state, make_batch, rng)
+        logger.info("step %d eval: %s", step, metrics)
+        if args.out:
+            with open(args.out, "a") as handle:
+                handle.write(
+                    json.dumps({"step": step, **{
+                        k: round(float(v), 6) for k, v in metrics.items()
+                    }}) + "\n"
+                )
+        last_evaluated = step
+        if args.until_step is not None and step >= args.until_step:
+            return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

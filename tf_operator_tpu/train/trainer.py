@@ -656,6 +656,16 @@ class Trainer:
             raise ValueError("Trainer built without checkpoint_dir")
         return self._ckpt.restore_latest(state)
 
+    def reload_checkpoints(self):
+        """Cross-process refresh: re-scan for steps another process
+        wrote, returning the newest step (or None). Call before
+        restore() when watching a directory a different process writes
+        (train/eval_loop.py)."""
+        if self._ckpt is None:
+            raise ValueError("Trainer built without checkpoint_dir")
+        self._ckpt.reload()
+        return self._ckpt.latest_step()
+
 
 def _opt_state_shardings(opt_state, params, params_sh, replicated):
     """Optimizer moments inherit their params' shardings.
@@ -713,6 +723,16 @@ class Checkpointer:
     def wait(self) -> None:
         """Flush any in-flight async save."""
         self.manager.wait_until_finished()
+
+    def reload(self) -> None:
+        """Re-scan the directory for steps written by ANOTHER process —
+        orbax caches the step list, so a cross-process watcher (the
+        Evaluator replica) must reload before every restore_latest or
+        it only ever sees the steps that existed at startup."""
+        self.manager.reload()
+
+    def latest_step(self):
+        return self.manager.latest_step()
 
     def restore_latest(self, target: TrainState) -> Optional[TrainState]:
         self.manager.wait_until_finished()  # settle in-flight saves
