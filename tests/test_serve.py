@@ -204,3 +204,129 @@ class TestDecodeServer:
             assert err.code == 404
         else:
             raise AssertionError("expected 404")
+
+
+class TestDynamicBatching:
+    """serve/batching.py: concurrent greedy requests coalesce into one
+    shape-bucketed decode; padding rows/columns are invisible (the
+    ragged generate never reads them); sampled requests bypass."""
+
+    @pytest.fixture(scope="class")
+    def batched_server(self):
+        cfg = gpt_lib.GPT_TINY
+        rng = jax.random.PRNGKey(1)
+        params = gpt_lib.GPT(cfg).init(
+            rng, jnp.zeros((1, 8), jnp.int32)
+        )["params"]
+        from tf_operator_tpu.serve import make_server
+
+        srv = make_server(
+            cfg, params, model_name="gpt-batched", max_new_cap=64,
+            batch_window_ms=150.0,
+        )
+        thread = threading.Thread(target=srv.serve_forever, daemon=True)
+        thread.start()
+        try:
+            yield cfg, srv.server_address[1], srv.state
+        finally:
+            srv.state.batcher.stop()
+            srv.shutdown()
+
+    def test_concurrent_greedy_requests_coalesce(self, batched_server):
+        cfg, port, state = batched_server
+        # warm the (batch=4-bucket, width-bucket) compile so the
+        # concurrent burst below lands in one fast window
+        post(port, {"input_ids": [[9, 8], [7, 6], [5, 4]],
+                    "max_new_tokens": 5})
+        prompts = [[[1, 2, 3]], [[4, 5]], [[6]], [[7, 8, 9, 10]]]
+        results = [None] * len(prompts)
+        errors = []
+
+        def fire(i):
+            try:
+                _, body = post(port, {
+                    "input_ids": prompts[i], "max_new_tokens": 5,
+                })
+                results[i] = body
+            except Exception as err:  # noqa: BLE001
+                errors.append(err)
+
+        batches_before = state.decode_batches
+        threads = [
+            threading.Thread(target=fire, args=(i,))
+            for i in range(len(prompts))
+        ]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(timeout=300)
+        assert not errors, errors
+        for i, body in enumerate(results):
+            assert body is not None
+            assert body["prompt_lens"] == [len(prompts[i][0])]
+            chain = body["tokens"][0]
+            assert chain[: len(prompts[i][0])] == prompts[i][0]
+            assert len(chain) == len(prompts[i][0]) + 5
+        # the whole burst cost FEWER device decodes than requests —
+        # the coalescing claim itself
+        batches_used = state.decode_batches - batches_before
+        assert batches_used < len(prompts), batches_used
+
+    def test_batched_greedy_is_deterministic(self, batched_server):
+        _, port, _ = batched_server
+        _, a = post(port, {"input_ids": [[11, 12, 13]],
+                           "max_new_tokens": 6})
+        _, b = post(port, {"input_ids": [[11, 12, 13]],
+                           "max_new_tokens": 6})
+        assert a["tokens"] == b["tokens"]
+
+    def test_sampled_requests_bypass_the_batcher(self, batched_server):
+        _, port, state = batched_server
+        before = state.decode_batches
+        _, body = post(port, {
+            "input_ids": [[3, 4, 5]], "max_new_tokens": 4,
+            "temperature": 1.0, "seed": 5,
+        })
+        assert len(body["tokens"][0]) == 7
+        # the inline path counts its own decode as one batch
+        assert state.decode_batches == before + 1
+
+    def test_different_max_new_split_groups(self, batched_server):
+        """Incompatible requests in one window still BOTH complete
+        (the second group decodes in the next round)."""
+        _, port, _ = batched_server
+        results = {}
+
+        def fire(name, new):
+            _, body = post(port, {
+                "input_ids": [[21, 22]], "max_new_tokens": new,
+            })
+            results[name] = body
+
+        t1 = threading.Thread(target=fire, args=("a", 5))
+        t2 = threading.Thread(target=fire, args=("b", 7))
+        t1.start(); t2.start()
+        t1.join(timeout=300); t2.join(timeout=300)
+        assert len(results["a"]["tokens"][0]) == 7
+        assert len(results["b"]["tokens"][0]) == 9
+
+    def test_decode_failure_fans_out_as_json_500(self, batched_server):
+        """A device/compile failure inside a coalesced decode must
+        reach every client as a JSON 500, never a dropped connection;
+        the batcher thread survives to serve the next request."""
+        _, port, state = batched_server
+        original = state.batcher.decode_fn
+        state.batcher.decode_fn = lambda *a: (_ for _ in ()).throw(
+            RuntimeError("injected device failure")
+        )
+        try:
+            status, body = post_err(port, {
+                "input_ids": [[31, 32]], "max_new_tokens": 3,
+            })
+            assert status == 500
+            assert "injected device failure" in body["error"]
+        finally:
+            state.batcher.decode_fn = original
+        # batcher still alive and serving
+        _, ok = post(port, {"input_ids": [[31, 32]], "max_new_tokens": 3})
+        assert len(ok["tokens"][0]) == 5

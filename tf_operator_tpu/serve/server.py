@@ -27,8 +27,10 @@ TPU-first behavior worth naming:
   distinct shapes pay one compile each, so production callers should
   bucket their prompt lengths;
 - requests serialize through a lock: decode saturates the chip, so
-  concurrency buys queueing, not throughput (batching belongs in the
-  request: send [b, p] prompts);
+  raw concurrency buys queueing, not throughput. --batch-window-ms
+  enables dynamic batching instead: concurrent GREEDY requests
+  coalesce into one shape-bucketed decode (serve/batching.py) —
+  per-batch decode cost is nearly flat, so coalesced rows ride free;
 - --kv-int8 serves with the int8 KV cache (half the per-step cache
   bandwidth — the decode bottleneck at long contexts).
 
@@ -63,7 +65,9 @@ class _State:
         self.model_name = model_name
         self.max_new_cap = max_new_cap
         self.lock = threading.Lock()
+        self.batcher = None  # set by make_server when batching is on
         self.decodes = 0
+        self.decode_batches = 0
         self.tokens_generated = 0
         self.decode_seconds = 0.0
         self.request_errors = 0
@@ -76,6 +80,7 @@ class _State:
         rows = []
         for name, kind, value in (
             ("decodes_total", "counter", self.decodes),
+            ("decode_batches_total", "counter", self.decode_batches),
             ("generated_tokens_total", "counter", self.tokens_generated),
             ("decode_seconds_total", "counter", self.decode_seconds),
             ("request_errors_total", "counter", self.request_errors),
@@ -152,8 +157,37 @@ def _validate(state: _State, body):
     return prompt, lens, new, float(temperature), seed, top_k, float(top_p)
 
 
-def DecodeHandlerFactory(state: _State):
+def _device_decode(
+    state: _State, prompt, lens, new, temperature=0.0, rng=None,
+    top_k=0, top_p=1.0,
+):
+    """THE decode-and-account block, shared by the inline path and the
+    batcher's decode_fn so locking/timing/metrics can't diverge.
+    Returns host chains [b, width + new]."""
+    import time
+
+    import jax
+    import jax.numpy as jnp
+
     from ..models import gpt as gpt_lib
+
+    with state.lock:  # decode saturates the chip; serialize
+        start = time.perf_counter()
+        out = gpt_lib.generate(
+            state.cfg, state.params, jnp.asarray(prompt),
+            max_new_tokens=new, temperature=temperature,
+            rng=rng if rng is not None else jax.random.PRNGKey(0),
+            kv_quant_int8=state.kv_quant_int8,
+            prompt_lens=jnp.asarray(lens),
+            top_k=top_k, top_p=top_p,
+        )
+        jax.block_until_ready(out)
+        state.decode_seconds += time.perf_counter() - start
+        state.decode_batches += 1
+    return jax.device_get(out)
+
+
+def DecodeHandlerFactory(state: _State):
 
     class Handler(BaseHTTPRequestHandler):
         protocol_version = "HTTP/1.1"
@@ -202,26 +236,51 @@ def DecodeHandlerFactory(state: _State):
                     state.request_errors += 1
                 return self._reply(*result)
             prompt, lens, new, temperature, seed, top_k, top_p = result
-            import time
-
             import jax
-            import jax.numpy as jnp
 
-            rng = jax.random.PRNGKey(seed)
-            with state.lock:  # decode saturates the chip; serialize
-                start = time.perf_counter()
-                out = gpt_lib.generate(
-                    state.cfg, state.params, prompt, max_new_tokens=new,
-                    temperature=temperature, rng=rng,
-                    kv_quant_int8=state.kv_quant_int8,
-                    prompt_lens=jnp.asarray(lens),
-                    top_k=top_k, top_p=top_p,
+            greedy = temperature == 0.0 and top_k == 0 and top_p == 1.0
+            if state.batcher is not None and greedy:
+                # dynamic batching: greedy requests coalesce into one
+                # scan (serve/batching.py); sampled requests keep the
+                # inline path so their rng streams stay per-request
+                try:
+                    tokens = state.batcher.submit(prompt, lens, new)
+                except TimeoutError as err:
+                    with state.lock:
+                        state.request_errors += 1
+                    return self._reply(503, {"error": str(err)})
+                except Exception as err:  # noqa: BLE001 — a device/
+                    # compile failure fans out to every coalesced
+                    # client as JSON, never a dropped connection
+                    with state.lock:
+                        state.request_errors += 1
+                    return self._reply(500, {
+                        "error": f"decode failed: "
+                        f"{type(err).__name__}: {err}"[:300]
+                    })
+                with state.lock:
+                    state.decodes += 1
+                    state.tokens_generated += new * len(lens)
+                return self._reply(200, {
+                    "tokens": tokens,
+                    "prompt_lens": lens,
+                })
+
+            try:
+                chains = _device_decode(
+                    state, prompt, lens, new, temperature=temperature,
+                    rng=jax.random.PRNGKey(seed), top_k=top_k, top_p=top_p,
                 )
-                jax.block_until_ready(out)
-                state.decode_seconds += time.perf_counter() - start
+            except Exception as err:  # noqa: BLE001 — same contract
+                with state.lock:
+                    state.request_errors += 1
+                return self._reply(500, {
+                    "error": f"decode failed: "
+                    f"{type(err).__name__}: {err}"[:300]
+                })
+            with state.lock:
                 state.decodes += 1
                 state.tokens_generated += new * len(lens)
-            chains = jax.device_get(out)
             # each row's answer is its own prompt plus max_new tokens
             # (the shared scan makes shorter rows generate further;
             # that overrun is private to the server)
@@ -248,12 +307,27 @@ def make_server(
     model_name: str = "gpt",
     max_new_cap: int = 1024,
     host: str = "127.0.0.1",
+    batch_window_ms: float = 0.0,
 ) -> ThreadingHTTPServer:
     """In-process server (tests and embedders); caller owns
     serve_forever/shutdown. The CLI binds 0.0.0.0 (pods must be
-    reachable on the pod IP); the in-process default stays loopback."""
+    reachable on the pod IP); the in-process default stays loopback.
+    batch_window_ms > 0 enables dynamic batching of greedy requests
+    (serve/batching.py)."""
     state = _State(cfg, params, kv_quant_int8, model_name, max_new_cap)
-    return ThreadingHTTPServer((host, port), DecodeHandlerFactory(state))
+    if batch_window_ms > 0:
+        from .batching import DynamicBatcher
+
+        def decode_fn(prompt, lens, new):
+            return _device_decode(state, prompt, lens, new)
+
+        state.batcher = DynamicBatcher(
+            state, decode_fn, window_ms=batch_window_ms,
+            max_batch=MAX_BATCH, max_seq_len=cfg.max_seq_len,
+        )
+    server = ThreadingHTTPServer((host, port), DecodeHandlerFactory(state))
+    server.state = state  # tests reach the batcher for shutdown
+    return server
 
 
 def main(argv=None) -> int:
@@ -272,6 +346,11 @@ def main(argv=None) -> int:
     parser.add_argument(
         "--max-new-cap", type=int, default=1024,
         help="upper bound a single request may ask for",
+    )
+    parser.add_argument(
+        "--batch-window-ms", type=float, default=0.0,
+        help="dynamic batching: hold a greedy request this long to "
+        "coalesce concurrent peers into one decode (0 = off)",
     )
     args = parser.parse_args(argv)
     logging.basicConfig(level=logging.INFO, stream=sys.stderr)
@@ -314,7 +393,7 @@ def main(argv=None) -> int:
     server = make_server(
         cfg, params, port=args.port, kv_quant_int8=args.kv_int8,
         model_name=f"gpt-{args.preset}", max_new_cap=args.max_new_cap,
-        host=args.host,
+        host=args.host, batch_window_ms=args.batch_window_ms,
     )
     logger.info("decode server on :%d", server.server_address[1])
     try:
