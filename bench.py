@@ -696,6 +696,22 @@ def run_extras(on_tpu: bool, n_chips: int, line: dict) -> None:
         )
         line["resnet_bs512_mfu"] = r["mfu"]
 
+    def bs128():
+        # the occupancy curve's other side: r4 measured bs512 WORSE
+        # than 256 (0.2839 vs 0.3067), and the r1 harness got its best
+        # img/s at per-chip batch 128 under a worse dispatch regime —
+        # if 128 wins, smaller activations (less HBM pressure per conv
+        # fusion) beat raw MXU occupancy at ResNet's shapes and the
+        # canonical config should move
+        r = bench_resnet(
+            on_tpu, n_chips, steps=20 if on_tpu else None,
+            batch_override=128 if on_tpu else 8,
+        )
+        line["resnet_bs128_mfu"] = r["mfu"]
+        line["resnet_bs128_images_per_sec_per_chip"] = r[
+            "images_per_sec_per_chip"
+        ]
+
     def flash():
         from benchmarks.flash_vs_xla import run as flash_run
 
@@ -759,6 +775,7 @@ def run_extras(on_tpu: bool, n_chips: int, line: dict) -> None:
     if gated:  # stem A/B only meaningful at the real 224/3-channel shape
         extra("resnet_s2d", s2d)
         extra("resnet_bs512", bs512)
+        extra("resnet_bs128", bs128)
     extra("fed", fed)
     if gated:
         # LAST: this A/B is expected to OOM at seq 4096 (that is the

@@ -152,6 +152,17 @@ def run(verbose: bool = True, quick: bool = False, write: bool = True) -> list:
         os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
         "FLASH_BENCH.json",
     )
+    if quick and os.path.exists(out):
+        try:
+            existing_full = json.load(open(out)).get("sweep") == "full"
+        except Exception:  # noqa: BLE001 — unreadable file: overwrite
+            existing_full = False
+        if existing_full:
+            # the quick in-bench subset must never replace a committed
+            # full sweep (it did once this round, costing a
+            # hand-reconstruction — see FLASH_BENCH.json provenance)
+            log("kept existing full-sweep", out)
+            return rows
     with open(out, "w") as handle:
         json.dump(
             {
@@ -161,6 +172,11 @@ def run(verbose: bool = True, quick: bool = False, write: bool = True) -> list:
                 "chip": getattr(
                     jax.devices()[0], "device_kind", jax.devices()[0].platform
                 ),
+                "methodology": "per-step time = (wall of one fused "
+                "2L-step chained lax.scan dispatch minus wall of an "
+                "L-step one) / L, value-transfer synced, input values "
+                "unique per dispatch; see time_grad docstring for why "
+                "per-dispatch timing is invalid through the TPU tunnel",
                 "provenance": "written by benchmarks/flash_vs_xla.py "
                 "(standalone or via bench.py extras on the driver's TPU)",
             },
