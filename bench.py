@@ -149,7 +149,7 @@ def setup_resnet(
 def bench_resnet(
     on_tpu: bool, n_chips: int, norm_impl: str = "tpu",
     steps: int | None = None, fed: bool = False, stem: str = "conv7",
-    batch_override: int | None = None,
+    batch_override: int | None = None, fed_uint8: bool = False,
 ) -> dict:
     """norm_impl: "tpu" (TpuBatchNorm, the default) or "flax"
     (nn.BatchNorm) — benched both ways so the r3 BN rework's effect is
@@ -170,6 +170,7 @@ def bench_resnet(
         state, elapsed = time_fed_steps(
             trainer, state, rng, global_batch, meta["image_size"],
             meta["classes"], steps, meta["resnet_lib"],
+            uint8=fed_uint8,
         )
     else:
         state, elapsed = time_fused_steps(trainer, state, batch, steps)
@@ -187,19 +188,31 @@ def bench_resnet(
 
 
 def time_fed_steps(
-    trainer, state, rng, global_batch, image_size, classes, steps, resnet_lib
+    trainer, state, rng, global_batch, image_size, classes, steps,
+    resnet_lib, uint8: bool = False,
 ) -> tuple:
     """Per-step dispatch with a host feed through the framework's
     InputPipeline (train/input_pipeline.py): background host batch
     prep + double-buffered device placement. Includes host->device
     bytes in the measured time, which the resident-batch number
-    deliberately excludes."""
+    deliberately excludes.
+
+    uint8=True feeds the uint8 wire format (4x fewer bytes than f32;
+    normalization fused on device by the model) — the A/B that shows
+    what the wire format costs on a transfer-bound feed."""
     import numpy as np
 
     from tf_operator_tpu.train import InputPipeline
 
     host_batches = []
     for i in range(4):  # distinct batches so no transfer is a no-op
+        if uint8:
+            host_batches.append(
+                resnet_lib.synthetic_uint8_batch(
+                    i, global_batch, image_size, classes
+                )
+            )
+            continue
         b = resnet_lib.synthetic_batch(
             jax.random.fold_in(rng, i), global_batch, image_size, classes
         )
@@ -527,6 +540,19 @@ def run_extras(on_tpu: bool, n_chips: int, line: dict) -> None:
         )
         line["fed_images_per_sec_per_chip"] = r["images_per_sec_per_chip"]
 
+    def fed_u8():
+        # r4 measured the f32 feed at 31 img/s/chip: transfer-bound
+        # (154MB/batch through the tunnel; PCIe on a real host). uint8
+        # wire + on-device normalize is the standard image input path
+        # — this A/B measures what the 4x byte cut buys end-to-end
+        r = bench_resnet(
+            on_tpu, n_chips, steps=15 if on_tpu else None, fed=True,
+            fed_uint8=True,
+        )
+        line["fed_u8_images_per_sec_per_chip"] = r[
+            "images_per_sec_per_chip"
+        ]
+
     def bert_wide():
         # BERT_BASE_WIDE shape class (6 heads x 128 = same hidden/param
         # count as base): head_dim 128 is MXU-native, so the flash
@@ -777,6 +803,7 @@ def run_extras(on_tpu: bool, n_chips: int, line: dict) -> None:
         extra("resnet_bs512", bs512)
         extra("resnet_bs128", bs128)
     extra("fed", fed)
+    extra("fed_u8", fed_u8)
     if gated:
         # LAST: this A/B is expected to OOM at seq 4096 (that is the
         # measurement) — a hard abort or fragmented HBM must not cost

@@ -441,6 +441,31 @@ class TestTraining:
         )
         np.testing.assert_allclose(got, ref, atol=1e-5, rtol=1e-5)
 
+    def test_uint8_input_matches_normalized_f32(self):
+        """uint8 is the image wire format (4x fewer host->HBM bytes);
+        the model normalizes on device. A uint8 batch must produce
+        exactly the logits of the equivalently pre-normalized f32
+        batch — the wire format is a transfer optimization, never a
+        numerics change."""
+        model = resnet_lib.ResNet(
+            stage_sizes=(1, 1), num_classes=10, width=8,
+            dtype=jnp.float32,
+        )
+        u8 = resnet_lib.synthetic_uint8_batch(0, 2, 32, 10)["image"]
+        # same expression the model uses, so the two paths' inputs are
+        # bitwise identical (v/127.5 differs from v*(1/127.5) by an ulp)
+        f32 = (u8.astype(np.float32) - 127.5) * (1.0 / 127.5)
+        variables = model.init(jax.random.PRNGKey(0), jnp.asarray(f32))
+        logits_f32 = model.apply(
+            variables, jnp.asarray(f32), train=False
+        )
+        logits_u8 = model.apply(
+            variables, jnp.asarray(u8), train=False
+        )
+        np.testing.assert_allclose(
+            logits_u8, logits_f32, rtol=1e-6, atol=1e-6
+        )
+
     def test_s2d_resnet_trains(self, devices8):
         model = resnet_lib.ResNet(
             stage_sizes=(1, 1), num_classes=10, width=8,
