@@ -575,10 +575,18 @@ def run_extras(on_tpu: bool, n_chips: int, line: dict) -> None:
         ]
         line["gpt_seq4096_mfu"] = r["mfu"]
 
-    def _decode_setup():
+    def _decode_setup(long: bool = False):
         from tf_operator_tpu.models import gpt as gpt_lib
 
-        if on_tpu:
+        if on_tpu and long:
+            # cache >> params: generate() sizes the KV cache to
+            # prompt_len + max_new_tokens, so the pair must SUM to 4096
+            # — at batch 4 that is ~600MB of bf16 KV against 248MB of
+            # weights, the regime where the int8 cache's byte cut
+            # dominates the step's HBM traffic
+            cfg = gpt_lib.GPTConfig(max_seq_len=4096)
+            batch, prompt_len, new = 4, 256, 3840
+        elif on_tpu:
             cfg = gpt_lib.GPTConfig(max_seq_len=1024)  # GPT-small
             batch, prompt_len, new = 8, 128, 512
         else:  # smoke: same code path, CPU-feasible shapes
@@ -642,6 +650,30 @@ def run_extras(on_tpu: bool, n_chips: int, line: dict) -> None:
             gpt_lib, cfg, params, prompt, new, kv_quant_int8=True
         )
         line["gpt_decode_int8_tokens_per_sec"] = round(
+            batch * (prompt_len - 1 + new) / elapsed, 2
+        )
+
+    def gpt_decode_long():
+        # bf16-cache control for the long-context serving A/B (see
+        # _decode_setup(long=True)); cache length is the tokens/sec
+        # driver here, so this pair is where the factored int8 path
+        # (models/gpt.py _cache_attention) must show its win
+        gpt_lib, cfg, params, prompt, batch, prompt_len, new = (
+            _decode_setup(long=True)
+        )
+        elapsed = _time_decode(gpt_lib, cfg, params, prompt, new)
+        line["gpt_decode_seq4096_tokens_per_sec"] = round(
+            batch * (prompt_len - 1 + new) / elapsed, 2
+        )
+
+    def gpt_decode_long_int8():
+        gpt_lib, cfg, params, prompt, batch, prompt_len, new = (
+            _decode_setup(long=True)
+        )
+        elapsed = _time_decode(
+            gpt_lib, cfg, params, prompt, new, kv_quant_int8=True
+        )
+        line["gpt_decode_seq4096_int8_tokens_per_sec"] = round(
             batch * (prompt_len - 1 + new) / elapsed, 2
         )
 
@@ -793,6 +825,8 @@ def run_extras(on_tpu: bool, n_chips: int, line: dict) -> None:
         extra("gpt_long", gpt_long)
         extra("gpt_decode", gpt_decode)
         extra("gpt_decode_int8", gpt_decode_int8)
+        extra("gpt_decode_long", gpt_decode_long)
+        extra("gpt_decode_long_int8", gpt_decode_long_int8)
         extra("gpt_decode_tp", gpt_decode_tp)
         extra("gpt_remat", gpt_remat)
         extra("bert_wide", bert_wide)
