@@ -600,9 +600,10 @@ def run_extras(on_tpu: bool, n_chips: int, line: dict) -> None:
                                     cfg.vocab_size)
         return gpt_lib, cfg, params, prompt, batch, prompt_len, new
 
-    def _time_decode(gpt_lib, cfg, params, prompt, new, **kw) -> float:
-        out = gpt_lib.generate(cfg, params, prompt, max_new_tokens=new,
-                               **kw)
+    def _time_decode(gpt_lib, cfg, params, prompt, new, fn=None,
+                     **kw) -> float:
+        call = fn if fn is not None else gpt_lib.generate
+        out = call(cfg, params, prompt, max_new_tokens=new, **kw)
         int(out.sum())  # compile + warm; value transfer = real barrier
         # measured call gets a DIFFERENT prompt: through the remote
         # tunnel, a repeat of a byte-identical dispatch can be served
@@ -613,8 +614,7 @@ def run_extras(on_tpu: bool, n_chips: int, line: dict) -> None:
         prompt2 = (prompt + 1) % cfg.vocab_size
         int(prompt2.sum())  # materialize outside the timed window
         start = time.perf_counter()
-        out = gpt_lib.generate(cfg, params, prompt2, max_new_tokens=new,
-                               **kw)
+        out = call(cfg, params, prompt2, max_new_tokens=new, **kw)
         int(out.sum())
         return time.perf_counter() - start
 
@@ -674,6 +674,23 @@ def run_extras(on_tpu: bool, n_chips: int, line: dict) -> None:
             gpt_lib, cfg, params, prompt, new, kv_quant_int8=True
         )
         line["gpt_decode_seq4096_int8_tokens_per_sec"] = round(
+            batch * (prompt_len - 1 + new) / elapsed, 2
+        )
+
+    def gpt_decode_spec():
+        # prompt-lookup speculative decoding (models/gpt.py
+        # generate_speculative; greedy-exact) at gpt_decode's shape —
+        # tokens/sec depends on how n-gram-repetitive the model's own
+        # continuation is, so this measures the bench model's real
+        # acceptance rate, favorable or not
+        gpt_lib, cfg, params, prompt, batch, prompt_len, new = (
+            _decode_setup()
+        )
+        elapsed = _time_decode(
+            gpt_lib, cfg, params, prompt, new,
+            fn=gpt_lib.generate_speculative,
+        )
+        line["gpt_decode_spec_tokens_per_sec"] = round(
             batch * (prompt_len - 1 + new) / elapsed, 2
         )
 
@@ -827,6 +844,7 @@ def run_extras(on_tpu: bool, n_chips: int, line: dict) -> None:
         extra("gpt_decode_int8", gpt_decode_int8)
         extra("gpt_decode_long", gpt_decode_long)
         extra("gpt_decode_long_int8", gpt_decode_long_int8)
+        extra("gpt_decode_spec", gpt_decode_spec)
         extra("gpt_decode_tp", gpt_decode_tp)
         extra("gpt_remat", gpt_remat)
         extra("bert_wide", bert_wide)

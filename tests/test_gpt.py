@@ -561,3 +561,85 @@ class TestShardedDecode:
             f"sharded int8 decode forked at decisive positions "
             f"(top-2 gaps {gaps})"
         )
+
+
+class TestSpeculative:
+    """generate_speculative must be OUTPUT-EXACT w.r.t. greedy decode:
+    acceptance compares drafts against the verify forward's own
+    argmax, so every committed token is the model's greedy choice.
+    (models/gpt.py generate_speculative; net-new serving capability —
+    the reference has no data plane.)"""
+
+    def _setup(self, kv_quant_int8=False, batch=2, prompt_len=12,
+               new=20, seed=0):
+        # f32: the guarantee is "greedy-exact up to floating-point
+        # program equivalence" — the k+1-wide verify and the one-token
+        # scan are different XLA programs, so bf16 near-tie logits
+        # could legitimately flip an argmax between them; f32 makes a
+        # tie with random continuous params measure-zero and the
+        # equality assertion deterministic
+        cfg = dataclasses.replace(gpt_lib.GPT_TINY, dtype=jnp.float32)
+        rng = jax.random.PRNGKey(seed)
+        params = gpt_lib.GPT(cfg).init(
+            rng, jnp.zeros((1, 8), jnp.int32)
+        )["params"]
+        # a prompt with internal repetition so the n-gram drafter has
+        # matches to propose (exactness must hold either way)
+        base = jax.random.randint(
+            jax.random.PRNGKey(seed + 1), (batch, 4), 0, cfg.vocab_size
+        )
+        prompt = jnp.tile(base, (1, prompt_len // 4))[:, :prompt_len]
+        greedy = gpt_lib.generate(
+            cfg, params, prompt, max_new_tokens=new,
+            kv_quant_int8=kv_quant_int8,
+        )
+        spec = gpt_lib.generate_speculative(
+            cfg, params, prompt, max_new_tokens=new,
+            kv_quant_int8=kv_quant_int8,
+        )
+        return np.asarray(greedy), np.asarray(spec)
+
+    def test_exact_vs_greedy(self):
+        greedy, spec = self._setup()
+        assert spec.shape == greedy.shape
+        np.testing.assert_array_equal(spec, greedy)
+
+    def test_exact_vs_greedy_int8_cache(self):
+        greedy, spec = self._setup(kv_quant_int8=True)
+        np.testing.assert_array_equal(spec, greedy)
+
+    def test_exact_on_random_prompt(self):
+        # no engineered repetition: drafts mostly rejected, the loop
+        # degenerates toward one-token rounds and must still be exact
+        # (f32 for the same tie-determinism reason as _setup)
+        cfg = dataclasses.replace(gpt_lib.GPT_TINY, dtype=jnp.float32)
+        params = gpt_lib.GPT(cfg).init(
+            jax.random.PRNGKey(3), jnp.zeros((1, 8), jnp.int32)
+        )["params"]
+        prompt = jax.random.randint(
+            jax.random.PRNGKey(4), (3, 9), 0, cfg.vocab_size
+        )
+        greedy = gpt_lib.generate(cfg, params, prompt, max_new_tokens=13)
+        spec = gpt_lib.generate_speculative(
+            cfg, params, prompt, max_new_tokens=13, draft_k=3, ngram=3
+        )
+        np.testing.assert_array_equal(np.asarray(spec), np.asarray(greedy))
+
+    def test_validation(self):
+        cfg = gpt_lib.GPT_TINY
+        params = gpt_lib.GPT(cfg).init(
+            jax.random.PRNGKey(0), jnp.zeros((1, 8), jnp.int32)
+        )["params"]
+        prompt = jnp.zeros((1, 4), jnp.int32)
+        with pytest.raises(ValueError, match="max_new_tokens"):
+            gpt_lib.generate_speculative(cfg, params, prompt, 0)
+        with pytest.raises(ValueError, match="draft_k"):
+            gpt_lib.generate_speculative(cfg, params, prompt, 4, draft_k=0)
+        with pytest.raises(ValueError, match="ngram"):
+            gpt_lib.generate_speculative(
+                cfg, params, prompt, 4, ngram=5
+            )
+        with pytest.raises(ValueError, match="max_seq_len"):
+            gpt_lib.generate_speculative(
+                cfg, params, prompt, cfg.max_seq_len
+            )

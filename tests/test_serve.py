@@ -330,3 +330,79 @@ class TestDynamicBatching:
         # batcher still alive and serving
         _, ok = post(port, {"input_ids": [[31, 32]], "max_new_tokens": 3})
         assert len(ok["tokens"][0]) == 5
+
+
+class TestSpeculativeServing:
+    """--speculative routes greedy uniform-length requests through
+    prompt-lookup speculative decoding (models/gpt.py
+    generate_speculative) — output must be IDENTICAL to the plain
+    greedy path; sampled and ragged requests fall back."""
+
+    @pytest.fixture(scope="class")
+    def spec_server(self):
+        import dataclasses
+
+        # f32 for tie-determinism between the verify-block and
+        # one-token programs (see tests/test_gpt.py TestSpeculative)
+        cfg = dataclasses.replace(gpt_lib.GPT_TINY, dtype=jnp.float32)
+        rng = jax.random.PRNGKey(0)
+        params = gpt_lib.GPT(cfg).init(
+            rng, jnp.zeros((1, 8), jnp.int32)
+        )["params"]
+        srv = make_server(
+            cfg, params, model_name="gpt-spec", max_new_cap=64,
+            speculative=True,
+        )
+        thread = threading.Thread(target=srv.serve_forever, daemon=True)
+        thread.start()
+        try:
+            yield cfg, params, srv
+        finally:
+            srv.shutdown()
+
+    def test_greedy_output_identical_and_metered(self, spec_server):
+        cfg, params, srv = spec_server
+        port = srv.server_address[1]
+        prompt = [[1, 2, 3, 1, 2, 3, 1, 2], [9, 8, 7, 9, 8, 7, 9, 8]]
+        status, body = post(port, {
+            "input_ids": prompt, "max_new_tokens": 10,
+        })
+        assert status == 200
+        expect = gpt_lib.generate(
+            cfg, params, jnp.asarray(prompt), max_new_tokens=10
+        )
+        np.testing.assert_array_equal(
+            np.asarray(body["tokens"]), np.asarray(expect)
+        )
+        assert srv.state.speculative_decodes >= 1
+
+    def test_sampled_falls_back(self, spec_server):
+        _, _, srv = spec_server
+        port = srv.server_address[1]
+        before = srv.state.speculative_decodes
+        status, _ = post(port, {
+            "input_ids": [[1, 2, 3, 4]], "max_new_tokens": 4,
+            "temperature": 0.8, "seed": 1,
+        })
+        assert status == 200
+        assert srv.state.speculative_decodes == before
+
+    def test_ragged_falls_back(self, spec_server):
+        _, _, srv = spec_server
+        port = srv.server_address[1]
+        before = srv.state.speculative_decodes
+        status, _ = post(port, {
+            "input_ids": [[1, 2, 3, 4], [5, 6]], "max_new_tokens": 4,
+        })
+        assert status == 200
+        assert srv.state.speculative_decodes == before
+
+    def test_batching_and_speculative_refused_together(self):
+        cfg = gpt_lib.GPT_TINY
+        params = gpt_lib.GPT(cfg).init(
+            jax.random.PRNGKey(0), jnp.zeros((1, 8), jnp.int32)
+        )["params"]
+        with pytest.raises(ValueError, match="mutually exclusive"):
+            make_server(
+                cfg, params, speculative=True, batch_window_ms=5.0
+            )

@@ -361,6 +361,12 @@ class _CachedBlock(nn.Module):
         y = nn.LayerNorm(dtype=jnp.float32, name="ln_attn")(x)
         if index is None:
             y = PrefillSelfAttention(**kwargs)(y.astype(cfg.dtype))
+        elif y.ndim == 3:
+            # [b, s, hidden] at a dynamic offset: speculative-verify
+            # block (prefill attention with the offset threaded in)
+            y = PrefillSelfAttention(**kwargs)(
+                y.astype(cfg.dtype), offset=index
+            )
         else:
             y = CachedSelfAttention(**kwargs)(y.astype(cfg.dtype), index)
         x = x + y
@@ -406,7 +412,9 @@ class PrefillSelfAttention(nn.Module):
     kv_quant_int8: bool = False
 
     @nn.compact
-    def __call__(self, x: jax.Array) -> jax.Array:
+    def __call__(
+        self, x: jax.Array, offset: Optional[jax.Array] = None
+    ) -> jax.Array:
         batch, p = x.shape[:2]
         dense = lambda name: head_projection(  # noqa: E731
             self.num_heads, self.head_dim, self.dtype, name
@@ -420,22 +428,39 @@ class PrefillSelfAttention(nn.Module):
         # must see the same representation or the two phases' logits
         # diverge at quantization scale (not ULP scale) — a row's
         # tokens must not depend on which phase ingested its prompt
-        def store(name, new):
+        def store(name, new, start, width):
             cache, cache_scale = _store_kv(
                 self, name, new, self.max_len, self.dtype,
-                self.kv_quant_int8, 0,
+                self.kv_quant_int8, start,
             )
-            return cache[:, :p], (
-                None if cache_scale is None else cache_scale[:, :p]
+            if width is None:  # dynamic offset: keep the full cache
+                return cache, cache_scale
+            return cache[:, :width], (
+                None if cache_scale is None else cache_scale[:, :width]
             )
 
-        keys, key_scale = store("k", key)
-        values, value_scale = store("v", value)
-        causal = (
-            jnp.arange(p)[:, None] >= jnp.arange(p)[None, :]
-        )[None, None]
+        if offset is None:
+            # static prompt-at-0 prefill: attend over the [:p] slice
+            keys, key_scale = store("k", key, 0, p)
+            values, value_scale = store("v", value, 0, p)
+            mask = (
+                jnp.arange(p)[:, None] >= jnp.arange(p)[None, :]
+            )[None, None]
+        else:
+            # speculative-verify block at a DYNAMIC cache offset: the
+            # slice width would be traced, so attend over the whole
+            # cache with the causal window in the mask (exactly what
+            # the one-token decode step does); stale entries past
+            # offset+row are masked out and overwritten by later
+            # writes before they can ever become visible
+            keys, key_scale = store("k", key, offset, None)
+            values, value_scale = store("v", value, offset, None)
+            mask = (
+                jnp.arange(self.max_len)[None, :]
+                <= offset + jnp.arange(p)[:, None]
+            )[None, None]
         out = _cache_attention(
-            query, keys, key_scale, values, value_scale, causal
+            query, keys, key_scale, values, value_scale, mask
         )
         return nn.DenseGeneral(
             features=x.shape[-1], axis=(-2, -1), dtype=self.dtype,
@@ -705,3 +730,229 @@ def generate(
     )
     generated = run(params, prompt, rng, lens)
     return jnp.concatenate([prompt[:, :1], generated], axis=1)
+
+
+# -- speculative decoding (prompt-lookup drafting) --------------------------
+
+
+class GPTVerifyBlock(nn.Module):
+    """k+1-token forward at a dynamic cache offset — the verify step of
+    speculative decoding. Param-path identical to GPTDecodeStep /
+    GPTPrefill (token_embed/position_embed/layer_i/ln_final/lm_head),
+    so one set of trained weights drives prefill, stepwise decode, and
+    speculative verify. Writes K/V for positions
+    [offset, offset + s) and returns logits for ALL s positions."""
+
+    config: GPTConfig
+    cache_len: int = 0
+    kv_quant_int8: bool = False
+
+    @nn.compact
+    def __call__(
+        self, tokens: jax.Array, offset: jax.Array
+    ) -> jax.Array:  # [b, s], scalar -> [b, s, vocab]
+        cfg = self.config
+        s = tokens.shape[1]
+        x = nn.Embed(
+            cfg.vocab_size, cfg.hidden_size, dtype=cfg.dtype,
+            name="token_embed",
+        )(tokens)
+        # clip: the provisional tail of a near-the-end verify block can
+        # overshoot max_seq_len by up to draft_k; those positions only
+        # ever feed the acceptance decision (correctness-neutral), so a
+        # clamped embedding is fine and keeps the gather in range
+        x = x + nn.Embed(
+            cfg.max_seq_len, cfg.hidden_size, dtype=cfg.dtype,
+            name="position_embed",
+        )(jnp.minimum(
+            offset + jnp.arange(s)[None, :], cfg.max_seq_len - 1
+        ))
+        cache_len = self.cache_len or cfg.max_seq_len
+        for layer in range(cfg.num_layers):
+            x = _CachedBlock(
+                cfg, cache_len=cache_len,
+                kv_quant_int8=self.kv_quant_int8, name=f"layer_{layer}",
+            )(x, index=offset)
+        x = nn.LayerNorm(dtype=jnp.float32, name="ln_final")(x)
+        return nn.Dense(
+            cfg.vocab_size, dtype=cfg.dtype, name="lm_head"
+        )(x.astype(cfg.dtype))
+
+
+def _ngram_draft(
+    buf: jax.Array, index: jax.Array, k: int, ngram: int
+) -> jax.Array:
+    """Prompt-lookup drafter (no draft model): propose the k tokens
+    that followed the most recent earlier occurrence of the current
+    ngram-token tail. buf: [b, L] token buffer whose positions
+    [0, index] are committed; returns [b, k] drafts. Pure jnp with
+    static shapes — runs inside the decode loop's jit.
+
+    When no earlier occurrence exists the draft repeats the current
+    token; a bad draft costs nothing but its verify slot (the verify
+    step's correction still commits one true token per round). Drafts
+    may read a few stale positions past `index`; that only lowers the
+    acceptance rate, never correctness — acceptance is decided against
+    the verify forward's own logits."""
+    b, length = buf.shape
+    pos = jnp.arange(length)
+    tail = jax.vmap(
+        lambda row: jax.lax.dynamic_slice(
+            row, (index - (ngram - 1),), (ngram,)
+        )
+    )(buf)  # [b, ngram]
+    match = jnp.ones((b, length), bool)
+    for j in range(ngram):
+        # token at p+j as a statically shifted view; pad with -1 so
+        # shifted-off positions can never match a real token
+        shifted = jnp.concatenate(
+            [buf[:, j:], jnp.full((b, j), -1, buf.dtype)], axis=1
+        )
+        match &= shifted == tail[:, j][:, None]
+    # the continuation must start at committed positions: p + ngram
+    # <= index
+    match &= (pos <= index - ngram)[None, :]
+    p_star = jnp.max(jnp.where(match, pos[None, :], -1), axis=1)  # [b]
+    start = jnp.clip(p_star + ngram, 0, length - k)
+    cont = jax.vmap(
+        lambda row, st: jax.lax.dynamic_slice(row, (st,), (k,))
+    )(buf, start)
+    last = jax.vmap(
+        lambda row: jax.lax.dynamic_slice(row, (index,), (1,))
+    )(buf)
+    return jnp.where((p_star >= 0)[:, None], cont, jnp.tile(last, (1, k)))
+
+
+@functools.lru_cache(maxsize=32)
+def _compiled_spec_decode(
+    cfg: GPTConfig, batch: int, prompt_len: int, total: int,
+    draft_k: int, ngram: int, kv_quant_int8: bool = False,
+):
+    """One compiled speculative-decode program per (config, shape):
+    batched prefill, then a lax.while_loop of draft -> verify ->
+    commit rounds. Greedy-exact: every committed token is the argmax
+    of the model's logits given the committed prefix, so the output
+    equals generate(temperature=0)'s up to floating-point program
+    equivalence between the block-verify and one-token forwards."""
+    # buf AND cache are wider than `total`: a verify round entered at
+    # index = total - 2 writes its k+1 candidate tokens/KV at
+    # index(+1) .. index+k(+1) <= total + k - 1. A `total`-sized cache
+    # would make dynamic_update_slice CLAMP the write start near the
+    # end, landing the block at a shifted offset and silently
+    # corrupting the final tokens' logits (caught by
+    # TestSpeculative::test_exact_on_random_prompt). The tail past
+    # `total` only ever holds provisional candidates — sliced off the
+    # returned buf, masked out of every committed position's attention.
+    width = total + draft_k
+    model = GPTVerifyBlock(
+        cfg, cache_len=width, kv_quant_int8=kv_quant_int8
+    )
+    prefill_model = GPTPrefill(
+        cfg, cache_len=width, kv_quant_int8=kv_quant_int8
+    )
+
+    @jax.jit
+    def run(params, prompt):
+        logits, updates = prefill_model.apply(
+            {"params": params}, prompt, mutable=["cache"]
+        )
+        first_new = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+        buf = jnp.concatenate(
+            [
+                prompt.astype(jnp.int32),
+                first_new[:, None],
+                jnp.zeros((batch, width - prompt_len - 1), jnp.int32),
+            ],
+            axis=1,
+        )
+        state = (buf, updates["cache"], jnp.int32(prompt_len))
+
+        def cond(state):
+            _, _, index = state
+            return index < total - 1
+
+        def body(state):
+            buf, cache, index = state
+            drafts = _ngram_draft(buf, index, draft_k, ngram)  # [b, k]
+            cur = jax.vmap(
+                lambda row: jax.lax.dynamic_slice(row, (index,), (1,))
+            )(buf)
+            block = jnp.concatenate([cur, drafts], axis=1)  # [b, k+1]
+            logits, updates = model.apply(
+                {"params": params, "cache": cache}, block, index,
+                mutable=["cache"],
+            )
+            greedy = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+            # per-row count of leading drafts the model agrees with;
+            # commit the batch-min so the cache index stays scalar
+            ok = (greedy[:, :draft_k] == drafts).astype(jnp.int32)
+            accepted = jnp.sum(jnp.cumprod(ok, axis=1), axis=1)  # [b]
+            commit = jnp.min(accepted)
+            # greedy[:, :commit+1] are all model-true given the
+            # committed prefix (drafts agree up to commit in every
+            # row); tokens past commit+1 are provisional and will be
+            # overwritten before index ever reaches them
+            buf = jax.lax.dynamic_update_slice(
+                buf, greedy, (0, index + 1)
+            )
+            return (buf, updates["cache"], index + commit + 1)
+
+        buf, _, _ = jax.lax.while_loop(cond, body, state)
+        return buf[:, :total]
+
+    return run
+
+
+def generate_speculative(
+    cfg: GPTConfig,
+    params,
+    prompt: jax.Array,
+    max_new_tokens: int,
+    draft_k: int = 4,
+    ngram: int = 2,
+    kv_quant_int8: bool = False,
+) -> jax.Array:
+    """Greedy decode with prompt-lookup speculative decoding: an
+    n-gram match against the already-generated context proposes
+    draft_k tokens, ONE k+1-wide verify forward checks them, and the
+    longest model-agreeing prefix (batch-min) commits in a single
+    round — so repetitive stretches advance several tokens per
+    weights+cache read instead of one. Decode is HBM-bandwidth-bound
+    (every round reads all weights and the whole KV cache), which
+    makes tokens-per-read the lever; the draft itself is free (pure
+    jnp lookup, no draft model).
+
+    Output-exact w.r.t. generate(temperature=0) — acceptance compares
+    the drafts against the verify forward's own argmax, so every
+    committed token is the model's greedy choice (pinned by
+    tests/test_gpt.py::TestSpeculative). Worst case (no draft ever
+    accepted) degenerates to one committed token per round, i.e.
+    stepwise decode cost plus the k extra verify columns.
+
+    The reference delegates serving entirely (SURVEY.md §2: no data
+    plane); this is net-new capability on the framework's serving
+    path, single-host/single-chip (the serving shape; use
+    generate(mesh=...) for sharded decode)."""
+    batch, prompt_len = prompt.shape
+    total = prompt_len + max_new_tokens
+    if max_new_tokens < 1:
+        raise ValueError(
+            f"max_new_tokens must be >= 1, got {max_new_tokens}"
+        )
+    if total > cfg.max_seq_len:
+        raise ValueError(
+            f"prompt+new = {total} exceeds max_seq_len {cfg.max_seq_len}"
+        )
+    if draft_k < 1:
+        raise ValueError(f"draft_k must be >= 1, got {draft_k}")
+    if ngram < 1:
+        raise ValueError(f"ngram must be >= 1, got {ngram}")
+    if prompt_len < ngram:
+        raise ValueError(
+            f"prompt_len {prompt_len} must be >= ngram {ngram}"
+        )
+    run = _compiled_spec_decode(
+        cfg, batch, prompt_len, total, int(draft_k), int(ngram),
+        kv_quant_int8=kv_quant_int8,
+    )
+    return run(params, prompt)

@@ -52,18 +52,23 @@ from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 logger = logging.getLogger("tf_operator_tpu.serve")
 
 MAX_BATCH = 64
+# the ngram passed to generate_speculative AND the eligibility floor in
+# _device_decode — one constant, so the gate can never admit a prompt
+# the drafter rejects
+_SPEC_NGRAM = 2
 
 
 class _State:
     """Model + params + decode bookkeeping shared by request threads."""
 
     def __init__(self, cfg, params, kv_quant_int8: bool, model_name: str,
-                 max_new_cap: int):
+                 max_new_cap: int, speculative: bool = False):
         self.cfg = cfg
         self.params = params
         self.kv_quant_int8 = kv_quant_int8
         self.model_name = model_name
         self.max_new_cap = max_new_cap
+        self.speculative = speculative
         self.lock = threading.Lock()
         self.batcher = None  # set by make_server when batching is on
         self.decodes = 0
@@ -71,6 +76,7 @@ class _State:
         self.tokens_generated = 0
         self.decode_seconds = 0.0
         self.request_errors = 0
+        self.speculative_decodes = 0
 
     def render_metrics(self) -> str:
         """Prometheus text format — same no-dependency exposition the
@@ -84,6 +90,8 @@ class _State:
             ("generated_tokens_total", "counter", self.tokens_generated),
             ("decode_seconds_total", "counter", self.decode_seconds),
             ("request_errors_total", "counter", self.request_errors),
+            ("speculative_decodes_total", "counter",
+             self.speculative_decodes),
         ):
             rows.append(f"# TYPE {prefix}_{name} {kind}")
             rows.append(f"{prefix}_{name} {value}")
@@ -171,16 +179,35 @@ def _device_decode(
 
     from ..models import gpt as gpt_lib
 
+    prompt = jnp.asarray(prompt)
+    # speculative path: greedy-only and uniform-length-only (it has no
+    # ragged forcing), output-exact vs generate(temperature=0) — see
+    # models/gpt.py generate_speculative. Everything else falls back.
+    lens_list = list(lens)
+    use_spec = (
+        state.speculative
+        and temperature == 0.0
+        and all(length == prompt.shape[1] for length in lens_list)
+        and prompt.shape[1] >= _SPEC_NGRAM
+    )
     with state.lock:  # decode saturates the chip; serialize
         start = time.perf_counter()
-        out = gpt_lib.generate(
-            state.cfg, state.params, jnp.asarray(prompt),
-            max_new_tokens=new, temperature=temperature,
-            rng=rng if rng is not None else jax.random.PRNGKey(0),
-            kv_quant_int8=state.kv_quant_int8,
-            prompt_lens=jnp.asarray(lens),
-            top_k=top_k, top_p=top_p,
-        )
+        if use_spec:
+            out = gpt_lib.generate_speculative(
+                state.cfg, state.params, prompt, max_new_tokens=new,
+                ngram=_SPEC_NGRAM,
+                kv_quant_int8=state.kv_quant_int8,
+            )
+            state.speculative_decodes += 1
+        else:
+            out = gpt_lib.generate(
+                state.cfg, state.params, prompt,
+                max_new_tokens=new, temperature=temperature,
+                rng=rng if rng is not None else jax.random.PRNGKey(0),
+                kv_quant_int8=state.kv_quant_int8,
+                prompt_lens=jnp.asarray(lens),
+                top_k=top_k, top_p=top_p,
+            )
         jax.block_until_ready(out)
         state.decode_seconds += time.perf_counter() - start
         state.decode_batches += 1
@@ -308,13 +335,30 @@ def make_server(
     max_new_cap: int = 1024,
     host: str = "127.0.0.1",
     batch_window_ms: float = 0.0,
+    speculative: bool = False,
 ) -> ThreadingHTTPServer:
     """In-process server (tests and embedders); caller owns
     serve_forever/shutdown. The CLI binds 0.0.0.0 (pods must be
     reachable on the pod IP); the in-process default stays loopback.
     batch_window_ms > 0 enables dynamic batching of greedy requests
-    (serve/batching.py)."""
-    state = _State(cfg, params, kv_quant_int8, model_name, max_new_cap)
+    (serve/batching.py). speculative=True routes greedy uniform-length
+    requests through prompt-lookup speculative decoding
+    (models/gpt.py generate_speculative; output-exact). The two are
+    mutually exclusive: the batcher's width/batch bucketing pads
+    groups into shapes the speculative eligibility check would almost
+    never pass, silently defeating the flag — refused loudly here
+    instead."""
+    if speculative and batch_window_ms > 0:
+        raise ValueError(
+            "speculative and batch_window_ms are mutually exclusive: "
+            "the dynamic batcher's shape bucketing (padded widths, "
+            "dummy rows) defeats the uniform-length speculative gate; "
+            "pick the one that fits the traffic"
+        )
+    state = _State(
+        cfg, params, kv_quant_int8, model_name, max_new_cap,
+        speculative=speculative,
+    )
     if batch_window_ms > 0:
         from .batching import DynamicBatcher
 
@@ -351,6 +395,12 @@ def main(argv=None) -> int:
         "--batch-window-ms", type=float, default=0.0,
         help="dynamic batching: hold a greedy request this long to "
         "coalesce concurrent peers into one decode (0 = off)",
+    )
+    parser.add_argument(
+        "--speculative", action="store_true",
+        help="prompt-lookup speculative decoding for greedy "
+        "uniform-length requests (output-exact; repetitive "
+        "continuations commit several tokens per model read)",
     )
     args = parser.parse_args(argv)
     logging.basicConfig(level=logging.INFO, stream=sys.stderr)
@@ -394,6 +444,7 @@ def main(argv=None) -> int:
         cfg, params, port=args.port, kv_quant_int8=args.kv_int8,
         model_name=f"gpt-{args.preset}", max_new_cap=args.max_new_cap,
         host=args.host, batch_window_ms=args.batch_window_ms,
+        speculative=args.speculative,
     )
     logger.info("decode server on :%d", server.server_address[1])
     try:
