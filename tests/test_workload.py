@@ -441,6 +441,25 @@ class TestTraining:
         )
         np.testing.assert_allclose(got, ref, atol=1e-5, rtol=1e-5)
 
+    def test_vit_uint8_input_matches_normalized_f32(self):
+        """ViT honors the same uint8 wire contract as ResNet."""
+        import dataclasses as _dc
+
+        from tf_operator_tpu.models import vit as vit_lib
+
+        cfg = _dc.replace(vit_lib.VIT_TINY, dtype=jnp.float32)
+        model = vit_lib.ViT(cfg)
+        u8 = resnet_lib.synthetic_uint8_batch(
+            1, 2, cfg.image_size, cfg.num_classes
+        )["image"]
+        f32 = (u8.astype(np.float32) - 127.5) * (1.0 / 127.5)
+        variables = model.init(jax.random.PRNGKey(0), jnp.asarray(f32))
+        np.testing.assert_allclose(
+            model.apply(variables, jnp.asarray(u8)),
+            model.apply(variables, jnp.asarray(f32)),
+            rtol=1e-6, atol=1e-6,
+        )
+
     def test_uint8_input_matches_normalized_f32(self):
         """uint8 is the image wire format (4x fewer host->HBM bytes);
         the model normalizes on device. A uint8 batch must produce

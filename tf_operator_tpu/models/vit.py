@@ -90,6 +90,11 @@ class ViT(nn.Module):
     def __call__(self, images: jax.Array) -> jax.Array:
         cfg = self.config
         block_cfg = cfg.block_config()
+        if images.dtype == jnp.uint8:
+            # uint8 image wire format, normalized on device — same
+            # contract as ResNet (models/resnet.py): 4x fewer
+            # host->HBM bytes, cast+affine fused into the patch conv
+            images = (images.astype(cfg.dtype) - 127.5) * (1.0 / 127.5)
         x = nn.Conv(
             cfg.hidden_size,
             kernel_size=(cfg.patch_size, cfg.patch_size),
