@@ -677,6 +677,47 @@ def run_extras(on_tpu: bool, n_chips: int, line: dict) -> None:
             batch * (prompt_len - 1 + new) / elapsed, 2
         )
 
+    def _quantized_decode_setup():
+        # pre-quantize OUTSIDE the timed window — serving pays the
+        # transform once at load (serve/server.py make_server), so the
+        # A/B must measure the steady-state int8 path, not a per-call
+        # re-quantization generate() would otherwise perform
+        from tf_operator_tpu.ops.quant import quantize_params
+
+        gpt_lib, cfg, params, prompt, batch, prompt_len, new = (
+            _decode_setup()
+        )
+        params = jax.block_until_ready(quantize_params(params))
+        return gpt_lib, cfg, params, prompt, batch, prompt_len, new
+
+    def gpt_decode_w8():
+        # int8 weights (ops/quant.py): decode's OTHER bandwidth half —
+        # params are re-read per token just like the cache; scales
+        # factored onto the matmul outputs, same discipline as the
+        # int8 KV cache
+        gpt_lib, cfg, params, prompt, batch, prompt_len, new = (
+            _quantized_decode_setup()
+        )
+        elapsed = _time_decode(
+            gpt_lib, cfg, params, prompt, new, weights_int8=True
+        )
+        line["gpt_decode_w8_tokens_per_sec"] = round(
+            batch * (prompt_len - 1 + new) / elapsed, 2
+        )
+
+    def gpt_decode_w8kv8():
+        # both int8 levers composed: the full halved-traffic decode
+        gpt_lib, cfg, params, prompt, batch, prompt_len, new = (
+            _quantized_decode_setup()
+        )
+        elapsed = _time_decode(
+            gpt_lib, cfg, params, prompt, new, weights_int8=True,
+            kv_quant_int8=True,
+        )
+        line["gpt_decode_w8kv8_tokens_per_sec"] = round(
+            batch * (prompt_len - 1 + new) / elapsed, 2
+        )
+
     def gpt_decode_spec():
         # prompt-lookup speculative decoding (models/gpt.py
         # generate_speculative; greedy-exact) at gpt_decode's shape —
@@ -844,6 +885,8 @@ def run_extras(on_tpu: bool, n_chips: int, line: dict) -> None:
         extra("gpt_decode_int8", gpt_decode_int8)
         extra("gpt_decode_long", gpt_decode_long)
         extra("gpt_decode_long_int8", gpt_decode_long_int8)
+        extra("gpt_decode_w8", gpt_decode_w8)
+        extra("gpt_decode_w8kv8", gpt_decode_w8kv8)
         extra("gpt_decode_spec", gpt_decode_spec)
         extra("gpt_decode_tp", gpt_decode_tp)
         extra("gpt_remat", gpt_remat)

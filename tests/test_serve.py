@@ -406,3 +406,36 @@ class TestSpeculativeServing:
             make_server(
                 cfg, params, speculative=True, batch_window_ms=5.0
             )
+
+
+class TestWeightsInt8Serving:
+    def test_serves_and_reports_flag(self):
+        import dataclasses
+
+        cfg = dataclasses.replace(gpt_lib.GPT_TINY, dtype=jnp.float32)
+        params = gpt_lib.GPT(cfg).init(
+            jax.random.PRNGKey(0), jnp.zeros((1, 8), jnp.int32)
+        )["params"]
+        srv = make_server(
+            cfg, params, model_name="gpt-w8", max_new_cap=32,
+            weights_int8=True,
+        )
+        thread = threading.Thread(target=srv.serve_forever, daemon=True)
+        thread.start()
+        try:
+            port = srv.server_address[1]
+            status, body = post(port, {
+                "input_ids": [[1, 2, 3, 4]], "max_new_tokens": 5,
+            })
+            assert status == 200
+            assert len(body["tokens"][0]) == 9
+            # the params were quantized ONCE at load
+            from tf_operator_tpu.ops.quant import is_quantized
+
+            assert is_quantized(srv.state.params)
+            req = urllib.request.Request(f"http://127.0.0.1:{port}/healthz")
+            with urllib.request.urlopen(req, timeout=30) as resp:
+                health = json.loads(resp.read())
+            assert health["weights_int8"] is True
+        finally:
+            srv.shutdown()

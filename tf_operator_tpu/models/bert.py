@@ -58,17 +58,20 @@ BERT_TINY = BertConfig(
 )
 
 
-def transformer_mlp(cfg, x: jax.Array) -> jax.Array:
+def transformer_mlp(cfg, x: jax.Array, dense_cls=None) -> jax.Array:
     """The LN'd-input MLP half of a transformer block. A free function
     creating layers in the CALLER's scope (flax attaches them to the
     calling module), so TransformerBlock and the GPT decode-path
     _CachedBlock share one implementation with identical param paths
-    (mlp_in/mlp_out)."""
-    y = nn.Dense(cfg.intermediate_size, dtype=cfg.dtype, name="mlp_in")(
+    (mlp_in/mlp_out). dense_cls swaps the projection implementation
+    at the same param paths (the decode path's int8-weight twin,
+    ops/quant.py QuantDense)."""
+    dense = dense_cls if dense_cls is not None else nn.Dense
+    y = dense(cfg.intermediate_size, dtype=cfg.dtype, name="mlp_in")(
         x.astype(cfg.dtype)
     )
     y = nn.gelu(y)
-    return nn.Dense(cfg.hidden_size, dtype=cfg.dtype, name="mlp_out")(y)
+    return dense(cfg.hidden_size, dtype=cfg.dtype, name="mlp_out")(y)
 
 
 class TransformerBlock(nn.Module):

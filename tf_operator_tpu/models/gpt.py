@@ -238,6 +238,30 @@ def _cache_attention(
     return jnp.einsum("bhqk,bkhd->bqhd", weights, value.astype(dtype))
 
 
+def _projections(weights_int8: bool):
+    """The decode path's projection factories: flax modules, or their
+    int8-kernel twins (ops/quant.py) at identical param paths when the
+    params tree went through quantize_params. One switch point so the
+    five decode modules can't drift apart."""
+    from types import SimpleNamespace
+
+    if weights_int8:
+        from ..ops.quant import (
+            QuantDense,
+            QuantDenseGeneral,
+            quant_head_projection,
+        )
+
+        return SimpleNamespace(
+            head=quant_head_projection,
+            general=QuantDenseGeneral,
+            dense=QuantDense,
+        )
+    return SimpleNamespace(
+        head=head_projection, general=nn.DenseGeneral, dense=nn.Dense
+    )
+
+
 class CachedSelfAttention(nn.Module):
     """Single-token decode attention over a pre-allocated KV cache.
 
@@ -263,6 +287,7 @@ class CachedSelfAttention(nn.Module):
     max_len: int
     dtype: jnp.dtype = jnp.bfloat16
     kv_quant_int8: bool = False
+    weights_int8: bool = False
 
     def _store(self, name: str, new, batch: int, index):
         """Write one token's K or V into its cache; returns
@@ -275,7 +300,8 @@ class CachedSelfAttention(nn.Module):
     @nn.compact
     def __call__(self, x: jax.Array, index: jax.Array) -> jax.Array:
         batch = x.shape[0]
-        dense = lambda name: head_projection(  # noqa: E731
+        proj = _projections(self.weights_int8)
+        dense = lambda name: proj.head(  # noqa: E731
             self.num_heads, self.head_dim, self.dtype, name
         )
         # x: [batch, hidden] — ONE new token per call
@@ -290,7 +316,7 @@ class CachedSelfAttention(nn.Module):
         out = _cache_attention(
             query, keys, key_scale, values, value_scale, valid
         )  # [b,1,h,d]
-        return nn.DenseGeneral(
+        return proj.general(
             features=x.shape[-1], axis=(-2, -1), dtype=self.dtype,
             name="attn_out",
         )(out[:, 0])
@@ -310,6 +336,7 @@ class GPTDecodeStep(nn.Module):
     config: GPTConfig
     cache_len: int = 0  # 0 -> cfg.max_seq_len
     kv_quant_int8: bool = False
+    weights_int8: bool = False
 
     @nn.compact
     def __call__(self, token: jax.Array, index: jax.Array) -> jax.Array:
@@ -326,12 +353,13 @@ class GPTDecodeStep(nn.Module):
         for layer in range(cfg.num_layers):
             x = _CachedBlock(
                 cfg, cache_len=cache_len,
-                kv_quant_int8=self.kv_quant_int8, name=f"layer_{layer}",
+                kv_quant_int8=self.kv_quant_int8,
+                weights_int8=self.weights_int8, name=f"layer_{layer}",
             )(x, index)
         x = nn.LayerNorm(dtype=jnp.float32, name="ln_final")(x)
         # model-dtype head: bf16 MXU matmul + bf16 logits; the fused
         # loss upcasts to f32 at reduced shapes (see models/bert.py)
-        return nn.Dense(
+        return _projections(self.weights_int8).dense(
             cfg.vocab_size, dtype=cfg.dtype, name="lm_head"
         )(x.astype(cfg.dtype))
 
@@ -345,6 +373,7 @@ class _CachedBlock(nn.Module):
     config: GPTConfig
     cache_len: int = 0
     kv_quant_int8: bool = False
+    weights_int8: bool = False
 
     @nn.compact
     def __call__(
@@ -356,7 +385,8 @@ class _CachedBlock(nn.Module):
         kwargs = dict(
             num_heads=cfg.num_heads, head_dim=cfg.head_dim,
             max_len=self.cache_len or cfg.max_seq_len, dtype=cfg.dtype,
-            kv_quant_int8=self.kv_quant_int8, name="attention",
+            kv_quant_int8=self.kv_quant_int8,
+            weights_int8=self.weights_int8, name="attention",
         )
         y = nn.LayerNorm(dtype=jnp.float32, name="ln_attn")(x)
         if index is None:
@@ -371,7 +401,9 @@ class _CachedBlock(nn.Module):
             y = CachedSelfAttention(**kwargs)(y.astype(cfg.dtype), index)
         x = x + y
         y = nn.LayerNorm(dtype=jnp.float32, name="ln_mlp")(x)
-        return x + transformer_mlp(cfg, y)
+        return x + transformer_mlp(
+            cfg, y, dense_cls=_projections(self.weights_int8).dense
+        )
 
 
 def _filter_logits(logits: jax.Array, top_k: int, top_p: float) -> jax.Array:
@@ -410,13 +442,15 @@ class PrefillSelfAttention(nn.Module):
     max_len: int
     dtype: jnp.dtype = jnp.bfloat16
     kv_quant_int8: bool = False
+    weights_int8: bool = False
 
     @nn.compact
     def __call__(
         self, x: jax.Array, offset: Optional[jax.Array] = None
     ) -> jax.Array:
         batch, p = x.shape[:2]
-        dense = lambda name: head_projection(  # noqa: E731
+        proj = _projections(self.weights_int8)
+        dense = lambda name: proj.head(  # noqa: E731
             self.num_heads, self.head_dim, self.dtype, name
         )
         query = dense("query")(x)  # [b, p, h, d]
@@ -462,7 +496,7 @@ class PrefillSelfAttention(nn.Module):
         out = _cache_attention(
             query, keys, key_scale, values, value_scale, mask
         )
-        return nn.DenseGeneral(
+        return proj.general(
             features=x.shape[-1], axis=(-2, -1), dtype=self.dtype,
             name="attn_out",
         )(out)
@@ -476,6 +510,7 @@ class GPTPrefill(nn.Module):
     config: GPTConfig
     cache_len: int = 0
     kv_quant_int8: bool = False
+    weights_int8: bool = False
 
     @nn.compact
     def __call__(self, tokens: jax.Array) -> jax.Array:  # [b, p]
@@ -493,18 +528,29 @@ class GPTPrefill(nn.Module):
         for layer in range(cfg.num_layers):
             x = _CachedBlock(
                 cfg, cache_len=cache_len,
-                kv_quant_int8=self.kv_quant_int8, name=f"layer_{layer}",
+                kv_quant_int8=self.kv_quant_int8,
+                weights_int8=self.weights_int8, name=f"layer_{layer}",
             )(x, index=None)  # None = whole-prompt prefill phase
         x = nn.LayerNorm(dtype=jnp.float32, name="ln_final")(x)
-        return nn.Dense(
+        return _projections(self.weights_int8).dense(
             cfg.vocab_size, dtype=cfg.dtype, name="lm_head"
         )(x[:, -1].astype(cfg.dtype))
+
+
+def _ensure_quantized(params):
+    """Quantize a decode params tree unless it already is (serving
+    pre-quantizes once at load; repeated generate() calls must not
+    re-pay the transform)."""
+    from ..ops.quant import is_quantized, quantize_params
+
+    return params if is_quantized(params) else quantize_params(params)
 
 
 @functools.lru_cache(maxsize=32)
 def _compiled_decode(cfg: GPTConfig, temperature: float, batch: int,
                      prompt_len: int, total: int,
                      kv_quant_int8: bool = False,
+                     weights_int8: bool = False,
                      top_k: int = 0, top_p: float = 1.0,
                      ragged: bool = False):
     """One compiled decode scan per (config, temperature, shape) —
@@ -514,7 +560,10 @@ def _compiled_decode(cfg: GPTConfig, temperature: float, batch: int,
     as zeros INSIDE the jitted function from an abstract shape tree —
     the executable carries no device-array constants, so cached
     entries cost metadata, not HBM."""
-    model = GPTDecodeStep(cfg, cache_len=total, kv_quant_int8=kv_quant_int8)
+    model = GPTDecodeStep(
+        cfg, cache_len=total, kv_quant_int8=kv_quant_int8,
+        weights_int8=weights_int8,
+    )
     cache_shapes = jax.eval_shape(
         lambda: model.init(
             jax.random.PRNGKey(0), jnp.zeros((batch,), jnp.int32),
@@ -579,7 +628,8 @@ def _compiled_decode(cfg: GPTConfig, temperature: float, batch: int,
     # prefill/decode split every serving stack uses), then scan only
     # over the genuinely sequential new tokens
     prefill_model = GPTPrefill(
-        cfg, cache_len=total, kv_quant_int8=kv_quant_int8
+        cfg, cache_len=total, kv_quant_int8=kv_quant_int8,
+        weights_int8=weights_int8,
     )
 
     @jax.jit
@@ -614,6 +664,7 @@ def generate(
     mesh=None,
     rules=None,
     kv_quant_int8: bool = False,
+    weights_int8: bool = False,
     prompt_lens: Optional[jax.Array] = None,
     top_k: int = 0,
     top_p: float = 1.0,
@@ -644,6 +695,13 @@ def generate(
     kv_quant_int8: int8 KV cache with per-(position, head) scales —
     halves the per-step cache HBM traffic decode is bound by (see
     CachedSelfAttention).
+
+    weights_int8: int8 kernels with per-feature-slice scales (see
+    ops/quant.py) — halves the per-step WEIGHTS traffic, the other
+    half of decode's bandwidth bill. Quantizes the params once per
+    call unless the tree is already int8 (serving pre-quantizes at
+    load; both int8 flags compose). ~0.5%-of-range logit error:
+    output tokens may differ from the bf16 weights' at near-ties.
 
     top_k / top_p (sampling only, temperature > 0): standard top-k and
     nucleus filtering before the categorical draw; 0 / 1.0 disable.
@@ -722,9 +780,11 @@ def generate(
             else PartitionSpec()
         )
         lens = jax.device_put(lens, NamedSharding(mesh, lens_spec))
+    if weights_int8:
+        params = _ensure_quantized(params)
     run = _compiled_decode(
         cfg, float(temperature), batch, prompt_len, total,
-        kv_quant_int8=kv_quant_int8,
+        kv_quant_int8=kv_quant_int8, weights_int8=weights_int8,
         top_k=int(top_k), top_p=float(top_p),
         ragged=ragged,
     )
@@ -746,6 +806,7 @@ class GPTVerifyBlock(nn.Module):
     config: GPTConfig
     cache_len: int = 0
     kv_quant_int8: bool = False
+    weights_int8: bool = False
 
     @nn.compact
     def __call__(
@@ -771,10 +832,11 @@ class GPTVerifyBlock(nn.Module):
         for layer in range(cfg.num_layers):
             x = _CachedBlock(
                 cfg, cache_len=cache_len,
-                kv_quant_int8=self.kv_quant_int8, name=f"layer_{layer}",
+                kv_quant_int8=self.kv_quant_int8,
+                weights_int8=self.weights_int8, name=f"layer_{layer}",
             )(x, index=offset)
         x = nn.LayerNorm(dtype=jnp.float32, name="ln_final")(x)
-        return nn.Dense(
+        return _projections(self.weights_int8).dense(
             cfg.vocab_size, dtype=cfg.dtype, name="lm_head"
         )(x.astype(cfg.dtype))
 
@@ -827,6 +889,7 @@ def _ngram_draft(
 def _compiled_spec_decode(
     cfg: GPTConfig, batch: int, prompt_len: int, total: int,
     draft_k: int, ngram: int, kv_quant_int8: bool = False,
+    weights_int8: bool = False,
 ):
     """One compiled speculative-decode program per (config, shape):
     batched prefill, then a lax.while_loop of draft -> verify ->
@@ -845,10 +908,12 @@ def _compiled_spec_decode(
     # returned buf, masked out of every committed position's attention.
     width = total + draft_k
     model = GPTVerifyBlock(
-        cfg, cache_len=width, kv_quant_int8=kv_quant_int8
+        cfg, cache_len=width, kv_quant_int8=kv_quant_int8,
+        weights_int8=weights_int8,
     )
     prefill_model = GPTPrefill(
-        cfg, cache_len=width, kv_quant_int8=kv_quant_int8
+        cfg, cache_len=width, kv_quant_int8=kv_quant_int8,
+        weights_int8=weights_int8,
     )
 
     @jax.jit
@@ -911,6 +976,7 @@ def generate_speculative(
     draft_k: int = 4,
     ngram: int = 2,
     kv_quant_int8: bool = False,
+    weights_int8: bool = False,
 ) -> jax.Array:
     """Greedy decode with prompt-lookup speculative decoding: an
     n-gram match against the already-generated context proposes
@@ -951,8 +1017,10 @@ def generate_speculative(
         raise ValueError(
             f"prompt_len {prompt_len} must be >= ngram {ngram}"
         )
+    if weights_int8:
+        params = _ensure_quantized(params)
     run = _compiled_spec_decode(
         cfg, batch, prompt_len, total, int(draft_k), int(ngram),
-        kv_quant_int8=kv_quant_int8,
+        kv_quant_int8=kv_quant_int8, weights_int8=weights_int8,
     )
     return run(params, prompt)

@@ -1,0 +1,164 @@
+"""int8 weight quantization for the decode path.
+
+Decode re-reads every weight matrix once per committed token, so at
+serving shapes the params are half or more of the per-step HBM traffic
+(GPT-small: 248MB of bf16 weights vs ~300MB of bf16 KV at seq 1024).
+Storing kernels as int8 with one f32 scale per feature slice halves
+the weight bytes, under the same factoring discipline as the int8 KV
+cache (models/gpt.py _cache_attention): the scale multiplies the
+matmul's OUTPUT (small), never a dequantized copy of the kernel
+(large), so the dot consumes the raw int8 kernel through a pure
+convert that fuses into the MXU operand load:
+
+    y = x @ (Kq * s)  =  (x @ Kq) * s        # s constant over the
+                                             # contracted axes
+
+Absmax scaling per feature slice (every non-contracted kernel axis —
+per (head, column) for the head projections, per output channel for
+the plain matmuls) keeps the quantization error ~0.4% of each slice's
+range — the standard W8 inference configuration. Training is
+untouched; quantization is a one-time params transform at serving
+load (`quantize_params`).
+
+The reference has no data plane at all (SURVEY.md §2 — a Go control
+plane); this is net-new serving capability.
+"""
+
+from __future__ import annotations
+
+from typing import Sequence, Union
+
+import jax
+import jax.numpy as jnp
+from flax import linen as nn
+
+
+def quantize_kernel(kernel: jax.Array, n_contract: int = 1):
+    """(int8 kernel, f32 scale over every NON-contracted axis). The
+    scale must be constant over the axes the matmul reduces (that is
+    what lets it factor onto the output); making it per-element over
+    every OUTPUT axis is then free, so each feature slice gets its own
+    absmax group — a head projection's [in, heads, head_dim] kernel
+    scales per (head, column), not per column shared across heads."""
+    k32 = kernel.astype(jnp.float32)
+    reduce_axes = tuple(range(n_contract))
+    s = jnp.maximum(jnp.max(jnp.abs(k32), axis=reduce_axes), 1e-8) / 127.0
+    q = jnp.clip(
+        jnp.round(k32 / s[(None,) * n_contract]), -127, 127
+    ).astype(jnp.int8)
+    return q, s
+
+
+def quantize_params(params) -> dict:
+    """Walk a flax params tree; every module dict holding a "kernel"
+    (Dense/DenseGeneral/Conv) gets the kernel replaced by int8 plus a
+    "kernel_scale" sibling. Embeddings (gather-read, not matmul-read)
+    and norm scales/biases pass through untouched. Idempotent: an
+    already-int8 kernel is left alone.
+
+    Contraction-arity is inferred from the decode family's shapes: the
+    one multi-input-axis projection is "attn_out" (DenseGeneral
+    axis=(-2,-1): kernel [heads, head_dim, out] contracts TWO leading
+    axes); every other kernel contracts exactly its first axis. The
+    name coupling is deliberate — this transform exists for the gpt
+    decode modules, whose param paths gpt.py owns."""
+
+    def walk(node, parent_key=None):
+        if not isinstance(node, dict):
+            return node
+        out = {}
+        for key, value in node.items():
+            if (
+                key == "kernel"
+                and hasattr(value, "ndim")
+                and value.ndim >= 2
+                and value.dtype != jnp.int8
+            ):
+                n_contract = (
+                    2 if parent_key == "attn_out" and value.ndim == 3
+                    else 1
+                )
+                out["kernel"], out["kernel_scale"] = quantize_kernel(
+                    value, n_contract
+                )
+            else:
+                out[key] = walk(value, parent_key=key)
+        return out
+
+    return walk(params)
+
+
+def is_quantized(params) -> bool:
+    return any(
+        getattr(leaf, "dtype", None) == jnp.int8
+        for leaf in jax.tree_util.tree_leaves(params)
+    )
+
+
+class QuantDenseGeneral(nn.Module):
+    """Drop-in twin of flax's DenseGeneral for the decode path's three
+    usages (axis=-1 with int or tuple features; axis=(-2,-1) with int
+    features), reading an int8 "kernel" + f32 "kernel_scale" written
+    by quantize_params at the SAME param path. The scale applies to
+    the output's feature axes after the int8-operand dot."""
+
+    features: Union[int, Sequence[int]]
+    axis: Union[int, Sequence[int]] = -1
+    dtype: jnp.dtype = jnp.bfloat16
+    use_bias: bool = True
+
+    @nn.compact
+    def __call__(self, x: jax.Array) -> jax.Array:
+        features = (
+            (self.features,)
+            if isinstance(self.features, int)
+            else tuple(self.features)
+        )
+        axis = (
+            (self.axis,) if isinstance(self.axis, int) else tuple(self.axis)
+        )
+        n_in = len(axis)
+        in_shape = x.shape[-n_in:]
+        kernel = self.param(
+            "kernel",
+            lambda rng: jnp.zeros(in_shape + features, jnp.int8),
+        )
+        # one scale per feature slice (all non-contracted axes) —
+        # matches quantize_params' layout
+        scale = self.param(
+            "kernel_scale",
+            lambda rng: jnp.ones(features, jnp.float32),
+        )
+        contract = (
+            tuple(range(x.ndim - n_in, x.ndim)),  # x's trailing axes
+            tuple(range(n_in)),  # kernel's leading axes
+        )
+        y = jax.lax.dot_general(
+            x.astype(self.dtype), kernel.astype(self.dtype),
+            (contract, ((), ())),
+        )
+        y = (y.astype(jnp.float32) * scale).astype(self.dtype)
+        if self.use_bias:
+            bias = self.param(
+                "bias", lambda rng: jnp.zeros(features, jnp.float32)
+            )
+            y = y + bias.astype(self.dtype)
+        return y
+
+
+def QuantDense(features: int, dtype=jnp.bfloat16, name=None):
+    """flax.linen.Dense twin over an int8 kernel (see
+    QuantDenseGeneral)."""
+    return QuantDenseGeneral(
+        features=features, axis=-1, dtype=dtype, name=name
+    )
+
+
+def quant_head_projection(
+    num_heads: int, head_dim: int, dtype, name: str
+) -> QuantDenseGeneral:
+    """int8 twin of ops.attention.head_projection — identical param
+    path and output shape [..., num_heads, head_dim]."""
+    return QuantDenseGeneral(
+        features=(num_heads, head_dim), axis=-1, dtype=dtype, name=name
+    )
