@@ -873,21 +873,29 @@ def run_extras(on_tpu: bool, n_chips: int, line: dict) -> None:
 
     # importance order: if the driver's budget truncates the run, the
     # artifacts the round is judged on (FLASH_BENCH.json,
-    # MNIST_ACC.json) and the attribution A/Bs come first; the line is
+    # MNIST_ACC.json) come first, then everything NOT YET measured on
+    # hardware (the r4-interactive window measured the resnet
+    # attribution A/Bs, fed, gpt_long, remat, bert_wide, vit and the
+    # seq-1024 decode pair — those re-measure LAST); the line is
     # re-printed by main() after whatever completed. (The BERT
-    # flash-vs-XLA A/B moved into the headline phase, where the winner
+    # flash-vs-XLA A/B lives in the headline phase, where the winner
     # is chosen — main() fills the bert_xla_attention_* fields.)
     if gated:  # kernels + accuracy targets are TPU-only claims
         extra("flash", flash)
         extra("mnist", mnist)
+        # -- unmeasured-as-of-r4-interactive group --
+        extra("resnet_bs128", bs128)
+        extra("gpt_decode_w8", gpt_decode_w8)
+        extra("gpt_decode_w8kv8", gpt_decode_w8kv8)
+        extra("gpt_decode_long", gpt_decode_long)
+        extra("gpt_decode_long_int8", gpt_decode_long_int8)
+        extra("gpt_decode_spec", gpt_decode_spec)
+    extra("fed_u8", fed_u8)
+    if gated:
+        # -- re-measurement group (r4-interactive numbers exist) --
         extra("gpt_long", gpt_long)
         extra("gpt_decode", gpt_decode)
         extra("gpt_decode_int8", gpt_decode_int8)
-        extra("gpt_decode_long", gpt_decode_long)
-        extra("gpt_decode_long_int8", gpt_decode_long_int8)
-        extra("gpt_decode_w8", gpt_decode_w8)
-        extra("gpt_decode_w8kv8", gpt_decode_w8kv8)
-        extra("gpt_decode_spec", gpt_decode_spec)
         extra("gpt_decode_tp", gpt_decode_tp)
         extra("gpt_remat", gpt_remat)
         extra("bert_wide", bert_wide)
@@ -896,9 +904,7 @@ def run_extras(on_tpu: bool, n_chips: int, line: dict) -> None:
     if gated:  # stem A/B only meaningful at the real 224/3-channel shape
         extra("resnet_s2d", s2d)
         extra("resnet_bs512", bs512)
-        extra("resnet_bs128", bs128)
     extra("fed", fed)
-    extra("fed_u8", fed_u8)
     if gated:
         # LAST: this A/B is expected to OOM at seq 4096 (that is the
         # measurement) — a hard abort or fragmented HBM must not cost
