@@ -49,6 +49,11 @@ def main(argv=None) -> int:
         help="after training, greedily decode N tokens from a prompt",
     )
     parser.add_argument(
+        "--weights-int8", action="store_true",
+        help="int8 kernels for --generate (ops/quant.py: one-time "
+        "quantization, half the per-step weights bandwidth)",
+    )
+    parser.add_argument(
         "--kv-int8", action="store_true",
         help="int8 KV cache for --generate (half the per-step cache "
         "HBM traffic decode is bound by; models/gpt.py)",
@@ -191,6 +196,7 @@ def main(argv=None) -> int:
             cfg, state.params, jax.numpy.asarray(prompt),
             max_new_tokens=args.generate, mesh=mesh,
             kv_quant_int8=args.kv_int8,
+            weights_int8=args.weights_int8,
         )
         logger.info("generated: %s", jax.device_get(out)[0].tolist())
     return 0
