@@ -1,0 +1,34 @@
+"""Shared pieces of the controller-side benchmark harnesses
+(pods_ready.py, controller_scale.py) — one copy of the job template
+and the percentile math so the two can't silently diverge."""
+
+from __future__ import annotations
+
+import math
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from tf_operator_tpu.api import k8s, types as t
+
+
+def make_worker_job(name: str, workers: int) -> t.TFJob:
+    job = t.TFJob(metadata=k8s.ObjectMeta(name=name, namespace="default"))
+    job.spec.tf_replica_specs["Worker"] = t.ReplicaSpec(
+        replicas=workers,
+        template=k8s.PodTemplateSpec(
+            spec=k8s.PodSpec(
+                containers=[k8s.Container(name="tensorflow", image="local")]
+            )
+        ),
+    )
+    return job
+
+
+def percentile(sorted_samples, q: float) -> float:
+    """Nearest-rank percentile (ceil(q*n)-1) over an ascending list."""
+    if not sorted_samples:
+        raise ValueError("no samples")
+    rank = max(0, math.ceil(q * len(sorted_samples)) - 1)
+    return sorted_samples[rank]

@@ -1,0 +1,171 @@
+"""Controller scale benchmark: the reference's design point, measured.
+
+The reference publishes exactly one performance statement: a single
+multi-threaded controller should handle O(100) concurrent TFJobs per
+cluster (reference tf_job_design_doc.md:24-26 — the scale assumption
+its non-distributed controller design rests on). This harness applies
+that load to THIS controller and measures it: N jobs created at once
+against the live controller (real watch -> expectations -> workqueue ->
+reconcile path over InMemorySubstrate), a permissive-kubelet thread
+advancing Pending pods, readiness = all pods Running AND the status
+machine marking the job Running.
+
+Usage:  python benchmarks/controller_scale.py [--jobs 100] [--workers 2]
+Prints one JSON line and writes CONTROLLER_SCALE.json at the repo root.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import statistics
+import sys
+import threading
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from benchmarks._common import make_worker_job, percentile
+from tf_operator_tpu.api import k8s, types as t
+from tf_operator_tpu.controller import TFJobController
+from tf_operator_tpu.runtime import InMemorySubstrate
+
+
+def run_burst(jobs: int, workers: int, threadiness: int,
+              timeout: float) -> dict:
+    substrate = InMemorySubstrate()
+    controller = TFJobController(substrate)
+    controller.run(threadiness=threadiness, resync_period=10.0)
+
+    stop = threading.Event()
+
+    def kubelet() -> None:
+        # permissive scheduler+kubelet tick: every Pending pod starts
+        # Running shortly after creation; the measured latency is the
+        # CONTROLLER's (watch, expectations, child creation, status)
+        while not stop.is_set():
+            substrate.run_all_pending()
+            time.sleep(0.005)
+
+    kubelet_thread = threading.Thread(
+        target=kubelet, name="scale-kubelet", daemon=True
+    )
+    kubelet_thread.start()
+
+    names = [f"scale-{i}" for i in range(jobs)]
+    ready_at: dict = {}
+    try:
+        start = time.monotonic()
+        for name in names:
+            substrate.create_job(make_worker_job(name, workers))
+        applied = time.monotonic() - start
+
+        deadline = start + timeout
+        pending = set(names)
+        while pending and time.monotonic() < deadline:
+            # ONE substrate-wide pod list per tick, grouped by the
+            # job-name label: per-pending-job label-filtered lists
+            # would contend on the substrate lock with the very
+            # reconcile workers being measured
+            running_by_job: dict = {}
+            for pod in substrate.list_pods("default", None):
+                if pod.status.phase == k8s.POD_RUNNING:
+                    owner = pod.metadata.labels.get(t.LABEL_JOB_NAME)
+                    running_by_job[owner] = running_by_job.get(owner, 0) + 1
+            now = time.monotonic() - start
+            for name in list(pending):
+                if running_by_job.get(name, 0) != workers:
+                    continue
+                job = substrate.get_job("default", name)
+                if job.has_condition(t.ConditionType.RUNNING):
+                    ready_at[name] = now
+                    pending.discard(name)
+            time.sleep(0.02)
+        if pending:
+            raise TimeoutError(
+                f"{len(pending)} of {jobs} jobs not ready within "
+                f"{timeout}s (e.g. {sorted(pending)[:3]})"
+            )
+        all_ready = max(ready_at.values())
+
+        # teardown: delete every job and confirm no pods remain. The
+        # substrate's cascade delete is synchronous, so this measures
+        # delete-call + watch-notify throughput, NOT an async GC wait
+        # — named accordingly
+        teardown_start = time.monotonic()
+        for name in names:
+            substrate.delete_job("default", name)
+        if substrate.list_pods("default", None):
+            raise RuntimeError("pods survived cascade delete")
+        teardown_seconds = time.monotonic() - teardown_start
+    finally:
+        stop.set()
+        controller.stop()
+        kubelet_thread.join(timeout=5)
+
+    latencies = sorted(ready_at.values())
+    p50 = statistics.median(latencies)
+    p95 = percentile(latencies, 0.95)
+    return {
+        "jobs": jobs,
+        "workers_per_job": workers,
+        "pods_total": jobs * workers,
+        "threadiness": threadiness,
+        "all_ready_seconds": round(all_ready, 3),
+        "apply_seconds": round(applied, 3),
+        "per_job_ready_p50": round(p50, 3),
+        "per_job_ready_p95": round(p95, 3),
+        "teardown_seconds": round(teardown_seconds, 3),
+        "jobs_per_second_to_ready": round(jobs / all_ready, 1),
+    }
+
+
+def main() -> None:
+    parser = argparse.ArgumentParser()
+    parser.add_argument("--jobs", type=int, default=100)
+    parser.add_argument("--workers", type=int, default=2)
+    parser.add_argument("--threadiness", type=int, default=4)
+    parser.add_argument("--timeout", type=float, default=300.0)
+    parser.add_argument(
+        "--headroom", type=int, default=500, metavar="N",
+        help="after the design-point burst, repeat at N jobs on a "
+        "fresh substrate to show how far past O(100) the controller "
+        "holds (0 = skip)",
+    )
+    parser.add_argument("--out", default=None)
+    args = parser.parse_args()
+
+    burst = run_burst(
+        args.jobs, args.workers, args.threadiness, args.timeout
+    )
+    result = {
+        "metric": "controller_scale_all_ready_seconds",
+        "value": burst["all_ready_seconds"],
+        "unit": "seconds",
+        **burst,
+        "design_point": (
+            "reference tf_job_design_doc.md:24-26: one multi-threaded "
+            "controller is expected to handle O(100) concurrent TFJobs; "
+            "this run applies that load in one burst against the live "
+            "controller over the in-memory substrate (no cloud "
+            "scheduler in the path — the number is the controller's "
+            "own contribution)"
+        ),
+    }
+    if args.headroom:
+        result["headroom"] = run_burst(
+            args.headroom, args.workers, args.threadiness, args.timeout
+        )
+    line = json.dumps(result)
+    print(line)
+    out = args.out or os.path.join(
+        os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+        "CONTROLLER_SCALE.json",
+    )
+    with open(out, "w") as handle:
+        handle.write(line + "\n")
+
+
+if __name__ == "__main__":
+    main()

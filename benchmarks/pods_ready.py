@@ -24,29 +24,17 @@ import time
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
+from benchmarks._common import make_worker_job, percentile
 from tf_operator_tpu.api import k8s, types as t
 from tf_operator_tpu.controller import TFJobController
 from tf_operator_tpu.runtime import InMemorySubstrate
 from tf_operator_tpu.runtime.process_kubelet import ProcessKubelet
 
 
-def make_job(name: str, workers: int) -> t.TFJob:
-    job = t.TFJob(metadata=k8s.ObjectMeta(name=name, namespace="default"))
-    job.spec.tf_replica_specs["Worker"] = t.ReplicaSpec(
-        replicas=workers,
-        template=k8s.PodTemplateSpec(
-            spec=k8s.PodSpec(
-                containers=[k8s.Container(name="tensorflow", image="local")]
-            )
-        ),
-    )
-    return job
-
-
 def measure_one(substrate, name: str, workers: int, timeout: float = 90.0) -> float:
     """Seconds from create_job to every pod Running."""
     start = time.monotonic()
-    substrate.create_job(make_job(name, workers))
+    substrate.create_job(make_worker_job(name, workers))
     deadline = start + timeout
     while time.monotonic() < deadline:
         pods = substrate.list_pods("default", t.gen_labels(name))
@@ -82,7 +70,7 @@ def main() -> None:
 
     samples.sort()
     p50 = statistics.median(samples)
-    p95 = samples[min(len(samples) - 1, int(round(0.95 * len(samples))) )]
+    p95 = percentile(samples, 0.95)
     result = {
         "metric": "tfjob_pods_ready_p50_seconds",
         "value": round(p50, 3),

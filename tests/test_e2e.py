@@ -554,3 +554,34 @@ class TestPodsReadyHarness:
         assert result["metric"] == "tfjob_pods_ready_p50_seconds"
         assert 0 < result["value"] < 90.0
         assert result["p95"] >= result["value"]
+
+
+class TestControllerScaleHarness:
+    """The controller scale harness (benchmarks/controller_scale.py —
+    the reference's O(100)-concurrent-jobs design point) must run
+    end-to-end: burst-apply, per-job readiness, GC teardown."""
+
+    def test_harness_small_burst(self, tmp_path):
+        import os
+        import subprocess
+        import sys
+
+        out = tmp_path / "scale.json"
+        repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+        proc = subprocess.run(
+            [sys.executable,
+             os.path.join(repo, "benchmarks", "controller_scale.py"),
+             "--jobs", "8", "--workers", "2", "--headroom", "0",
+             "--out", str(out)],
+            capture_output=True, text=True, timeout=120,
+        )
+        assert proc.returncode == 0, proc.stderr
+        result = json.loads(out.read_text())
+        assert result["metric"] == "controller_scale_all_ready_seconds"
+        assert result["pods_total"] == 16
+        assert 0 < result["value"] < 60.0
+        assert result["per_job_ready_p95"] >= result["per_job_ready_p50"]
+        # cascade delete is synchronous, so at 8 jobs this can round
+        # to 0.0 — presence and non-negativity are the contract
+        assert result["teardown_seconds"] >= 0
+        assert "headroom" not in result
