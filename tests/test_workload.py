@@ -441,6 +441,28 @@ class TestTraining:
         )
         np.testing.assert_allclose(got, ref, atol=1e-5, rtol=1e-5)
 
+    def test_uint8_survives_place_batch_and_trains(self, devices8):
+        """The wire contract end-to-end: place_batch must ship uint8
+        bytes unchanged (a silent upcast would quadruple the
+        host->device transfer the format exists to cut), and a train
+        step over the placed uint8 batch must run (the model
+        normalizes on device)."""
+        model = resnet_lib.ResNet(
+            stage_sizes=(1, 1), num_classes=10, width=8,
+            dtype=jnp.float32,
+        )
+        trainer = Trainer(
+            model, classification_task(model), optax.sgd(0.1),
+            mesh=build_mesh(MeshConfig(dp=8)), rules=(),
+        )
+        placed = trainer.place_batch(
+            resnet_lib.synthetic_uint8_batch(0, 8, 32, 10)
+        )
+        assert placed["image"].dtype == jnp.uint8
+        state = trainer.init(jax.random.PRNGKey(0), placed)
+        _, metrics = trainer.step(state, placed)
+        assert np.isfinite(metrics["loss"])
+
     def test_vit_uint8_input_matches_normalized_f32(self):
         """ViT honors the same uint8 wire contract as ResNet."""
         import dataclasses as _dc
