@@ -439,3 +439,71 @@ class TestWeightsInt8Serving:
             assert health["weights_int8"] is True
         finally:
             srv.shutdown()
+
+
+class TestQuantizedExport:
+    """serve/export.py: train checkpoint -> params-only int8 artifact
+    -> served with the layout auto-detected and weights_int8
+    auto-enabled."""
+
+    def test_export_and_serve_round_trip(self, tmp_path):
+        import dataclasses
+
+        import optax
+
+        from tf_operator_tpu.ops.quant import is_quantized
+        from tf_operator_tpu.serve import export as export_mod
+        from tf_operator_tpu.train import Trainer, causal_lm_task
+
+        cfg = dataclasses.replace(gpt_lib.GPT_TINY, dtype=jnp.float32)
+        ckpt_dir = str(tmp_path / "train-ckpt")
+        model = gpt_lib.GPT(cfg)
+        trainer = Trainer(
+            model, causal_lm_task(model), optax.adamw(1e-3),
+            checkpoint_dir=ckpt_dir,
+        )
+        rng = jax.random.PRNGKey(0)
+        # batch divisible by the conftest's 8-device default mesh
+        sample = gpt_lib.synthetic_batch(rng, 8, 16, cfg)
+        state = trainer.init(rng, sample)
+        state, _ = trainer.step(state, sample)
+        trainer.save(state)
+
+        out = str(tmp_path / "serving-int8")
+        manifest = export_mod.export(
+            lambda: (state.params, int(state.step)), out, "tiny"
+        )
+        # dropped-optimizer + int8 kernels: the artifact must be well
+        # under half the f32 params bytes
+        assert manifest["params_bytes"] < 0.6 * manifest[
+            "source_params_bytes"
+        ]
+        assert export_mod.is_exported_dir(out)
+
+        params, loaded_manifest = export_mod.load_exported(out)
+        assert loaded_manifest["step"] == int(state.step)
+        assert is_quantized(params)
+
+        # serve from the artifact WITHOUT passing weights_int8: the
+        # pre-quantized tree must auto-enable the flag
+        srv = make_server(cfg, params, model_name="gpt-exported")
+        thread = threading.Thread(target=srv.serve_forever, daemon=True)
+        thread.start()
+        try:
+            port = srv.server_address[1]
+            status, body = post(port, {
+                "input_ids": [[1, 2, 3, 4]], "max_new_tokens": 5,
+            })
+            assert status == 200
+            assert len(body["tokens"][0]) == 9
+            assert srv.state.weights_int8 is True
+            # and the tokens match direct int8-weights decode
+            expect = gpt_lib.generate(
+                cfg, params, jnp.asarray([[1, 2, 3, 4]]),
+                max_new_tokens=5, weights_int8=True,
+            )
+            np.testing.assert_array_equal(
+                np.asarray(body["tokens"]), np.asarray(expect)
+            )
+        finally:
+            srv.shutdown()

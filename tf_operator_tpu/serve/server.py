@@ -49,6 +49,8 @@ import sys
 import threading
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 
+from . import export as export_mod
+
 logger = logging.getLogger("tf_operator_tpu.serve")
 
 MAX_BATCH = 64
@@ -361,12 +363,18 @@ def make_server(
             "dummy rows) defeats the uniform-length speculative gate; "
             "pick the one that fits the traffic"
         )
-    if weights_int8:
+    from ..ops.quant import is_quantized, quantize_params
+
+    if is_quantized(params) and not weights_int8:
+        # a pre-quantized tree (serve/export.py artifact) through the
+        # normal Dense modules would read int8 kernels as weights —
+        # auto-enable the flag instead of failing downstream
+        logger.info("params are pre-quantized: enabling weights_int8")
+        weights_int8 = True
+    if weights_int8 and not is_quantized(params):
         # ONE quantization at load (ops/quant.py): every decode then
         # reads int8 kernels; generate(weights_int8=True) detects the
         # already-quantized tree and skips re-transforming per request
-        from ..ops.quant import quantize_params
-
         params = quantize_params(params)
     state = _State(
         cfg, params, kv_quant_int8, model_name, max_new_cap,
@@ -431,7 +439,28 @@ def main(argv=None) -> int:
 
     cfg = gpt_lib.GPT_TINY if args.preset == "tiny" else gpt_lib.GPT_SMALL
     rng = jax.random.PRNGKey(0)
-    if args.checkpoint_dir:
+    if args.checkpoint_dir and export_mod.is_exported_dir(
+        args.checkpoint_dir
+    ):
+        # params-only quantized serving artifact (serve/export.py):
+        # no TrainState target, no per-load quantization
+        params, manifest = export_mod.load_exported(args.checkpoint_dir)
+        exported_preset = manifest.get("preset")
+        if exported_preset and exported_preset != args.preset:
+            # a mismatch would otherwise fail per-request, deep in
+            # flax apply, as a cryptic 500 — refuse at startup instead
+            raise SystemExit(
+                f"exported artifact was built for --preset "
+                f"{exported_preset!r} but the server was started with "
+                f"--preset {args.preset!r}"
+            )
+        logger.info(
+            "serving exported step-%d artifact (%.1fMB params, "
+            "quantized=%s)", manifest.get("step", -1),
+            manifest.get("params_bytes", 0) / 1e6,
+            manifest.get("quantized"),
+        )
+    elif args.checkpoint_dir:
         import optax
 
         from ..train import Trainer, causal_lm_task
