@@ -507,3 +507,67 @@ class TestQuantizedExport:
             )
         finally:
             srv.shutdown()
+
+
+class TestBeamServing:
+    """num_beams in /generate: best beam keeps the tokens schema;
+    all beams + scores ride alongside."""
+
+    @pytest.fixture(scope="class")
+    def beam_server(self):
+        import dataclasses
+
+        cfg = dataclasses.replace(gpt_lib.GPT_TINY, dtype=jnp.float32)
+        params = gpt_lib.GPT(cfg).init(
+            jax.random.PRNGKey(0), jnp.zeros((1, 8), jnp.int32)
+        )["params"]
+        srv = make_server(cfg, params, model_name="gpt-beam")
+        thread = threading.Thread(target=srv.serve_forever, daemon=True)
+        thread.start()
+        try:
+            yield cfg, params, srv.server_address[1]
+        finally:
+            srv.shutdown()
+
+    def test_beams_sorted_best_first_and_schema(self, beam_server):
+        cfg, params, port = beam_server
+        status, body = post(port, {
+            "input_ids": [[1, 2, 3, 4], [5, 6, 7, 8]],
+            "max_new_tokens": 5, "num_beams": 3,
+        })
+        assert status == 200
+        assert len(body["beams"][0]) == 3
+        scores = body["beam_scores"]
+        for row in scores:
+            assert row == sorted(row, reverse=True)
+        assert body["tokens"][0] == body["beams"][0][0]
+        expect, _ = gpt_lib.beam_search(
+            cfg, params, jnp.asarray([[1, 2, 3, 4], [5, 6, 7, 8]]),
+            max_new_tokens=5, num_beams=3,
+        )
+        np.testing.assert_array_equal(
+            np.asarray(body["beams"]), np.asarray(expect)
+        )
+
+    def test_beam_validation(self, beam_server):
+        _, _, port = beam_server
+        status, body = post_err(port, {
+            "input_ids": [[1, 2]], "max_new_tokens": 2,
+            "num_beams": 2, "temperature": 0.7,
+        })
+        assert status == 400 and "greedy" in body["error"]
+        status, body = post_err(port, {
+            "input_ids": [[1, 2, 3], [4]], "max_new_tokens": 2,
+            "num_beams": 2,
+        })
+        assert status == 400 and "uniform" in body["error"]
+        status, body = post_err(port, {
+            "input_ids": [[1, 2]], "max_new_tokens": 2, "num_beams": 99,
+        })
+        assert status == 400 and "num_beams" in body["error"]
+        # the device admission cap bounds the batch x beams PRODUCT
+        status, body = post_err(port, {
+            "input_ids": [[1, 2]] * 16, "max_new_tokens": 2,
+            "num_beams": 8,
+        })
+        assert status == 400 and "admission cap" in body["error"]

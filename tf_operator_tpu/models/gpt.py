@@ -1024,3 +1024,134 @@ def generate_speculative(
         kv_quant_int8=kv_quant_int8, weights_int8=weights_int8,
     )
     return run(params, prompt)
+
+
+# -- beam search -------------------------------------------------------------
+
+
+@functools.lru_cache(maxsize=32)
+def _compiled_beam_search(
+    cfg: GPTConfig, batch: int, prompt_len: int, total: int,
+    num_beams: int, kv_quant_int8: bool = False,
+    weights_int8: bool = False,
+):
+    """One compiled beam-search program per (config, shape). Beams ride
+    the batch axis ([batch * num_beams] rows) through the SAME
+    GPTDecodeStep the greedy scan uses; each step re-indexes the KV
+    cache by the surviving beams' parents (a batched gather — the
+    classic beam reorder) and extends scores with log-softmax
+    log-probabilities."""
+    beams = num_beams
+    model = GPTDecodeStep(
+        cfg, cache_len=total, kv_quant_int8=kv_quant_int8,
+        weights_int8=weights_int8,
+    )
+    prefill_model = GPTPrefill(
+        cfg, cache_len=total, kv_quant_int8=kv_quant_int8,
+        weights_int8=weights_int8,
+    )
+    @jax.jit
+    def run(params, prompt):
+        # prefill ONCE at batch width, then repeat each cache row
+        # beams times (every beam starts from the identical prompt
+        # state; row b*beams+k is (batch b, beam k) from here on) —
+        # prefilling at batch*beams would just recompute the same
+        # prompt forward beams times
+        logits, updates = prefill_model.apply(
+            {"params": params}, prompt, mutable=["cache"],
+        )
+        cache = jax.tree_util.tree_map(
+            lambda c: jnp.repeat(c, beams, axis=0), updates["cache"]
+        )
+        logp0 = jax.nn.log_softmax(
+            logits.astype(jnp.float32), axis=-1
+        )  # [batch, V] — identical for every beam
+        # init: top-num_beams FIRST tokens per batch row
+        scores0, tok0 = jax.lax.top_k(logp0, beams)  # [batch, beams]
+        buf = jnp.zeros((batch, beams, total), jnp.int32)
+        buf = buf.at[:, :, :prompt_len].set(prompt[:, None, :])
+        buf = buf.at[:, :, prompt_len].set(tok0)
+
+        def step(carry, index):
+            cache, buf, scores, last = carry
+            flat_last = last.reshape(batch * beams)
+            logits, updates = model.apply(
+                {"params": params, "cache": cache}, flat_last, index,
+                mutable=["cache"],
+            )
+            cache = updates["cache"]
+            logp = jax.nn.log_softmax(
+                logits.astype(jnp.float32), axis=-1
+            ).reshape(batch, beams, -1)
+            vocab = logp.shape[-1]
+            candidates = scores[:, :, None] + logp  # [batch, beams, V]
+            flat = candidates.reshape(batch, beams * vocab)
+            new_scores, idx = jax.lax.top_k(flat, beams)  # [batch, beams]
+            parent = idx // vocab  # which beam each winner extends
+            token = idx % vocab
+            # reorder histories + cache rows by parent
+            buf = jnp.take_along_axis(buf, parent[:, :, None], axis=1)
+            buf = buf.at[:, :, index + 1].set(token)
+            flat_parent = (
+                jnp.arange(batch)[:, None] * beams + parent
+            ).reshape(batch * beams)
+            cache = jax.tree_util.tree_map(
+                lambda c: c[flat_parent], cache
+            )
+            return (cache, buf, new_scores, token), ()
+
+        carry = (cache, buf, scores0, tok0)
+        if total - 1 > prompt_len:
+            carry, _ = jax.lax.scan(
+                step, carry, jnp.arange(prompt_len, total - 1)
+            )
+        _, buf, scores, _ = carry
+        return buf, scores
+
+    return run
+
+
+def beam_search(
+    cfg: GPTConfig,
+    params,
+    prompt: jax.Array,
+    max_new_tokens: int,
+    num_beams: int = 4,
+    kv_quant_int8: bool = False,
+    weights_int8: bool = False,
+):
+    """Beam-search decode: returns (sequences [b, num_beams, p+new],
+    scores [b, num_beams]) sorted best-first, where score is the sum of
+    log-probabilities of the generated tokens under the model. Fixed
+    output length (this framework's vocabularies carry no EOS token),
+    so no length normalization is applied — all candidates have equal
+    length.
+
+    num_beams=1 reduces exactly to greedy decode. The whole search is
+    one jitted lax.scan (compiled once per config/shape); beams ride
+    the batch axis through the same KV-cached decode step as
+    generate(), and both int8 flags compose. Net-new capability — the
+    reference ships no data plane (SURVEY.md §2)."""
+    batch, prompt_len = prompt.shape
+    total = prompt_len + max_new_tokens
+    if max_new_tokens < 1:
+        raise ValueError(
+            f"max_new_tokens must be >= 1, got {max_new_tokens}"
+        )
+    if total > cfg.max_seq_len:
+        raise ValueError(
+            f"prompt+new = {total} exceeds max_seq_len {cfg.max_seq_len}"
+        )
+    if num_beams < 1:
+        raise ValueError(f"num_beams must be >= 1, got {num_beams}")
+    if num_beams > cfg.vocab_size:
+        raise ValueError(
+            f"num_beams {num_beams} exceeds vocab {cfg.vocab_size}"
+        )
+    if weights_int8:
+        params = _ensure_quantized(params)
+    run = _compiled_beam_search(
+        cfg, batch, prompt_len, total, int(num_beams),
+        kv_quant_int8=kv_quant_int8, weights_int8=weights_int8,
+    )
+    return run(params, prompt)

@@ -58,6 +58,8 @@ MAX_BATCH = 64
 # _device_decode — one constant, so the gate can never admit a prompt
 # the drafter rejects
 _SPEC_NGRAM = 2
+# beams multiply the decode batch (and the KV cache) num_beams-fold
+MAX_BEAMS = 8
 
 
 class _State:
@@ -166,16 +168,38 @@ def _validate(state: _State, body):
         not 0.0 < float(top_p) <= 1.0
     ):
         return _bad("top_p must be in (0, 1]")
-    return prompt, lens, new, float(temperature), seed, top_k, float(top_p)
+    num_beams = body.get("num_beams", 1)
+    if not isinstance(num_beams, int) or isinstance(num_beams, bool) or (
+        not 1 <= num_beams <= MAX_BEAMS
+    ):
+        return _bad(f"num_beams must be an int in [1, {MAX_BEAMS}]")
+    if num_beams > 1:
+        if temperature != 0 or top_k != 0 or float(top_p) != 1.0:
+            return _bad("num_beams > 1 requires greedy settings "
+                        "(temperature 0, no top_k/top_p)")
+        if any(length != width for length in lens):
+            return _bad("num_beams > 1 requires uniform-length prompts")
+        if len(ids) * num_beams > MAX_BATCH:
+            # beams ride the batch axis on device: the PRODUCT is what
+            # the chip sees, and it must honor the same admission cap
+            # as the widest greedy batch
+            return _bad(
+                f"batch {len(ids)} x num_beams {num_beams} exceeds "
+                f"the device admission cap {MAX_BATCH}"
+            )
+    return (prompt, lens, new, float(temperature), seed, top_k,
+            float(top_p), num_beams)
 
 
 def _device_decode(
     state: _State, prompt, lens, new, temperature=0.0, rng=None,
-    top_k=0, top_p=1.0,
+    top_k=0, top_p=1.0, num_beams=1,
 ):
-    """THE decode-and-account block, shared by the inline path and the
-    batcher's decode_fn so locking/timing/metrics can't diverge.
-    Returns host chains [b, width + new]."""
+    """THE decode-and-account block, shared by the inline path, the
+    batcher's decode_fn, AND the beam path so locking/timing/metrics
+    can't diverge. Returns host chains [b, width + new] — or, for
+    num_beams > 1, the host (sequences, scores) pair beam_search
+    yields."""
     import time
 
     import jax
@@ -189,14 +213,22 @@ def _device_decode(
     # models/gpt.py generate_speculative. Everything else falls back.
     lens_list = list(lens)
     use_spec = (
-        state.speculative
+        num_beams == 1
+        and state.speculative
         and temperature == 0.0
         and all(length == prompt.shape[1] for length in lens_list)
         and prompt.shape[1] >= _SPEC_NGRAM
     )
     with state.lock:  # decode saturates the chip; serialize
         start = time.perf_counter()
-        if use_spec:
+        if num_beams > 1:
+            out = gpt_lib.beam_search(
+                state.cfg, state.params, prompt, max_new_tokens=new,
+                num_beams=num_beams,
+                kv_quant_int8=state.kv_quant_int8,
+                weights_int8=state.weights_int8,
+            )
+        elif use_spec:
             out = gpt_lib.generate_speculative(
                 state.cfg, state.params, prompt, max_new_tokens=new,
                 ngram=_SPEC_NGRAM,
@@ -269,8 +301,36 @@ def DecodeHandlerFactory(state: _State):
                 with state.lock:  # += races other request threads
                     state.request_errors += 1
                 return self._reply(*result)
-            prompt, lens, new, temperature, seed, top_k, top_p = result
+            (prompt, lens, new, temperature, seed, top_k, top_p,
+             num_beams) = result
             import jax
+
+            if num_beams > 1:
+                # beam search: through THE shared decode-and-account
+                # block (never the greedy batcher — beams already
+                # multiply the device batch num_beams-fold);
+                # greedy-only and uniform-length-only per _validate
+                try:
+                    seqs, scores = _device_decode(
+                        state, prompt, lens, new, num_beams=num_beams,
+                    )
+                except Exception as err:  # noqa: BLE001 — same contract
+                    with state.lock:
+                        state.request_errors += 1
+                    return self._reply(500, {
+                        "error": f"decode failed: "
+                        f"{type(err).__name__}: {err}"[:300]
+                    })
+                with state.lock:
+                    state.decodes += 1
+                    state.tokens_generated += new * len(lens)
+                return self._reply(200, {
+                    # schema-compatible: tokens = each row's BEST beam
+                    "tokens": [row[0].tolist() for row in seqs],
+                    "beams": [row.tolist() for row in seqs],
+                    "beam_scores": [row.tolist() for row in scores],
+                    "prompt_lens": lens,
+                })
 
             greedy = temperature == 0.0 and top_k == 0 and top_p == 1.0
             if state.batcher is not None and greedy:
