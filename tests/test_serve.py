@@ -549,6 +549,17 @@ class TestBeamServing:
             np.asarray(body["beams"]), np.asarray(expect)
         )
 
+    def test_decode_client_beams(self, beam_server):
+        from tf_operator_tpu.serve import DecodeClient
+
+        _, _, port = beam_server
+        client = DecodeClient(f"http://127.0.0.1:{port}")
+        beams, scores = client.beam_search(
+            [[1, 2, 3, 4]], max_new_tokens=3, num_beams=2
+        )
+        assert len(beams[0]) == 2 and len(scores[0]) == 2
+        assert scores[0][0] >= scores[0][1]
+
     def test_beam_validation(self, beam_server):
         _, _, port = beam_server
         status, body = post_err(port, {

@@ -71,6 +71,21 @@ class DecodeClient:
         }))
         return body["tokens"]
 
+    def beam_search(
+        self,
+        input_ids: List[List[int]],
+        max_new_tokens: int = 16,
+        num_beams: int = 4,
+    ):
+        """(beams, beam_scores) per row, best-first — uniform-length
+        prompts only (the server enforces it)."""
+        body = json.loads(self._request("/generate", {
+            "input_ids": input_ids,
+            "max_new_tokens": max_new_tokens,
+            "num_beams": num_beams,
+        }))
+        return body["beams"], body["beam_scores"]
+
     def healthy(self) -> dict:
         return json.loads(self._request("/healthz"))
 
