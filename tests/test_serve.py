@@ -376,16 +376,32 @@ class TestSpeculativeServing:
         )
         assert srv.state.speculative_decodes >= 1
 
-    def test_sampled_falls_back(self, spec_server):
+    def test_sampled_routes_through_spec_and_is_seed_deterministic(
+        self, spec_server
+    ):
+        # sampled uniform-length requests ALSO take the speculative
+        # path (distribution-exact rejection sampling; the stream
+        # differs from a non-speculative server's but stays
+        # deterministic per seed)
         _, _, srv = spec_server
         port = srv.server_address[1]
         before = srv.state.speculative_decodes
-        status, _ = post(port, {
+        status, a = post(port, {
             "input_ids": [[1, 2, 3, 4]], "max_new_tokens": 4,
             "temperature": 0.8, "seed": 1,
         })
         assert status == 200
-        assert srv.state.speculative_decodes == before
+        assert srv.state.speculative_decodes == before + 1
+        _, b = post(port, {
+            "input_ids": [[1, 2, 3, 4]], "max_new_tokens": 4,
+            "temperature": 0.8, "seed": 1,
+        })
+        assert a["tokens"] == b["tokens"]
+        _, c = post(port, {
+            "input_ids": [[1, 2, 3, 4]], "max_new_tokens": 4,
+            "temperature": 0.8, "seed": 2,
+        })
+        assert c["tokens"] != a["tokens"]
 
     def test_ragged_falls_back(self, spec_server):
         _, _, srv = spec_server

@@ -885,18 +885,66 @@ def _ngram_draft(
     return jnp.where((p_star >= 0)[:, None], cont, jnp.tile(last, (1, k)))
 
 
+def _accept_or_resample(
+    p: jax.Array, d: jax.Array, u: jax.Array, rng: jax.Array
+) -> jax.Array:
+    """One position of deterministic-draft speculative SAMPLING.
+
+    p: [b, V] target probabilities; d: [b] proposed tokens (d < 0
+    means "no draft" — sample from p directly, the bonus-token case);
+    u: [b] uniform draws. Accept d with probability p[d]; otherwise
+    sample from p with d zeroed and renormalized. Because the draft
+    distribution is a point mass, this is the speculative-sampling
+    rejection rule specialized to q = delta_d, and the returned token
+    is distributed EXACTLY as p (pinned by
+    tests/test_gpt.py::TestSpeculativeSampling::test_acceptance_lemma).
+    """
+    batch, vocab = p.shape
+    p_draft = jnp.take_along_axis(
+        p, jnp.clip(d, 0, vocab - 1)[:, None], axis=1
+    )[:, 0]
+    no_draft = d < 0
+    accept = (u < p_draft) & ~no_draft
+    # zero the draft's mass for the resample (skipped when no draft);
+    # the resample target has positive mass whenever it is reachable:
+    # a reject implies u >= p[d], so p[d] < 1 and 1 - p[d] > 0
+    zero_at = jnp.where(no_draft, -1, d)
+    target = jnp.where(
+        jnp.arange(vocab)[None, :] == zero_at[:, None], 0.0, p
+    )
+    target = target / jnp.clip(
+        jnp.sum(target, axis=-1, keepdims=True), 1e-9, None
+    )
+    sampled = jax.random.categorical(
+        rng, jnp.log(target + 1e-30), axis=-1
+    ).astype(jnp.int32)
+    return jnp.where(accept, d, sampled)
+
+
 @functools.lru_cache(maxsize=32)
 def _compiled_spec_decode(
     cfg: GPTConfig, batch: int, prompt_len: int, total: int,
     draft_k: int, ngram: int, kv_quant_int8: bool = False,
-    weights_int8: bool = False,
+    weights_int8: bool = False, temperature: float = 0.0,
+    top_k: int = 0, top_p: float = 1.0,
 ):
     """One compiled speculative-decode program per (config, shape):
     batched prefill, then a lax.while_loop of draft -> verify ->
-    commit rounds. Greedy-exact: every committed token is the argmax
-    of the model's logits given the committed prefix, so the output
+    commit rounds.
+
+    temperature == 0 (greedy): every committed token is the argmax of
+    the model's logits given the committed prefix, so the output
     equals generate(temperature=0)'s up to floating-point program
-    equivalence between the block-verify and one-token forwards."""
+    equivalence between the block-verify and one-token forwards.
+
+    temperature > 0 (speculative SAMPLING): each draft position
+    accepts with probability p(draft) under the tempered/filtered
+    distribution; the first rejected position resamples from p with
+    the draft zeroed (exact — see _accept_or_resample), and a round
+    where every draft survives samples the bonus token from the
+    (k+1)-th distribution. Committed tokens are therefore distributed
+    exactly as plain sampled decode's, with fresh randomness per
+    committed position."""
     # buf AND cache are wider than `total`: a verify round entered at
     # index = total - 2 writes its k+1 candidate tokens/KV at
     # index(+1) .. index+k(+1) <= total + k - 1. A `total`-sized cache
@@ -916,12 +964,36 @@ def _compiled_spec_decode(
         weights_int8=weights_int8,
     )
 
+    sampled = temperature > 0.0
+
+    def tempered_probs(logits):
+        return jax.nn.softmax(
+            _filter_logits(
+                logits.astype(jnp.float32) / temperature, top_k, top_p
+            ),
+            axis=-1,
+        )
+
     @jax.jit
-    def run(params, prompt):
+    def run(params, prompt, rng):
         logits, updates = prefill_model.apply(
             {"params": params}, prompt, mutable=["cache"]
         )
-        first_new = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+        if sampled:
+            rng, first_rng = jax.random.split(rng)
+            # categorical takes unnormalized logits — the same
+            # formulation as _compiled_decode's sample(), no
+            # softmax+log round-trip
+            first_new = jax.random.categorical(
+                first_rng,
+                _filter_logits(
+                    logits.astype(jnp.float32) / temperature,
+                    top_k, top_p,
+                ),
+                axis=-1,
+            ).astype(jnp.int32)
+        else:
+            first_new = jnp.argmax(logits, axis=-1).astype(jnp.int32)
         buf = jnp.concatenate(
             [
                 prompt.astype(jnp.int32),
@@ -930,14 +1002,14 @@ def _compiled_spec_decode(
             ],
             axis=1,
         )
-        state = (buf, updates["cache"], jnp.int32(prompt_len))
+        state = (buf, updates["cache"], jnp.int32(prompt_len), rng)
 
         def cond(state):
-            _, _, index = state
+            _, _, index, _ = state
             return index < total - 1
 
         def body(state):
-            buf, cache, index = state
+            buf, cache, index, rng = state
             drafts = _ngram_draft(buf, index, draft_k, ngram)  # [b, k]
             cur = jax.vmap(
                 lambda row: jax.lax.dynamic_slice(row, (index,), (1,))
@@ -947,22 +1019,67 @@ def _compiled_spec_decode(
                 {"params": params, "cache": cache}, block, index,
                 mutable=["cache"],
             )
-            greedy = jnp.argmax(logits, axis=-1).astype(jnp.int32)
-            # per-row count of leading drafts the model agrees with;
-            # commit the batch-min so the cache index stays scalar
-            ok = (greedy[:, :draft_k] == drafts).astype(jnp.int32)
+            if not sampled:
+                greedy = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+                # per-row count of leading drafts the model agrees
+                # with; commit the batch-min so the cache index stays
+                # scalar
+                ok = (greedy[:, :draft_k] == drafts).astype(jnp.int32)
+                accepted = jnp.sum(jnp.cumprod(ok, axis=1), axis=1)
+                commit = jnp.min(accepted)
+                # greedy[:, :commit+1] are all model-true given the
+                # committed prefix (drafts agree up to commit in every
+                # row); tokens past commit+1 are provisional and will
+                # be overwritten before index ever reaches them
+                buf = jax.lax.dynamic_update_slice(
+                    buf, greedy, (0, index + 1)
+                )
+                return (buf, updates["cache"], index + commit + 1, rng)
+
+            probs = tempered_probs(logits)  # [b, k+1, V]
+            rng, u_rng, fix_rng = jax.random.split(rng, 3)
+            u = jax.random.uniform(u_rng, (batch, draft_k))
+            p_draft = jnp.take_along_axis(
+                probs[:, :draft_k], drafts[..., None], axis=2
+            )[..., 0]  # [b, k]
+            ok = (u < p_draft).astype(jnp.int32)
             accepted = jnp.sum(jnp.cumprod(ok, axis=1), axis=1)  # [b]
             commit = jnp.min(accepted)
-            # greedy[:, :commit+1] are all model-true given the
-            # committed prefix (drafts agree up to commit in every
-            # row); tokens past commit+1 are provisional and will be
-            # overwritten before index ever reaches them
-            buf = jax.lax.dynamic_update_slice(
-                buf, greedy, (0, index + 1)
+            # the token at position index+commit+1: rows that accepted
+            # their draft there keep it; the batch-min rejecting rows
+            # resample from the zeroed-renormalized distribution; a
+            # full-accept round (commit == k) samples the BONUS token
+            # from the (k+1)-th distribution for every row (d = -1)
+            p_at = jax.lax.dynamic_index_in_dim(
+                probs, commit, axis=1, keepdims=False
+            )  # [b, V]
+            d_pad = jnp.concatenate(
+                [drafts, jnp.full((batch, 1), -1, jnp.int32)], axis=1
             )
-            return (buf, updates["cache"], index + commit + 1)
+            d_at = jax.lax.dynamic_index_in_dim(
+                d_pad, commit, axis=1, keepdims=False
+            )  # [b]; -1 on the bonus round
+            u_at = jax.lax.dynamic_index_in_dim(
+                jnp.concatenate([u, jnp.ones((batch, 1))], axis=1),
+                commit, axis=1, keepdims=False,
+            )  # padded 1.0 on the bonus round: never "accepts" the pad
+            # one rule covers every row class: a row that accepted its
+            # draft at `commit` has u_at < p(d) and gets d back; the
+            # batch-min rejecting rows resample; the bonus round
+            # (d_at = -1) samples from the (k+1)-th distribution
+            tok_commit = _accept_or_resample(p_at, d_at, u_at, fix_rng)
+            # committed tokens j < commit are the drafts every row
+            # accepted; position commit carries tok_commit; later
+            # slots hold provisional drafts, overwritten before use
+            cand = jnp.where(
+                jnp.arange(draft_k + 1)[None, :] == commit,
+                tok_commit[:, None], d_pad,
+            )
+            cand = jnp.where(cand < 0, 0, cand).astype(jnp.int32)
+            buf = jax.lax.dynamic_update_slice(buf, cand, (0, index + 1))
+            return (buf, updates["cache"], index + commit + 1, rng)
 
-        buf, _, _ = jax.lax.while_loop(cond, body, state)
+        buf, _, _, _ = jax.lax.while_loop(cond, body, state)
         return buf[:, :total]
 
     return run
@@ -977,6 +1094,10 @@ def generate_speculative(
     ngram: int = 2,
     kv_quant_int8: bool = False,
     weights_int8: bool = False,
+    temperature: float = 0.0,
+    rng: Optional[jax.Array] = None,
+    top_k: int = 0,
+    top_p: float = 1.0,
 ) -> jax.Array:
     """Greedy decode with prompt-lookup speculative decoding: an
     n-gram match against the already-generated context proposes
@@ -994,6 +1115,16 @@ def generate_speculative(
     tests/test_gpt.py::TestSpeculative). Worst case (no draft ever
     accepted) degenerates to one committed token per round, i.e.
     stepwise decode cost plus the k extra verify columns.
+
+    temperature > 0 switches to speculative SAMPLING: each draft
+    accepts with probability p(draft) under the tempered/filtered
+    distribution and rejections resample from the zeroed-renormalized
+    remainder (_accept_or_resample) — committed tokens are distributed
+    EXACTLY as plain sampled decode's (the rejection-sampling lemma,
+    pinned empirically by TestSpeculativeSampling), though the
+    specific stream differs from generate()'s because randomness is
+    consumed per-round, not per-token. top_k/top_p compose as in
+    generate().
 
     The reference delegates serving entirely (SURVEY.md §2: no data
     plane); this is net-new capability on the framework's serving
@@ -1017,13 +1148,25 @@ def generate_speculative(
         raise ValueError(
             f"prompt_len {prompt_len} must be >= ngram {ngram}"
         )
+    if temperature < 0:
+        raise ValueError(f"temperature must be >= 0, got {temperature}")
+    if top_k < 0:
+        raise ValueError(f"top_k must be >= 0, got {top_k}")
+    if not 0.0 < top_p <= 1.0:
+        raise ValueError(f"top_p must be in (0, 1], got {top_p}")
+    if top_k >= cfg.vocab_size:
+        top_k = 0  # normalize: shares one compiled-decode cache entry
     if weights_int8:
         params = _ensure_quantized(params)
+    if rng is None:
+        rng = jax.random.PRNGKey(0)
     run = _compiled_spec_decode(
         cfg, batch, prompt_len, total, int(draft_k), int(ngram),
         kv_quant_int8=kv_quant_int8, weights_int8=weights_int8,
+        temperature=float(temperature), top_k=int(top_k),
+        top_p=float(top_p),
     )
-    return run(params, prompt)
+    return run(params, prompt, rng)
 
 
 # -- beam search -------------------------------------------------------------

@@ -208,14 +208,17 @@ def _device_decode(
     from ..models import gpt as gpt_lib
 
     prompt = jnp.asarray(prompt)
-    # speculative path: greedy-only and uniform-length-only (it has no
-    # ragged forcing), output-exact vs generate(temperature=0) — see
-    # models/gpt.py generate_speculative. Everything else falls back.
+    # speculative path: uniform-length-only (it has no ragged
+    # forcing). Greedy requests are output-exact vs
+    # generate(temperature=0); sampled requests are
+    # DISTRIBUTION-exact but consume randomness per round instead of
+    # per token, so a given seed yields a different (equally valid)
+    # stream than a non-speculative server's — see models/gpt.py
+    # generate_speculative. Ragged requests fall back.
     lens_list = list(lens)
     use_spec = (
         num_beams == 1
         and state.speculative
-        and temperature == 0.0
         and all(length == prompt.shape[1] for length in lens_list)
         and prompt.shape[1] >= _SPEC_NGRAM
     )
@@ -234,6 +237,9 @@ def _device_decode(
                 ngram=_SPEC_NGRAM,
                 kv_quant_int8=state.kv_quant_int8,
                 weights_int8=state.weights_int8,
+                temperature=temperature,
+                rng=rng if rng is not None else jax.random.PRNGKey(0),
+                top_k=top_k, top_p=top_p,
             )
             state.speculative_decodes += 1
         else:
