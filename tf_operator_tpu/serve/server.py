@@ -67,7 +67,7 @@ class _State:
 
     def __init__(self, cfg, params, kv_quant_int8: bool, model_name: str,
                  max_new_cap: int, speculative: bool = False,
-                 weights_int8: bool = False):
+                 weights_int8: bool = False, mesh=None):
         self.cfg = cfg
         self.params = params
         self.kv_quant_int8 = kv_quant_int8
@@ -75,6 +75,9 @@ class _State:
         self.max_new_cap = max_new_cap
         self.speculative = speculative
         self.weights_int8 = weights_int8
+        self.mesh = mesh  # sharded decode (generate(mesh=)); tp over
+        # TRANSFORMER_RULES — speculative/beam are single-device paths
+        # and fall back to plain generate when a mesh is set
         self.lock = threading.Lock()
         self.batcher = None  # set by make_server when batching is on
         self.decodes = 0
@@ -83,6 +86,14 @@ class _State:
         self.decode_seconds = 0.0
         self.request_errors = 0
         self.speculative_decodes = 0
+        # device decodes dispatched and not yet finished — maintained
+        # OUTSIDE the decode lock (which a decode holds for its whole
+        # duration) under its own tiny lock, so observers can see work
+        # in flight. With dynamic batching a coalesced group counts
+        # once, and requests still waiting in the batch window are not
+        # yet counted (see docs/monitoring.md).
+        self.decodes_inflight = 0
+        self.inflight_lock = threading.Lock()
 
     def render_metrics(self) -> str:
         """Prometheus text format — same no-dependency exposition the
@@ -98,6 +109,7 @@ class _State:
             ("request_errors_total", "counter", self.request_errors),
             ("speculative_decodes_total", "counter",
              self.speculative_decodes),
+            ("decodes_inflight", "gauge", self.decodes_inflight),
         ):
             rows.append(f"# TYPE {prefix}_{name} {kind}")
             rows.append(f"{prefix}_{name} {value}")
@@ -200,12 +212,7 @@ def _device_decode(
     can't diverge. Returns host chains [b, width + new] — or, for
     num_beams > 1, the host (sequences, scores) pair beam_search
     yields."""
-    import time
-
-    import jax
     import jax.numpy as jnp
-
-    from ..models import gpt as gpt_lib
 
     prompt = jnp.asarray(prompt)
     # speculative path: uniform-length-only (it has no ragged
@@ -219,9 +226,36 @@ def _device_decode(
     use_spec = (
         num_beams == 1
         and state.speculative
+        and state.mesh is None  # spec decode is single-device
         and all(length == prompt.shape[1] for length in lens_list)
         and prompt.shape[1] >= _SPEC_NGRAM
     )
+    # += on an attribute is NOT GIL-atomic (LOAD/ADD/STORE can
+    # interleave across threads and lose updates); the dedicated lock
+    # keeps the gauge exact without touching the decode lock
+    with state.inflight_lock:
+        state.decodes_inflight += 1
+    try:
+        return _locked_decode(
+            state, prompt, lens, new, temperature, rng, top_k, top_p,
+            num_beams, use_spec,
+        )
+    finally:
+        with state.inflight_lock:
+            state.decodes_inflight -= 1
+
+
+def _locked_decode(
+    state, prompt, lens, new, temperature, rng, top_k, top_p,
+    num_beams, use_spec,
+):
+    import time
+
+    import jax
+    import jax.numpy as jnp
+
+    from ..models import gpt as gpt_lib
+
     with state.lock:  # decode saturates the chip; serialize
         start = time.perf_counter()
         if num_beams > 1:
@@ -251,6 +285,7 @@ def _device_decode(
                 weights_int8=state.weights_int8,
                 prompt_lens=jnp.asarray(lens),
                 top_k=top_k, top_p=top_p,
+                mesh=state.mesh,
             )
         jax.block_until_ready(out)
         state.decode_seconds += time.perf_counter() - start
@@ -262,6 +297,13 @@ def DecodeHandlerFactory(state: _State):
 
     class Handler(BaseHTTPRequestHandler):
         protocol_version = "HTTP/1.1"
+        # idle keep-alive connections close after this many seconds
+        # (http.server turns the socket timeout into close_connection).
+        # Without it a persistent client — a Prometheus scraper is the
+        # expected deployment — parks a handler thread in readline()
+        # forever, and the SIGTERM drain (server_close joins non-daemon
+        # handler threads) would hang past the pod grace period.
+        timeout = 5
 
         def _reply(self, code: int, payload: dict) -> None:
             body = json.dumps(payload).encode()
@@ -410,6 +452,7 @@ def make_server(
     batch_window_ms: float = 0.0,
     speculative: bool = False,
     weights_int8: bool = False,
+    mesh=None,
 ) -> ThreadingHTTPServer:
     """In-process server (tests and embedders); caller owns
     serve_forever/shutdown. The CLI binds 0.0.0.0 (pods must be
@@ -442,9 +485,28 @@ def make_server(
         # reads int8 kernels; generate(weights_int8=True) detects the
         # already-quantized tree and skips re-transforming per request
         params = quantize_params(params)
+    if speculative and mesh is not None:
+        raise ValueError(
+            "speculative and mesh are mutually exclusive: the "
+            "speculative verify loop is a single-device program; "
+            "sharded serving uses the plain generate(mesh=) path"
+        )
+    if mesh is not None:
+        # place the weights on the mesh ONCE at load: generate(mesh=)
+        # re-places per call, which short-circuits on already-matching
+        # shardings — without this, every request would pay a full
+        # single-device -> mesh weights transfer inside the decode lock
+        from ..parallel import sharding as sharding_lib
+
+        params = sharding_lib.place(
+            params,
+            sharding_lib.shardings_for_tree(
+                params, mesh, sharding_lib.TRANSFORMER_RULES
+            ),
+        )
     state = _State(
         cfg, params, kv_quant_int8, model_name, max_new_cap,
-        speculative=speculative, weights_int8=weights_int8,
+        speculative=speculative, weights_int8=weights_int8, mesh=mesh,
     )
     if batch_window_ms > 0:
         from .batching import DynamicBatcher
@@ -494,6 +556,13 @@ def main(argv=None) -> int:
         help="prompt-lookup speculative decoding for greedy "
         "uniform-length requests (output-exact; repetitive "
         "continuations commit several tokens per model read)",
+    )
+    parser.add_argument(
+        "--tp", type=int, default=1,
+        help="tensor-parallel degree for sharded decode: params place "
+        "by TRANSFORMER_RULES over a dp x tp mesh and GSPMD shards "
+        "the KV cache (generate(mesh=)); beams run single-device; "
+        "mutually exclusive with --speculative",
     )
     args = parser.parse_args(argv)
     logging.basicConfig(level=logging.INFO, stream=sys.stderr)
@@ -554,17 +623,42 @@ def main(argv=None) -> int:
             rng, jnp.zeros((1, 8), jnp.int32)
         )["params"]
 
+    mesh = None
+    if args.tp > 1:
+        from ..parallel.mesh import MeshConfig, build_mesh
+
+        mesh = build_mesh(MeshConfig(dp=-1, tp=args.tp))
+        logger.info("sharded decode over mesh %s", dict(mesh.shape))
     server = make_server(
         cfg, params, port=args.port, kv_quant_int8=args.kv_int8,
         model_name=f"gpt-{args.preset}", max_new_cap=args.max_new_cap,
         host=args.host, batch_window_ms=args.batch_window_ms,
         speculative=args.speculative, weights_int8=args.weights_int8,
+        mesh=mesh,
     )
     logger.info("decode server on :%d", server.server_address[1])
+    # graceful drain — the serving sibling of the training-side
+    # preemption contract (train/preemption.py): SIGTERM (spot
+    # reclaim, pod deletion) stops accepting, lets in-flight requests
+    # finish, and exits 0 so the controller records a clean stop.
+    # Non-daemon handler threads + block_on_close make server_close()
+    # join whatever is still decoding.
+    server.daemon_threads = False
+    server.block_on_close = True
+
+    def _drain(signum, frame):
+        logger.info("signal %d: draining in-flight requests", signum)
+        threading.Thread(target=server.shutdown, daemon=True).start()
+
+    import signal
+
+    signal.signal(signal.SIGTERM, _drain)
     try:
         server.serve_forever()
     except KeyboardInterrupt:
         pass
+    server.server_close()
+    logger.info("drained; exiting 0")
     return 0
 
 
