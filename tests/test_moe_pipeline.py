@@ -7,6 +7,8 @@ bit-for-bit-ish (f32 tolerance), forward AND gradient, on the virtual
 8-device CPU mesh (conftest).
 """
 
+import dataclasses
+
 import jax
 import jax.numpy as jnp
 import numpy as np
@@ -371,3 +373,73 @@ class TestMoeTask:
         np.testing.assert_allclose(
             float(metrics["loss"]), float(eval_loss), rtol=1e-5, atol=1e-6
         )
+
+
+class TestMoEDecode:
+    """KV-cached MoE decode (models/moe.py MoEDecodeStep): the decode
+    dataflow re-implements the MoELM forward token by token, so
+    teacher-forced logits must match the training forward exactly —
+    the same load-bearing parity pin the GPT family carries."""
+
+    @pytest.fixture(scope="class")
+    def setup(self):
+        # capacity_factor 2.0 so the training forward drops nothing at
+        # this length: decode's per-token groups NEVER drop, so parity
+        # only holds when training didn't either (documented semantics)
+        cfg = dataclasses.replace(
+            m.MOE_TINY, capacity_factor=2.0, num_layers=2,
+        )
+        params = m.MoELM(cfg).init(
+            jax.random.PRNGKey(0), jnp.zeros((1, 8), jnp.int32)
+        )["params"]
+        return cfg, params
+
+    def test_teacher_forced_parity_with_training_forward(self, setup):
+        cfg, params = setup
+        seq = jax.random.randint(
+            jax.random.PRNGKey(5), (2, 10), 0, cfg.vocab_size
+        )
+        train_logits = m.MoELM(cfg).apply({"params": params}, seq)
+
+        model = m.MoEDecodeStep(cfg, cache_len=10)
+        cache = jax.tree_util.tree_map(
+            lambda s: jnp.zeros(s.shape, s.dtype),
+            jax.eval_shape(
+                lambda: model.init(
+                    jax.random.PRNGKey(0), jnp.zeros((2,), jnp.int32),
+                    jnp.int32(0),
+                )["cache"]
+            ),
+        )
+        step_logits = []
+        for i in range(10):
+            logits, updates = model.apply(
+                {"params": params, "cache": cache}, seq[:, i],
+                jnp.int32(i), mutable=["cache"],
+            )
+            cache = updates["cache"]
+            step_logits.append(np.asarray(logits, np.float32))
+        np.testing.assert_allclose(
+            np.stack(step_logits, axis=1),
+            np.asarray(train_logits, np.float32),
+            atol=2e-4, rtol=2e-4,
+            err_msg="MoE decode/train logit mismatch",
+        )
+
+    def test_generate_prefix_shapes_and_range(self, setup):
+        cfg, params = setup
+        prompt = jax.random.randint(
+            jax.random.PRNGKey(6), (2, 5), 0, cfg.vocab_size
+        )
+        out = m.moe_generate(cfg, params, prompt, max_new_tokens=6)
+        assert out.shape == (2, 11)
+        np.testing.assert_array_equal(
+            np.asarray(out[:, :5]), np.asarray(prompt)
+        )
+        arr = np.asarray(out)
+        assert ((arr >= 0) & (arr < cfg.vocab_size)).all()
+        with pytest.raises(ValueError, match="max_position"):
+            m.moe_generate(
+                cfg, params, prompt,
+                max_new_tokens=cfg.max_position_embeddings,
+            )

@@ -21,6 +21,7 @@ capability, built the TPU way:
 from __future__ import annotations
 
 import dataclasses
+import functools
 from typing import Optional, Tuple
 
 import jax
@@ -271,6 +272,16 @@ class MoEHead(nn.Module):
         )(x.astype(cfg.dtype))
 
 
+def layer_is_moe(cfg: MoEConfig, layer: int) -> bool:
+    """THE dense/MoE alternation rule, shared by the training forward
+    and the decode step so they can never route through different
+    blocks: layers 1, 1+moe_every, ... are MoE (layer 0 stays dense —
+    standard practice, the first block's routing is unstable)."""
+    return cfg.moe_every > 0 and layer % cfg.moe_every == (
+        1 % cfg.moe_every
+    )
+
+
 class MoELM(nn.Module):
     """Causal decoder LM with alternating dense/MoE FFN blocks."""
 
@@ -288,13 +299,9 @@ class MoELM(nn.Module):
         if mask is not None:
             attn_mask = attn_mask & mask[:, None, None, :].astype(bool)
         for layer in range(cfg.num_layers):
-            # layers 1, 1+moe_every, ... are MoE (layer 0 stays dense:
-            # standard practice, the first block's routing is unstable)
-            use_moe = cfg.moe_every > 0 and layer % cfg.moe_every == (
-                1 % cfg.moe_every
-            )
             x = MoEBlock(
-                cfg, use_moe=use_moe, attention_fn=self.attention_fn,
+                cfg, use_moe=layer_is_moe(cfg, layer),
+                attention_fn=self.attention_fn,
                 name=f"layer_{layer}",
             )(x, attn_mask)
         return MoEHead(cfg, name="head")(x)
@@ -329,3 +336,157 @@ def synthetic_batch(rng: jax.Array, batch_size: int, seq_len: int, cfg: MoEConfi
         "labels": input_ids,
         "attention_mask": jnp.ones((batch_size, seq_len), jnp.int32),
     }
+
+
+# -- KV-cached decode --------------------------------------------------------
+
+
+class _MoEEmbedAt(nn.Module):
+    """MoEEmbed's decode twin: ONE token at a dynamic position, same
+    param paths (embed/token_embed, embed/position_embed) so trained
+    MoELM params drive decode directly."""
+
+    config: MoEConfig
+
+    @nn.compact
+    def __call__(self, token: jax.Array, index: jax.Array) -> jax.Array:
+        cfg = self.config
+        x = nn.Embed(
+            cfg.vocab_size, cfg.hidden_size, dtype=cfg.dtype,
+            name="token_embed",
+        )(token)
+        return x + nn.Embed(
+            cfg.max_position_embeddings, cfg.hidden_size, dtype=cfg.dtype,
+            name="position_embed",
+        )(index)
+
+
+class MoEDecodeStep(nn.Module):
+    """One-token forward over a KV cache for the MoE family —
+    param-path identical to MoELM (embed/layer_i/head), so one set of
+    trained weights serves training and decode.
+
+    The attention reuses gpt.py's CachedSelfAttention (same
+    query/key/value/attn_out child paths as MultiHeadAttention); the
+    FFN half reuses MoEMlp VERBATIM on a [batch, 1, hidden] group —
+    each decoded token routes within its own group, where it occupies
+    slot 0 of every expert it chose (capacity is PER EXPERT), so
+    decode never drops for any experts_per_token, while a long
+    training sequence can overflow expert capacity and drop. Parity
+    with the training forward therefore holds exactly when training
+    dropped nothing (tests/test_moe_pipeline.py::TestMoEDecode uses a
+    capacity factor that guarantees it)."""
+
+    config: MoEConfig
+    cache_len: int = 0
+
+    @nn.compact
+    def __call__(self, token: jax.Array, index: jax.Array) -> jax.Array:
+        cfg = self.config
+        cache_len = self.cache_len or cfg.max_position_embeddings
+        x = _MoEEmbedAt(cfg, name="embed")(token, index)
+        for layer in range(cfg.num_layers):
+            x = _MoECachedBlock(
+                cfg, use_moe=layer_is_moe(cfg, layer),
+                cache_len=cache_len, name=f"layer_{layer}",
+            )(x, index)
+        return MoEHead(cfg, name="head")(x)
+
+
+class _MoECachedBlock(nn.Module):
+    """MoEBlock's decode twin (same child param paths)."""
+
+    config: MoEConfig
+    use_moe: bool = True
+    cache_len: int = 0
+
+    @nn.compact
+    def __call__(self, x: jax.Array, index: jax.Array) -> jax.Array:
+        from .gpt import CachedSelfAttention
+
+        cfg = self.config
+        y = nn.LayerNorm(dtype=jnp.float32, name="ln_attn")(x)
+        y = CachedSelfAttention(
+            num_heads=cfg.num_heads, head_dim=cfg.head_dim,
+            max_len=self.cache_len, dtype=cfg.dtype, name="attention",
+        )(y.astype(cfg.dtype), index)
+        x = x + y
+        y = nn.LayerNorm(dtype=jnp.float32, name="ln_mlp")(x)
+        if self.use_moe:
+            # one-token group: MoEMlp's dispatch/combine einsums apply
+            # unchanged at [batch, 1, hidden]
+            y = MoEMlp(cfg, name="moe_mlp")(y[:, None])[:, 0]
+        else:
+            y = nn.Dense(
+                cfg.intermediate_size, dtype=cfg.dtype, name="mlp_in"
+            )(y.astype(cfg.dtype))
+            y = nn.gelu(y)
+            y = nn.Dense(
+                cfg.hidden_size, dtype=cfg.dtype, name="mlp_out"
+            )(y)
+        return x + y
+
+
+@functools.lru_cache(maxsize=16)
+def _compiled_moe_decode(cfg: MoEConfig, batch: int, prompt_len: int,
+                         total: int):
+    """One compiled greedy decode per (config, shape): every position
+    steps through the one-token model (prompt positions teacher-forced
+    — the per-token path; a batched MoE prefill can come later without
+    changing this contract)."""
+    model = MoEDecodeStep(cfg, cache_len=total)
+    cache_shapes = jax.eval_shape(
+        lambda: model.init(
+            jax.random.PRNGKey(0), jnp.zeros((batch,), jnp.int32),
+            jnp.int32(0),
+        )["cache"]
+    )
+
+    @jax.jit
+    def run(params, prompt):
+        cache0 = jax.tree_util.tree_map(
+            lambda s: jnp.zeros(s.shape, s.dtype), cache_shapes
+        )
+        first = prompt[:, 0].astype(jnp.int32)
+
+        def step(carry, index):
+            cache, tok = carry
+            logits, updates = model.apply(
+                {"params": params, "cache": cache}, tok, index,
+                mutable=["cache"],
+            )
+            nxt = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+            in_prompt = index + 1 < prompt_len
+            forced = prompt[:, jnp.minimum(index + 1, prompt_len - 1)]
+            nxt = jnp.where(in_prompt, forced, nxt).astype(jnp.int32)
+            return (updates["cache"], nxt), nxt
+
+        (_, _), toks = jax.lax.scan(
+            step, (cache0, first), jnp.arange(total - 1)
+        )
+        return jnp.concatenate([prompt[:, :1], toks.T], axis=1)
+
+    return run
+
+
+def moe_generate(
+    cfg: MoEConfig, params, prompt: jax.Array, max_new_tokens: int
+) -> jax.Array:
+    """Greedy KV-cached decode for the MoE family: [b, p] ->
+    [b, p + max_new_tokens]. Every model family decodes — the MoE
+    decode step routes each new token through the same trained experts
+    the training forward used (teacher-forced parity pinned by
+    tests/test_moe_pipeline.py::TestMoEDecode)."""
+    batch, prompt_len = prompt.shape
+    total = prompt_len + max_new_tokens
+    if max_new_tokens < 1:
+        raise ValueError(
+            f"max_new_tokens must be >= 1, got {max_new_tokens}"
+        )
+    if total > cfg.max_position_embeddings:
+        raise ValueError(
+            f"prompt+new = {total} exceeds max_position_embeddings "
+            f"{cfg.max_position_embeddings}"
+        )
+    run = _compiled_moe_decode(cfg, batch, prompt_len, total)
+    return run(params, jnp.asarray(prompt, jnp.int32))

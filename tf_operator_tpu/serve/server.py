@@ -76,8 +76,11 @@ class _State:
         self.speculative = speculative
         self.weights_int8 = weights_int8
         self.mesh = mesh  # sharded decode (generate(mesh=)); tp over
-        # TRANSFORMER_RULES — speculative/beam are single-device paths
-        # and fall back to plain generate when a mesh is set
+        # TRANSFORMER_RULES. Speculative is a single-device program
+        # (refused with a mesh at make_server); beam_search runs over
+        # the mesh-placed params under GSPMD and matches single-device
+        # output (tests/test_serve.py TestShardedServing pins the
+        # greedy path; beams share the same placed tree)
         self.lock = threading.Lock()
         self.batcher = None  # set by make_server when batching is on
         self.decodes = 0
@@ -561,8 +564,8 @@ def main(argv=None) -> int:
         "--tp", type=int, default=1,
         help="tensor-parallel degree for sharded decode: params place "
         "by TRANSFORMER_RULES over a dp x tp mesh and GSPMD shards "
-        "the KV cache (generate(mesh=)); beams run single-device; "
-        "mutually exclusive with --speculative",
+        "the KV cache (generate(mesh=)); mutually exclusive with "
+        "--speculative",
     )
     args = parser.parse_args(argv)
     logging.basicConfig(level=logging.INFO, stream=sys.stderr)
