@@ -693,6 +693,21 @@ class TestWeightsInt8:
         q2 = quantize_params(q)
         chex.assert_trees_all_equal(q, q2)
 
+    def test_quantize_params_rejects_conv_kernels(self):
+        """A Conv kernel [h, w, in, out] contracts three leading axes;
+        the decode-family contraction rule would mis-scale it (axis 0
+        only), so the transform must refuse loudly rather than emit a
+        broken export (ADVICE r4)."""
+        import pytest
+
+        from tf_operator_tpu.ops.quant import quantize_params
+
+        params = {
+            "stem_conv": {"kernel": jnp.ones((3, 3, 8, 16), jnp.float32)}
+        }
+        with pytest.raises(ValueError, match="stem_conv/kernel"):
+            quantize_params(params)
+
     def test_decode_quality_and_composition(self):
         """int8-weight decode must track bf16-weight decode closely
         (forks only at small top-2 gaps would be the strict oracle;

@@ -73,8 +73,22 @@ def to_jsonable(value: Any) -> Any:
 
 
 def _coerce(value: Any, tp: Any) -> Any:
-    tp = _unwrap_optional(tp)
+    unwrapped = _unwrap_optional(tp)
+    was_optional = unwrapped is not tp
+    tp = unwrapped
     if value is None:
+        # an explicit JSON null for a REQUIRED map/list field means
+        # "absent" (k8s apiserver semantics): coerce to the empty
+        # collection so validation reports the real problem
+        # ("tfReplicaSpecs must be specified") instead of every
+        # downstream consumer crashing on a None where the declared
+        # type promises a collection
+        if not was_optional:
+            origin = typing.get_origin(tp)
+            if origin is dict:
+                return {}
+            if origin in (list, tuple):
+                return []
         return None
     origin = typing.get_origin(tp)
     if origin in (list, tuple):

@@ -61,9 +61,13 @@ def quantize_params(params) -> dict:
     axis=(-2,-1): kernel [heads, head_dim, out] contracts TWO leading
     axes); every other kernel contracts exactly its first axis. The
     name coupling is deliberate — this transform exists for the gpt
-    decode modules, whose param paths gpt.py owns."""
+    decode modules, whose param paths gpt.py owns. Kernels whose
+    contraction that rule cannot describe — a Conv's [h, w, in, out]
+    contracts THREE leading axes — would be silently mis-grouped
+    (scaled over axis 0 alone), so any ndim >= 4 kernel is rejected
+    loudly instead of exported broken (ADVICE r4)."""
 
-    def walk(node, parent_key=None):
+    def walk(node, path=()):
         if not isinstance(node, dict):
             return node
         out = {}
@@ -74,15 +78,24 @@ def quantize_params(params) -> dict:
                 and value.ndim >= 2
                 and value.dtype != jnp.int8
             ):
+                if value.ndim >= 4:
+                    joined = "/".join((*path, key))
+                    raise ValueError(
+                        f"quantize_params: kernel at '{joined}' has "
+                        f"ndim {value.ndim} (a conv-family shape); only "
+                        "the decode matmul family (ndim <= 3) has a "
+                        "known contraction here — refusing to emit a "
+                        "mis-scaled int8 export"
+                    )
                 n_contract = (
-                    2 if parent_key == "attn_out" and value.ndim == 3
+                    2 if path and path[-1] == "attn_out" and value.ndim == 3
                     else 1
                 )
                 out["kernel"], out["kernel_scale"] = quantize_kernel(
                     value, n_contract
                 )
             else:
-                out[key] = walk(value, parent_key=key)
+                out[key] = walk(value, path=path + (key,))
         return out
 
     return walk(params)
