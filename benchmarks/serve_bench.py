@@ -1,0 +1,320 @@
+"""End-to-end serving benchmark: the live HTTP decode server under
+concurrent clients (VERDICT r4 next #4).
+
+Four scenarios, one JSON artifact (SERVE_BENCH.json):
+
+1. ``plain``      — N concurrent clients, single-row greedy requests
+                    against a bare server: requests/sec, p50/p95
+                    latency, served tokens/sec.
+2. ``batched``    — the same load with ``--batch-window-ms`` dynamic
+                    batching: the coalescing factor
+                    (decodes / device dispatches) is the mechanism, the
+                    latency/throughput delta is the verdict.
+3. ``speculative``— model-level A/B on repetitive vs non-repetitive
+                    prompts: measured acceptance rate (verify-round
+                    counter, models/gpt.py generate_speculative
+                    return_rounds) and tokens/sec vs plain decode.
+4. ``spec_batch`` — the batch-min exposure (VERDICT r4 weak #3): the
+                    same A/B at batch > 1, where one non-repetitive row
+                    drags every row's commit to the batch minimum. The
+                    measured ratio is the evidence for the server's
+                    single-row speculative routing policy
+                    (serve/server.py).
+
+Run:  BENCH_CPU=1 python benchmarks/serve_bench.py   (CPU shapes)
+      python benchmarks/serve_bench.py               (TPU shapes)
+
+Every request carries DISTINCT prompt values at a fixed shape: one
+compile, fresh dispatches — byte-identical dispatches coalesce through
+the TPU tunnel (bench.py _time_decode) and would fake the throughput.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+import threading
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from bench import _maybe_force_cpu  # noqa: E402
+
+_maybe_force_cpu()
+
+import jax  # noqa: E402
+import jax.numpy as jnp  # noqa: E402
+
+from benchmarks._common import percentile  # noqa: E402
+
+
+def _shapes(on_tpu: bool):
+    from tf_operator_tpu.models import gpt as gpt_lib
+
+    if on_tpu:
+        cfg = gpt_lib.GPTConfig(max_seq_len=1024)  # GPT-small
+        return cfg, 128, 128, 6, 5   # prompt_len, new, clients, reqs/client
+    return gpt_lib.GPT_TINY, 16, 24, 6, 5
+
+
+def _make_params(cfg):
+    return __import__(
+        "tf_operator_tpu.models.gpt", fromlist=["GPT"]
+    ).GPT(cfg).init(
+        jax.random.PRNGKey(0), jnp.zeros((1, 8), jnp.int32)
+    )["params"]
+
+
+def _client_load(port: int, prompts, new: int, n_clients: int):
+    """Fire len(prompts) single-row requests from n_clients threads;
+    returns (wall_seconds, sorted per-request latencies)."""
+    from tf_operator_tpu.serve.client import DecodeClient
+
+    client = DecodeClient(f"http://127.0.0.1:{port}")
+    latencies = []
+    lock = threading.Lock()
+    queue = list(enumerate(prompts))
+
+    def worker():
+        while True:
+            with lock:
+                if not queue:
+                    return
+                _, prompt = queue.pop()
+            t0 = time.perf_counter()
+            client.generate([prompt], max_new_tokens=new)
+            dt = time.perf_counter() - t0
+            with lock:
+                latencies.append(dt)
+
+    threads = [threading.Thread(target=worker) for _ in range(n_clients)]
+    start = time.perf_counter()
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    return time.perf_counter() - start, sorted(latencies)
+
+
+def _serve_scenario(cfg, params, prompts, new: int, n_clients: int,
+                    batch_window_ms: float = 0.0) -> dict:
+    from tf_operator_tpu.serve import make_server
+    from tf_operator_tpu.serve.client import DecodeClient
+
+    width = len(prompts[0])
+    # steady-state measurement: the batcher coalesces into power-of-two
+    # batch buckets, each a distinct compiled shape — warm them all up
+    # front (serve --warm), or the measured window pays the compiles
+    # (observed: unwarmed bucket compiles put the CPU batched p95 at
+    # 16.9s vs 0.13s p50)
+    warm = [
+        (b, width, new)
+        for b in ((1, 2, 4, 8) if batch_window_ms > 0 else (1,))
+    ]
+    srv = make_server(
+        cfg, params, batch_window_ms=batch_window_ms, max_new_cap=4096,
+        warm_shapes=warm,
+    )
+    thread = threading.Thread(target=srv.serve_forever, daemon=True)
+    thread.start()
+    port = srv.server_address[1]
+    try:
+        # warm the compile outside the measured window (the shape is
+        # what compiles; values stay distinct per measured request)
+        DecodeClient(f"http://127.0.0.1:{port}").generate(
+            [prompts[0]], max_new_tokens=new
+        )
+        wall, lats = _client_load(port, prompts, new, n_clients)
+        metrics = DecodeClient(f"http://127.0.0.1:{port}").metrics()
+    finally:
+        srv.shutdown()
+    decodes = metrics["tf_operator_tpu_serve_decodes_total"] - 1
+    dispatches = metrics["tf_operator_tpu_serve_decode_batches_total"] - 1
+    return {
+        "requests": len(lats),
+        "clients": n_clients,
+        "requests_per_sec": round(len(lats) / wall, 2),
+        "served_tokens_per_sec": round(len(lats) * new / wall, 1),
+        "p50_latency_s": round(percentile(lats, 0.50), 4),
+        "p95_latency_s": round(percentile(lats, 0.95), 4),
+        "coalescing_factor": round(decodes / max(dispatches, 1), 2),
+    }
+
+
+def _time_spec(cfg, params, prompt, new: int):
+    """(tokens/sec, acceptance rate) for one speculative decode; the
+    measured call uses a fresh prompt (tunnel dispatch-cache trap)."""
+    from tf_operator_tpu.models.gpt import generate_speculative
+
+    out, _ = generate_speculative(
+        cfg, params, prompt, max_new_tokens=new, return_rounds=True
+    )
+    int(out.sum())  # compile + warm; value transfer = real barrier
+    prompt2 = (prompt + 1) % cfg.vocab_size
+    int(prompt2.sum())
+    start = time.perf_counter()
+    out, rounds = generate_speculative(
+        cfg, params, prompt2, max_new_tokens=new, return_rounds=True
+    )
+    int(out.sum())
+    elapsed = time.perf_counter() - start
+    batch = prompt.shape[0]
+    accepted_per_round = max((new - 1) / max(rounds, 1) - 1.0, 0.0)
+    return (
+        round(batch * new / elapsed, 2),
+        round(accepted_per_round / 4.0, 4),  # draft_k = 4 default
+    )
+
+
+def _time_plain(cfg, params, prompt, new: int):
+    from tf_operator_tpu.models.gpt import generate
+
+    out = generate(cfg, params, prompt, max_new_tokens=new)
+    int(out.sum())
+    prompt2 = (prompt + 1) % cfg.vocab_size
+    int(prompt2.sum())
+    start = time.perf_counter()
+    out = generate(cfg, params, prompt2, max_new_tokens=new)
+    int(out.sum())
+    return round(prompt.shape[0] * new / (time.perf_counter() - start), 2)
+
+
+def _memorizing_params(cfg, steps: int = 120):
+    """Train the model to memorize a short repeating token pattern —
+    the controlled FAVORABLE case for prompt-lookup speculation. A
+    random-init model's greedy continuation is not n-gram-predictable
+    (measured acceptance ~0 whatever the prompt looks like), which
+    exercises only the worst case; a model that actually repeats its
+    context is the regime the feature exists for, and memorization is
+    the cheapest way to construct one."""
+    import optax
+
+    from tf_operator_tpu.models import gpt as gpt_lib
+
+    model = gpt_lib.GPT(cfg)
+    params = model.init(
+        jax.random.PRNGKey(0), jnp.zeros((1, 8), jnp.int32)
+    )["params"]
+    period = 17  # co-prime with the draft ngram, no degenerate loops
+    width = max(96, cfg.max_seq_len // 4)
+    pat = jnp.tile(
+        jnp.arange(period, dtype=jnp.int32)[None, :],
+        (4, width // period + 1),
+    )[:, :width]
+    opt = optax.adamw(3e-3)
+    opt_state = opt.init(params)
+
+    @jax.jit
+    def step(params, opt_state):
+        def loss_fn(p):
+            logits = model.apply({"params": p}, pat)
+            return gpt_lib.causal_lm_loss(logits, pat)
+
+        loss, grads = jax.value_and_grad(loss_fn)(params)
+        updates, opt_state = opt.update(grads, opt_state, params)
+        return optax.apply_updates(params, updates), opt_state, loss
+
+    for _ in range(steps):
+        params, opt_state, loss = step(params, opt_state)
+    return params, pat, float(loss)
+
+
+def spec_scenarios(cfg, params, prompt_len: int, new: int) -> dict:
+    """Speculative acceptance + speedup, bracketing both ends:
+
+    - ``random``/``repetitive``: the served (random-init) model on
+      non-repetitive and repetitive prompts — both land near zero
+      acceptance (an untrained model's continuation is not n-gram
+      predictable even when its prompt is), i.e. the documented
+      worst case: every round pays the k extra verify columns.
+    - ``memorized``: a model trained to repeat a pattern (the
+      input-grounded regime prompt lookup targets) — the favorable
+      bound.
+    - ``memorized_mixed_batch4``: the batch-min exposure (VERDICT r4
+      weak #3): three high-acceptance rows + one random row; the
+      min(accepted) commit rule drags the whole batch to the worst
+      row, the measured basis for the server's speculative routing
+      policy."""
+    rng = jax.random.PRNGKey(7)
+    repetitive = jnp.tile(
+        jnp.arange(4, dtype=jnp.int32), prompt_len // 4 + 1
+    )[:prompt_len][None, :]
+    random1 = jax.random.randint(rng, (1, prompt_len), 0, cfg.vocab_size)
+
+    def ab(prompt, params):
+        tps, acc = _time_spec(cfg, params, prompt, new)
+        row = {
+            "spec_tokens_per_sec": tps,
+            "acceptance_rate": acc,
+            "plain_tokens_per_sec": _time_plain(cfg, params, prompt, new),
+        }
+        row["speedup"] = round(tps / row["plain_tokens_per_sec"], 3)
+        return row
+
+    out = {
+        "repetitive": ab(repetitive, params),
+        "random": ab(random1, params),
+    }
+
+    mem_params, pat, loss = _memorizing_params(cfg)
+    mem_prompt = pat[:1, :prompt_len]
+    out["memorized"] = ab(mem_prompt, mem_params)
+    out["memorized"]["train_loss"] = round(loss, 5)
+    mixed = jnp.concatenate(
+        [jnp.tile(mem_prompt, (3, 1)),
+         jax.random.randint(rng, (1, prompt_len), 0, cfg.vocab_size)],
+        axis=0,
+    )
+    out["memorized_mixed_batch4"] = ab(mixed, mem_params)
+    return out
+
+
+def run(write: bool = True) -> dict:
+    on_tpu = jax.devices()[0].platform == "tpu"
+    cfg, prompt_len, new, n_clients, reqs_per_client = _shapes(on_tpu)
+    params = _make_params(cfg)
+    n_requests = n_clients * reqs_per_client
+    # distinct values, one shape: request i perturbs a base prompt
+    base = jax.random.randint(
+        jax.random.PRNGKey(1), (prompt_len,), 0, cfg.vocab_size
+    )
+    prompts = [
+        [int(x) for x in (base + i) % cfg.vocab_size] for i in range(n_requests)
+    ]
+
+    result = {
+        "environment": "tpu" if on_tpu else "cpu",
+        "device": getattr(jax.devices()[0], "device_kind", "cpu"),
+        "config": {
+            "prompt_len": prompt_len, "max_new_tokens": new,
+            "clients": n_clients, "requests": n_requests,
+        },
+        "plain": _serve_scenario(cfg, params, prompts, new, n_clients),
+        "batched": _serve_scenario(
+            cfg, params, prompts, new, n_clients, batch_window_ms=10.0
+        ),
+        "speculative": spec_scenarios(cfg, params, prompt_len, new),
+        "notes": (
+            "plain/batched drive the live HTTP server (in-process, "
+            "loopback) with single-row greedy requests from concurrent "
+            "threads; batched pre-warms the batcher's power-of-two "
+            "bucket shapes (serve --warm). speculative is a model-level "
+            "A/B (acceptance from the verify-round counter, draft_k=4): "
+            "random-init model = worst case, memorized model = the "
+            "favorable input-grounded regime; memorized_mixed_batch4 is "
+            "the batch-min exposure (one random row dragging three "
+            "high-acceptance rows)."
+        ),
+    }
+    if write:
+        with open(
+            os.path.join(os.path.dirname(os.path.dirname(
+                os.path.abspath(__file__))), "SERVE_BENCH.json"), "w"
+        ) as fh:
+            json.dump(result, fh, indent=1)
+    return result
+
+
+if __name__ == "__main__":
+    print(json.dumps(run(), indent=1))

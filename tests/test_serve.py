@@ -363,7 +363,7 @@ class TestSpeculativeServing:
     def test_greedy_output_identical_and_metered(self, spec_server):
         cfg, params, srv = spec_server
         port = srv.server_address[1]
-        prompt = [[1, 2, 3, 1, 2, 3, 1, 2], [9, 8, 7, 9, 8, 7, 9, 8]]
+        prompt = [[1, 2, 3, 1, 2, 3, 1, 2]]
         status, body = post(port, {
             "input_ids": prompt, "max_new_tokens": 10,
         })
@@ -375,6 +375,20 @@ class TestSpeculativeServing:
             np.asarray(body["tokens"]), np.asarray(expect)
         )
         assert srv.state.speculative_decodes >= 1
+
+    def test_multi_row_falls_back(self, spec_server):
+        # batch-min commit: one low-acceptance row drags the whole
+        # batch (SERVE_BENCH.json memorized_mixed_batch4), so the
+        # server speculates single-row requests ONLY
+        _, _, srv = spec_server
+        port = srv.server_address[1]
+        before = srv.state.speculative_decodes
+        status, _ = post(port, {
+            "input_ids": [[1, 2, 3, 1], [9, 8, 7, 9]],
+            "max_new_tokens": 4,
+        })
+        assert status == 200
+        assert srv.state.speculative_decodes == before
 
     def test_sampled_routes_through_spec_and_is_seed_deterministic(
         self, spec_server

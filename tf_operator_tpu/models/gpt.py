@@ -1002,14 +1002,18 @@ def _compiled_spec_decode(
             ],
             axis=1,
         )
-        state = (buf, updates["cache"], jnp.int32(prompt_len), rng)
+        # the trailing scalar counts verify ROUNDS — with the committed
+        # token total it yields the measured acceptance rate
+        # (benchmarks/serve_bench.py), at zero cost to the loop
+        state = (buf, updates["cache"], jnp.int32(prompt_len), rng,
+                 jnp.int32(0))
 
         def cond(state):
-            _, _, index, _ = state
+            _, _, index, _, _ = state
             return index < total - 1
 
         def body(state):
-            buf, cache, index, rng = state
+            buf, cache, index, rng, rounds = state
             drafts = _ngram_draft(buf, index, draft_k, ngram)  # [b, k]
             cur = jax.vmap(
                 lambda row: jax.lax.dynamic_slice(row, (index,), (1,))
@@ -1034,7 +1038,8 @@ def _compiled_spec_decode(
                 buf = jax.lax.dynamic_update_slice(
                     buf, greedy, (0, index + 1)
                 )
-                return (buf, updates["cache"], index + commit + 1, rng)
+                return (buf, updates["cache"], index + commit + 1, rng,
+                        rounds + 1)
 
             probs = tempered_probs(logits)  # [b, k+1, V]
             rng, u_rng, fix_rng = jax.random.split(rng, 3)
@@ -1077,10 +1082,11 @@ def _compiled_spec_decode(
             )
             cand = jnp.where(cand < 0, 0, cand).astype(jnp.int32)
             buf = jax.lax.dynamic_update_slice(buf, cand, (0, index + 1))
-            return (buf, updates["cache"], index + commit + 1, rng)
+            return (buf, updates["cache"], index + commit + 1, rng,
+                    rounds + 1)
 
-        buf, _, _, _ = jax.lax.while_loop(cond, body, state)
-        return buf[:, :total]
+        buf, _, _, _, rounds = jax.lax.while_loop(cond, body, state)
+        return buf[:, :total], rounds
 
     return run
 
@@ -1098,6 +1104,7 @@ def generate_speculative(
     rng: Optional[jax.Array] = None,
     top_k: int = 0,
     top_p: float = 1.0,
+    return_rounds: bool = False,
 ) -> jax.Array:
     """Greedy decode with prompt-lookup speculative decoding: an
     n-gram match against the already-generated context proposes
@@ -1166,7 +1173,13 @@ def generate_speculative(
         temperature=float(temperature), top_k=int(top_k),
         top_p=float(top_p),
     )
-    return run(params, prompt, rng)
+    out, rounds = run(params, prompt, rng)
+    if return_rounds:
+        # rounds = verify forwards executed; with max_new_tokens - 1
+        # loop-committed tokens this yields the measured acceptance
+        # rate: mean accepted drafts/round = (new - 1)/rounds - 1
+        return out, int(rounds)
+    return out
 
 
 # -- beam search -------------------------------------------------------------

@@ -230,6 +230,13 @@ def _device_decode(
         num_beams == 1
         and state.speculative
         and state.mesh is None  # spec decode is single-device
+        # single-row only: the verify loop commits the BATCH-MIN of
+        # per-row accepted drafts (models/gpt.py), so one
+        # low-acceptance row drags every row to ~one token per round
+        # plus the k verify columns — measured in SERVE_BENCH.json
+        # (memorized_mixed_batch4: acceptance collapses to ~0 with a
+        # single random row). Multi-row requests take plain generate.
+        and prompt.shape[0] == 1
         and all(length == prompt.shape[1] for length in lens_list)
         and prompt.shape[1] >= _SPEC_NGRAM
     )
@@ -307,6 +314,10 @@ def DecodeHandlerFactory(state: _State):
         # forever, and the SIGTERM drain (server_close joins non-daemon
         # handler threads) would hang past the pod grace period.
         timeout = 5
+        # a request BODY in flight gets a roomier budget: MAX_BATCH
+        # prompts over a slow link can legitimately take longer than
+        # the idle keep-alive timeout (ADVICE r4)
+        body_timeout = 60
 
         def _reply(self, code: int, payload: dict) -> None:
             body = json.dumps(payload).encode()
@@ -342,7 +353,14 @@ def DecodeHandlerFactory(state: _State):
                 return self._reply(404, {"error": f"no route {self.path}"})
             try:
                 length = int(self.headers.get("Content-Length") or 0)
-                body = json.loads(self.rfile.read(length) or b"{}")
+                # widen the socket budget for the upload only; the
+                # idle timeout comes back before the keep-alive wait
+                self.connection.settimeout(self.body_timeout)
+                try:
+                    raw = self.rfile.read(length) if length else b""
+                finally:
+                    self.connection.settimeout(self.timeout)
+                body = json.loads(raw or b"{}")
             except (ValueError, json.JSONDecodeError) as err:
                 with state.lock:
                     state.request_errors += 1
@@ -374,7 +392,12 @@ def DecodeHandlerFactory(state: _State):
                     })
                 with state.lock:
                     state.decodes += 1
-                    state.tokens_generated += new * len(lens)
+                    # count ALL beams: decode_seconds covers the full
+                    # batch*num_beams device work, so the derived
+                    # tokens/sec must use the same denominator as the
+                    # greedy path or beam throughput reads low
+                    # (ADVICE r4; docs/monitoring.md)
+                    state.tokens_generated += new * num_beams * len(lens)
                 return self._reply(200, {
                     # schema-compatible: tokens = each row's BEST beam
                     "tokens": [row[0].tolist() for row in seqs],
@@ -456,6 +479,7 @@ def make_server(
     speculative: bool = False,
     weights_int8: bool = False,
     mesh=None,
+    warm_shapes=None,
 ) -> ThreadingHTTPServer:
     """In-process server (tests and embedders); caller owns
     serve_forever/shutdown. The CLI binds 0.0.0.0 (pods must be
@@ -521,6 +545,28 @@ def make_server(
             state, decode_fn, window_ms=batch_window_ms,
             max_batch=MAX_BATCH, max_seq_len=cfg.max_seq_len,
         )
+    if warm_shapes:
+        # pre-compile the expected (batch, width, new) decode shapes at
+        # startup: each distinct shape costs one XLA compile (~20-40s
+        # on TPU), and without warming the dynamic batcher's bucketed
+        # shapes that bill lands inside the first clients' latency —
+        # measured in benchmarks/serve_bench.py, where unwarmed bucket
+        # compiles dominated the batched scenario's p95
+        import numpy as np
+
+        for wbatch, wwidth, wnew in warm_shapes:
+            logger.info(
+                "warming decode shape batch=%d width=%d new=%d",
+                wbatch, wwidth, wnew,
+            )
+            _device_decode(
+                state, np.zeros((wbatch, wwidth), np.int32),
+                [wwidth] * wbatch, int(wnew),
+            )
+        # warming is not traffic: zero the counters it bumped
+        state.decode_batches = 0
+        state.decode_seconds = 0.0
+        state.speculative_decodes = 0
     server = ThreadingHTTPServer((host, port), DecodeHandlerFactory(state))
     server.state = state  # tests reach the batcher for shutdown
     return server
@@ -561,6 +607,14 @@ def main(argv=None) -> int:
         "continuations commit several tokens per model read)",
     )
     parser.add_argument(
+        "--warm", action="append", default=[],
+        metavar="BATCHxWIDTHxNEW",
+        help="pre-compile a decode shape at startup (repeatable), e.g. "
+        "--warm 8x128x256 — moves the per-shape XLA compile out of "
+        "the first matching request's latency; with --batch-window-ms "
+        "warm the batcher's power-of-two batch buckets",
+    )
+    parser.add_argument(
         "--tp", type=int, default=1,
         help="tensor-parallel degree for sharded decode: params place "
         "by TRANSFORMER_RULES over a dp x tp mesh and GSPMD shards "
@@ -576,6 +630,31 @@ def main(argv=None) -> int:
     from ..models import gpt as gpt_lib
 
     cfg = gpt_lib.GPT_TINY if args.preset == "tiny" else gpt_lib.GPT_SMALL
+
+    # flag validation BEFORE any device work: a bad --warm spec must be
+    # an argparse error, not a traceback after a 30s TPU init
+    warm_shapes = []
+    for spec in args.warm:
+        parts = spec.split("x")
+        try:
+            wbatch, wwidth, wnew = (int(p) for p in parts)
+        except ValueError:
+            parser.error(
+                f"--warm {spec!r}: expected BATCHxWIDTHxNEW (three "
+                "positive integers, e.g. 8x128x256)"
+            )
+        if min(wbatch, wwidth, wnew) < 1 or wbatch > MAX_BATCH:
+            parser.error(
+                f"--warm {spec!r}: batch must be 1..{MAX_BATCH}, "
+                "width/new >= 1"
+            )
+        if wwidth + wnew > cfg.max_seq_len:
+            parser.error(
+                f"--warm {spec!r}: width+new = {wwidth + wnew} exceeds "
+                f"the preset's max_seq_len {cfg.max_seq_len}"
+            )
+        warm_shapes.append((wbatch, wwidth, wnew))
+
     rng = jax.random.PRNGKey(0)
     if args.checkpoint_dir and export_mod.is_exported_dir(
         args.checkpoint_dir
@@ -638,6 +717,7 @@ def main(argv=None) -> int:
         host=args.host, batch_window_ms=args.batch_window_ms,
         speculative=args.speculative, weights_int8=args.weights_int8,
         mesh=mesh,
+        warm_shapes=warm_shapes,
     )
     logger.info("decode server on :%d", server.server_address[1])
     # graceful drain — the serving sibling of the training-side
