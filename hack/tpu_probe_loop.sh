@@ -1,9 +1,10 @@
 #!/bin/sh
-# Round-4 TPU availability prober. The r3 round lost every hardware
-# artifact to a tunnel outage (TPU_OUTAGE_r03.json); this loop records
-# each probe attempt to TPU_PROBE_r04.jsonl and exits 0 the moment
-# jax.devices() answers with a TPU, so the bench can run immediately.
-LOG="${1:-/root/repo/TPU_PROBE_r04.jsonl}"
+# TPU availability prober (round 5). Rounds 3-4 lost most hardware time
+# to tunnel outages (TPU_OUTAGE_r03/r04.json); this loop records each
+# probe attempt and, the moment jax.devices() answers with a TPU, runs
+# the full bench AND the ResNet op profile (VERDICT r4 next #1) before
+# the window can close.
+LOG="${1:-/root/repo/TPU_PROBE_r05.jsonl}"
 DEADLINE_S="${2:-39600}"   # give up after 11h
 START=$(date +%s)
 while :; do
@@ -16,14 +17,23 @@ print(ds[0].platform, len(ds), getattr(ds[0], 'device_kind', ''))
   RC=$?
   if [ $RC -eq 0 ] && echo "$OUT" | grep -q "^tpu"; then
     printf '{"t":"%s","ok":true,"devices":"%s"}\n' "$NOW" "$(echo "$OUT" | tail -1)" >> "$LOG"
-    # seize the window: the tunnel has died mid-round before
-    # (TPU_OUTAGE_r03.json), so run the full bench IMMEDIATELY and
-    # capture stdout; the operator commits the artifacts after review
+    # seize the window: run the full bench IMMEDIATELY and capture
+    # stdout; the operator commits the artifacts after review
     if [ "${PROBE_RUN_BENCH:-1}" = "1" ]; then
       cd /root/repo && timeout 5400 python bench.py \
-        > /root/repo/BENCH_r04_probe.out 2> /root/repo/BENCH_r04_probe.err
+        > /root/repo/BENCH_r05_probe.out 2> /root/repo/BENCH_r05_probe.err
       BRC=$?  # captured BEFORE the date substitution (bash resets $?)
       printf '{"t":"%s","bench_rc":%d}\n' "$(date -u +%Y-%m-%dT%H:%M:%SZ)" "$BRC" >> "$LOG"
+      # post-BN-fix ResNet op table: PROFILE.md lever #1
+      timeout 1800 python benchmarks/model_profile.py --model resnet \
+        > /root/repo/PROFILE_OPS_r05.out 2> /root/repo/PROFILE_OPS_r05.err
+      PRC=$?  # captured BEFORE the date substitution (bash resets $?)
+      printf '{"t":"%s","profile_rc":%d}\n' "$(date -u +%Y-%m-%dT%H:%M:%SZ)" "$PRC" >> "$LOG"
+      # serving-path numbers (SERVE_BENCH.json, VERDICT r4 next #4)
+      timeout 1800 python benchmarks/serve_bench.py \
+        > /root/repo/SERVE_BENCH_r05.out 2> /root/repo/SERVE_BENCH_r05.err
+      SRC=$?
+      printf '{"t":"%s","serve_bench_rc":%d}\n' "$(date -u +%Y-%m-%dT%H:%M:%SZ)" "$SRC" >> "$LOG"
     fi
     exit 0
   fi
