@@ -1019,3 +1019,29 @@ class TestSpeculativeSampling:
             gpt_lib.generate_speculative(
                 cfg, params, prompt, max_new_tokens=2, top_p=0.0
             )
+
+
+class TestSpeculativeRounds:
+    """return_rounds exposes the verify-round count — the measured
+    acceptance-rate basis (benchmarks/serve_bench.py). The counter
+    must bound the committed tokens: each round commits 1..draft_k+1
+    positions, so rounds is in [ceil((new-1)/(k+1)), new-1]."""
+
+    def test_rounds_bounds_and_output_unchanged(self):
+        cfg = dataclasses.replace(gpt_lib.GPT_TINY, dtype=jnp.float32)
+        params = gpt_lib.GPT(cfg).init(
+            jax.random.PRNGKey(0), jnp.zeros((1, 8), jnp.int32)
+        )["params"]
+        prompt = jax.random.randint(
+            jax.random.PRNGKey(1), (2, 12), 0, cfg.vocab_size
+        )
+        new, k = 20, 4
+        plain = gpt_lib.generate_speculative(
+            cfg, params, prompt, max_new_tokens=new, draft_k=k
+        )
+        out, rounds = gpt_lib.generate_speculative(
+            cfg, params, prompt, max_new_tokens=new, draft_k=k,
+            return_rounds=True,
+        )
+        np.testing.assert_array_equal(np.asarray(out), np.asarray(plain))
+        assert -(-(new - 1) // (k + 1)) <= rounds <= new - 1

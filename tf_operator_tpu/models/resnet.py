@@ -27,22 +27,66 @@ from flax import linen as nn
 ModuleDef = Any
 
 
+class PallasConv3x3(nn.Module):
+    """3x3 conv whose stride-1 forward runs the pallas shifted-window
+    implicit-GEMM kernel (ops/pallas/conv_bn.py) — same "kernel" param
+    name/shape/init as nn.Conv(use_bias=False), so the two paths share
+    checkpoints; shapes the kernel doesn't support fall back to
+    lax.conv_general_dilated."""
+
+    features: int
+    strides: Tuple[int, int] = (1, 1)
+    dtype: jnp.dtype = jnp.bfloat16
+    interpret: bool = False
+
+    @nn.compact
+    def __call__(self, x: jax.Array) -> jax.Array:
+        from ..ops.pallas.conv_bn import conv3x3_s1, supports
+
+        kernel = self.param(
+            "kernel", nn.initializers.lecun_normal(),
+            (3, 3, x.shape[-1], self.features), jnp.float32,
+        ).astype(self.dtype)
+        x = x.astype(self.dtype)
+        if supports(x.shape, kernel.shape, self.strides):
+            return conv3x3_s1(x, kernel, self.interpret)
+        return jax.lax.conv_general_dilated(
+            x, kernel, window_strides=self.strides, padding="SAME",
+            dimension_numbers=("NHWC", "HWIO", "NHWC"),
+        )
+
+
 class BottleneckBlock(nn.Module):
     filters: int
     strides: Tuple[int, int]
     conv: ModuleDef
     norm: ModuleDef
+    conv3_impl: str = "xla"
 
     @nn.compact
     def __call__(self, x: jax.Array) -> jax.Array:
+        # conv names pin the HISTORICAL flax auto-names (Conv_0/1/2):
+        # the param tree must stay byte-identical to pre-conv3_impl
+        # checkpoints on the default path, and identical ACROSS impls
+        # so one trained tree serves both (PallasConv3x3 declares the
+        # same "kernel" param at the same "Conv_1" path)
         residual = x
-        y = self.conv(self.filters, (1, 1))(x)
+        y = self.conv(self.filters, (1, 1), name="Conv_0")(x)
         y = self.norm()(y)
         y = nn.relu(y)
-        y = self.conv(self.filters, (3, 3), self.strides)(y)
+        if self.conv3_impl == "xla":
+            y = self.conv(self.filters, (3, 3), self.strides,
+                          name="Conv_1")(y)
+        else:
+            y = PallasConv3x3(
+                self.filters, strides=self.strides,
+                dtype=y.dtype,
+                interpret=self.conv3_impl == "pallas_interpret",
+                name="Conv_1",
+            )(y)
         y = self.norm()(y)
         y = nn.relu(y)
-        y = self.conv(self.filters * 4, (1, 1))(y)
+        y = self.conv(self.filters * 4, (1, 1), name="Conv_2")(y)
         # zero-init the last BN scale: residual branches start as
         # identity, the standard trick for large-batch training
         y = self.norm(scale_init=nn.initializers.zeros)(y)
@@ -75,6 +119,12 @@ class ResNet(nn.Module):
     # kernel maps exactly onto a 4x4 kernel over the s2d layout
     # (tests/test_workload.py::test_s2d_stem_reparameterizes_conv7).
     stem: str = "conv7"
+    # "xla": nn.Conv everywhere (default); "pallas": the stride-1 3x3
+    # bottleneck convs run the shifted-window implicit-GEMM kernel
+    # (ops/pallas/conv_bn.py — the PROFILE.md conv-tiling attempt,
+    # measured by the resnet_pallas_conv bench extra);
+    # "pallas_interpret": same kernel in interpret mode (CPU tests)
+    conv3_impl: str = "xla"
 
     @nn.compact
     def __call__(self, x: jax.Array, train: bool = True) -> jax.Array:
@@ -120,6 +170,7 @@ class ResNet(nn.Module):
                     strides=strides,
                     conv=conv,
                     norm=norm,
+                    conv3_impl=self.conv3_impl,
                 )(x)
         x = jnp.mean(x, axis=(1, 2))
         return nn.Dense(self.num_classes, dtype=jnp.float32)(x)
