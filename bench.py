@@ -35,432 +35,31 @@ sync, so remote-TPU tunnel round trips cannot pollute the number.
 from __future__ import annotations
 
 import json
-import time
 
 import jax
-import jax.numpy as jnp
-import optax
 
-TARGET_MFU = 0.40
-
-# bf16 peak FLOP/s per chip by device kind substring (public specs).
-PEAK_FLOPS = (
-    ("v6", 918e12),   # Trillium / v6e
-    ("v5p", 459e12),
-    ("v5", 197e12),   # v5e / "TPU v5 lite"
-    ("v4", 275e12),
-    ("v3", 123e12),
-    ("v2", 45e12),
+# the per-family benchmark registry lives in benchmarks/ (VERDICT r4
+# weak #6 split); these re-exports keep the public surface — callers
+# (benchmarks/model_profile.py, benchmarks/moe_bench.py, tests) import
+# setup_*/bench_*/accounting from `bench` as before
+from benchmarks.model_benches import (  # noqa: F401
+    PEAK_FLOPS,
+    TARGET_MFU,
+    bench_bert,
+    bench_gpt,
+    bench_resnet,
+    bench_vit,
+    peak_flops_per_chip,
+    resnet50_step_flops,
+    setup_bert,
+    setup_gpt,
+    setup_resnet,
+    setup_vit,
+    time_fed_steps,
+    time_fused_steps,
+    transformer_step_flops,
 )
-
-
-def peak_flops_per_chip(device) -> float:
-    kind = (getattr(device, "device_kind", "") or "").lower()
-    for token, peak in PEAK_FLOPS:
-        if token in kind:
-            return peak
-    return 0.0  # unknown chip / CPU: MFU reported as 0
-
-
-def resnet50_step_flops(global_batch: int) -> float:
-    """ResNet-50 @224 forward ~= 3.8e9 MACs = 7.7e9 FLOPs per image
-    (published figure); training step ~= 3x forward (backward ~2x
-    forward). GLOBAL-batch FLOPs."""
-    return 3.0 * 7.7e9 * global_batch
-
-
-def transformer_step_flops(
-    params, global_batch: int, seq: int, cfg, causal: bool = False,
-) -> float:
-    """~6*P FLOPs/token for fwd+bwd of a dense transformer (P = total
-    params) plus the attention quadratic term 12 * L * s * h per token
-    (fwd 2 matmuls of 2*s*h each, x3 for train) — halved when causal
-    (the kernel skips blocks past the diagonal). GLOBAL-batch FLOPs."""
-    import jax as _jax
-
-    p_total = sum(x.size for x in _jax.tree_util.tree_leaves(params))
-    attn_coeff = 6.0 if causal else 12.0
-    per_token = (
-        6.0 * p_total + attn_coeff * cfg.num_layers * seq * cfg.hidden_size
-    )
-    return per_token * global_batch * seq
-
-
-def time_fused_steps(trainer, state, batch, steps: int) -> tuple:
-    """(new_state, elapsed_seconds) for `steps` steps in ONE dispatch;
-    compile happens on a separate warmup call with the same step count
-    so the timed run is pure steady-state execution."""
-    state, metrics = trainer.run_steps(state, batch, steps)  # compile + warm
-    float(metrics["loss"])  # sync
-    start = time.perf_counter()
-    state, metrics = trainer.run_steps(state, batch, steps)
-    loss = float(metrics["loss"])  # the state dependency forces full drain
-    elapsed = time.perf_counter() - start
-    assert loss == loss, "NaN loss in benchmark"
-    return state, elapsed
-
-
-def setup_resnet(
-    on_tpu: bool, n_chips: int, norm_impl: str = "tpu", stem: str = "conv7",
-    batch_override: int | None = None,
-):
-    """(trainer, state, placed_batch, meta) for the canonical ResNet
-    benchmark configuration — the ONE place its shape/config constants
-    live, shared by bench_resnet and benchmarks/model_profile.py so
-    the profile always describes the benchmarked workload."""
-    from tf_operator_tpu.models import resnet as resnet_lib
-    from tf_operator_tpu.parallel.mesh import MeshConfig, build_mesh
-    from tf_operator_tpu.parallel.sharding import CONV_RULES
-    from tf_operator_tpu.train import Trainer, classification_task
-
-    if on_tpu:
-        model = resnet_lib.ResNet50(
-            num_classes=1000, norm_impl=norm_impl, stem=stem
-        )
-        per_chip_batch, image_size, classes = 256, 224, 1000
-    else:  # CPU smoke: tiny shapes, same code path
-        model = resnet_lib.ResNet(
-            stage_sizes=(1, 1), num_classes=10, width=8, dtype=jnp.float32,
-            norm_impl=norm_impl, stem=stem,
-        )
-        per_chip_batch, image_size, classes = 8, 64, 10
-    if batch_override is not None:
-        per_chip_batch = batch_override
-    mesh = build_mesh(MeshConfig(dp=-1))
-    trainer = Trainer(
-        model, classification_task(model), optax.sgd(0.1, momentum=0.9),
-        mesh=mesh, rules=CONV_RULES,
-    )
-    rng = jax.random.PRNGKey(0)
-    global_batch = per_chip_batch * n_chips
-    batch = trainer.place_batch(
-        resnet_lib.synthetic_batch(rng, global_batch, image_size, classes)
-    )
-    state = trainer.init(rng, batch)
-    meta = {
-        "global_batch": global_batch,
-        "image_size": image_size,
-        "classes": classes,
-        "resnet_lib": resnet_lib,
-    }
-    return trainer, state, batch, meta
-
-
-def bench_resnet(
-    on_tpu: bool, n_chips: int, norm_impl: str = "tpu",
-    steps: int | None = None, fed: bool = False, stem: str = "conv7",
-    batch_override: int | None = None, fed_uint8: bool = False,
-) -> dict:
-    """norm_impl: "tpu" (TpuBatchNorm, the default) or "flax"
-    (nn.BatchNorm) — benched both ways so the r3 BN rework's effect is
-    attributable (PROFILE.md). fed=True measures with a host input
-    pipeline (fresh per-step device_put, double-buffered) instead of a
-    resident batch — VERDICT r2 weak #5."""
-    steps = steps if steps is not None else (30 if on_tpu else 3)
-    trainer, state, batch, meta = setup_resnet(
-        on_tpu, n_chips, norm_impl=norm_impl, stem=stem,
-        batch_override=batch_override,
-    )
-    rng = jax.random.PRNGKey(0)
-    global_batch = meta["global_batch"]
-    # model-math FLOPs only apply to the real ResNet-50 config; the CPU
-    # smoke model reports mfu 0 regardless (no peak for cpu)
-    flops = resnet50_step_flops(global_batch) if on_tpu else 0.0
-    if fed:
-        state, elapsed = time_fed_steps(
-            trainer, state, rng, global_batch, meta["image_size"],
-            meta["classes"], steps, meta["resnet_lib"],
-            uint8=fed_uint8,
-        )
-    else:
-        state, elapsed = time_fused_steps(trainer, state, batch, steps)
-
-    images_per_sec_chip = global_batch * steps / elapsed / n_chips
-    achieved = flops * steps / elapsed / n_chips
-    peak = peak_flops_per_chip(jax.devices()[0])
-    return {
-        "images_per_sec_per_chip": round(images_per_sec_chip, 2),
-        "step_flops": flops,
-        "mfu": round(achieved / peak, 4) if peak else 0.0,
-        "steps": steps,
-        "global_batch": global_batch,
-    }
-
-
-def time_fed_steps(
-    trainer, state, rng, global_batch, image_size, classes, steps,
-    resnet_lib, uint8: bool = False,
-) -> tuple:
-    """Per-step dispatch with a host feed through the framework's
-    InputPipeline (train/input_pipeline.py): background host batch
-    prep + double-buffered device placement. Includes host->device
-    bytes in the measured time, which the resident-batch number
-    deliberately excludes.
-
-    uint8=True feeds the uint8 wire format (4x fewer bytes than f32;
-    normalization fused on device by the model) — the A/B that shows
-    what the wire format costs on a transfer-bound feed."""
-    import numpy as np
-
-    from tf_operator_tpu.train import InputPipeline
-
-    host_batches = []
-    for i in range(4):  # distinct batches so no transfer is a no-op
-        if uint8:
-            host_batches.append(
-                resnet_lib.synthetic_uint8_batch(
-                    i, global_batch, image_size, classes
-                )
-            )
-            continue
-        b = resnet_lib.synthetic_batch(
-            jax.random.fold_in(rng, i), global_batch, image_size, classes
-        )
-        host_batches.append(
-            {k: np.asarray(v) for k, v in jax.device_get(b).items()}
-        )
-
-    def run(n):
-        nonlocal state
-        last = None
-        with InputPipeline(
-            source=lambda i: host_batches[i % 4], trainer=trainer,
-            depth=2, steps=n,
-        ) as pipe:
-            for batch in pipe:
-                state, last = trainer.step(state, batch)
-        float(last["loss"])  # drain
-
-    run(2)  # compile + warm
-    start = time.perf_counter()
-    run(steps)
-    elapsed = time.perf_counter() - start
-    return state, elapsed
-
-
-def setup_bert(
-    on_tpu: bool, n_chips: int, attention: str = "flash",
-    num_heads: int | None = None,
-):
-    """(trainer, state, placed_batch, meta) for the canonical BERT MLM
-    benchmark configuration — shared with benchmarks/model_profile.py
-    (see setup_resnet)."""
-    from tf_operator_tpu.models import bert as bert_lib
-    from tf_operator_tpu.parallel.mesh import MeshConfig, build_mesh
-    from tf_operator_tpu.train import Trainer, mlm_task
-
-    if on_tpu:
-        cfg = bert_lib.BertConfig(
-            vocab_size=30522, hidden_size=768, num_layers=12,
-            num_heads=num_heads if num_heads is not None else 12,
-            intermediate_size=3072, max_position_embeddings=512,
-        )
-        per_chip_batch, seq = 32, 512
-    else:
-        cfg = bert_lib.BertConfig(
-            vocab_size=1024, hidden_size=128, num_layers=2,
-            num_heads=num_heads if num_heads is not None else 4,
-            intermediate_size=256, max_position_embeddings=128,
-        )
-        per_chip_batch, seq = 4, 128
-
-    if attention == "flash":
-        from tf_operator_tpu.ops.pallas.flash_attention import flash_attention
-
-        model = bert_lib.BertForMLM(cfg, attention_fn=flash_attention)
-    else:
-        model = bert_lib.BertForMLM(cfg)
-    mesh = build_mesh(MeshConfig(dp=-1))
-    trainer = Trainer(
-        model, mlm_task(model),
-        optax.adamw(1e-4, weight_decay=0.01), mesh=mesh,
-        # packed=True: synthetic MLM batches are unpadded; the
-        # all-ones mask is pure overhead even in-kernel, so the
-        # Trainer drops it at the mechanism (trainer._prepare_batch)
-        packed=attention == "flash",
-    )
-    rng = jax.random.PRNGKey(0)
-    global_batch = per_chip_batch * n_chips
-    batch = trainer.place_batch(
-        bert_lib.synthetic_batch(rng, global_batch, seq, cfg)
-    )
-    state = trainer.init(rng, batch)
-    meta = {"global_batch": global_batch, "seq": seq, "cfg": cfg}
-    return trainer, state, batch, meta
-
-
-def bench_bert(
-    on_tpu: bool, n_chips: int, attention: str = "flash",
-    steps: int | None = None, num_heads: int | None = None,
-) -> dict:
-    """attention="flash" (headline): the pallas kernel on a packed
-    batch — synthetic MLM batches are unpadded, so the all-ones mask
-    carries no information and is dropped (the kernel handles real
-    key-padding masks in-kernel; a constant-true mask is just wasted
-    bandwidth). BERT-base head_dim is 64 → the lane-padded kernel.
-    "xla": the previous default, kept as an A/B extra so BENCH reports
-    the kernel's measured contribution (VERDICT r2 next #2)."""
-    steps = steps if steps is not None else (30 if on_tpu else 3)
-    trainer, state, batch, meta = setup_bert(
-        on_tpu, n_chips, attention=attention, num_heads=num_heads
-    )
-    global_batch, seq, cfg = meta["global_batch"], meta["seq"], meta["cfg"]
-    flops = transformer_step_flops(state.params, global_batch, seq, cfg)
-    state, elapsed = time_fused_steps(trainer, state, batch, steps)
-
-    tokens_per_sec_chip = global_batch * seq * steps / elapsed / n_chips
-    achieved = flops * steps / elapsed / n_chips
-    peak = peak_flops_per_chip(jax.devices()[0])
-    return {
-        "tokens_per_sec_per_chip": round(tokens_per_sec_chip, 2),
-        "step_flops": flops,
-        "mfu": round(achieved / peak, 4) if peak else 0.0,
-        "steps": steps,
-        "global_batch": global_batch,
-        "seq_len": seq,
-    }
-
-
-def setup_gpt(
-    on_tpu: bool, n_chips: int, attention: str = "flash",
-    remat: bool = False, batch_override: int | None = None,
-):
-    """(trainer, state, placed_batch, meta) for the canonical GPT
-    long-context benchmark configuration — shared with
-    benchmarks/model_profile.py (see setup_resnet). remat: per-block
-    rematerialization (activation memory ~1 block instead of all 12,
-    bought with an extra forward in the backward)."""
-    from tf_operator_tpu.models import gpt as gpt_lib
-    from tf_operator_tpu.parallel.mesh import MeshConfig, build_mesh
-    from tf_operator_tpu.train import Trainer, causal_lm_task
-
-    if on_tpu:
-        cfg = gpt_lib.GPTConfig(max_seq_len=4096, remat=remat)  # GPT-small
-        # batch 4/chip: the [b, s, vocab] logits (bf16 since the fused
-        # loss, f32 transients inside the loss fusion) plus 12 layers
-        # of activations at seq 4096 — batch 8 crowds the v5e's 16GB;
-        # 4 leaves headroom and 16k tokens/step is plenty for MFU.
-        # (The remat extra probes whether trading that recompute for
-        # batch 8 nets throughput — see gpt_remat in run_extras.)
-        per_chip_batch, seq = 4, 4096
-    else:
-        import dataclasses as _dc
-
-        cfg = _dc.replace(gpt_lib.GPT_TINY, remat=remat)
-        per_chip_batch, seq = 2, 128
-    if batch_override is not None:
-        per_chip_batch = batch_override
-
-    if attention == "xla":
-        from tf_operator_tpu.ops.attention import dot_product_attention
-
-        def xla_causal(q, k, v, mask=None):
-            s = q.shape[1]
-            causal_mask = (
-                jnp.arange(s)[:, None] >= jnp.arange(s)[None, :]
-            )[None, None]
-            return dot_product_attention(q, k, v, causal_mask)
-
-        model = gpt_lib.GPT(cfg, attention_fn=xla_causal)
-    else:
-        model = gpt_lib.GPT(cfg)  # default: causal flash in-kernel
-    mesh = build_mesh(MeshConfig(dp=-1))
-    trainer = Trainer(
-        model, causal_lm_task(model),
-        optax.adamw(3e-4, weight_decay=0.01), mesh=mesh,
-    )
-    rng = jax.random.PRNGKey(0)
-    global_batch = per_chip_batch * n_chips
-    batch = trainer.place_batch(
-        gpt_lib.synthetic_batch(rng, global_batch, seq, cfg)
-    )
-    state = trainer.init(rng, batch)
-    meta = {"global_batch": global_batch, "seq": seq, "cfg": cfg}
-    return trainer, state, batch, meta
-
-
-def bench_gpt(
-    on_tpu: bool, n_chips: int, attention: str = "flash",
-    steps: int | None = None, remat: bool = False,
-    batch_override: int | None = None,
-) -> dict:
-    """Long-context causal LM (GPT-small @ seq 4096): the shape class
-    where flash attention is load-bearing — the XLA path materializes
-    b*h*seq^2 f32 scores (>= fwd+bwd residency of several GB at this
-    config) while the kernel stays O(seq). attention="xla" is the
-    guarded A/B; an OOM there is itself the measurement."""
-    steps = steps if steps is not None else (15 if on_tpu else 3)
-    trainer, state, batch, meta = setup_gpt(
-        on_tpu, n_chips, attention, remat=remat,
-        batch_override=batch_override,
-    )
-    global_batch, seq, cfg = meta["global_batch"], meta["seq"], meta["cfg"]
-    flops = transformer_step_flops(
-        state.params, global_batch, seq, cfg, causal=True
-    )
-    state, elapsed = time_fused_steps(trainer, state, batch, steps)
-
-    tokens_per_sec_chip = global_batch * seq * steps / elapsed / n_chips
-    achieved = flops * steps / elapsed / n_chips
-    peak = peak_flops_per_chip(jax.devices()[0])
-    return {
-        "tokens_per_sec_per_chip": round(tokens_per_sec_chip, 2),
-        "mfu": round(achieved / peak, 4) if peak else 0.0,
-        "steps": steps,
-        "global_batch": global_batch,
-        "seq_len": seq,
-    }
-
-
-def setup_vit(on_tpu: bool, n_chips: int):
-    """(trainer, state, placed_batch, meta) for the canonical ViT-B/16
-    benchmark configuration — shared with benchmarks/model_profile.py
-    (see setup_resnet)."""
-    from tf_operator_tpu.models import vit as vit_lib
-    from tf_operator_tpu.parallel.mesh import MeshConfig, build_mesh
-    from tf_operator_tpu.parallel.sharding import TRANSFORMER_RULES
-    from tf_operator_tpu.train import Trainer, classification_task
-
-    cfg = vit_lib.VIT_B16 if on_tpu else vit_lib.VIT_TINY
-    per_chip_batch = 128 if on_tpu else 8
-    model = vit_lib.ViT(cfg)
-    mesh = build_mesh(MeshConfig(dp=-1))
-    trainer = Trainer(
-        model, classification_task(model),
-        optax.adamw(1e-3, weight_decay=0.05),
-        mesh=mesh, rules=TRANSFORMER_RULES,
-    )
-    rng = jax.random.PRNGKey(0)
-    global_batch = per_chip_batch * n_chips
-    batch = trainer.place_batch(
-        vit_lib.synthetic_batch(rng, global_batch, cfg)
-    )
-    state = trainer.init(rng, batch)
-    meta = {"global_batch": global_batch, "cfg": cfg}
-    return trainer, state, batch, meta
-
-
-def bench_vit(on_tpu: bool, n_chips: int, steps: int | None = None) -> dict:
-    """ViT-B/16 @224 classification — the attention-side image model:
-    near-pure transformer GEMMs where ResNet is conv-tiling-limited
-    (PROFILE.md), so the pair brackets the image-model MFU range. MFU
-    uses the same stated transformer formula with seq = patch count."""
-    steps = steps if steps is not None else (15 if on_tpu else 3)
-    trainer, state, batch, meta = setup_vit(on_tpu, n_chips)
-    global_batch, cfg = meta["global_batch"], meta["cfg"]
-    flops = transformer_step_flops(
-        state.params, global_batch, cfg.num_patches, cfg
-    )
-    state, elapsed = time_fused_steps(trainer, state, batch, steps)
-    images_per_sec_chip = global_batch * steps / elapsed / n_chips
-    achieved = flops * steps / elapsed / n_chips
-    peak = peak_flops_per_chip(jax.devices()[0])
-    return {
-        "images_per_sec_per_chip": round(images_per_sec_chip, 2),
-        "mfu": round(achieved / peak, 4) if peak else 0.0,
-        "steps": steps,
-        "global_batch": global_batch,
-    }
+from benchmarks.extras import run_extras  # noqa: F401
 
 
 def _maybe_force_cpu() -> None:
@@ -479,458 +78,6 @@ def _maybe_force_cpu() -> None:
         ).strip()
     jax.config.update("jax_platforms", "cpu")
 
-
-def run_extras(on_tpu: bool, n_chips: int, line: dict) -> None:
-    """Secondary measurements + side artifacts, each individually
-    guarded so a failure (or an interrupted bench) can never cost the
-    headline numbers already in `line`:
-
-    - flax-BN A/B (attributes the BN rework's effect, PROFILE.md)
-    - fed_images_per_sec (host input pipeline, VERDICT r2 weak #5)
-    - FLASH_BENCH.json (flash vs XLA attention, VERDICT r2 next #2/#6)
-    - MNIST_ACC.json (BASELINE row 3 accuracy artifact)
-
-    Disable with BENCH_EXTRAS=0.
-    """
-    import io
-    import os
-    import sys
-    from contextlib import redirect_stdout
-
-    if os.environ.get("BENCH_EXTRAS") == "0":
-        return
-    # BENCH_EXTRAS_FORCE=1: run the TPU-gated extras off-TPU too, at
-    # CPU-tiny shapes — the presubmit smoke for the exact code that must
-    # produce the round's judged artifacts in one unattended TPU shot
-    # (VERDICT r3 weak #3: a latent arg/import bug in a gated extra
-    # fails quietly into *_error and costs a full round of evidence)
-    force = os.environ.get("BENCH_EXTRAS_FORCE") == "1"
-    gated = on_tpu or force
-
-    def extra(name, fn):
-        start = time.perf_counter()
-        try:
-            fn()
-        except Exception as err:  # noqa: BLE001 — extras must not kill bench
-            line[name + "_error"] = f"{type(err).__name__}: {err}"[:200]
-        finally:
-            # per-extra wall time, so a budget-truncated run shows
-            # exactly where the time went (tunnels make this vital)
-            line.setdefault("extras_seconds", {})[name] = round(
-                time.perf_counter() - start, 1
-            )
-            print(
-                f"extra {name}: {line['extras_seconds'][name]}s",
-                file=sys.stderr, flush=True,
-            )
-
-    def flax_ab():
-        r = bench_resnet(
-            on_tpu, n_chips, norm_impl="flax",
-            steps=15 if on_tpu else None,
-        )
-        line["resnet_flax_bn_mfu"] = r["mfu"]
-        line["resnet_flax_bn_images_per_sec_per_chip"] = r[
-            "images_per_sec_per_chip"
-        ]
-
-    def fed():
-        r = bench_resnet(
-            on_tpu, n_chips, steps=15 if on_tpu else None, fed=True
-        )
-        line["fed_images_per_sec_per_chip"] = r["images_per_sec_per_chip"]
-
-    def fed_u8():
-        # r4 measured the f32 feed at 31 img/s/chip: transfer-bound
-        # (154MB/batch through the tunnel; PCIe on a real host). uint8
-        # wire + on-device normalize is the standard image input path
-        # — this A/B measures what the 4x byte cut buys end-to-end
-        r = bench_resnet(
-            on_tpu, n_chips, steps=15 if on_tpu else None, fed=True,
-            fed_uint8=True,
-        )
-        line["fed_u8_images_per_sec_per_chip"] = r[
-            "images_per_sec_per_chip"
-        ]
-
-    def bert_wide():
-        # BERT_BASE_WIDE shape class (6 heads x 128 = same hidden/param
-        # count as base): head_dim 128 is MXU-native, so the flash
-        # kernel spends no lane-padding FLOPs — the A/B that shows what
-        # the 12x64 head split costs. (CPU smoke: hidden 128 → 2 heads
-        # give the same native-64 head_dim class.)
-        r = bench_bert(
-            on_tpu, n_chips, steps=15 if on_tpu else None,
-            num_heads=6 if on_tpu else 2,
-        )
-        line["bert_wide_heads_mfu"] = r["mfu"]
-        line["bert_wide_heads_tokens_per_sec_per_chip"] = r[
-            "tokens_per_sec_per_chip"
-        ]
-
-    def gpt_long():
-        r = bench_gpt(on_tpu, n_chips)
-        line["gpt_seq4096_tokens_per_sec_per_chip"] = r[
-            "tokens_per_sec_per_chip"
-        ]
-        line["gpt_seq4096_mfu"] = r["mfu"]
-
-    def _decode_setup(long: bool = False):
-        from tf_operator_tpu.models import gpt as gpt_lib
-
-        if on_tpu and long:
-            # cache >> params: generate() sizes the KV cache to
-            # prompt_len + max_new_tokens, so the pair must SUM to 4096
-            # — at batch 4 that is ~600MB of bf16 KV against 248MB of
-            # weights, the regime where the int8 cache's byte cut
-            # dominates the step's HBM traffic
-            cfg = gpt_lib.GPTConfig(max_seq_len=4096)
-            batch, prompt_len, new = 4, 256, 3840
-        elif on_tpu:
-            cfg = gpt_lib.GPTConfig(max_seq_len=1024)  # GPT-small
-            batch, prompt_len, new = 8, 128, 512
-        else:  # smoke: same code path, CPU-feasible shapes
-            cfg = gpt_lib.GPT_TINY
-            batch, prompt_len, new = 4, 16, 16
-        rng = jax.random.PRNGKey(0)
-        params = gpt_lib.GPT(cfg).init(
-            rng, jnp.zeros((1, 8), jnp.int32)
-        )["params"]
-        prompt = jax.random.randint(rng, (batch, prompt_len), 0,
-                                    cfg.vocab_size)
-        return gpt_lib, cfg, params, prompt, batch, prompt_len, new
-
-    def _time_decode(gpt_lib, cfg, params, prompt, new, fn=None,
-                     **kw) -> float:
-        call = fn if fn is not None else gpt_lib.generate
-        out = call(cfg, params, prompt, max_new_tokens=new, **kw)
-        int(out.sum())  # compile + warm; value transfer = real barrier
-        # measured call gets a DIFFERENT prompt: through the remote
-        # tunnel, a repeat of a byte-identical dispatch can be served
-        # from cache (observed on this round's chip — see
-        # benchmarks/flash_vs_xla.py time_grad docstring), and
-        # block_until_ready returns before remote completion, so the
-        # sync must be a value transfer
-        prompt2 = (prompt + 1) % cfg.vocab_size
-        int(prompt2.sum())  # materialize outside the timed window
-        start = time.perf_counter()
-        out = call(cfg, params, prompt2, max_new_tokens=new, **kw)
-        int(out.sum())
-        return time.perf_counter() - start
-
-    def gpt_decode():
-        # KV-cached autoregressive decode throughput (models/gpt.py
-        # generate: one jitted lax.scan over steps) — the serving-side
-        # number; decode is bandwidth-bound, so tokens/sec, not MFU
-        gpt_lib, cfg, params, prompt, batch, prompt_len, new = (
-            _decode_setup()
-        )
-        elapsed = _time_decode(gpt_lib, cfg, params, prompt, new)
-        # generate() is a single-device jit (no mesh), so this is a
-        # one-chip number regardless of host chip count — not divided
-        # by n_chips. The rate counts ALL token positions processed
-        # (prompt_len-1 prefill + `new` generated): the denominator is
-        # one batched prefill forward plus `new` sequential steps, so
-        # the same metric directly shows what the prefill path buys on
-        # prompt-heavy shapes (the metric would otherwise shift with
-        # prompt_len alone)
-        line["gpt_decode_tokens_per_sec"] = round(
-            batch * (prompt_len - 1 + new) / elapsed, 2
-        )
-
-    def gpt_decode_int8():
-        # int8 KV cache (models/gpt.py CachedSelfAttention): decode
-        # re-reads the whole cache every step, so half the KV bytes is
-        # the serving bandwidth lever — this extra measures what it
-        # buys against gpt_decode's bf16-cache number at the same shape
-        gpt_lib, cfg, params, prompt, batch, prompt_len, new = (
-            _decode_setup()
-        )
-        elapsed = _time_decode(
-            gpt_lib, cfg, params, prompt, new, kv_quant_int8=True
-        )
-        line["gpt_decode_int8_tokens_per_sec"] = round(
-            batch * (prompt_len - 1 + new) / elapsed, 2
-        )
-
-    def gpt_decode_long():
-        # bf16-cache control for the long-context serving A/B (see
-        # _decode_setup(long=True)); cache length is the tokens/sec
-        # driver here, so this pair is where the factored int8 path
-        # (models/gpt.py _cache_attention) must show its win
-        gpt_lib, cfg, params, prompt, batch, prompt_len, new = (
-            _decode_setup(long=True)
-        )
-        elapsed = _time_decode(gpt_lib, cfg, params, prompt, new)
-        line["gpt_decode_seq4096_tokens_per_sec"] = round(
-            batch * (prompt_len - 1 + new) / elapsed, 2
-        )
-
-    def gpt_decode_long_int8():
-        gpt_lib, cfg, params, prompt, batch, prompt_len, new = (
-            _decode_setup(long=True)
-        )
-        elapsed = _time_decode(
-            gpt_lib, cfg, params, prompt, new, kv_quant_int8=True
-        )
-        line["gpt_decode_seq4096_int8_tokens_per_sec"] = round(
-            batch * (prompt_len - 1 + new) / elapsed, 2
-        )
-
-    def _quantized_decode_setup():
-        # pre-quantize OUTSIDE the timed window — serving pays the
-        # transform once at load (serve/server.py make_server), so the
-        # A/B must measure the steady-state int8 path, not a per-call
-        # re-quantization generate() would otherwise perform
-        from tf_operator_tpu.ops.quant import quantize_params
-
-        gpt_lib, cfg, params, prompt, batch, prompt_len, new = (
-            _decode_setup()
-        )
-        params = jax.block_until_ready(quantize_params(params))
-        return gpt_lib, cfg, params, prompt, batch, prompt_len, new
-
-    def gpt_decode_w8():
-        # int8 weights (ops/quant.py): decode's OTHER bandwidth half —
-        # params are re-read per token just like the cache; scales
-        # factored onto the matmul outputs, same discipline as the
-        # int8 KV cache
-        gpt_lib, cfg, params, prompt, batch, prompt_len, new = (
-            _quantized_decode_setup()
-        )
-        elapsed = _time_decode(
-            gpt_lib, cfg, params, prompt, new, weights_int8=True
-        )
-        line["gpt_decode_w8_tokens_per_sec"] = round(
-            batch * (prompt_len - 1 + new) / elapsed, 2
-        )
-
-    def gpt_decode_w8kv8():
-        # both int8 levers composed: the full halved-traffic decode
-        gpt_lib, cfg, params, prompt, batch, prompt_len, new = (
-            _quantized_decode_setup()
-        )
-        elapsed = _time_decode(
-            gpt_lib, cfg, params, prompt, new, weights_int8=True,
-            kv_quant_int8=True,
-        )
-        line["gpt_decode_w8kv8_tokens_per_sec"] = round(
-            batch * (prompt_len - 1 + new) / elapsed, 2
-        )
-
-    def moe():
-        # the expert-parallel family's first number ever (VERDICT r4
-        # missing #2): tokens/sec/chip + active-param MFU + router
-        # balance/drop stats — benchmarks/moe_bench.py
-        from benchmarks.moe_bench import bench_moe
-
-        r = bench_moe(on_tpu, n_chips)
-        line["moe_tokens_per_sec_per_chip"] = r["tokens_per_sec_per_chip"]
-        line["moe_mfu"] = r["mfu"]
-        line["moe_router_balance"] = r["router_balance"]
-        line["moe_routed_token_fraction"] = r["routed_token_fraction"]
-
-    def moe_decode():
-        from benchmarks.moe_bench import bench_moe_decode
-
-        r = bench_moe_decode(on_tpu)
-        line["moe_decode_tokens_per_sec"] = r["tokens_per_sec"]
-
-    def gpt_decode_spec():
-        # prompt-lookup speculative decoding (models/gpt.py
-        # generate_speculative; greedy-exact) at gpt_decode's shape —
-        # tokens/sec depends on how n-gram-repetitive the model's own
-        # continuation is, so this measures the bench model's real
-        # acceptance rate, favorable or not
-        gpt_lib, cfg, params, prompt, batch, prompt_len, new = (
-            _decode_setup()
-        )
-        elapsed = _time_decode(
-            gpt_lib, cfg, params, prompt, new,
-            fn=gpt_lib.generate_speculative,
-        )
-        line["gpt_decode_spec_tokens_per_sec"] = round(
-            batch * (prompt_len - 1 + new) / elapsed, 2
-        )
-
-    def gpt_decode_tp():
-        # the mesh-aware decode path the dryrun validates (VERDICT r3
-        # weak #5 / next #6): generate(mesh=) places params by
-        # TRANSFORMER_RULES (Megatron tp) and lets GSPMD shard the KV
-        # cache. tp=2 when ≥2 devices exist (the 8-virtual-CPU smoke);
-        # on the single-chip bench TPU, tp=1 still exercises the full
-        # sharded code path (constraints become no-ops), so the number
-        # stays comparable to gpt_decode and the path is never skipped
-        from tf_operator_tpu.parallel.mesh import MeshConfig, build_mesh
-
-        gpt_lib, cfg, params, prompt, batch, prompt_len, new = (
-            _decode_setup()
-        )
-        tp = 2 if len(jax.devices()) >= 2 else 1
-        mesh = build_mesh(MeshConfig(dp=-1, tp=tp))
-        elapsed = _time_decode(
-            gpt_lib, cfg, params, prompt, new, mesh=mesh
-        )
-        line["gpt_decode_tp"] = tp
-        line["gpt_decode_tp_tokens_per_sec"] = round(
-            batch * (prompt_len - 1 + new) / elapsed, 2
-        )
-
-    def gpt_remat():
-        # the HBM/FLOPs trade (jax.checkpoint): per-block remat frees
-        # ~11 layers of activations at seq 4096, buying per-chip batch
-        # 8 where the default config tops out at 4 — does the extra
-        # backward forward pay for itself in throughput? (an OOM lands
-        # in gpt_remat_error and is itself a measurement)
-        bs = 8 if on_tpu else 2
-        r = bench_gpt(
-            on_tpu, n_chips, steps=10 if on_tpu else None, remat=True,
-            batch_override=bs,
-        )
-        line[f"gpt_remat_bs{bs}_tokens_per_sec_per_chip"] = r[
-            "tokens_per_sec_per_chip"
-        ]
-        line[f"gpt_remat_bs{bs}_mfu"] = r["mfu"]
-
-    def gpt_long_xla():
-        # the A/B where the kernel is load-bearing: the XLA path's
-        # quadratic score materialization at seq 4096 — an OOM lands
-        # in gpt_long_xla_error and is itself the measurement
-        r = bench_gpt(
-            on_tpu, n_chips, attention="xla",
-            steps=10 if on_tpu else None,
-        )
-        line["gpt_seq4096_xla_tokens_per_sec_per_chip"] = r[
-            "tokens_per_sec_per_chip"
-        ]
-
-    def s2d():
-        r = bench_resnet(
-            on_tpu, n_chips, steps=15 if on_tpu else None, stem="s2d"
-        )
-        line["resnet_s2d_stem_mfu"] = r["mfu"]
-        line["resnet_s2d_stem_images_per_sec_per_chip"] = r[
-            "images_per_sec_per_chip"
-        ]
-
-    def vit():
-        r = bench_vit(on_tpu, n_chips)
-        line["vit_b16_mfu"] = r["mfu"]
-        line["vit_b16_images_per_sec_per_chip"] = r[
-            "images_per_sec_per_chip"
-        ]
-
-    def bs512():
-        # occupancy probe: does 2x the per-chip batch lift MXU
-        # utilization? (guarded: an HBM OOM lands in bs512_error,
-        # never in the headline)
-        r = bench_resnet(
-            on_tpu, n_chips, steps=10 if on_tpu else None,
-            batch_override=512 if on_tpu else 16,
-        )
-        line["resnet_bs512_mfu"] = r["mfu"]
-
-    def bs128():
-        # the occupancy curve's other side: r4 measured bs512 WORSE
-        # than 256 (0.2839 vs 0.3067), and the r1 harness got its best
-        # img/s at per-chip batch 128 under a worse dispatch regime —
-        # if 128 wins, smaller activations (less HBM pressure per conv
-        # fusion) beat raw MXU occupancy at ResNet's shapes and the
-        # canonical config should move
-        r = bench_resnet(
-            on_tpu, n_chips, steps=20 if on_tpu else None,
-            batch_override=128 if on_tpu else 8,
-        )
-        line["resnet_bs128_mfu"] = r["mfu"]
-        line["resnet_bs128_images_per_sec_per_chip"] = r[
-            "images_per_sec_per_chip"
-        ]
-
-    def flash():
-        from benchmarks.flash_vs_xla import run as flash_run
-
-        rows = flash_run(quick=True, write=on_tpu)
-        # rows may carry flash_error/xla_error instead of timings (the
-        # per-path guards record OOMs and tunnel failures in-row); only
-        # rows that actually measured something count here
-        line["flash_speedup_seq2048_hd128"] = next(
-            (r["speedup"] for r in rows
-             if r["seq"] == 2048 and r["head_dim"] == 128
-             and "speedup" in r), None,
-        )
-        measured = [r["seq"] for r in rows if "flash_ms" in r]
-        line["flash_max_seq_measured"] = max(measured, default=None)
-
-    def mnist():
-        import tempfile
-
-        from tf_operator_tpu.train import mnist as mnist_main
-
-        if on_tpu:
-            argv = [
-                "--steps", "1000", "--batch-size", "512",
-                "--target-accuracy", "0.99", "--acc-json", "MNIST_ACC.json",
-                "--log-every", "500",
-            ]
-            acc_path = "MNIST_ACC.json"
-        else:  # smoke: same entrypoint + artifact code, not the claim
-            acc_path = os.path.join(tempfile.mkdtemp(), "MNIST_ACC.json")
-            argv = [
-                "--steps", "20", "--batch-size", "64",
-                "--acc-json", acc_path, "--log-every", "10",
-            ]
-        buf = io.StringIO()
-        with redirect_stdout(buf):  # nothing may print before our line
-            rc = mnist_main.main(argv)
-        line["mnist_target_reached"] = rc == 0
-        if os.path.exists(acc_path):
-            with open(acc_path) as handle:
-                line["mnist_eval_accuracy"] = json.load(handle).get(
-                    "eval_accuracy"
-                )
-
-    # importance order: if the driver's budget truncates the run, the
-    # artifacts the round is judged on (FLASH_BENCH.json,
-    # MNIST_ACC.json) come first, then everything NOT YET measured on
-    # hardware (the r4-interactive window measured the resnet
-    # attribution A/Bs, fed, gpt_long, remat, bert_wide, vit and the
-    # seq-1024 decode pair — those re-measure LAST); the line is
-    # re-printed by main() after whatever completed. (The BERT
-    # flash-vs-XLA A/B lives in the headline phase, where the winner
-    # is chosen — main() fills the bert_xla_attention_* fields.)
-    if gated:  # kernels + accuracy targets are TPU-only claims
-        extra("flash", flash)
-        extra("mnist", mnist)
-        # -- unmeasured-as-of-r4-interactive group --
-        extra("resnet_bs128", bs128)
-        extra("gpt_decode_w8", gpt_decode_w8)
-        extra("gpt_decode_w8kv8", gpt_decode_w8kv8)
-        extra("gpt_decode_long", gpt_decode_long)
-        extra("gpt_decode_long_int8", gpt_decode_long_int8)
-        extra("gpt_decode_spec", gpt_decode_spec)
-        extra("moe", moe)
-        extra("moe_decode", moe_decode)
-    extra("fed_u8", fed_u8)
-    if gated:
-        # -- re-measurement group (r4-interactive numbers exist) --
-        extra("gpt_long", gpt_long)
-        extra("gpt_decode", gpt_decode)
-        extra("gpt_decode_int8", gpt_decode_int8)
-        extra("gpt_decode_tp", gpt_decode_tp)
-        extra("gpt_remat", gpt_remat)
-        extra("bert_wide", bert_wide)
-        extra("vit", vit)
-    extra("resnet_flax_bn", flax_ab)
-    if gated:  # stem A/B only meaningful at the real 224/3-channel shape
-        extra("resnet_s2d", s2d)
-        extra("resnet_bs512", bs512)
-    extra("fed", fed)
-    if gated:
-        # LAST: this A/B is expected to OOM at seq 4096 (that is the
-        # measurement) — a hard abort or fragmented HBM must not cost
-        # any other extra
-        extra("gpt_long_xla", gpt_long_xla)
-    print("extras done", file=sys.stderr, flush=True)
 
 
 def _watchdog(seconds: float, what: str, likely: str):

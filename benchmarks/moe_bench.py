@@ -144,7 +144,9 @@ def bench_moe(on_tpu: bool, n_chips: int, steps: int | None = None) -> dict:
     """MoE pretraining throughput: tokens/sec/chip + active-param MFU +
     router health. Same fused-scan timing discipline as the dense
     families (bench.py time_fused_steps)."""
-    from bench import peak_flops_per_chip, time_fused_steps
+    from benchmarks.model_benches import (
+        peak_flops_per_chip, time_fused_steps,
+    )
 
     steps = steps if steps is not None else (15 if on_tpu else 3)
     trainer, state, batch, meta = setup_moe(on_tpu, n_chips)

@@ -22,6 +22,7 @@ EXPECTED_EXTRAS = {
     "flash", "mnist", "gpt_long", "gpt_decode", "gpt_decode_int8",
     "gpt_decode_long", "gpt_decode_long_int8", "gpt_decode_spec",
     "gpt_decode_w8", "gpt_decode_w8kv8", "moe", "moe_decode",
+    "resnet_pallas_conv",
     "gpt_decode_tp", "gpt_remat", "bert_wide", "vit", "resnet_flax_bn",
     "resnet_s2d", "resnet_bs512", "resnet_bs128", "fed", "fed_u8",
     "gpt_long_xla",
