@@ -173,7 +173,7 @@ def bench_moe_decode(on_tpu: bool) -> dict:
     token routes through the trained experts). Single-device jit like
     gpt_decode; the rate counts all token positions processed. The
     measured call gets a DIFFERENT prompt (tunnel dispatch-cache trap,
-    see bench.py _time_decode)."""
+    see _time_decode inside benchmarks/extras.py run_extras)."""
     from tf_operator_tpu.models import moe as moe_lib
 
     if on_tpu:

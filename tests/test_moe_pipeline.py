@@ -443,3 +443,49 @@ class TestMoEDecode:
                 cfg, params, prompt,
                 max_new_tokens=cfg.max_position_embeddings,
             )
+
+
+class TestMoEPrefill:
+    """Batched MoE prefill (models/moe.py MoEPrefill): one forward
+    fills the cache for the whole prompt; its last-position logits and
+    the decode continuation must match the per-token path exactly
+    (routing is per-token in both phases)."""
+
+    @pytest.fixture(scope="class")
+    def setup(self):
+        cfg = dataclasses.replace(
+            m.MOE_TINY, capacity_factor=2.0, num_layers=2,
+        )
+        params = m.MoELM(cfg).init(
+            jax.random.PRNGKey(0), jnp.zeros((1, 8), jnp.int32)
+        )["params"]
+        return cfg, params
+
+    def test_prefill_logits_match_training_forward(self, setup):
+        cfg, params = setup
+        prompt = jax.random.randint(
+            jax.random.PRNGKey(3), (2, 9), 0, cfg.vocab_size
+        )
+        train_logits = m.MoELM(cfg).apply({"params": params}, prompt)
+        prefill_logits, _ = m.MoEPrefill(cfg, cache_len=12).apply(
+            {"params": params}, prompt, mutable=["cache"]
+        )
+        np.testing.assert_allclose(
+            np.asarray(prefill_logits), np.asarray(train_logits[:, -1]),
+            atol=2e-4, rtol=2e-4,
+        )
+
+    def test_generate_single_new_token(self, setup):
+        # max_new_tokens=1: the post-prefill scan is EMPTY — the chain
+        # is prompt + the prefill's own argmax
+        cfg, params = setup
+        prompt = jax.random.randint(
+            jax.random.PRNGKey(4), (2, 6), 0, cfg.vocab_size
+        )
+        out = m.moe_generate(cfg, params, prompt, max_new_tokens=1)
+        assert out.shape == (2, 7)
+        train_logits = m.MoELM(cfg).apply({"params": params}, prompt)
+        np.testing.assert_array_equal(
+            np.asarray(out[:, -1]),
+            np.asarray(jnp.argmax(train_logits[:, -1], axis=-1)),
+        )

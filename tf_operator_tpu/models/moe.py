@@ -427,27 +427,85 @@ class _MoECachedBlock(nn.Module):
         return x + y
 
 
+class _MoEPrefillBlock(nn.Module):
+    """MoEBlock's whole-prompt cache-filling twin (same child param
+    paths as _MoECachedBlock). Attention is the shared batched
+    PrefillSelfAttention (models/gpt.py); the MoE FFN routes each
+    position in its OWN one-token group — exactly the decode step's
+    routing, so prefill cannot introduce capacity drops the per-token
+    path wouldn't (the parity contract TestMoEDecode pins)."""
+
+    config: MoEConfig
+    use_moe: bool = True
+    cache_len: int = 0
+
+    @nn.compact
+    def __call__(self, x: jax.Array) -> jax.Array:
+        from .gpt import PrefillSelfAttention
+
+        cfg = self.config
+        b, p, _ = x.shape
+        y = nn.LayerNorm(dtype=jnp.float32, name="ln_attn")(x)
+        y = PrefillSelfAttention(
+            num_heads=cfg.num_heads, head_dim=cfg.head_dim,
+            max_len=self.cache_len, dtype=cfg.dtype, name="attention",
+        )(y.astype(cfg.dtype))
+        x = x + y
+        y = nn.LayerNorm(dtype=jnp.float32, name="ln_mlp")(x)
+        if self.use_moe:
+            y = MoEMlp(cfg, name="moe_mlp")(
+                y.reshape(b * p, 1, -1)
+            ).reshape(b, p, -1)
+        else:
+            y = nn.Dense(
+                cfg.intermediate_size, dtype=cfg.dtype, name="mlp_in"
+            )(y.astype(cfg.dtype))
+            y = nn.gelu(y)
+            y = nn.Dense(
+                cfg.hidden_size, dtype=cfg.dtype, name="mlp_out"
+            )(y)
+        return x + y
+
+
+class MoEPrefill(nn.Module):
+    """Whole-prompt forward that fills the KV cache and returns the
+    LAST position's logits — the MoE family's batched prefill (GPT's
+    GPTPrefill analog): prompt ingestion is ONE forward of MXU-shaped
+    matmuls instead of prompt_len sequential one-token steps.
+    Param-path identical to MoELM/MoEDecodeStep."""
+
+    config: MoEConfig
+    cache_len: int = 0
+
+    @nn.compact
+    def __call__(self, tokens: jax.Array) -> jax.Array:  # [b, p]
+        cfg = self.config
+        cache_len = self.cache_len or cfg.max_position_embeddings
+        x = MoEEmbed(cfg, name="embed")(tokens)
+        for layer in range(cfg.num_layers):
+            x = _MoEPrefillBlock(
+                cfg, use_moe=layer_is_moe(cfg, layer),
+                cache_len=cache_len, name=f"layer_{layer}",
+            )(x)
+        return MoEHead(cfg, name="head")(x[:, -1])
+
+
 @functools.lru_cache(maxsize=16)
-def _compiled_moe_decode(cfg: MoEConfig, batch: int, prompt_len: int,
-                         total: int):
-    """One compiled greedy decode per (config, shape): every position
-    steps through the one-token model (prompt positions teacher-forced
-    — the per-token path; a batched MoE prefill can come later without
-    changing this contract)."""
+def _compiled_moe_decode(cfg: MoEConfig, prompt_len: int, total: int):
+    """One compiled greedy decode per (config, shape): a batched
+    prefill fills the cache for the whole prompt in one forward, then
+    a lax.scan of one-token steps generates. Routing is per-token in
+    both phases (see _MoEPrefillBlock), so the output equals the
+    old all-teacher-forced per-token formulation exactly."""
+    prefill = MoEPrefill(cfg, cache_len=total)
     model = MoEDecodeStep(cfg, cache_len=total)
-    cache_shapes = jax.eval_shape(
-        lambda: model.init(
-            jax.random.PRNGKey(0), jnp.zeros((batch,), jnp.int32),
-            jnp.int32(0),
-        )["cache"]
-    )
 
     @jax.jit
     def run(params, prompt):
-        cache0 = jax.tree_util.tree_map(
-            lambda s: jnp.zeros(s.shape, s.dtype), cache_shapes
+        logits, updates = prefill.apply(
+            {"params": params}, prompt, mutable=["cache"]
         )
-        first = prompt[:, 0].astype(jnp.int32)
+        first_new = jnp.argmax(logits, axis=-1).astype(jnp.int32)
 
         def step(carry, index):
             cache, tok = carry
@@ -456,15 +514,15 @@ def _compiled_moe_decode(cfg: MoEConfig, batch: int, prompt_len: int,
                 mutable=["cache"],
             )
             nxt = jnp.argmax(logits, axis=-1).astype(jnp.int32)
-            in_prompt = index + 1 < prompt_len
-            forced = prompt[:, jnp.minimum(index + 1, prompt_len - 1)]
-            nxt = jnp.where(in_prompt, forced, nxt).astype(jnp.int32)
             return (updates["cache"], nxt), nxt
 
         (_, _), toks = jax.lax.scan(
-            step, (cache0, first), jnp.arange(total - 1)
+            step, (updates["cache"], first_new),
+            jnp.arange(prompt_len, total - 1),
         )
-        return jnp.concatenate([prompt[:, :1], toks.T], axis=1)
+        return jnp.concatenate(
+            [prompt, first_new[:, None], toks.T], axis=1
+        )
 
     return run
 
@@ -477,7 +535,7 @@ def moe_generate(
     decode step routes each new token through the same trained experts
     the training forward used (teacher-forced parity pinned by
     tests/test_moe_pipeline.py::TestMoEDecode)."""
-    batch, prompt_len = prompt.shape
+    prompt_len = prompt.shape[1]
     total = prompt_len + max_new_tokens
     if max_new_tokens < 1:
         raise ValueError(
@@ -488,5 +546,5 @@ def moe_generate(
             f"prompt+new = {total} exceeds max_position_embeddings "
             f"{cfg.max_position_embeddings}"
         )
-    run = _compiled_moe_decode(cfg, batch, prompt_len, total)
+    run = _compiled_moe_decode(cfg, prompt_len, total)
     return run(params, jnp.asarray(prompt, jnp.int32))
