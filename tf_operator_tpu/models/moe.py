@@ -203,6 +203,19 @@ class MoEMlp(nn.Module):
         return y
 
 
+def _dense_mlp(cfg: MoEConfig, y: jax.Array) -> jax.Array:
+    """The non-MoE blocks' FFN — ONE definition of the mlp_in/gelu/
+    mlp_out stack (param names are a cross-phase contract: MoEBlock,
+    _MoECachedBlock and _MoEPrefillBlock must all read the same
+    trained tree). Must be called from inside a block's @nn.compact
+    __call__ — the Dense modules attach to the calling block."""
+    y = nn.Dense(
+        cfg.intermediate_size, dtype=cfg.dtype, name="mlp_in"
+    )(y.astype(cfg.dtype))
+    y = nn.gelu(y)
+    return nn.Dense(cfg.hidden_size, dtype=cfg.dtype, name="mlp_out")(y)
+
+
 class MoEBlock(nn.Module):
     config: MoEConfig
     use_moe: bool = True
@@ -225,11 +238,7 @@ class MoEBlock(nn.Module):
                 cfg, ep_axis=self.ep_axis, ep_size=self.ep_size, name="moe_mlp"
             )(y)
         else:
-            y = nn.Dense(cfg.intermediate_size, dtype=cfg.dtype, name="mlp_in")(
-                y.astype(cfg.dtype)
-            )
-            y = nn.gelu(y)
-            y = nn.Dense(cfg.hidden_size, dtype=cfg.dtype, name="mlp_out")(y)
+            y = _dense_mlp(cfg, y)
         return x + y
 
 
@@ -417,13 +426,7 @@ class _MoECachedBlock(nn.Module):
             # unchanged at [batch, 1, hidden]
             y = MoEMlp(cfg, name="moe_mlp")(y[:, None])[:, 0]
         else:
-            y = nn.Dense(
-                cfg.intermediate_size, dtype=cfg.dtype, name="mlp_in"
-            )(y.astype(cfg.dtype))
-            y = nn.gelu(y)
-            y = nn.Dense(
-                cfg.hidden_size, dtype=cfg.dtype, name="mlp_out"
-            )(y)
+            y = _dense_mlp(cfg, y)
         return x + y
 
 
@@ -457,13 +460,7 @@ class _MoEPrefillBlock(nn.Module):
                 y.reshape(b * p, 1, -1)
             ).reshape(b, p, -1)
         else:
-            y = nn.Dense(
-                cfg.intermediate_size, dtype=cfg.dtype, name="mlp_in"
-            )(y.astype(cfg.dtype))
-            y = nn.gelu(y)
-            y = nn.Dense(
-                cfg.hidden_size, dtype=cfg.dtype, name="mlp_out"
-            )(y)
+            y = _dense_mlp(cfg, y)
         return x + y
 
 
@@ -491,21 +488,37 @@ class MoEPrefill(nn.Module):
 
 
 @functools.lru_cache(maxsize=16)
-def _compiled_moe_decode(cfg: MoEConfig, prompt_len: int, total: int):
-    """One compiled greedy decode per (config, shape): a batched
+def _compiled_moe_decode(cfg: MoEConfig, prompt_len: int, total: int,
+                         temperature: float = 0.0):
+    """One compiled decode per (config, shape, temperature): a batched
     prefill fills the cache for the whole prompt in one forward, then
     a lax.scan of one-token steps generates. Routing is per-token in
-    both phases (see _MoEPrefillBlock), so the output equals the
-    old all-teacher-forced per-token formulation exactly."""
+    both phases (see _MoEPrefillBlock), so the greedy output equals
+    the old all-teacher-forced per-token formulation exactly;
+    temperature > 0 samples each token from the tempered logits with
+    a per-position fold_in of the caller's rng — deterministic per
+    (rng, position). NOTE: this is a different stream derivation than
+    GPT's decode (which splits the rng through the scan carry), so the
+    same seed yields different — equally valid — samples across the
+    two families."""
     prefill = MoEPrefill(cfg, cache_len=total)
     model = MoEDecodeStep(cfg, cache_len=total)
+    sampled = temperature > 0.0
+
+    def pick(logits, rng, index):
+        if not sampled:
+            return jnp.argmax(logits, axis=-1).astype(jnp.int32)
+        return jax.random.categorical(
+            jax.random.fold_in(rng, index),
+            logits.astype(jnp.float32) / temperature, axis=-1,
+        ).astype(jnp.int32)
 
     @jax.jit
-    def run(params, prompt):
+    def run(params, prompt, rng):
         logits, updates = prefill.apply(
             {"params": params}, prompt, mutable=["cache"]
         )
-        first_new = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+        first_new = pick(logits, rng, prompt_len - 1)
 
         def step(carry, index):
             cache, tok = carry
@@ -513,7 +526,7 @@ def _compiled_moe_decode(cfg: MoEConfig, prompt_len: int, total: int):
                 {"params": params, "cache": cache}, tok, index,
                 mutable=["cache"],
             )
-            nxt = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+            nxt = pick(logits, rng, index)
             return (updates["cache"], nxt), nxt
 
         (_, _), toks = jax.lax.scan(
@@ -528,12 +541,15 @@ def _compiled_moe_decode(cfg: MoEConfig, prompt_len: int, total: int):
 
 
 def moe_generate(
-    cfg: MoEConfig, params, prompt: jax.Array, max_new_tokens: int
+    cfg: MoEConfig, params, prompt: jax.Array, max_new_tokens: int,
+    temperature: float = 0.0, rng: Optional[jax.Array] = None,
 ) -> jax.Array:
-    """Greedy KV-cached decode for the MoE family: [b, p] ->
-    [b, p + max_new_tokens]. Every model family decodes — the MoE
-    decode step routes each new token through the same trained experts
-    the training forward used (teacher-forced parity pinned by
+    """KV-cached decode for the MoE family: [b, p] ->
+    [b, p + max_new_tokens], greedy by default, sampled when
+    temperature > 0 (deterministic per rng). Every model family
+    decodes AND serves — the MoE decode step routes each new token
+    through the same trained experts the training forward used
+    (teacher-forced parity pinned by
     tests/test_moe_pipeline.py::TestMoEDecode)."""
     prompt_len = prompt.shape[1]
     total = prompt_len + max_new_tokens
@@ -546,5 +562,9 @@ def moe_generate(
             f"prompt+new = {total} exceeds max_position_embeddings "
             f"{cfg.max_position_embeddings}"
         )
-    run = _compiled_moe_decode(cfg, prompt_len, total)
-    return run(params, jnp.asarray(prompt, jnp.int32))
+    if temperature < 0:
+        raise ValueError(f"temperature must be >= 0, got {temperature}")
+    run = _compiled_moe_decode(cfg, prompt_len, total, float(temperature))
+    if rng is None:
+        rng = jax.random.PRNGKey(0)
+    return run(params, jnp.asarray(prompt, jnp.int32), rng)

@@ -1,13 +1,17 @@
-"""Minimal decode server: the serving side of the GPT family.
+"""Minimal decode server — every decoder family serves.
 
 The reference framework stops at training orchestration; a complete
 TPU framework owes its users the path from a trained checkpoint to
 tokens. This server is deliberately small — stdlib HTTP around the
-same ``models/gpt.py generate`` the benchmarks measure:
+same ``models/gpt.py generate`` / ``models/moe.py moe_generate`` the
+benchmarks measure:
 
     python -m tf_operator_tpu.serve --preset tiny --port 8600
     python -m tf_operator_tpu.serve --preset small \
         --checkpoint-dir /ckpt/gpt --kv-int8
+    python -m tf_operator_tpu.serve --preset moe-base \
+        --checkpoint-dir /ckpt/moe   # greedy/sampled decode through
+                                     # the trained experts
 
     POST /generate   {"input_ids": [[1,2,3], [7,8], ...],   # ragged OK
                       "max_new_tokens": 32, "temperature": 0.0,
@@ -62,6 +66,20 @@ _SPEC_NGRAM = 2
 MAX_BEAMS = 8
 
 
+def _family(cfg) -> str:
+    """"moe" for an MoEConfig, else "gpt" — the one dispatch point the
+    server keys decode routing and per-family validation on."""
+    from ..models.moe import MoEConfig
+
+    return "moe" if isinstance(cfg, MoEConfig) else "gpt"
+
+
+def _max_seq(cfg) -> int:
+    """The config's decode-length bound (GPTConfig.max_seq_len /
+    MoEConfig.max_position_embeddings)."""
+    return getattr(cfg, "max_seq_len", None) or cfg.max_position_embeddings
+
+
 class _State:
     """Model + params + decode bookkeeping shared by request threads."""
 
@@ -69,6 +87,7 @@ class _State:
                  max_new_cap: int, speculative: bool = False,
                  weights_int8: bool = False, mesh=None):
         self.cfg = cfg
+        self.family = _family(cfg)
         self.params = params
         self.kv_quant_int8 = kv_quant_int8
         self.model_name = model_name
@@ -162,10 +181,10 @@ def _validate(state: _State, body):
         return _bad(
             f"max_new_tokens must be an int in [1, {state.max_new_cap}]"
         )
-    if width + new > state.cfg.max_seq_len:
+    if width + new > _max_seq(state.cfg):
         return _bad(
             f"prompt_len {width} + max_new_tokens {new} "
-            f"exceeds max_seq_len {state.cfg.max_seq_len}"
+            f"exceeds max_seq_len {_max_seq(state.cfg)}"
         )
     temperature = body.get("temperature", 0.0)
     if not isinstance(temperature, (int, float)) or isinstance(
@@ -202,6 +221,19 @@ def _validate(state: _State, body):
                 f"batch {len(ids)} x num_beams {num_beams} exceeds "
                 f"the device admission cap {MAX_BATCH}"
             )
+    if state.family == "moe":
+        # the MoE decode path is greedy/temperature sampling over
+        # uniform-length prompts (models/moe.py moe_generate); the
+        # GPT-only machinery is refused loudly, never silently ignored
+        if any(length != width for length in lens):
+            return _bad(
+                "the moe family requires uniform-length prompts "
+                "(no ragged prompt_lens machinery in moe_generate)"
+            )
+        if top_k != 0 or float(top_p) != 1.0:
+            return _bad("top_k/top_p are not supported for the moe family")
+        if num_beams > 1:
+            return _bad("beam search is not supported for the moe family")
     return (prompt, lens, new, float(temperature), seed, top_k,
             float(top_p), num_beams)
 
@@ -268,7 +300,14 @@ def _locked_decode(
 
     with state.lock:  # decode saturates the chip; serialize
         start = time.perf_counter()
-        if num_beams > 1:
+        if state.family == "moe":
+            from ..models.moe import moe_generate
+
+            out = moe_generate(
+                state.cfg, state.params, prompt, max_new_tokens=new,
+                temperature=temperature, rng=rng,
+            )
+        elif num_beams > 1:
             out = gpt_lib.beam_search(
                 state.cfg, state.params, prompt, max_new_tokens=new,
                 num_beams=num_beams,
@@ -499,6 +538,19 @@ def make_server(
             "dummy rows) defeats the uniform-length speculative gate; "
             "pick the one that fits the traffic"
         )
+    if _family(cfg) == "moe" and (
+        kv_quant_int8 or weights_int8 or speculative
+        or batch_window_ms > 0 or mesh is not None
+    ):
+        # moe serves the plain decode path only: its generate has no
+        # int8/speculative/sharded machinery, and the batcher's dummy
+        # 1-token pad rows violate its uniform-length contract —
+        # refused at startup, not per-request
+        raise ValueError(
+            "the moe family serves plain decode only: kv_quant_int8, "
+            "weights_int8, speculative, batch_window_ms and mesh are "
+            "gpt-family features"
+        )
     from ..ops.quant import is_quantized, quantize_params
 
     if is_quantized(params) and not weights_int8:
@@ -543,7 +595,7 @@ def make_server(
 
         state.batcher = DynamicBatcher(
             state, decode_fn, window_ms=batch_window_ms,
-            max_batch=MAX_BATCH, max_seq_len=cfg.max_seq_len,
+            max_batch=MAX_BATCH, max_seq_len=_max_seq(cfg),
         )
     if warm_shapes:
         # pre-compile the expected (batch, width, new) decode shapes at
@@ -574,7 +626,14 @@ def make_server(
 
 def main(argv=None) -> int:
     parser = argparse.ArgumentParser()
-    parser.add_argument("--preset", choices=["tiny", "small"], default="small")
+    parser.add_argument(
+        "--preset",
+        choices=["tiny", "small", "moe-tiny", "moe-base"],
+        default="small",
+        help="gpt presets (tiny/small) serve the full feature set; "
+        "moe presets serve plain greedy/sampled decode through the "
+        "trained experts (models/moe.py moe_generate)",
+    )
     parser.add_argument(
         "--port", type=int, default=int(os.environ.get("PORT", "8600"))
     )
@@ -629,10 +688,33 @@ def main(argv=None) -> int:
 
     from ..models import gpt as gpt_lib
 
-    cfg = gpt_lib.GPT_TINY if args.preset == "tiny" else gpt_lib.GPT_SMALL
+    from ..models import moe as moe_lib
 
-    # flag validation BEFORE any device work: a bad --warm spec must be
-    # an argparse error, not a traceback after a 30s TPU init
+    cfg = {
+        "tiny": gpt_lib.GPT_TINY,
+        "small": gpt_lib.GPT_SMALL,
+        "moe-tiny": moe_lib.MOE_TINY,
+        "moe-base": moe_lib.MOE_BASE,
+    }[args.preset]
+
+    # flag validation BEFORE any device work: a bad flag combination
+    # must be an argparse error, not a traceback after a 30s TPU init
+    # (make_server re-checks for embedders)
+    if args.preset.startswith("moe"):
+        offending = [
+            flag for flag, on in (
+                ("--kv-int8", args.kv_int8),
+                ("--weights-int8", args.weights_int8),
+                ("--speculative", args.speculative),
+                ("--batch-window-ms", args.batch_window_ms > 0),
+                ("--tp", args.tp > 1),
+            ) if on
+        ]
+        if offending:
+            parser.error(
+                f"{', '.join(offending)} are gpt-family features; the "
+                "moe presets serve plain greedy/sampled decode only"
+            )
     warm_shapes = []
     for spec in args.warm:
         parts = spec.split("x")
@@ -648,10 +730,10 @@ def main(argv=None) -> int:
                 f"--warm {spec!r}: batch must be 1..{MAX_BATCH}, "
                 "width/new >= 1"
             )
-        if wwidth + wnew > cfg.max_seq_len:
+        if wwidth + wnew > _max_seq(cfg):
             parser.error(
                 f"--warm {spec!r}: width+new = {wwidth + wnew} exceeds "
-                f"the preset's max_seq_len {cfg.max_seq_len}"
+                f"the preset's max_seq_len {_max_seq(cfg)}"
             )
         warm_shapes.append((wbatch, wwidth, wnew))
 
@@ -680,14 +762,23 @@ def main(argv=None) -> int:
     elif args.checkpoint_dir:
         import optax
 
-        from ..train import Trainer, causal_lm_task
+        from ..train import Trainer, causal_lm_task, moe_task
 
-        model = gpt_lib.GPT(cfg)
-        trainer = Trainer(
-            model, causal_lm_task(model), optax.adamw(1e-4),
-            checkpoint_dir=args.checkpoint_dir,
-        )
-        sample = gpt_lib.synthetic_batch(rng, 1, 8, cfg)
+        if _family(cfg) == "moe":
+            # same orbax layout the train/moe.py CLI writes
+            model = moe_lib.MoELM(cfg)
+            trainer = Trainer(
+                model, moe_task(model), optax.adamw(1e-4),
+                checkpoint_dir=args.checkpoint_dir,
+            )
+            sample = moe_lib.synthetic_batch(rng, 1, 8, cfg)
+        else:
+            model = gpt_lib.GPT(cfg)
+            trainer = Trainer(
+                model, causal_lm_task(model), optax.adamw(1e-4),
+                checkpoint_dir=args.checkpoint_dir,
+            )
+            sample = gpt_lib.synthetic_batch(rng, 1, 8, cfg)
         state = trainer.init(rng, sample)  # the ONE init; restore target
         restored = trainer.restore(state)
         if restored is None:
@@ -701,7 +792,10 @@ def main(argv=None) -> int:
             logger.info("serving step-%d checkpoint", int(restored.step))
     else:
         logger.warning("no --checkpoint-dir — serving RANDOM weights")
-        params = gpt_lib.GPT(cfg).init(
+        model_cls = (
+            moe_lib.MoELM if _family(cfg) == "moe" else gpt_lib.GPT
+        )
+        params = model_cls(cfg).init(
             rng, jnp.zeros((1, 8), jnp.int32)
         )["params"]
 
@@ -713,7 +807,11 @@ def main(argv=None) -> int:
         logger.info("sharded decode over mesh %s", dict(mesh.shape))
     server = make_server(
         cfg, params, port=args.port, kv_quant_int8=args.kv_int8,
-        model_name=f"gpt-{args.preset}", max_new_cap=args.max_new_cap,
+        model_name=(
+            args.preset if args.preset.startswith("moe")
+            else f"gpt-{args.preset}"
+        ),
+        max_new_cap=args.max_new_cap,
         host=args.host, batch_window_ms=args.batch_window_ms,
         speculative=args.speculative, weights_int8=args.weights_int8,
         mesh=mesh,
