@@ -315,3 +315,51 @@ class TestControllerOverHttp:
             assert job.has_condition(t.ConditionType.SUCCEEDED)
         finally:
             controller.stop()
+
+
+class TestClientThrottle:
+    """--qps/--burst client-side throttling (runtime/kube.py
+    _TokenBucket) — the reference's client-go flowcontrol analog."""
+
+    def test_token_bucket_paces_after_burst(self):
+        import time as _time
+
+        from tf_operator_tpu.runtime.kube import _TokenBucket
+
+        bucket = _TokenBucket(qps=50.0, burst=2)
+        start = _time.monotonic()
+        for _ in range(6):
+            bucket.acquire()
+        elapsed = _time.monotonic() - start
+        # 2 burst tokens free, 4 paced at 50/s => >= 80ms
+        assert elapsed >= 0.075, elapsed
+
+    def test_zero_qps_is_unthrottled(self):
+        import time as _time
+
+        from tf_operator_tpu.runtime.kube import _TokenBucket
+
+        bucket = _TokenBucket(qps=0.0, burst=1)
+        start = _time.monotonic()
+        for _ in range(1000):
+            bucket.acquire()
+        assert _time.monotonic() - start < 0.5
+
+    def test_requests_ride_the_limiter(self):
+        import time as _time
+
+        from tf_operator_tpu.testing.fake_apiserver import FakeApiServer
+
+        server = FakeApiServer()
+        port = server.start()
+        try:
+            sub = KubeSubstrate(f"http://127.0.0.1:{port}",
+                                qps=25.0, burst=1)
+            start = _time.monotonic()
+            for _ in range(4):
+                sub.list_jobs("default")
+            # burst 1 free + 3 paced at 25/s >= 120ms (floor: 90ms)
+            assert _time.monotonic() - start >= 0.09
+            sub.close()
+        finally:
+            server.stop()

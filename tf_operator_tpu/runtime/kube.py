@@ -20,6 +20,7 @@ import os
 import ssl
 import tempfile
 import threading
+import time
 import urllib.error
 import urllib.parse
 import urllib.request
@@ -43,6 +44,48 @@ from .substrate import (
 logger = logging.getLogger("tf_operator_tpu.kube")
 
 SA_DIR = "/var/run/secrets/kubernetes.io/serviceaccount"
+
+
+class _TokenBucket:
+    """Client-side request throttle — the reference's --qps/--burst
+    (options.go:27-87, client-go flowcontrol): an operator reconciling
+    hundreds of jobs must not dogpile the apiserver. qps <= 0 disables.
+
+    Reservation semantics (rate.Limiter-style): a caller that finds no
+    token RESERVES the next one under the lock (the balance goes
+    negative) and sleeps out exactly its own deficit — FIFO by lock
+    order, so a woken sleeper never re-competes with fresh arrivals
+    and no request can be starved. The sleep is interruptible via the
+    cancel event (close() must not stall behind a low --qps); a
+    cancelled acquire returns immediately — its caller is shutting
+    down, so the reserved slot going unused only under-uses budget.
+    Thread-safe; watch streams count once at initiation (their held
+    connection is not per-request load)."""
+
+    def __init__(self, qps: float, burst: int) -> None:
+        self.qps = float(qps)
+        self.burst = max(1, int(burst))
+        self._tokens = float(self.burst)
+        self._last = time.monotonic()
+        self._lock = threading.Lock()
+
+    def acquire(self, cancel: Optional[threading.Event] = None) -> None:
+        if self.qps <= 0:
+            return
+        with self._lock:
+            now = time.monotonic()
+            self._tokens = min(
+                self.burst, self._tokens + (now - self._last) * self.qps
+            )
+            self._last = now
+            self._tokens -= 1.0  # negative balance = queued reservations
+            wait = -self._tokens / self.qps
+        if wait <= 0:
+            return
+        if cancel is not None:
+            cancel.wait(wait)
+        else:
+            time.sleep(wait)
 
 
 class ApiError(RuntimeError):
@@ -80,10 +123,13 @@ class KubeSubstrate:
         base_url: str,
         token: Optional[str] = None,
         ssl_context: Optional[ssl.SSLContext] = None,
+        qps: float = 0.0,
+        burst: int = 10,
     ) -> None:
         self.base_url = base_url.rstrip("/")
         self._token = token
         self._ssl = ssl_context
+        self._limiter = _TokenBucket(qps, burst)
         self._subscribers: Dict[str, List[Callable]] = {}
         self._sub_lock = threading.Lock()
         self._watch_threads: Dict[str, threading.Thread] = {}
@@ -103,25 +149,30 @@ class KubeSubstrate:
 
     @classmethod
     def from_config(
-        cls, kubeconfig: Optional[str] = None, master: Optional[str] = None
+        cls, kubeconfig: Optional[str] = None, master: Optional[str] = None,
+        qps: float = 0.0, burst: int = 10,
     ) -> "KubeSubstrate":
         if kubeconfig is None and os.path.exists(os.path.join(SA_DIR, "token")):
-            return cls.in_cluster()
+            return cls.in_cluster(qps=qps, burst=burst)
         kubeconfig = kubeconfig or os.path.expanduser("~/.kube/config")
-        return cls.from_kubeconfig(kubeconfig, master)
+        return cls.from_kubeconfig(kubeconfig, master, qps=qps, burst=burst)
 
     @classmethod
-    def in_cluster(cls) -> "KubeSubstrate":
+    def in_cluster(cls, qps: float = 0.0, burst: int = 10) -> "KubeSubstrate":
         with open(os.path.join(SA_DIR, "token")) as handle:
             token = handle.read().strip()
         context = ssl.create_default_context(cafile=os.path.join(SA_DIR, "ca.crt"))
         host = os.environ.get("KUBERNETES_SERVICE_HOST", "kubernetes.default.svc")
         port = os.environ.get("KUBERNETES_SERVICE_PORT", "443")
-        return cls(f"https://{host}:{port}", token=token, ssl_context=context)
+        return cls(
+            f"https://{host}:{port}", token=token, ssl_context=context,
+            qps=qps, burst=burst,
+        )
 
     @classmethod
     def from_kubeconfig(
-        cls, path: str, master: Optional[str] = None
+        cls, path: str, master: Optional[str] = None,
+        qps: float = 0.0, burst: int = 10,
     ) -> "KubeSubstrate":
         import yaml
 
@@ -154,7 +205,10 @@ class KubeSubstrate:
                     user["client-key-data"]
                 )
                 ssl_context.load_cert_chain(cert, key)
-        return cls(server, token=user.get("token"), ssl_context=ssl_context)
+        return cls(
+            server, token=user.get("token"), ssl_context=ssl_context,
+            qps=qps, burst=burst,
+        )
 
     # -- HTTP --------------------------------------------------------------
 
@@ -176,6 +230,7 @@ class KubeSubstrate:
             req.add_header("Content-Type", content_type)
         if self._token:
             req.add_header("Authorization", f"Bearer {self._token}")
+        self._limiter.acquire(cancel=self._stop)
         try:
             with urllib.request.urlopen(req, timeout=timeout, context=self._ssl) as resp:
                 payload = resp.read().decode()
@@ -705,6 +760,7 @@ class KubeSubstrate:
                 req.add_header("Accept", "application/json")
                 if self._token:
                     req.add_header("Authorization", f"Bearer {self._token}")
+                self._limiter.acquire(cancel=self._stop)
                 with urllib.request.urlopen(
                     req, timeout=330.0, context=self._ssl
                 ) as resp:

@@ -31,6 +31,12 @@ class ServerOptions:
     kubeconfig: Optional[str] = None
     master: Optional[str] = None
     substrate: str = "kube"  # "kube" | "memory" (demo/testing)
+    # client-side apiserver throttle (reference options.go:27-87
+    # --qps/--burst): 0 disables. Controller-friendly defaults (the
+    # client-go 5/10 default is famously too low for operators); at
+    # the O(100)-job design point raise further or disable.
+    qps: float = 50.0
+    burst: int = 100
 
 
 def parse_args(argv: Optional[List[str]] = None) -> ServerOptions:
@@ -87,6 +93,14 @@ def parse_args(argv: Optional[List[str]] = None) -> ServerOptions:
         "--substrate", choices=["kube", "memory"], default=opts.substrate
     )
     parser.add_argument(
+        "--qps", type=float, default=opts.qps,
+        help="client-side apiserver request rate limit (0 = off)",
+    )
+    parser.add_argument(
+        "--burst", type=int, default=opts.burst,
+        help="token-bucket burst size for --qps",
+    )
+    parser.add_argument(
         "--version", action="store_true", help="Print version and exit"
     )
     ns = parser.parse_args(argv)
@@ -114,4 +128,6 @@ def parse_args(argv: Optional[List[str]] = None) -> ServerOptions:
         kubeconfig=ns.kubeconfig,
         master=ns.master,
         substrate=ns.substrate,
+        qps=ns.qps,
+        burst=ns.burst,
     )

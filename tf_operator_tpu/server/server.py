@@ -51,7 +51,8 @@ def build_substrate(options: ServerOptions):
     from ..runtime.kube import KubeSubstrate
 
     return KubeSubstrate.from_config(
-        kubeconfig=options.kubeconfig, master=options.master
+        kubeconfig=options.kubeconfig, master=options.master,
+        qps=options.qps, burst=options.burst,
     )
 
 
