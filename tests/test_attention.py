@@ -654,14 +654,32 @@ class TestPallasConv:
             atol=1e-4, rtol=1e-4,
         )
 
-    def test_gradient_parity(self):
-        from tf_operator_tpu.ops.pallas.conv_bn import conv3x3_s1
+    @pytest.mark.parametrize("shape,cout", [
+        # single-block grids
+        ((2, 8, 8, 64), 64),
+        # n//tn = 2: exercises the dw kernel's image-axis
+        # revisit-accumulation (i > 0 steps re-enter the output block)
+        ((16, 8, 8, 64), 64),
+        # _dw_cout_block splits cout (9*256*512*4 > 2.5MB -> cb=256):
+        # exercises the per-cout-block init and slicing (j > 0)
+        ((2, 4, 4, 256), 512),
+    ], ids=["single-block", "multi-image-block", "cout-blocked"])
+    def test_gradient_parity(self, shape, cout):
+        from tf_operator_tpu.ops.pallas.conv_bn import (
+            _dw_cout_block, conv3x3_s1, images_per_program, supports,
+        )
+
+        assert supports(shape, (3, 3, shape[3], cout), (1, 1))
+        if shape == (16, 8, 8, 64):
+            assert shape[0] // images_per_program(8, 8, 16) >= 2
+        if cout == 512:
+            assert _dw_cout_block(shape[3], cout) < cout
 
         rng = jax.random.PRNGKey(2)
-        x = jax.random.normal(rng, (2, 8, 8, 64), jnp.float32)
+        x = jax.random.normal(rng, shape, jnp.float32)
         k = jax.random.normal(
-            jax.random.fold_in(rng, 1), (3, 3, 64, 64), jnp.float32
-        ) / 8.0
+            jax.random.fold_in(rng, 1), (3, 3, shape[3], cout), jnp.float32
+        ) / shape[3] ** 0.5
 
         def loss(x, k):
             return (conv3x3_s1(x, k, True).astype(jnp.float32) ** 2).sum()

@@ -26,9 +26,12 @@ the transformer families hit 60%+ MFU with:
   would starve the 128-lane systolic array).
 - dx in the backward is the SAME kernel on the incoming cotangent
   with the spatially-flipped, transposed weights (stride-1 3x3 SAME
-  conv is self-adjoint in shape); dw is 9 shifted [C, M] @ [M, Cout]
-  contractions expressed as einsums — weight-shaped outputs, plain
-  GEMMs XLA tiles well, no conv emitter anywhere in the VJP.
+  conv is self-adjoint in shape); dw is its own pallas reduction
+  kernel — the grid's image axis accumulates all nine weight-shaped
+  [C, M] @ [M, Cout] taps into a VMEM-resident f32 block; inputs are
+  read once per Cout block (cout/cb passes — see _dw_cout_block —
+  where a 9-GEMM XLA decomposition re-reads the cotangent per tap).
+  No conv emitter anywhere in the VJP.
 
 Measured by the `resnet_pallas_conv` bench extra (bench.py run_extras)
 against the default XLA path at the headline config; parity pinned on
@@ -133,36 +136,82 @@ def _fwd(x, kernel, interpret):
     return _conv3x3_fwd(x, kernel, interpret), (x, kernel)
 
 
+def _dw_kernel(x_ref, g_ref, dw_ref, *, h: int, w: int):
+    """dw[dy, dx] = sum over the block's (n, h, w) of
+    x[n, h+dy-1, w+dx-1, :] (x) g[n, h, w, :]. The grid's IMAGE axis
+    (innermost, so the output block stays VMEM-resident between
+    steps) is a sequential reduction: each step reads its padded-input
+    and cotangent blocks from HBM and accumulates all nine
+    weight-shaped taps. Input reads scale with cout/cb (each cout
+    block re-sweeps the images — the accumulator-residency vs
+    input-reuse tradeoff _dw_cout_block sets), still well under the
+    XLA 9-GEMM formulation's 9x re-read of g."""
+    i = pl.program_id(1)  # image-block (reduction) axis
+
+    @pl.when(i == 0)
+    def _init():
+        dw_ref[...] = jnp.zeros_like(dw_ref)
+
+    # tpu.matmul takes exactly one contracting dim per operand: merge
+    # (n, h, w) into the contraction's M axis up front
+    cb = g_ref.shape[3]  # the per-block Cout slice, not the full Cout
+    c = x_ref.shape[3]
+    g2 = g_ref[...].reshape(-1, cb)
+    for dy in range(3):
+        for dx in range(3):
+            window = x_ref[:, dy:dy + h, dx:dx + w, :].reshape(-1, c)
+            dw_ref[dy, dx] += jax.lax.dot_general(
+                window, g2,
+                dimension_numbers=(((0,), (0,)), ((), ())),
+                preferred_element_type=jnp.float32,
+            )
+
+
+def _dw_cout_block(c: int, cout: int) -> int:
+    """Largest Cout slice whose [3, 3, C, cb] f32 accumulator stays
+    within a ~2.5MB VMEM budget (stage-4 shapes need blocking)."""
+    cb = cout
+    while cb > 64 and 9 * c * cb * 4 > 2_500_000:
+        cb //= 2
+    return cb
+
+
+@functools.partial(jax.jit, static_argnames=("interpret",))
+def _conv3x3_dw(x: jax.Array, g: jax.Array,
+                interpret: bool = False) -> jax.Array:
+    n, h, w, c = x.shape
+    cout = g.shape[3]
+    tn = images_per_program(h, w, n)
+    cb = _dw_cout_block(c, cout)
+    xp = jnp.pad(x, ((0, 0), (1, 1), (1, 1), (0, 0)))
+    return pl.pallas_call(
+        functools.partial(_dw_kernel, h=h, w=w),
+        # cout blocks OUTER, image blocks INNER: consecutive steps
+        # share the output block (clean revisit-accumulation), and each
+        # cout block's first image step runs the init
+        grid=(cout // cb, n // tn),
+        in_specs=[
+            pl.BlockSpec(
+                (tn, h + 2, w + 2, c), lambda j, i: (i, 0, 0, 0)
+            ),
+            pl.BlockSpec((tn, h, w, cb), lambda j, i: (i, 0, 0, j)),
+        ],
+        out_specs=pl.BlockSpec(
+            (3, 3, c, cb), lambda j, i: (0, 0, 0, j)
+        ),
+        out_shape=jax.ShapeDtypeStruct((3, 3, c, cout), jnp.float32),
+        interpret=interpret,
+    )(xp, g)
+
+
 def _bwd(interpret, residuals, g):
     x, kernel = residuals
     # dx: correlate the cotangent with the flipped, transposed kernel —
     # the same 3x3/s1 shape class, so the SAME pallas kernel applies
     k_flip = jnp.flip(kernel, axis=(0, 1)).transpose(0, 1, 3, 2)
-    dx = _conv3x3_fwd(g.astype(x.dtype), k_flip.astype(x.dtype),
-                      interpret)
-    # dw[dy, dx] = sum_{n, h, w} x[n, h+dy-1, w+dx-1, :] (x) g[n, h, w, :]
-    # — nine weight-shaped GEMM reductions; f32 accumulation via the
-    # dot's preferred element type, cast back to the param dtype
-    n, h, w, _ = x.shape
-    xp = jnp.pad(x, ((0, 0), (1, 1), (1, 1), (0, 0)))
-    taps = []
-    for dy in range(3):
-        row = []
-        for dx_ in range(3):
-            window = jax.lax.dynamic_slice(
-                xp, (0, dy, dx_, 0), (n, h, w, x.shape[3])
-            )
-            row.append(
-                jax.lax.dot_general(
-                    window, g,
-                    dimension_numbers=(
-                        ((0, 1, 2), (0, 1, 2)), ((), ())
-                    ),
-                    preferred_element_type=jnp.float32,
-                )
-            )
-        taps.append(jnp.stack(row))
-    dw = jnp.stack(taps).astype(kernel.dtype)
+    g = g.astype(x.dtype)
+    dx = _conv3x3_fwd(g, k_flip.astype(x.dtype), interpret)
+    dw = _conv3x3_dw(x, g, interpret).astype(kernel.dtype)
     return dx, dw
 
 
