@@ -363,3 +363,80 @@ class TestClientThrottle:
             sub.close()
         finally:
             server.stop()
+
+
+class TestLogFollowOverHttp:
+    """kubectl logs -f over the wire: KubeSubstrate.read_pod_log
+    (follow=True) consumes the apiserver's ?follow=true chunked
+    stream; the stream ends when the pod goes terminal, with
+    everything written first drained."""
+
+    def test_follow_streams_and_ends_at_terminal(self, wire):
+        server, substrate = wire
+        # a pod with logs, directly in the store (kubelet sim)
+        pod = k8s.Pod(
+            metadata=k8s.ObjectMeta(name="fol-0", namespace="default"),
+            spec=k8s.PodSpec(
+                containers=[k8s.Container(name="tensorflow", image="x")]
+            ),
+        )
+        substrate.create_pod(pod)
+        server.append_pod_log("default", "fol-0", "early\n")
+        stream = substrate.read_pod_log("default", "fol-0", follow=True)
+        got = []
+
+        def writer():
+            server.append_pod_log("default", "fol-0", "late\n")
+            server.set_pod_phase("default", "fol-0", "Succeeded",
+                                 exit_code=0)
+
+        timer = threading.Timer(0.2, writer)
+        timer.start()
+        for piece in stream:
+            got.append(piece)
+        timer.join()
+        assert "".join(got) == "early\nlate\n"
+
+    def test_plain_read_unaffected(self, wire):
+        server, substrate = wire
+        pod = k8s.Pod(
+            metadata=k8s.ObjectMeta(name="plain-0", namespace="default"),
+            spec=k8s.PodSpec(
+                containers=[k8s.Container(name="tensorflow", image="x")]
+            ),
+        )
+        substrate.create_pod(pod)
+        server.append_pod_log("default", "plain-0", "a\nb\n")
+        assert substrate.read_pod_log(
+            "default", "plain-0", tail_lines=1
+        ) == "b\n"
+
+    def test_tail_plus_follow_does_not_replay(self, wire):
+        """tailLines trims the HISTORY; the follow offset must still
+        count in full-buffer coordinates or the tail is delivered
+        twice (review-found bug)."""
+        server, substrate = wire
+        pod = k8s.Pod(
+            metadata=k8s.ObjectMeta(name="tf-0", namespace="default"),
+            spec=k8s.PodSpec(
+                containers=[k8s.Container(name="tensorflow", image="x")]
+            ),
+        )
+        substrate.create_pod(pod)
+        server.append_pod_log("default", "tf-0", "a\nb\n")
+        stream = substrate.read_pod_log(
+            "default", "tf-0", tail_lines=1, follow=True
+        )
+        got = []
+
+        def writer():
+            server.append_pod_log("default", "tf-0", "c\n")
+            server.set_pod_phase("default", "tf-0", "Succeeded",
+                                 exit_code=0)
+
+        timer = threading.Timer(0.2, writer)
+        timer.start()
+        for piece in stream:
+            got.append(piece)
+        timer.join()
+        assert "".join(got) == "b\nc\n"

@@ -285,6 +285,51 @@ class TestSDK:
         with pytest.raises(BadRequest, match="not valid"):
             client.get_logs("tailed", container="nope")
 
+    def test_logs_follow_streams_until_terminal(self):
+        """kubectl logs -f semantics: follow=True yields chunks as the
+        pod writes them and ends once the container terminates — with
+        everything written before termination drained."""
+        import threading as _threading
+
+        sub, controller, client = self.setup_env()
+        client.create(make_job({"Worker": 1}, name="fol"))
+        controller.run_until_quiet()
+        sub.append_pod_log("default", "fol-worker-0", "early\n")
+        stream = client.get_logs("fol", master=True, follow=True)[
+            "fol-worker-0"
+        ]
+        got = []
+
+        def writer():
+            sub.append_pod_log("default", "fol-worker-0", "late\n")
+            sub.mark_pod_running("default", "fol-worker-0")
+            sub.append_pod_log("default", "fol-worker-0", "final\n")
+            sub.terminate_pod("default", "fol-worker-0", exit_code=0)
+
+        thread = _threading.Timer(0.15, writer)
+        thread.start()
+        for piece in stream:  # ends by itself at the terminal phase
+            got.append(piece)
+        thread.join()
+        assert "".join(got) == "early\nlate\nfinal\n"
+
+    def test_logs_tail_plus_follow_does_not_replay(self):
+        """In-memory twin of the wire tail+follow contract."""
+        sub, controller, client = self.setup_env()
+        client.create(make_job({"Worker": 1}, name="tfol"))
+        controller.run_until_quiet()
+        sub.append_pod_log("default", "tfol-worker-0", "a\nb\n")
+        stream = client.get_logs(
+            "tfol", master=True, tail_lines=1, follow=True
+        )["tfol-worker-0"]
+        first = next(stream)
+        assert first == "b\n"
+        sub.append_pod_log("default", "tfol-worker-0", "c\n")
+        sub.mark_pod_running("default", "tfol-worker-0")
+        sub.terminate_pod("default", "tfol-worker-0", exit_code=0)
+        rest = "".join(stream)
+        assert rest == "c\n"
+
     def test_describe_renders_status_and_events(self):
         """kubectl-describe analog: one text blob with spec summary,
         conditions, replica statuses, and the recorded events."""

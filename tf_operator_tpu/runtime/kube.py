@@ -328,17 +328,28 @@ class KubeSubstrate:
         name: str,
         container: Optional[str] = None,
         tail_lines: Optional[int] = None,
-    ) -> str:
+        follow: bool = False,
+    ):
         """GET .../pods/{name}/log — plain text, not JSON (the
         reference SDK's read_namespaced_pod_log; feeds
         TFJobClient.get_logs). `container` is required by the apiserver
         for multi-container pods (a bare GET 400s there); `tail_lines`
-        maps to ?tailLines= (ADVICE r3)."""
+        maps to ?tailLines= (ADVICE r3). follow=True maps to ?follow=
+        and returns an ITERATOR of decoded chunks as the kubelet
+        streams them (kubectl logs -f); the stream ends when the
+        container terminates. Follow reads carry NO socket timeout —
+        a quiet training pod can go far longer than any fixed budget
+        between log lines, and kubectl follows indefinitely; stop a
+        stream early by closing the iterator (``gen.close()``) or the
+        substrate. Like a watch, a follow counts ONE limiter token at
+        initiation."""
         query = []
         if container:
             query.append("container=" + urllib.parse.quote(container))
         if tail_lines is not None:
             query.append(f"tailLines={int(tail_lines)}")
+        if follow:
+            query.append("follow=true")
         req = urllib.request.Request(
             self.base_url
             + self._core_path("pods", namespace, name)
@@ -348,11 +359,11 @@ class KubeSubstrate:
         )
         if self._token:
             req.add_header("Authorization", f"Bearer {self._token}")
+        self._limiter.acquire(cancel=self._stop)
         try:
-            with urllib.request.urlopen(
-                req, timeout=30.0, context=self._ssl
-            ) as resp:
-                return resp.read().decode(errors="replace")
+            resp = urllib.request.urlopen(
+                req, timeout=None if follow else 30.0, context=self._ssl
+            )
         except urllib.error.HTTPError as err:
             body = err.read().decode(errors="replace")
             if err.code == 400:
@@ -362,6 +373,20 @@ class KubeSubstrate:
                 raise BadRequest(body) from None
             _raise_for_status(err.code, body)
             raise  # unreachable
+        if not follow:
+            with resp:
+                return resp.read().decode(errors="replace")
+
+        def stream():
+            with resp:
+                # http.client de-chunks; iterate in line-sized reads so
+                # chunks surface promptly
+                for line in resp:
+                    if self._stop.is_set():
+                        return
+                    yield line.decode(errors="replace")
+
+        return stream()
 
     def update_pod_status(
         self, namespace: str, name: str, status: k8s.PodStatus

@@ -493,11 +493,15 @@ class InMemorySubstrate:
         name: str,
         container: Optional[str] = None,
         tail_lines: Optional[int] = None,
-    ) -> str:
+        follow: bool = False,
+    ):
         """Signature mirrors KubeClient.read_pod_log (the apiserver
         requires ?container= for multi-container pods and supports
-        ?tailLines=); the in-memory twin validates the container name
-        and honors the tail so SDK code exercises the same contract."""
+        ?tailLines= and ?follow=); the in-memory twin validates the
+        container name and honors the tail so SDK code exercises the
+        same contract. follow=True returns an ITERATOR of log chunks
+        that ends when the pod reaches a terminal phase or is deleted
+        (kubectl logs -f semantics)."""
         with self._lock:
             pod = self._pods.get((namespace, name))
             if pod is None:
@@ -509,6 +513,9 @@ class InMemorySubstrate:
                     f"container {container} is not valid for pod {name}"
                 )
             text = self._pod_logs.get((namespace, name), "")
+        full_len = len(text)  # offsets are in FULL-buffer coordinates:
+        # the tail below restricts the HISTORY shown, not what counts
+        # as already-delivered for the follow stream
         if tail_lines is not None:
             n = int(tail_lines)
             if n < 0:  # matches the apiserver's Invalid class
@@ -517,7 +524,32 @@ class InMemorySubstrate:
                 )
             lines = text.splitlines(keepends=True)
             text = "".join(lines[-n:]) if n else ""
-        return text
+        if not follow:
+            return text
+        return self._follow_pod_log(namespace, name, full_len, text)
+
+    def _follow_pod_log(self, namespace: str, name: str,
+                        offset: int, first: str):
+        """Generator behind read_pod_log(follow=True): poll the log
+        buffer, yield appended chunks, stop once the pod is terminal
+        (after draining whatever it wrote) or deleted."""
+        import time as _time
+
+        if first:
+            yield first
+        while True:
+            with self._lock:
+                pod = self._pods.get((namespace, name))
+                text = self._pod_logs.get((namespace, name), "")
+            if len(text) > offset:
+                yield text[offset:]
+                offset = len(text)
+                continue  # drain fully before any terminal check
+            if pod is None or pod.status.phase in (
+                k8s.POD_SUCCEEDED, k8s.POD_FAILED,
+            ):
+                return
+            _time.sleep(0.05)
 
     # -- Kubelet simulator -------------------------------------------------
 

@@ -58,6 +58,11 @@ def main(argv=None) -> int:
     )
     p_logs.add_argument("--tail", type=int, default=None,
                         help="only the last N lines (tailLines)")
+    p_logs.add_argument(
+        "-f", "--follow", action="store_true",
+        help="stream appended log output until the container "
+        "terminates (kubectl logs -f)",
+    )
 
     p_watch = sub.add_parser(
         "watch", help="stream status transitions until terminal/timeout"
@@ -122,9 +127,18 @@ def _run(args) -> int:
         for name, text in client.get_logs(
             args.name, master=args.master,
             container=args.container, tail_lines=args.tail,
+            follow=args.follow,
         ).items():
             print(f"==> {name} <==")
-            print(text)
+            if args.follow:
+                # text is an iterator of streamed chunks; pods print
+                # sequentially (follow one pod with --master or
+                # --replica filters for interleave-free output)
+                for piece in text:
+                    print(piece, end="", flush=True)
+                print()
+            else:
+                print(text)
     elif args.verb == "describe":
         print(client.describe(args.name))
     elif args.verb == "delete":

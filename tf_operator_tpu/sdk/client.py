@@ -165,11 +165,15 @@ class TFJobClient:
         replica_index: Optional[int] = None,
         container: Optional[str] = None,
         tail_lines: Optional[int] = None,
-    ) -> Dict[str, str]:
+        follow: bool = False,
+    ) -> Dict[str, object]:
         """Pod name -> log text, for substrates that expose logs.
         `container`/`tail_lines` map to the apiserver's ?container=/
         ?tailLines= (required for multi-container pods — the reference
-        client's read_namespaced_pod_log surface, ADVICE r3)."""
+        client's read_namespaced_pod_log surface, ADVICE r3).
+        follow=True maps each pod to an ITERATOR of chunks streamed
+        until its container terminates (kubectl logs -f; the CLI's
+        `logs --follow`)."""
         namespace = namespace or self.namespace
         names = self.get_pod_names(
             name, namespace, master=master,
@@ -184,6 +188,7 @@ class TFJobClient:
             pod_name: reader(
                 namespace, pod_name,
                 container=container, tail_lines=tail_lines,
+                follow=follow,
             )
             for pod_name in names
         }

@@ -172,59 +172,115 @@ class FakeApiServer:
 
             # -- verbs ----------------------------------------------------
 
+            def _pod_log(self, plural, namespace, name, params) -> None:
+                """GET .../pods/{name}/log: validation under the store
+                lock, body (or ?follow=true chunked stream) outside it
+                — the follower must not hold the lock the writer
+                needs."""
+                with store.lock:
+                    pod = store.objects.get((plural, namespace, name))
+                    if pod is None:
+                        return self._error(404, "NotFound", f"pod {name}")
+                    # the real apiserver's contract: ?container= must
+                    # name a container of the pod, and is REQUIRED
+                    # once the pod has more than one
+                    containers = [
+                        c.get("name", "")
+                        for c in pod.get("spec", {}).get("containers", [])
+                    ]
+                    requested = params.get("container", [None])[0]
+                    if requested is not None and requested not in containers:
+                        return self._error(
+                            400, "BadRequest",
+                            f"container {requested} is not valid for "
+                            f"pod {name}",
+                        )
+                    if requested is None and len(containers) > 1:
+                        return self._error(
+                            400, "BadRequest",
+                            f"a container name must be specified for "
+                            f"pod {name}, choose one of {containers}",
+                        )
+                    text = store.pod_logs.get((namespace, name), "")
+                full_len = len(text)  # follow offsets are in FULL-
+                # buffer coordinates; tailLines only trims the history
+                if "tailLines" in params:
+                    raw = params["tailLines"][0]
+                    try:
+                        n = int(raw)
+                    except ValueError:
+                        n = -1
+                    if n < 0:  # the apiserver's Invalid class
+                        return self._error(
+                            400, "BadRequest",
+                            f"tailLines must be a non-negative "
+                            f"integer, got {raw!r}",
+                        )
+                    lines = text.splitlines(keepends=True)
+                    text = "".join(lines[-n:]) if n else ""
+                if params.get("follow") != ["true"]:
+                    body = text.encode()
+                    self.send_response(200)
+                    self.send_header("Content-Type", "text/plain")
+                    self.send_header("Content-Length", str(len(body)))
+                    self.end_headers()
+                    self.wfile.write(body)
+                    return None
+                # ?follow=true: chunked stream — send what exists, then
+                # poll for appends until the pod is terminal or deleted
+                # (kubectl logs -f semantics). A disconnected consumer
+                # just ends the handler, never a handler-thread
+                # traceback.
+                self.send_response(200)
+                self.send_header("Content-Type", "text/plain")
+                self.send_header("Transfer-Encoding", "chunked")
+                self.end_headers()
+
+                def chunk(data: bytes) -> None:
+                    self.wfile.write(
+                        f"{len(data):x}\r\n".encode() + data + b"\r\n"
+                    )
+                    self.wfile.flush()
+
+                import time as _time
+
+                offset = full_len
+                try:
+                    if text:
+                        chunk(text.encode())
+                    while not closing.is_set():
+                        with store.lock:
+                            pod = store.objects.get(
+                                (plural, namespace, name)
+                            )
+                            full = store.pod_logs.get(
+                                (namespace, name), ""
+                            )
+                            phase = (
+                                (pod or {}).get("status", {}).get("phase")
+                            )
+                        if len(full) > offset:
+                            chunk(full[offset:].encode())
+                            offset = len(full)
+                            continue  # drain before any terminal check
+                        if pod is None or phase in ("Succeeded", "Failed"):
+                            break
+                        _time.sleep(0.05)
+                    self.wfile.write(b"0\r\n\r\n")
+                    self.wfile.flush()
+                except (BrokenPipeError, ConnectionResetError):
+                    pass  # consumer hung up mid-stream
+                return None
+
             def do_GET(self) -> None:  # noqa: N802
                 url = urlparse(self.path)
                 params = parse_qs(url.query)
                 plural, namespace, name, subresource = _split(url.path)
                 if params.get("watch") == ["true"]:
                     return self._watch(plural, params)
+                if subresource == "log" and plural == "pods":
+                    return self._pod_log(plural, namespace, name, params)
                 with store.lock:
-                    if subresource == "log" and plural == "pods":
-                        pod = store.objects.get((plural, namespace, name))
-                        if pod is None:
-                            return self._error(404, "NotFound", f"pod {name}")
-                        # the real apiserver's contract: ?container= must
-                        # name a container of the pod, and is REQUIRED
-                        # once the pod has more than one
-                        containers = [
-                            c.get("name", "")
-                            for c in pod.get("spec", {}).get("containers", [])
-                        ]
-                        requested = params.get("container", [None])[0]
-                        if requested is not None and requested not in containers:
-                            return self._error(
-                                400, "BadRequest",
-                                f"container {requested} is not valid for "
-                                f"pod {name}",
-                            )
-                        if requested is None and len(containers) > 1:
-                            return self._error(
-                                400, "BadRequest",
-                                f"a container name must be specified for "
-                                f"pod {name}, choose one of {containers}",
-                            )
-                        text = store.pod_logs.get((namespace, name), "")
-                        if "tailLines" in params:
-                            raw = params["tailLines"][0]
-                            try:
-                                n = int(raw)
-                            except ValueError:
-                                n = -1
-                            if n < 0:  # the apiserver's Invalid class
-                                return self._error(
-                                    400, "BadRequest",
-                                    f"tailLines must be a non-negative "
-                                    f"integer, got {raw!r}",
-                                )
-                            lines = text.splitlines(keepends=True)
-                            text = "".join(lines[-n:]) if n else ""
-                        body = text.encode()
-                        self.send_response(200)
-                        self.send_header("Content-Type", "text/plain")
-                        self.send_header("Content-Length", str(len(body)))
-                        self.end_headers()
-                        self.wfile.write(body)
-                        return None
                     if name is not None:
                         obj = store.objects.get((plural, namespace, name))
                         if obj is None:
@@ -453,6 +509,14 @@ class FakeApiServer:
                 ]
             self.store.stamp(pod)
             self.store.notify("pods", "MODIFIED", pod)
+
+    def append_pod_log(self, namespace: str, name: str, text: str) -> None:
+        """Kubelet-sim twin of InMemorySubstrate.append_pod_log; feeds
+        the /log endpoint (incl. ?follow=true streams)."""
+        with self.store.lock:
+            self.store.pod_logs[(namespace, name)] = (
+                self.store.pod_logs.get((namespace, name), "") + text
+            )
 
 
 def _merge(base: dict, patch: dict) -> None:
