@@ -110,7 +110,7 @@ def router_stats(model, params, batch, cfg) -> dict:
     """One forward with the router internals captured: balance (1.0 =
     uniform) from the sown aux losses, routed fraction from the
     dispatch masks' occupancy."""
-    from tf_operator_tpu.models.moe import layer_is_moe, total_aux_loss
+    from tf_operator_tpu.models.moe import layer_is_moe, sum_sown
 
     n_moe = sum(layer_is_moe(cfg, l) for l in range(cfg.num_layers))
     _, mods = model.apply(
@@ -118,7 +118,10 @@ def router_stats(model, params, batch, cfg) -> dict:
         mutable=["losses", "intermediates"],
         capture_intermediates=lambda mdl, _: mdl.name == "router_gate",
     )
-    aux = float(total_aux_loss(mods.get("losses", {})))
+    # ONLY the load-balancing terms: the losses collection also carries
+    # the ST-MoE z-loss (router_z), which must not skew the balance
+    # stat's uniform-routing normalization
+    aux = float(sum_sown(mods.get("losses", {}), "router_aux"))
     balance = aux / (cfg.router_aux_weight * max(n_moe, 1))
 
     # each captured router_gate __call__ value is the (dispatch,

@@ -283,6 +283,24 @@ def run(write: bool = True) -> dict:
         [int(x) for x in (base + i) % cfg.vocab_size] for i in range(n_requests)
     ]
 
+    # the MoE family's served number (train and decode have theirs in
+    # bench.py's moe extras): same live-HTTP harness, plain server
+    from tf_operator_tpu.models import moe as moe_lib
+
+    moe_cfg = moe_lib.MOE_BASE if on_tpu else moe_lib.MOE_TINY
+    moe_prompt_len = 128 if on_tpu else 16
+    moe_new = 64 if on_tpu else 16
+    moe_params = moe_lib.MoELM(moe_cfg).init(
+        jax.random.PRNGKey(2), jnp.zeros((1, 8), jnp.int32)
+    )["params"]
+    moe_base = jax.random.randint(
+        jax.random.PRNGKey(3), (moe_prompt_len,), 0, moe_cfg.vocab_size
+    )
+    moe_prompts = [
+        [int(t) for t in (moe_base + i) % moe_cfg.vocab_size]
+        for i in range(n_clients * 2)
+    ]
+
     result = {
         "environment": "tpu" if on_tpu else "cpu",
         "device": getattr(jax.devices()[0], "device_kind", "cpu"),
@@ -294,6 +312,9 @@ def run(write: bool = True) -> dict:
         "batched": _serve_scenario(
             cfg, params, prompts, new, n_clients, batch_window_ms=10.0
         ),
+        "moe_plain": _serve_scenario(
+            moe_cfg, moe_params, moe_prompts, moe_new, n_clients
+        ),
         "speculative": spec_scenarios(cfg, params, prompt_len, new),
         "notes": (
             "plain/batched drive the live HTTP server (in-process, "
@@ -304,7 +325,9 @@ def run(write: bool = True) -> dict:
             "random-init model = worst case, memorized model = the "
             "favorable input-grounded regime; memorized_mixed_batch4 is "
             "the batch-min exposure (one random row dragging three "
-            "high-acceptance rows)."
+            "high-acceptance rows). moe_plain serves the MoE family "
+            "through the same live-HTTP harness (plain server; the "
+            "batcher is a gpt-family feature)."
         ),
     }
     if write:

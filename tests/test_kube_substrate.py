@@ -440,3 +440,29 @@ class TestLogFollowOverHttp:
             got.append(piece)
         timer.join()
         assert "".join(got) == "b\nc\n"
+
+    def test_close_unblocks_quiet_follow(self, wire):
+        """substrate.close() must end a follow stream parked in a
+        timeout-less read on a pod that writes nothing (review-found:
+        _stop alone is only checked after a line arrives)."""
+        server, substrate = wire
+        pod = k8s.Pod(
+            metadata=k8s.ObjectMeta(name="quiet-0", namespace="default"),
+            spec=k8s.PodSpec(
+                containers=[k8s.Container(name="tensorflow", image="x")]
+            ),
+        )
+        substrate.create_pod(pod)
+        stream = substrate.read_pod_log("default", "quiet-0", follow=True)
+        done = threading.Event()
+
+        def consume():
+            for _ in stream:
+                pass
+            done.set()
+
+        thread = threading.Thread(target=consume, daemon=True)
+        thread.start()
+        time.sleep(0.3)  # let the reader park in recv
+        substrate.close()
+        assert done.wait(5.0), "close() did not unblock the follower"

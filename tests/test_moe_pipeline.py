@@ -363,9 +363,12 @@ class TestMoeTask:
         train_loss, train_aux = task.loss_fn(variables, sample, train=True)
         eval_loss, eval_aux = task.loss_fn(variables, sample, train=False)
         assert float(train_aux["router_aux"]) > 0.0
+        # the regularizer total = balance term + z-loss term; the two
+        # metrics stay separate (router_aux must remain the pure
+        # balance number)
         np.testing.assert_allclose(
             float(train_loss) - float(eval_loss),
-            float(train_aux["router_aux"]),
+            float(train_aux["router_aux"]) + float(train_aux["router_z"]),
             rtol=1e-5, atol=1e-7,
         )
         # the Trainer.evaluate path reports the pure-LM loss
@@ -489,3 +492,38 @@ class TestMoEPrefill:
             np.asarray(out[:, -1]),
             np.asarray(jnp.argmax(train_logits[:, -1], axis=-1)),
         )
+
+
+class TestRouterZLoss:
+    """ST-MoE z-loss (models/moe.py TopKRouter): mean(logsumexp^2) of
+    the router logits, sown into the losses collection — a stabilizer
+    against router logit drift; 0 disables the sow entirely."""
+
+    def test_z_loss_sown_and_positive(self):
+        cfg = dataclasses.replace(CFG, router_z_weight=0.01)
+        model = m.MoELM(cfg)
+        seq = jax.random.randint(
+            jax.random.PRNGKey(0), (2, 16), 0, cfg.vocab_size
+        )
+        variables = model.init(jax.random.PRNGKey(1), seq)
+        _, mods = model.apply(
+            {"params": variables["params"]}, seq, mutable=["losses"]
+        )
+        z_total = float(m.sum_sown(mods["losses"], "router_z"))
+        assert z_total > 0
+        # total_aux_loss picks both terms up (what moe_task trains on)
+        total = float(m.total_aux_loss(mods["losses"]))
+        aux_only = float(m.sum_sown(mods["losses"], "router_aux"))
+        np.testing.assert_allclose(total, aux_only + z_total, rtol=1e-6)
+
+    def test_zero_weight_skips_the_sow(self):
+        cfg = dataclasses.replace(CFG, router_z_weight=0.0)
+        model = m.MoELM(cfg)
+        seq = jax.random.randint(
+            jax.random.PRNGKey(0), (2, 16), 0, cfg.vocab_size
+        )
+        variables = model.init(jax.random.PRNGKey(1), seq)
+        _, mods = model.apply(
+            {"params": variables["params"]}, seq, mutable=["losses"]
+        )
+        assert float(m.sum_sown(mods["losses"], "router_z")) == 0.0

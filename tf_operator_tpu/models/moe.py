@@ -47,6 +47,11 @@ class MoEConfig:
     # 1 = every block (Mixtral-style)
     moe_every: int = 2
     router_aux_weight: float = 0.01
+    # ST-MoE router z-loss (Zoph et al.): mean(logsumexp(logits)^2),
+    # penalizing large router logits — the standard stabilizer against
+    # router logit drift in long bf16 runs. 0 disables (the sow is
+    # skipped entirely, so existing losses are unchanged).
+    router_z_weight: float = 0.001
     dtype: jnp.dtype = jnp.bfloat16
 
     @property
@@ -134,6 +139,16 @@ class TopKRouter(nn.Module):
         prob_frac = probs.mean(axis=(0, 1))
         aux = cfg.num_experts * jnp.sum(top1_frac * prob_frac)
         self.sow("losses", "router_aux", cfg.router_aux_weight * aux)
+        if cfg.router_z_weight > 0:
+            # ST-MoE z-loss: keeps router logits small so the f32
+            # softmax stays well-conditioned over long runs; sown into
+            # the same collection, so moe_task's total_aux_loss picks
+            # it up with no trainer change
+            z = jnp.mean(
+                jax.nn.logsumexp(logits.astype(jnp.float32), axis=-1)
+                ** 2
+            )
+            self.sow("losses", "router_z", cfg.router_z_weight * z)
         return dispatch, combine
 
 
@@ -331,11 +346,26 @@ def lm_loss(
 
 
 def total_aux_loss(losses_collection) -> jax.Array:
-    """Sum every sown router_aux scalar (one per MoE block)."""
+    """Sum EVERY sown scalar in the losses collection — the training
+    regularizer total (load-balancing router_aux + ST-MoE router_z,
+    one each per MoE block)."""
     leaves = jax.tree_util.tree_leaves(losses_collection)
     if not leaves:
         return jnp.asarray(0.0, jnp.float32)
     return sum(jnp.asarray(leaf, jnp.float32).sum() for leaf in leaves)
+
+
+def sum_sown(losses_collection, name: str) -> jax.Array:
+    """Sum only the sown scalars whose path ends in `name` ("router_aux"
+    or "router_z") — the per-term view total_aux_loss aggregates; keeps
+    metrics (and the bench's balance stat) from mixing the two."""
+    total = jnp.asarray(0.0, jnp.float32)
+    for path, leaf in jax.tree_util.tree_flatten_with_path(
+        losses_collection
+    )[0]:
+        if any(getattr(k, "key", None) == name for k in path):
+            total = total + jnp.asarray(leaf, jnp.float32).sum()
+    return total
 
 
 def synthetic_batch(rng: jax.Array, batch_size: int, seq_len: int, cfg: MoEConfig):

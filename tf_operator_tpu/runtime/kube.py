@@ -14,6 +14,7 @@ or a kubeconfig (token / client-cert contexts).
 from __future__ import annotations
 
 import base64
+import http.client
 import json
 import logging
 import os
@@ -143,6 +144,10 @@ class KubeSubstrate:
         # synthesize DELETED events for objects that vanished during the
         # outage (the informer store's role)
         self._watch_known: Dict[str, Dict[str, dict]] = {}
+        # live follow-stream responses, so close() can unblock readers
+        # parked in a timeout-less recv (read_pod_log follow=True)
+        self._follow_streams: set = set()
+        self._follow_lock = threading.Lock()
         self._stop = threading.Event()
 
     # -- construction ------------------------------------------------------
@@ -340,9 +345,10 @@ class KubeSubstrate:
         container terminates. Follow reads carry NO socket timeout —
         a quiet training pod can go far longer than any fixed budget
         between log lines, and kubectl follows indefinitely; stop a
-        stream early by closing the iterator (``gen.close()``) or the
-        substrate. Like a watch, a follow counts ONE limiter token at
-        initiation."""
+        stream early with ``substrate.close()`` (it tears the socket
+        out from under a blocked read — the stream ends cleanly), or
+        close the iterator from the consuming thread. Like a watch, a
+        follow counts ONE limiter token at initiation."""
         query = []
         if container:
             query.append("container=" + urllib.parse.quote(container))
@@ -378,13 +384,27 @@ class KubeSubstrate:
                 return resp.read().decode(errors="replace")
 
         def stream():
-            with resp:
-                # http.client de-chunks; iterate in line-sized reads so
-                # chunks surface promptly
-                for line in resp:
-                    if self._stop.is_set():
-                        return
-                    yield line.decode(errors="replace")
+            with self._follow_lock:
+                self._follow_streams.add(resp)
+            try:
+                with resp:
+                    # http.client de-chunks; iterate in line-sized
+                    # reads so chunks surface promptly
+                    for line in resp:
+                        if self._stop.is_set():
+                            return
+                        yield line.decode(errors="replace")
+            except (ValueError, OSError, AttributeError,
+                    http.client.HTTPException):
+                # close() tore the socket out from under a blocked
+                # read — the documented way to stop a quiet stream.
+                # The race surfaces variously: ECONNRESET/EBADF,
+                # IncompleteRead, or http.client tearing fp to None
+                # mid-chunk (AttributeError)
+                return
+            finally:
+                with self._follow_lock:
+                    self._follow_streams.discard(resp)
 
         return stream()
 
@@ -877,6 +897,22 @@ class KubeSubstrate:
 
     def close(self) -> None:
         self._stop.set()
+        # unblock follow readers parked in a timeout-less recv: only a
+        # socket SHUTDOWN interrupts a recv blocked in another thread
+        # (closing the file object alone leaves it parked)
+        import socket as _socket
+
+        with self._follow_lock:
+            streams = list(self._follow_streams)
+        for resp in streams:
+            try:
+                resp.fp.raw._sock.shutdown(_socket.SHUT_RDWR)
+            except Exception:  # noqa: BLE001 — CPython detail; the
+                pass  # close() below is the portable fallback
+            try:
+                resp.close()
+            except Exception:  # noqa: BLE001 — already-closed is fine
+                pass
 
 
 def _obj_key(obj: dict) -> str:

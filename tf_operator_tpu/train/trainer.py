@@ -144,7 +144,7 @@ def moe_task(model) -> Task:
     (already cfg.router_aux_weight-scaled) load-balancing terms into
     the "losses" collection; the task collects and adds them, and
     reports the aux magnitude as a metric."""
-    from ..models.moe import lm_loss, total_aux_loss
+    from ..models.moe import lm_loss, sum_sown, total_aux_loss
 
     def loss_fn(variables, batch, train=True):
         mask = batch.get("attention_mask")
@@ -161,7 +161,14 @@ def moe_task(model) -> Task:
         # excludes it; it stays visible as the router_aux metric
         # (ADVICE r3)
         loss = lm + aux if train else lm
-        extras = {"router_aux": aux, "batch_stats": None}
+        # router_aux reports ONLY the load-balancing term (balance =
+        # router_aux / (weight * n_moe_layers) must stay meaningful);
+        # the z-loss gets its own metric, `aux` (their sum) trains
+        extras = {
+            "router_aux": sum_sown(mods.get("losses", {}), "router_aux"),
+            "router_z": sum_sown(mods.get("losses", {}), "router_z"),
+            "batch_stats": None,
+        }
         if mask is not None:
             # weight mass -> exact LM gradient under accumulation.
             # Trade-off: the aux regularizer rides the same per-
