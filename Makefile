@@ -24,7 +24,7 @@ IMAGE_DIR := build/images
 DIST      := build/dist
 
 .PHONY: ci presubmit lint native native-test native-race test wire-test e2e e2e-kind bench \
-        images release mnist-acc clean
+        chaos-soak images release mnist-acc clean
 
 # `test` already runs the whole tests/ tree (native bindings, wire,
 # E2E suites included) — native-test/wire-test exist for targeted runs,
@@ -64,6 +64,11 @@ test:
 
 wire-test:
 	$(PY) -m pytest tests/test_kube_substrate.py tests/test_e2e.py -q
+
+# long seeded chaos soak: full controller vs the fault-injecting
+# substrate (docs/chaos.md); the fast seeded variant runs in `test`
+chaos-soak:
+	$(PY) -m pytest tests/test_chaos.py -q -m slow
 
 # Hermetic E2E runs everywhere (operator process <-HTTP-> apiserver
 # <-HTTP-> process kubelet); the kind path self-activates when kind is

@@ -1,0 +1,650 @@
+"""Chaos soak: the whole controller against a hostile apiserver.
+
+The acceptance gate for the robustness work (docs/chaos.md):
+
+- a seeded soak with >=3 fault kinds firing (transient API errors,
+  watch drops, pod deaths) must reach all-jobs-converged with zero
+  orphaned pods, zero duplicate active pods, and a reconcile loop
+  that never died — and the SAME driver with chaos disabled passes
+  unchanged;
+- a forced watch outage triggers relist + resume, observable via
+  `watch_reestablished_total`;
+- an injected reconcile exception for one job never prevents other
+  jobs from syncing (per-key isolation, client-go HandleCrash);
+- the degraded-mode latch stops pod churn under consecutive substrate
+  errors and recovers with a condition the job keeps as history.
+
+Layering under soak mirrors production hardening:
+controller -> RetryingSubstrate -> ChaosSubstrate -> InMemorySubstrate.
+"""
+
+import random
+import time
+
+import pytest
+
+from tf_operator_tpu.api import k8s, types as t
+from tf_operator_tpu.chaos import (
+    FAULT_API_ERROR,
+    FAULT_POD_DEATH,
+    FAULT_WATCH_DROP,
+    WATCH_REESTABLISH,
+    ChaosConfig,
+    ChaosSubstrate,
+    FaultSpec,
+)
+from tf_operator_tpu.controller import TFJobController
+from tf_operator_tpu.controller.degraded import DegradedLatch
+from tf_operator_tpu.runtime import (
+    InMemorySubstrate,
+    RetryingSubstrate,
+    RetryPolicy,
+    call_with_retries,
+)
+from tf_operator_tpu.runtime.kube import ApiError
+from tf_operator_tpu.server.metrics import OperatorMetrics
+
+from tests.test_api import make_job
+
+
+def no_sleep(_delay):
+    pass
+
+
+def fast_policy(seed=0, max_attempts=5):
+    return RetryPolicy(
+        max_attempts=max_attempts, base_delay=0.0001, max_delay=0.001,
+        rng=random.Random(seed), sleep=no_sleep,
+    )
+
+
+def assert_no_duplicate_active_pods(sub, context=""):
+    """The canonical expectations bug: two live pods for one replica
+    slot. Must hold at every instant, not just at quiescence."""
+    seen = {}
+    for pod in sub.list_pods(None):
+        if not pod.is_active():
+            continue
+        key = (
+            pod.metadata.namespace,
+            pod.metadata.labels.get(t.LABEL_JOB_NAME),
+            pod.metadata.labels.get(t.LABEL_REPLICA_TYPE),
+            pod.metadata.labels.get(t.LABEL_REPLICA_INDEX),
+        )
+        assert key not in seen, (
+            f"duplicate active pod for {key}: {pod.metadata.name} "
+            f"and {seen[key]} ({context})"
+        )
+        seen[key] = pod.metadata.name
+
+
+def assert_no_orphan_pods(sub, context=""):
+    """Every pod must belong to a job that exists — a faulted reconcile
+    must never strand a pod for a job the apiserver rejected."""
+    jobs = {(j.namespace, j.name) for j in sub.list_jobs()}
+    for pod in sub.list_pods(None):
+        owner = (
+            pod.metadata.namespace,
+            pod.metadata.labels.get(t.LABEL_JOB_NAME),
+        )
+        assert owner in jobs, (
+            f"orphaned pod {pod.metadata.name}: job {owner} gone ({context})"
+        )
+
+
+class SoakResult:
+    def __init__(self, inner, chaos, controller, metrics, names):
+        self.inner = inner
+        self.chaos = chaos
+        self.controller = controller
+        self.metrics = metrics
+        self.names = names
+
+
+def run_soak(seed, chaos_on, steps=40, jobs=3, deadline_s=60.0):
+    """Drive a seeded interleaving of user/kubelet actions and partial
+    reconcile bursts while the chaos schedule fires, then force
+    convergence and return the harness for assertions."""
+    inner = InMemorySubstrate()
+    metrics = OperatorMetrics()
+    config = (
+        ChaosConfig.soak(seed=seed, probability=0.10, max_count=25)
+        if chaos_on else ChaosConfig(seed=seed)
+    )
+    chaos = ChaosSubstrate(inner, config, metrics=metrics)
+    substrate = RetryingSubstrate(
+        chaos, policy=fast_policy(seed + 1), metrics=metrics
+    )
+    latch = DegradedLatch(
+        error_threshold=8, recovery_threshold=2, probe_interval=0.02,
+        metrics=metrics,
+    )
+    controller = TFJobController(substrate, metrics=metrics, degraded=latch)
+    rng = random.Random(seed + 2)
+    ctx = f"seed={seed} chaos={chaos_on}"
+
+    names = []
+    for i in range(jobs):
+        spec = {"Worker": rng.randint(1, 2)}
+        if rng.random() < 0.5:
+            spec["PS"] = 1
+        job = make_job(spec, name=f"chaos-{i}")
+        for rspec in job.spec.tf_replica_specs.values():
+            # chaos kills pods with 137/143 — both retryable under
+            # ExitCode, so injected deaths restart instead of failing
+            rspec.restart_policy = t.RestartPolicy.EXIT_CODE
+        inner.create_job(job)
+        names.append(f"chaos-{i}")
+
+    # -- hostile phase: actions land mid-reconcile, faults fire ----------
+    for _ in range(steps):
+        action = rng.choice(["advance", "advance", "terminate", "noop"])
+        if action == "advance":
+            inner.run_all_pending()
+        elif action == "terminate":
+            name = rng.choice(names)
+            running = [
+                p for p in inner.list_pods("default", t.gen_labels(name))
+                if p.status.phase == k8s.POD_RUNNING
+            ]
+            if running:
+                try:
+                    inner.terminate_pod(
+                        "default", rng.choice(running).metadata.name,
+                        exit_code=0,
+                    )
+                except Exception:
+                    pass  # raced a reconcile delete: the point of chaos
+        chaos.tick()  # faults land even while the queue is quiet
+        for _ in range(rng.randint(1, 4)):
+            controller.process_next(timeout=0.01)
+        assert_no_duplicate_active_pods(inner, ctx)
+        assert_no_orphan_pods(inner, ctx)
+
+    # -- convergence phase: drive every job to terminal -------------------
+    deadline = time.monotonic() + deadline_s
+    while time.monotonic() < deadline:
+        chaos.tick()
+        inner.run_all_pending()
+        unfinished = []
+        for name in names:
+            stored = inner.get_job("default", name)
+            if stored.is_finished():
+                continue
+            unfinished.append(name)
+            for pod in inner.list_pods("default", t.gen_labels(name)):
+                if pod.status.phase == k8s.POD_RUNNING:
+                    try:
+                        inner.terminate_pod(
+                            "default", pod.metadata.name, exit_code=0
+                        )
+                    except Exception:
+                        pass
+        # any stream still down re-establishes here (in production the
+        # reflector's relist loop plays this role)
+        for kind in list(chaos._watch_down):
+            chaos.reestablish_watch(kind)
+        controller.run_until_quiet(max_steps=400)
+        if not unfinished and controller.run_until_quiet(max_steps=50) == 0:
+            break
+        time.sleep(0.02)  # let rate-limited requeue timers fire
+    else:
+        pytest.fail(f"soak never converged ({ctx})")
+
+    return SoakResult(inner, chaos, controller, metrics, names)
+
+
+def assert_converged(soak, context=""):
+    assert_no_duplicate_active_pods(soak.inner, context)
+    assert_no_orphan_pods(soak.inner, context)
+    for name in soak.names:
+        stored = soak.inner.get_job("default", name)
+        assert stored.is_finished(), (
+            f"{name} not terminal: {stored.status.conditions} ({context})"
+        )
+        # CleanPodPolicy Running (the default) leaves no active pods
+        active = [
+            p for p in soak.inner.list_pods("default", t.gen_labels(name))
+            if p.is_active()
+        ]
+        assert not active, (
+            f"{name} finished but keeps {[p.metadata.name for p in active]} "
+            f"({context})"
+        )
+        # expectations eventually satisfied — nothing dangles past the
+        # watch re-establishments
+        assert soak.controller._satisfied_expectations(stored), (
+            f"{name} still expectation-blocked ({context})"
+        )
+    # the reconcile loop survived: the queue still accepts and drains
+    soak.controller.enqueue(f"default/{soak.names[0]}")
+    assert soak.controller.run_until_quiet(max_steps=50) >= 1, (
+        f"reconcile loop dead ({context})"
+    )
+
+
+class TestChaosSoak:
+    @pytest.mark.parametrize("seed", [0, 1, 2])
+    def test_soak_converges_under_chaos(self, seed):
+        soak = run_soak(seed, chaos_on=True)
+        ctx = f"seed={seed}"
+        assert_converged(soak, ctx)
+        # the run must actually have been hostile: >=3 distinct fault
+        # kinds including the acceptance trio
+        kinds = soak.chaos.fault_log.kinds() - {WATCH_REESTABLISH}
+        assert {FAULT_API_ERROR, FAULT_WATCH_DROP, FAULT_POD_DEATH} <= kinds, (
+            f"chaos too tame: only {sorted(kinds)} fired ({ctx})"
+        )
+        # hardening observables moved: transient errors were retried
+        assert soak.metrics.value("substrate_retries_total") > 0
+        assert soak.metrics.value("watch_reestablished_total") > 0
+
+    @pytest.mark.parametrize("seed", [0, 1, 2])
+    def test_soak_with_chaos_disabled_passes_unchanged(self, seed):
+        soak = run_soak(seed, chaos_on=False)
+        assert_converged(soak, f"seed={seed} chaos=off")
+        assert len(soak.chaos.fault_log) == 0
+
+    @pytest.mark.slow
+    @pytest.mark.parametrize("seed", list(range(8)))
+    def test_long_soak(self, seed):
+        soak = run_soak(
+            seed, chaos_on=True, steps=200, jobs=5, deadline_s=300.0
+        )
+        assert_converged(soak, f"seed={seed} long")
+        assert len(soak.chaos.fault_log.kinds() - {WATCH_REESTABLISH}) >= 3
+
+
+class TestChaosDeterminism:
+    def test_same_seed_same_fault_log(self):
+        """The replay contract: an identical op sequence against the
+        same seed injects the identical fault sequence."""
+        logs = []
+        for _ in range(2):
+            inner = InMemorySubstrate()
+            chaos = ChaosSubstrate(
+                inner, ChaosConfig.soak(seed=7, probability=0.3)
+            )
+            job = make_job({"Worker": 1}, name="det")
+            inner.create_job(job)
+            for _ in range(60):
+                for op in (
+                    lambda: chaos.list_jobs(),
+                    lambda: chaos.get_job("default", "det"),
+                    lambda: chaos.list_pods("default"),
+                    lambda: chaos.update_job_status(
+                        inner.get_job("default", "det")
+                    ),
+                ):
+                    try:
+                        op()
+                    except Exception:
+                        pass
+            logs.append(
+                [(r.op, r.kind, r.detail)
+                 for r in chaos.fault_log.records()
+                 if r.kind != "latency"]  # detail embeds the drawn sleep
+            )
+            assert logs[0], "no faults fired at probability=0.3"
+        assert logs[0] == logs[1]
+
+    def test_different_seeds_differ(self):
+        logs = []
+        for seed in (1, 2):
+            inner = InMemorySubstrate()
+            chaos = ChaosSubstrate(
+                inner, ChaosConfig.soak(seed=seed, probability=0.3)
+            )
+            for _ in range(50):
+                try:
+                    chaos.list_jobs()
+                except Exception:
+                    pass
+            logs.append([(r.op, r.kind, r.detail)
+                         for r in chaos.fault_log.records()])
+        assert logs[0] != logs[1]
+
+
+class TestWatchReestablish:
+    def test_forced_drop_relists_and_resumes(self):
+        """The 410-Gone acceptance path: events lost during an outage
+        are recovered by the relist (ADDED for never-seen objects, so
+        creation expectations resolve), observable via
+        `watch_reestablished_total`."""
+        inner = InMemorySubstrate()
+        metrics = OperatorMetrics()
+        chaos = ChaosSubstrate(inner, ChaosConfig(), metrics=metrics)
+        controller = TFJobController(chaos, metrics=metrics)
+
+        inner.create_job(make_job({"Worker": 1}, name="wd-before"))
+        controller.run_until_quiet()
+        assert inner.list_pods("default", t.gen_labels("wd-before"))
+
+        chaos.force_watch_gone("pod", outage_ops=10**9)  # manual resume
+        inner.create_job(make_job({"Worker": 1}, name="wd-during"))
+        controller.run_until_quiet()
+        # the pod was created but its ADDED event died with the stream:
+        # the job is expectation-blocked, NOT wedged forever
+        assert inner.list_pods("default", t.gen_labels("wd-during"))
+        stored = inner.get_job("default", "wd-during")
+        assert not controller._satisfied_expectations(stored)
+
+        chaos.reestablish_watch("pod")
+        assert metrics.value("watch_reestablished_total") == 1
+        controller.run_until_quiet()
+        stored = inner.get_job("default", "wd-during")
+        assert controller._satisfied_expectations(stored)
+        kinds = chaos.fault_log.kinds()
+        assert FAULT_WATCH_DROP in kinds and WATCH_REESTABLISH in kinds
+
+    def test_relist_synthesizes_deleted_for_vanished_pods(self):
+        inner = InMemorySubstrate()
+        chaos = ChaosSubstrate(inner, ChaosConfig())
+        seen = []
+        chaos.subscribe("pod", lambda verb, pod: seen.append(
+            (verb, pod.metadata.name)
+        ))
+        pod = k8s.Pod(
+            metadata=k8s.ObjectMeta(name="doomed", namespace="default"),
+            spec=k8s.PodSpec(
+                containers=[k8s.Container(name="tensorflow", image="i")]
+            ),
+        )
+        chaos.create_pod(pod)
+        assert ("ADDED", "doomed") in seen
+        chaos.force_watch_gone("pod", outage_ops=10**9)
+        inner.delete_pod("default", "doomed")
+        assert ("DELETED", "doomed") not in seen  # lost with the stream
+        chaos.reestablish_watch("pod")
+        assert ("DELETED", "doomed") in seen
+
+
+class _PoisonedSubstrate:
+    """Delegating wrapper that fails get_job for one poisoned name —
+    the injected per-key reconcile exception of the acceptance gate."""
+
+    def __init__(self, inner, poisoned):
+        self.inner = inner
+        self.poisoned = poisoned
+
+    def get_job(self, namespace, name):
+        if name == self.poisoned:
+            raise RuntimeError(f"injected reconcile failure for {name}")
+        return self.inner.get_job(namespace, name)
+
+    def __getattr__(self, name):
+        return getattr(self.inner, name)
+
+
+class TestReconcileIsolation:
+    def test_one_jobs_exception_does_not_block_others(self):
+        inner = InMemorySubstrate()
+        metrics = OperatorMetrics()
+        poisoned = _PoisonedSubstrate(inner, "bad")
+        controller = TFJobController(poisoned, metrics=metrics)
+
+        inner.create_job(make_job({"Worker": 1}, name="good"))
+        inner.create_job(make_job({"Worker": 1}, name="bad"))
+        controller.run_until_quiet()
+        inner.run_all_pending()
+        controller.run_until_quiet()
+        inner.terminate_pod("default", "good-worker-0", exit_code=0)
+        controller.run_until_quiet()
+
+        # "bad" kept crashing its syncs; "good" converged regardless
+        good = inner.get_job("default", "good")
+        assert good.is_finished()
+        assert metrics.value("reconcile_panics_total") >= 1
+
+        # heal the poison: the rate-limited requeue recovers "bad"
+        poisoned.poisoned = None
+        deadline = time.monotonic() + 10
+        while time.monotonic() < deadline:
+            controller.run_until_quiet()
+            if inner.list_pods("default", t.gen_labels("bad")):
+                break
+            time.sleep(0.02)
+        assert inner.list_pods("default", t.gen_labels("bad")), (
+            "poisoned key never recovered after heal"
+        )
+
+    def test_event_handler_crash_is_isolated_and_requeued(self):
+        sub = InMemorySubstrate()
+        metrics = OperatorMetrics()
+        controller = TFJobController(sub, metrics=metrics)
+
+        def boom(verb, obj):
+            raise RuntimeError("handler crash")
+
+        controller._guard_handler(boom, "ADDED", None, "default/x")
+        assert metrics.value("reconcile_panics_total") == 1
+        # the key was requeued so the level-triggered sync replays it
+        assert controller.queue.get(timeout=1.0) == "default/x"
+
+
+class _FlakySubstrate:
+    """Delegating wrapper with a switchable full-outage mode: every
+    gated read/write raises a transient 500 while `failing` is set.
+    Counts pod creates so tests can assert churn stopped."""
+
+    GATED = {
+        "list_jobs", "get_job", "update_job", "update_job_status",
+        "list_pods", "create_pod", "delete_pod",
+        "list_services", "create_service", "delete_service",
+    }
+
+    def __init__(self, inner):
+        self.inner = inner
+        self.failing = False
+        self.creates = 0
+
+    def create_pod(self, pod):
+        if self.failing:
+            raise ApiError(500, "outage")
+        self.creates += 1
+        return self.inner.create_pod(pod)
+
+    def __getattr__(self, name):
+        attr = getattr(self.inner, name)
+        if name not in self.GATED or not callable(attr):
+            return attr
+
+        def wrapped(*args, **kwargs):
+            if self.failing:
+                raise ApiError(500, "outage")
+            return attr(*args, **kwargs)
+
+        return wrapped
+
+
+class TestDegradedLatch:
+    def test_latch_trips_and_recovers(self):
+        metrics = OperatorMetrics()
+        latch = DegradedLatch(
+            error_threshold=3, recovery_threshold=2, metrics=metrics
+        )
+        latch.record_error()
+        latch.record_error()
+        assert not latch.degraded
+        latch.record_success()  # success resets the streak
+        latch.record_error()
+        latch.record_error()
+        assert not latch.degraded
+        latch.record_error()
+        assert latch.degraded
+        assert metrics.value("degraded") == 1
+        latch.record_success()
+        assert latch.degraded  # half-open: one probe isn't recovery
+        latch.record_success()
+        assert not latch.degraded
+        assert metrics.value("degraded") == 0
+
+    def test_degraded_controller_pauses_churn_and_recovers(self):
+        inner = InMemorySubstrate()
+        metrics = OperatorMetrics()
+        flaky = _FlakySubstrate(inner)
+        latch = DegradedLatch(
+            error_threshold=2, recovery_threshold=1, probe_interval=0.01,
+            metrics=metrics,
+        )
+        controller = TFJobController(flaky, metrics=metrics, degraded=latch)
+
+        inner.create_job(make_job({"Worker": 2}, name="dg"))
+        controller.run_until_quiet()
+        creates_before = flaky.creates
+        assert creates_before >= 1  # healthy baseline reconciled
+
+        # outage: consecutive transient sync errors trip the latch
+        flaky.failing = True
+        for _ in range(3):
+            controller.enqueue("default/dg")
+            controller.run_until_quiet(max_steps=5)
+        assert latch.degraded
+        assert metrics.value("degraded") == 1
+
+        # substrate heals but the latch is still down: syncs degrade to
+        # read-only probes — condition stamped, NO pod churn
+        flaky.failing = False
+        controller.enqueue("default/dg")
+        controller.process_next(timeout=0.5)
+        stored = inner.get_job("default", "dg")
+        degraded_conds = [
+            c for c in stored.status.conditions
+            if c.type == t.ConditionType.DEGRADED
+        ]
+        assert degraded_conds and degraded_conds[-1].status == "True"
+        assert flaky.creates == creates_before  # churn paused
+        assert any(
+            e.reason == "OperatorDegraded"
+            for e in inner.events_for("TFJob", "dg")
+        )
+
+        # that successful probe met recovery_threshold=1: next sync
+        # reconciles for real and flips the condition to False; a pod
+        # lost meanwhile is replaced again (churn resumed)
+        assert not latch.degraded
+        inner.delete_pod("default", "dg-worker-0")
+        deadline = time.monotonic() + 10
+        conds = []
+        while time.monotonic() < deadline:
+            controller.run_until_quiet()
+            stored = inner.get_job("default", "dg")
+            conds = [
+                c for c in stored.status.conditions
+                if c.type == t.ConditionType.DEGRADED
+            ]
+            if conds and conds[-1].status == "False" and flaky.creates > creates_before:
+                break
+            time.sleep(0.02)
+        assert conds and conds[-1].status == "False"
+        assert flaky.creates > creates_before  # churn resumed
+        assert metrics.value("degraded") == 0
+
+
+class _CountingFlaky:
+    """get_job fails with a transient status N times, then succeeds."""
+
+    def __init__(self, inner, failures, status=500):
+        self.inner = inner
+        self.remaining = failures
+        self.status = status
+        self.calls = 0
+
+    def get_job(self, namespace, name):
+        self.calls += 1
+        if self.remaining > 0:
+            self.remaining -= 1
+            raise ApiError(self.status, "flaky")
+        return self.inner.get_job(namespace, name)
+
+    def __getattr__(self, name):
+        return getattr(self.inner, name)
+
+
+class TestRetryLayer:
+    def test_transient_errors_are_retried_then_succeed(self):
+        calls = []
+
+        def flaky():
+            calls.append(1)
+            if len(calls) < 3:
+                raise ApiError(429, "throttled")
+            return 7
+
+        assert call_with_retries(flaky, policy=fast_policy()) == 7
+        assert len(calls) == 3
+
+    def test_non_transient_raises_immediately(self):
+        calls = []
+
+        def notfound():
+            calls.append(1)
+            raise ApiError(404, "nope")
+
+        with pytest.raises(ApiError):
+            call_with_retries(notfound, policy=fast_policy())
+        assert len(calls) == 1
+
+    def test_budget_exhausted_raises_original(self):
+        calls = []
+
+        def always_down():
+            calls.append(1)
+            raise ApiError(503, "down")
+
+        policy = fast_policy(max_attempts=3)
+        with pytest.raises(ApiError) as exc:
+            call_with_retries(always_down, policy=policy)
+        assert exc.value.status == 503
+        assert len(calls) == 3
+
+    def test_retrying_substrate_absorbs_and_counts(self):
+        inner = InMemorySubstrate()
+        inner.create_job(make_job({"Worker": 1}, name="r1"))
+        metrics = OperatorMetrics()
+        flaky = _CountingFlaky(inner, failures=2)
+        substrate = RetryingSubstrate(
+            flaky, policy=fast_policy(), metrics=metrics
+        )
+        job = substrate.get_job("default", "r1")
+        assert job.name == "r1"
+        assert flaky.calls == 3
+        assert metrics.value("substrate_retries_total") == 2
+
+    def test_delays_follow_decorrelated_bounds(self):
+        policy = RetryPolicy(
+            max_attempts=30, base_delay=0.05, max_delay=0.4,
+            rng=random.Random(3), sleep=no_sleep,
+        )
+        prev = policy.base_delay
+        count = 0
+        for delay in policy.delays():
+            assert policy.base_delay <= delay <= min(0.4, prev * 3)
+            prev = delay
+            count += 1
+        assert count == 29
+
+
+class TestFaultSchedule:
+    def test_max_count_caps_injections(self):
+        inner = InMemorySubstrate()
+        config = ChaosConfig(
+            seed=0,
+            faults={FAULT_API_ERROR: FaultSpec(probability=1.0, max_count=3)},
+        )
+        chaos = ChaosSubstrate(inner, config)
+        errors = 0
+        for _ in range(10):
+            try:
+                chaos.list_jobs()
+            except ApiError:
+                errors += 1
+        assert errors == 3
+        assert chaos.fault_log.counts()[FAULT_API_ERROR] == 3
+
+    def test_zero_probability_is_silent(self):
+        inner = InMemorySubstrate()
+        chaos = ChaosSubstrate(inner, ChaosConfig(seed=0))
+        for _ in range(50):
+            chaos.list_jobs()
+        assert len(chaos.fault_log) == 0

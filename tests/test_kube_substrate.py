@@ -233,6 +233,53 @@ class TestWatchOverHttp:
             f"relist after 410 never resynchronized; saw {seen}"
         )
 
+    def test_410_relist_counts_and_adds_unseen_objects(self):
+        """The 410 path must (a) bump `watch_reestablished_total` and
+        (b) replay objects created during the outage as ADDED, not
+        MODIFIED — a creation expectation is only resolved by ADDED, so
+        a MODIFIED replay would wedge the owning job until the TTL
+        failsafe."""
+        from tf_operator_tpu.server.metrics import OperatorMetrics
+
+        server = FakeApiServer()
+        port = server.start()
+        metrics = OperatorMetrics()
+        substrate = KubeSubstrate(
+            f"http://127.0.0.1:{port}", metrics=metrics
+        )
+        try:
+            seen = []
+            arrived = threading.Event()
+
+            def on_event(verb, pod):
+                seen.append((verb, pod.metadata.name))
+                if pod.metadata.name == "missed":
+                    arrived.set()
+
+            substrate.subscribe("pod", on_event)
+            time.sleep(0.3)
+            early = k8s.Pod()
+            early.metadata.name = "early"
+            early.metadata.namespace = "default"
+            substrate.create_pod(early)
+            deadline = time.monotonic() + 10
+            while time.monotonic() < deadline and not seen:
+                time.sleep(0.05)
+            assert seen, "never saw the first event"
+            server.store.kill_watchers("pods")
+            missed = k8s.Pod()
+            missed.metadata.name = "missed"
+            missed.metadata.namespace = "default"
+            substrate.create_pod(missed)
+            server.store.compact("pods")
+            assert arrived.wait(10.0), f"no relist; saw {seen}"
+            verbs = {name: verb for verb, name in seen}
+            assert verbs["missed"] == "ADDED"
+            assert metrics.value("watch_reestablished_total") >= 1
+        finally:
+            substrate.close()
+            server.stop()
+
     def test_relist_synthesizes_deleted_for_vanished_objects(self, wire):
         """Objects deleted while the stream was down AND whose events
         were compacted away must still surface as DELETED after the

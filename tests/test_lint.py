@@ -124,6 +124,12 @@ class TestRepoIsClean:
                       "bench.py", "__graft_entry__.py")
         ]
         findings = []
-        for path in lint.iter_py_files(targets):
+        seen = list(lint.iter_py_files(targets))
+        for path in seen:
             findings.extend(lint.lint_file(path))
         assert findings == []
+        # subpackages added later must not silently escape the sweep —
+        # the chaos package rode in on this guarantee
+        assert any(os.sep + os.path.join("chaos", "substrate.py") in p
+                   for p in seen)
+        assert any(p.endswith("test_chaos.py") for p in seen)

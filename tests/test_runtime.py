@@ -4,6 +4,7 @@ Modeled on reference pkg/control/*_test.go and the workqueue/
 expectations invariants the reference controller depends on.
 """
 
+import random
 import threading
 import time
 
@@ -13,6 +14,7 @@ from tf_operator_tpu.api import k8s, types as t
 from tf_operator_tpu.runtime import (
     ControllerExpectations,
     EventRecorder,
+    ExponentialBackoff,
     FakePodControl,
     InMemorySubstrate,
     NotFound,
@@ -143,6 +145,81 @@ class TestWorkQueue:
         q.shut_down()
         worker.join(timeout=2)
         assert results == [None]
+
+    def test_add_after_on_shut_down_queue_arms_no_timer(self):
+        """Regression: add_after racing shut_down used to arm its timer
+        after the cancel sweep, leaving a live timer firing into a
+        drained queue."""
+        q = RateLimitingQueue()
+        q.shut_down()
+        q.add_after("late", 0.01)
+        assert not q._timers
+        time.sleep(0.05)
+        assert q.get(timeout=0.05) is None
+
+    def test_add_after_shutdown_race_leaves_no_timers(self):
+        """Hammer add_after against shut_down from another thread; no
+        timer may survive the shutdown sweep."""
+        for _ in range(20):
+            q = RateLimitingQueue()
+            barrier = threading.Barrier(2)
+
+            def adder(q=q, barrier=barrier):
+                barrier.wait()
+                for i in range(50):
+                    q.add_after(f"k{i}", 0.5)
+
+            worker = threading.Thread(target=adder)
+            worker.start()
+            barrier.wait()
+            q.shut_down()
+            worker.join(timeout=5)
+            with q._timer_lock:
+                assert not q._timers
+
+
+class TestExponentialBackoffJitter:
+    def test_default_is_deterministic_doubling(self):
+        b = ExponentialBackoff(base_delay=0.01, max_delay=10.0)
+        assert [b.when("k") for _ in range(4)] == [0.01, 0.02, 0.04, 0.08]
+
+    def test_jitter_delays_stay_within_decorrelated_bounds(self):
+        base, cap = 0.01, 5.0
+        b = ExponentialBackoff(
+            base_delay=base, max_delay=cap, jitter=True,
+            rng=random.Random(42),
+        )
+        prev = base
+        for _ in range(200):
+            delay = b.when("k")
+            assert base <= delay <= min(cap, prev * 3)
+            prev = delay
+
+    def test_jitter_is_capped(self):
+        b = ExponentialBackoff(
+            base_delay=1.0, max_delay=2.0, jitter=True, rng=random.Random(0)
+        )
+        assert all(b.when("k") <= 2.0 for _ in range(50))
+
+    def test_jitter_is_per_item_and_forget_resets(self):
+        b = ExponentialBackoff(
+            base_delay=0.01, max_delay=10.0, jitter=True,
+            rng=random.Random(7),
+        )
+        for _ in range(10):
+            b.when("a")
+        assert b.num_requeues("a") == 10
+        # a fresh item starts from the base range, not "a"'s history
+        assert b.when("b") <= 0.03
+        b.forget("a")
+        assert b.num_requeues("a") == 0
+        assert b.when("a") <= 0.03
+
+    def test_failure_counting_unchanged_by_jitter(self):
+        b = ExponentialBackoff(jitter=True, rng=random.Random(1))
+        b.when("x")
+        b.when("x")
+        assert b.num_requeues("x") == 2
 
 
 class TestExpectations:

@@ -141,6 +141,38 @@ class TestMetrics:
         finally:
             server.stop()
 
+    def test_robustness_metrics_exposed(self):
+        """The chaos-hardening observables must appear in /metrics with
+        HELP/TYPE lines: substrate retries, watch re-establishments,
+        isolated reconcile panics, and the degraded-mode gauge."""
+        metrics = OperatorMetrics()
+        metrics.retried()
+        metrics.retried()
+        metrics.watch_reestablished()
+        metrics.reconcile_panic()
+        metrics.set_degraded(True)
+        server = MonitoringServer(metrics, port=0)
+        port = server.start()
+        try:
+            body = urllib.request.urlopen(
+                f"http://127.0.0.1:{port}/metrics"
+            ).read().decode()
+            assert "tf_operator_tpu_substrate_retries_total 2" in body
+            assert "tf_operator_tpu_watch_reestablished_total 1" in body
+            assert "tf_operator_tpu_reconcile_panics_total 1" in body
+            assert "tf_operator_tpu_degraded 1" in body
+            for name in (
+                "substrate_retries_total",
+                "watch_reestablished_total",
+                "reconcile_panics_total",
+                "degraded",
+            ):
+                assert f"# HELP tf_operator_tpu_{name}" in body
+        finally:
+            server.stop()
+        metrics.set_degraded(False)
+        assert metrics.value("degraded") == 0
+
 
 class TestLeaderElection:
     def test_file_lock_mutual_exclusion(self, tmp_path):

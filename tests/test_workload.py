@@ -1001,6 +1001,69 @@ class TestPreemptionGuard:
         assert restored is not None
         assert int(restored.step) == saved
 
+    def test_guard_degrades_off_main_thread(self):
+        """signal.signal raises ValueError off the main thread; the
+        guard must degrade to never-triggered instead of crashing the
+        worker (threaded executors, notebooks)."""
+        import signal
+
+        from tf_operator_tpu.train.preemption import PreemptionGuard
+
+        import threading
+
+        before = signal.getsignal(signal.SIGTERM)
+        result = {}
+
+        def run():
+            with PreemptionGuard() as guard:
+                result["installed"] = guard._installed
+                result["triggered"] = guard.triggered.is_set()
+
+        worker = threading.Thread(target=run)
+        worker.start()
+        worker.join(timeout=5)
+        assert result == {"installed": False, "triggered": False}
+        # the real handler was never touched
+        assert signal.getsignal(signal.SIGTERM) is before
+
+    def test_maybe_preempt_exit_contract(self, tmp_path):
+        """maybe_preempt_exit: None while not triggered; 143 (retryable)
+        once triggered — saving a checkpoint when a dir is configured,
+        warning-only when not."""
+        from tf_operator_tpu.train.preemption import (
+            PREEMPTED_EXIT_CODE,
+            PreemptionGuard,
+            maybe_preempt_exit,
+        )
+
+        class FakeState:
+            step = 7
+
+        class FakeTrainer:
+            def __init__(self):
+                self.saved = []
+
+            def save(self, state):
+                self.saved.append(int(state.step))
+
+        guard = PreemptionGuard()  # not entered: handler never installed
+        trainer = FakeTrainer()
+        state = FakeState()
+
+        assert maybe_preempt_exit(guard, trainer, state, str(tmp_path)) is None
+        assert trainer.saved == []
+
+        guard.triggered.set()
+        rc = maybe_preempt_exit(guard, trainer, state, str(tmp_path))
+        assert rc == PREEMPTED_EXIT_CODE == 143  # 128 + SIGTERM
+        assert trainer.saved == [7]
+
+        # no checkpoint_dir: still exits 143, but saves nothing
+        trainer2 = FakeTrainer()
+        rc = maybe_preempt_exit(guard, trainer2, state, "")
+        assert rc == PREEMPTED_EXIT_CODE
+        assert trainer2.saved == []
+
 
 class TestGradientAccumulation:
     """accum_steps=k must produce the same optimizer update as the

@@ -121,6 +121,10 @@ class ConditionType(str, enum.Enum):
     RESTARTING = "Restarting"
     SUCCEEDED = "Succeeded"
     FAILED = "Failed"
+    # operator-side: set while the degraded-mode latch holds (the
+    # apiserver is failing and pod churn is paused); not a reference
+    # condition — the reference has no degraded mode to report
+    DEGRADED = "Degraded"
 
 
 @dataclass

@@ -1,0 +1,33 @@
+"""Chaos substrate: deterministic fault injection for the control
+plane (see docs/chaos.md for the fault model and seeding contract)."""
+
+from .faults import (
+    ALL_FAULT_KINDS,
+    FAULT_API_ERROR,
+    FAULT_CONFLICT,
+    FAULT_LATENCY,
+    FAULT_POD_DEATH,
+    FAULT_PREEMPTION,
+    FAULT_WATCH_DROP,
+    ChaosConfig,
+    FaultLog,
+    FaultRecord,
+    FaultSpec,
+)
+from .substrate import WATCH_REESTABLISH, ChaosSubstrate
+
+__all__ = [
+    "ALL_FAULT_KINDS",
+    "FAULT_API_ERROR",
+    "FAULT_CONFLICT",
+    "FAULT_LATENCY",
+    "FAULT_POD_DEATH",
+    "FAULT_PREEMPTION",
+    "FAULT_WATCH_DROP",
+    "WATCH_REESTABLISH",
+    "ChaosConfig",
+    "ChaosSubstrate",
+    "FaultLog",
+    "FaultRecord",
+    "FaultSpec",
+]

@@ -1,0 +1,117 @@
+"""Fault model for the chaos substrate: kinds, schedules, records.
+
+Everything here is deterministic by construction: one seeded
+`random.Random` owned by the ChaosSubstrate makes every draw, and the
+fault log records each injection in order, so a failing soak replays
+exactly from its seed (the determinism contract in docs/chaos.md).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import threading
+from typing import Dict, List, Optional, Tuple
+
+# -- fault kinds ------------------------------------------------------------
+
+FAULT_API_ERROR = "api_error"     # transient 429/500/410 raised pre-op
+FAULT_CONFLICT = "conflict"       # 409 stale-resourceVersion on a write
+FAULT_LATENCY = "latency"         # added request latency
+FAULT_WATCH_DROP = "watch_drop"   # watch stream dies; relist on re-establish
+FAULT_POD_DEATH = "pod_death"     # container exits 137 (OOM-kill class)
+FAULT_PREEMPTION = "preemption"   # SIGTERM-style exit 143 (slice preempted)
+
+ALL_FAULT_KINDS = (
+    FAULT_API_ERROR,
+    FAULT_CONFLICT,
+    FAULT_LATENCY,
+    FAULT_WATCH_DROP,
+    FAULT_POD_DEATH,
+    FAULT_PREEMPTION,
+)
+
+
+@dataclasses.dataclass
+class FaultSpec:
+    """Schedule for one fault kind: fire with `probability` per gated
+    substrate operation, at most `max_count` times (None = unbounded).
+    A bounded count lets a soak front-load chaos and still guarantee a
+    convergence window at the tail."""
+
+    probability: float = 0.0
+    max_count: Optional[int] = None
+
+
+@dataclasses.dataclass
+class ChaosConfig:
+    seed: int = 0
+    faults: Dict[str, FaultSpec] = dataclasses.field(default_factory=dict)
+    # uniform added latency range for FAULT_LATENCY, seconds
+    latency_range: Tuple[float, float] = (0.0002, 0.002)
+    # gated ops a dropped watch stays down before auto re-establish
+    watch_outage_ops: int = 8
+    # statuses FAULT_API_ERROR draws from (500 weighted double: real
+    # outages skew to 5xx); 410 exercises the non-retryable-but-
+    # requeueable path, 429 the throttle path
+    api_error_statuses: Tuple[int, ...] = (429, 500, 500, 410)
+
+    def spec(self, kind: str) -> FaultSpec:
+        return self.faults.get(kind) or FaultSpec()
+
+    @classmethod
+    def soak(
+        cls,
+        seed: int = 0,
+        probability: float = 0.08,
+        max_count: Optional[int] = 40,
+    ) -> "ChaosConfig":
+        """The standard soak mix: every fault kind enabled at the same
+        per-op probability, each capped so the run always ends with a
+        quiet convergence window."""
+        return cls(
+            seed=seed,
+            faults={
+                kind: FaultSpec(probability=probability, max_count=max_count)
+                for kind in ALL_FAULT_KINDS
+            },
+        )
+
+
+@dataclasses.dataclass
+class FaultRecord:
+    seq: int
+    op: str       # the substrate operation that triggered the draw
+    kind: str     # one of ALL_FAULT_KINDS (or "watch_reestablish")
+    detail: str = ""
+
+
+class FaultLog:
+    """Ordered record of every injected fault, for post-soak
+    assertions ("did ≥3 kinds actually fire?") and failure replay."""
+
+    def __init__(self) -> None:
+        self._lock = threading.Lock()
+        self._records: List[FaultRecord] = []
+
+    def append(self, op: str, kind: str, detail: str = "") -> FaultRecord:
+        with self._lock:
+            record = FaultRecord(len(self._records), op, kind, detail)
+            self._records.append(record)
+            return record
+
+    def records(self) -> List[FaultRecord]:
+        with self._lock:
+            return list(self._records)
+
+    def counts(self) -> Dict[str, int]:
+        counts: Dict[str, int] = {}
+        for record in self.records():
+            counts[record.kind] = counts.get(record.kind, 0) + 1
+        return counts
+
+    def kinds(self) -> set:
+        return set(self.counts())
+
+    def __len__(self) -> int:
+        with self._lock:
+            return len(self._records)

@@ -1,0 +1,359 @@
+"""ChaosSubstrate: seeded fault injection around any Substrate.
+
+The reference operator's resilience claims (retryable exit codes,
+per-item backoff, watch re-establishment — SURVEY.md §5, §7 hard part
+#3) were only ever exercised here against a well-behaved in-memory
+apiserver. This wrapper makes the cluster hostile on demand: it
+implements the `Substrate` protocol around an inner substrate
+(InMemorySubstrate — the fake apiserver — in tests, KubeSubstrate in a
+staging cluster) and injects configurable faults *between* the
+controller and the truth:
+
+- transient API errors (429/500/410 as `kube.ApiError`, 409 as
+  `Conflict`) raised before the inner call runs, so a faulted write
+  never half-applies;
+- added latency;
+- watch-stream drops: subscriber callbacks go silent, then the stream
+  re-establishes with the informer relist contract (ADDED for
+  never-seen objects, MODIFIED for known ones, synthesized DELETED
+  for objects that vanished during the outage) and bumps
+  `watch_reestablished_total`;
+- spurious pod deaths (exit 137) and SIGTERM-style preemptions
+  (exit 143) via the inner kubelet surface.
+
+Every draw comes from one seeded rng and is recorded in `fault_log`,
+so a failing soak is replayable from its seed alone. The controller
+under test must converge anyway — that is the whole point.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import threading
+import time
+from typing import Any, Dict, List, Optional, Tuple
+
+from ..api.serde import deep_copy
+from ..runtime.kube import ApiError
+from ..runtime.substrate import ADDED, Conflict, DELETED, MODIFIED
+from .faults import (
+    FAULT_API_ERROR,
+    FAULT_CONFLICT,
+    FAULT_LATENCY,
+    FAULT_POD_DEATH,
+    FAULT_PREEMPTION,
+    FAULT_WATCH_DROP,
+    ChaosConfig,
+    FaultLog,
+)
+
+WATCH_REESTABLISH = "watch_reestablish"
+
+
+def _obj_key(obj: Any) -> Tuple[str, str]:
+    meta = getattr(obj, "metadata", None)
+    if meta is not None and getattr(meta, "name", ""):
+        return meta.namespace, meta.name
+    return getattr(obj, "namespace", ""), getattr(obj, "name", "")
+
+
+def _copy(obj: Any) -> Any:
+    if dataclasses.is_dataclass(obj):
+        return deep_copy(obj)
+    if hasattr(obj, "copy"):
+        return obj.copy()
+    return obj
+
+
+class ChaosSubstrate:
+    def __init__(
+        self,
+        inner,
+        config: Optional[ChaosConfig] = None,
+        metrics=None,
+    ) -> None:
+        import random
+
+        self.inner = inner
+        self.config = config or ChaosConfig()
+        self.metrics = metrics
+        self.fault_log = FaultLog()
+        self.rng = random.Random(self.config.seed)
+        self._lock = threading.RLock()
+        self._counts: Dict[str, int] = {}
+        # watch interposition: we are the only subscriber the inner
+        # substrate sees; real subscribers register here so a "stream"
+        # can be cut and re-established independently of inner state
+        self._subs: Dict[str, List] = {}
+        self._forwarders: Dict[str, Any] = {}
+        self._watch_down: Dict[str, int] = {}   # kind -> ops left down
+        # last object delivered per key, per kind — the informer-store
+        # role: lets re-establishment synthesize DELETED for objects
+        # that vanished mid-outage and pick ADDED vs MODIFIED
+        self._known: Dict[str, Dict[Tuple[str, str], Any]] = {}
+
+    # -- fault engine ------------------------------------------------------
+
+    def _should(self, kind: str) -> bool:
+        """One seeded draw for one fault kind. Caller holds the lock."""
+        spec = self.config.spec(kind)
+        if spec.probability <= 0:
+            return False
+        count = self._counts.get(kind, 0)
+        if spec.max_count is not None and count >= spec.max_count:
+            return False
+        if self.rng.random() >= spec.probability:
+            return False
+        self._counts[kind] = count + 1
+        return True
+
+    def _gate(self, op: str, write: bool = False,
+              raise_errors: bool = True) -> None:
+        """Run the fault schedule for one substrate operation. Raising
+        faults fire BEFORE the inner call, so a faulted write is a
+        clean server-side rejection, never a half-applied mutation."""
+        cfg = self.config
+        with self._lock:
+            latency = None
+            if self._should(FAULT_LATENCY):
+                latency = self.rng.uniform(*cfg.latency_range)
+            # tick running outages toward auto re-establishment
+            expired = []
+            for kind in sorted(self._watch_down):
+                self._watch_down[kind] -= 1
+                if self._watch_down[kind] <= 0:
+                    expired.append(kind)
+            drop_kind = None
+            if self._should(FAULT_WATCH_DROP):
+                up = [
+                    k for k in sorted(self._subs)
+                    if self._subs[k] and k not in self._watch_down
+                    and k not in expired
+                ]
+                if up:
+                    drop_kind = self.rng.choice(up)
+            kill_code = None
+            if self._should(FAULT_POD_DEATH):
+                kill_code = 137
+            elif self._should(FAULT_PREEMPTION):
+                kill_code = 143
+            conflict = write and raise_errors and self._should(FAULT_CONFLICT)
+            api_status = None
+            if raise_errors and self._should(FAULT_API_ERROR):
+                api_status = self.rng.choice(cfg.api_error_statuses)
+
+        if latency is not None:
+            self.fault_log.append(op, FAULT_LATENCY, f"{latency:.4f}s")
+            time.sleep(latency)
+        for kind in expired:
+            self.reestablish_watch(kind)
+        if drop_kind is not None:
+            self.force_watch_gone(drop_kind)
+        if kill_code is not None:
+            self._kill_random_pod(op, kill_code)
+        if conflict:
+            self.fault_log.append(op, FAULT_CONFLICT)
+            raise Conflict(f"chaos: injected conflict on {op}")
+        if api_status is not None:
+            self.fault_log.append(op, FAULT_API_ERROR, str(api_status))
+            raise ApiError(api_status, f"chaos: injected error on {op}")
+
+    def tick(self) -> None:
+        """Advance the fault schedule without a substrate op (latency,
+        watch outages, pod kills only — never raises). Soak drivers
+        call this between controller bursts so faults keep landing
+        even while the queue is quiet."""
+        self._gate("tick", raise_errors=False)
+
+    def _kill_random_pod(self, op: str, exit_code: int) -> None:
+        pods = [p for p in self.inner.list_pods(None) if p.is_active()]
+        if not pods:
+            return
+        with self._lock:
+            pod = self.rng.choice(pods)
+        kind = FAULT_PREEMPTION if exit_code == 143 else FAULT_POD_DEATH
+        self.fault_log.append(
+            op, kind,
+            f"{pod.metadata.namespace}/{pod.metadata.name} exit={exit_code}",
+        )
+        try:
+            self.inner.terminate_pod(
+                pod.metadata.namespace, pod.metadata.name, exit_code=exit_code
+            )
+        except Exception:
+            pass  # pod raced away between list and kill — fine
+
+    # -- watch interposition ----------------------------------------------
+
+    def subscribe(self, kind: str, callback) -> None:
+        with self._lock:
+            self._subs.setdefault(kind, []).append(callback)
+            if kind not in self._forwarders:
+                def forwarder(verb, obj, _kind=kind):
+                    self._on_inner_event(_kind, verb, obj)
+
+                self._forwarders[kind] = forwarder
+                self.inner.subscribe(kind, forwarder)
+
+    def unsubscribe(self, kind: str, callback) -> None:
+        with self._lock:
+            callbacks = self._subs.get(kind, [])
+            if callback in callbacks:
+                callbacks.remove(callback)
+
+    def _on_inner_event(self, kind: str, verb: str, obj: Any) -> None:
+        with self._lock:
+            if kind in self._watch_down:
+                return  # the stream is down: subscribers miss this
+            known = self._known.setdefault(kind, {})
+            key = _obj_key(obj)
+            if verb == DELETED:
+                known.pop(key, None)
+            else:
+                known[key] = obj
+            callbacks = list(self._subs.get(kind, []))
+        self._deliver(callbacks, verb, obj)
+
+    @staticmethod
+    def _deliver(callbacks: List, verb: str, obj: Any) -> None:
+        for callback in callbacks:
+            callback(verb, _copy(obj))
+
+    def force_watch_gone(self, kind: str, outage_ops: Optional[int] = None) -> None:
+        """Cut one kind's watch stream — the 410 Gone / dropped-
+        connection injection. Events are silently lost until
+        `reestablish_watch` runs (explicitly, or automatically after
+        `watch_outage_ops` further gated operations)."""
+        with self._lock:
+            self._watch_down[kind] = (
+                outage_ops if outage_ops is not None
+                else self.config.watch_outage_ops
+            )
+        self.fault_log.append("watch", FAULT_WATCH_DROP, kind)
+
+    def reestablish_watch(self, kind: str) -> None:
+        """Reconnect a cut stream with the informer relist contract:
+        ADDED for objects subscribers never saw, MODIFIED for known
+        ones, synthesized DELETED for objects that vanished during the
+        outage (mirrors KubeSubstrate._relist after a real 410)."""
+        with self._lock:
+            self._watch_down.pop(kind, None)
+            known = dict(self._known.get(kind, {}))
+            callbacks = list(self._subs.get(kind, []))
+        live = self._list_kind(kind)
+        if live is None:  # kind without a lister: resume, no replay
+            return
+        events = []
+        live_keys = set()
+        for obj in live:
+            key = _obj_key(obj)
+            live_keys.add(key)
+            events.append((MODIFIED if key in known else ADDED, obj))
+        for key, stale in known.items():
+            if key not in live_keys:
+                events.append((DELETED, stale))
+        with self._lock:
+            self._known[kind] = {_obj_key(o): o for o in live}
+        self.fault_log.append("watch", WATCH_REESTABLISH, kind)
+        if self.metrics is not None:
+            self.metrics.watch_reestablished()
+        for verb, obj in events:
+            self._deliver(callbacks, verb, obj)
+
+    def _list_kind(self, kind: str):
+        if kind == "tfjob":
+            return self.inner.list_jobs()
+        if kind == "pod":
+            return self.inner.list_pods(None)
+        if kind == "service":
+            with self._lock:
+                namespaces = {ns for ns, _ in self._known.get(kind, {})}
+            namespaces.update(job.namespace for job in self.inner.list_jobs())
+            return [
+                svc
+                for ns in sorted(namespaces)
+                for svc in self.inner.list_services(ns)
+            ]
+        return None
+
+    # -- gated Substrate surface ------------------------------------------
+    # Only operations the CONTROLLER performs are gated; test-harness
+    # helpers (create_job, run_all_pending, mark_pod_running, ...) pass
+    # through via __getattr__ so chaos never corrupts test setup.
+
+    def list_jobs(self, namespace=None):
+        self._gate("list_jobs")
+        return self.inner.list_jobs(namespace)
+
+    def get_job(self, namespace, name):
+        self._gate("get_job")
+        return self.inner.get_job(namespace, name)
+
+    def update_job(self, job):
+        self._gate("update_job", write=True)
+        return self.inner.update_job(job)
+
+    def update_job_status(self, job):
+        self._gate("update_job_status", write=True)
+        return self.inner.update_job_status(job)
+
+    def delete_job(self, namespace, name):
+        self._gate("delete_job", write=True)
+        return self.inner.delete_job(namespace, name)
+
+    def create_pod(self, pod):
+        self._gate("create_pod", write=True)
+        return self.inner.create_pod(pod)
+
+    def get_pod(self, namespace, name):
+        self._gate("get_pod")
+        return self.inner.get_pod(namespace, name)
+
+    def list_pods(self, namespace, selector=None):
+        self._gate("list_pods")
+        return self.inner.list_pods(namespace, selector)
+
+    def delete_pod(self, namespace, name):
+        self._gate("delete_pod", write=True)
+        return self.inner.delete_pod(namespace, name)
+
+    def patch_pod_labels(self, namespace, name, labels):
+        self._gate("patch_pod_labels", write=True)
+        return self.inner.patch_pod_labels(namespace, name, labels)
+
+    def patch_pod_owner_references(self, namespace, name, refs,
+                                   expected_uid=""):
+        self._gate("patch_pod_owner_references", write=True)
+        return self.inner.patch_pod_owner_references(
+            namespace, name, refs, expected_uid
+        )
+
+    def create_service(self, service):
+        self._gate("create_service", write=True)
+        return self.inner.create_service(service)
+
+    def list_services(self, namespace, selector=None):
+        self._gate("list_services")
+        return self.inner.list_services(namespace, selector)
+
+    def delete_service(self, namespace, name):
+        self._gate("delete_service", write=True)
+        return self.inner.delete_service(namespace, name)
+
+    def patch_service_owner_references(self, namespace, name, refs,
+                                       expected_uid=""):
+        self._gate("patch_service_owner_references", write=True)
+        return self.inner.patch_service_owner_references(
+            namespace, name, refs, expected_uid
+        )
+
+    # events are best-effort by contract on every substrate — never
+    # faulted, so fault-log assertions don't depend on event volume
+    def record_event(self, event) -> None:
+        self.inner.record_event(event)
+
+    def events_for(self, kind, name, namespace=None):
+        return self.inner.events_for(kind, name, namespace)
+
+    def __getattr__(self, name: str):
+        return getattr(self.inner, name)

@@ -1,5 +1,6 @@
 from .clock import Clock, FakeClock
 from .controller import TFJobController
+from .degraded import DegradedLatch
 from .reconciler import Reconciler, ReconcilerConfig
 from .status import (
     REASON_CREATED,
@@ -12,6 +13,7 @@ from .status import (
 
 __all__ = [
     "Clock",
+    "DegradedLatch",
     "FakeClock",
     "TFJobController",
     "Reconciler",

@@ -28,18 +28,22 @@ from ..runtime import (
     RealPodControl,
     RealServiceControl,
 )
+from ..runtime.retry import is_transient_error
 from .clock import Clock
+from .degraded import DegradedLatch
 from .reconciler import (
     Reconciler,
     ReconcilerConfig,
     expectation_pods_key,
     expectation_services_key,
 )
-from .status import REASON_CREATED, set_condition
+from .status import REASON_CREATED, clear_condition, set_condition
 
 logger = logging.getLogger("tf_operator_tpu.controller")
 
 REASON_FAILED_VALIDATION = "TFJobFailedValidation"
+REASON_DEGRADED = "OperatorDegraded"
+REASON_RECOVERED = "OperatorRecovered"
 # retry cadence for admission blocked on transient causes (port range
 # exhausted); resync() also re-admits condition-less jobs as a backstop
 ADMIT_RETRY_SECONDS = 5.0
@@ -62,12 +66,20 @@ class TFJobController:
         metrics=None,
         gang=None,
         port_allocator=None,
+        degraded: Optional[DegradedLatch] = None,
     ) -> None:
         self.substrate = substrate
         self.clock = clock or Clock()
         self.namespace = namespace
         self.metrics = metrics
         self.port_allocator = port_allocator
+        # circuit-breaker against a failing apiserver: consecutive
+        # transient substrate errors latch it; while latched, sync
+        # degrades to a read-only probe (no pod churn)
+        self.degraded = degraded or DegradedLatch(metrics=metrics)
+        # jobs stamped with the Degraded condition this episode, so the
+        # event/condition fires once per (job, outage), not per probe
+        self._degraded_marked: set = set()
         if gang is None and config is not None and config.enable_gang_scheduling:
             from .gang import GangScheduler
 
@@ -109,7 +121,39 @@ class TFJobController:
     def _in_scope(self, namespace: str) -> bool:
         return self.namespace is None or namespace == self.namespace
 
+    def _guard_handler(self, handler, verb, obj, key: Optional[str]) -> None:
+        """client-go HandleCrash for informer callbacks: a handler
+        exception (bad object, transient substrate error inside
+        admission) must never propagate into the watch dispatcher —
+        on InMemorySubstrate that would poison the mutator that
+        emitted the event. Isolate, count, and requeue the key so the
+        level-triggered sync replays whatever the handler missed."""
+        try:
+            handler(verb, obj)
+        except Exception:
+            logger.exception(
+                "%s handler crashed on %s (isolated)",
+                getattr(handler, "__name__", "event"), verb,
+            )
+            if self.metrics is not None:
+                self.metrics.reconcile_panic()
+            if key:
+                self.enqueue(key)
+
     def _on_job(self, verb: str, job: TFJob) -> None:
+        self._guard_handler(self._handle_job, verb, job, job.key())
+
+    def _on_pod(self, verb: str, pod: k8s.Pod) -> None:
+        job_name = pod.metadata.labels.get(LABEL_JOB_NAME)
+        key = f"{pod.metadata.namespace}/{job_name}" if job_name else None
+        self._guard_handler(self._handle_pod, verb, pod, key)
+
+    def _on_service(self, verb: str, svc: k8s.Service) -> None:
+        job_name = svc.metadata.labels.get(LABEL_JOB_NAME)
+        key = f"{svc.metadata.namespace}/{job_name}" if job_name else None
+        self._guard_handler(self._handle_service, verb, svc, key)
+
+    def _handle_job(self, verb: str, job: TFJob) -> None:
         if not self._in_scope(job.namespace):
             return
         if verb == ADDED:
@@ -194,7 +238,7 @@ class TFJobController:
             self.metrics.created()
         self.enqueue(job.key())
 
-    def _on_pod(self, verb: str, pod: k8s.Pod) -> None:
+    def _handle_pod(self, verb: str, pod: k8s.Pod) -> None:
         if not self._in_scope(pod.metadata.namespace):
             return
         if verb == DELETED and self.port_allocator is not None:
@@ -222,7 +266,7 @@ class TFJobController:
             self.expectations.deletion_observed(expectation_pods_key(job_key, rt))
         self.enqueue(job_key)
 
-    def _on_service(self, verb: str, svc: k8s.Service) -> None:
+    def _handle_service(self, verb: str, svc: k8s.Service) -> None:
         if not self._in_scope(svc.metadata.namespace):
             return
         owner = _controller_owner(svc.metadata)
@@ -289,11 +333,29 @@ class TFJobController:
             self._admit(job)
             return
 
+        if self.degraded.degraded:
+            # read-only probe: the get_job above already proved the
+            # substrate answers, which process_next feeds into the
+            # latch's recovery count. Reconciling now would churn pods
+            # against an apiserver we just watched fail repeatedly.
+            self._mark_degraded(job)
+            self.queue.add_after(key, self.degraded.probe_interval)
+            return
+
         needs_sync = job.spec.enable_dynamic_worker or self._satisfied_expectations(job)
         if not needs_sync:
             return
 
         old_status = to_jsonable(job.status)
+        # reaching here means the latch is clear: flip the Degraded
+        # condition to False (persisted via the status-diff below) and
+        # re-arm the once-per-episode mark for the next outage
+        clear_condition(
+            job, ConditionType.DEGRADED, REASON_RECOVERED,
+            "Operator recovered; resuming reconciliation.",
+            self.clock.now_iso(),
+        )
+        self._degraded_marked.discard(key)
         # The selector-filtered LIST covers both our children and
         # adoptable orphans (an adoptable orphan is by definition
         # label-matched). The reference lists the whole namespace
@@ -312,6 +374,32 @@ class TFJobController:
             # their pods are gone: the host ports go back to the pool
             # (reference DeAllocate on pod deletion, port.go:258-295)
             self.port_allocator.release(job.key())
+
+    def _mark_degraded(self, job: TFJob) -> None:
+        """Stamp the Degraded condition + Warning event once per
+        (job, outage episode). Best-effort: the substrate is by
+        definition unhealthy right now, so a failed write just leaves
+        the mark for the next probe."""
+        key = job.key()
+        if key in self._degraded_marked or job.is_finished():
+            return
+        self._degraded_marked.add(key)
+        message = (
+            "Operator degraded: repeated apiserver errors; "
+            "pausing reconciliation."
+        )
+        try:
+            self.recorder.event(
+                job.kind, job.name, job.namespace, "Warning",
+                REASON_DEGRADED, message,
+            )
+            set_condition(
+                job, ConditionType.DEGRADED, REASON_DEGRADED, message,
+                self.clock.now_iso(),
+            )
+            self._update_status(job)
+        except Exception:
+            logger.exception("failed to mark %s degraded", key)
 
     def _fresh_job(self, namespace: str, name: str) -> Optional[TFJob]:
         """Live job read for the adoption re-check (reference
@@ -388,10 +476,18 @@ class TFJobController:
             return False
         try:
             self.sync(key)
-        except Exception:
+        except Exception as err:
+            # HandleCrash analog: one key's failure never kills the
+            # worker; the key retries with backoff while other keys
+            # keep syncing
             logger.exception("error syncing %r; requeueing", key)
+            if self.metrics is not None:
+                self.metrics.reconcile_panic()
+            if is_transient_error(err):
+                self.degraded.record_error()
             self.queue.add_rate_limited(key)
         else:
+            self.degraded.record_success()
             self.queue.forget(key)
         finally:
             self.queue.done(key)

@@ -1,0 +1,83 @@
+"""Degraded-mode latch: stop churning pods under a failing apiserver.
+
+When the substrate fails repeatedly, continuing to reconcile is worse
+than pausing: half-completed syncs create pods whose ADDED events get
+lost, delete pods they then can't replace, and hammer an apiserver
+that is trying to recover. The latch trips after `error_threshold`
+CONSECUTIVE substrate errors (any success resets the count), and while
+latched the controller degrades every sync to a read-only probe — no
+pod/service mutations. It unlatches only after `recovery_threshold`
+consecutive successful probes, so one lucky request during an outage
+doesn't resume churn (the same asymmetry as a circuit breaker's
+half-open state). Transitions flip the `degraded` gauge and invoke the
+optional on_change hook (the controller emits events from it)."""
+
+from __future__ import annotations
+
+import logging
+import threading
+from typing import Callable, Optional
+
+logger = logging.getLogger("tf_operator_tpu.degraded")
+
+
+class DegradedLatch:
+    def __init__(
+        self,
+        error_threshold: int = 5,
+        recovery_threshold: int = 3,
+        probe_interval: float = 2.0,
+        metrics=None,
+        on_change: Optional[Callable[[bool], None]] = None,
+    ) -> None:
+        self.error_threshold = max(1, int(error_threshold))
+        self.recovery_threshold = max(1, int(recovery_threshold))
+        self.probe_interval = probe_interval
+        self.metrics = metrics
+        self.on_change = on_change
+        self._lock = threading.Lock()
+        self._errors = 0
+        self._successes = 0
+        self._degraded = False
+
+    @property
+    def degraded(self) -> bool:
+        with self._lock:
+            return self._degraded
+
+    def record_error(self) -> None:
+        with self._lock:
+            self._errors += 1
+            self._successes = 0
+            trip = not self._degraded and self._errors >= self.error_threshold
+            if trip:
+                self._degraded = True
+        if trip:
+            logger.warning(
+                "degraded mode: %d consecutive substrate errors — "
+                "pausing pod churn", self.error_threshold,
+            )
+            self._notify(True)
+
+    def record_success(self) -> None:
+        clear = False
+        with self._lock:
+            self._errors = 0
+            if self._degraded:
+                self._successes += 1
+                if self._successes >= self.recovery_threshold:
+                    self._degraded = False
+                    self._successes = 0
+                    clear = True
+        if clear:
+            logger.info("degraded mode cleared: substrate healthy again")
+            self._notify(False)
+
+    def _notify(self, degraded: bool) -> None:
+        if self.metrics is not None:
+            self.metrics.set_degraded(degraded)
+        if self.on_change is not None:
+            try:
+                self.on_change(degraded)
+            except Exception:  # pragma: no cover — hook must not wedge
+                logger.exception("degraded on_change hook failed")

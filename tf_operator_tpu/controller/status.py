@@ -96,6 +96,30 @@ def set_condition(
     job.status.conditions = _filter_out(job.status.conditions, ctype) + [condition]
 
 
+def clear_condition(
+    job: TFJob, ctype: ConditionType, reason: str, message: str, now: str
+) -> bool:
+    """Flip an existing True condition to False (k8s convention for "no
+    longer the case" — deleting it would erase the history that the
+    episode happened). No-op unless a True condition of that type
+    exists; returns whether anything changed."""
+    if not any(
+        c.type == ctype and c.status == "True" for c in job.status.conditions
+    ):
+        return False
+    job.status.conditions = _filter_out(job.status.conditions, ctype) + [
+        JobCondition(
+            type=ctype,
+            status="False",
+            reason=reason,
+            message=message,
+            last_update_time=now,
+            last_transition_time=now,
+        )
+    ]
+    return True
+
+
 def initialize_replica_statuses(job: TFJob, rtype: ReplicaType) -> None:
     """Reset phase counters for one replica type before re-counting
     (reference initializeTFReplicaStatuses, status.go:194-202). The

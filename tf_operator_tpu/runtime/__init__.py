@@ -8,6 +8,12 @@ from .control import (
 )
 from .events import EventRecorder, NullRecorder
 from .expectations import ControllerExpectations
+from .retry import (
+    RetryingSubstrate,
+    RetryPolicy,
+    call_with_retries,
+    is_transient_error,
+)
 from .substrate import (
     ADDED,
     DELETED,
@@ -34,6 +40,10 @@ __all__ = [
     "match_labels",
     "now_iso",
     "ControllerExpectations",
+    "RetryPolicy",
+    "RetryingSubstrate",
+    "call_with_retries",
+    "is_transient_error",
     "WorkQueue",
     "DelayingQueue",
     "RateLimitingQueue",

@@ -30,6 +30,7 @@ from typing import Any, Callable, Dict, List, Optional
 from ..api import k8s
 from ..api.serde import from_jsonable, to_jsonable
 from ..api.types import GROUP_NAME, PLURAL, TFJob, VERSION
+from .retry import RetryPolicy, call_with_retries
 from .substrate import (
     ADDED,
     AlreadyExists,
@@ -126,11 +127,18 @@ class KubeSubstrate:
         ssl_context: Optional[ssl.SSLContext] = None,
         qps: float = 0.0,
         burst: int = 10,
+        retry_policy: Optional[RetryPolicy] = None,
+        metrics=None,
     ) -> None:
         self.base_url = base_url.rstrip("/")
         self._token = token
         self._ssl = ssl_context
         self._limiter = _TokenBucket(qps, burst)
+        # transport-level transient retry (429/5xx/conn-reset) with
+        # decorrelated jitter — the client-go REST-layer retry analog;
+        # semantic outcomes (404/409/400) keep propagating untouched
+        self._retry = retry_policy or RetryPolicy()
+        self._metrics = metrics
         self._subscribers: Dict[str, List[Callable]] = {}
         self._sub_lock = threading.Lock()
         self._watch_threads: Dict[str, threading.Thread] = {}
@@ -155,15 +163,19 @@ class KubeSubstrate:
     @classmethod
     def from_config(
         cls, kubeconfig: Optional[str] = None, master: Optional[str] = None,
-        qps: float = 0.0, burst: int = 10,
+        qps: float = 0.0, burst: int = 10, metrics=None,
     ) -> "KubeSubstrate":
         if kubeconfig is None and os.path.exists(os.path.join(SA_DIR, "token")):
-            return cls.in_cluster(qps=qps, burst=burst)
+            return cls.in_cluster(qps=qps, burst=burst, metrics=metrics)
         kubeconfig = kubeconfig or os.path.expanduser("~/.kube/config")
-        return cls.from_kubeconfig(kubeconfig, master, qps=qps, burst=burst)
+        return cls.from_kubeconfig(
+            kubeconfig, master, qps=qps, burst=burst, metrics=metrics
+        )
 
     @classmethod
-    def in_cluster(cls, qps: float = 0.0, burst: int = 10) -> "KubeSubstrate":
+    def in_cluster(
+        cls, qps: float = 0.0, burst: int = 10, metrics=None
+    ) -> "KubeSubstrate":
         with open(os.path.join(SA_DIR, "token")) as handle:
             token = handle.read().strip()
         context = ssl.create_default_context(cafile=os.path.join(SA_DIR, "ca.crt"))
@@ -171,13 +183,13 @@ class KubeSubstrate:
         port = os.environ.get("KUBERNETES_SERVICE_PORT", "443")
         return cls(
             f"https://{host}:{port}", token=token, ssl_context=context,
-            qps=qps, burst=burst,
+            qps=qps, burst=burst, metrics=metrics,
         )
 
     @classmethod
     def from_kubeconfig(
         cls, path: str, master: Optional[str] = None,
-        qps: float = 0.0, burst: int = 10,
+        qps: float = 0.0, burst: int = 10, metrics=None,
     ) -> "KubeSubstrate":
         import yaml
 
@@ -212,12 +224,30 @@ class KubeSubstrate:
                 ssl_context.load_cert_chain(cert, key)
         return cls(
             server, token=user.get("token"), ssl_context=ssl_context,
-            qps=qps, burst=burst,
+            qps=qps, burst=burst, metrics=metrics,
         )
 
     # -- HTTP --------------------------------------------------------------
 
     def _request(
+        self,
+        method: str,
+        path: str,
+        body: Optional[dict] = None,
+        content_type: str = "application/json",
+        timeout: float = 30.0,
+    ) -> Any:
+        return call_with_retries(
+            self._request_once, method, path, body, content_type, timeout,
+            policy=self._retry, on_retry=self._count_retry,
+            op=f"{method} {path.split('?', 1)[0]}",
+        )
+
+    def _count_retry(self, op: str, attempt: int, err: BaseException) -> None:
+        if self._metrics is not None:
+            self._metrics.retried()
+
+    def _request_once(
         self,
         method: str,
         path: str,
@@ -750,19 +780,31 @@ class KubeSubstrate:
         unstructured/informer.go:25-63 inherits it). Without the
         DELETED side, delete-driven cleanup (port release, expectation
         teardown) would silently never fire for objects removed during
-        the outage."""
+        the outage. Never-seen objects replay as ADDED, not MODIFIED:
+        a pod created during the outage must resolve its creation
+        expectation (creation_observed fires on ADDED only), or the
+        owning job stays expectation-blocked until the TTL failsafe."""
         data = self._request("GET", self._list_path(kind))
         items = data.get("items", [])
         rv = data.get("metadata", {}).get("resourceVersion") or "0"
         listed_keys = {_obj_key(item) for item in items}
         known = self._watch_known.setdefault(kind, {})
+        known_keys = set(known)
         for key, stale in list(known.items()):
             if key not in listed_keys:
                 self._deliver(kind, DELETED, stale, update_rv=False)
         for item in items:
-            self._deliver(kind, MODIFIED, item, update_rv=False)
+            verb = MODIFIED if _obj_key(item) in known_keys else ADDED
+            self._deliver(kind, verb, item, update_rv=False)
         self._watch_rv[kind] = rv
         return rv
+
+    def _count_watch_reestablished(self) -> None:
+        """One lost watch stream about to be re-established (410 Gone
+        relist or connection-level reconnect) — the observable the
+        chaos acceptance gate asserts on."""
+        if self._metrics is not None and not self._stop.is_set():
+            self._metrics.watch_reestablished()
 
     def _stale(self, kind: str, gen: int) -> bool:
         with self._sub_lock:
@@ -819,12 +861,15 @@ class KubeSubstrate:
                     kind,
                 )
                 self._watch_rv.pop(kind, None)
+                self._count_watch_reestablished()
             except urllib.error.HTTPError as err:
                 if err.code == 410:
                     self._watch_rv.pop(kind, None)
+                    self._count_watch_reestablished()
                     continue
                 logger.warning("watch %s failed: %s; reconnecting", kind, err)
                 self._stop.wait(2.0)
+                self._count_watch_reestablished()
             except Exception as err:
                 # connection-level failure (apiserver down): back off —
                 # a 0.2s loop would hammer a recovering apiserver with a
@@ -835,6 +880,7 @@ class KubeSubstrate:
                     kind, err, self._watch_rv.get(kind),
                 )
                 self._stop.wait(2.0)
+                self._count_watch_reestablished()
 
     def _dispatch(self, kind: str, line: bytes) -> None:
         try:

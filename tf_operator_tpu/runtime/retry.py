@@ -1,0 +1,184 @@
+"""Transient-error retry with decorrelated jitter.
+
+The reference operator inherits retries from client-go: the REST
+client retries connection resets and honors Retry-After on 429s, and
+every controller-level failure falls back to the workqueue's per-item
+exponential backoff. Our stdlib-HTTP client (kube.py) had neither
+layer below the workqueue, so a single flaky LB hiccup failed a whole
+sync. This module is that missing transport-adjacent layer, shared by
+`KubeSubstrate._request` and the substrate-wrapper path the chaos
+harness exercises (`RetryingSubstrate`).
+
+Jitter is *decorrelated* (sleep = min(cap, uniform(base, 3*prev)),
+the AWS architecture-blog scheme): many clients retrying the same
+outage spread out instead of re-synchronizing into waves, which is
+exactly the thundering-herd failure mode a recovering apiserver dies
+under.
+
+What is retried: HTTP 429/5xx-class errors (anything carrying a
+``status`` attribute in TRANSIENT_HTTP_STATUSES, i.e. kube.ApiError
+and the chaos harness's injected twins) and connection-level failures
+(ConnectionError/TimeoutError/URLError). What is NOT: NotFound,
+Conflict, AlreadyExists, BadRequest — those are *semantic* outcomes
+the controller handles itself (Conflict needs a fresh read, not a
+blind replay)."""
+
+from __future__ import annotations
+
+import logging
+import random
+import threading
+import time
+import urllib.error
+from typing import Callable, Iterator, Optional
+
+logger = logging.getLogger("tf_operator_tpu.retry")
+
+# 429 Too Many Requests + the 5xx gateway/overload class. 501 Not
+# Implemented is deliberately absent (retrying it can never succeed).
+TRANSIENT_HTTP_STATUSES = frozenset({429, 500, 502, 503, 504})
+
+
+def is_transient_error(err: BaseException) -> bool:
+    """True when a failed call may succeed if simply replayed."""
+    status = getattr(err, "status", None) or getattr(err, "code", None)
+    if isinstance(status, int):
+        return status in TRANSIENT_HTTP_STATUSES
+    # URLError with no .code is a connection-level failure (refused,
+    # reset, DNS); HTTPError always carries .code and was handled above
+    return isinstance(
+        err, (ConnectionError, TimeoutError, urllib.error.URLError)
+    )
+
+
+class RetryPolicy:
+    """Attempt budget + decorrelated-jitter delay schedule.
+
+    One policy instance may be shared across threads (the rng is
+    lock-guarded); each retried call draws its own delay chain via
+    `delays()` so concurrent calls don't couple their schedules."""
+
+    def __init__(
+        self,
+        max_attempts: int = 4,
+        base_delay: float = 0.05,
+        max_delay: float = 1.0,
+        rng: Optional[random.Random] = None,
+        sleep: Callable[[float], None] = time.sleep,
+    ) -> None:
+        self.max_attempts = max(1, int(max_attempts))
+        self.base_delay = base_delay
+        self.max_delay = max_delay
+        self.sleep = sleep
+        self._rng = rng or random.Random()
+        self._lock = threading.Lock()
+
+    def _uniform(self, low: float, high: float) -> float:
+        with self._lock:
+            return self._rng.uniform(low, high)
+
+    def delays(self) -> Iterator[float]:
+        """The decorrelated-jitter chain for ONE call: max_attempts-1
+        delays, each uniform(base, 3*prev) capped at max_delay."""
+        prev = self.base_delay
+        for _ in range(self.max_attempts - 1):
+            prev = min(self.max_delay, self._uniform(self.base_delay, prev * 3))
+            yield prev
+
+
+def call_with_retries(
+    fn: Callable,
+    *args,
+    policy: Optional[RetryPolicy] = None,
+    classify: Callable[[BaseException], bool] = is_transient_error,
+    on_retry: Optional[Callable[[str, int, BaseException], None]] = None,
+    op: str = "",
+    **kwargs,
+):
+    """Run fn, replaying transient failures per the policy's schedule.
+
+    Non-transient errors propagate immediately; the final transient
+    failure (attempt budget exhausted) propagates unchanged so callers
+    keep their typed-exception handling."""
+    policy = policy or RetryPolicy()
+    name = op or getattr(fn, "__name__", "call")
+    delays = policy.delays()
+    attempt = 0
+    while True:
+        try:
+            return fn(*args, **kwargs)
+        except Exception as err:  # noqa: BLE001 — classify() filters
+            if not classify(err):
+                raise
+            delay = next(delays, None)
+            if delay is None:
+                raise
+            attempt += 1
+            if on_retry is not None:
+                on_retry(name, attempt, err)
+            logger.warning(
+                "%s: transient error (%s); retry %d/%d in %.3fs",
+                name, err, attempt, policy.max_attempts - 1, delay,
+            )
+            policy.sleep(delay)
+
+
+# The Substrate protocol surface worth replaying. record_event is
+# excluded (best-effort by contract: both substrates already degrade
+# it to a warning), as are subscribe/unsubscribe (local state only).
+RETRIED_SUBSTRATE_METHODS = frozenset({
+    "list_jobs", "get_job", "create_job", "update_job",
+    "update_job_status", "delete_job",
+    "create_pod", "get_pod", "list_pods", "delete_pod",
+    "patch_pod_labels", "patch_pod_owner_references",
+    "create_service", "list_services", "delete_service",
+    "patch_service_owner_references",
+    "create_pod_group", "get_pod_group", "update_pod_group",
+    "delete_pod_group",
+    "get_lease", "create_lease", "update_lease",
+    "events_for",
+})
+
+
+class RetryingSubstrate:
+    """Substrate wrapper that absorbs transient inner-substrate errors.
+
+    The in-process analog of client-go's REST-layer retries: the
+    controller keeps its workqueue backoff for *semantic* failures,
+    while flaky-transport failures are replayed here with decorrelated
+    jitter and surfaced as `substrate_retries_total`. Methods outside
+    RETRIED_SUBSTRATE_METHODS (watch plumbing, test-only kubelet
+    helpers) pass through untouched."""
+
+    def __init__(
+        self,
+        inner,
+        policy: Optional[RetryPolicy] = None,
+        metrics=None,
+        methods: frozenset = RETRIED_SUBSTRATE_METHODS,
+    ) -> None:
+        self.inner = inner
+        self.policy = policy or RetryPolicy()
+        self.metrics = metrics
+        self._methods = methods
+
+    def _on_retry(self, op: str, attempt: int, err: BaseException) -> None:
+        if self.metrics is not None:
+            self.metrics.retried()
+
+    def __getattr__(self, name: str):
+        attr = getattr(self.inner, name)
+        if name not in self._methods or not callable(attr):
+            return attr
+
+        def wrapped(*args, **kwargs):
+            return call_with_retries(
+                attr, *args,
+                policy=self.policy, on_retry=self._on_retry, op=name,
+                **kwargs,
+            )
+
+        wrapped.__name__ = name
+        # cache so repeated lookups skip __getattr__ (hot sync path)
+        self.__dict__[name] = wrapped
+        return wrapped

@@ -14,6 +14,7 @@ the fallback.
 
 from __future__ import annotations
 
+import random
 import threading
 import time
 from typing import Dict, Hashable, Optional, Set
@@ -21,23 +22,47 @@ from typing import Dict, Hashable, Optional, Set
 
 class ExponentialBackoff:
     """Per-item exponential failure backoff (client-go
-    ItemExponentialFailureRateLimiter; defaults 5ms base, 1000s cap)."""
+    ItemExponentialFailureRateLimiter; defaults 5ms base, 1000s cap).
 
-    def __init__(self, base_delay: float = 0.005, max_delay: float = 1000.0) -> None:
+    With jitter=True the deterministic doubling becomes decorrelated
+    jitter (next = uniform(base, 3*prev), capped): many keys failing on
+    the same cause — an apiserver outage — spread their retries instead
+    of thundering back in lockstep. Off by default because tier-1 tests
+    rely on exact delay arithmetic.
+    """
+
+    def __init__(
+        self,
+        base_delay: float = 0.005,
+        max_delay: float = 1000.0,
+        jitter: bool = False,
+        rng: Optional[random.Random] = None,
+    ) -> None:
         self.base_delay = base_delay
         self.max_delay = max_delay
+        self.jitter = jitter
+        self._rng = rng or random.Random()
         self._failures: Dict[Hashable, int] = {}
+        self._prev_delay: Dict[Hashable, float] = {}
         self._lock = threading.Lock()
 
     def when(self, item: Hashable) -> float:
         with self._lock:
             failures = self._failures.get(item, 0)
             self._failures[item] = failures + 1
-        return min(self.base_delay * (2**failures), self.max_delay)
+            if not self.jitter:
+                return min(self.base_delay * (2**failures), self.max_delay)
+            prev = self._prev_delay.get(item, self.base_delay)
+            delay = min(
+                self.max_delay, self._rng.uniform(self.base_delay, prev * 3)
+            )
+            self._prev_delay[item] = delay
+            return delay
 
     def forget(self, item: Hashable) -> None:
         with self._lock:
             self._failures.pop(item, None)
+            self._prev_delay.pop(item, None)
 
     def num_requeues(self, item: Hashable) -> int:
         with self._lock:
@@ -116,9 +141,17 @@ class DelayingQueue(WorkQueue):
             return
         timer: threading.Timer = threading.Timer(delay, lambda: self._fire(item, timer))
         timer.daemon = True
+        # register AND start under _timer_lock, checking shutdown first:
+        # otherwise an add_after racing shut_down can arm its timer
+        # after the cancel sweep, leaving a live timer firing into a
+        # drained queue (same lock order as shut_down: _timer_lock
+        # before _cond)
         with self._timer_lock:
+            with self._cond:
+                if self._shutting_down:
+                    return
             self._timers.add(timer)
-        timer.start()
+            timer.start()
 
     def _fire(self, item: Hashable, timer: threading.Timer) -> None:
         with self._timer_lock:

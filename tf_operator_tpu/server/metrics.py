@@ -25,15 +25,26 @@ class OperatorMetrics:
             "jobs_successful_total": 0,
             "jobs_failed_total": 0,
             "jobs_restarted_total": 0,
+            "substrate_retries_total": 0,
+            "watch_reestablished_total": 0,
+            "reconcile_panics_total": 0,
         }
-        self._gauges: Dict[str, float] = {"is_leader": 0}
+        self._gauges: Dict[str, float] = {"is_leader": 0, "degraded": 0}
         self._help = {
             "jobs_created_total": "Counts number of jobs created",
             "jobs_deleted_total": "Counts number of jobs deleted",
             "jobs_successful_total": "Counts number of jobs successful",
             "jobs_failed_total": "Counts number of jobs failed",
             "jobs_restarted_total": "Counts number of jobs restarted",
+            "substrate_retries_total":
+                "Counts transient substrate/apiserver errors retried",
+            "watch_reestablished_total":
+                "Counts watch streams re-established after a drop or 410",
+            "reconcile_panics_total":
+                "Counts reconcile worker exceptions isolated per key",
             "is_leader": "1 when this replica holds leadership",
+            "degraded":
+                "1 while the degraded-mode latch holds (pod churn paused)",
         }
 
     def _inc(self, name: str) -> None:
@@ -55,9 +66,22 @@ class OperatorMetrics:
     def restarted(self) -> None:
         self._inc("jobs_restarted_total")
 
+    def retried(self) -> None:
+        self._inc("substrate_retries_total")
+
+    def watch_reestablished(self) -> None:
+        self._inc("watch_reestablished_total")
+
+    def reconcile_panic(self) -> None:
+        self._inc("reconcile_panics_total")
+
     def set_leader(self, is_leader: bool) -> None:
         with self._lock:
             self._gauges["is_leader"] = 1 if is_leader else 0
+
+    def set_degraded(self, degraded: bool) -> None:
+        with self._lock:
+            self._gauges["degraded"] = 1 if degraded else 0
 
     def value(self, name: str) -> float:
         with self._lock:

@@ -45,14 +45,14 @@ def setup_logging(json_format: bool) -> None:
     root.setLevel(logging.INFO)
 
 
-def build_substrate(options: ServerOptions):
+def build_substrate(options: ServerOptions, metrics=None):
     if options.substrate == "memory":
         return InMemorySubstrate()
     from ..runtime.kube import KubeSubstrate
 
     return KubeSubstrate.from_config(
         kubeconfig=options.kubeconfig, master=options.master,
-        qps=options.qps, burst=options.burst,
+        qps=options.qps, burst=options.burst, metrics=metrics,
     )
 
 
@@ -84,7 +84,13 @@ class OperatorServer:
             options.monitoring_port,
             enable_debug=options.enable_debug_endpoints,
         )
-        self.substrate = substrate if substrate is not None else build_substrate(options)
+        # metrics threaded into the substrate so the transport-level
+        # observables (substrate_retries_total, watch_reestablished_
+        # total) surface on /metrics alongside the controller's
+        self.substrate = (
+            substrate if substrate is not None
+            else build_substrate(options, metrics=self.metrics)
+        )
         self.controller = TFJobController(
             self.substrate,
             config=ReconcilerConfig(
