@@ -1,7 +1,7 @@
 """End-to-end serving benchmark: the live HTTP decode server under
 concurrent clients (VERDICT r4 next #4).
 
-Four scenarios, one JSON artifact (SERVE_BENCH.json):
+Scenarios, one JSON artifact (SERVE_BENCH.json):
 
 1. ``plain``      — N concurrent clients, single-row greedy requests
                     against a bare server: requests/sec, p50/p95
@@ -10,16 +10,22 @@ Four scenarios, one JSON artifact (SERVE_BENCH.json):
                     batching: the coalescing factor
                     (decodes / device dispatches) is the mechanism, the
                     latency/throughput delta is the verdict.
-3. ``speculative``— model-level A/B on repetitive vs non-repetitive
+3. ``continuous`` — the same load through the slot-based
+                    continuous-batching engine (serve/engine.py) via
+                    the streaming route, adding per-request
+                    time-to-first-token and mean slot occupancy — the
+                    head-to-head against ``batched``'s whole-scan
+                    scheduling quantum.
+4. ``latency_under_load`` — windowed vs continuous swept over client
+                    counts: how each scheduler's p95 and TTFT degrade
+                    as concurrency grows past the slot grid.
+5. ``speculative``— model-level A/B on repetitive vs non-repetitive
                     prompts: measured acceptance rate (verify-round
                     counter, models/gpt.py generate_speculative
-                    return_rounds) and tokens/sec vs plain decode.
-4. ``spec_batch`` — the batch-min exposure (VERDICT r4 weak #3): the
-                    same A/B at batch > 1, where one non-repetitive row
-                    drags every row's commit to the batch minimum. The
-                    measured ratio is the evidence for the server's
-                    single-row speculative routing policy
-                    (serve/server.py).
+                    return_rounds) and tokens/sec vs plain decode,
+                    including the batch-min exposure at batch > 1
+                    (the evidence for the server's single-row
+                    speculative routing policy).
 
 Run:  BENCH_CPU=1 python benchmarks/serve_bench.py   (CPU shapes)
       python benchmarks/serve_bench.py               (TPU shapes)
@@ -66,13 +72,18 @@ def _make_params(cfg):
     )["params"]
 
 
-def _client_load(port: int, prompts, new: int, n_clients: int):
+def _client_load(port: int, prompts, new: int, n_clients: int,
+                 stream: bool = False):
     """Fire len(prompts) single-row requests from n_clients threads;
-    returns (wall_seconds, sorted per-request latencies)."""
+    returns (wall_seconds, sorted per-request latencies, sorted
+    per-request TTFTs). stream=True drives /generate_stream and times
+    the first token event — the per-request TTFT; otherwise TTFTs are
+    empty (the whole-scan paths have no first-token moment)."""
     from tf_operator_tpu.serve.client import DecodeClient
 
     client = DecodeClient(f"http://127.0.0.1:{port}")
     latencies = []
+    ttfts = []
     lock = threading.Lock()
     queue = list(enumerate(prompts))
 
@@ -83,10 +94,20 @@ def _client_load(port: int, prompts, new: int, n_clients: int):
                     return
                 _, prompt = queue.pop()
             t0 = time.perf_counter()
-            client.generate([prompt], max_new_tokens=new)
+            first = None
+            if stream:
+                for event in client.generate_stream(
+                    prompt, max_new_tokens=new
+                ):
+                    if first is None and "token" in event:
+                        first = time.perf_counter() - t0
+            else:
+                client.generate([prompt], max_new_tokens=new)
             dt = time.perf_counter() - t0
             with lock:
                 latencies.append(dt)
+                if first is not None:
+                    ttfts.append(first)
 
     threads = [threading.Thread(target=worker) for _ in range(n_clients)]
     start = time.perf_counter()
@@ -94,11 +115,12 @@ def _client_load(port: int, prompts, new: int, n_clients: int):
         t.start()
     for t in threads:
         t.join()
-    return time.perf_counter() - start, sorted(latencies)
+    return time.perf_counter() - start, sorted(latencies), sorted(ttfts)
 
 
 def _serve_scenario(cfg, params, prompts, new: int, n_clients: int,
-                    batch_window_ms: float = 0.0) -> dict:
+                    batch_window_ms: float = 0.0, batching: str = "",
+                    n_slots: int = 8, stream: bool = False) -> dict:
     from tf_operator_tpu.serve import make_server
     from tf_operator_tpu.serve.client import DecodeClient
 
@@ -107,14 +129,17 @@ def _serve_scenario(cfg, params, prompts, new: int, n_clients: int,
     # batch buckets, each a distinct compiled shape — warm them all up
     # front (serve --warm), or the measured window pays the compiles
     # (observed: unwarmed bucket compiles put the CPU batched p95 at
-    # 16.9s vs 0.13s p50)
-    warm = [
-        (b, width, new)
-        for b in ((1, 2, 4, 8) if batch_window_ms > 0 else (1,))
-    ]
+    # 16.9s vs 0.13s p50). The continuous engine has nothing to warm
+    # beyond its ONE step program, which it compiles at construction.
+    warm = (
+        [] if batching == "continuous" else [
+            (b, width, new)
+            for b in ((1, 2, 4, 8) if batch_window_ms > 0 else (1,))
+        ]
+    )
     srv = make_server(
         cfg, params, batch_window_ms=batch_window_ms, max_new_cap=4096,
-        warm_shapes=warm,
+        warm_shapes=warm, batching=batching, n_slots=n_slots,
     )
     thread = threading.Thread(target=srv.serve_forever, daemon=True)
     thread.start()
@@ -125,21 +150,71 @@ def _serve_scenario(cfg, params, prompts, new: int, n_clients: int,
         DecodeClient(f"http://127.0.0.1:{port}").generate(
             [prompts[0]], max_new_tokens=new
         )
-        wall, lats = _client_load(port, prompts, new, n_clients)
+        wall, lats, ttfts = _client_load(
+            port, prompts, new, n_clients, stream=stream
+        )
         metrics = DecodeClient(f"http://127.0.0.1:{port}").metrics()
     finally:
         srv.shutdown()
-    decodes = metrics["tf_operator_tpu_serve_decodes_total"] - 1
-    dispatches = metrics["tf_operator_tpu_serve_decode_batches_total"] - 1
-    return {
+        if srv.state.engine is not None:
+            srv.state.engine.stop()
+    out = {
         "requests": len(lats),
         "clients": n_clients,
         "requests_per_sec": round(len(lats) / wall, 2),
         "served_tokens_per_sec": round(len(lats) * new / wall, 1),
         "p50_latency_s": round(percentile(lats, 0.50), 4),
         "p95_latency_s": round(percentile(lats, 0.95), 4),
-        "coalescing_factor": round(decodes / max(dispatches, 1), 2),
     }
+    if ttfts:
+        out["ttft_p50_s"] = round(percentile(ttfts, 0.50), 4)
+        out["ttft_p95_s"] = round(percentile(ttfts, 0.95), 4)
+    if batching == "continuous":
+        steps = metrics["tf_operator_tpu_serve_engine_steps_total"]
+        row_steps = metrics["tf_operator_tpu_serve_engine_row_steps_total"]
+        # occupancy is the engine's coalescing analogue: decoding rows
+        # per step, averaged over steps that did work
+        out["mean_active_slots"] = round(row_steps / max(steps, 1), 2)
+        out["engine_compiles"] = int(
+            metrics["tf_operator_tpu_serve_engine_compiles_total"]
+        )
+    else:
+        decodes = metrics["tf_operator_tpu_serve_decodes_total"] - 1
+        dispatches = (
+            metrics["tf_operator_tpu_serve_decode_batches_total"] - 1
+        )
+        out["coalescing_factor"] = round(decodes / max(dispatches, 1), 2)
+    return out
+
+
+def _latency_sweep(cfg, params, base, new: int,
+                   reqs_per_client: int = 5) -> dict:
+    """Windowed vs continuous at growing concurrency: past the slot
+    grid (clients > n_slots) the engine queues admissions; the sweep
+    shows whether p95/TTFT degrade gracefully or collapse the way the
+    windowed path does."""
+    out = {}
+    for n_clients in (2, 6, 12):
+        n = n_clients * reqs_per_client
+        prompts = [
+            [int(x) for x in (base + 1000 + i) % cfg.vocab_size]
+            for i in range(n)
+        ]
+        row = {}
+        for mode, kwargs in (
+            ("windowed", {"batch_window_ms": 10.0}),
+            ("continuous", {"batching": "continuous", "stream": True}),
+        ):
+            s = _serve_scenario(cfg, params, prompts, new, n_clients,
+                                **kwargs)
+            row[mode] = {
+                "requests_per_sec": s["requests_per_sec"],
+                "p95_latency_s": s["p95_latency_s"],
+            }
+            if "ttft_p50_s" in s:
+                row[mode]["ttft_p50_s"] = s["ttft_p50_s"]
+        out[f"clients_{n_clients}"] = row
+    return out
 
 
 def _time_spec(cfg, params, prompt, new: int):
@@ -312,15 +387,26 @@ def run(write: bool = True) -> dict:
         "batched": _serve_scenario(
             cfg, params, prompts, new, n_clients, batch_window_ms=10.0
         ),
+        "continuous": _serve_scenario(
+            cfg, params, prompts, new, n_clients,
+            batching="continuous", stream=True,
+        ),
+        "latency_under_load": _latency_sweep(cfg, params, base, new),
         "moe_plain": _serve_scenario(
             moe_cfg, moe_params, moe_prompts, moe_new, n_clients
         ),
         "speculative": spec_scenarios(cfg, params, prompt_len, new),
         "notes": (
-            "plain/batched drive the live HTTP server (in-process, "
-            "loopback) with single-row greedy requests from concurrent "
-            "threads; batched pre-warms the batcher's power-of-two "
-            "bucket shapes (serve --warm). speculative is a model-level "
+            "plain/batched/continuous drive the live HTTP server "
+            "(in-process, loopback) with single-row greedy requests "
+            "from concurrent threads; batched pre-warms the batcher's "
+            "power-of-two bucket shapes (serve --warm). continuous "
+            "routes through the slot engine's streaming endpoint "
+            "(ttft_* = time to the first token EVENT per request; "
+            "mean_active_slots = decoding rows per engine step). "
+            "latency_under_load sweeps windowed vs continuous over "
+            "client counts, past the 8-slot grid. speculative is a "
+            "model-level "
             "A/B (acceptance from the verify-round counter, draft_k=4): "
             "random-init model = worst case, memorized model = the "
             "favorable input-grounded regime; memorized_mixed_batch4 is "

@@ -39,6 +39,7 @@ import subprocess
 import sys
 import tarfile
 import tempfile
+import threading
 import time
 import urllib.error
 import urllib.request
@@ -96,6 +97,18 @@ def boot(image: str, args: list, timeout: float = 60.0) -> dict:
             argv, cwd=cwd, env=env,
             stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True,
         )
+        # drain stdout CONCURRENTLY: a chatty child fills the 64KB pipe
+        # while boot() is parked in the health poll / wait, deadlocks
+        # on write, never exits, and escapes as a TimeoutExpired
+        # traceback instead of the JSON failure report (ADVICE r5)
+        out_chunks: list = []
+
+        def _drain():
+            for line in proc.stdout:
+                out_chunks.append(line)
+
+        reader = threading.Thread(target=_drain, daemon=True)
+        reader.start()
         healthz, body = None, ""
         deadline = time.monotonic() + timeout
         try:
@@ -118,7 +131,8 @@ def boot(image: str, args: list, timeout: float = 60.0) -> dict:
             if proc.poll() is None:
                 proc.kill()
                 proc.wait()
-        out = proc.stdout.read() if proc.stdout else ""
+        reader.join(timeout=5)  # EOF follows process exit
+        out = "".join(out_chunks)
 
     result = {
         "image": image,

@@ -702,6 +702,18 @@ class TestPallasConv:
         assert not supports((2, 8, 8, 63), (3, 3, 63, 64), (1, 1))  # lanes
         assert not supports((2, 8, 8, 64), (1, 1, 64, 64), (1, 1))  # 1x1
 
+    def test_supports_vmem_estimate_uses_dtype_itemsize(self):
+        """A shape that fits the VMEM budget at bf16 must be REJECTED
+        at f32: the input/weight footprint doubles with the itemsize,
+        and a hardcoded 2 bytes/element admitted f32 configs into
+        VMEM-exhausting shapes (ADVICE r5)."""
+        from tf_operator_tpu.ops.pallas.conv_bn import supports
+
+        shape, w_shape = (8, 16, 16, 512), (3, 3, 512, 512)
+        assert supports(shape, w_shape, (1, 1))  # bf16 default: fits
+        assert supports(shape, w_shape, (1, 1), dtype=jnp.bfloat16)
+        assert not supports(shape, w_shape, (1, 1), dtype=jnp.float32)
+
     def test_resnet_pallas_conv_matches_xla(self):
         """ResNet with conv3_impl='pallas' (interpret) must match the
         default XLA conv path at identical params."""

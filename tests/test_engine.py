@@ -1,0 +1,384 @@
+"""The continuous-batching engine (tf_operator_tpu/serve/engine.py):
+slot scheduling, bit-exact greedy equivalence with the inline decode
+path, the one-compile contract, and the server/stream wiring."""
+
+import json
+import threading
+import urllib.error
+import urllib.request
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from tf_operator_tpu.models import gpt as gpt_lib
+from tf_operator_tpu.serve import make_server
+from tf_operator_tpu.serve.client import DecodeClient
+from tf_operator_tpu.serve.engine import (
+    ContinuousBatchingEngine,
+    DecodeCancelled,
+)
+
+CFG = gpt_lib.GPT_TINY
+
+
+@pytest.fixture(scope="module")
+def params():
+    return gpt_lib.GPT(CFG).init(
+        jax.random.PRNGKey(0), jnp.zeros((1, 8), jnp.int32)
+    )["params"]
+
+
+def inline_chain(params, row, new):
+    """The reference: the plain whole-scan generate() path, solo."""
+    out = gpt_lib.generate(
+        CFG, params, jnp.asarray([row], jnp.int32), max_new_tokens=new
+    )
+    return np.asarray(out)[0].tolist()
+
+
+class TestSlotScheduling:
+    """Deterministic scheduler assertions: engine built with
+    start=False, the test IS the scheduler loop."""
+
+    @pytest.fixture()
+    def engine(self, params):
+        eng = ContinuousBatchingEngine(
+            CFG, params, n_slots=2, start=False
+        )
+        yield eng
+        eng.stop()
+
+    def test_admit_evict_ordering(self, engine):
+        # three requests, two slots: FIFO admission into the LOWEST
+        # free slot; the third waits for the first eviction
+        r1 = engine.submit([1, 2, 3], 2)   # done after 4 steps
+        r2 = engine.submit([4, 5, 6, 7], 4)
+        r3 = engine.submit([8, 9], 2)
+        engine._admit()
+        assert engine.slots() == (r1, r2)
+        assert engine.queue_depth == 1
+        # r1 needs lens + new - 1 = 4 steps; r2 needs 7
+        for _ in range(4):
+            engine._step_once()
+        assert r1.done.is_set()
+        assert engine.slots() == (None, r2)  # evicted immediately
+        engine._admit()
+        assert engine.slots() == (r3, r2)    # freed slot reused FIFO
+        assert engine.queue_depth == 0
+        for _ in range(3):
+            engine._step_once()
+        assert r2.done.is_set() and r3.done.is_set()
+        assert engine.slots() == (None, None)
+        assert engine.admitted == 3
+        assert engine.finished == 3
+        # every chain still matches the reference despite slot reuse
+        # over a cache region holding the previous occupant's stale KV
+        assert r1.result(1) == inline_chain(engine.params, [1, 2, 3], 2)
+        assert r3.result(1) == inline_chain(engine.params, [8, 9], 2)
+
+    def test_cancellation_mid_decode_frees_slot(self, engine):
+        r1 = engine.submit([1, 2, 3, 4, 5], 8)
+        r2 = engine.submit([6, 7], 12)
+        engine._admit()
+        engine._step_once()
+        engine._step_once()
+        r1.cancel()
+        engine._evict_cancelled()
+        # the slot is free BEFORE the next step — mid-decode, not at
+        # the request's natural end
+        assert engine.slots() == (None, r2)
+        assert engine.cancelled == 1
+        with pytest.raises(DecodeCancelled):
+            r1.result(1)
+        # the survivor decodes on, unaffected
+        while not r2.done.is_set():
+            engine._step_once()
+        assert r2.result(1) == inline_chain(engine.params, [6, 7], 12)
+
+    def test_cancel_while_queued_never_occupies_a_slot(self, engine):
+        r1 = engine.submit([1, 2], 4)
+        r2 = engine.submit([3, 4], 4)
+        r3 = engine.submit([5, 6], 4)
+        r3.cancel()
+        engine._admit()
+        assert engine.slots() == (r1, r2)
+        # both occupants need lens + new - 1 = 5 steps
+        for _ in range(5):
+            engine._step_once()
+        assert engine.slots() == (None, None)
+        engine._admit()
+        # r3 is discarded at placement time: it never occupies a slot
+        assert engine.slots() == (None, None)
+        assert engine.queue_depth == 0
+        assert engine.cancelled == 1
+        with pytest.raises(DecodeCancelled):
+            r3.result(1)
+
+    def test_device_error_fans_out_and_engine_recovers(self, engine):
+        real_step = engine.step
+
+        class Boom:
+            def __init__(self):
+                self.armed = True
+                self.compiles = real_step.compiles
+
+            def init_cache(self):
+                return real_step.init_cache()
+
+            def __call__(self, *args):
+                if self.armed:
+                    self.armed = False
+                    raise RuntimeError("injected device failure")
+                return real_step(*args)
+
+        engine.step = Boom()
+        r1 = engine.submit([1, 2, 3], 3)
+        r2 = engine.submit([4, 5], 3)
+        engine._admit()
+        engine._step_once()  # fails: both requests get the error
+        with pytest.raises(RuntimeError, match="injected"):
+            r1.result(1)
+        with pytest.raises(RuntimeError, match="injected"):
+            r2.result(1)
+        assert engine.slots() == (None, None)
+        # the engine survives with a rebuilt cache: the next request
+        # decodes correctly
+        r3 = engine.submit([1, 2, 3], 3)
+        engine._admit()
+        while not r3.done.is_set():
+            engine._step_once()
+        assert r3.result(1) == inline_chain(engine.params, [1, 2, 3], 3)
+
+
+class TestEngineDecode:
+    """Threaded engine: correctness and the one-compile contract."""
+
+    @pytest.fixture(scope="class")
+    def engine(self, params):
+        eng = ContinuousBatchingEngine(CFG, params, n_slots=4)
+        yield eng
+        eng.stop()
+
+    def test_bit_identical_to_inline_greedy(self, engine, params):
+        """The acceptance pin: continuous-mode greedy output ==
+        inline plain decode, per row, despite ragged lengths sharing
+        the slot grid and slots being reused across requests."""
+        rows = [
+            ([1, 2, 3, 4, 5, 6, 7], 6),
+            ([11, 12], 6),
+            ([21, 22, 23, 24], 3),
+            ([31], 8),
+            ([41, 42, 43, 44, 45], 6),
+            ([51, 52, 53], 3),
+        ]
+        handles = [engine.submit(row, new) for row, new in rows]
+        for (row, new), handle in zip(rows, handles):
+            assert handle.result(120) == inline_chain(params, row, new)
+
+    def test_exactly_one_compile(self, engine):
+        """The bounded-compile-universe discipline collapsed to ONE:
+        ragged admissions, evictions, and slot churn never retrace."""
+        assert engine.step.compiles == 1
+
+    def test_more_requests_than_slots(self, engine, params):
+        handles = [engine.submit([7, i + 1], 4) for i in range(11)]
+        for i, handle in enumerate(handles):
+            assert handle.result(120) == inline_chain(
+                params, [7, i + 1], 4
+            )
+        assert engine.active_slots == 0
+
+    def test_generate_fanout_matches_ragged_batch(self, engine, params):
+        """The batcher-compatible entry: right-padded ragged batch in,
+        per-row full chains out."""
+        prompt = np.zeros((3, 5), np.int32)
+        prompt[0, :5] = [1, 2, 3, 4, 5]
+        prompt[1, :2] = [9, 8]
+        prompt[2, :3] = [4, 4, 4]
+        lens = [5, 2, 3]
+        chains = engine.generate(prompt, lens, 4)
+        for i in range(3):
+            assert chains[i] == inline_chain(
+                params, prompt[i, :lens[i]].tolist(), 4
+            )
+
+    def test_seeded_concurrency_stress(self, engine, params):
+        """Many client threads submitting overlapping mixed-length
+        requests; every chain must match its solo reference. Seeded so
+        a failure reproduces."""
+        rng = np.random.default_rng(1234)
+        # few distinct (len, new) combos: the inline references reuse
+        # compiled scan shapes, keeping the test fast on CPU
+        combos = [(2, 3), (5, 4), (9, 3)]
+        jobs = []
+        for _ in range(18):
+            p_len, new = combos[int(rng.integers(len(combos)))]
+            row = rng.integers(0, CFG.vocab_size, size=p_len).tolist()
+            jobs.append((row, new))
+        results = [None] * len(jobs)
+
+        def submit_and_wait(i):
+            row, new = jobs[i]
+            results[i] = engine.submit(row, new).result(120)
+
+        threads = [
+            threading.Thread(target=submit_and_wait, args=(i,))
+            for i in range(len(jobs))
+        ]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(timeout=120)
+        for (row, new), got in zip(jobs, results):
+            assert got == inline_chain(params, row, new)
+        assert engine.step.compiles == 1
+
+    def test_submit_validation(self, engine):
+        with pytest.raises(ValueError, match="non-empty"):
+            engine.submit([], 4)
+        with pytest.raises(ValueError, match="max_new_tokens"):
+            engine.submit([1, 2], 0)
+        with pytest.raises(ValueError, match="max_total"):
+            engine.submit([1] * CFG.max_seq_len, 1)
+
+    def test_ttft_recorded(self, engine):
+        req = engine.submit([1, 2, 3], 2)
+        req.result(120)
+        assert req.ttft is not None and req.ttft >= 0
+
+
+class TestContinuousServing:
+    """make_server(batching='continuous'): HTTP wiring, streaming,
+    metrics."""
+
+    @pytest.fixture(scope="class")
+    def server(self, params):
+        srv = make_server(
+            CFG, params, model_name="gpt-test", max_new_cap=64,
+            batching="continuous", n_slots=4,
+        )
+        thread = threading.Thread(target=srv.serve_forever, daemon=True)
+        thread.start()
+        try:
+            yield srv.server_address[1]
+        finally:
+            srv.shutdown()
+            srv.state.engine.stop()
+
+    def test_generate_routes_through_engine(self, server, params):
+        port = server
+        client = DecodeClient(f"http://127.0.0.1:{port}", timeout=120)
+        before = client.metrics()["tf_operator_tpu_serve_"
+                                  "engine_finished_total"]
+        chains = client.generate([[1, 2, 3], [4, 5, 6, 7]],
+                                 max_new_tokens=5)
+        assert chains[0] == inline_chain(params, [1, 2, 3], 5)
+        assert chains[1] == inline_chain(params, [4, 5, 6, 7], 5)
+        after = client.metrics()
+        assert after["tf_operator_tpu_serve_engine_finished_total"] \
+            == before + 2
+        assert after["tf_operator_tpu_serve_engine_compiles_total"] == 1
+
+    def test_generate_stream_tokens_match_generate(self, server, params):
+        port = server
+        client = DecodeClient(f"http://127.0.0.1:{port}", timeout=120)
+        events = list(client.generate_stream([5, 6, 7], max_new_tokens=6))
+        done = events[-1]
+        assert done["done"] is True
+        token_events = events[:-1]
+        assert len(token_events) == 6
+        assert [e["index"] for e in token_events] == list(range(3, 9))
+        chain = inline_chain(params, [5, 6, 7], 6)
+        assert [e["token"] for e in token_events] == chain[3:]
+        assert done["tokens"] == [chain]
+        assert done["prompt_lens"] == [3]
+
+    def test_generate_stream_rejects_multi_row(self, server):
+        port = server
+        req = urllib.request.Request(
+            f"http://127.0.0.1:{port}/generate_stream",
+            data=json.dumps({
+                "input_ids": [[1, 2], [3, 4]], "max_new_tokens": 2,
+            }).encode(),
+            headers={"Content-Type": "application/json"},
+            method="POST",
+        )
+        with pytest.raises(urllib.error.HTTPError) as err:
+            urllib.request.urlopen(req, timeout=30)
+        assert err.value.code == 400
+
+    def test_sampled_keeps_inline_path(self, server):
+        port = server
+        client = DecodeClient(f"http://127.0.0.1:{port}", timeout=120)
+        finished = "tf_operator_tpu_serve_engine_finished_total"
+        before = client.metrics()[finished]
+        client.generate([[3, 1, 4]], max_new_tokens=4,
+                        temperature=1.0, seed=3)
+        assert client.metrics()[finished] == before  # engine untouched
+
+    def test_stream_on_plain_server_still_serves(self, params):
+        """No engine: /generate_stream falls back to whole-scan decode
+        — same wire contract, one burst."""
+        srv = make_server(CFG, params, model_name="gpt-test",
+                          max_new_cap=64)
+        thread = threading.Thread(target=srv.serve_forever, daemon=True)
+        thread.start()
+        try:
+            port = srv.server_address[1]
+            client = DecodeClient(f"http://127.0.0.1:{port}", timeout=120)
+            events = list(
+                client.generate_stream([2, 7, 1], max_new_tokens=4)
+            )
+            assert events[-1]["done"] is True
+            assert events[-1]["tokens"] == [
+                inline_chain(params, [2, 7, 1], 4)
+            ]
+            assert len(events) == 5
+        finally:
+            srv.shutdown()
+
+
+class TestMakeServerValidation:
+    def test_continuous_refuses_window(self, params):
+        with pytest.raises(ValueError, match="mutually exclusive"):
+            make_server(CFG, params, batching="continuous",
+                        batch_window_ms=5.0)
+
+    def test_continuous_refuses_speculative(self, params):
+        with pytest.raises(ValueError, match="mutually exclusive"):
+            make_server(CFG, params, batching="continuous",
+                        speculative=True)
+
+    def test_window_needs_window_ms(self, params):
+        with pytest.raises(ValueError, match="batch_window_ms"):
+            make_server(CFG, params, batching="window")
+
+    def test_unknown_batching_refused(self, params):
+        with pytest.raises(ValueError, match="batching"):
+            make_server(CFG, params, batching="magic")
+
+    def test_moe_refuses_continuous(self):
+        from tf_operator_tpu.models import moe as moe_lib
+
+        cfg = moe_lib.MOE_TINY
+        with pytest.raises(ValueError, match="gpt-family"):
+            make_server(cfg, {}, batching="continuous")
+
+
+def test_stopped_engine_refuses_submits(params):
+    eng = ContinuousBatchingEngine(CFG, params, n_slots=2)
+    req = eng.submit([1, 2], 2)
+    assert req.result(120) == inline_chain(params, [1, 2], 2)
+    eng.stop()
+    with pytest.raises(RuntimeError, match="stopped"):
+        eng.submit([1, 2], 2)
+
+
+def test_queued_requests_fail_on_stop(params):
+    eng = ContinuousBatchingEngine(CFG, params, n_slots=2, start=False)
+    req = eng.submit([1, 2, 3], 4)  # queued; no thread ever places it
+    eng.stop()
+    with pytest.raises(RuntimeError, match="stopped"):
+        req.result(1)

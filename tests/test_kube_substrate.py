@@ -513,3 +513,26 @@ class TestLogFollowOverHttp:
         time.sleep(0.3)  # let the reader park in recv
         substrate.close()
         assert done.wait(5.0), "close() did not unblock the follower"
+
+    def test_close_before_first_iteration_does_not_leak(self, wire):
+        """The stream must be REGISTERED when read_pod_log returns, not
+        at first next(): close() between creation and iteration has to
+        find (and close) the connection, and the generator must end
+        immediately instead of reading a torn socket (ADVICE r5)."""
+        server, substrate = wire
+        pod = k8s.Pod(
+            metadata=k8s.ObjectMeta(name="early-0", namespace="default"),
+            spec=k8s.PodSpec(
+                containers=[k8s.Container(name="tensorflow", image="x")]
+            ),
+        )
+        substrate.create_pod(pod)
+        server.append_pod_log("default", "early-0", "never-seen\n")
+        stream = substrate.read_pod_log("default", "early-0", follow=True)
+        with substrate._follow_lock:
+            registered = len(substrate._follow_streams)
+        assert registered == 1, "stream not registered before iteration"
+        substrate.close()  # before ANY next(): must not leak the socket
+        assert list(stream) == []
+        with substrate._follow_lock:
+            assert not substrate._follow_streams

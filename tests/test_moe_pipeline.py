@@ -527,3 +527,13 @@ class TestRouterZLoss:
             {"params": variables["params"]}, seq, mutable=["losses"]
         )
         assert float(m.sum_sown(mods["losses"], "router_z")) == 0.0
+
+    def test_default_off_with_preset_opt_in(self):
+        """The z-loss must be opt-in: a default-on weight silently
+        changes the training objective of every unmodified config and
+        of runs resumed across the introducing commit (ADVICE r5).
+        MOE_BASE — the long-bf16-pretraining preset the stabilizer
+        exists for — opts in explicitly."""
+        assert m.MoEConfig().router_z_weight == 0.0
+        assert m.MOE_TINY.router_z_weight == 0.0
+        assert m.MOE_BASE.router_z_weight > 0.0

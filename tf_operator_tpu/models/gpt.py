@@ -157,6 +157,16 @@ def _absmax_quantize(x: jax.Array):
     return q, s / 127.0
 
 
+def _row_update(cache_row: jax.Array, new_row: jax.Array, index):
+    """One row's cache write at its OWN position — the vmapped unit of
+    the per-row (slot) decode path, shared by the value cache
+    ([max_len, h, d] <- [1, h, d]) and the int8 scale ([max_len, h] <-
+    [1, h])."""
+    return jax.lax.dynamic_update_slice(
+        cache_row, new_row, (index,) + (0,) * (cache_row.ndim - 1)
+    )
+
+
 def _store_kv(
     mod: nn.Module, name: str, new: jax.Array, max_len: int,
     dtype, kv_quant_int8: bool, index,
@@ -168,6 +178,12 @@ def _store_kv(
     cache in its STORAGE dtype plus the per-(position, head) f32
     scale, or `(cache, None)` for the unquantized path.
 
+    `index` may be a scalar (one shared position — the whole-batch
+    scan) or a [b] vector (each row at its OWN position — the slot
+    grid of the continuous-batching engine, serve/engine.py); the
+    vector path vmaps the same dynamic_update_slice per row, so the
+    two layouts stay byte-compatible.
+
     The int8 cache is deliberately NOT dequantized here: a full-shape
     `int8 * scale -> bf16` product is a materialization XLA may write
     back to HBM, which r4 measured as a net LOSS (12,560 vs the bf16
@@ -176,6 +192,7 @@ def _store_kv(
     the scales out of the dots, so the matmuls consume the raw int8
     cache through a pure convert."""
     batch, _, heads, head_dim = new.shape
+    per_row = jnp.ndim(index) == 1
     if kv_quant_int8:
         cache = mod.variable(
             "cache", name,
@@ -186,20 +203,33 @@ def _store_kv(
             lambda: jnp.zeros((batch, max_len, heads), jnp.float32),
         )
         quantized, scale_new = _absmax_quantize(new)
-        cache.value = jax.lax.dynamic_update_slice(
-            cache.value, quantized, (0, index, 0, 0)
-        )
-        scale.value = jax.lax.dynamic_update_slice(
-            scale.value, scale_new, (0, index, 0)
-        )
+        if per_row:
+            cache.value = jax.vmap(_row_update)(
+                cache.value, quantized, index
+            )
+            scale.value = jax.vmap(_row_update)(
+                scale.value, scale_new, index
+            )
+        else:
+            cache.value = jax.lax.dynamic_update_slice(
+                cache.value, quantized, (0, index, 0, 0)
+            )
+            scale.value = jax.lax.dynamic_update_slice(
+                scale.value, scale_new, (0, index, 0)
+            )
         return cache.value, scale.value
     cache = mod.variable(
         "cache", name,
         lambda: jnp.zeros((batch, max_len, heads, head_dim), dtype),
     )
-    cache.value = jax.lax.dynamic_update_slice(
-        cache.value, new.astype(dtype), (0, index, 0, 0)
-    )
+    if per_row:
+        cache.value = jax.vmap(_row_update)(
+            cache.value, new.astype(dtype), index
+        )
+    else:
+        cache.value = jax.lax.dynamic_update_slice(
+            cache.value, new.astype(dtype), (0, index, 0, 0)
+        )
     return cache.value, None
 
 
@@ -311,8 +341,13 @@ class CachedSelfAttention(nn.Module):
 
         keys, key_scale = self._store("k", key_new, batch, index)
         values, value_scale = self._store("v", value_new, batch, index)
-        # attend over positions <= index only
-        valid = (jnp.arange(self.max_len) <= index)[None, None, None, :]
+        # attend over positions <= index only; a [b] index (the slot
+        # grid) gives each row its OWN window, a scalar broadcasts one
+        # window over the batch — identical math either way
+        valid = (
+            jnp.arange(self.max_len)[None, :]
+            <= jnp.atleast_1d(index)[:, None]
+        )[:, None, None, :]
         out = _cache_attention(
             query, keys, key_scale, values, value_scale, valid
         )  # [b,1,h,d]
@@ -331,7 +366,11 @@ class GPTDecodeStep(nn.Module):
     DECODE length, not cfg.max_seq_len: the cache shape is a variable,
     not a param, so a 14-token generate attends over 14 keys instead
     of paying max_seq_len (2048) compute+HBM per step. The position
-    embedding table keeps cfg.max_seq_len (it IS a trained param)."""
+    embedding table keeps cfg.max_seq_len (it IS a trained param).
+
+    `index` may be a scalar (every row at the same position — the
+    whole-batch scan) or a [b] vector (every row at its OWN position —
+    the slot grid of SlotDecodeStep / serve/engine.py)."""
 
     config: GPTConfig
     cache_len: int = 0  # 0 -> cfg.max_seq_len
@@ -790,6 +829,102 @@ def generate(
     )
     generated = run(params, prompt, rng, lens)
     return jnp.concatenate([prompt[:, :1], generated], axis=1)
+
+
+# -- slot-grid decode step (continuous batching) -----------------------------
+
+
+class SlotDecodeStep:
+    """ONE compiled single-token decode over a fixed [n_slots] row grid
+    — the device half of the continuous-batching engine
+    (serve/engine.py).
+
+    Every row is an independent decode stream at its own position:
+    `index` is a [n_slots] vector, so each slot writes K/V into its own
+    cache row at its own offset and attends over its own prefix (the
+    per-row paths in _store_kv / CachedSelfAttention). Prompt ingestion
+    rides the SAME step via the ragged forcing rule of
+    _compiled_decode's scan: while a row is still inside its prompt
+    (index + 1 < lens), the sampled token is overridden by the row's
+    next prompt token — so there is no separate prefill program, and
+    the whole engine is exactly ONE compile per (config, n_slots,
+    max_total, int8 flags). Shapes never change across steps; the cache
+    is donated back in, so on TPU it is updated in place and steady-
+    state decode allocates nothing.
+
+    Greedy only, by design: slots run the argmax rule, matching the
+    inline generate(temperature=0) path bit-for-bit (pinned by
+    tests/test_engine.py); sampled requests keep the inline path, where
+    each request owns its rng stream.
+
+    `compiles` counts TRACES of the step function (a Python-side
+    effect inside the jitted body runs once per compilation) — the
+    bounded-compile-universe discipline of serve/batching.py collapsed
+    to a universe of exactly one, asserted in tests."""
+
+    def __init__(self, cfg: GPTConfig, n_slots: int, max_total: int,
+                 kv_quant_int8: bool = False, weights_int8: bool = False):
+        if max_total > cfg.max_seq_len:
+            raise ValueError(
+                f"max_total {max_total} exceeds max_seq_len "
+                f"{cfg.max_seq_len}"
+            )
+        self.cfg = cfg
+        self.n_slots = int(n_slots)
+        self.max_total = int(max_total)
+        self.compiles = 0
+        model = GPTDecodeStep(
+            cfg, cache_len=max_total, kv_quant_int8=kv_quant_int8,
+            weights_int8=weights_int8,
+        )
+        self._cache_shapes = jax.eval_shape(
+            lambda: model.init(
+                jax.random.PRNGKey(0),
+                jnp.zeros((n_slots,), jnp.int32),
+                jnp.zeros((n_slots,), jnp.int32),
+            )["cache"]
+        )
+
+        def step(params, cache, tok, index, prompt, lens):
+            # trace-time side effect: runs once per compilation, so the
+            # counter IS the compile count for this step function
+            self.compiles += 1
+            logits, updates = model.apply(
+                {"params": params, "cache": cache}, tok, index,
+                mutable=["cache"],
+            )
+            nxt = jnp.argmax(logits, axis=-1)
+            # the ragged forcing rule: rows still inside their prompt
+            # emit the prompt's next token instead of the model's
+            in_prompt = index + 1 < lens
+            forced = jnp.take_along_axis(
+                prompt,
+                jnp.minimum(index + 1, prompt.shape[1] - 1)[:, None],
+                axis=1,
+            )[:, 0]
+            nxt = jnp.where(in_prompt, forced, nxt).astype(jnp.int32)
+            return updates["cache"], nxt
+
+        # donation keeps the cache a single fixed allocation on TPU;
+        # the CPU runtime cannot donate (it would only warn per compile)
+        donate = (1,) if jax.default_backend() != "cpu" else ()
+        self._step = jax.jit(step, donate_argnums=donate)
+
+    def init_cache(self):
+        """Fresh zero cache for the whole grid — created from abstract
+        shapes, one allocation of [n_slots, max_total, ...] per layer
+        per k/v (+ scales under int8)."""
+        return jax.tree_util.tree_map(
+            lambda s: jnp.zeros(s.shape, s.dtype), self._cache_shapes
+        )
+
+    def __call__(self, params, cache, tok, index, prompt, lens):
+        """One step for every slot. tok/index/lens: [n_slots] int32;
+        prompt: [n_slots, max_prompt] int32 (right-padded). Returns
+        (cache, next_tok [n_slots]); next_tok[i] is row i's token at
+        position index[i] + 1 (forced while inside the prompt,
+        greedy-generated after)."""
+        return self._step(params, cache, tok, index, prompt, lens)
 
 
 # -- speculative decoding (prompt-lookup drafting) --------------------------

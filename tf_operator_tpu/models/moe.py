@@ -50,8 +50,12 @@ class MoEConfig:
     # ST-MoE router z-loss (Zoph et al.): mean(logsumexp(logits)^2),
     # penalizing large router logits — the standard stabilizer against
     # router logit drift in long bf16 runs. 0 disables (the sow is
-    # skipped entirely, so existing losses are unchanged).
-    router_z_weight: float = 0.001
+    # skipped entirely, so existing losses are unchanged). Default OFF:
+    # a nonzero default silently changes the training objective of
+    # every unmodified config — and of runs RESUMED across the version
+    # bump that introduced it; presets that want the stabilizer opt in
+    # explicitly (MOE_BASE below).
+    router_z_weight: float = 0.0
     dtype: jnp.dtype = jnp.bfloat16
 
     @property
@@ -66,7 +70,9 @@ MOE_TINY = MoEConfig(
 )
 # BASELINE-class pretraining config: BERT-base-sized attention with 8
 # experts, alternating MoE blocks (~4x FFN params at ~1x FLOPs/token).
-MOE_BASE = MoEConfig()
+# Long bf16 pretraining is exactly where router logit drift bites, so
+# this preset opts into the z-loss stabilizer explicitly.
+MOE_BASE = MoEConfig(router_z_weight=0.001)
 
 
 def expert_capacity(cfg: MoEConfig, tokens_per_group: int) -> int:

@@ -48,7 +48,7 @@ class PallasConv3x3(nn.Module):
             (3, 3, x.shape[-1], self.features), jnp.float32,
         ).astype(self.dtype)
         x = x.astype(self.dtype)
-        if supports(x.shape, kernel.shape, self.strides):
+        if supports(x.shape, kernel.shape, self.strides, dtype=self.dtype):
             return conv3x3_s1(x, kernel, self.interpret)
         return jax.lax.conv_general_dilated(
             x, kernel, window_strides=self.strides, padding="SAME",

@@ -49,10 +49,14 @@ import jax.numpy as jnp
 from jax.experimental import pallas as pl
 
 
-def supports(x_shape, w_shape, strides) -> bool:
+def supports(x_shape, w_shape, strides, dtype=jnp.bfloat16) -> bool:
     """Kernel eligibility: 3x3, stride 1, NHWC, channels that map onto
     MXU lanes (C % 64 == 0 keeps worst-case lane padding at 2x), and a
-    spatial block that fits the VMEM budget."""
+    spatial block that fits the VMEM budget. dtype is the INPUT/WEIGHT
+    element type the caller will actually run with — the estimate must
+    use its real itemsize, or an f32 config doubles the input/weight
+    footprint past what was budgeted and exhausts VMEM at shapes this
+    gate accepted (ADVICE r5)."""
     if tuple(strides) != (1, 1):
         return False
     if tuple(w_shape[:2]) != (3, 3):
@@ -64,11 +68,12 @@ def supports(x_shape, w_shape, strides) -> bool:
     tn = images_per_program(h, w, n)
     if n % tn:
         return False
+    itemsize = jnp.dtype(dtype).itemsize
     # VMEM: padded input block + f32 accumulator + weights, with room
     # for double-buffering (16MB/core)
-    in_bytes = tn * (h + 2) * (w + 2) * c * 2
+    in_bytes = tn * (h + 2) * (w + 2) * c * itemsize
     acc_bytes = tn * h * w * cout * 4
-    w_bytes = 9 * c * cout * 2
+    w_bytes = 9 * c * cout * itemsize
     return in_bytes + acc_bytes + w_bytes < 8 * 1024 * 1024
 
 

@@ -413,9 +413,23 @@ class KubeSubstrate:
             with resp:
                 return resp.read().decode(errors="replace")
 
+        # register BEFORE handing the generator out: a generator body
+        # runs nothing until first next(), so registering inside it
+        # would let close() miss (and leak) a stream that was created
+        # but not yet iterated
+        with self._follow_lock:
+            self._follow_streams.add(resp)
+
         def stream():
-            with self._follow_lock:
-                self._follow_streams.add(resp)
+            if self._stop.is_set():
+                # closed between creation and first iteration: end the
+                # stream (the finally still deregisters)
+                try:
+                    resp.close()
+                finally:
+                    with self._follow_lock:
+                        self._follow_streams.discard(resp)
+                return
             try:
                 with resp:
                     # http.client de-chunks; iterate in line-sized

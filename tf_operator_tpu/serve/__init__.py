@@ -1,4 +1,5 @@
 from .client import DecodeClient, DecodeError
+from .engine import ContinuousBatchingEngine, DecodeCancelled, EngineRequest
 from .server import DecodeHandlerFactory, main, make_server
 
 __all__ = [
@@ -7,4 +8,7 @@ __all__ = [
     "DecodeHandlerFactory",
     "DecodeClient",
     "DecodeError",
+    "ContinuousBatchingEngine",
+    "EngineRequest",
+    "DecodeCancelled",
 ]

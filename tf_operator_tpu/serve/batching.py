@@ -19,6 +19,16 @@ compiles per (batch, width, total), so free-form coalescing would
 compile endlessly. Batch sizes round up to powers of two (pad rows:
 length-1 dummy prompts) and prompt widths to WIDTH_BUCKET multiples,
 bounding the compile universe to |buckets| x |widths| x |new values|.
+
+Positioning vs serve/engine.py: this batcher's scheduling quantum is
+the WHOLE scan — every request in a group rides the full
+max_new_tokens, and a late arrival waits out the previous group
+(measured collapse under concurrent load in SERVE_BENCH.json). The
+continuous-batching engine shrinks the quantum to one token and the
+compile universe to exactly one program; this batcher remains the
+fallback where the engine doesn't reach (e.g. alongside speculative
+or sharded serving, which the engine refuses) and as the simpler
+baseline the bench compares against.
 """
 
 from __future__ import annotations

@@ -71,6 +71,55 @@ class DecodeClient:
         }))
         return body["tokens"]
 
+    def generate_stream(
+        self,
+        input_ids: List[int],
+        max_new_tokens: int = 16,
+        temperature: float = 0.0,
+        top_k: int = 0,
+        top_p: float = 1.0,
+        seed: int = 0,
+    ):
+        """Yield one event dict per line of the chunked ndjson
+        /generate_stream response for ONE prompt row: {"token": t,
+        "index": i} per generated token as the server produces it
+        (incremental only with --batching continuous), then a final
+        {"done": true, "tokens": [[...]], "prompt_lens": [n]}.
+        urllib de-chunks transparently; a server-side decode failure
+        mid-stream arrives as an {"error": ...} line and raises
+        DecodeError here."""
+        data = json.dumps({
+            "input_ids": [list(input_ids)],
+            "max_new_tokens": max_new_tokens,
+            "temperature": temperature,
+            "top_k": top_k,
+            "top_p": top_p,
+            "seed": seed,
+        }).encode()
+        req = urllib.request.Request(
+            self.base_url + "/generate_stream",
+            data=data,
+            headers={"Content-Type": "application/json"},
+            method="POST",
+        )
+        try:
+            with urllib.request.urlopen(req, timeout=self.timeout) as resp:
+                for line in resp:
+                    line = line.strip()
+                    if not line:
+                        continue
+                    event = json.loads(line)
+                    if "error" in event:
+                        raise DecodeError(200, event["error"])
+                    yield event
+        except urllib.error.HTTPError as err:
+            body = err.read().decode(errors="replace")
+            try:
+                message = json.loads(body).get("error", body)
+            except json.JSONDecodeError:
+                message = body
+            raise DecodeError(err.code, message) from None
+
     def beam_search(
         self,
         input_ids: List[List[int]],

@@ -1,0 +1,422 @@
+"""Continuous batching: a persistent per-step decode loop over a slot
+grid (Orca-style iteration-level scheduling, OSDI '22).
+
+The window-coalescing DynamicBatcher (serve/batching.py) rides every
+request in a group through the FULL max_new_tokens scan: a late
+arrival waits out the whole previous scan, and a short request waits
+for the group's longest. Under concurrent load that collapses
+(SERVE_BENCH.json: batched 17.5 req/s, p95 1.53 s vs plain 167.9
+req/s) — the scan is the wrong scheduling quantum. This engine's
+quantum is ONE token: a compiled single-token `decode_step` runs over
+a fixed `[n_slots]` row grid (models/gpt.py SlotDecodeStep), and
+between steps the scheduler
+
+- ADMITS queued requests into free slots (prompt ingestion rides the
+  same step via the ragged `prompt_lens` forcing rule — no separate
+  prefill program, no prefill compile universe),
+- EVICTS finished or cancelled rows immediately (the freed slot is
+  re-admitted the very next step), and
+- STREAMS each generated token back to its request as it is produced,
+  so time-to-first-token depends on the request's OWN prompt length,
+  never on other requests' remaining work.
+
+Shape discipline, inherited and sharpened: the batcher bounds its
+compile universe to |batch buckets| x |width buckets| x |new values|;
+the slot grid collapses it to exactly ONE — `[n_slots]` rows over a
+fixed `n_slots x max_total` KV cache, donated across steps, compiled
+once per (model, config) and asserted by a trace counter
+(tests/test_engine.py).
+
+Scope, deliberately (same contract as the batcher): GREEDY requests
+only — sampled requests keep the inline path so each owns its rng
+stream — and the gpt family only. kv_quant_int8 composes: the slot
+cache layout carries the same per-(position, head) int8 scales.
+"""
+
+from __future__ import annotations
+
+import json
+import queue
+import threading
+import time
+
+import numpy as np
+
+_DONE = object()
+
+
+class DecodeCancelled(RuntimeError):
+    """The request was cancelled before it finished decoding."""
+
+
+class EngineRequest:
+    """Handle for one in-flight request: streams tokens as they are
+    produced, or blocks for the full chain. Created by
+    ContinuousBatchingEngine.submit(); not constructed directly."""
+
+    __slots__ = (
+        "prompt", "new", "tokens", "error", "done", "cancelled",
+        "created", "first_token_at", "_stream",
+    )
+
+    def __init__(self, prompt, new: int):
+        self.prompt = [int(t) for t in prompt]
+        self.new = int(new)
+        self.tokens: list = []  # generated tokens, appended live
+        self.error = None
+        self.done = threading.Event()
+        self.cancelled = threading.Event()
+        self.created = time.monotonic()
+        self.first_token_at = None
+        self._stream: queue.Queue = queue.Queue()
+
+    # -- engine side -------------------------------------------------------
+
+    def _emit(self, token: int) -> None:
+        if self.first_token_at is None:
+            self.first_token_at = time.monotonic()
+        self.tokens.append(token)
+        self._stream.put(token)
+
+    def _finish(self, error=None) -> None:
+        self.error = error
+        self.done.set()
+        self._stream.put(_DONE if error is None else error)
+
+    # -- client side -------------------------------------------------------
+
+    def cancel(self) -> None:
+        """Stop decoding for this request; the engine frees its slot
+        before the next step. result()/stream() then raise
+        DecodeCancelled."""
+        self.cancelled.set()
+
+    def result(self, timeout: float = 600.0):
+        """Block until done; -> the full chain (prompt + generated)."""
+        if not self.done.wait(timeout):
+            self.cancel()
+            raise TimeoutError("decode timed out in the engine")
+        if self.error is not None:
+            raise self.error
+        return self.prompt + self.tokens
+
+    def stream(self, timeout: float = 600.0):
+        """Yield generated tokens as the engine produces them; raises
+        the decode error (or DecodeCancelled) in the consumer."""
+        while True:
+            item = self._stream.get(timeout=timeout)
+            if item is _DONE:
+                return
+            if isinstance(item, BaseException):
+                raise item
+            yield item
+
+    @property
+    def ttft(self):
+        """Seconds from submit to the first generated token, or None
+        before it arrives."""
+        if self.first_token_at is None:
+            return None
+        return self.first_token_at - self.created
+
+
+class ContinuousBatchingEngine:
+    """Slot-based continuous-batching decode engine over one model.
+
+    One background thread owns the device loop and ALL slot state;
+    submit()/cancel() only touch the queue and per-request flags, so
+    there is no lock on the hot path. The KV cache is a single fixed
+    [n_slots, max_total, ...] allocation per layer, donated through
+    every step.
+    """
+
+    def __init__(
+        self,
+        cfg,
+        params,
+        n_slots: int = 8,
+        max_total: int = 0,
+        kv_quant_int8: bool = False,
+        weights_int8: bool = False,
+        start: bool = True,
+    ):
+        from ..models import gpt as gpt_lib
+
+        if n_slots < 1:
+            raise ValueError(f"n_slots must be >= 1, got {n_slots}")
+        max_total = int(max_total) or cfg.max_seq_len
+        self.cfg = cfg
+        self.params = params
+        self.n_slots = int(n_slots)
+        self.max_total = max_total
+        self.step = gpt_lib.SlotDecodeStep(
+            cfg, self.n_slots, max_total,
+            kv_quant_int8=kv_quant_int8, weights_int8=weights_int8,
+        )
+        s = self.n_slots
+        self._cache = self.step.init_cache()
+        self._tok = np.zeros((s,), np.int32)
+        self._index = np.zeros((s,), np.int32)
+        self._lens = np.ones((s,), np.int32)  # idle rows: 1-token dummy
+        self._prompt = np.zeros((s, max_total), np.int32)
+        self._reqs: list = [None] * s
+        self._free = list(range(s))
+        self._queue: queue.Queue = queue.Queue()
+        self._stop = threading.Event()
+        # counters (engine thread writes, observers read — stale reads
+        # are fine for monitoring)
+        self.steps = 0
+        self.row_steps = 0
+        self.admitted = 0
+        self.finished = 0
+        self.cancelled = 0
+        self.decode_seconds = 0.0
+        # THE one compile, paid at construction instead of inside the
+        # first request's latency (the engine twin of serve --warm)
+        self._cache, _ = self.step(
+            self.params, self._cache, self._tok, self._index,
+            self._prompt, self._lens,
+        )
+        # start=False: no scheduler thread — tests drive _admit /
+        # _evict_cancelled / _step_once by hand for deterministic
+        # ordering assertions
+        self.thread = None
+        if start:
+            self.thread = threading.Thread(
+                target=self._run, name="decode-engine", daemon=True
+            )
+            self.thread.start()
+
+    # -- client API --------------------------------------------------------
+
+    def submit(self, prompt, new: int) -> EngineRequest:
+        """Queue one decode stream; -> its handle (stream()/result()).
+        prompt: one row of token ids."""
+        if self._stop.is_set() or (
+            self.thread is not None and not self.thread.is_alive()
+        ):
+            raise RuntimeError("engine is stopped")
+        row = [int(t) for t in prompt]
+        if not row:
+            raise ValueError("prompt must be non-empty")
+        if new < 1:
+            raise ValueError(f"max_new_tokens must be >= 1, got {new}")
+        if len(row) + new > self.max_total:
+            raise ValueError(
+                f"prompt {len(row)} + new {new} exceeds the engine's "
+                f"max_total {self.max_total}"
+            )
+        req = EngineRequest(row, new)
+        self._queue.put(req)
+        return req
+
+    def generate(self, prompt, lens, new: int, timeout: float = 600.0):
+        """Batcher-compatible fan-out: prompt [rows, width] right-padded
+        with per-row lens -> list of full chains (each row's prompt +
+        new tokens). Rows are independent engine streams, so they
+        interleave with every other in-flight request."""
+        prompt = np.asarray(prompt, np.int32)
+        reqs = [
+            self.submit(prompt[i, :int(lens[i])].tolist(), new)
+            for i in range(prompt.shape[0])
+        ]
+        deadline = time.monotonic() + timeout
+        try:
+            return [
+                req.result(max(deadline - time.monotonic(), 1e-3))
+                for req in reqs
+            ]
+        except BaseException:
+            for req in reqs:
+                req.cancel()
+            raise
+
+    def stop(self) -> None:
+        self._stop.set()
+        if self.thread is not None:
+            self.thread.join(timeout=10)
+        stopped = RuntimeError("engine is stopped")
+        while True:  # fail queued requests so waiters don't hang
+            try:
+                req = self._queue.get_nowait()
+            except queue.Empty:
+                break
+            req._finish(stopped)
+        for slot, req in enumerate(self._reqs):
+            if req is not None:
+                self._release(slot, error=stopped)
+
+    # -- observers ---------------------------------------------------------
+
+    @property
+    def active_slots(self) -> int:
+        return self.n_slots - len(self._free)
+
+    @property
+    def queue_depth(self) -> int:
+        return self._queue.qsize()
+
+    def slots(self) -> tuple:
+        """Per-slot request handles (None = free) — test/debug view."""
+        return tuple(self._reqs)
+
+    def metrics(self) -> dict:
+        """(name, kind) -> value rows for the server's /metrics."""
+        return {
+            ("engine_steps_total", "counter"): self.steps,
+            ("engine_row_steps_total", "counter"): self.row_steps,
+            ("engine_admitted_total", "counter"): self.admitted,
+            ("engine_finished_total", "counter"): self.finished,
+            ("engine_cancelled_total", "counter"): self.cancelled,
+            ("engine_decode_seconds_total", "counter"):
+                self.decode_seconds,
+            ("engine_compiles_total", "counter"): self.step.compiles,
+            ("engine_active_slots", "gauge"): self.active_slots,
+            ("engine_queue_depth", "gauge"): self.queue_depth,
+        }
+
+    # -- engine thread -----------------------------------------------------
+
+    def _run(self) -> None:
+        while not self._stop.is_set():
+            self._admit()
+            self._evict_cancelled()
+            if self.active_slots == 0:
+                # idle: park on the queue instead of spinning
+                try:
+                    req = self._queue.get(timeout=0.05)
+                except queue.Empty:
+                    continue
+                self._place(req)
+                continue
+            self._step_once()
+
+    def _admit(self) -> None:
+        while self._free:
+            try:
+                req = self._queue.get_nowait()
+            except queue.Empty:
+                return
+            self._place(req)
+
+    def _place(self, req: EngineRequest) -> None:
+        if req.cancelled.is_set():
+            self.cancelled += 1
+            req._finish(DecodeCancelled("cancelled before admission"))
+            return
+        slot = self._free.pop(0)
+        self._reqs[slot] = req
+        n = len(req.prompt)
+        self._prompt[slot, :] = 0
+        self._prompt[slot, :n] = req.prompt
+        self._lens[slot] = n
+        self._index[slot] = 0
+        self._tok[slot] = req.prompt[0]
+        self.admitted += 1
+
+    def _evict_cancelled(self) -> None:
+        for slot, req in enumerate(self._reqs):
+            if req is not None and req.cancelled.is_set():
+                self.cancelled += 1
+                self._release(slot, error=DecodeCancelled("cancelled"))
+
+    def _release(self, slot: int, error=None) -> None:
+        req = self._reqs[slot]
+        self._reqs[slot] = None
+        self._free.append(slot)
+        # park the row as an idle 1-token dummy; its stale KV is
+        # masked (each row attends <= its own index only) and gets
+        # overwritten position-by-position by the next occupant
+        self._tok[slot] = 0
+        self._index[slot] = 0
+        self._lens[slot] = 1
+        if req is not None:
+            req._finish(error)
+
+    def _step_once(self) -> None:
+        start = time.perf_counter()
+        try:
+            self._cache, nxt = self.step(
+                self.params, self._cache, self._tok, self._index,
+                self._prompt, self._lens,
+            )
+            nxt = np.asarray(nxt)
+        except Exception as err:  # noqa: BLE001 — fan out, stay alive
+            # the donated cache's state is unknown after a failed step;
+            # rebuild it and fail every in-flight request as JSON-able
+            # errors (a dead engine would hang all later requests)
+            self._cache = self.step.init_cache()
+            for slot, req in enumerate(self._reqs):
+                if req is not None:
+                    self._release(slot, error=err)
+            return
+        self.decode_seconds += time.perf_counter() - start
+        self.steps += 1
+        self.row_steps += self.active_slots
+        for slot, req in enumerate(self._reqs):
+            if req is None:
+                continue
+            pos = int(self._index[slot]) + 1
+            self._tok[slot] = nxt[slot]
+            self._index[slot] = pos
+            if pos >= int(self._lens[slot]):
+                req._emit(int(nxt[slot]))
+                if pos == int(self._lens[slot]) + req.new - 1:
+                    self.finished += 1
+                    self._release(slot)
+
+
+def main(argv=None) -> int:
+    """Executable smoke (ci/presubmit.yaml serve-engine-smoke): tiny
+    model, concurrent mixed-length requests through the engine, every
+    chain checked bit-identical against the inline generate() path,
+    exactly one compile — printed as JSON, exit 1 on any mismatch."""
+    import argparse
+
+    parser = argparse.ArgumentParser()
+    parser.add_argument("--slots", type=int, default=4)
+    parser.add_argument("--requests", type=int, default=12)
+    parser.add_argument("--smoke", action="store_true",
+                        help="accepted for CI-invocation clarity")
+    args = parser.parse_args(argv)
+
+    import jax
+    import jax.numpy as jnp
+
+    from ..models import gpt as gpt_lib
+
+    cfg = gpt_lib.GPT_TINY
+    params = gpt_lib.GPT(cfg).init(
+        jax.random.PRNGKey(0), jnp.zeros((1, 8), jnp.int32)
+    )["params"]
+    engine = ContinuousBatchingEngine(cfg, params, n_slots=args.slots)
+    rng = np.random.default_rng(0)
+    jobs = []
+    for i in range(args.requests):
+        p_len = int(rng.integers(1, 12))
+        new = int(rng.integers(1, 8))
+        row = rng.integers(0, cfg.vocab_size, size=p_len).tolist()
+        jobs.append((row, new, engine.submit(row, new)))
+    mismatches = 0
+    for row, new, req in jobs:
+        got = req.result(timeout=120)
+        want = np.asarray(gpt_lib.generate(
+            cfg, params, jnp.asarray([row], jnp.int32), new,
+        ))[0].tolist()
+        mismatches += got != want
+    engine.stop()
+    report = {
+        "requests": len(jobs),
+        "mismatches": mismatches,
+        "compiles": engine.step.compiles,
+        "steps": engine.steps,
+        "ok": mismatches == 0 and engine.step.compiles == 1,
+    }
+    print(json.dumps(report, indent=1))
+    return 0 if report["ok"] else 1
+
+
+if __name__ == "__main__":
+    import sys
+
+    sys.exit(main())

@@ -17,6 +17,10 @@ benchmarks measure:
                       "max_new_tokens": 32, "temperature": 0.0,
                       "top_k": 0, "top_p": 1.0, "seed": 0}
                   -> {"tokens": [[...], ...], "prompt_lens": [3, 2, ...]}
+    POST /generate_stream  (single row) -> chunked ndjson: one
+                  {"token": t, "index": i} event per generated token,
+                  then {"done": true, "tokens": [[...]],
+                        "prompt_lens": [n]}
     GET  /healthz -> {"status": "ok", "model": "...", "decodes": N}
 
 Ragged batches are first-class: rows are right-padded server-side and
@@ -35,6 +39,11 @@ TPU-first behavior worth naming:
   enables dynamic batching instead: concurrent GREEDY requests
   coalesce into one shape-bucketed decode (serve/batching.py) —
   per-batch decode cost is nearly flat, so coalesced rows ride free;
+- --batching continuous replaces whole-scan group decode with the
+  slot-based continuous-batching engine (serve/engine.py): one
+  compiled per-token step over a fixed slot grid, requests admitted
+  and evicted BETWEEN steps, tokens streamed per request — TTFT no
+  longer waits on other requests' remaining scans;
 - --kv-int8 serves with the int8 KV cache (half the per-step cache
   bandwidth — the decode bottleneck at long contexts).
 
@@ -101,7 +110,8 @@ class _State:
         # output (tests/test_serve.py TestShardedServing pins the
         # greedy path; beams share the same placed tree)
         self.lock = threading.Lock()
-        self.batcher = None  # set by make_server when batching is on
+        self.batcher = None  # set by make_server (batching="window")
+        self.engine = None  # set by make_server (batching="continuous")
         self.decodes = 0
         self.decode_batches = 0
         self.tokens_generated = 0
@@ -135,6 +145,10 @@ class _State:
         ):
             rows.append(f"# TYPE {prefix}_{name} {kind}")
             rows.append(f"{prefix}_{name} {value}")
+        if self.engine is not None:
+            for (name, kind), value in self.engine.metrics().items():
+                rows.append(f"# TYPE {prefix}_{name} {kind}")
+                rows.append(f"{prefix}_{name} {value}")
         return "\n".join(rows) + "\n"
 
 
@@ -387,8 +401,26 @@ def DecodeHandlerFactory(state: _State):
             else:
                 self._reply(404, {"error": f"no route {self.path}"})
 
+        # -- chunked ndjson streaming (/generate_stream) --------------
+
+        def _start_stream(self) -> None:
+            self.send_response(200)
+            self.send_header("Content-Type", "application/x-ndjson")
+            self.send_header("Transfer-Encoding", "chunked")
+            self.end_headers()
+
+        def _stream_event(self, payload: dict) -> None:
+            data = json.dumps(payload).encode() + b"\n"
+            self.wfile.write(b"%X\r\n" % len(data) + data + b"\r\n")
+            self.wfile.flush()  # one chunk per event — the flush IS
+            # the streaming; a buffered event is a late event
+
+        def _end_stream(self) -> None:
+            self.wfile.write(b"0\r\n\r\n")
+            self.wfile.flush()
+
         def do_POST(self) -> None:  # noqa: N802
-            if self.path != "/generate":
+            if self.path not in ("/generate", "/generate_stream"):
                 return self._reply(404, {"error": f"no route {self.path}"})
             try:
                 length = int(self.headers.get("Content-Length") or 0)
@@ -412,6 +444,12 @@ def DecodeHandlerFactory(state: _State):
             (prompt, lens, new, temperature, seed, top_k, top_p,
              num_beams) = result
             import jax
+
+            if self.path == "/generate_stream":
+                return self._do_stream(
+                    prompt, lens, new, temperature, seed, top_k, top_p,
+                    num_beams,
+                )
 
             if num_beams > 1:
                 # beam search: through THE shared decode-and-account
@@ -446,6 +484,36 @@ def DecodeHandlerFactory(state: _State):
                 })
 
             greedy = temperature == 0.0 and top_k == 0 and top_p == 1.0
+            if state.engine is not None and greedy:
+                # continuous batching: each row becomes its own engine
+                # stream — admitted into a free slot between steps, so
+                # no row waits on another request's remaining scan.
+                # Sampled requests keep the inline path (the engine is
+                # greedy-only, same scoping as the batcher).
+                try:
+                    chains = state.engine.generate(prompt, lens, new)
+                except TimeoutError as err:
+                    with state.lock:
+                        state.request_errors += 1
+                    return self._reply(503, {"error": str(err)})
+                except Exception as err:  # noqa: BLE001 — a device
+                    # failure fans out to every in-flight client as
+                    # JSON, never a dropped connection (the engine
+                    # rebuilds its cache and stays up)
+                    with state.lock:
+                        state.request_errors += 1
+                    return self._reply(500, {
+                        "error": f"decode failed: "
+                        f"{type(err).__name__}: {err}"[:300]
+                    })
+                with state.lock:
+                    state.decodes += 1
+                    state.tokens_generated += new * len(lens)
+                return self._reply(200, {
+                    "tokens": chains,
+                    "prompt_lens": lens,
+                })
+
             if state.batcher is not None and greedy:
                 # dynamic batching: greedy requests coalesce into one
                 # scan (serve/batching.py); sampled requests keep the
@@ -500,6 +568,125 @@ def DecodeHandlerFactory(state: _State):
                 "prompt_lens": lens,
             })
 
+        def _do_stream(
+            self, prompt, lens, new, temperature, seed, top_k, top_p,
+            num_beams,
+        ) -> None:
+            """/generate_stream: chunked ndjson, one event per
+            generated token. With the continuous engine, events leave
+            as the engine produces them (true token streaming); on any
+            other path the decode is whole-scan, so tokens arrive in
+            one burst at the end — same wire contract, no TTFT win."""
+            import jax
+
+            if len(lens) != 1:
+                with state.lock:
+                    state.request_errors += 1
+                return self._reply(400, {
+                    "error": "/generate_stream takes exactly one "
+                    "prompt row (one stream per connection)"
+                })
+            if num_beams > 1:
+                with state.lock:
+                    state.request_errors += 1
+                return self._reply(400, {
+                    "error": "/generate_stream does not support beams"
+                })
+            greedy = temperature == 0.0 and top_k == 0 and top_p == 1.0
+            if state.engine is not None and greedy:
+                try:
+                    req = state.engine.submit(
+                        prompt[0, :lens[0]].tolist(), new
+                    )
+                except Exception as err:  # noqa: BLE001 — pre-stream
+                    with state.lock:
+                        state.request_errors += 1
+                    return self._reply(500, {
+                        "error": f"decode failed: "
+                        f"{type(err).__name__}: {err}"[:300]
+                    })
+                self._start_stream()
+                try:
+                    index = lens[0]
+                    for token in req.stream():
+                        self._stream_event(
+                            {"token": token, "index": index}
+                        )
+                        index += 1
+                    self._stream_event({
+                        "done": True,
+                        "tokens": [req.prompt + req.tokens],
+                        "prompt_lens": lens,
+                    })
+                    self._end_stream()
+                except (BrokenPipeError, ConnectionError) as err:
+                    # the client went away mid-stream: cancel so the
+                    # slot frees before the next step instead of
+                    # decoding to nobody
+                    req.cancel()
+                    logger.info("stream client gone: %s", err)
+                    self.close_connection = True
+                    return
+                except Exception as err:  # noqa: BLE001 — the 200 is
+                    # already on the wire; the error rides the stream
+                    # as its own terminal event
+                    with state.lock:
+                        state.request_errors += 1
+                    try:
+                        self._stream_event({
+                            "error": f"decode failed: "
+                            f"{type(err).__name__}: {err}"[:300]
+                        })
+                        self._end_stream()
+                    except OSError:
+                        self.close_connection = True
+                    return
+                with state.lock:
+                    state.decodes += 1
+                    state.tokens_generated += new
+                return
+
+            # fallback (no engine, or sampled): whole-scan decode,
+            # then the same event stream in one burst
+            try:
+                if state.batcher is not None and greedy:
+                    chain = state.batcher.submit(prompt, lens, new)[0]
+                else:
+                    chains = _device_decode(
+                        state, prompt, lens, new,
+                        temperature=temperature,
+                        rng=jax.random.PRNGKey(seed),
+                        top_k=top_k, top_p=top_p,
+                    )
+                    chain = chains[0, :lens[0] + new].tolist()
+            except TimeoutError as err:
+                with state.lock:
+                    state.request_errors += 1
+                return self._reply(503, {"error": str(err)})
+            except Exception as err:  # noqa: BLE001 — same contract
+                with state.lock:
+                    state.request_errors += 1
+                return self._reply(500, {
+                    "error": f"decode failed: "
+                    f"{type(err).__name__}: {err}"[:300]
+                })
+            with state.lock:
+                state.decodes += 1
+                state.tokens_generated += new
+            try:
+                self._start_stream()
+                for i, token in enumerate(chain[lens[0]:]):
+                    self._stream_event(
+                        {"token": int(token), "index": lens[0] + i}
+                    )
+                self._stream_event({
+                    "done": True, "tokens": [chain],
+                    "prompt_lens": lens,
+                })
+                self._end_stream()
+            except (BrokenPipeError, ConnectionError):
+                self.close_connection = True
+
         def log_message(self, *args) -> None:
             pass
 
@@ -519,18 +706,53 @@ def make_server(
     weights_int8: bool = False,
     mesh=None,
     warm_shapes=None,
+    batching: str = "",
+    n_slots: int = 8,
 ) -> ThreadingHTTPServer:
     """In-process server (tests and embedders); caller owns
     serve_forever/shutdown. The CLI binds 0.0.0.0 (pods must be
     reachable on the pod IP); the in-process default stays loopback.
-    batch_window_ms > 0 enables dynamic batching of greedy requests
-    (serve/batching.py). speculative=True routes greedy uniform-length
-    requests through prompt-lookup speculative decoding
-    (models/gpt.py generate_speculative; output-exact). The two are
-    mutually exclusive: the batcher's width/batch bucketing pads
-    groups into shapes the speculative eligibility check would almost
-    never pass, silently defeating the flag — refused loudly here
-    instead."""
+    batching selects the greedy scheduling strategy: "none" (inline,
+    lock-serialized), "window" (serve/batching.py DynamicBatcher;
+    requires batch_window_ms > 0), or "continuous" (serve/engine.py
+    slot grid with per-step admit/evict and token streaming). The
+    default "" keeps the historical contract: window iff
+    batch_window_ms > 0. speculative=True routes greedy
+    uniform-length requests through prompt-lookup speculative decoding
+    (models/gpt.py generate_speculative; output-exact). Batching and
+    speculative are mutually exclusive: the batcher's width/batch
+    bucketing pads groups into shapes the speculative eligibility
+    check would almost never pass, and the engine owns the greedy
+    path outright — refused loudly here instead."""
+    if not batching:
+        batching = "window" if batch_window_ms > 0 else "none"
+    if batching not in ("none", "window", "continuous"):
+        raise ValueError(
+            f"batching must be none/window/continuous, got {batching!r}"
+        )
+    if batching == "window" and batch_window_ms <= 0:
+        raise ValueError(
+            "batching='window' needs batch_window_ms > 0 (the coalesce "
+            "window IS the policy knob)"
+        )
+    if batching == "continuous":
+        if batch_window_ms > 0:
+            raise ValueError(
+                "batching='continuous' and batch_window_ms are mutually "
+                "exclusive: the engine admits per step, there is no "
+                "coalesce window"
+            )
+        if speculative:
+            raise ValueError(
+                "batching='continuous' and speculative are mutually "
+                "exclusive: the engine owns the greedy path and its "
+                "quantum is one token, not a drafted run"
+            )
+        if mesh is not None:
+            raise ValueError(
+                "batching='continuous' and mesh are mutually exclusive: "
+                "the slot engine is a single-device program"
+            )
     if speculative and batch_window_ms > 0:
         raise ValueError(
             "speculative and batch_window_ms are mutually exclusive: "
@@ -541,6 +763,7 @@ def make_server(
     if _family(cfg) == "moe" and (
         kv_quant_int8 or weights_int8 or speculative
         or batch_window_ms > 0 or mesh is not None
+        or batching != "none"
     ):
         # moe serves the plain decode path only: its generate has no
         # int8/speculative/sharded machinery, and the batcher's dummy
@@ -548,8 +771,8 @@ def make_server(
         # refused at startup, not per-request
         raise ValueError(
             "the moe family serves plain decode only: kv_quant_int8, "
-            "weights_int8, speculative, batch_window_ms and mesh are "
-            "gpt-family features"
+            "weights_int8, speculative, batching (window/continuous) "
+            "and mesh are gpt-family features"
         )
     from ..ops.quant import is_quantized, quantize_params
 
@@ -587,7 +810,7 @@ def make_server(
         cfg, params, kv_quant_int8, model_name, max_new_cap,
         speculative=speculative, weights_int8=weights_int8, mesh=mesh,
     )
-    if batch_window_ms > 0:
+    if batching == "window":
         from .batching import DynamicBatcher
 
         def decode_fn(prompt, lens, new):
@@ -596,6 +819,16 @@ def make_server(
         state.batcher = DynamicBatcher(
             state, decode_fn, window_ms=batch_window_ms,
             max_batch=MAX_BATCH, max_seq_len=_max_seq(cfg),
+        )
+    elif batching == "continuous":
+        from .engine import ContinuousBatchingEngine
+
+        # state.params is the final tree (post weights_int8 quantize,
+        # which the engine's step reads the same way generate does);
+        # the engine pays its ONE compile here, at startup
+        state.engine = ContinuousBatchingEngine(
+            cfg, state.params, n_slots=n_slots,
+            kv_quant_int8=kv_quant_int8, weights_int8=weights_int8,
         )
     if warm_shapes:
         # pre-compile the expected (batch, width, new) decode shapes at
@@ -657,7 +890,23 @@ def main(argv=None) -> int:
     parser.add_argument(
         "--batch-window-ms", type=float, default=0.0,
         help="dynamic batching: hold a greedy request this long to "
-        "coalesce concurrent peers into one decode (0 = off)",
+        "coalesce concurrent peers into one decode (0 = off; implies "
+        "--batching window)",
+    )
+    parser.add_argument(
+        "--batching", choices=["none", "window", "continuous"],
+        default="",
+        help="greedy scheduling strategy: none (inline), window "
+        "(DynamicBatcher; needs --batch-window-ms), continuous "
+        "(serve/engine.py slot grid: per-step admit/evict, token "
+        "streaming on /generate_stream, one compile total). Default: "
+        "window iff --batch-window-ms > 0, else none",
+    )
+    parser.add_argument(
+        "--slots", type=int, default=8,
+        help="slot-grid rows for --batching continuous: the maximum "
+        "number of concurrently decoding requests (the compiled step "
+        "batch; excess requests queue)",
     )
     parser.add_argument(
         "--speculative", action="store_true",
@@ -700,6 +949,23 @@ def main(argv=None) -> int:
     # flag validation BEFORE any device work: a bad flag combination
     # must be an argparse error, not a traceback after a 30s TPU init
     # (make_server re-checks for embedders)
+    if args.batching == "window" and args.batch_window_ms <= 0:
+        parser.error("--batching window needs --batch-window-ms > 0")
+    if args.batching == "continuous":
+        offending = [
+            flag for flag, on in (
+                ("--batch-window-ms", args.batch_window_ms > 0),
+                ("--speculative", args.speculative),
+                ("--tp", args.tp > 1),
+            ) if on
+        ]
+        if offending:
+            parser.error(
+                f"--batching continuous is mutually exclusive with "
+                f"{', '.join(offending)}"
+            )
+    if args.slots < 1:
+        parser.error("--slots must be >= 1")
     if args.preset.startswith("moe"):
         offending = [
             flag for flag, on in (
@@ -707,6 +973,7 @@ def main(argv=None) -> int:
                 ("--weights-int8", args.weights_int8),
                 ("--speculative", args.speculative),
                 ("--batch-window-ms", args.batch_window_ms > 0),
+                ("--batching", args.batching not in ("", "none")),
                 ("--tp", args.tp > 1),
             ) if on
         ]
@@ -816,6 +1083,7 @@ def main(argv=None) -> int:
         speculative=args.speculative, weights_int8=args.weights_int8,
         mesh=mesh,
         warm_shapes=warm_shapes,
+        batching=args.batching, n_slots=args.slots,
     )
     logger.info("decode server on :%d", server.server_address[1])
     # graceful drain — the serving sibling of the training-side
@@ -839,6 +1107,8 @@ def main(argv=None) -> int:
     except KeyboardInterrupt:
         pass
     server.server_close()
+    if server.state.engine is not None:
+        server.state.engine.stop()  # fail any still-queued requests
     logger.info("drained; exiting 0")
     return 0
 
