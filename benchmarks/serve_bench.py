@@ -178,6 +178,21 @@ def _serve_scenario(cfg, params, prompts, new: int, n_clients: int,
         out["engine_compiles"] = int(
             metrics["tf_operator_tpu_serve_engine_compiles_total"]
         )
+        # server-side distributions from the engine's histograms —
+        # TTFT without the HTTP/client overhead the client-side ttft_*
+        # numbers include, plus inter-token gaps (which the client
+        # can't see at all on the non-streamed rows). PromQL-style
+        # estimates from the scraped buckets (telemetry/exposition.py).
+        from tf_operator_tpu.telemetry import quantile_from_flat
+
+        for family, key in (
+            ("tf_operator_tpu_serve_ttft_seconds", "server_ttft"),
+            ("tf_operator_tpu_serve_inter_token_seconds", "server_itl"),
+        ):
+            for q, tag in ((0.50, "p50"), (0.95, "p95")):
+                est = quantile_from_flat(metrics, family, q)
+                if est is not None:
+                    out[f"{key}_{tag}_s"] = round(est, 4)
     else:
         decodes = metrics["tf_operator_tpu_serve_decodes_total"] - 1
         dispatches = (
@@ -403,7 +418,9 @@ def run(write: bool = True) -> dict:
             "power-of-two bucket shapes (serve --warm). continuous "
             "routes through the slot engine's streaming endpoint "
             "(ttft_* = time to the first token EVENT per request; "
-            "mean_active_slots = decoding rows per engine step). "
+            "server_ttft_*/server_itl_* = PromQL-style estimates from "
+            "the engine's scraped histograms; mean_active_slots = "
+            "decoding rows per engine step). "
             "latency_under_load sweeps windowed vs continuous over "
             "client counts, past the 8-slot grid. speculative is a "
             "model-level "

@@ -1,0 +1,491 @@
+"""The unified telemetry core (tf_operator_tpu/telemetry): labeled
+registry + exposition-format conformance + span tracer, and the three
+planes riding it — operator facade (server/metrics.py), serve server
+(serve/server.py), trainer (train/trainer.py).
+
+The exposition tests are parser-based: validate_text() re-parses the
+rendered page and enforces the invariants Prometheus assumes (HELP +
+TYPE per family, unique families, monotone cumulative buckets ending
++Inf, _sum/_count consistency) — a renderer regression fails here
+before it corrupts a scrape."""
+
+import json
+import threading
+import urllib.request
+
+import pytest
+
+from tf_operator_tpu.telemetry import (
+    FAST_BUCKETS,
+    LATENCY_BUCKETS,
+    ExpositionError,
+    MetricRegistry,
+    SpanTracer,
+    bucket_pairs,
+    format_value,
+    histogram_quantile,
+    parse_text,
+    quantile_from_flat,
+    validate_text,
+)
+
+
+class TestRegistry:
+    def test_counter_gauge_roundtrip(self):
+        reg = MetricRegistry("t")
+        c = reg.counter("things_total", "things")
+        c.inc()
+        c.inc(2)
+        assert c.value == 3
+        with pytest.raises(ValueError):
+            c.inc(-1)
+        g = reg.gauge("level", "level")
+        g.set(5)
+        g.dec(2)
+        assert g.value == 3
+
+    def test_labels_are_distinct_series(self):
+        reg = MetricRegistry("t")
+        fam = reg.counter("ops_total", "ops", labelnames=("verb",))
+        fam.labels(verb="get").inc()
+        fam.labels(verb="put").inc(4)
+        assert fam.labels(verb="get").value == 1
+        assert fam.labels(verb="put").value == 4
+        with pytest.raises(ValueError):
+            fam.labels(wrong="x")
+        with pytest.raises(ValueError):
+            fam.inc()  # labeled family has no default child
+
+    def test_histogram_buckets_cumulative(self):
+        reg = MetricRegistry("t")
+        h = reg.histogram("lat_seconds", "lat", buckets=(0.1, 1.0))
+        for v in (0.05, 0.5, 2.0):
+            h.observe(v)
+        assert h.count == 3
+        assert h.sum == pytest.approx(2.55)
+        assert h.cumulative_buckets() == [
+            (0.1, 1), (1.0, 2), (float("inf"), 3),
+        ]
+
+    def test_get_or_create_is_idempotent_but_conflicts_raise(self):
+        reg = MetricRegistry("t")
+        a = reg.counter("x_total", "x")
+        assert reg.counter("x_total", "other help") is a
+        with pytest.raises(ValueError):
+            reg.gauge("x_total", "now a gauge")
+        h = reg.histogram("h_seconds", "h", buckets=(1, 2))
+        assert reg.histogram("h_seconds", "h", buckets=(1.0, 2.0)) is h
+        with pytest.raises(ValueError):
+            reg.histogram("h_seconds", "h", buckets=(1, 2, 3))
+
+    def test_render_is_valid_exposition(self):
+        reg = MetricRegistry("t")
+        reg.counter("a_total", "a").inc()
+        reg.gauge("b", "b").set(1.5)
+        fam = reg.histogram(
+            "c_seconds", "c", buckets=(0.1, 1.0), labelnames=("op",)
+        )
+        fam.labels(op="read").observe(0.2)
+        fam.labels(op="write").observe(5.0)
+        page = reg.render()
+        validate_text(page)  # raises on any violated invariant
+        assert "t_a_total 1" in page
+        assert 't_c_seconds_bucket{op="read",le="+Inf"} 1' in page
+
+    def test_format_value_pins(self):
+        assert format_value(1.0) == "1"
+        assert format_value(2.5) == "2.5"
+        assert format_value(float("inf")) == "+Inf"
+
+    def test_histogram_quantile_interpolates_and_clamps(self):
+        pairs = [(0.1, 10), (1.0, 20), (float("inf"), 20)]
+        assert histogram_quantile(0.5, pairs) == pytest.approx(0.1)
+        assert histogram_quantile(0.75, pairs) == pytest.approx(0.55)
+        # everything beyond the last finite bound clamps to it
+        assert histogram_quantile(
+            0.99, [(0.1, 0), (float("inf"), 5)]
+        ) == pytest.approx(0.1)
+        assert histogram_quantile(0.5, []) is None
+
+
+class TestExpositionParser:
+    def test_flat_helpers(self):
+        reg = MetricRegistry("t")
+        h = reg.histogram("f_seconds", "f", buckets=(0.1, 1.0))
+        h.observe(0.05)
+        h.observe(0.5)
+        flat = {}
+        for line in reg.render().splitlines():
+            if line and not line.startswith("#"):
+                name, value = line.split()
+                flat[name] = float(value)
+        assert bucket_pairs(flat, "t_f_seconds") == [
+            (0.1, 1.0), (1.0, 2.0), (float("inf"), 2.0),
+        ]
+        assert quantile_from_flat(flat, "t_f_seconds", 0.5) is not None
+
+    @pytest.mark.parametrize("page", [
+        # TYPE without HELP
+        "# TYPE x counter\nx 1\n",
+        # duplicate family
+        "# HELP x h\n# TYPE x counter\nx 1\n"
+        "# HELP x h\n# TYPE x counter\nx 2\n",
+        # buckets not ending +Inf
+        "# HELP h h\n# TYPE h histogram\n"
+        'h_bucket{le="1"} 1\nh_sum 1\nh_count 1\n',
+        # non-monotone cumulative buckets
+        "# HELP h h\n# TYPE h histogram\n"
+        'h_bucket{le="1"} 2\nh_bucket{le="+Inf"} 1\n'
+        "h_sum 1\nh_count 1\n",
+        # _count disagrees with the +Inf bucket
+        "# HELP h h\n# TYPE h histogram\n"
+        'h_bucket{le="1"} 1\nh_bucket{le="+Inf"} 2\n'
+        "h_sum 1\nh_count 3\n",
+    ])
+    def test_invalid_pages_raise(self, page):
+        with pytest.raises(ExpositionError):
+            validate_text(page)
+
+    def test_parse_labels(self):
+        families = parse_text(
+            "# HELP x h\n# TYPE x counter\n"
+            'x{a="1",b="two"} 3\n'
+        )
+        ((name, labels, value),) = families["x"].samples
+        assert name == "x"
+        assert labels == {"a": "1", "b": "two"}
+        assert value == 3.0
+
+
+class TestSpanTracer:
+    def test_exact_microsecond_arithmetic(self):
+        t = [100.0]
+        tracer = SpanTracer(clock=lambda: t[0], process_name="p")
+        span = tracer.begin("req", prompt_tokens=7)
+        t[0] = 100.5
+        span.annotate("admitted")
+        span.annotate("admitted")  # idempotent: one mark
+        t[0] = 101.0
+        span.finish(outcome="finished")
+        span.finish(outcome="again")  # double-finish: no-op
+        assert span.duration == pytest.approx(1.0)
+        trace = tracer.export_chrome()
+        assert trace["traceEvents"][0]["ph"] == "M"
+        (x,) = [e for e in trace["traceEvents"] if e["ph"] == "X"]
+        assert x["ts"] == 0.0 and x["dur"] == 1_000_000.0
+        assert x["args"] == {"prompt_tokens": 7, "outcome": "finished"}
+        instants = [e for e in trace["traceEvents"] if e["ph"] == "i"]
+        assert [(e["name"], e["ts"]) for e in instants] == [
+            ("admitted", 500_000.0)
+        ]
+        json.dumps(trace)  # the export must be JSON-serializable
+
+    def test_ring_bounds_and_context_manager(self):
+        tracer = SpanTracer(clock=lambda: 0.0, capacity=2)
+        for i in range(4):
+            tracer.begin(f"s{i}").finish()
+        assert [s.name for s in tracer.finished_spans()] == ["s2", "s3"]
+        with pytest.raises(RuntimeError):
+            with tracer.begin("boom"):
+                raise RuntimeError("x")
+        assert tracer.finished_spans()[-1].args["outcome"] == "error"
+
+
+class TestOperatorPlane:
+    def test_facade_metrics_and_exposition(self):
+        from tf_operator_tpu.server.metrics import OperatorMetrics
+
+        m = OperatorMetrics()
+        m.created()
+        m.set_leader(True)
+        m.set_degraded(False)
+        m.observe_reconcile(0.01, "success")
+        m.observe_reconcile(0.02, "error")
+        wq = m.workqueue("tfjob")
+        wq.on_add(1)
+        wq.on_get(0.001, 0)
+        wq.on_done(0.005)
+        wq.on_retry()
+        page = m.render()
+        validate_text(page)
+        assert "tf_operator_tpu_jobs_created_total 1" in page
+        assert (
+            'tf_operator_tpu_workqueue_adds_total{name="tfjob"} 1' in page
+        )
+        assert 'reconcile_duration_seconds_bucket{result="success"' in page
+        assert m.value("jobs_created_total") == 1
+
+    def test_value_error_lists_registered_names(self):
+        from tf_operator_tpu.server.metrics import OperatorMetrics
+
+        with pytest.raises(KeyError) as err:
+            OperatorMetrics().value("no_such_metric")
+        message = str(err.value)
+        assert "no_such_metric" in message
+        assert "jobs_created_total" in message
+        assert "is_leader" in message
+
+    def test_job_lifecycle_span(self):
+        from tf_operator_tpu.server.metrics import OperatorMetrics
+
+        m = OperatorMetrics()
+        m.job_observed("ns/job")
+        m.job_observed("ns/job")  # idempotent while open
+        m.job_phase("ns/job", "pods-created")
+        m.job_phase("ns/job", "running")
+        m.job_phase("ns/job", "running")  # sync re-reports: one mark
+        m.job_finished("ns/job", "succeeded")
+        (span,) = m.tracer.finished_spans()
+        assert span.args["outcome"] == "succeeded"
+        assert [name for name, _ in span.events] == [
+            "observed", "pods-created", "running", "terminal",
+        ]
+        m.job_phase("ns/job", "late")  # after finish: ignored
+        assert len(m.tracer.finished_spans()) == 1
+
+    def test_workqueue_instrumented_end_to_end(self):
+        from tf_operator_tpu.runtime.workqueue import RateLimitingQueue
+        from tf_operator_tpu.server.metrics import OperatorMetrics
+
+        m = OperatorMetrics()
+        q = RateLimitingQueue(metrics=m.workqueue("tfjob"))
+        q.add("a")
+        q.add("a")  # deduplicated: one add
+        assert q.get() == "a"
+        q.done("a")
+        q.add_rate_limited("a")
+        assert m.value("jobs_created_total") == 0  # untouched
+        page = m.render()
+        assert 'workqueue_adds_total{name="tfjob"} 1' in page
+        assert 'workqueue_retries_total{name="tfjob"} 1' in page
+        assert (
+            'workqueue_work_duration_seconds_count{name="tfjob"} 1' in page
+        )
+        q.shut_down()
+
+    def test_monitoring_server_bind_addr_and_trace(self):
+        from tf_operator_tpu.server.metrics import (
+            MonitoringServer,
+            OperatorMetrics,
+        )
+
+        m = OperatorMetrics()
+        m.job_observed("ns/j")
+        m.job_finished("ns/j", "succeeded")
+        srv = MonitoringServer(
+            m, port=0, enable_debug=True, bind_addr="127.0.0.1"
+        )
+        port = srv.start()
+        try:
+            assert srv.bind_addr == "127.0.0.1"
+            with urllib.request.urlopen(
+                f"http://127.0.0.1:{port}/metrics", timeout=30
+            ) as resp:
+                validate_text(resp.read().decode())
+            with urllib.request.urlopen(
+                f"http://127.0.0.1:{port}/debug/trace", timeout=30
+            ) as resp:
+                trace = json.loads(resp.read())
+            assert any(
+                e.get("ph") == "X" and e.get("name") == "tfjob"
+                for e in trace["traceEvents"]
+            )
+        finally:
+            srv.stop()
+
+    def test_debug_trace_is_gated(self):
+        from tf_operator_tpu.server.metrics import (
+            MonitoringServer,
+            OperatorMetrics,
+        )
+
+        srv = MonitoringServer(
+            OperatorMetrics(), port=0, bind_addr="127.0.0.1"
+        )
+        port = srv.start()
+        try:
+            with pytest.raises(urllib.error.HTTPError) as err:
+                urllib.request.urlopen(
+                    f"http://127.0.0.1:{port}/debug/trace", timeout=30
+                )
+            assert err.value.code == 404
+        finally:
+            srv.stop()
+
+
+class TestControllerIntegration:
+    def test_reconcile_and_span_telemetry_flow(self):
+        """Drive one job through the live controller against the
+        in-memory substrate and assert the new telemetry surfaced:
+        reconcile-duration observations, workqueue durations on the
+        controller's (possibly native) queue, and an open job span
+        carrying its phase marks."""
+        from tests.test_api import make_job
+
+        from tf_operator_tpu.controller import TFJobController
+        from tf_operator_tpu.runtime import InMemorySubstrate
+        from tf_operator_tpu.server.metrics import OperatorMetrics
+
+        substrate = InMemorySubstrate()
+        metrics = OperatorMetrics()
+        controller = TFJobController(substrate, metrics=metrics)
+        substrate.create_job(make_job(name="tele"))
+        controller.run_until_quiet()
+        hist = metrics.registry.get("reconcile_duration_seconds")
+        assert hist.labels(result="success").count >= 1
+        page = metrics.render()
+        validate_text(page)
+        assert 'workqueue_queue_duration_seconds_count{name="tfjob"}' \
+            in page
+        assert 'workqueue_work_duration_seconds_count{name="tfjob"}' \
+            in page
+        # the job span opened at admission and recorded pod creation
+        assert "default/tele" in metrics._job_spans
+        span = metrics._job_spans["default/tele"]
+        marks = [name for name, _ in span.events]
+        assert marks[0] == "observed"
+        assert "pods-created" in marks
+        # deleting the job closes the span with its outcome
+        substrate.delete_job("default", "tele")
+        controller.run_until_quiet()
+        finished = [
+            s for s in metrics.tracer.finished_spans()
+            if s.name == "tfjob"
+        ]
+        assert finished and finished[-1].args["outcome"] == "deleted"
+
+
+@pytest.fixture(scope="module")
+def continuous_server():
+    import jax
+    import jax.numpy as jnp
+
+    from tf_operator_tpu.models import gpt as gpt_lib
+    from tf_operator_tpu.serve import make_server
+
+    cfg = gpt_lib.GPT_TINY
+    params = gpt_lib.GPT(cfg).init(
+        jax.random.PRNGKey(0), jnp.zeros((1, 8), jnp.int32)
+    )["params"]
+    srv = make_server(
+        cfg, params, model_name="gpt-test",
+        batching="continuous", n_slots=4,
+    )
+    thread = threading.Thread(target=srv.serve_forever, daemon=True)
+    thread.start()
+    try:
+        yield srv
+    finally:
+        srv.shutdown()
+        srv.state.engine.stop()
+
+
+class TestServePlane:
+    def test_exposition_validity_and_ttft(self, continuous_server):
+        from tf_operator_tpu.serve.client import DecodeClient
+
+        port = continuous_server.server_address[1]
+        client = DecodeClient(f"http://127.0.0.1:{port}", timeout=120.0)
+        assert sum(
+            1 for event in client.generate_stream([1, 2, 3],
+                                                  max_new_tokens=6)
+            if "token" in event
+        ) == 6
+        client.generate([[4, 5], [6, 7, 8]], max_new_tokens=3)
+        page = client.metrics_text()
+        validate_text(page)
+        flat = client.metrics()
+        assert flat["tf_operator_tpu_serve_ttft_seconds_count"] >= 3
+        assert flat["tf_operator_tpu_serve_queue_wait_seconds_count"] >= 3
+        assert flat["tf_operator_tpu_serve_inter_token_seconds_count"] >= 1
+        assert flat["tf_operator_tpu_serve_engine_batch_size_count"] >= 1
+        # legacy counters still ride the same page
+        assert flat["tf_operator_tpu_serve_decodes_total"] >= 2
+        # server-side quantile is estimable straight from the scrape
+        assert quantile_from_flat(
+            flat, "tf_operator_tpu_serve_ttft_seconds", 0.5
+        ) is not None
+
+    def test_debug_trace_has_complete_request_span(
+        self, continuous_server
+    ):
+        from tf_operator_tpu.serve.client import DecodeClient
+
+        port = continuous_server.server_address[1]
+        client = DecodeClient(f"http://127.0.0.1:{port}", timeout=120.0)
+        client.generate([[9, 10, 11]], max_new_tokens=4)
+        trace = client.trace()
+        spans = [
+            e for e in trace["traceEvents"]
+            if e.get("ph") == "X" and e.get("name") == "serve-request"
+        ]
+        assert spans, "no complete serve-request span exported"
+        assert all(s["dur"] > 0 for s in spans)
+        marks = {
+            e["name"] for e in trace["traceEvents"] if e.get("ph") == "i"
+        }
+        assert {"queued", "admitted", "first-token", "finished"} <= marks
+        json.dumps(trace)
+
+    def test_legacy_scalar_attrs_still_mutate(self, continuous_server):
+        state = continuous_server.state
+        before = state.decodes
+        state.decodes += 1
+        assert state.decodes == before + 1
+        assert (
+            f"tf_operator_tpu_serve_decodes_total {format_value(state.decodes)}"
+            in state.render_metrics()
+        )
+        state.decodes = before  # restore for the other tests
+
+    def test_engine_bucket_constants(self):
+        # the engine registers TTFT on the latency spread and ITL on
+        # the sub-millisecond spread — a swap would quantize ITL into
+        # its lowest bucket and destroy the p95
+        assert FAST_BUCKETS[0] < LATENCY_BUCKETS[0]
+
+
+class TestTrainerPlane:
+    def test_step_histogram_and_token_rate(self):
+        import jax
+        import optax
+
+        from tf_operator_tpu.models import gpt as gpt_lib
+        from tf_operator_tpu.telemetry import MetricRegistry as MR
+        from tf_operator_tpu.train import Trainer, causal_lm_task
+
+        registry = MR("tf_operator_tpu")
+        cfg = gpt_lib.GPT_TINY
+        model = gpt_lib.GPT(cfg)
+        trainer = Trainer(
+            model, causal_lm_task(model), optax.sgd(0.1),
+            metrics_registry=registry,
+        )
+        rng = jax.random.PRNGKey(0)
+        # batch 8: divisible by the conftest 8-device dp mesh
+        sample = gpt_lib.synthetic_batch(rng, 8, 8, cfg)
+        state = trainer.init(rng, sample)
+
+        def batches():
+            while True:
+                yield sample
+
+        state, metrics = trainer.fit(
+            state, batches(), steps=2, log_every=1
+        )
+        hist = registry.get("train_step_seconds")
+        assert hist.count == 2
+        tokens = sample["input_ids"].size  # 2 x 8
+        rate = registry.get("train_tokens_per_sec").value
+        assert rate > 0
+        assert rate == pytest.approx(
+            metrics["steps_per_sec"] * tokens, rel=1e-6
+        )
+        page = registry.render()
+        validate_text(page)
+        assert "tf_operator_tpu_train_step_seconds_bucket" in page
+
+    def test_default_registry_is_shared(self):
+        from tf_operator_tpu.telemetry import default_registry
+
+        assert default_registry() is default_registry()

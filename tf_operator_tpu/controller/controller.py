@@ -10,6 +10,7 @@ from __future__ import annotations
 
 import logging
 import threading
+import time
 from typing import List, Optional
 
 from ..api import k8s, set_defaults, validate
@@ -90,7 +91,15 @@ class TFJobController:
         from ..runtime.native_queue import make_expectations, make_rate_limiting_queue
 
         self.expectations = make_expectations()
-        self.queue = make_rate_limiting_queue()
+        # workqueue depth/age/work-duration metrics ride the queue
+        # itself (k8s client-go conventions; duck-typed so embedder
+        # metrics objects without the telemetry surface still work)
+        wq_metrics = None
+        if metrics is not None:
+            wq_factory = getattr(metrics, "workqueue", None)
+            if wq_factory is not None:
+                wq_metrics = wq_factory("tfjob")
+        self.queue = make_rate_limiting_queue(metrics=wq_metrics)
         self.reconciler = Reconciler(
             pod_control=RealPodControl(substrate, self.recorder),
             service_control=RealServiceControl(substrate, self.recorder),
@@ -115,6 +124,14 @@ class TFJobController:
         substrate.subscribe("tfjob", self._on_job)
         substrate.subscribe("pod", self._on_pod)
         substrate.subscribe("service", self._on_service)
+
+    def _telemetry(self, method: str, *args) -> None:
+        """Best-effort telemetry call — duck-typed like the rest of the
+        metrics surface, so a minimal embedder metrics object missing
+        the span/histogram methods degrades to counters, not crashes."""
+        fn = getattr(self.metrics, method, None) if self.metrics is not None else None
+        if fn is not None:
+            fn(*args)
 
     # -- event handlers (the informer side) --------------------------------
 
@@ -173,6 +190,7 @@ class TFJobController:
                 self.port_allocator.release(job.key())
             if self.metrics is not None:
                 self.metrics.deleted()
+            self._telemetry("job_finished", job.key(), "deleted")
 
     def _admit(self, job: TFJob) -> None:
         """Admission-time work (reference addTFJob, job.go:35-144):
@@ -180,6 +198,10 @@ class TFJobController:
         on), allocate hostNetwork ports, stamp Created, enqueue."""
         job = job.copy()
         set_defaults(job)
+        # the lifecycle span opens at first observation; later phases
+        # (pods-created, running, terminal) annotate it from the
+        # reconciler and sync (idempotent per phase)
+        self._telemetry("job_observed", job.key())
         try:
             validate(job)
         except ValidationError as err:
@@ -193,6 +215,7 @@ class TFJobController:
                 self.clock.now_iso(),
             )
             self._update_status(job)
+            self._telemetry("job_finished", job.key(), "failed-validation")
             return
         if self.port_allocator is not None:
             try:
@@ -369,6 +392,15 @@ class TFJobController:
         self.reconciler.reconcile(job, pods, services)
         if to_jsonable(job.status) != old_status:
             self._update_status(job)
+        if job.has_condition(ConditionType.RUNNING):
+            self._telemetry("job_phase", key, "running")
+        if job.is_finished():
+            outcome = (
+                "succeeded"
+                if job.has_condition(ConditionType.SUCCEEDED)
+                else "failed"
+            )
+            self._telemetry("job_finished", key, outcome)
         if self.port_allocator is not None and job.is_finished():
             # terminal jobs keep their record (TTL may retain it) but
             # their pods are gone: the host ports go back to the pool
@@ -474,6 +506,10 @@ class TFJobController:
         key = self.queue.get(timeout=timeout)
         if key is None:
             return False
+        # timed HERE, around sync(), not inside the queue: the native
+        # C++ queue path has no Python-side get/done seam, and the
+        # reconcile-duration histogram must cover both implementations
+        started = time.monotonic()
         try:
             self.sync(key)
         except Exception as err:
@@ -481,12 +517,18 @@ class TFJobController:
             # worker; the key retries with backoff while other keys
             # keep syncing
             logger.exception("error syncing %r; requeueing", key)
+            self._telemetry(
+                "observe_reconcile", time.monotonic() - started, "error"
+            )
             if self.metrics is not None:
                 self.metrics.reconcile_panic()
             if is_transient_error(err):
                 self.degraded.record_error()
             self.queue.add_rate_limited(key)
         else:
+            self._telemetry(
+                "observe_reconcile", time.monotonic() - started, "success"
+            )
             self.degraded.record_success()
             self.queue.forget(key)
         finally:

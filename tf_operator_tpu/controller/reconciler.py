@@ -148,6 +148,7 @@ class Reconciler:
         self.schedule_resync = schedule_resync
         self.delete_job = delete_job
         self.gang = gang
+        self.metrics = metrics
         self.fresh_job = fresh_job
         self.status_updater = StatusUpdater(
             now=self.clock.now_iso,
@@ -565,6 +566,11 @@ class Reconciler:
             # (reference pod_control.go:69-74 semantics)
             self.expectations.creation_observed(key)
             raise
+        # first successful pod create marks the span phase (idempotent:
+        # job_phase records each phase name once per job span)
+        job_phase = getattr(self.metrics, "job_phase", None)
+        if job_phase is not None:
+            job_phase(job.key(), "pods-created")
 
     def _delete_pod(self, job: TFJob, pod: k8s.Pod, rt: str) -> None:
         """Delete with deletion-expectation accounting, the mirror of the

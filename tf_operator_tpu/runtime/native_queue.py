@@ -16,6 +16,8 @@ path (reference hot loop: controller.go:225-283).
 from __future__ import annotations
 
 import ctypes
+import threading
+import time
 from typing import Hashable, Optional
 
 from . import _native
@@ -167,15 +169,87 @@ class NativePortBitmap:
             self._lib.tfoprt_ports_free(h)
 
 
+class InstrumentedRateLimitingQueue:
+    """Workqueue-metric hooks around the native queue (dedup and delay
+    scheduling live in C++, so enqueue times are approximated
+    host-side: an add_after is aged from its expected fire time, and a
+    rate-limited re-add from the call — close enough for the
+    queue-duration histogram, exact for depth/adds/work-duration).
+    Interface-compatible with workqueue.RateLimitingQueue; the
+    pure-Python queue instruments itself exactly instead
+    (workqueue.py), so this wrapper only ever fronts the native one."""
+
+    def __init__(self, inner, metrics) -> None:
+        self._inner = inner
+        self._metrics = metrics
+        self._lock = threading.Lock()
+        self._added_at: dict = {}
+        self._started_at: dict = {}
+
+    def _note_add(self, item, at: float) -> None:
+        with self._lock:
+            if item not in self._added_at:
+                self._added_at[item] = at
+        self._metrics.on_add(len(self._inner))
+
+    def add(self, item) -> None:
+        self._inner.add(item)
+        self._note_add(item, time.monotonic())
+
+    def add_after(self, item, delay: float) -> None:
+        self._inner.add_after(item, delay)
+        self._note_add(item, time.monotonic() + max(0.0, delay))
+
+    def add_rate_limited(self, item) -> None:
+        self._metrics.on_retry()
+        self._inner.add_rate_limited(item)
+        self._note_add(item, time.monotonic())
+
+    def get(self, timeout=None):
+        item = self._inner.get(timeout=timeout)
+        if item is not None:
+            now = time.monotonic()
+            with self._lock:
+                added = self._added_at.pop(item, now)
+                self._started_at[item] = now
+            self._metrics.on_get(max(0.0, now - added), len(self._inner))
+        return item
+
+    def done(self, item) -> None:
+        with self._lock:
+            started = self._started_at.pop(item, None)
+        if started is not None:
+            self._metrics.on_done(time.monotonic() - started)
+        self._inner.done(item)
+
+    def forget(self, item) -> None:
+        self._inner.forget(item)
+
+    def num_requeues(self, item) -> int:
+        return self._inner.num_requeues(item)
+
+    def shut_down(self) -> None:
+        self._inner.shut_down()
+
+    def __len__(self) -> int:
+        return len(self._inner)
+
+
 def native_available() -> bool:
     return _native.available()
 
 
-def make_rate_limiting_queue():
-    """Native queue when available, pure-Python otherwise."""
+def make_rate_limiting_queue(metrics=None):
+    """Native queue when available, pure-Python otherwise. metrics is
+    the optional workqueue-convention hook object (server/metrics.py
+    WorkqueueMetrics); the Python queue takes it natively, the C++ one
+    gets the host-side wrapper."""
     if _native.available():
-        return NativeRateLimitingQueue()
-    return RateLimitingQueue()
+        queue = NativeRateLimitingQueue()
+        if metrics is not None:
+            return InstrumentedRateLimitingQueue(queue, metrics)
+        return queue
+    return RateLimitingQueue(metrics=metrics)
 
 
 def make_expectations():

@@ -76,14 +76,24 @@ class WorkQueue:
     - An item added while being *processed* ("dirty while running") is
       re-queued when its worker calls done().
     - shut_down() drains: get() returns None once empty.
+
+    metrics (optional) is a duck-typed hook object with the client-go
+    workqueue convention surface — on_add(depth), on_get(queue_seconds,
+    depth), on_done(work_seconds) — e.g. server/metrics.py
+    WorkqueueMetrics. Timestamps are taken HERE, at the actual
+    enqueue/dequeue transitions (so dedup'd adds don't reset the queue
+    age and a dirty-while-running redo is aged from its re-queue).
     """
 
-    def __init__(self) -> None:
+    def __init__(self, metrics=None) -> None:
         self._cond = threading.Condition()
         self._queue: list = []
         self._dirty: Set[Hashable] = set()
         self._processing: Set[Hashable] = set()
         self._shutting_down = False
+        self._metrics = metrics
+        self._added_at: Dict[Hashable, float] = {}
+        self._started_at: Dict[Hashable, float] = {}
 
     def add(self, item: Hashable) -> None:
         with self._cond:
@@ -92,6 +102,9 @@ class WorkQueue:
             self._dirty.add(item)
             if item not in self._processing:
                 self._queue.append(item)
+                if self._metrics is not None:
+                    self._added_at.setdefault(item, time.monotonic())
+                    self._metrics.on_add(len(self._queue))
                 self._cond.notify()
 
     def get(self, timeout: Optional[float] = None) -> Optional[Hashable]:
@@ -108,13 +121,26 @@ class WorkQueue:
             item = self._queue.pop(0)
             self._processing.add(item)
             self._dirty.discard(item)
+            if self._metrics is not None:
+                now = time.monotonic()
+                self._started_at[item] = now
+                self._metrics.on_get(
+                    now - self._added_at.pop(item, now), len(self._queue)
+                )
             return item
 
     def done(self, item: Hashable) -> None:
         with self._cond:
             self._processing.discard(item)
+            if self._metrics is not None and item in self._started_at:
+                self._metrics.on_done(
+                    time.monotonic() - self._started_at.pop(item)
+                )
             if item in self._dirty:
                 self._queue.append(item)
+                if self._metrics is not None:
+                    self._added_at.setdefault(item, time.monotonic())
+                    self._metrics.on_add(len(self._queue))
                 self._cond.notify()
 
     def shut_down(self) -> None:
@@ -130,8 +156,8 @@ class WorkQueue:
 class DelayingQueue(WorkQueue):
     """WorkQueue plus add_after, via a background timer thread."""
 
-    def __init__(self) -> None:
-        super().__init__()
+    def __init__(self, metrics=None) -> None:
+        super().__init__(metrics=metrics)
         self._timer_lock = threading.Lock()
         self._timers: Set[threading.Timer] = set()
 
@@ -170,11 +196,17 @@ class RateLimitingQueue(DelayingQueue):
     """DelayingQueue plus per-item exponential retry accounting
     (client-go RateLimitingInterface: AddRateLimited/Forget/NumRequeues)."""
 
-    def __init__(self, backoff: Optional[ExponentialBackoff] = None) -> None:
-        super().__init__()
+    def __init__(
+        self,
+        backoff: Optional[ExponentialBackoff] = None,
+        metrics=None,
+    ) -> None:
+        super().__init__(metrics=metrics)
         self._backoff = backoff or ExponentialBackoff()
 
     def add_rate_limited(self, item: Hashable) -> None:
+        if self._metrics is not None:
+            self._metrics.on_retry()
         self.add_after(item, self._backoff.when(item))
 
     def forget(self, item: Hashable) -> None:

@@ -139,9 +139,24 @@ class DecodeClient:
         return json.loads(self._request("/healthz"))
 
     def metrics(self) -> Dict[str, float]:
+        """Flat {sample_name_with_labels: value}; histogram families
+        appear as their `_bucket{le=...}`/`_sum`/`_count` samples
+        (telemetry/exposition.py bucket_pairs/quantile_from_flat
+        consume them)."""
         out = {}
-        for line in self._request("/metrics").decode().splitlines():
+        for line in self.metrics_text().splitlines():
             if line and not line.startswith("#"):
                 name, value = line.split()
                 out[name] = float(value)
         return out
+
+    def metrics_text(self) -> str:
+        """The raw /metrics exposition page (what metrics() parses) —
+        feed it to telemetry.validate_text for a conformance check."""
+        return self._request("/metrics").decode()
+
+    def trace(self) -> dict:
+        """Chrome/Perfetto trace-event JSON from /debug/trace: recent
+        request spans (queued -> admitted -> first-token -> finished);
+        load it in ui.perfetto.dev as-is."""
+        return json.loads(self._request("/debug/trace"))

@@ -44,6 +44,24 @@ import numpy as np
 
 _DONE = object()
 
+# HELP text for the flat metrics() families below, consumed by the
+# serve server's /metrics renderer (exposition-format validity needs a
+# HELP line per family)
+METRIC_HELP = {
+    "engine_steps_total": "Decode steps executed by the engine loop",
+    "engine_row_steps_total":
+        "Slot-rows advanced across all decode steps (steps x occupancy)",
+    "engine_admitted_total": "Requests admitted into a slot",
+    "engine_finished_total": "Requests that decoded to completion",
+    "engine_cancelled_total": "Requests cancelled before or during decode",
+    "engine_decode_seconds_total":
+        "Wall-clock seconds spent inside decode steps",
+    "engine_compiles_total":
+        "XLA compilations of the slot decode step (expected: 1)",
+    "engine_active_slots": "Slots currently occupied by a request",
+    "engine_queue_depth": "Requests waiting for a free slot",
+}
+
 
 class DecodeCancelled(RuntimeError):
     """The request was cancelled before it finished decoding."""
@@ -56,7 +74,8 @@ class EngineRequest:
 
     __slots__ = (
         "prompt", "new", "tokens", "error", "done", "cancelled",
-        "created", "first_token_at", "_stream",
+        "created", "first_token_at", "admitted_at", "last_token_at",
+        "span", "_stream",
     )
 
     def __init__(self, prompt, new: int):
@@ -68,6 +87,11 @@ class EngineRequest:
         self.cancelled = threading.Event()
         self.created = time.monotonic()
         self.first_token_at = None
+        # telemetry (engine-thread-owned): when this request entered a
+        # slot, when its previous token left, and its trace span
+        self.admitted_at = None
+        self.last_token_at = None
+        self.span = None
         self._stream: queue.Queue = queue.Queue()
 
     # -- engine side -------------------------------------------------------
@@ -139,6 +163,8 @@ class ContinuousBatchingEngine:
         kv_quant_int8: bool = False,
         weights_int8: bool = False,
         start: bool = True,
+        registry=None,
+        tracer=None,
     ):
         from ..models import gpt as gpt_lib
 
@@ -171,6 +197,39 @@ class ContinuousBatchingEngine:
         self.finished = 0
         self.cancelled = 0
         self.decode_seconds = 0.0
+        # latency distributions + request spans (telemetry.MetricRegistry
+        # / SpanTracer, both optional): TTFT and queue-wait are per
+        # request, inter-token per emitted token, batch size per step.
+        # All observations happen on the engine thread (or in submit for
+        # the queued mark), and the registry children are internally
+        # locked, so no new synchronization rides the hot path.
+        self._tracer = tracer
+        self._h_ttft = self._h_itl = self._h_queue_wait = None
+        self._h_batch = None
+        if registry is not None:
+            from ..telemetry import FAST_BUCKETS, LATENCY_BUCKETS, SIZE_BUCKETS
+
+            self._h_ttft = registry.histogram(
+                "ttft_seconds",
+                "Time from submit to a request's first generated token",
+                buckets=LATENCY_BUCKETS,
+            )
+            self._h_itl = registry.histogram(
+                "inter_token_seconds",
+                "Gap between a request's consecutive generated tokens",
+                buckets=FAST_BUCKETS,
+            )
+            self._h_queue_wait = registry.histogram(
+                "queue_wait_seconds",
+                "Time from submit until the engine admits the request "
+                "into a slot",
+                buckets=LATENCY_BUCKETS,
+            )
+            self._h_batch = registry.histogram(
+                "engine_batch_size",
+                "Occupied slots per decode step",
+                buckets=SIZE_BUCKETS,
+            )
         # THE one compile, paid at construction instead of inside the
         # first request's latency (the engine twin of serve --warm)
         self._cache, _ = self.step(
@@ -207,6 +266,11 @@ class ContinuousBatchingEngine:
                 f"max_total {self.max_total}"
             )
         req = EngineRequest(row, new)
+        if self._tracer is not None:
+            req.span = self._tracer.begin(
+                "serve-request", prompt_tokens=len(row), max_new_tokens=new,
+            )
+            req.span.annotate("queued")
         self._queue.put(req)
         return req
 
@@ -302,8 +366,15 @@ class ContinuousBatchingEngine:
     def _place(self, req: EngineRequest) -> None:
         if req.cancelled.is_set():
             self.cancelled += 1
+            if req.span is not None:
+                req.span.finish(outcome="cancelled")
             req._finish(DecodeCancelled("cancelled before admission"))
             return
+        req.admitted_at = time.monotonic()
+        if self._h_queue_wait is not None:
+            self._h_queue_wait.observe(req.admitted_at - req.created)
+        if req.span is not None:
+            req.span.annotate("admitted")
         slot = self._free.pop(0)
         self._reqs[slot] = req
         n = len(req.prompt)
@@ -331,6 +402,16 @@ class ContinuousBatchingEngine:
         self._index[slot] = 0
         self._lens[slot] = 1
         if req is not None:
+            if req.span is not None:
+                if error is None:
+                    req.span.annotate("finished")
+                    req.span.finish(outcome="finished")
+                elif isinstance(error, DecodeCancelled):
+                    req.span.finish(outcome="cancelled")
+                else:
+                    req.span.finish(
+                        outcome="error", error=type(error).__name__
+                    )
             req._finish(error)
 
     def _step_once(self) -> None:
@@ -353,6 +434,9 @@ class ContinuousBatchingEngine:
         self.decode_seconds += time.perf_counter() - start
         self.steps += 1
         self.row_steps += self.active_slots
+        if self._h_batch is not None:
+            self._h_batch.observe(self.active_slots)
+        now = time.monotonic()
         for slot, req in enumerate(self._reqs):
             if req is None:
                 continue
@@ -361,6 +445,14 @@ class ContinuousBatchingEngine:
             self._index[slot] = pos
             if pos >= int(self._lens[slot]):
                 req._emit(int(nxt[slot]))
+                if req.last_token_at is None:
+                    if self._h_ttft is not None:
+                        self._h_ttft.observe(now - req.created)
+                    if req.span is not None:
+                        req.span.annotate("first-token")
+                elif self._h_itl is not None:
+                    self._h_itl.observe(now - req.last_token_at)
+                req.last_token_at = now
                 if pos == int(self._lens[slot]) + req.new - 1:
                     self.finished += 1
                     self._release(slot)

@@ -89,6 +89,22 @@ def _max_seq(cfg) -> int:
     return getattr(cfg, "max_seq_len", None) or cfg.max_position_embeddings
 
 
+def _registry_scalar(attr: str):
+    """Property bridging a legacy `state.x` scalar onto a registry
+    child: reads return the child's value, writes (including the
+    `state.x += 1` read-modify-write at every historical call site)
+    land in the child, so the attribute and the /metrics page can
+    never disagree."""
+
+    def _get(self):
+        return getattr(self, attr).value
+
+    def _set(self, value):
+        getattr(self, attr).set(float(value))
+
+    return property(_get, _set)
+
+
 class _State:
     """Model + params + decode bookkeeping shared by request threads."""
 
@@ -112,44 +128,77 @@ class _State:
         self.lock = threading.Lock()
         self.batcher = None  # set by make_server (batching="window")
         self.engine = None  # set by make_server (batching="continuous")
-        self.decodes = 0
-        self.decode_batches = 0
-        self.tokens_generated = 0
-        self.decode_seconds = 0.0
-        self.request_errors = 0
-        self.speculative_decodes = 0
+        # one labeled-metric registry + span tracer per server — the
+        # same telemetry core the operator plane uses
+        # (telemetry/registry.py), so one scrape config covers both
+        # planes and /debug/trace serves per-request spans. The legacy
+        # scalar attributes below stay the mutation API (properties
+        # bridge them onto the children).
+        from ..telemetry import MetricRegistry, SpanTracer
+
+        self.registry = MetricRegistry("tf_operator_tpu_serve")
+        self.tracer = SpanTracer(process_name="tf-operator-tpu-serve")
+        self._c_decodes = self.registry.counter(
+            "decodes_total", "Decode requests answered successfully"
+        )
+        self._c_decode_batches = self.registry.counter(
+            "decode_batches_total",
+            "Device decode dispatches (a coalesced group counts once)",
+        )
+        self._c_tokens = self.registry.counter(
+            "generated_tokens_total", "Tokens generated across all rows"
+        )
+        self._c_decode_seconds = self.registry.counter(
+            "decode_seconds_total",
+            "Wall-clock seconds inside device decode calls",
+        )
+        self._c_request_errors = self.registry.counter(
+            "request_errors_total",
+            "Requests rejected (4xx) or failed during decode (5xx)",
+        )
+        self._c_speculative = self.registry.counter(
+            "speculative_decodes_total",
+            "Decodes that took the speculative prompt-lookup path",
+        )
         # device decodes dispatched and not yet finished — maintained
         # OUTSIDE the decode lock (which a decode holds for its whole
         # duration) under its own tiny lock, so observers can see work
         # in flight. With dynamic batching a coalesced group counts
         # once, and requests still waiting in the batch window are not
         # yet counted (see docs/monitoring.md).
-        self.decodes_inflight = 0
+        self._g_inflight = self.registry.gauge(
+            "decodes_inflight",
+            "Device decodes dispatched and not yet finished",
+        )
         self.inflight_lock = threading.Lock()
 
+    decodes = _registry_scalar("_c_decodes")
+    decode_batches = _registry_scalar("_c_decode_batches")
+    tokens_generated = _registry_scalar("_c_tokens")
+    decode_seconds = _registry_scalar("_c_decode_seconds")
+    request_errors = _registry_scalar("_c_request_errors")
+    speculative_decodes = _registry_scalar("_c_speculative")
+    decodes_inflight = _registry_scalar("_g_inflight")
+
     def render_metrics(self) -> str:
-        """Prometheus text format — same no-dependency exposition the
-        operator's /metrics uses (server/metrics.py), so one scrape
-        config covers both planes."""
-        prefix = "tf_operator_tpu_serve"
-        rows = []
-        for name, kind, value in (
-            ("decodes_total", "counter", self.decodes),
-            ("decode_batches_total", "counter", self.decode_batches),
-            ("generated_tokens_total", "counter", self.tokens_generated),
-            ("decode_seconds_total", "counter", self.decode_seconds),
-            ("request_errors_total", "counter", self.request_errors),
-            ("speculative_decodes_total", "counter",
-             self.speculative_decodes),
-            ("decodes_inflight", "gauge", self.decodes_inflight),
-        ):
-            rows.append(f"# TYPE {prefix}_{name} {kind}")
-            rows.append(f"{prefix}_{name} {value}")
+        """Prometheus text format via the shared telemetry registry —
+        the same exposition core the operator's /metrics uses
+        (server/metrics.py), so one scrape config covers both planes.
+        The engine's flat counters (plain ints owned by its thread)
+        are appended as their own HELP/TYPE'd families."""
+        out = self.registry.render()
         if self.engine is not None:
+            from ..telemetry import format_value
+            from .engine import METRIC_HELP
+
+            rows = []
             for (name, kind), value in self.engine.metrics().items():
-                rows.append(f"# TYPE {prefix}_{name} {kind}")
-                rows.append(f"{prefix}_{name} {value}")
-        return "\n".join(rows) + "\n"
+                full = self.registry.full_name(name)
+                rows.append(f"# HELP {full} {METRIC_HELP.get(name, name)}")
+                rows.append(f"# TYPE {full} {kind}")
+                rows.append(f"{full} {format_value(value)}")
+            out += "\n".join(rows) + "\n"
+        return out
 
 
 def _bad(payload) -> tuple:
@@ -387,7 +436,7 @@ def DecodeHandlerFactory(state: _State):
                     "model": state.model_name,
                     "kv_int8": state.kv_quant_int8,
                     "weights_int8": state.weights_int8,
-                    "decodes": state.decodes,
+                    "decodes": int(state.decodes),
                 })
             elif self.path == "/metrics":
                 body = state.render_metrics().encode()
@@ -395,6 +444,17 @@ def DecodeHandlerFactory(state: _State):
                 self.send_header(
                     "Content-Type", "text/plain; version=0.0.4"
                 )
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+            elif self.path == "/debug/trace":
+                # Chrome/Perfetto trace-event JSON of recent request
+                # spans (queued -> admitted -> first-token -> finished)
+                # — load the payload in ui.perfetto.dev or
+                # chrome://tracing as-is
+                body = json.dumps(state.tracer.export_chrome()).encode()
+                self.send_response(200)
+                self.send_header("Content-Type", "application/json")
                 self.send_header("Content-Length", str(len(body)))
                 self.end_headers()
                 self.wfile.write(body)
@@ -829,6 +889,7 @@ def make_server(
         state.engine = ContinuousBatchingEngine(
             cfg, state.params, n_slots=n_slots,
             kv_quant_int8=kv_quant_int8, weights_int8=weights_int8,
+            registry=state.registry, tracer=state.tracer,
         )
     if warm_shapes:
         # pre-compile the expected (batch, width, new) decode shapes at
@@ -855,6 +916,85 @@ def make_server(
     server = ThreadingHTTPServer((host, port), DecodeHandlerFactory(state))
     server.state = state  # tests reach the batcher for shutdown
     return server
+
+
+def _smoke() -> int:
+    """Telemetry smoke (ci/presubmit.yaml telemetry-smoke): boot a
+    tiny continuous-batching server, drive one streaming and one batch
+    request, then assert the telemetry contract end to end — /metrics
+    parses as valid exposition text with a nonzero TTFT histogram, and
+    /debug/trace holds >= 1 complete serve-request span carrying its
+    queued/admitted/first-token marks. Prints a JSON report; exit 1 on
+    any violated assertion."""
+    import jax
+    import jax.numpy as jnp
+
+    from ..models import gpt as gpt_lib
+    from ..telemetry import ExpositionError, validate_text
+    from .client import DecodeClient
+
+    cfg = gpt_lib.GPT_TINY
+    params = gpt_lib.GPT(cfg).init(
+        jax.random.PRNGKey(0), jnp.zeros((1, 8), jnp.int32)
+    )["params"]
+    server = make_server(
+        cfg, params, port=0, model_name="gpt-tiny",
+        batching="continuous", n_slots=4,
+    )
+    thread = threading.Thread(target=server.serve_forever, daemon=True)
+    thread.start()
+    try:
+        client = DecodeClient(
+            f"http://127.0.0.1:{server.server_address[1]}", timeout=120.0,
+        )
+        streamed = sum(
+            1 for event in client.generate_stream([1, 2, 3], max_new_tokens=8)
+            if "token" in event
+        )
+        chains = client.generate([[5, 6], [7, 8, 9]], max_new_tokens=4)
+        text = client.metrics_text()
+        try:
+            validate_text(text)
+            exposition_error = None
+        except ExpositionError as err:
+            exposition_error = str(err)
+        flat = client.metrics()
+        ttft_count = int(flat.get(
+            "tf_operator_tpu_serve_ttft_seconds_count", 0
+        ))
+        trace = client.trace()
+        spans = [
+            event for event in trace.get("traceEvents", [])
+            if event.get("ph") == "X"
+            and event.get("name") == "serve-request"
+        ]
+        marks = {
+            event.get("name") for event in trace.get("traceEvents", [])
+            if event.get("ph") == "i"
+        }
+    finally:
+        server.shutdown()
+        server.server_close()
+        if server.state.engine is not None:
+            server.state.engine.stop()
+    report = {
+        "streamed_tokens": streamed,
+        "batch_chains": len(chains),
+        "exposition_error": exposition_error,
+        "ttft_count": ttft_count,
+        "complete_spans": len(spans),
+        "span_marks": sorted(m for m in marks if m),
+        "ok": (
+            streamed == 8
+            and len(chains) == 2
+            and exposition_error is None
+            and ttft_count >= 3  # 1 streamed + 2 batch rows
+            and len(spans) >= 1
+            and {"queued", "admitted", "first-token"} <= marks
+        ),
+    }
+    print(json.dumps(report, indent=1))
+    return 0 if report["ok"] else 1
 
 
 def main(argv=None) -> int:
@@ -929,8 +1069,18 @@ def main(argv=None) -> int:
         "the KV cache (generate(mesh=)); mutually exclusive with "
         "--speculative",
     )
+    parser.add_argument(
+        "--smoke", action="store_true",
+        help="self-contained telemetry smoke: boot a tiny continuous-"
+        "batching server, drive two requests, validate the /metrics "
+        "exposition and a complete /debug/trace span, print a JSON "
+        "report, exit 0/1 (ci/presubmit.yaml telemetry-smoke); all "
+        "other flags are ignored",
+    )
     args = parser.parse_args(argv)
     logging.basicConfig(level=logging.INFO, stream=sys.stderr)
+    if args.smoke:
+        return _smoke()
 
     import jax
     import jax.numpy as jnp

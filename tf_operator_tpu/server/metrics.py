@@ -3,8 +3,16 @@
 Mirrors the reference's metric surface (SURVEY.md #22; names from
 docs/monitoring/README.md:59-91 and the counter definitions in
 job.go:27-32, controller.go:68-71, status.go:45-58, server.go:61-66),
-with no client-library dependency: counters render straight to the
-/metrics text format.
+with no client-library dependency.
+
+Since the telemetry core landed, OperatorMetrics is a facade over
+tf_operator_tpu/telemetry: the historical method surface and metric
+names are unchanged (tests/test_server_sdk.py pins them), but the
+rendering, the new control-plane histograms (reconcile duration,
+workqueue queue/work durations — k8s client-go conventions), and the
+job-lifecycle spans all come from the shared registry/tracer, so one
+scrape config and one trace viewer cover the operator alongside the
+serve and train planes.
 """
 
 from __future__ import annotations
@@ -14,42 +22,115 @@ import time
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Dict, Optional, Tuple
 
+from ..telemetry import (
+    LATENCY_BUCKETS,
+    WORKQUEUE_BUCKETS,
+    MetricRegistry,
+    SpanTracer,
+)
+
+_COUNTER_HELP = {
+    "jobs_created_total": "Counts number of jobs created",
+    "jobs_deleted_total": "Counts number of jobs deleted",
+    "jobs_successful_total": "Counts number of jobs successful",
+    "jobs_failed_total": "Counts number of jobs failed",
+    "jobs_restarted_total": "Counts number of jobs restarted",
+    "substrate_retries_total":
+        "Counts transient substrate/apiserver errors retried",
+    "watch_reestablished_total":
+        "Counts watch streams re-established after a drop or 410",
+    "reconcile_panics_total":
+        "Counts reconcile worker exceptions isolated per key",
+}
+_GAUGE_HELP = {
+    "is_leader": "1 when this replica holds leadership",
+    "degraded":
+        "1 while the degraded-mode latch holds (pod churn paused)",
+}
+
+
+class WorkqueueMetrics:
+    """client-go workqueue metric conventions for one named queue:
+    depth gauge, adds counter, queue-duration (add -> get) and
+    work-duration (get -> done) histograms, retries counter — all
+    labeled {name=...} on shared families, so several queues coexist
+    in one registry. The queue implementations call the on_* hooks
+    with plain numbers; all clocking stays queue-side."""
+
+    def __init__(self, registry: MetricRegistry, name: str = "tfjob"):
+        self.name = name
+        self._depth = registry.gauge(
+            "workqueue_depth", "Current depth of the workqueue",
+            labelnames=("name",),
+        ).labels(name=name)
+        self._adds = registry.counter(
+            "workqueue_adds_total", "Total adds handled by the workqueue",
+            labelnames=("name",),
+        ).labels(name=name)
+        self._queue_duration = registry.histogram(
+            "workqueue_queue_duration_seconds",
+            "How long an item stays in the workqueue before being "
+            "requested (add -> get)",
+            buckets=WORKQUEUE_BUCKETS, labelnames=("name",),
+        ).labels(name=name)
+        self._work_duration = registry.histogram(
+            "workqueue_work_duration_seconds",
+            "How long processing an item from the workqueue takes "
+            "(get -> done)",
+            buckets=WORKQUEUE_BUCKETS, labelnames=("name",),
+        ).labels(name=name)
+        self._retries = registry.counter(
+            "workqueue_retries_total",
+            "Total rate-limited requeues handled by the workqueue",
+            labelnames=("name",),
+        ).labels(name=name)
+
+    def on_add(self, depth: int) -> None:
+        self._adds.inc()
+        self._depth.set(depth)
+
+    def on_get(self, queue_seconds: float, depth: int) -> None:
+        self._queue_duration.observe(max(0.0, queue_seconds))
+        self._depth.set(depth)
+
+    def on_done(self, work_seconds: float) -> None:
+        self._work_duration.observe(max(0.0, work_seconds))
+
+    def on_retry(self) -> None:
+        self._retries.inc()
+
 
 class OperatorMetrics:
-    def __init__(self, prefix: str = "tf_operator_tpu") -> None:
+    def __init__(
+        self,
+        prefix: str = "tf_operator_tpu",
+        registry: Optional[MetricRegistry] = None,
+        tracer: Optional[SpanTracer] = None,
+    ) -> None:
         self.prefix = prefix
-        self._lock = threading.Lock()
-        self._counters: Dict[str, float] = {
-            "jobs_created_total": 0,
-            "jobs_deleted_total": 0,
-            "jobs_successful_total": 0,
-            "jobs_failed_total": 0,
-            "jobs_restarted_total": 0,
-            "substrate_retries_total": 0,
-            "watch_reestablished_total": 0,
-            "reconcile_panics_total": 0,
+        self.registry = registry or MetricRegistry(prefix)
+        self.tracer = tracer or SpanTracer(process_name="tfjob-operator")
+        self._counters = {
+            name: self.registry.counter(name, help_text)
+            for name, help_text in _COUNTER_HELP.items()
         }
-        self._gauges: Dict[str, float] = {"is_leader": 0, "degraded": 0}
-        self._help = {
-            "jobs_created_total": "Counts number of jobs created",
-            "jobs_deleted_total": "Counts number of jobs deleted",
-            "jobs_successful_total": "Counts number of jobs successful",
-            "jobs_failed_total": "Counts number of jobs failed",
-            "jobs_restarted_total": "Counts number of jobs restarted",
-            "substrate_retries_total":
-                "Counts transient substrate/apiserver errors retried",
-            "watch_reestablished_total":
-                "Counts watch streams re-established after a drop or 410",
-            "reconcile_panics_total":
-                "Counts reconcile worker exceptions isolated per key",
-            "is_leader": "1 when this replica holds leadership",
-            "degraded":
-                "1 while the degraded-mode latch holds (pod churn paused)",
+        self._gauges = {
+            name: self.registry.gauge(name, help_text)
+            for name, help_text in _GAUGE_HELP.items()
         }
+        self.reconcile_duration = self.registry.histogram(
+            "reconcile_duration_seconds",
+            "Wall time of one per-key reconcile (sync) pass",
+            buckets=LATENCY_BUCKETS, labelnames=("result",),
+        )
+        self._workqueues: Dict[str, WorkqueueMetrics] = {}
+        # job-lifecycle spans: observed -> pods-created -> running ->
+        # terminal, keyed by "namespace/name"
+        self._span_lock = threading.Lock()
+        self._job_spans: Dict[str, object] = {}
 
     def _inc(self, name: str) -> None:
-        with self._lock:
-            self._counters[name] += 1
+        self._counters[name].inc()
 
     def created(self) -> None:
         self._inc("jobs_created_total")
@@ -76,38 +157,71 @@ class OperatorMetrics:
         self._inc("reconcile_panics_total")
 
     def set_leader(self, is_leader: bool) -> None:
-        with self._lock:
-            self._gauges["is_leader"] = 1 if is_leader else 0
+        self._gauges["is_leader"].set(1 if is_leader else 0)
 
     def set_degraded(self, degraded: bool) -> None:
-        with self._lock:
-            self._gauges["degraded"] = 1 if degraded else 0
+        self._gauges["degraded"].set(1 if degraded else 0)
+
+    # -- histograms / workqueues -------------------------------------------
+
+    def observe_reconcile(self, seconds: float, result: str) -> None:
+        self.reconcile_duration.labels(result=result).observe(
+            max(0.0, seconds)
+        )
+
+    def workqueue(self, name: str = "tfjob") -> WorkqueueMetrics:
+        wq = self._workqueues.get(name)
+        if wq is None:
+            wq = WorkqueueMetrics(self.registry, name)
+            self._workqueues[name] = wq
+        return wq
+
+    # -- job-lifecycle spans -----------------------------------------------
+
+    def job_observed(self, key: str) -> None:
+        with self._span_lock:
+            if key in self._job_spans:
+                return
+            span = self.tracer.begin("tfjob", job=key)
+            self._job_spans[key] = span
+        span.annotate("observed")
+
+    def job_phase(self, key: str, phase: str) -> None:
+        """Mark a lifecycle instant (idempotent per phase): sync
+        re-reports states every pass, the span records each once."""
+        with self._span_lock:
+            span = self._job_spans.get(key)
+        if span is not None:
+            span.annotate(phase)
+
+    def job_finished(self, key: str, outcome: str) -> None:
+        with self._span_lock:
+            span = self._job_spans.pop(key, None)
+        if span is not None:
+            span.annotate("terminal")
+            span.finish(outcome=outcome)
+
+    # -- introspection ------------------------------------------------------
 
     def value(self, name: str) -> float:
-        with self._lock:
-            if name in self._counters:
-                return self._counters[name]
-            return self._gauges[name]
+        if name in self._counters:
+            return self._counters[name].value
+        if name in self._gauges:
+            return self._gauges[name].value
+        registered = sorted(self._counters) + sorted(self._gauges)
+        raise KeyError(
+            f"unknown metric {name!r}; registered: {', '.join(registered)}"
+        )
 
     def snapshot(self) -> Tuple[Dict[str, float], Dict[str, float]]:
         """Consistent (counters, gauges) copy for debug/introspection."""
-        with self._lock:
-            return dict(self._counters), dict(self._gauges)
+        return (
+            {name: c.value for name, c in self._counters.items()},
+            {name: g.value for name, g in self._gauges.items()},
+        )
 
     def render(self) -> str:
-        lines = []
-        with self._lock:
-            for name, value in sorted(self._counters.items()):
-                full = f"{self.prefix}_{name}"
-                lines.append(f"# HELP {full} {self._help[name]}")
-                lines.append(f"# TYPE {full} counter")
-                lines.append(f"{full} {value}")
-            for name, value in sorted(self._gauges.items()):
-                full = f"{self.prefix}_{name}"
-                lines.append(f"# HELP {full} {self._help[name]}")
-                lines.append(f"# TYPE {full} gauge")
-                lines.append(f"{full} {value}")
-        return "\n".join(lines) + "\n"
+        return self.registry.render()
 
 
 def _dump_threads() -> str:
@@ -133,13 +247,17 @@ class MonitoringServer:
         metrics: OperatorMetrics,
         port: int = 8443,
         enable_debug: bool = False,
+        bind_addr: str = "0.0.0.0",
     ) -> None:
-        # /debug/* is opt-in: thread stacks expose code structure and the
-        # monitoring port binds 0.0.0.0 (the Go reference likewise only
-        # exposes pprof when the operator is deployed with it enabled)
+        # /debug/* is opt-in: thread stacks and job-name traces expose
+        # internals (the Go reference likewise only exposes pprof when
+        # the operator is deployed with it enabled). bind_addr defaults
+        # to all interfaces — the historical behavior pods need — but
+        # tests and single-host deploys can pass 127.0.0.1.
         self.metrics = metrics
         self.port = port
         self.enable_debug = enable_debug
+        self.bind_addr = bind_addr
         self.started_at = time.time()
         self._httpd: Optional[ThreadingHTTPServer] = None
         self._thread: Optional[threading.Thread] = None
@@ -161,6 +279,11 @@ class MonitoringServer:
             },
             indent=2,
         ).encode()
+
+    def _debug_trace(self) -> bytes:
+        import json
+
+        return json.dumps(self.metrics.tracer.export_chrome()).encode()
 
     def start(self) -> int:
         metrics = self.metrics
@@ -184,6 +307,10 @@ class MonitoringServer:
                     body = server._debug_vars()
                     self.send_response(200)
                     self.send_header("Content-Type", "application/json")
+                elif self.path == "/debug/trace" and server.enable_debug:
+                    body = server._debug_trace()
+                    self.send_response(200)
+                    self.send_header("Content-Type", "application/json")
                 else:
                     body = b"not found"
                     self.send_response(404)
@@ -194,7 +321,7 @@ class MonitoringServer:
             def log_message(self, *args) -> None:
                 pass  # quiet; operator logs go through logging
 
-        self._httpd = ThreadingHTTPServer(("0.0.0.0", self.port), Handler)
+        self._httpd = ThreadingHTTPServer((self.bind_addr, self.port), Handler)
         self.port = self._httpd.server_address[1]
         self._thread = threading.Thread(
             target=self._httpd.serve_forever, name="monitoring", daemon=True

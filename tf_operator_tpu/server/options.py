@@ -14,6 +14,9 @@ class ServerOptions:
     threadiness: int = 1
     resync_period: float = 30.0
     monitoring_port: int = 8443
+    # all interfaces by default (pods must answer on the pod IP);
+    # loopback for tests and single-host deploys
+    monitoring_bind_addr: str = "0.0.0.0"
     enable_debug_endpoints: bool = False
     json_log_format: bool = True
     enable_gang_scheduling: bool = False
@@ -53,6 +56,11 @@ def parse_args(argv: Optional[List[str]] = None) -> ServerOptions:
         help="Seconds between level-trigger resyncs",
     )
     parser.add_argument("--monitoring-port", type=int, default=opts.monitoring_port)
+    parser.add_argument(
+        "--monitoring-bind-addr", default=opts.monitoring_bind_addr,
+        help="bind address for the monitoring port (default 0.0.0.0; "
+        "use 127.0.0.1 for local-only)",
+    )
     parser.add_argument(
         "--enable-debug-endpoints", action="store_true",
         default=opts.enable_debug_endpoints,
@@ -114,6 +122,7 @@ def parse_args(argv: Optional[List[str]] = None) -> ServerOptions:
         threadiness=ns.threadiness,
         resync_period=ns.resync_period,
         monitoring_port=ns.monitoring_port,
+        monitoring_bind_addr=ns.monitoring_bind_addr,
         enable_debug_endpoints=ns.enable_debug_endpoints,
         json_log_format=ns.json_log_format,
         enable_gang_scheduling=ns.enable_gang_scheduling,

@@ -83,6 +83,7 @@ class OperatorServer:
             self.metrics,
             options.monitoring_port,
             enable_debug=options.enable_debug_endpoints,
+            bind_addr=options.monitoring_bind_addr,
         )
         # metrics threaded into the substrate so the transport-level
         # observables (substrate_retries_total, watch_reestablished_

@@ -1,0 +1,80 @@
+"""Unified telemetry core: labeled metrics + span tracing.
+
+One registry model for all three planes (controller, serve engine,
+trainer) so a single Prometheus scrape config and a single trace
+viewer cover the whole stack:
+
+    from tf_operator_tpu.telemetry import MetricRegistry, SpanTracer
+
+    reg = MetricRegistry("tf_operator_tpu")
+    ttft = reg.histogram("ttft_seconds", "Submit to first token")
+    ttft.observe(0.042)
+    reg.render()                      # -> Prometheus text page
+
+    tracer = SpanTracer()
+    span = tracer.begin("serve-request", prompt_tokens=7)
+    span.annotate("admitted")
+    span.finish(outcome="finished")
+    tracer.export_chrome()            # -> Perfetto-loadable JSON
+
+Stdlib only, like everything else in the SDK. The operator facade
+(server/metrics.py OperatorMetrics) and the serve server's _State
+both build on this; the trainer feeds `default_registry()` so
+embedders can expose training metrics without plumbing.
+"""
+
+from __future__ import annotations
+
+import threading
+
+from .exposition import (
+    ExpositionError,
+    bucket_pairs,
+    parse_text,
+    quantile_from_flat,
+    validate_text,
+)
+from .registry import (
+    FAST_BUCKETS,
+    LATENCY_BUCKETS,
+    SIZE_BUCKETS,
+    STEP_BUCKETS,
+    WORKQUEUE_BUCKETS,
+    MetricRegistry,
+    format_value,
+    histogram_quantile,
+)
+from .tracing import Span, SpanTracer
+
+__all__ = [
+    "MetricRegistry",
+    "SpanTracer",
+    "Span",
+    "format_value",
+    "histogram_quantile",
+    "parse_text",
+    "validate_text",
+    "bucket_pairs",
+    "quantile_from_flat",
+    "ExpositionError",
+    "LATENCY_BUCKETS",
+    "FAST_BUCKETS",
+    "WORKQUEUE_BUCKETS",
+    "SIZE_BUCKETS",
+    "STEP_BUCKETS",
+    "default_registry",
+]
+
+_default_lock = threading.Lock()
+_default: MetricRegistry = None  # type: ignore[assignment]
+
+
+def default_registry() -> MetricRegistry:
+    """Process-wide registry for components without an obvious owner
+    (the Trainer): registration is get-or-create, so any number of
+    instances can feed the same families."""
+    global _default
+    with _default_lock:
+        if _default is None:
+            _default = MetricRegistry("tf_operator_tpu")
+        return _default

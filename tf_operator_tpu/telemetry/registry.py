@@ -1,0 +1,424 @@
+"""Labeled metric registry with Prometheus text exposition.
+
+The repo grew two hand-rolled /metrics renderers (server/metrics.py
+and serve/server.py) that could only say *that* things happened —
+plain counters, no labels, no distributions. This registry is the one
+metric core both planes now share: Counter / Gauge / Histogram
+families, optional labels, fixed histogram buckets rendered as
+cumulative `_bucket{le=...}` rows plus `_sum`/`_count`, all in the
+text exposition format 0.0.4 a Prometheus scraper expects — still
+with zero dependencies (the same stdlib-only posture as the rest of
+the SDK).
+
+Concurrency: every family carries its own lock; children (label sets)
+are created under it and mutate under it. Observation is a dict
+update plus a couple of float adds — cheap enough for the decode
+per-token path.
+
+Registration is get-or-create: asking for an existing (name, kind,
+labelnames, buckets) returns the same family, so facades and repeated
+constructions (several Trainers feeding the default registry) are
+safe; a *conflicting* re-registration raises.
+"""
+
+from __future__ import annotations
+
+import bisect
+import math
+import threading
+from typing import Dict, List, Optional, Sequence, Tuple
+
+# Prometheus' classic latency spread — wide enough for TTFT and
+# whole-request times on anything from CPU-tiny to TPU decode.
+LATENCY_BUCKETS: Tuple[float, ...] = (
+    0.001, 0.0025, 0.005, 0.01, 0.025, 0.05, 0.1, 0.25, 0.5,
+    1.0, 2.5, 5.0, 10.0,
+)
+# per-token / queue-hop durations: sub-millisecond resolution matters
+# (an engine step on TPU is tens of microseconds of host time)
+FAST_BUCKETS: Tuple[float, ...] = (
+    0.0001, 0.00025, 0.0005, 0.001, 0.0025, 0.005, 0.01, 0.025,
+    0.05, 0.1, 0.25, 0.5, 1.0,
+)
+# client-go workqueue convention (queue/work duration): microseconds
+# up to ~10s, the spread the k8s dashboards assume
+WORKQUEUE_BUCKETS: Tuple[float, ...] = (
+    1e-06, 1e-05, 0.0001, 0.001, 0.01, 0.1, 1.0, 10.0,
+)
+# batch/slot occupancy style size distributions
+SIZE_BUCKETS: Tuple[float, ...] = (1, 2, 4, 8, 16, 32, 64, 128)
+# optimizer steps: spans jitted-tiny on CPU through big-model TPU steps
+STEP_BUCKETS: Tuple[float, ...] = (
+    0.005, 0.01, 0.025, 0.05, 0.1, 0.25, 0.5, 1.0, 2.5, 5.0,
+    10.0, 30.0, 60.0,
+)
+
+_INF = float("inf")
+
+
+def format_value(value: float) -> str:
+    """Exposition-format number: integers without a trailing .0 (the
+    historical renderers emitted raw ints and tests pin substrings
+    like `jobs_created_total 1`), floats via repr (round-trip exact)."""
+    f = float(value)
+    if f == _INF:
+        return "+Inf"
+    if f == -_INF:
+        return "-Inf"
+    if f != f:  # NaN
+        return "NaN"
+    if f.is_integer() and abs(f) < 1e15:
+        return str(int(f))
+    return repr(f)
+
+
+def _escape_label(value: str) -> str:
+    return (
+        str(value)
+        .replace("\\", r"\\")
+        .replace("\n", r"\n")
+        .replace('"', r'\"')
+    )
+
+
+def _label_str(labelnames: Sequence[str], labelvalues: Sequence[str]) -> str:
+    return ",".join(
+        f'{k}="{_escape_label(v)}"'
+        for k, v in zip(labelnames, labelvalues)
+    )
+
+
+class _Child:
+    """One (family, label set) time series."""
+
+    __slots__ = ("_family", "_labelvalues")
+
+    def __init__(self, family: "_Family", labelvalues: Tuple[str, ...]):
+        self._family = family
+        self._labelvalues = labelvalues
+
+
+class CounterChild(_Child):
+    def inc(self, amount: float = 1.0) -> None:
+        if amount < 0:
+            raise ValueError(f"counters only go up, got {amount}")
+        fam = self._family
+        with fam._lock:
+            fam._values[self._labelvalues] = (
+                fam._values.get(self._labelvalues, 0.0) + amount
+            )
+
+    def set(self, value: float) -> None:
+        """Facade escape hatch (NOT a Prometheus counter operation):
+        the serve server zeroes warm-up traffic out of its counters
+        and its legacy `state.x += 1` call sites read-modify-write
+        through a property. Both go through here."""
+        fam = self._family
+        with fam._lock:
+            fam._values[self._labelvalues] = float(value)
+
+    @property
+    def value(self) -> float:
+        fam = self._family
+        with fam._lock:
+            return fam._values.get(self._labelvalues, 0.0)
+
+
+class GaugeChild(_Child):
+    def set(self, value: float) -> None:
+        fam = self._family
+        with fam._lock:
+            fam._values[self._labelvalues] = float(value)
+
+    def inc(self, amount: float = 1.0) -> None:
+        fam = self._family
+        with fam._lock:
+            fam._values[self._labelvalues] = (
+                fam._values.get(self._labelvalues, 0.0) + amount
+            )
+
+    def dec(self, amount: float = 1.0) -> None:
+        self.inc(-amount)
+
+    @property
+    def value(self) -> float:
+        fam = self._family
+        with fam._lock:
+            return fam._values.get(self._labelvalues, 0.0)
+
+
+class HistogramChild(_Child):
+    def observe(self, value: float) -> None:
+        fam = self._family
+        v = float(value)
+        with fam._lock:
+            counts, stats = fam._values[self._labelvalues]
+            counts[bisect.bisect_left(fam.buckets, v)] += 1
+            stats[0] += v
+            stats[1] += 1
+
+    @property
+    def count(self) -> int:
+        with self._family._lock:
+            return int(self._family._values[self._labelvalues][1][1])
+
+    @property
+    def sum(self) -> float:
+        with self._family._lock:
+            return float(self._family._values[self._labelvalues][1][0])
+
+    def cumulative_buckets(self) -> List[Tuple[float, int]]:
+        """[(le, cumulative_count)] ending with (+Inf, count)."""
+        fam = self._family
+        with fam._lock:
+            counts, _ = fam._values[self._labelvalues]
+            out, acc = [], 0
+            for le, c in zip(list(fam.buckets) + [_INF], counts):
+                acc += c
+                out.append((le, acc))
+            return out
+
+
+class _Family:
+    """One metric family: name, kind, help, label schema, children."""
+
+    CHILD = _Child  # overridden
+
+    def __init__(
+        self,
+        name: str,
+        help_text: str,
+        labelnames: Tuple[str, ...],
+        buckets: Optional[Tuple[float, ...]] = None,
+    ):
+        self.name = name
+        self.help = help_text
+        self.labelnames = labelnames
+        self.buckets = buckets
+        self._lock = threading.Lock()
+        self._values: Dict[Tuple[str, ...], object] = {}
+        self._children: Dict[Tuple[str, ...], _Child] = {}
+        if not labelnames:
+            self._default = self.labels()
+
+    def labels(self, **labelvalues: str):
+        if set(labelvalues) != set(self.labelnames):
+            raise ValueError(
+                f"{self.name} takes labels {self.labelnames}, "
+                f"got {tuple(sorted(labelvalues))}"
+            )
+        key = tuple(str(labelvalues[k]) for k in self.labelnames)
+        with self._lock:
+            child = self._children.get(key)
+            if child is None:
+                child = self.CHILD(self, key)
+                self._children[key] = child
+                self._init_value(key)
+            return child
+
+    def _init_value(self, key: Tuple[str, ...]) -> None:
+        self._values[key] = 0.0
+
+    # unlabeled families proxy straight to their single child, so
+    # `registry.counter("x", "...").inc()` just works
+    def _only(self):
+        if self.labelnames:
+            raise ValueError(
+                f"{self.name} is labeled {self.labelnames}; "
+                "call .labels(...) first"
+            )
+        return self._default
+
+
+class CounterFamily(_Family):
+    kind = "counter"
+    CHILD = CounterChild
+
+    def inc(self, amount: float = 1.0) -> None:
+        self._only().inc(amount)
+
+    def set(self, value: float) -> None:
+        self._only().set(value)
+
+    @property
+    def value(self) -> float:
+        return self._only().value
+
+    def _render_samples(self, full: str, lines: List[str]) -> None:
+        with self._lock:
+            items = sorted(self._values.items())
+        for key, value in items:
+            labels = _label_str(self.labelnames, key)
+            suffix = "{%s}" % labels if labels else ""
+            lines.append(f"{full}{suffix} {format_value(value)}")
+
+
+class GaugeFamily(CounterFamily):
+    kind = "gauge"
+    CHILD = GaugeChild
+
+    def dec(self, amount: float = 1.0) -> None:
+        self._only().dec(amount)
+
+
+class HistogramFamily(_Family):
+    kind = "histogram"
+    CHILD = HistogramChild
+
+    def __init__(self, name, help_text, labelnames, buckets):
+        buckets = tuple(sorted(float(b) for b in buckets))
+        if not buckets:
+            raise ValueError(f"{name}: histogram needs >= 1 bucket bound")
+        if buckets and buckets[-1] == _INF:
+            buckets = buckets[:-1]  # +Inf is implicit
+        if len(set(buckets)) != len(buckets):
+            raise ValueError(f"{name}: duplicate bucket bounds")
+        super().__init__(name, help_text, labelnames, buckets)
+
+    def _init_value(self, key):
+        # per-bucket (non-cumulative) counts incl. the +Inf overflow,
+        # plus [sum, count]
+        self._values[key] = ([0] * (len(self.buckets) + 1), [0.0, 0])
+
+    def observe(self, value: float) -> None:
+        self._only().observe(value)
+
+    @property
+    def count(self) -> int:
+        return self._only().count
+
+    @property
+    def sum(self) -> float:
+        return self._only().sum
+
+    def cumulative_buckets(self):
+        return self._only().cumulative_buckets()
+
+    def _render_samples(self, full: str, lines: List[str]) -> None:
+        with self._lock:
+            items = sorted(
+                (key, [list(v[0]), list(v[1])])
+                for key, v in self._values.items()
+            )
+        for key, (counts, stats) in items:
+            labels = _label_str(self.labelnames, key)
+            acc = 0
+            for le, c in zip(list(self.buckets) + [_INF], counts):
+                acc += c
+                le_label = f'le="{format_value(le)}"'
+                all_labels = f"{labels},{le_label}" if labels else le_label
+                lines.append(f"{full}_bucket{{{all_labels}}} {acc}")
+            suffix = "{%s}" % labels if labels else ""
+            lines.append(f"{full}_sum{suffix} {format_value(stats[0])}")
+            lines.append(f"{full}_count{suffix} {int(stats[1])}")
+
+
+class MetricRegistry:
+    """Families keyed by (unprefixed) name; render() emits the whole
+    exposition page with the registry prefix applied."""
+
+    def __init__(self, prefix: str = "") -> None:
+        self.prefix = prefix
+        self._lock = threading.Lock()
+        self._families: Dict[str, _Family] = {}
+
+    def full_name(self, name: str) -> str:
+        return f"{self.prefix}_{name}" if self.prefix else name
+
+    def _get_or_create(self, cls, name, help_text, labelnames, buckets=None):
+        labelnames = tuple(labelnames)
+        norm_buckets = None
+        if buckets is not None:
+            norm_buckets = tuple(
+                sorted(float(b) for b in buckets if float(b) != _INF)
+            )
+        with self._lock:
+            existing = self._families.get(name)
+            if existing is not None:
+                same = (
+                    type(existing) is cls
+                    and existing.labelnames == labelnames
+                    and (
+                        norm_buckets is None
+                        or existing.buckets == norm_buckets
+                    )
+                )
+                if not same:
+                    raise ValueError(
+                        f"metric {name!r} already registered as "
+                        f"{existing.kind}{existing.labelnames} and the "
+                        "new registration conflicts"
+                    )
+                return existing
+            if buckets is None:
+                family = cls(name, help_text, labelnames)
+            else:
+                family = cls(name, help_text, labelnames, buckets)
+            self._families[name] = family
+            return family
+
+    def counter(
+        self, name: str, help_text: str, labelnames: Sequence[str] = ()
+    ) -> CounterFamily:
+        return self._get_or_create(CounterFamily, name, help_text, labelnames)
+
+    def gauge(
+        self, name: str, help_text: str, labelnames: Sequence[str] = ()
+    ) -> GaugeFamily:
+        return self._get_or_create(GaugeFamily, name, help_text, labelnames)
+
+    def histogram(
+        self,
+        name: str,
+        help_text: str,
+        buckets: Sequence[float] = LATENCY_BUCKETS,
+        labelnames: Sequence[str] = (),
+    ) -> HistogramFamily:
+        return self._get_or_create(
+            HistogramFamily, name, help_text, labelnames, buckets
+        )
+
+    def get(self, name: str) -> Optional[_Family]:
+        with self._lock:
+            return self._families.get(name)
+
+    def families(self) -> List[_Family]:
+        with self._lock:
+            return list(self._families.values())
+
+    def render(self) -> str:
+        lines: List[str] = []
+        for family in self.families():
+            full = self.full_name(family.name)
+            lines.append(f"# HELP {full} {family.help}")
+            lines.append(f"# TYPE {full} {family.kind}")
+            family._render_samples(full, lines)
+        return "\n".join(lines) + "\n"
+
+
+def histogram_quantile(
+    q: float, buckets: Sequence[Tuple[float, float]]
+) -> Optional[float]:
+    """PromQL-style estimated quantile from cumulative (le, count)
+    pairs (ascending, ending +Inf). Linear interpolation inside the
+    target bucket; the +Inf bucket clamps to the last finite bound.
+    None when the histogram is empty."""
+    if not buckets:
+        return None
+    buckets = sorted((float(le), float(c)) for le, c in buckets)
+    total = buckets[-1][1]
+    if total <= 0:
+        return None
+    rank = q * total
+    prev_le, prev_count = 0.0, 0.0
+    for le, count in buckets:
+        if count >= rank:
+            if math.isinf(le):
+                return prev_le  # clamp like Prometheus
+            if count == prev_count:
+                return le
+            return prev_le + (le - prev_le) * (
+                (rank - prev_count) / (count - prev_count)
+            )
+        prev_le, prev_count = le, count
+    return buckets[-1][0]

@@ -193,6 +193,7 @@ class Trainer:
         packed: bool = False,
         checkpoint_dir: Optional[str] = None,
         accum_steps: int = 1,
+        metrics_registry=None,
     ) -> None:
         if accum_steps < 1:
             raise ValueError(f"accum_steps must be >= 1, got {accum_steps}")
@@ -214,6 +215,28 @@ class Trainer:
         self._eval_step = None
         self._multi_steps: Dict[int, Any] = {}
         self.state_shardings = None
+        # trainer-plane telemetry rides the shared registry
+        # (telemetry/registry.py): the step-time distribution and the
+        # derived token rate land next to whatever else the process
+        # exposes. Registration is get-or-create, so several Trainers
+        # in one process share the same families.
+        from ..telemetry import STEP_BUCKETS, default_registry
+
+        registry = (
+            metrics_registry if metrics_registry is not None
+            else default_registry()
+        )
+        self.metrics_registry = registry
+        self._h_step_seconds = registry.histogram(
+            "train_step_seconds",
+            "Wall-clock time per optimizer step (the first observation "
+            "per shape absorbs the jit compile)",
+            buckets=STEP_BUCKETS,
+        )
+        self._g_tokens_per_sec = registry.gauge(
+            "train_tokens_per_sec",
+            "Training token throughput over the last logging interval",
+        )
 
     # -- init --------------------------------------------------------------
 
@@ -579,7 +602,15 @@ class Trainer:
             for i in range(remaining):
                 profiler.before_step(i)
                 batch = self.place_batch(next(batches))
+                step_start = time.perf_counter()
                 state, metrics = self.step(state, batch)
+                # dispatch time, not device time: jax is async, so a
+                # step only blocks here once the device queue backs up
+                # — the distribution still shows compiles (first
+                # observation) and sustained-rate shifts
+                self._h_step_seconds.observe(
+                    time.perf_counter() - step_start
+                )
                 interval_steps += 1
                 profiler.after_step(
                     i,
@@ -626,6 +657,13 @@ class Trainer:
                     last_metrics["steps_per_sec"] = interval_steps / max(
                         now - interval_start, 1e-9
                     )
+                    ids = batch.get("input_ids")
+                    if ids is not None:
+                        # derived rate on the registry gauge only — the
+                        # metrics_callback dict keeps its historical keys
+                        self._g_tokens_per_sec.set(
+                            last_metrics["steps_per_sec"] * ids.size
+                        )
                     interval_start, interval_steps = now, 0
                     logger.info(
                         "step %d loss=%.4f (%.1f steps/s)",
