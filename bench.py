@@ -100,6 +100,23 @@ def _watchdog(seconds: float, what: str, likely: str):
             # after cancel() returns, fire can never print
             if cancelled[0]:
                 return
+            # black-box context for the post-mortem: where every thread
+            # was wedged, plus the recent flight-recorder tail — the
+            # tunnel hang leaves no other trace (telemetry/flight.py)
+            stacks = ""
+            flight_tail = []
+            try:
+                from tf_operator_tpu.telemetry.flight import (
+                    all_thread_stacks,
+                    default_flight,
+                )
+
+                stacks = all_thread_stacks()[-8000:]
+                flight_tail = [
+                    r.to_dict() for r in default_flight().snapshot(limit=80)
+                ]
+            except Exception:
+                pass  # diagnostics must never mask the timeout itself
             print(
                 json.dumps(
                     {
@@ -109,6 +126,8 @@ def _watchdog(seconds: float, what: str, likely: str):
                         "vs_baseline": 0.0,
                         "error": f"{what} did not finish within "
                         f"{seconds:.0f}s — {likely}",
+                        "thread_stacks": stacks,
+                        "flight": flight_tail,
                     }
                 ),
                 flush=True,

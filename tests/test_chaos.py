@@ -648,3 +648,44 @@ class TestFaultSchedule:
         for _ in range(50):
             chaos.list_jobs()
         assert len(chaos.fault_log) == 0
+
+
+class TestChaosFlightRecords:
+    def test_every_injection_lands_in_flight_with_seed_and_site(self):
+        """The black-box contract: each injected fault is a flight
+        record carrying the seed (replay pointer) and the substrate op
+        it fired at, so a postmortem timeline distinguishes injected
+        chaos from organic failures (telemetry/flight.py)."""
+        from tf_operator_tpu.telemetry.flight import (
+            FlightRecorder,
+            default_flight,
+            set_default_flight,
+        )
+
+        prev = default_flight()
+        flight = set_default_flight(FlightRecorder(capacity=512))
+        try:
+            inner = InMemorySubstrate()
+            config = ChaosConfig(
+                seed=11,
+                faults={
+                    FAULT_API_ERROR: FaultSpec(
+                        probability=1.0, max_count=4
+                    ),
+                },
+            )
+            chaos = ChaosSubstrate(inner, config)
+            for _ in range(10):
+                try:
+                    chaos.list_jobs()
+                except ApiError:
+                    pass
+            records = flight.snapshot(kind="chaos")
+            assert len(records) == len(chaos.fault_log) == 4
+            for record, logged in zip(records, chaos.fault_log.records()):
+                assert record.fields["seed"] == 11
+                assert record.fields["site"] == logged.op == "list_jobs"
+                assert record.fields["fault"] == FAULT_API_ERROR
+                assert record.fields["seq"] == logged.seq
+        finally:
+            set_default_flight(prev)

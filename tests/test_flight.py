@@ -1,0 +1,503 @@
+"""The flight recorder (tf_operator_tpu/telemetry/flight.py): ring
+semantics, correlation propagation end-to-end (controller -> events,
+serve server -> engine -> stream), crash/signal dump surfaces, the
+/debug/flightz page on both servers, the CLI, and the log-line join.
+"""
+
+import json
+import logging
+import os
+import signal
+import sys
+import threading
+import urllib.error
+import urllib.request
+
+import pytest
+
+from tf_operator_tpu.telemetry.flight import (
+    FlightRecorder,
+    all_thread_stacks,
+    correlate,
+    current_correlation,
+    default_flight,
+    flight_chrome_events,
+    install_crash_handlers,
+    render_flightz,
+    set_default_flight,
+)
+
+
+@pytest.fixture()
+def flight():
+    """Swap in an isolated process-default recorder for the test (the
+    integration points resolve default_flight() lazily)."""
+    prev = default_flight()
+    rec = set_default_flight(FlightRecorder(capacity=1024))
+    try:
+        yield rec
+    finally:
+        set_default_flight(prev)
+
+
+class TestRing:
+    def test_wraparound_keeps_newest(self):
+        rec = FlightRecorder(capacity=4)
+        for i in range(10):
+            rec.record("tick", i=i)
+        assert len(rec) == 4
+        assert rec.total_recorded == 10
+        records = rec.snapshot()
+        assert [r.fields["i"] for r in records] == [6, 7, 8, 9]
+        # seq keeps counting across overwrites (records are orderable
+        # even after the ring has lapped)
+        assert [r.seq for r in records] == [6, 7, 8, 9]
+
+    def test_snapshot_filters_and_limit(self):
+        rec = FlightRecorder(capacity=64)
+        with correlate("a"):
+            rec.record("x", i=0)
+            rec.record("y", i=1)
+        with correlate("b"):
+            rec.record("x", i=2)
+        assert [r.fields["i"] for r in rec.snapshot(kind="x")] == [0, 2]
+        assert [r.fields["i"] for r in rec.snapshot(corr="a")] == [0, 1]
+        assert [r.fields["i"] for r in rec.snapshot(limit=1)] == [2]
+        assert rec.snapshot(kind="x", corr="a")[0].fields["i"] == 0
+
+    def test_disabled_recorder_is_a_no_op(self):
+        rec = FlightRecorder(capacity=8, enabled=False)
+        assert rec.record("x", i=1) is None
+        assert len(rec) == 0
+        assert rec.to_jsonl() == ""
+        # dump still writes (an empty file), never raises
+        rec.enabled = True
+        assert rec.record("x", i=2) is not None
+
+    def test_jsonl_round_trips(self):
+        rec = FlightRecorder(capacity=8)
+        with correlate("c-1"):
+            rec.record("serve", op="admit", slot=0)
+        lines = rec.to_jsonl().splitlines()
+        assert len(lines) == 1
+        parsed = json.loads(lines[0])
+        assert parsed["kind"] == "serve"
+        assert parsed["corr"] == "c-1"
+        assert parsed["fields"] == {"op": "admit", "slot": 0}
+
+    def test_non_jsonable_fields_are_stringified(self):
+        rec = FlightRecorder(capacity=8)
+        rec.record("x", err=ValueError("boom"))
+        parsed = json.loads(rec.to_jsonl())
+        assert parsed["fields"]["err"] == "boom"
+
+
+class TestCorrelate:
+    def test_nesting_restores_previous(self):
+        assert current_correlation() is None
+        with correlate("outer"):
+            assert current_correlation() == "outer"
+            with correlate("inner"):
+                assert current_correlation() == "inner"
+            assert current_correlation() == "outer"
+            # None binds nothing: the active id survives
+            with correlate(None):
+                assert current_correlation() == "outer"
+        assert current_correlation() is None
+
+    def test_record_inherits_context_binding(self):
+        rec = FlightRecorder(capacity=8)
+        with correlate(12345):  # non-str ids are coerced
+            rec.record("x")
+        rec.record("y")
+        records = rec.snapshot()
+        assert records[0].corr == "12345"
+        assert records[1].corr is None
+
+    def test_explicit_corr_wins_over_context(self):
+        rec = FlightRecorder(capacity=8)
+        with correlate("ctx"):
+            rec.record("x", corr="explicit")
+        assert rec.snapshot()[0].corr == "explicit"
+
+    def test_span_begin_inherits_correlation(self):
+        from tf_operator_tpu.telemetry import SpanTracer
+
+        tracer = SpanTracer()
+        with correlate("corr-span"):
+            span = tracer.begin("work")
+        span.finish()
+        assert span.args["corr"] == "corr-span"
+        exported = tracer.export_chrome()["traceEvents"]
+        x = next(e for e in exported if e.get("ph") == "X")
+        assert x["args"]["corr"] == "corr-span"
+
+
+class TestCrashDumps:
+    def test_excepthook_dumps_ring_then_chains(self, flight, tmp_path, capsys):
+        flight.record("reconcile", op="sync", key="ns/j")
+        seen = []
+        prev_hook = sys.excepthook
+        stub = lambda *a: seen.append(a)  # noqa: E731
+        sys.excepthook = stub
+        try:
+            handles = install_crash_handlers(
+                directory=str(tmp_path), install_signal=False
+            )
+            try:
+                try:
+                    raise RuntimeError("boom")
+                except RuntimeError:
+                    sys.excepthook(*sys.exc_info())
+            finally:
+                handles.uninstall()
+            # uninstall restored the hook that was installed before
+            assert sys.excepthook is stub
+        finally:
+            sys.excepthook = prev_hook
+        assert len(handles.dumps) == 1
+        path = handles.dumps[0]
+        assert os.path.basename(path) == f"flight-crash-{os.getpid()}.jsonl"
+        records = [json.loads(l) for l in open(path) if l.strip()]
+        assert any(r["kind"] == "reconcile" for r in records)
+        # the previous hook still ran (the traceback is not swallowed)
+        assert len(seen) == 1 and seen[0][0] is RuntimeError
+
+    def test_sigusr2_dumps_snapshot_and_stacks(self, flight, tmp_path):
+        flight.record("serve", op="step", step=3)
+        handles = install_crash_handlers(
+            directory=str(tmp_path), install_excepthook=False
+        )
+        try:
+            os.kill(os.getpid(), signal.SIGUSR2)
+            # delivery is synchronous for a self-signal on the main
+            # thread, but give the handler a bounded grace anyway
+            deadline = threading.Event()
+            for _ in range(100):
+                if len(handles.dumps) >= 2:
+                    break
+                deadline.wait(0.05)
+        finally:
+            handles.uninstall()
+        names = sorted(os.path.basename(p) for p in handles.dumps)
+        assert names == [
+            f"flight-stacks-{os.getpid()}.txt",
+            f"flight-usr2-{os.getpid()}.jsonl",
+        ]
+        stacks = open(os.path.join(tmp_path, names[0])).read()
+        assert "thread" in stacks.lower() and "File" in stacks
+        records = [
+            json.loads(l)
+            for l in open(os.path.join(tmp_path, names[1]))
+            if l.strip()
+        ]
+        assert any(
+            r["kind"] == "serve" and r["fields"]["step"] == 3
+            for r in records
+        )
+
+    def test_all_thread_stacks(self):
+        out = all_thread_stacks()
+        assert "thread" in out.lower() and "File" in out
+
+
+class TestFlightz:
+    def _fill(self):
+        rec = FlightRecorder(capacity=64)
+        with correlate("uid-1"):
+            rec.record("reconcile", op="sync", key="ns/a", decision="ok")
+            rec.record("event", reason="Created", obj="ns/a")
+        with correlate("uid-2"):
+            rec.record("reconcile", op="sync", key="ns/b", decision="ok")
+        rec.record("workqueue", op="add", key="ns/a")
+        return rec
+
+    def _parse(self, body):
+        return [json.loads(l) for l in body.decode().splitlines() if l]
+
+    def test_corr_and_request_alias(self):
+        rec = self._fill()
+        for param in ("corr", "request"):
+            records = self._parse(render_flightz(rec, f"{param}=uid-1"))
+            assert len(records) == 2
+            assert all(r["corr"] == "uid-1" for r in records)
+
+    def test_kind_and_limit(self):
+        rec = self._fill()
+        records = self._parse(render_flightz(rec, "kind=reconcile"))
+        assert [r["fields"]["key"] for r in records] == ["ns/a", "ns/b"]
+        records = self._parse(render_flightz(rec, "kind=reconcile&limit=1"))
+        assert [r["fields"]["key"] for r in records] == ["ns/b"]
+
+    def test_job_filter_matches_corr_or_fields(self):
+        rec = self._fill()
+        by_corr = self._parse(render_flightz(rec, "job=uid-2"))
+        assert len(by_corr) == 1 and by_corr[0]["corr"] == "uid-2"
+        # key= (reconcile, workqueue) and obj= (event) fields all match
+        by_key = self._parse(render_flightz(rec, "job=ns/a"))
+        kinds = {r["kind"] for r in by_key}
+        assert kinds == {"reconcile", "workqueue", "event"}
+
+    def test_empty_result_is_empty_body(self):
+        rec = self._fill()
+        assert render_flightz(rec, "corr=nope") == b""
+        assert render_flightz(FlightRecorder(capacity=4), "") == b""
+
+    def test_monitoring_server_serves_and_gates_flightz(self):
+        from tf_operator_tpu.server.metrics import (
+            MonitoringServer,
+            OperatorMetrics,
+        )
+
+        rec = self._fill()
+        metrics = OperatorMetrics(flight=rec)
+        srv = MonitoringServer(
+            metrics, port=0, enable_debug=True, bind_addr="127.0.0.1"
+        )
+        port = srv.start()
+        try:
+            with urllib.request.urlopen(
+                f"http://127.0.0.1:{port}/debug/flightz?corr=uid-1",
+                timeout=30,
+            ) as resp:
+                assert resp.headers["Content-Type"] == (
+                    "application/x-ndjson"
+                )
+                records = self._parse(resp.read())
+            assert len(records) == 2
+            assert {r["corr"] for r in records} == {"uid-1"}
+        finally:
+            srv.stop()
+        # without --enable-debug-endpoints the page does not exist
+        srv = MonitoringServer(
+            OperatorMetrics(flight=rec), port=0, bind_addr="127.0.0.1"
+        )
+        port = srv.start()
+        try:
+            with pytest.raises(urllib.error.HTTPError) as err:
+                urllib.request.urlopen(
+                    f"http://127.0.0.1:{port}/debug/flightz", timeout=30
+                )
+            assert err.value.code == 404
+        finally:
+            srv.stop()
+
+
+class TestControllerCorrelation:
+    def test_job_uid_threads_reconcile_and_events(self, flight):
+        """The control-plane join: one job driven through the live
+        controller leaves reconcile decisions AND event emissions in
+        the ring, all carrying the job's UID as the correlation ID."""
+        from tf_operator_tpu.controller import TFJobController
+        from tf_operator_tpu.runtime import InMemorySubstrate
+
+        from tests.test_api import make_job
+
+        sub = InMemorySubstrate()
+        controller = TFJobController(sub)
+        job = make_job({"Worker": 1}, name="corrjob")
+        job.metadata.uid = "uid-flight-1"
+        sub.create_job(job)
+        controller.run_until_quiet()
+
+        by_corr = flight.snapshot(corr="uid-flight-1")
+        kinds = {r.kind for r in by_corr}
+        assert "reconcile" in kinds and "event" in kinds
+        decisions = {
+            r.fields.get("decision") for r in by_corr
+            if r.kind == "reconcile"
+        }
+        assert "admitted" in decisions and "reconciled" in decisions
+        # the workqueue transitions are in the ring too (not correlated:
+        # enqueue happens outside any job context)
+        wq = flight.snapshot(kind="workqueue")
+        assert {r.fields["op"] for r in wq} >= {"add", "done"}
+        assert any(
+            r.fields.get("outcome") == "success" for r in wq
+        )
+
+    def test_event_aggregation_rolls_up_but_flight_sees_all(self, flight):
+        """Satellite contract: repeated (kind,name,ns,reason) emissions
+        mutate ONE substrate event's count/timestamps in place, while
+        the flight ring keeps every emission."""
+        from tf_operator_tpu.runtime import InMemorySubstrate
+        from tf_operator_tpu.runtime.events import EventRecorder
+
+        sub = InMemorySubstrate()
+        recorder = EventRecorder(sub)
+        for i in range(4):
+            recorder.event(
+                "TFJob", "agg", "ns", "Warning", "FailedCreate",
+                f"attempt {i}",
+            )
+        recorder.event(
+            "TFJob", "agg", "ns", "Normal", "Created", "pod up"
+        )
+        events = sub.events_for("TFJob", "agg")
+        assert len(events) == 2
+        failed = next(e for e in events if e.reason == "FailedCreate")
+        assert failed.extra["count"] == 4
+        assert failed.extra["first_timestamp"] == failed.timestamp
+        assert "last_timestamp" in failed.extra
+        assert failed.extra["last_message"] == "attempt 3"
+        assert failed.message == "attempt 0"
+        created = next(e for e in events if e.reason == "Created")
+        assert created.extra["count"] == 1
+        # every emission is a flight record, rolled up nowhere
+        emitted = flight.snapshot(kind="event")
+        assert len(emitted) == 5
+        assert [
+            r.fields["message"] for r in emitted
+            if r.fields["reason"] == "FailedCreate"
+        ] == [f"attempt {i}" for i in range(4)]
+
+
+class TestLogJoin:
+    def test_json_log_lines_carry_correlation_and_span(self):
+        from tf_operator_tpu.telemetry import SpanTracer
+        from tf_operator_tpu.utils import JsonFieldFormatter
+
+        fmt = JsonFieldFormatter()
+        record = logging.LogRecord(
+            "t", logging.INFO, __file__, 1, "hello", (), None
+        )
+        tracer = SpanTracer()
+        with correlate("corr-log"):
+            with tracer.begin("sync-span") as span:
+                entry = json.loads(fmt.format(record))
+        assert entry["correlation"] == "corr-log"
+        assert entry["span"] == "sync-span"
+        assert entry["span_id"] == span.id
+        # outside any binding the keys are absent, not null
+        entry = json.loads(fmt.format(record))
+        assert "correlation" not in entry and "span" not in entry
+
+
+class TestCli:
+    def _dump(self, tmp_path, name="d.jsonl"):
+        rec = FlightRecorder(capacity=16)
+        with correlate("req-9"):
+            rec.record("serve", op="submit")
+            rec.record("serve", op="admit", slot=0)
+        rec.record("train", op="step-stats", step=50, loss=1.5)
+        path = tmp_path / name
+        path.write_text(rec.to_jsonl())
+        return str(path)
+
+    def test_timeline_merge_and_filters(self, tmp_path, capsys):
+        from tf_operator_tpu.telemetry.__main__ import main
+
+        path = self._dump(tmp_path)
+        assert main([path]) == 0
+        out = capsys.readouterr().out
+        assert "# 3 records, 1 correlation IDs, 1 dump(s)" in out
+        assert "[req-9]" in out and "op=step-stats" in out
+        assert main([path, "--corr", "req-9"]) == 0
+        out = capsys.readouterr().out
+        assert "# 2 records" in out and "train" not in out
+
+    def test_perfetto_export(self, tmp_path, capsys):
+        from tf_operator_tpu.telemetry.__main__ import main
+
+        path = self._dump(tmp_path)
+        trace_out = str(tmp_path / "flight-trace.json")
+        assert main([path, "--quiet", "--perfetto", trace_out]) == 0
+        events = json.loads(open(trace_out).read())["traceEvents"]
+        instants = [e for e in events if e.get("ph") == "i"]
+        assert {e["name"] for e in instants} == {
+            "serve:submit", "serve:admit", "train:step-stats",
+        }
+        # one named track per correlation ID
+        metas = [e for e in events if e.get("ph") == "M"]
+        assert any(
+            e["args"]["name"] == "flight:req-9" for e in metas
+        )
+        corr_tid = next(
+            e["tid"] for e in metas if e["args"]["name"] == "flight:req-9"
+        )
+        assert all(
+            e["tid"] == corr_tid for e in instants
+            if e["args"].get("corr") == "req-9"
+        )
+
+    def test_bad_dump_is_a_named_error(self, tmp_path, capsys):
+        from tf_operator_tpu.telemetry.__main__ import main
+
+        bad = tmp_path / "bad.jsonl"
+        bad.write_text('{"kind": "x"}\nnot json\n')
+        assert main([str(bad)]) == 1
+        err = capsys.readouterr().err
+        assert "bad.jsonl:2" in err
+
+    def test_chrome_events_accept_records_and_dicts(self):
+        rec = FlightRecorder(capacity=4)
+        r = rec.record("x", op="a")
+        assert flight_chrome_events([r])[-1]["name"] == "x:a"
+        assert flight_chrome_events([r.to_dict()])[-1]["name"] == "x:a"
+
+
+class TestServeCorrelation:
+    """The serve-plane join: request ID minted at the HTTP edge rides
+    the engine slot lifecycle and comes back on the stream."""
+
+    def test_request_id_threads_server_engine_stream(self, flight):
+        import jax
+        import jax.numpy as jnp
+
+        from tf_operator_tpu.models import gpt as gpt_lib
+        from tf_operator_tpu.serve import make_server
+        from tf_operator_tpu.serve.client import DecodeClient
+
+        cfg = gpt_lib.GPT_TINY
+        params = gpt_lib.GPT(cfg).init(
+            jax.random.PRNGKey(0), jnp.zeros((1, 8), jnp.int32)
+        )["params"]
+        srv = make_server(
+            cfg, params, model_name="gpt-test", max_new_cap=64,
+            batching="continuous", n_slots=2,
+        )
+        thread = threading.Thread(target=srv.serve_forever, daemon=True)
+        thread.start()
+        try:
+            port = srv.server_address[1]
+            client = DecodeClient(f"http://127.0.0.1:{port}", timeout=120)
+            events = list(
+                client.generate_stream([1, 2, 3], max_new_tokens=4)
+            )
+            done = events[-1]
+            assert done["done"] is True
+            request_id = done["request_id"]
+            assert request_id and request_id.startswith("req-")
+
+            records = client.flightz(request=request_id)
+            assert records, "no correlated flight records for the request"
+            assert all(r["corr"] == request_id for r in records)
+            ops = [r["fields"].get("op") for r in records]
+            assert ops[0] == "request"
+            assert {"submit", "admit", "evict"} <= set(ops)
+            evict = next(
+                r for r in records if r["fields"].get("op") == "evict"
+            )
+            assert evict["fields"]["outcome"] == "finished"
+            # uncorrelated engine step records are in the full page
+            kinds_ops = {
+                (r["kind"], r["fields"].get("op"))
+                for r in client.flightz()
+            }
+            assert ("serve", "step") in kinds_ops
+            # kind/limit filters apply server-side
+            assert all(
+                r["kind"] == "serve" for r in client.flightz(kind="serve")
+            )
+            assert len(client.flightz(limit=2)) == 2
+            # the span for this request shares the correlation ID
+            trace = client.trace()
+            span = next(
+                e for e in trace["traceEvents"]
+                if e.get("ph") == "X"
+                and e.get("args", {}).get("corr") == request_id
+            )
+            assert span["name"] == "serve-request"
+        finally:
+            srv.shutdown()
+            srv.state.engine.stop()

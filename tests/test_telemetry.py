@@ -173,7 +173,11 @@ class TestSpanTracer:
         assert trace["traceEvents"][0]["ph"] == "M"
         (x,) = [e for e in trace["traceEvents"] if e["ph"] == "X"]
         assert x["ts"] == 0.0 and x["dur"] == 1_000_000.0
-        assert x["args"] == {"prompt_tokens": 7, "outcome": "finished"}
+        assert x["args"] == {
+            "span_id": span.id,
+            "prompt_tokens": 7,
+            "outcome": "finished",
+        }
         instants = [e for e in trace["traceEvents"] if e["ph"] == "i"]
         assert [(e["name"], e["ts"]) for e in instants] == [
             ("admitted", 500_000.0)

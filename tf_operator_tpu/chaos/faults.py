@@ -12,6 +12,8 @@ import dataclasses
 import threading
 from typing import Dict, List, Optional, Tuple
 
+from ..telemetry.flight import FlightRecorder, default_flight
+
 # -- fault kinds ------------------------------------------------------------
 
 FAULT_API_ERROR = "api_error"     # transient 429/500/410 raised pre-op
@@ -87,17 +89,35 @@ class FaultRecord:
 
 class FaultLog:
     """Ordered record of every injected fault, for post-soak
-    assertions ("did ≥3 kinds actually fire?") and failure replay."""
+    assertions ("did ≥3 kinds actually fire?") and failure replay.
 
-    def __init__(self) -> None:
+    Each append also lands in the flight recorder (kind "chaos", with
+    the seed and injection site), so a postmortem timeline
+    distinguishes injected faults from organic ones."""
+
+    def __init__(
+        self,
+        flight: Optional[FlightRecorder] = None,
+        seed: Optional[int] = None,
+    ) -> None:
         self._lock = threading.Lock()
         self._records: List[FaultRecord] = []
+        self._flight = flight
+        self.seed = seed
 
     def append(self, op: str, kind: str, detail: str = "") -> FaultRecord:
         with self._lock:
             record = FaultRecord(len(self._records), op, kind, detail)
             self._records.append(record)
-            return record
+        (self._flight or default_flight()).record(
+            "chaos",
+            fault=kind,
+            site=op,
+            detail=detail,
+            seed=self.seed,
+            seq=record.seq,
+        )
+        return record
 
     def records(self) -> List[FaultRecord]:
         with self._lock:

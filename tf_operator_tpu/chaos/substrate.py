@@ -71,13 +71,14 @@ class ChaosSubstrate:
         inner,
         config: Optional[ChaosConfig] = None,
         metrics=None,
+        flight=None,
     ) -> None:
         import random
 
         self.inner = inner
         self.config = config or ChaosConfig()
         self.metrics = metrics
-        self.fault_log = FaultLog()
+        self.fault_log = FaultLog(flight=flight, seed=self.config.seed)
         self.rng = random.Random(self.config.seed)
         self._lock = threading.RLock()
         self._counts: Dict[str, int] = {}

@@ -30,6 +30,7 @@ from ..runtime import (
     RealServiceControl,
 )
 from ..runtime.retry import is_transient_error
+from ..telemetry.flight import correlate, flight_record
 from .clock import Clock
 from .degraded import DegradedLatch
 from .reconciler import (
@@ -195,17 +196,27 @@ class TFJobController:
     def _admit(self, job: TFJob) -> None:
         """Admission-time work (reference addTFJob, job.go:35-144):
         default, validate (invalid jobs are marked Failed, not crashed
-        on), allocate hostNetwork ports, stamp Created, enqueue."""
+        on), allocate hostNetwork ports, stamp Created, enqueue.
+        Runs under the job's correlation ID (its UID), so the flight
+        records, events, spans, and log lines it produces all join."""
+        with correlate(job.metadata.uid or job.key()):
+            self._admit_correlated(job)
+
+    def _admit_correlated(self, job: TFJob) -> None:
         job = job.copy()
         set_defaults(job)
         # the lifecycle span opens at first observation; later phases
         # (pods-created, running, terminal) annotate it from the
         # reconciler and sync (idempotent per phase)
-        self._telemetry("job_observed", job.key())
+        self._telemetry("job_observed", job.key(), job.metadata.uid)
         try:
             validate(job)
         except ValidationError as err:
             logger_for_job(job, logger).warning("failed validation: %s", err)
+            flight_record(
+                "reconcile", op="admit", key=job.key(),
+                decision="failed-validation", error=str(err),
+            )
             self.recorder.event(
                 job.kind, job.name, job.namespace, "Warning",
                 REASON_FAILED_VALIDATION, str(err),
@@ -233,6 +244,11 @@ class TFJobController:
                     "port allocation failed: %s; retrying", err
                 )
                 key = job.key()
+                flight_record(
+                    "reconcile", op="admit", key=key,
+                    decision="ports-exhausted",
+                    retry_seconds=ADMIT_RETRY_SECONDS,
+                )
                 if key not in self._port_wait:
                     self._port_wait.add(key)
                     self.recorder.event(
@@ -257,6 +273,9 @@ class TFJobController:
             f"TFJob {job.name} is created.", self.clock.now_iso(),
         )
         self._update_status(job)
+        flight_record(
+            "reconcile", op="admit", key=job.key(), decision="admitted",
+        )
         if self.metrics is not None:
             self.metrics.created()
         self.enqueue(job.key())
@@ -309,6 +328,7 @@ class TFJobController:
         self.enqueue(job_key)
 
     def enqueue(self, key: str) -> None:
+        flight_record("workqueue", op="add", key=key)
         self.queue.add(key)
 
     # -- sync --------------------------------------------------------------
@@ -327,7 +347,10 @@ class TFJobController:
         return True
 
     def sync(self, key: str) -> None:
-        """Process one key (reference syncTFJob, controller.go:299-343)."""
+        """Process one key (reference syncTFJob, controller.go:299-343).
+        Everything after the job fetch runs under the job's correlation
+        ID (its UID), so every flight record, event, span, and JSON log
+        line one reconcile pass emits joins on one key."""
         try:
             namespace, name = key.split("/", 1)
         except ValueError:
@@ -338,7 +361,13 @@ class TFJobController:
         except NotFound:
             self.expectations.delete_expectations(key)
             self._port_wait.discard(key)
+            flight_record("reconcile", op="sync", key=key, decision="gone")
             return
+        with correlate(job.metadata.uid or key):
+            self._sync_job(key, job)
+
+    def _sync_job(self, key: str, job: TFJob) -> None:
+        namespace, name = job.namespace, job.name
         set_defaults(job)
 
         if job.metadata.deletion_timestamp is not None:
@@ -346,6 +375,9 @@ class TFJobController:
             # deleted (finalizer holding it) must never be admitted or
             # allocated ports — a doomed job could consume the range's
             # last free ports and starve live jobs
+            flight_record(
+                "reconcile", op="sync", key=key, decision="pending-deletion",
+            )
             return
 
         if not job.status.conditions:
@@ -361,12 +393,20 @@ class TFJobController:
             # substrate answers, which process_next feeds into the
             # latch's recovery count. Reconciling now would churn pods
             # against an apiserver we just watched fail repeatedly.
+            flight_record(
+                "reconcile", op="sync", key=key, decision="degraded-paused",
+                probe_interval=self.degraded.probe_interval,
+            )
             self._mark_degraded(job)
             self.queue.add_after(key, self.degraded.probe_interval)
             return
 
         needs_sync = job.spec.enable_dynamic_worker or self._satisfied_expectations(job)
         if not needs_sync:
+            flight_record(
+                "reconcile", op="sync", key=key,
+                decision="expectations-pending",
+            )
             return
 
         old_status = to_jsonable(job.status)
@@ -390,7 +430,13 @@ class TFJobController:
         pods = self.substrate.list_pods(namespace, gen_labels(name))
         services = self.substrate.list_services(namespace, gen_labels(name))
         self.reconciler.reconcile(job, pods, services)
-        if to_jsonable(job.status) != old_status:
+        status_changed = to_jsonable(job.status) != old_status
+        flight_record(
+            "reconcile", op="sync", key=key, decision="reconciled",
+            pods=len(pods), services=len(services),
+            status_changed=status_changed,
+        )
+        if status_changed:
             self._update_status(job)
         if job.has_condition(ConditionType.RUNNING):
             self._telemetry("job_phase", key, "running")
@@ -517,8 +563,11 @@ class TFJobController:
             # worker; the key retries with backoff while other keys
             # keep syncing
             logger.exception("error syncing %r; requeueing", key)
-            self._telemetry(
-                "observe_reconcile", time.monotonic() - started, "error"
+            elapsed = time.monotonic() - started
+            self._telemetry("observe_reconcile", elapsed, "error")
+            flight_record(
+                "workqueue", op="done", key=key, outcome="error",
+                seconds=round(elapsed, 6), error=type(err).__name__,
             )
             if self.metrics is not None:
                 self.metrics.reconcile_panic()
@@ -526,8 +575,11 @@ class TFJobController:
                 self.degraded.record_error()
             self.queue.add_rate_limited(key)
         else:
-            self._telemetry(
-                "observe_reconcile", time.monotonic() - started, "success"
+            elapsed = time.monotonic() - started
+            self._telemetry("observe_reconcile", elapsed, "success")
+            flight_record(
+                "workqueue", op="done", key=key, outcome="success",
+                seconds=round(elapsed, 6),
             )
             self.degraded.record_success()
             self.queue.forget(key)

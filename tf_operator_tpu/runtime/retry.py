@@ -32,6 +32,8 @@ import time
 import urllib.error
 from typing import Callable, Iterator, Optional
 
+from ..telemetry.flight import flight_record
+
 logger = logging.getLogger("tf_operator_tpu.retry")
 
 # 429 Too Many Requests + the 5xx gateway/overload class. 501 Not
@@ -116,6 +118,13 @@ def call_with_retries(
             attempt += 1
             if on_retry is not None:
                 on_retry(name, attempt, err)
+            # black-box breadcrumb: a retry storm shows up in the
+            # flight timeline with the op and the correlated job (when
+            # a reconcile pass is the caller)
+            flight_record(
+                "retry", op=name, attempt=attempt,
+                error=type(err).__name__, delay=round(delay, 6),
+            )
             logger.warning(
                 "%s: transient error (%s); retry %d/%d in %.3fs",
                 name, err, attempt, policy.max_attempts - 1, delay,

@@ -160,3 +160,28 @@ class DecodeClient:
         request spans (queued -> admitted -> first-token -> finished);
         load it in ui.perfetto.dev as-is."""
         return json.loads(self._request("/debug/trace"))
+
+    def flightz(
+        self,
+        request: Optional[str] = None,
+        kind: Optional[str] = None,
+        limit: Optional[int] = None,
+    ) -> List[dict]:
+        """Parsed flight-recorder records from /debug/flightz, newest
+        last. request filters on the correlation ID the server echoes
+        as "request_id" (so a client can pull exactly its own
+        admit/evict/step records); kind/limit filter server-side."""
+        from urllib.parse import urlencode
+
+        params = {}
+        if request is not None:
+            params["request"] = request
+        if kind is not None:
+            params["kind"] = kind
+        if limit is not None:
+            params["limit"] = str(limit)
+        path = "/debug/flightz"
+        if params:
+            path += "?" + urlencode(params)
+        raw = self._request(path).decode()
+        return [json.loads(line) for line in raw.splitlines() if line]

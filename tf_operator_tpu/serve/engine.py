@@ -42,6 +42,8 @@ import time
 
 import numpy as np
 
+from ..telemetry.flight import current_correlation, default_flight
+
 _DONE = object()
 
 # HELP text for the flat metrics() families below, consumed by the
@@ -75,12 +77,16 @@ class EngineRequest:
     __slots__ = (
         "prompt", "new", "tokens", "error", "done", "cancelled",
         "created", "first_token_at", "admitted_at", "last_token_at",
-        "span", "_stream",
+        "span", "corr", "_stream",
     )
 
-    def __init__(self, prompt, new: int):
+    def __init__(self, prompt, new: int, corr=None):
         self.prompt = [int(t) for t in prompt]
         self.new = int(new)
+        # correlation ID (the server's request id): carried from the
+        # HTTP thread into the engine thread, so slot-side flight
+        # records join the request's server-side records and span
+        self.corr = corr
         self.tokens: list = []  # generated tokens, appended live
         self.error = None
         self.done = threading.Event()
@@ -165,6 +171,7 @@ class ContinuousBatchingEngine:
         start: bool = True,
         registry=None,
         tracer=None,
+        flight=None,
     ):
         from ..models import gpt as gpt_lib
 
@@ -204,6 +211,9 @@ class ContinuousBatchingEngine:
         # the queued mark), and the registry children are internally
         # locked, so no new synchronization rides the hot path.
         self._tracer = tracer
+        # resolved per call (self._flight or default_flight()) so a
+        # test swapping the default after construction still captures
+        self._flight = flight
         self._h_ttft = self._h_itl = self._h_queue_wait = None
         self._h_batch = None
         if registry is not None:
@@ -248,9 +258,11 @@ class ContinuousBatchingEngine:
 
     # -- client API --------------------------------------------------------
 
-    def submit(self, prompt, new: int) -> EngineRequest:
+    def submit(self, prompt, new: int, corr=None) -> EngineRequest:
         """Queue one decode stream; -> its handle (stream()/result()).
-        prompt: one row of token ids."""
+        prompt: one row of token ids. corr: correlation ID tying the
+        slot's flight records to the submitting request (defaults to
+        the context's correlate() binding — the server's request id)."""
         if self._stop.is_set() or (
             self.thread is not None and not self.thread.is_alive()
         ):
@@ -265,12 +277,19 @@ class ContinuousBatchingEngine:
                 f"prompt {len(row)} + new {new} exceeds the engine's "
                 f"max_total {self.max_total}"
             )
-        req = EngineRequest(row, new)
+        if corr is None:
+            corr = current_correlation()
+        req = EngineRequest(row, new, corr=corr)
         if self._tracer is not None:
-            req.span = self._tracer.begin(
-                "serve-request", prompt_tokens=len(row), max_new_tokens=new,
-            )
+            span_args = {"prompt_tokens": len(row), "max_new_tokens": new}
+            if corr is not None:
+                span_args["corr"] = corr
+            req.span = self._tracer.begin("serve-request", **span_args)
             req.span.annotate("queued")
+        (self._flight or default_flight()).record(
+            "serve", corr=corr, op="submit",
+            prompt_tokens=len(row), new=new,
+        )
         self._queue.put(req)
         return req
 
@@ -368,6 +387,10 @@ class ContinuousBatchingEngine:
             self.cancelled += 1
             if req.span is not None:
                 req.span.finish(outcome="cancelled")
+            (self._flight or default_flight()).record(
+                "serve", corr=req.corr, op="evict",
+                outcome="cancelled-before-admission",
+            )
             req._finish(DecodeCancelled("cancelled before admission"))
             return
         req.admitted_at = time.monotonic()
@@ -375,6 +398,10 @@ class ContinuousBatchingEngine:
             self._h_queue_wait.observe(req.admitted_at - req.created)
         if req.span is not None:
             req.span.annotate("admitted")
+        (self._flight or default_flight()).record(
+            "serve", corr=req.corr, op="admit", slot=self._free[0],
+            queue_wait=round(req.admitted_at - req.created, 6),
+        )
         slot = self._free.pop(0)
         self._reqs[slot] = req
         n = len(req.prompt)
@@ -402,6 +429,12 @@ class ContinuousBatchingEngine:
         self._index[slot] = 0
         self._lens[slot] = 1
         if req is not None:
+            if error is None:
+                outcome = "finished"
+            elif isinstance(error, DecodeCancelled):
+                outcome = "cancelled"
+            else:
+                outcome = "error"
             if req.span is not None:
                 if error is None:
                     req.span.annotate("finished")
@@ -412,6 +445,10 @@ class ContinuousBatchingEngine:
                     req.span.finish(
                         outcome="error", error=type(error).__name__
                     )
+            (self._flight or default_flight()).record(
+                "serve", corr=req.corr, op="evict", slot=slot,
+                outcome=outcome, tokens=len(req.tokens),
+            )
             req._finish(error)
 
     def _step_once(self) -> None:
@@ -426,6 +463,10 @@ class ContinuousBatchingEngine:
             # the donated cache's state is unknown after a failed step;
             # rebuild it and fail every in-flight request as JSON-able
             # errors (a dead engine would hang all later requests)
+            (self._flight or default_flight()).record(
+                "serve", op="step-error", error=type(err).__name__,
+                slots=self.active_slots,
+            )
             self._cache = self.step.init_cache()
             for slot, req in enumerate(self._reqs):
                 if req is not None:
@@ -436,6 +477,12 @@ class ContinuousBatchingEngine:
         self.row_steps += self.active_slots
         if self._h_batch is not None:
             self._h_batch.observe(self.active_slots)
+        # the per-step breadcrumb: the slot grid's occupancy over time
+        # IS the engine's narrative (one ring slot per step, no
+        # allocation beyond the record tuple — SERVE_BENCH stays flat)
+        (self._flight or default_flight()).record(
+            "serve", op="step", step=self.steps, slots=self.active_slots,
+        )
         now = time.monotonic()
         for slot, req in enumerate(self._reqs):
             if req is None:

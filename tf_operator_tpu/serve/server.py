@@ -55,6 +55,7 @@ random weights and says so loudly (smoke/demo mode).
 from __future__ import annotations
 
 import argparse
+import itertools
 import json
 import logging
 import os
@@ -62,9 +63,16 @@ import sys
 import threading
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 
+from ..telemetry.flight import correlate, default_flight, render_flightz
 from . import export as export_mod
 
 logger = logging.getLogger("tf_operator_tpu.serve")
+
+# request correlation IDs: every POST gets req-N, bound for the whole
+# handler (correlate()), threaded into the engine slot and its stream,
+# echoed back as "request_id" so a client can pull its own records
+# from /debug/flightz?request=req-N
+_REQ_IDS = itertools.count(1)
 
 MAX_BATCH = 64
 # the ngram passed to generate_speculative AND the eligibility floor in
@@ -421,7 +429,13 @@ def DecodeHandlerFactory(state: _State):
         # the idle keep-alive timeout (ADVICE r4)
         body_timeout = 60
 
+        # per-connection state: the correlation ID of the POST being
+        # handled (None outside one; keep-alive reuses the instance)
+        _request_corr = None
+
         def _reply(self, code: int, payload: dict) -> None:
+            if self._request_corr is not None:
+                payload.setdefault("request_id", self._request_corr)
             body = json.dumps(payload).encode()
             self.send_response(code)
             self.send_header("Content-Type", "application/json")
@@ -430,6 +444,7 @@ def DecodeHandlerFactory(state: _State):
             self.wfile.write(body)
 
         def do_GET(self) -> None:  # noqa: N802
+            self._request_corr = None
             if self.path == "/healthz":
                 self._reply(200, {
                     "status": "ok",
@@ -458,6 +473,20 @@ def DecodeHandlerFactory(state: _State):
                 self.send_header("Content-Length", str(len(body)))
                 self.end_headers()
                 self.wfile.write(body)
+            elif self.path.partition("?")[0] == "/debug/flightz":
+                # JSONL flight-recorder dump; ?request=req-N (alias
+                # ?corr=) / ?kind= / ?limit= filter. Like /debug/trace
+                # it holds request shapes, not payloads, so no flag.
+                # Resolved per request so a recorder swapped in later
+                # (tests, embedders) is the one served.
+                body = render_flightz(
+                    default_flight(), self.path.partition("?")[2]
+                )
+                self.send_response(200)
+                self.send_header("Content-Type", "application/x-ndjson")
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
             else:
                 self._reply(404, {"error": f"no route {self.path}"})
 
@@ -480,6 +509,21 @@ def DecodeHandlerFactory(state: _State):
             self.wfile.flush()
 
         def do_POST(self) -> None:  # noqa: N802
+            # one correlation ID per request, bound for the whole
+            # handler: the engine slot, its span, its flight records,
+            # and any log line emitted while decoding all join on it
+            corr = f"req-{next(_REQ_IDS)}"
+            self._request_corr = corr
+            try:
+                with correlate(corr):
+                    default_flight().record(
+                        "serve", op="request", path=self.path,
+                    )
+                    self._handle_post()
+            finally:
+                self._request_corr = None
+
+        def _handle_post(self) -> None:
             if self.path not in ("/generate", "/generate_stream"):
                 return self._reply(404, {"error": f"no route {self.path}"})
             try:
@@ -677,6 +721,7 @@ def DecodeHandlerFactory(state: _State):
                         "done": True,
                         "tokens": [req.prompt + req.tokens],
                         "prompt_lens": lens,
+                        "request_id": self._request_corr,
                     })
                     self._end_stream()
                 except (BrokenPipeError, ConnectionError) as err:
@@ -742,6 +787,7 @@ def DecodeHandlerFactory(state: _State):
                 self._stream_event({
                     "done": True, "tokens": [chain],
                     "prompt_lens": lens,
+                    "request_id": self._request_corr,
                 })
                 self._end_stream()
             except (BrokenPipeError, ConnectionError):
@@ -919,13 +965,19 @@ def make_server(
 
 
 def _smoke() -> int:
-    """Telemetry smoke (ci/presubmit.yaml telemetry-smoke): boot a
-    tiny continuous-batching server, drive one streaming and one batch
-    request, then assert the telemetry contract end to end — /metrics
-    parses as valid exposition text with a nonzero TTFT histogram, and
-    /debug/trace holds >= 1 complete serve-request span carrying its
-    queued/admitted/first-token marks. Prints a JSON report; exit 1 on
-    any violated assertion."""
+    """Telemetry smoke (ci/presubmit.yaml telemetry-smoke +
+    flightz-smoke): boot a tiny continuous-batching server, drive one
+    streaming and one batch request, then assert the telemetry
+    contract end to end — /metrics parses as valid exposition text
+    with a nonzero TTFT histogram, /debug/trace holds >= 1 complete
+    serve-request span carrying its queued/admitted/first-token marks,
+    and /debug/flightz serves parseable JSONL whose ?request= filter
+    returns the streamed request's correlated submit/admit/evict
+    records (the request_id echoed on its done event). The dump is
+    also round-tripped through the `python -m tf_operator_tpu.telemetry`
+    CLI. Prints a JSON report; exit 1 on any violated assertion."""
+    import tempfile
+
     import jax
     import jax.numpy as jnp
 
@@ -947,10 +999,13 @@ def _smoke() -> int:
         client = DecodeClient(
             f"http://127.0.0.1:{server.server_address[1]}", timeout=120.0,
         )
-        streamed = sum(
-            1 for event in client.generate_stream([1, 2, 3], max_new_tokens=8)
-            if "token" in event
-        )
+        streamed = 0
+        stream_request_id = None
+        for event in client.generate_stream([1, 2, 3], max_new_tokens=8):
+            if "token" in event:
+                streamed += 1
+            if event.get("done"):
+                stream_request_id = event.get("request_id")
         chains = client.generate([[5, 6], [7, 8, 9]], max_new_tokens=4)
         text = client.metrics_text()
         try:
@@ -972,6 +1027,29 @@ def _smoke() -> int:
             event.get("name") for event in trace.get("traceEvents", [])
             if event.get("ph") == "i"
         }
+        # flight recorder: the full dump parses, and the streamed
+        # request's id pulls its own correlated slot records
+        flight_all = client.flightz()
+        flight_req = (
+            client.flightz(request=stream_request_id)
+            if stream_request_id else []
+        )
+        flight_ops = {r["fields"].get("op") for r in flight_req}
+        span_corrs = {
+            e.get("args", {}).get("corr") for e in trace["traceEvents"]
+            if e.get("ph") == "X"
+        }
+        with tempfile.NamedTemporaryFile(
+            "w", suffix=".jsonl", delete=False
+        ) as f:
+            f.write(
+                "\n".join(json.dumps(r) for r in flight_all) + "\n"
+            )
+            dump_path = f.name
+        from ..telemetry.__main__ import main as flight_cli
+
+        cli_rc = flight_cli([dump_path, "--quiet",
+                             "--perfetto", dump_path + ".trace.json"])
     finally:
         server.shutdown()
         server.server_close()
@@ -984,6 +1062,10 @@ def _smoke() -> int:
         "ttft_count": ttft_count,
         "complete_spans": len(spans),
         "span_marks": sorted(m for m in marks if m),
+        "stream_request_id": stream_request_id,
+        "flight_records": len(flight_all),
+        "flight_request_ops": sorted(o for o in flight_ops if o),
+        "flight_cli_rc": cli_rc,
         "ok": (
             streamed == 8
             and len(chains) == 2
@@ -991,6 +1073,13 @@ def _smoke() -> int:
             and ttft_count >= 3  # 1 streamed + 2 batch rows
             and len(spans) >= 1
             and {"queued", "admitted", "first-token"} <= marks
+            and stream_request_id is not None
+            and len(flight_all) > 0
+            # the streamed request's lifecycle, correlated end to end
+            and {"request", "submit", "admit", "evict"} <= flight_ops
+            # the trace's span args share the flight correlation ID
+            and stream_request_id in span_corrs
+            and cli_rc == 0
         ),
     }
     print(json.dumps(report, indent=1))

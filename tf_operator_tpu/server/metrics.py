@@ -25,8 +25,11 @@ from typing import Dict, Optional, Tuple
 from ..telemetry import (
     LATENCY_BUCKETS,
     WORKQUEUE_BUCKETS,
+    FlightRecorder,
     MetricRegistry,
     SpanTracer,
+    default_flight,
+    render_flightz,
 )
 
 _COUNTER_HELP = {
@@ -106,10 +109,14 @@ class OperatorMetrics:
         prefix: str = "tf_operator_tpu",
         registry: Optional[MetricRegistry] = None,
         tracer: Optional[SpanTracer] = None,
+        flight: Optional[FlightRecorder] = None,
     ) -> None:
         self.prefix = prefix
         self.registry = registry or MetricRegistry(prefix)
         self.tracer = tracer or SpanTracer(process_name="tfjob-operator")
+        # the black box /debug/flightz serves; the process default
+        # unless an embedder isolates one
+        self.flight = flight or default_flight()
         self._counters = {
             name: self.registry.counter(name, help_text)
             for name, help_text in _COUNTER_HELP.items()
@@ -178,11 +185,16 @@ class OperatorMetrics:
 
     # -- job-lifecycle spans -----------------------------------------------
 
-    def job_observed(self, key: str) -> None:
+    def job_observed(self, key: str, uid: Optional[str] = None) -> None:
         with self._span_lock:
             if key in self._job_spans:
                 return
-            span = self.tracer.begin("tfjob", job=key)
+            # corr = job UID: the span joins the job's flight records,
+            # events, and log lines on the same key
+            if uid:
+                span = self.tracer.begin("tfjob", job=key, corr=uid)
+            else:
+                span = self.tracer.begin("tfjob", job=key)
             self._job_spans[key] = span
         span.annotate("observed")
 
@@ -291,7 +303,16 @@ class MonitoringServer:
 
         class Handler(BaseHTTPRequestHandler):
             def do_GET(self) -> None:  # noqa: N802
-                if self.path == "/metrics":
+                path, _, query = self.path.partition("?")
+                if path == "/debug/flightz" and server.enable_debug:
+                    # JSONL black-box dump; ?corr= / ?job= / ?kind= /
+                    # ?limit= filter (telemetry/flight.py render_flightz)
+                    body = render_flightz(metrics.flight, query)
+                    self.send_response(200)
+                    self.send_header(
+                        "Content-Type", "application/x-ndjson"
+                    )
+                elif self.path == "/metrics":
                     body = metrics.render().encode()
                     self.send_response(200)
                     self.send_header("Content-Type", "text/plain; version=0.0.4")

@@ -177,6 +177,11 @@ def main(argv=None) -> int:
     options = parse_args(argv)
     setup_logging(options.json_log_format)
     logger.info(version_info())
+    # black-box dumps: unhandled crash -> flight JSONL via excepthook;
+    # SIGUSR2 -> live snapshot + all-thread stacks (telemetry/flight.py)
+    from ..telemetry import install_crash_handlers
+
+    install_crash_handlers()
     server = OperatorServer(options)
     signal.signal(signal.SIGTERM, server.shutdown)
     signal.signal(signal.SIGINT, server.shutdown)

@@ -44,12 +44,33 @@ from .registry import (
     format_value,
     histogram_quantile,
 )
-from .tracing import Span, SpanTracer
+from .flight import (
+    FlightRecord,
+    FlightRecorder,
+    correlate,
+    current_correlation,
+    default_flight,
+    flight_record,
+    install_crash_handlers,
+    render_flightz,
+    set_default_flight,
+)
+from .tracing import Span, SpanTracer, current_span
 
 __all__ = [
     "MetricRegistry",
     "SpanTracer",
     "Span",
+    "current_span",
+    "FlightRecorder",
+    "FlightRecord",
+    "correlate",
+    "current_correlation",
+    "default_flight",
+    "set_default_flight",
+    "flight_record",
+    "install_crash_handlers",
+    "render_flightz",
     "format_value",
     "histogram_quantile",
     "parse_text",

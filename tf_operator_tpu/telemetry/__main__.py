@@ -1,0 +1,141 @@
+"""Flight-dump inspector: `python -m tf_operator_tpu.telemetry`.
+
+Takes one or more JSONL flight dumps (from /debug/flightz, a crash
+dump, or a SIGUSR2 snapshot), merges them into one timeline sorted by
+wall-clock, and pretty-prints it — and/or exports the records as
+Chrome/Perfetto instant events (one track per correlation ID) so a
+postmortem loads the flight narrative next to the span tracer's
+/debug/trace export in ui.perfetto.dev:
+
+    python -m tf_operator_tpu.telemetry crash.jsonl usr2.jsonl
+    python -m tf_operator_tpu.telemetry dump.jsonl --corr req-3
+    python -m tf_operator_tpu.telemetry dump.jsonl \
+        --perfetto flight.json --trace debug-trace.json
+
+--trace merges a saved /debug/trace JSON (span events) into the
+Perfetto output, so spans and flight instants share one file.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+from typing import List
+
+from .flight import flight_chrome_events
+
+
+def load_dump(path: str) -> List[dict]:
+    """Parse one JSONL dump; raises ValueError naming the bad line."""
+    records = []
+    with open(path) as f:
+        for lineno, line in enumerate(f, 1):
+            line = line.strip()
+            if not line:
+                continue
+            try:
+                rec = json.loads(line)
+            except json.JSONDecodeError as e:
+                raise ValueError(f"{path}:{lineno}: not JSON: {e}") from e
+            if not isinstance(rec, dict) or "kind" not in rec:
+                raise ValueError(
+                    f"{path}:{lineno}: not a flight record (no 'kind')"
+                )
+            rec.setdefault("_source", path)
+            records.append(rec)
+    return records
+
+
+def merge_timeline(dumps: List[List[dict]]) -> List[dict]:
+    """One timeline across dumps: wall-clock first (comparable across
+    processes), seq as the tiebreak within a process."""
+    merged = [r for d in dumps for r in d]
+    merged.sort(key=lambda r: (r.get("wall", 0.0), r.get("seq", 0)))
+    return merged
+
+
+def format_record(rec: dict, multi_source: bool) -> str:
+    fields = rec.get("fields") or {}
+    parts = [f"{k}={fields[k]}" for k in sorted(fields)]
+    corr = rec.get("corr")
+    prefix = f"[{corr}] " if corr else ""
+    src = f" <{rec['_source']}>" if multi_source and "_source" in rec else ""
+    return (
+        f"{rec.get('wall', 0.0):17.6f} {rec.get('kind', '?'):<10} "
+        f"{prefix}{' '.join(parts)}{src}"
+    )
+
+
+def main(argv=None) -> int:
+    parser = argparse.ArgumentParser(
+        prog="python -m tf_operator_tpu.telemetry",
+        description="Merge and inspect flight-recorder JSONL dumps.",
+    )
+    parser.add_argument("dumps", nargs="+", help="flight JSONL dump path(s)")
+    parser.add_argument("--kind", help="keep only records of this kind")
+    parser.add_argument(
+        "--corr", help="keep only records with this correlation ID"
+    )
+    parser.add_argument(
+        "--limit", type=int, help="keep only the newest N records"
+    )
+    parser.add_argument(
+        "--perfetto", metavar="PATH",
+        help="write Chrome/Perfetto trace-event JSON here",
+    )
+    parser.add_argument(
+        "--trace", metavar="PATH",
+        help="merge a saved /debug/trace JSON's events into --perfetto",
+    )
+    parser.add_argument(
+        "--quiet", action="store_true",
+        help="skip the timeline print (export only)",
+    )
+    args = parser.parse_args(argv)
+
+    try:
+        dumps = [load_dump(p) for p in args.dumps]
+    except (OSError, ValueError) as e:
+        print(f"error: {e}", file=sys.stderr)
+        return 1
+
+    timeline = merge_timeline(dumps)
+    if args.kind:
+        timeline = [r for r in timeline if r.get("kind") == args.kind]
+    if args.corr:
+        timeline = [r for r in timeline if r.get("corr") == args.corr]
+    if args.limit and args.limit > 0:
+        timeline = timeline[-args.limit:]
+
+    if not args.quiet:
+        multi = len(args.dumps) > 1
+        corrs = {r.get("corr") for r in timeline if r.get("corr")}
+        print(
+            f"# {len(timeline)} records, {len(corrs)} correlation IDs, "
+            f"{len(args.dumps)} dump(s)"
+        )
+        for rec in timeline:
+            print(format_record(rec, multi))
+
+    if args.perfetto:
+        events = flight_chrome_events(timeline)
+        if args.trace:
+            try:
+                with open(args.trace) as f:
+                    trace = json.load(f)
+            except (OSError, json.JSONDecodeError) as e:
+                print(f"error: --trace {args.trace}: {e}", file=sys.stderr)
+                return 1
+            events = list(trace.get("traceEvents", [])) + events
+        with open(args.perfetto, "w") as f:
+            json.dump(
+                {"traceEvents": events, "displayTimeUnit": "ms"}, f
+            )
+        print(f"wrote {args.perfetto} ({len(events)} events)")
+
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

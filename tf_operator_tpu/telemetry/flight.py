@@ -1,0 +1,461 @@
+"""Flight recorder: always-on correlated black-box diagnostics.
+
+The registry answers "how much/how long in aggregate", a span answers
+"where did THIS request's time go" — but neither survives a wedge or a
+crash with a *narrative*: what the process was doing, in order, across
+planes, right before it stopped. This module is the aircraft-style
+black box (Dapper's lesson: cheap always-on recording with propagated
+IDs beats heavyweight profiling for production postmortems):
+
+- `FlightRecorder` — a preallocated, bounded ring of typed records
+  (reconcile decisions, workqueue transitions, substrate retries,
+  chaos injections, serve admit/evict/step, trainer step stats). The
+  hot path is one clock read and one slot store under a lock; nothing
+  is allocated beyond the record tuple itself, and a disabled recorder
+  returns before touching the lock — recording stays on in production
+  and in the serve engine's per-token loop.
+- correlation IDs — a `contextvars.ContextVar` threaded end-to-end
+  (job UID through controller -> reconciler -> events -> pod
+  lifecycle; request ID through serve server -> engine slot ->
+  stream). `correlate(id)` binds it for a block; every record, span
+  (tracing.py begin()), and JSON log line (utils/logger.py) emitted
+  inside carries it, so logs, metrics, traces, and flight records all
+  join on one key.
+- crash surfaces — `install_crash_handlers()` dumps the ring as JSONL
+  from `sys.excepthook` (postmortem survives the crash) and on
+  SIGUSR2 (live snapshot + `faulthandler` all-thread stacks, the
+  "what is it doing RIGHT NOW" signal for a wedged process).
+- `/debug/flightz` — `render_flightz()` renders a filtered JSONL page
+  for both the operator monitoring server (server/metrics.py, behind
+  --enable-debug-endpoints) and the serve server.
+- `python -m tf_operator_tpu.telemetry` — pretty-prints dumps as a
+  merged timeline and exports Perfetto trace events next to the span
+  tracer's (telemetry/__main__.py).
+
+Stdlib only, like the rest of the telemetry core.
+"""
+
+from __future__ import annotations
+
+import contextvars
+import faulthandler
+import json
+import os
+import sys
+import tempfile
+import threading
+import time
+from typing import Any, Dict, Iterable, List, NamedTuple, Optional
+
+__all__ = [
+    "FlightRecord",
+    "FlightRecorder",
+    "correlate",
+    "current_correlation",
+    "default_flight",
+    "set_default_flight",
+    "flight_record",
+    "install_crash_handlers",
+    "render_flightz",
+    "flight_chrome_events",
+]
+
+_correlation: contextvars.ContextVar = contextvars.ContextVar(
+    "flight_correlation", default=None
+)
+
+
+def current_correlation() -> Optional[str]:
+    """The correlation ID bound to the current context, or None."""
+    return _correlation.get()
+
+
+class correlate:
+    """Bind a correlation ID for a block::
+
+        with correlate(job.metadata.uid):
+            ...  # records, spans, and JSON log lines carry it
+
+    Nests: the previous binding is restored on exit. A None id binds
+    nothing new (records keep whatever was already active)."""
+
+    __slots__ = ("corr", "_token")
+
+    def __init__(self, corr) -> None:
+        self.corr = None if corr is None else str(corr)
+
+    def __enter__(self) -> Optional[str]:
+        if self.corr is None:
+            self._token = None
+            return _correlation.get()
+        self._token = _correlation.set(self.corr)
+        return self.corr
+
+    def __exit__(self, exc_type, exc, tb) -> None:
+        if self._token is not None:
+            _correlation.reset(self._token)
+
+
+class FlightRecord(NamedTuple):
+    """One ring entry. `t` is monotonic seconds (ordering/deltas),
+    `wall` is epoch seconds (joining dumps across processes)."""
+
+    seq: int
+    t: float
+    wall: float
+    kind: str
+    corr: Optional[str]
+    fields: Dict[str, Any]
+
+    def to_dict(self) -> Dict[str, Any]:
+        return {
+            "seq": self.seq,
+            "t": round(self.t, 6),
+            "wall": round(self.wall, 6),
+            "kind": self.kind,
+            "corr": self.corr,
+            "fields": {k: _jsonable(v) for k, v in self.fields.items()},
+        }
+
+
+def _jsonable(value):
+    if isinstance(value, (bool, int, float, str)) or value is None:
+        return value
+    return str(value)
+
+
+class FlightRecorder:
+    """Bounded ring of FlightRecords. Thread-safe; overwrite-oldest."""
+
+    def __init__(
+        self,
+        capacity: int = 4096,
+        clock=time.monotonic,
+        enabled: bool = True,
+    ) -> None:
+        if capacity < 1:
+            raise ValueError(f"capacity must be >= 1, got {capacity}")
+        self.capacity = int(capacity)
+        self.enabled = bool(enabled)
+        self._clock = clock
+        self._lock = threading.Lock()
+        # preallocated ring: record() stores into an existing slot, it
+        # never grows a list (no realloc jitter on the hot path)
+        self._buf: List[Optional[FlightRecord]] = [None] * self.capacity
+        self._seq = 0
+
+    def record(
+        self, kind: str, corr: Optional[str] = None, **fields
+    ) -> Optional[FlightRecord]:
+        """Append one record; -> it, or None when disabled. corr
+        defaults to the context's `correlate()` binding."""
+        if not self.enabled:
+            return None
+        if corr is None:
+            corr = _correlation.get()
+        t = self._clock()
+        wall = time.time()
+        with self._lock:
+            seq = self._seq
+            self._seq = seq + 1
+            record = FlightRecord(seq, t, wall, kind, corr, fields)
+            self._buf[seq % self.capacity] = record
+        return record
+
+    def __len__(self) -> int:
+        with self._lock:
+            return min(self._seq, self.capacity)
+
+    @property
+    def total_recorded(self) -> int:
+        """Records ever accepted (>= len(): the ring overwrites)."""
+        with self._lock:
+            return self._seq
+
+    def clear(self) -> None:
+        with self._lock:
+            self._buf = [None] * self.capacity
+            self._seq = 0
+
+    def snapshot(
+        self,
+        kind: Optional[str] = None,
+        corr: Optional[str] = None,
+        limit: Optional[int] = None,
+    ) -> List[FlightRecord]:
+        """Records currently in the ring, oldest first, optionally
+        filtered by kind and/or correlation ID; `limit` keeps the
+        newest N after filtering."""
+        with self._lock:
+            seq = self._seq
+            buf = list(self._buf)
+        start = max(0, seq - self.capacity)
+        records = [
+            r for i in range(start, seq)
+            if (r := buf[i % self.capacity]) is not None
+        ]
+        if kind is not None:
+            records = [r for r in records if r.kind == kind]
+        if corr is not None:
+            records = [r for r in records if r.corr == corr]
+        if limit is not None and limit > 0:
+            records = records[-limit:]
+        return records
+
+    def to_jsonl(self, **filters) -> str:
+        records = self.snapshot(**filters)
+        if not records:
+            return ""
+        return "\n".join(json.dumps(r.to_dict()) for r in records) + "\n"
+
+    def dump(self, path: Optional[str] = None, **filters) -> str:
+        """Write the ring as JSONL; -> the path written."""
+        if path is None:
+            path = os.path.join(
+                _dump_dir(), f"flight-{os.getpid()}-{int(time.time())}.jsonl"
+            )
+        with open(path, "w") as f:
+            f.write(self.to_jsonl(**filters))
+        return path
+
+
+# -- process-wide default ----------------------------------------------------
+
+def _env_default() -> FlightRecorder:
+    capacity = 4096
+    raw = os.environ.get("TF_OPERATOR_FLIGHT_CAPACITY")
+    if raw:
+        try:
+            capacity = max(1, int(raw))
+        except ValueError:
+            pass
+    enabled = os.environ.get("TF_OPERATOR_FLIGHT_DISABLED", "") not in (
+        "1", "true", "yes",
+    )
+    return FlightRecorder(capacity=capacity, enabled=enabled)
+
+
+_default: FlightRecorder = _env_default()
+
+
+def default_flight() -> FlightRecorder:
+    """The process-wide recorder every plane records into by default
+    (so one /debug/flightz page shows the merged narrative)."""
+    return _default
+
+
+def set_default_flight(recorder: FlightRecorder) -> FlightRecorder:
+    """Swap the process-wide recorder (tests isolate through this);
+    -> the recorder passed in."""
+    global _default
+    _default = recorder
+    return recorder
+
+
+def flight_record(
+    kind: str, corr: Optional[str] = None, **fields
+) -> Optional[FlightRecord]:
+    """record() on the process-wide default recorder."""
+    return _default.record(kind, corr=corr, **fields)
+
+
+# -- crash / signal dumps ----------------------------------------------------
+
+def _dump_dir() -> str:
+    return (
+        os.environ.get("TF_OPERATOR_FLIGHT_DIR") or tempfile.gettempdir()
+    )
+
+
+class CrashHandles:
+    """Installed-hook bookkeeping; uninstall() restores what was there
+    before (tests install into tmp dirs and must leave no trace)."""
+
+    def __init__(self) -> None:
+        self.dumps: List[str] = []
+        self._restores: List = []
+
+    def _add_restore(self, fn) -> None:
+        self._restores.append(fn)
+
+    def uninstall(self) -> None:
+        while self._restores:
+            self._restores.pop()()
+
+
+def install_crash_handlers(
+    recorder: Optional[FlightRecorder] = None,
+    directory: Optional[str] = None,
+    signum: Optional[int] = None,
+    install_excepthook: bool = True,
+    install_signal: bool = True,
+) -> CrashHandles:
+    """Arm the black box's two dump surfaces:
+
+    - `sys.excepthook`: an unhandled exception writes the ring to
+      ``<dir>/flight-crash-<pid>.jsonl`` before the normal traceback
+      (the postmortem survives the crash);
+    - SIGUSR2 (default; pass signum to override): a live snapshot to
+      ``<dir>/flight-usr2-<pid>.jsonl`` plus `faulthandler` all-thread
+      stacks to ``<dir>/flight-stacks-<pid>.txt`` — the "what is a
+      wedged process doing RIGHT NOW" signal, no restart needed.
+
+    dir defaults to $TF_OPERATOR_FLIGHT_DIR or the tmp dir. Returns a
+    CrashHandles whose uninstall() restores the previous hooks.
+    Signal installation requires the main thread; callers off the main
+    thread pass install_signal=False."""
+    rec = recorder if recorder is not None else _default
+    directory = directory or _dump_dir()
+    handles = CrashHandles()
+
+    def write_dump(tag: str) -> Optional[str]:
+        path = os.path.join(directory, f"flight-{tag}-{os.getpid()}.jsonl")
+        try:
+            rec.dump(path)
+        except OSError:
+            return None
+        handles.dumps.append(path)
+        return path
+
+    if install_excepthook:
+        prev_hook = sys.excepthook
+
+        def hook(exc_type, exc, tb):
+            path = write_dump("crash")
+            if path is not None:
+                try:
+                    sys.stderr.write(f"flight recorder dump: {path}\n")
+                except OSError:
+                    pass
+            prev_hook(exc_type, exc, tb)
+
+        sys.excepthook = hook
+
+        def restore_hook(prev=prev_hook):
+            sys.excepthook = prev
+
+        handles._add_restore(restore_hook)
+
+    if install_signal:
+        import signal as signal_mod
+
+        if signum is None:
+            signum = getattr(signal_mod, "SIGUSR2", None)
+        if signum is not None:
+            def on_signal(sig, frame):
+                stacks = os.path.join(
+                    directory, f"flight-stacks-{os.getpid()}.txt"
+                )
+                try:
+                    with open(stacks, "w") as f:
+                        faulthandler.dump_traceback(file=f, all_threads=True)
+                    handles.dumps.append(stacks)
+                except OSError:
+                    pass
+                write_dump("usr2")
+
+            prev_handler = signal_mod.signal(signum, on_signal)
+
+            def restore_signal(sig=signum, prev=prev_handler):
+                signal_mod.signal(sig, prev)
+
+            handles._add_restore(restore_signal)
+
+    return handles
+
+
+def all_thread_stacks() -> str:
+    """faulthandler's all-thread dump as a string (bench.py embeds it
+    in the bench_unavailable diagnostic record)."""
+    with tempfile.TemporaryFile(mode="w+") as f:
+        faulthandler.dump_traceback(file=f, all_threads=True)
+        f.seek(0)
+        return f.read()
+
+
+# -- /debug/flightz ----------------------------------------------------------
+
+def render_flightz(recorder: FlightRecorder, query: str = "") -> bytes:
+    """The shared /debug/flightz page: JSONL, one record per line,
+    filtered by query-string params — `corr=` / `request=` (alias) on
+    the correlation ID, `job=` on job-identifying fields OR the corr,
+    `kind=` on the record kind, `limit=` keeps the newest N. Served by
+    both the operator monitoring server and the serve server so one
+    curl works against either plane."""
+    from urllib.parse import parse_qs
+
+    params = parse_qs(query or "", keep_blank_values=False)
+
+    def first(name: str) -> Optional[str]:
+        values = params.get(name)
+        return values[0] if values else None
+
+    corr = first("corr") or first("request")
+    kind = first("kind")
+    job = first("job")
+    limit = None
+    raw_limit = first("limit")
+    if raw_limit:
+        try:
+            limit = max(1, int(raw_limit))
+        except ValueError:
+            limit = None
+    records = recorder.snapshot(kind=kind, corr=corr)
+    if job is not None:
+        records = [
+            r for r in records
+            if r.corr == job or job in (
+                r.fields.get("job"), r.fields.get("key"), r.fields.get("obj")
+            )
+        ]
+    if limit is not None:
+        records = records[-limit:]
+    if not records:
+        return b""
+    return (
+        "\n".join(json.dumps(r.to_dict()) for r in records) + "\n"
+    ).encode()
+
+
+# -- Perfetto export ---------------------------------------------------------
+
+def flight_chrome_events(
+    records: Iterable, pid: int = 0, tid_base: int = 10_000
+) -> List[dict]:
+    """Flight records as Chrome/Perfetto instant events: one track per
+    correlation ID (uncorrelated records share track tid_base), so a
+    request's or job's records line up as a row next to its span from
+    the tracer's export. Accepts FlightRecords or to_dict() dicts
+    (the CLI feeds parsed JSONL)."""
+    tracks: Dict[str, int] = {}
+    events: List[dict] = []
+    for r in records:
+        if isinstance(r, FlightRecord):
+            r = r.to_dict()
+        corr = r.get("corr")
+        if corr is None:
+            tid = tid_base
+        else:
+            tid = tracks.setdefault(str(corr), tid_base + 1 + len(tracks))
+        fields = dict(r.get("fields") or {})
+        if corr is not None:
+            fields["corr"] = corr
+        name = r.get("kind", "record")
+        op = fields.get("op")
+        if op:
+            name = f"{name}:{op}"
+        events.append({
+            "name": name,
+            "cat": "flight",
+            "ph": "i",
+            "ts": round(float(r.get("t", 0.0)) * 1e6, 3),
+            "pid": pid,
+            "tid": tid,
+            "s": "t",
+            "args": fields,
+        })
+    meta = [{
+        "name": "thread_name", "ph": "M", "pid": pid, "tid": tid,
+        "args": {"name": f"flight:{corr}"},
+    } for corr, tid in tracks.items()]
+    return meta + events

@@ -23,21 +23,44 @@ stays on even in production — the ring bounds memory, not the rate.
 
 from __future__ import annotations
 
+import contextvars
 import itertools
 import threading
 import time
 from collections import deque
 from typing import Dict, List, Optional
 
+# process-wide span ids: log lines carry span_id (utils/logger.py) and
+# join against the exported trace, so ids must be unique across tracers
+_span_ids = itertools.count(1)
+
+_active_span: contextvars.ContextVar = contextvars.ContextVar(
+    "telemetry_active_span", default=None
+)
+
+
+def current_span() -> Optional["Span"]:
+    """The innermost span entered (as a context manager) in the
+    current context and not yet finished, or None."""
+    span = _active_span.get()
+    if span is not None and span.end is not None:
+        return None
+    return span
+
 
 class Span:
     """One unit of traced work. Use as a context manager or call
     finish() explicitly; annotate() marks named phase instants."""
 
-    __slots__ = ("name", "track", "args", "start", "end", "events", "_tracer")
+    __slots__ = (
+        "name", "track", "args", "start", "end", "events", "id",
+        "_tracer", "_token",
+    )
 
     def __init__(self, tracer: "SpanTracer", name: str, track: int, args: dict):
         self._tracer = tracer
+        self._token = None
+        self.id = next(_span_ids)
         self.name = name
         self.track = track
         self.args = args
@@ -76,9 +99,13 @@ class Span:
         return self.end - self.start
 
     def __enter__(self) -> "Span":
+        self._token = _active_span.set(self)
         return self
 
     def __exit__(self, exc_type, exc, tb) -> None:
+        if self._token is not None:
+            _active_span.reset(self._token)
+            self._token = None
         if exc_type is not None:
             self.finish(outcome="error", error=exc_type.__name__)
         else:
@@ -106,7 +133,15 @@ class SpanTracer:
     def begin(self, name: str, track: Optional[int] = None, **args) -> Span:
         """Open a span. Each span defaults to its own track (tid), so
         overlapping requests render as parallel rows in the viewer;
-        pass track= to pin related spans to one row."""
+        pass track= to pin related spans to one row. A flight
+        correlation ID active in this context (flight.correlate) lands
+        in args["corr"] so spans join flight records and log lines."""
+        if "corr" not in args:
+            from .flight import current_correlation
+
+            corr = current_correlation()
+            if corr is not None:
+                args["corr"] = corr
         with self._lock:
             if track is None:
                 track = next(self._tracks)
@@ -139,7 +174,10 @@ class SpanTracer:
                 "dur": us((span.end or span.start) - span.start),
                 "pid": pid,
                 "tid": span.track,
-                "args": {k: _jsonable(v) for k, v in span.args.items()},
+                "args": {
+                    "span_id": span.id,
+                    **{k: _jsonable(v) for k, v in span.args.items()},
+                },
             })
             for phase, t in span.events:
                 events.append({

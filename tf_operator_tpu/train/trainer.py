@@ -29,6 +29,7 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec
 
 from ..parallel import mesh as mesh_lib
 from ..parallel import sharding as sharding_lib
+from ..telemetry.flight import flight_record
 
 logger = logging.getLogger("tf_operator_tpu.trainer")
 
@@ -665,6 +666,16 @@ class Trainer:
                             last_metrics["steps_per_sec"] * ids.size
                         )
                     interval_start, interval_steps = now, 0
+                    # trainer step stats land in the shared flight ring
+                    # so a post-mortem dump correlates training progress
+                    # with control-plane/serve activity (telemetry/flight)
+                    flight_record(
+                        "train", op="step-stats", step=int(state.step),
+                        loss=round(last_metrics.get("loss", float("nan")), 6),
+                        steps_per_sec=round(
+                            last_metrics["steps_per_sec"], 3
+                        ),
+                    )
                     logger.info(
                         "step %d loss=%.4f (%.1f steps/s)",
                         int(state.step), last_metrics.get("loss", float("nan")),

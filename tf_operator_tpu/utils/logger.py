@@ -39,7 +39,10 @@ class FieldsAdapter(logging.LoggerAdapter):
 
 
 class JsonFieldFormatter(logging.Formatter):
-    """JSON log lines with any structured fields folded in."""
+    """JSON log lines with any structured fields folded in, plus the
+    active telemetry context (correlation ID + open span) when one is
+    bound — log lines grep-join with /debug/flightz and /debug/trace
+    on the same keys."""
 
     def format(self, record: logging.LogRecord) -> str:
         entry: Dict[str, Any] = {
@@ -52,9 +55,27 @@ class JsonFieldFormatter(logging.Formatter):
         fields = getattr(record, "fields", None)
         if fields:
             entry.update(fields)
+        self._add_telemetry_context(entry)
         if record.exc_info:
             entry["exception"] = self.formatException(record.exc_info)
         return json.dumps(entry)
+
+    @staticmethod
+    def _add_telemetry_context(entry: Dict[str, Any]) -> None:
+        # imported lazily so logging stays usable even if telemetry is
+        # mid-import; a formatter must never raise
+        try:
+            from ..telemetry.flight import current_correlation
+            from ..telemetry.tracing import current_span
+        except Exception:
+            return
+        corr = current_correlation()
+        if corr is not None:
+            entry.setdefault("correlation", corr)
+        span = current_span()
+        if span is not None:
+            entry.setdefault("span", span.name)
+            entry.setdefault("span_id", span.id)
 
 
 class TextFieldFormatter(logging.Formatter):
