@@ -23,7 +23,7 @@ DOCKER    := $(shell command -v docker || command -v podman)
 IMAGE_DIR := build/images
 DIST      := build/dist
 
-.PHONY: ci presubmit lint native native-test native-race test wire-test e2e e2e-kind bench \
+.PHONY: ci presubmit lint analyze native native-test native-race test wire-test e2e e2e-kind bench \
         chaos-soak images release mnist-acc clean
 
 # `test` already runs the whole tests/ tree (native bindings, wire,
@@ -32,7 +32,7 @@ DIST      := build/dist
 # native-race (the TSAN/ASAN stress gate) IS a ci prerequisite: the
 # pytest native suite exercises the ctypes bindings, not the
 # sanitizers, and ci must match the presubmit DAG's coverage
-ci: lint native native-race test e2e
+ci: lint analyze native native-race test e2e
 	@echo "CI PASSED (tag $(TAG))"
 
 native-race: native
@@ -44,13 +44,27 @@ native-race: native
 presubmit:
 	$(PY) hack/run_workflow.py ci/presubmit.yaml --artifacts _artifacts
 
-# compileall (syntax) + hack/lint.py (undefined names F821, unused
-# imports F401 — the reference's py_checks.py lint analog; this image
-# ships no pyflakes/ruff, so the checker is vendored in-repo)
+# compileall (syntax) + the residual name-lint family of graftlint
+# (undefined names F821, unused imports F401, redefinitions F811,
+# mutable defaults, bare except:pass — the reference's py_checks.py
+# lint analog; this image ships no pyflakes/ruff, so the checker is
+# vendored in tf_operator_tpu/analysis). The name rules run baseline-
+# free: they must stay at zero, no exceptions accrue.
+LINT_RULES := syntax-error,undefined-name,unused-import,redefinition,mutable-default-arg,bare-except-pass
 lint:
 	$(PY) -m compileall -q tf_operator_tpu tests benchmarks hack bench.py __graft_entry__.py
-	$(PY) hack/lint.py tf_operator_tpu tests benchmarks hack bench.py __graft_entry__.py
+	$(PY) hack/graftlint.py --no-baseline --rules $(LINT_RULES) \
+	    tf_operator_tpu tests benchmarks hack bench.py __graft_entry__.py
 	@echo "lint: clean"
+
+# The full graftlint suite — lock discipline (order inversions, nested
+# non-reentrant acquire, blocking/callbacks under lock, signal-handler
+# locks) + JAX hazards (host-sync in jit, unroll bombs, use-after-
+# donation) + the name lints — against the committed baseline
+# (hack/graftlint_baseline.json). See docs/static-analysis.md.
+analyze:
+	$(PY) hack/graftlint.py
+	@echo "analyze: clean"
 
 native:
 	$(MAKE) -C native

@@ -1,0 +1,159 @@
+#!/usr/bin/env python3
+"""graftlint CLI: run the tf_operator_tpu.analysis passes over the repo.
+
+Usage:
+    python hack/graftlint.py [paths ...]
+        [--baseline hack/graftlint_baseline.json]
+        [--update-baseline] [--rules rule1,rule2] [--list-rules]
+
+Exit status: 0 when every finding is baselined (stale baseline entries
+only warn), 1 on any non-baselined finding, 2 on usage errors.
+
+This file also owns the repo-specific analyzer configuration (which
+call names are jit dispatch, which call sites donate buffers, which
+closure variables own locks) so the analysis package itself stays
+generic. See docs/static-analysis.md for the rule catalog.
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+import time
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+from tf_operator_tpu import analysis  # noqa: E402
+from tf_operator_tpu.analysis import Baseline, JaxConfig, LockConfig  # noqa: E402
+
+DEFAULT_PATHS = ("tf_operator_tpu", "tests", "benchmarks")
+DEFAULT_BASELINE = os.path.join("hack", "graftlint_baseline.json")
+
+# -- repo-specific analyzer knowledge ----------------------------------------
+
+# Calls that dispatch jitted computation: holding a lock across these
+# serializes every waiter behind device compile/execute latency.
+JIT_DISPATCH_NAMES = (
+    "jax.block_until_ready",
+    "block_until_ready",
+    "gpt_lib.generate",
+    "gpt_lib.beam_search",
+    "gpt_lib.generate_speculative",
+    "gpt_lib.moe_generate",
+)
+
+# `with state.lock:` closures in serve/server.py: the receiver is a
+# plain variable, so tell the lock pass its class.
+RECEIVER_TYPES = {
+    "state": "_State",
+}
+
+# Call sites whose arguments are donated to XLA, scoped per class so
+# two classes with a `self.step` attribute don't cross-contaminate:
+# the serve engine's SlotDecodeStep donates the KV cache (position 1,
+# off-CPU); the trainer's train step donates the TrainState
+# (position 0).
+DONATING_CALLABLES = {
+    "ContinuousBatchingEngine:self.step": (1,),
+    "Trainer:self.step": (0,),
+}
+
+
+def build_configs():
+    lock = LockConfig(
+        jit_dispatch_names=JIT_DISPATCH_NAMES,
+        receiver_types=RECEIVER_TYPES,
+    )
+    jax = JaxConfig(donating_callables=DONATING_CALLABLES)
+    return lock, jax
+
+
+def main(argv=None) -> int:
+    parser = argparse.ArgumentParser(
+        prog="graftlint", description=__doc__,
+        formatter_class=argparse.RawDescriptionHelpFormatter,
+    )
+    parser.add_argument("paths", nargs="*", default=None)
+    parser.add_argument("--baseline", default=DEFAULT_BASELINE)
+    parser.add_argument(
+        "--no-baseline", action="store_true",
+        help="report every finding, ignoring the baseline",
+    )
+    parser.add_argument(
+        "--update-baseline", action="store_true",
+        help="rewrite the baseline from current findings (placeholder "
+             "justifications must then be edited by hand)",
+    )
+    parser.add_argument(
+        "--rules", default="",
+        help="comma-separated rule names to run (default: all)",
+    )
+    parser.add_argument("--list-rules", action="store_true")
+    parser.add_argument("-q", "--quiet", action="store_true")
+    args = parser.parse_args(argv)
+
+    if args.list_rules:
+        for rule in analysis.ALL_RULES:
+            print(rule)
+        return 0
+
+    paths = args.paths or [os.path.join(REPO, p) for p in DEFAULT_PATHS]
+    paths = [p for p in paths if os.path.exists(p)]
+    rules = [r.strip() for r in args.rules.split(",") if r.strip()]
+
+    started = time.monotonic()
+    try:
+        lock_config, jax_config = build_configs()
+        findings = analysis.run(
+            paths, lock_config=lock_config, jax_config=jax_config,
+            rules=rules or None,
+        )
+    except analysis.AnalysisError as err:
+        print(f"graftlint: error: {err}", file=sys.stderr)
+        return 2
+
+    # normalize paths relative to the repo so baselines are portable
+    for finding in findings:
+        if os.path.isabs(finding.path):
+            finding.path = os.path.relpath(finding.path, REPO)
+
+    if args.update_baseline:
+        Baseline.dump(findings, args.baseline)
+        print(
+            f"graftlint: wrote {len(findings)} finding(s) to "
+            f"{args.baseline}; edit the justifications before committing"
+        )
+        return 0
+
+    if args.no_baseline:
+        new, baselined, stale = list(findings), [], []
+    else:
+        try:
+            baseline = Baseline.load(args.baseline)
+        except analysis.AnalysisError as err:
+            print(f"graftlint: error: {err}", file=sys.stderr)
+            return 2
+        new, baselined, stale = baseline.split(findings)
+
+    for finding in new:
+        print(finding.render())
+    if not args.quiet:
+        for key in stale:
+            print(
+                f"graftlint: warning: stale baseline entry "
+                f"{key[0]} at {key[1]} ({key[3]})", file=sys.stderr,
+            )
+        elapsed = time.monotonic() - started
+        print(
+            f"graftlint: {len(new)} finding(s), {len(baselined)} "
+            f"baselined, {len(stale)} stale baseline entr"
+            f"{'y' if len(stale) == 1 else 'ies'} in {elapsed:.1f}s",
+            file=sys.stderr,
+        )
+    return 1 if new else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,30 @@
+"""Blocking operations while a lock is held. Must fire
+blocking-under-lock for each case."""
+
+import queue
+import subprocess
+import threading
+import time
+
+
+class Worker:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._cond = threading.Condition()
+        self._inbox = queue.Queue()
+
+    def nap_under_lock(self):
+        with self._lock:
+            time.sleep(0.5)
+
+    def drain_under_lock(self):
+        with self._lock:
+            return self._inbox.get()
+
+    def wait_forever(self):
+        with self._cond:
+            self._cond.wait()
+
+    def shell_out(self):
+        with self._lock:
+            subprocess.run(["true"])

@@ -1,0 +1,21 @@
+"""User callbacks / event emission invoked while holding a lock. Must
+fire callback-under-lock."""
+
+import threading
+
+
+class Emitter:
+    def __init__(self, metrics, on_change=None):
+        self._lock = threading.Lock()
+        self._metrics = metrics
+        self._on_change = on_change
+        self.state = {}
+
+    def set(self, key, value):
+        with self._lock:
+            self.state[key] = value
+            self._metrics.on_add(len(self.state))
+
+    def apply(self, key, fn):
+        with self._lock:
+            self.state[key] = fn(self.state.get(key))

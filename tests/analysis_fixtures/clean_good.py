@@ -1,0 +1,135 @@
+"""Idioms this repo relies on; every pass must stay silent here — a
+noisy gate gets deleted."""
+
+import queue
+import threading
+import time
+import urllib.error
+import urllib.request
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+
+shared = threading.Lock()
+
+
+class Pipeline:
+    """Consistent lock order, timed waits, metrics AFTER release."""
+
+    def __init__(self, metrics=None, rng=None):
+        self._cond = threading.Condition()
+        self._aux = threading.Lock()
+        self._metrics = metrics
+        self._rng = rng
+        self._items = []
+        self._inbox = queue.Queue()
+
+    def put(self, item):
+        depth = None
+        with self._cond:
+            self._items.append(item)
+            depth = len(self._items)
+            self._cond.notify()
+        if self._metrics is not None:
+            self._metrics.on_add(depth)
+
+    def get(self, timeout: Optional[float] = None):
+        with self._cond:
+            deadline = None if timeout is None else time.monotonic() + timeout
+            while not self._items:
+                remaining = (
+                    None if deadline is None
+                    else deadline - time.monotonic()
+                )
+                if remaining is not None and remaining <= 0:
+                    return None
+                self._cond.wait(remaining)
+            return self._items.pop(0)
+
+    def jittered(self):
+        # rng use under a lock is computation, not a callback
+        with self._aux:
+            if self._rng is not None:
+                return self._rng.uniform(0.0, 1.0)
+            return 0.5
+
+    def ordered(self):
+        # same order as put/get: _cond before _aux, never reversed
+        with self._cond:
+            with self._aux:
+                return len(self._items)
+
+    def try_acquire(self) -> bool:
+        # timed/trylock acquire forms are not blocking
+        if shared.acquire(timeout=0.1):
+            try:
+                return True
+            finally:
+                shared.release()
+        return False
+
+    def poll(self):
+        with self._aux:
+            try:
+                return self._inbox.get(timeout=0.05)
+            except queue.Empty:
+                return None
+
+
+class Shape:
+    """Property setter pairs are not redefinitions."""
+
+    def __init__(self, width: int = 0):
+        self._width = width
+
+    @property
+    def width(self) -> int:
+        return self._width
+
+    @width.setter
+    def width(self, value: int) -> None:
+        self._width = value
+
+
+try:
+    import tomllib  # noqa: F401
+except ImportError:
+    tomllib = None  # conditional fallback is not a redefinition
+
+
+@jax.jit
+def scan_sum(x):
+    # static Python ints in shapes; lax.fori_loop instead of unroll
+    n = 8
+    ones = jnp.ones((n, n), jnp.float32)
+    return jax.lax.fori_loop(
+        0, n, lambda i, acc: acc + jnp.sum(ones[i]), jnp.sum(x)
+    )
+
+
+def _advance(params, cache):
+    return cache + 1, params
+
+
+step = jax.jit(_advance, donate_argnums=(1,))
+
+
+class Engine:
+    def __init__(self, cache):
+        self._cache = cache
+
+    def tick(self, params):
+        # donate-and-replace: the donated buffer is reassigned by the
+        # same statement, so no stale read exists
+        self._cache, out = step(params, self._cache)
+        return out
+
+
+def fetch(url: str) -> bytes:
+    # blocking call NOT under any lock
+    try:
+        with urllib.request.urlopen(url) as resp:
+            return resp.read()
+    except urllib.error.URLError:
+        return b""

@@ -1,0 +1,39 @@
+"""JAX hazards: host sync and Python unroll inside jit, donated buffer
+read after donation. Must fire jit-host-sync, jit-python-unroll, and
+use-after-donation."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+@jax.jit
+def host_sync(x):
+    total = jnp.sum(x)
+    return float(total.item())
+
+
+@jax.jit
+def asarray_sync(x):
+    return np.asarray(x) + 1
+
+
+@jax.jit
+def unroll(x):
+    acc = 0.0
+    for i in range(x.shape[0]):
+        acc = acc + x[i]
+    return acc
+
+
+def _consume(params, buf):
+    return buf * 2
+
+
+step = jax.jit(_consume, donate_argnums=(1,))
+
+
+def use_after_donate(params, buf):
+    out = step(params, buf)
+    stale = buf + 1
+    return out, stale

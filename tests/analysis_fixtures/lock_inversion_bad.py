@@ -1,0 +1,19 @@
+"""Seeded ABBA deadlock: two module functions take the same pair of
+locks in opposite orders. Must fire lock-order-inversion."""
+
+import threading
+
+lock_a = threading.Lock()
+lock_b = threading.Lock()
+
+
+def forward(items):
+    with lock_a:
+        with lock_b:
+            items.append("ab")
+
+
+def backward(items):
+    with lock_b:
+        with lock_a:
+            items.append("ba")

@@ -1,0 +1,15 @@
+"""Same non-reentrant lock acquired while already held. Must fire
+nested-nonreentrant-lock."""
+
+import threading
+
+
+class Counter:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self.n = 0
+
+    def bump_twice(self):
+        with self._lock:
+            with self._lock:
+                self.n += 2

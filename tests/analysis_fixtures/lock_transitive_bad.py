@@ -1,0 +1,25 @@
+"""Lock-order inversion only visible through the call graph: helper()
+acquires B, caller holds A; elsewhere B is held while a method that
+takes A is called. Must fire lock-order-inversion (transitive)."""
+
+import threading
+
+
+class Store:
+    def __init__(self):
+        self._index_lock = threading.Lock()
+        self._data_lock = threading.Lock()
+
+    def _reindex(self):
+        with self._index_lock:
+            return 1
+
+    def write(self, value):
+        with self._data_lock:
+            self._reindex()
+            return value
+
+    def scan(self):
+        with self._index_lock:
+            with self._data_lock:
+                return []

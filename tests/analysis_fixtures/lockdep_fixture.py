@@ -1,0 +1,21 @@
+"""Runtime lockdep fixture: an intentionally inverted lock pair.
+
+Not collected by default discovery (the filename matches neither
+test_*.py nor *_test.py); tests/test_lockdep.py runs it explicitly in
+a pytest subprocess, expecting FAILURE with --lockdep and SUCCESS
+without. The inversion is sequential in one thread — it can never
+actually deadlock, which is exactly the point: lockdep flags the
+*order*, not a hang."""
+
+from tf_operator_tpu.utils import locks
+
+
+def test_intentionally_inverted_pair():
+    a = locks.make_lock("fixture.A")
+    b = locks.make_lock("fixture.B")
+    with a:
+        with b:
+            pass
+    with b:
+        with a:
+            pass

@@ -1,0 +1,26 @@
+"""Residual name-lint violations: one per rule. Must fire
+unused-import, undefined-name, redefinition, mutable-default-arg, and
+bare-except-pass."""
+
+import json
+import os
+
+
+def lookup(key):
+    return registry[key]
+
+
+def lookup(key, default=None):
+    return default
+
+
+def collect(into=[]):
+    into.append(1)
+    return into
+
+
+def swallow():
+    try:
+        return os.getcwd()
+    except:
+        pass

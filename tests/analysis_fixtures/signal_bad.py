@@ -1,0 +1,22 @@
+"""A signal handler that can block on a lock the interrupted main
+thread may hold. Must fire signal-handler-lock."""
+
+import signal
+import threading
+
+_state_lock = threading.Lock()
+_state = {"dumps": 0}
+
+
+def snapshot():
+    with _state_lock:
+        return dict(_state)
+
+
+def handler(signum, frame):
+    snap = snapshot()
+    _state["dumps"] = snap.get("dumps", 0) + 1
+
+
+def install():
+    signal.signal(signal.SIGUSR1, handler)

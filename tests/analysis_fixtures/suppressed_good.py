@@ -1,0 +1,21 @@
+"""Real violations silenced by suppression comments; the analyzer must
+honor them and report nothing."""
+# graftlint: disable-file=unused-import
+
+import json
+import threading
+import time
+
+_lock = threading.Lock()
+
+
+def slow_flush():
+    with _lock:
+        time.sleep(0.01)  # graftlint: disable=blocking-under-lock
+
+
+def ignore_all():
+    try:
+        return 1
+    except:  # graftlint: disable=bare-except-pass
+        pass

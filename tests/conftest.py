@@ -26,8 +26,43 @@ import jax  # noqa: E402
 jax.config.update("jax_platforms", "cpu")
 
 
+def pytest_addoption(parser):
+    parser.addoption(
+        "--lockdep", action="store_true", default=False,
+        help="instrument utils.locks primitives with runtime lock-order "
+             "checking; any observed inversion fails the test that "
+             "triggered it (see docs/static-analysis.md)",
+    )
+
+
 def pytest_configure(config):
     config.addinivalue_line(
         "markers",
         "slow: long-running soaks, excluded from tier-1 (-m 'not slow')",
     )
+    if config.getoption("--lockdep"):
+        from tf_operator_tpu.utils import locks
+
+        locks.enable_lockdep()
+
+
+def pytest_runtest_teardown(item, nextitem):
+    """With --lockdep on, an inversion observed during a test fails
+    THAT test (kernel-lockdep style: one observed order is enough, no
+    real deadlock required). The order graph persists across tests so
+    orders learned in one test catch reversals in another; violations
+    are cleared so each is reported once."""
+    if not item.config.getoption("--lockdep"):
+        return
+    from tf_operator_tpu.utils import locks
+
+    violations = locks.lockdep_violations()
+    if violations:
+        locks.clear_lockdep_violations()
+        import pytest
+
+        pytest.fail(
+            "lockdep: lock-order inversion(s) observed:\n\n"
+            + "\n\n".join(v.render() for v in violations),
+            pytrace=False,
+        )

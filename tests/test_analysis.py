@@ -1,0 +1,278 @@
+"""graftlint analyzer coverage: every rule fires on its known-bad
+fixture, stays silent on the known-good corpus, suppressions and the
+baseline behave, and the CLI honors the make-analyze contract (exit 1
+on a seeded inversion, exit 0 on this repo)."""
+
+import json
+import os
+import subprocess
+import sys
+import textwrap
+
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+from tf_operator_tpu import analysis  # noqa: E402
+from tf_operator_tpu.analysis import (  # noqa: E402
+    AnalysisError,
+    Baseline,
+    Finding,
+    JaxConfig,
+    LockConfig,
+)
+
+FIXTURES = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                        "analysis_fixtures")
+
+
+def run_on(name, **kwargs):
+    return analysis.run([os.path.join(FIXTURES, name)], **kwargs)
+
+
+def rules_of(findings):
+    return {f.rule for f in findings}
+
+
+class TestLockRules:
+    def test_order_inversion_fires(self):
+        findings = run_on("lock_inversion_bad.py")
+        assert rules_of(findings) == {"lock-order-inversion"}
+        assert len(findings) == 1  # one cycle, reported once
+        assert "ABBA" in findings[0].message
+
+    def test_transitive_inversion_through_call_graph(self):
+        findings = run_on("lock_transitive_bad.py")
+        assert rules_of(findings) == {"lock-order-inversion"}
+        assert "Store._index_lock" in findings[0].message
+
+    def test_nested_nonreentrant(self):
+        findings = run_on("lock_nested_bad.py")
+        assert rules_of(findings) == {"nested-nonreentrant-lock"}
+
+    def test_blocking_under_lock_all_forms(self):
+        findings = run_on("blocking_bad.py")
+        assert rules_of(findings) == {"blocking-under-lock"}
+        messages = " | ".join(f.message for f in findings)
+        assert "time.sleep" in messages
+        assert "Queue.get" in messages
+        assert "untimed wait()" in messages
+        assert "subprocess.run" in messages
+
+    def test_callback_under_lock(self):
+        findings = run_on("callback_bad.py")
+        assert rules_of(findings) == {"callback-under-lock"}
+        messages = " | ".join(f.message for f in findings)
+        assert "on_add" in messages           # injected collaborator
+        assert "callable parameter" in messages
+
+    def test_signal_handler_lock(self):
+        findings = run_on("signal_bad.py")
+        assert rules_of(findings) == {"signal-handler-lock"}
+        assert "_state_lock" in findings[0].message
+
+    def test_jit_dispatch_under_lock_is_config_driven(self, tmp_path):
+        source = textwrap.dedent("""\
+            import threading
+
+            _lock = threading.Lock()
+
+
+            def decode(fn, tokens):
+                with _lock:
+                    return my_runtime.generate(tokens)
+        """)
+        path = tmp_path / "mod.py"
+        path.write_text(source)
+        quiet = analysis.run([str(path)])
+        assert "blocking-under-lock" not in rules_of(quiet)
+        loud = analysis.run(
+            [str(path)],
+            lock_config=LockConfig(jit_dispatch_names=("my_runtime.generate",)),
+        )
+        assert "blocking-under-lock" in rules_of(loud)
+
+    def test_receiver_types_resolve_closure_locks(self, tmp_path):
+        source = textwrap.dedent("""\
+            import threading
+            import time
+
+
+            class _State:
+                def __init__(self):
+                    self.lock = threading.Lock()
+
+
+            def make_handler(state):
+                def handle():
+                    with state.lock:
+                        time.sleep(1)
+                return handle
+        """)
+        path = tmp_path / "mod.py"
+        path.write_text(source)
+        quiet = analysis.run([str(path)])
+        assert "blocking-under-lock" not in rules_of(quiet)
+        loud = analysis.run(
+            [str(path)],
+            lock_config=LockConfig(receiver_types={"state": "_State"}),
+        )
+        assert any(
+            f.rule == "blocking-under-lock" and "_State.lock" in f.message
+            for f in loud
+        )
+
+
+class TestJaxRules:
+    def test_jax_bad_fires_all_three(self):
+        findings = run_on("jax_bad.py")
+        assert rules_of(findings) == {
+            "jit-host-sync", "jit-python-unroll", "use-after-donation",
+        }
+
+    def test_donating_callables_config_with_class_scope(self, tmp_path):
+        source = textwrap.dedent("""\
+            class Engine:
+                def run(self):
+                    out = self.step(self.params, self._cache)
+                    return out + self._cache
+
+            class Trainer:
+                def run(self):
+                    out = self.step(self.params, self._cache)
+                    return out + self._cache
+        """)
+        path = tmp_path / "mod.py"
+        path.write_text(source)
+        findings = analysis.run(
+            [str(path)],
+            jax_config=JaxConfig(
+                donating_callables={"Engine:self.step": (1,)}
+            ),
+        )
+        hits = [f for f in findings if f.rule == "use-after-donation"]
+        assert len(hits) == 1
+        assert hits[0].symbol == "Engine.run"  # Trainer's step not scoped
+
+    def test_donate_and_replace_is_clean(self, tmp_path):
+        source = textwrap.dedent("""\
+            class Engine:
+                def run(self):
+                    self._cache, out = self.step(self.params, self._cache)
+                    return out
+        """)
+        path = tmp_path / "mod.py"
+        path.write_text(source)
+        findings = analysis.run(
+            [str(path)],
+            jax_config=JaxConfig(
+                donating_callables={"Engine:self.step": (1,)}
+            ),
+        )
+        assert findings == []
+
+
+class TestNamesRules:
+    def test_names_bad_fires_every_rule(self):
+        findings = run_on("names_bad.py")
+        assert rules_of(findings) == {
+            "unused-import", "undefined-name", "redefinition",
+            "mutable-default-arg", "bare-except-pass",
+        }
+
+
+class TestGoodCorpus:
+    def test_clean_fixture_is_clean(self):
+        assert run_on("clean_good.py") == []
+
+    def test_suppressions_honored(self):
+        assert run_on("suppressed_good.py") == []
+
+    def test_rules_filter(self):
+        findings = run_on("names_bad.py", rules=["unused-import"])
+        assert rules_of(findings) == {"unused-import"}
+        with pytest.raises(AnalysisError):
+            run_on("names_bad.py", rules=["no-such-rule"])
+
+    def test_syntax_error_is_reported_not_raised(self, tmp_path):
+        path = tmp_path / "broken.py"
+        path.write_text("def f(:\n")
+        findings = analysis.run([str(path)])
+        assert rules_of(findings) == {"syntax-error"}
+
+    def test_fixture_corpus_excluded_from_directory_walks(self):
+        # make analyze over tests/ must never see the known-bad corpus
+        tests_dir = os.path.dirname(os.path.abspath(__file__))
+        seen = list(analysis.load_paths([tests_dir])[0])
+        assert not any("analysis_fixtures" in m.path for m in seen)
+
+
+class TestBaseline:
+    def _finding(self):
+        return Finding("blocking-under-lock", "a/b.py", 7, "msg", "C.m")
+
+    def test_round_trip_and_split(self, tmp_path):
+        path = str(tmp_path / "baseline.json")
+        f = self._finding()
+        Baseline.dump([f], path, justification="decode lock by design")
+        baseline = Baseline.load(path)
+        new, matched, stale = baseline.split([f])
+        assert (new, len(matched), stale) == ([], 1, [])
+        # line moves don't invalidate the entry
+        moved = Finding(f.rule, f.path, 99, f.message, f.symbol)
+        new, matched, stale = baseline.split([moved])
+        assert new == [] and len(matched) == 1
+        # a different finding is new; the old entry goes stale
+        other = Finding("jit-host-sync", "x.py", 1, "other")
+        new, matched, stale = baseline.split([other])
+        assert len(new) == 1 and matched == [] and len(stale) == 1
+
+    def test_empty_justification_rejected(self, tmp_path):
+        path = tmp_path / "baseline.json"
+        path.write_text(json.dumps({"findings": [{
+            "rule": "r", "path": "p", "symbol": "", "message": "m",
+            "justification": "  ",
+        }]}))
+        with pytest.raises(AnalysisError):
+            Baseline.load(str(path))
+
+    def test_missing_file_is_empty(self, tmp_path):
+        baseline = Baseline.load(str(tmp_path / "nope.json"))
+        assert baseline.entries == {}
+
+
+class TestCli:
+    def _run(self, *args):
+        return subprocess.run(
+            [sys.executable, os.path.join(REPO, "hack", "graftlint.py"),
+             *args],
+            capture_output=True, text=True, cwd=REPO,
+        )
+
+    def test_exits_nonzero_on_seeded_inversion(self):
+        proc = self._run(os.path.join(FIXTURES, "lock_inversion_bad.py"))
+        assert proc.returncode == 1
+        assert "lock-order-inversion" in proc.stdout
+
+    def test_repo_is_clean_modulo_baseline(self):
+        """The make-analyze contract: zero non-baselined findings on
+        the repo itself, within the CI time budget."""
+        proc = self._run()
+        assert proc.returncode == 0, proc.stdout + proc.stderr
+        assert "0 finding(s)" in proc.stderr
+        assert "0 stale" in proc.stderr
+
+    def test_update_baseline_then_clean(self, tmp_path):
+        baseline = str(tmp_path / "b.json")
+        bad = os.path.join(FIXTURES, "blocking_bad.py")
+        proc = self._run(bad, "--baseline", baseline, "--update-baseline")
+        assert proc.returncode == 0
+        proc = self._run(bad, "--baseline", baseline)
+        assert proc.returncode == 0, proc.stdout + proc.stderr
+
+    def test_list_rules(self):
+        proc = self._run("--list-rules")
+        assert proc.returncode == 0
+        listed = set(proc.stdout.split())
+        assert set(analysis.ALL_RULES) == listed

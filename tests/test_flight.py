@@ -10,6 +10,7 @@ import os
 import signal
 import sys
 import threading
+import time
 import urllib.error
 import urllib.request
 
@@ -199,6 +200,35 @@ class TestCrashDumps:
     def test_all_thread_stacks(self):
         out = all_thread_stacks()
         assert "thread" in out.lower() and "File" in out
+
+    def test_crash_dump_not_blocked_by_held_ring_lock(self, tmp_path):
+        """Regression for the signal-handler-lock finding: a signal can
+        land while the interrupted frame is inside record() holding the
+        ring lock. crash_dump must fall back to the racy copy and
+        return promptly instead of deadlocking the process."""
+        rec = FlightRecorder(capacity=8)
+        rec.record("reconcile", op="sync", key="ns/x")
+        held = threading.Event()
+        release = threading.Event()
+
+        def holder():
+            with rec._lock:
+                held.set()
+                release.wait(5)
+
+        t = threading.Thread(target=holder)
+        t.start()
+        assert held.wait(5)
+        try:
+            start = time.monotonic()
+            path = rec.crash_dump(str(tmp_path / "dump.jsonl"))
+            elapsed = time.monotonic() - start
+        finally:
+            release.set()
+            t.join(5)
+        assert elapsed < 2.0
+        records = [json.loads(l) for l in open(path) if l.strip()]
+        assert any(r["kind"] == "reconcile" for r in records)
 
 
 class TestFlightz:

@@ -1,0 +1,199 @@
+"""Runtime lockdep: instrumented lock wrappers record acquisition
+order and flag ABBA inversions live; the pytest --lockdep plugin turns
+a recorded inversion into a test failure."""
+
+import os
+import subprocess
+import sys
+import threading
+
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+from tf_operator_tpu.utils import locks  # noqa: E402
+
+FIXTURE = os.path.join(
+    os.path.dirname(os.path.abspath(__file__)),
+    "analysis_fixtures", "lockdep_fixture.py",
+)
+
+
+@pytest.fixture
+def lockdep():
+    locks.enable_lockdep()
+    try:
+        yield
+    finally:
+        locks.clear_lockdep_violations()
+        locks.reset_lockdep_graph()
+        locks.disable_lockdep()
+
+
+class TestFactories:
+    def test_disabled_returns_plain_primitives(self):
+        assert not locks.lockdep_enabled()
+        lock = locks.make_lock("t.plain")
+        assert not isinstance(lock, locks.InstrumentedLock)
+        with lock:
+            pass
+        cond = locks.make_condition("t.plain_cond")
+        with cond:
+            cond.notify_all()
+
+    def test_enabled_returns_instrumented(self, lockdep):
+        assert locks.lockdep_enabled()
+        assert isinstance(locks.make_lock("t.a"), locks.InstrumentedLock)
+        assert isinstance(locks.make_rlock("t.b"), locks.InstrumentedRLock)
+        assert isinstance(
+            locks.make_condition("t.c"), locks.InstrumentedCondition
+        )
+
+
+class TestDetection:
+    def test_inverted_pair_recorded_not_raised(self, lockdep):
+        a = locks.make_lock("t.A")
+        b = locks.make_lock("t.B")
+        with a:
+            with b:
+                pass
+        with b:
+            with a:  # inversion observed here, but never raises
+                pass
+        violations = locks.lockdep_violations()
+        assert len(violations) == 1
+        v = violations[0]
+        assert (v.a, v.b) == ("t.B", "t.A")
+        assert "t.A" in v.cycle and "t.B" in v.cycle
+        assert "lock-order inversion" in v.render()
+
+    def test_consistent_order_is_clean(self, lockdep):
+        a = locks.make_lock("t.A")
+        b = locks.make_lock("t.B")
+        for _ in range(3):
+            with a:
+                with b:
+                    pass
+        assert locks.lockdep_violations() == []
+
+    def test_transitive_cycle_detected(self, lockdep):
+        a = locks.make_lock("t.A")
+        b = locks.make_lock("t.B")
+        c = locks.make_lock("t.C")
+        with a:
+            with b:
+                pass
+        with b:
+            with c:
+                pass
+        with c:
+            with a:  # closes A -> B -> C -> A
+                pass
+        violations = locks.lockdep_violations()
+        assert len(violations) == 1
+        assert violations[0].cycle == ["t.A", "t.B", "t.C", "t.A"]
+
+    def test_rlock_reentry_is_not_self_edge(self, lockdep):
+        r = locks.make_rlock("t.R")
+        with r:
+            with r:
+                pass
+        assert locks.lockdep_violations() == []
+
+    def test_cross_thread_orders_merge(self, lockdep):
+        # thread 1 establishes A -> B; main thread takes B -> A —
+        # the classic deadlock that only manifests under load
+        a = locks.make_lock("t.A")
+        b = locks.make_lock("t.B")
+
+        def forward():
+            with a:
+                with b:
+                    pass
+
+        t = threading.Thread(target=forward)
+        t.start()
+        t.join()
+        with b:
+            with a:
+                pass
+        assert len(locks.lockdep_violations()) == 1
+
+    def test_condition_wait_keeps_held_stack_truthful(self, lockdep):
+        # wait() releases the condition and re-acquires on wake; a
+        # stale held-entry left behind would fabricate a cond->other
+        # edge from the post-wait acquisition below and turn the legal
+        # other->cond notify path into a false ABBA
+        cond = locks.make_condition("t.cond")
+        other = locks.make_lock("t.other")
+        ready = threading.Event()
+
+        def waiter():
+            with cond:
+                ready.set()
+                cond.wait(timeout=5)
+            with other:
+                pass
+
+        t = threading.Thread(target=waiter)
+        t.start()
+        ready.wait(timeout=5)
+        with other:
+            with cond:
+                cond.notify_all()
+        t.join(timeout=5)
+        assert not t.is_alive()
+        assert locks.lockdep_violations() == []
+
+    def test_condition_wait_for_predicate(self, lockdep):
+        cond = locks.make_condition("t.wf")
+        box = []
+
+        def producer():
+            with cond:
+                box.append(1)
+                cond.notify_all()
+
+        t = threading.Thread(target=producer)
+        with cond:
+            t.start()
+            assert cond.wait_for(lambda: box, timeout=5)
+        t.join(timeout=5)
+        assert locks.lockdep_violations() == []
+
+    def test_clear_and_reset(self, lockdep):
+        a = locks.make_lock("t.A")
+        b = locks.make_lock("t.B")
+        with a:
+            with b:
+                pass
+        with b:
+            with a:
+                pass
+        assert locks.lockdep_violations()
+        locks.clear_lockdep_violations()
+        assert locks.lockdep_violations() == []
+        locks.reset_lockdep_graph()
+        with b:
+            with a:  # old A->B edge is gone, so no cycle now
+                pass
+        assert locks.lockdep_violations() == []
+
+
+class TestPytestPlugin:
+    def _pytest(self, *args):
+        return subprocess.run(
+            [sys.executable, "-m", "pytest", "-q", "-p", "no:cacheprovider",
+             *args],
+            capture_output=True, text=True, cwd=REPO,
+        )
+
+    def test_fixture_fails_under_lockdep(self):
+        proc = self._pytest("--lockdep", FIXTURE)
+        assert proc.returncode == 1, proc.stdout + proc.stderr
+        assert "lock-order inversion" in proc.stdout
+
+    def test_fixture_passes_without_lockdep(self):
+        proc = self._pytest(FIXTURE)
+        assert proc.returncode == 0, proc.stdout + proc.stderr

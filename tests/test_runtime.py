@@ -120,6 +120,42 @@ class TestWorkQueue:
         assert len(q) == 1
         assert q.get() == "a"
 
+    def test_metrics_hooks_run_outside_lock(self):
+        """Regression for the callback-under-lock finding: add/get/done
+        used to invoke the injected metrics hooks while holding the
+        queue condition, so a hook touching the queue (or any lock
+        ordered before it elsewhere) could deadlock. Each hook now
+        observes the condition free."""
+        events = []
+
+        class Probe:
+            def __init__(self):
+                self.q = None
+
+            def _cond_free(self):
+                got = self.q._cond.acquire(timeout=1)
+                if got:
+                    self.q._cond.release()
+                return got
+
+            def on_add(self, depth):
+                events.append(("add", depth, self._cond_free(), len(self.q)))
+
+            def on_get(self, queue_seconds, depth):
+                events.append(("get", depth, self._cond_free(), len(self.q)))
+
+            def on_done(self, work_seconds):
+                events.append(("done", None, self._cond_free(), len(self.q)))
+
+        probe = Probe()
+        q = WorkQueue(metrics=probe)
+        probe.q = q
+        q.add("a")
+        item = q.get()
+        q.done(item)
+        assert [e[0] for e in events] == ["add", "get", "done"]
+        assert all(e[2] for e in events), "a hook saw the queue lock held"
+
     def test_rate_limited_backoff_growth(self):
         q = RateLimitingQueue()
         assert q.num_requeues("k") == 0

@@ -1,0 +1,95 @@
+"""graftlint: static analysis for the tf-operator-tpu reproduction.
+
+Three pass families over one shared parse (ISSUE 5):
+
+- lock discipline (`lockgraph`) — lock-order inversions, blocking ops
+  under lock, callbacks/event emission under lock, nested
+  non-reentrant acquire, signal handlers that can deadlock;
+- JAX hazards (`jaxhazards`) — host syncs inside jitted functions,
+  Python-range unroll bombs under `@jax.jit`, donated-buffer
+  use-after-donation;
+- residual name lint (`names`) — the old hack/lint.py rules (F821
+  undefined-name, F401 unused-import) plus redefinition,
+  mutable-default-arg and bare-except-pass.
+
+Entry point: :func:`run`. The CLI lives in hack/graftlint.py.
+"""
+
+from __future__ import annotations
+
+from typing import Iterable, List, Optional, Sequence
+
+from .baseline import Baseline
+from .core import (
+    AnalysisError,
+    Finding,
+    SourceFile,
+    load_paths,
+    parse_source,
+)
+from .jaxhazards import JaxConfig, run_jax_pass
+from .lockgraph import LockConfig, run_lock_pass
+from .names import run_names_pass
+
+# every rule graftlint can emit, for --rules validation and the docs
+ALL_RULES = (
+    # lock discipline
+    "lock-order-inversion",
+    "nested-nonreentrant-lock",
+    "blocking-under-lock",
+    "callback-under-lock",
+    "signal-handler-lock",
+    # JAX hazards
+    "jit-host-sync",
+    "jit-python-unroll",
+    "use-after-donation",
+    # residual name lint
+    "undefined-name",
+    "unused-import",
+    "redefinition",
+    "mutable-default-arg",
+    "bare-except-pass",
+    # parse failures
+    "syntax-error",
+)
+
+
+def run(
+    paths: Iterable[str],
+    lock_config: Optional[LockConfig] = None,
+    jax_config: Optional[JaxConfig] = None,
+    rules: Optional[Sequence[str]] = None,
+) -> List[Finding]:
+    """Parse every .py under `paths` once and run all passes.
+
+    `rules`, when given, keeps only those rule names (syntax errors are
+    always reported — nothing else is trustworthy on a file that does
+    not parse).
+    """
+    if rules:
+        unknown = sorted(set(rules) - set(ALL_RULES))
+        if unknown:
+            raise AnalysisError(f"unknown rule(s): {', '.join(unknown)}")
+    modules, findings = load_paths(paths)
+    findings.extend(run_lock_pass(modules, lock_config or LockConfig()))
+    findings.extend(run_jax_pass(modules, jax_config or JaxConfig()))
+    findings.extend(run_names_pass(modules))
+    if rules:
+        keep = set(rules) | {"syntax-error"}
+        findings = [f for f in findings if f.rule in keep]
+    findings.sort(key=lambda f: (f.path, f.line, f.rule, f.message))
+    return findings
+
+
+__all__ = [
+    "ALL_RULES",
+    "AnalysisError",
+    "Baseline",
+    "Finding",
+    "JaxConfig",
+    "LockConfig",
+    "SourceFile",
+    "load_paths",
+    "parse_source",
+    "run",
+]

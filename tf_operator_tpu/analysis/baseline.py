@@ -1,0 +1,106 @@
+"""Baseline handling: accepted findings with mandatory justifications.
+
+Some findings are intentional (e.g. the serve server holds the decode
+lock across jit dispatch *by design* — that lock exists to serialize
+decode). Rather than sprinkle inline suppressions through hot code,
+such findings live in a checked-in baseline (hack/graftlint_baseline.json)
+where each entry must carry a human-written justification. `make
+analyze` fails on any finding not in the baseline, and warns about
+stale entries so the file can't silently rot.
+
+Entries match findings by the line-free fingerprint
+(rule, path, symbol, message) so unrelated edits to a file don't
+invalidate the baseline.
+"""
+
+from __future__ import annotations
+
+import json
+from typing import Dict, List, Sequence, Tuple
+
+from .core import AnalysisError, Finding
+
+_FpKey = Tuple[str, str, str, str]
+
+
+class Baseline:
+    def __init__(self, entries: Dict[_FpKey, str]) -> None:
+        # fingerprint -> justification
+        self.entries = entries
+
+    @classmethod
+    def load(cls, path: str) -> "Baseline":
+        try:
+            with open(path, encoding="utf-8") as handle:
+                raw = json.load(handle)
+        except FileNotFoundError:
+            return cls({})
+        except (OSError, ValueError) as err:
+            raise AnalysisError(f"unreadable baseline {path}: {err}")
+        if not isinstance(raw, dict) or not isinstance(
+            raw.get("findings"), list
+        ):
+            raise AnalysisError(
+                f"baseline {path} must be {{'findings': [...]}}"
+            )
+        entries: Dict[_FpKey, str] = {}
+        for i, item in enumerate(raw["findings"]):
+            try:
+                key = (
+                    item["rule"], item["path"],
+                    item.get("symbol", ""), item["message"],
+                )
+                justification = item["justification"]
+            except (TypeError, KeyError) as err:
+                raise AnalysisError(
+                    f"baseline {path} entry {i} missing field: {err}"
+                )
+            if not isinstance(justification, str) or not justification.strip():
+                raise AnalysisError(
+                    f"baseline {path} entry {i} ({key[0]} at {key[1]}) "
+                    f"needs a non-empty justification"
+                )
+            entries[key] = justification
+        return cls(entries)
+
+    def split(
+        self, findings: Sequence[Finding]
+    ) -> Tuple[List[Finding], List[Finding], List[_FpKey]]:
+        """-> (new, baselined, stale-entry fingerprints)."""
+        new: List[Finding] = []
+        matched: List[Finding] = []
+        seen = set()
+        for finding in findings:
+            key = finding.fingerprint()
+            if key in self.entries:
+                matched.append(finding)
+                seen.add(key)
+            else:
+                new.append(finding)
+        stale = [key for key in self.entries if key not in seen]
+        return new, matched, stale
+
+    @staticmethod
+    def dump(findings: Sequence[Finding], path: str,
+             justification: str = "TODO: justify") -> None:
+        """--update-baseline: write entries for `findings`, each stamped
+        with a placeholder justification the author must then edit (the
+        loader rejects empty ones, not placeholders — review catches
+        those)."""
+        payload = {
+            "findings": [
+                {
+                    "rule": f.rule,
+                    "path": f.path,
+                    "symbol": f.symbol,
+                    "message": f.message,
+                    "justification": justification,
+                }
+                for f in sorted(
+                    findings, key=lambda f: (f.path, f.rule, f.line)
+                )
+            ]
+        }
+        with open(path, "w", encoding="utf-8") as handle:
+            json.dump(payload, handle, indent=2, sort_keys=True)
+            handle.write("\n")

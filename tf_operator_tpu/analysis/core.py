@@ -1,0 +1,190 @@
+"""graftlint core: shared source model for every analysis pass.
+
+The reference repo kept its controller honest with `go vet` + `go test
+-race`; this package is the Python-side analog (ISSUE 5). Every pass
+family (lock discipline, JAX hazards, residual name lint) consumes the
+same loaded-source model built here, so the whole suite parses each
+file exactly once and `make analyze` stays well under its 60 s budget.
+
+Pieces:
+
+- `Finding` — one diagnostic, with a line-independent fingerprint so
+  the baseline (baseline.py) survives unrelated edits.
+- `SourceFile` — path + source + AST + per-line suppressions
+  (`# graftlint: disable=<rule>[,<rule>...]` on the flagged line, or
+  `# graftlint: disable-file=<rule>` anywhere in the first 10 lines).
+- `load_paths()` / `iter_py_files()` — the file walker shared with the
+  CLI (hack/graftlint.py); excludes the analyzer's own known-bad test
+  corpus (tests/analysis_fixtures/) by default.
+"""
+
+from __future__ import annotations
+
+import ast
+import os
+import re
+from typing import Dict, Iterable, Iterator, List, Optional, Set, Tuple
+
+# directories never analyzed: caches/artifacts plus the intentional
+# known-bad corpus the analyzer's own tests feed it file-by-file
+DEFAULT_EXCLUDE_DIRS = (
+    "__pycache__", ".git", "build", "_artifacts", "analysis_fixtures",
+)
+
+_SUPPRESS_RE = re.compile(
+    r"#\s*graftlint:\s*disable(?P<scope>-file)?=(?P<rules>[A-Za-z0-9_,\-]+)"
+)
+
+
+class Finding:
+    """One diagnostic: `path:line: rule message  [symbol]`."""
+
+    __slots__ = ("rule", "path", "line", "message", "symbol")
+
+    def __init__(self, rule: str, path: str, line: int, message: str,
+                 symbol: str = "") -> None:
+        self.rule = rule
+        self.path = path
+        self.line = int(line)
+        self.message = message
+        self.symbol = symbol  # e.g. "WorkQueue.add" — the scope context
+
+    def fingerprint(self) -> Tuple[str, str, str, str]:
+        """Line-free identity used for baseline matching: survives
+        edits elsewhere in the file."""
+        return (self.rule, self.path, self.symbol, self.message)
+
+    def render(self) -> str:
+        where = f" [{self.symbol}]" if self.symbol else ""
+        return f"{self.path}:{self.line}: {self.rule} {self.message}{where}"
+
+    def __repr__(self) -> str:  # pragma: no cover - debugging aid
+        return f"Finding({self.render()!r})"
+
+    def __eq__(self, other) -> bool:
+        return (
+            isinstance(other, Finding)
+            and self.fingerprint() == other.fingerprint()
+            and self.line == other.line
+        )
+
+    def __hash__(self) -> int:
+        return hash((self.fingerprint(), self.line))
+
+
+class SourceFile:
+    """One parsed module plus its suppression map."""
+
+    def __init__(self, path: str, source: str, tree: ast.Module) -> None:
+        self.path = path
+        self.source = source
+        self.tree = tree
+        self.lines = source.splitlines()
+        self.module_name = os.path.splitext(os.path.basename(path))[0]
+        # line -> set of rule names (or {"all"}) suppressed there
+        self.suppressions: Dict[int, Set[str]] = {}
+        self.file_suppressions: Set[str] = set()
+        for lineno, line in enumerate(self.lines, start=1):
+            match = _SUPPRESS_RE.search(line)
+            if not match:
+                continue
+            rules = {r.strip() for r in match.group("rules").split(",") if r.strip()}
+            if match.group("scope"):
+                if lineno <= 10:
+                    self.file_suppressions |= rules
+            else:
+                self.suppressions.setdefault(lineno, set()).update(rules)
+
+    def suppressed(self, line: int, rule: str) -> bool:
+        if rule in self.file_suppressions or "all" in self.file_suppressions:
+            return True
+        rules = self.suppressions.get(line, ())
+        return rule in rules or "all" in rules
+
+
+class AnalysisError(Exception):
+    """Raised for unusable inputs (bad baseline file, bad path)."""
+
+
+def parse_source(path: str, source: str):
+    """-> (SourceFile, None) or (None, Finding) on a syntax error."""
+    try:
+        tree = ast.parse(source, filename=path)
+    except SyntaxError as err:
+        return None, Finding(
+            "syntax-error", path, err.lineno or 1, str(err.msg)
+        )
+    return SourceFile(path, source, tree), None
+
+
+def load_file(path: str):
+    with open(path, encoding="utf-8") as handle:
+        return parse_source(path, handle.read())
+
+
+def iter_py_files(
+    paths: Iterable[str],
+    exclude_dirs: Tuple[str, ...] = DEFAULT_EXCLUDE_DIRS,
+) -> Iterator[str]:
+    for path in paths:
+        if os.path.isfile(path):
+            yield path
+            continue
+        if not os.path.isdir(path):
+            raise AnalysisError(f"no such file or directory: {path}")
+        for root, dirs, files in os.walk(path):
+            dirs[:] = sorted(d for d in dirs if d not in exclude_dirs)
+            for name in sorted(files):
+                if name.endswith(".py"):
+                    yield os.path.join(root, name)
+
+
+def load_paths(
+    paths: Iterable[str],
+    exclude_dirs: Tuple[str, ...] = DEFAULT_EXCLUDE_DIRS,
+) -> Tuple[List[SourceFile], List[Finding]]:
+    """Parse every .py under paths once; -> (modules, syntax findings)."""
+    modules: List[SourceFile] = []
+    findings: List[Finding] = []
+    for path in iter_py_files(paths, exclude_dirs):
+        module, err = load_file(path)
+        if module is not None:
+            modules.append(module)
+        else:
+            findings.append(err)
+    return modules, findings
+
+
+# -- small AST helpers shared by the passes ----------------------------------
+
+def dotted_name(node: ast.AST) -> Optional[str]:
+    """`a.b.c` for Name/Attribute chains, else None."""
+    parts: List[str] = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+        return ".".join(reversed(parts))
+    if isinstance(node, ast.Call):
+        inner = dotted_name(node.func)
+        return f"{inner}()" if inner else None
+    return None
+
+
+def is_self_attr(node: ast.AST) -> Optional[str]:
+    """'attr' when node is `self.attr`, else None."""
+    if (
+        isinstance(node, ast.Attribute)
+        and isinstance(node.value, ast.Name)
+        and node.value.id in ("self", "cls")
+    ):
+        return node.attr
+    return None
+
+
+def call_keyword(call: ast.Call, name: str) -> Optional[ast.expr]:
+    for kw in call.keywords:
+        if kw.arg == name:
+            return kw.value
+    return None

@@ -1,0 +1,386 @@
+"""JAX-hazard pass: the lint no generic linter understands.
+
+Three rules over the same loaded-source model:
+
+- ``jit-host-sync`` — a host-synchronizing call inside a jitted
+  function (`.item()`, `float()` on a traced value, `np.asarray` /
+  `np.array`, `jax.device_get`, `.block_until_ready()`, `print`):
+  under trace these either fail or silently pin a device round-trip
+  into the hot path per step.
+- ``jit-python-unroll`` — a Python `for ... in range(...)` over a
+  tensor dimension (`x.shape[...]`) or a bare parameter inside a
+  jitted function: jit unrolls the loop into the graph, so compile
+  time and program size scale with the runtime value (the unroll
+  bomb); use `lax.scan`/`fori_loop`.
+- ``use-after-donation`` — an argument passed in a donated position
+  of a `jax.jit(..., donate_argnums=...)` callable is read again
+  before reassignment: the buffer was invalidated by donation, so the
+  read returns garbage on TPU (and only warns on CPU, where tests
+  run — exactly the class of bug that survives presubmit).
+
+Jitted-function discovery matches this repo's idioms: `@jax.jit`,
+`@functools.partial(jax.jit, ...)` decorators, and
+`name = jax.jit(fn, ...)` / `self._step = jax.jit(fn, donate_argnums=…)`
+wrapping of a local def. Call sites of donating wrappers resolve
+within the defining class/module; cross-module donating callables
+(the serve engine calling models/gpt.py's SlotDecodeStep) are injected
+by the CLI via ``JaxConfig.donating_callables``.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, List, Optional, Sequence, Set, Tuple
+
+from .core import Finding, SourceFile, dotted_name, is_self_attr, call_keyword
+
+_HOST_SYNC_DOTTED = (
+    "np.asarray", "np.array", "numpy.asarray", "numpy.array",
+    "jax.device_get", "onp.asarray",
+)
+_HOST_SYNC_METHODS = ("item", "block_until_ready", "tolist")
+
+
+def _is_jax_jit(node: ast.expr) -> Optional[ast.Call]:
+    """-> the jax.jit(...) Call when node is `jax.jit(...)` or
+    `partial(jax.jit, ...)`, else None. For a bare decorator
+    `@jax.jit` (a Name/Attribute, not a Call) returns a marker."""
+    if isinstance(node, ast.Call):
+        name = dotted_name(node.func) or ""
+        if name in ("jax.jit", "jit"):
+            return node
+        if name.endswith("partial"):
+            if node.args:
+                inner = dotted_name(node.args[0]) or ""
+                if inner in ("jax.jit", "jit"):
+                    return node
+        return None
+    name = dotted_name(node) or ""
+    if name in ("jax.jit", "jit"):
+        return ast.Call(func=node, args=[], keywords=[])  # bare marker
+    return None
+
+
+def _donated_positions(jit_call: ast.Call) -> Tuple[int, ...]:
+    donate = call_keyword(jit_call, "donate_argnums")
+    if donate is None:
+        return ()
+    if isinstance(donate, (ast.Tuple, ast.List)):
+        out = []
+        for elt in donate.elts:
+            if isinstance(elt, ast.Constant) and isinstance(elt.value, int):
+                out.append(elt.value)
+        return tuple(out)
+    if isinstance(donate, ast.Constant) and isinstance(donate.value, int):
+        return (donate.value,)
+    # computed (e.g. platform-conditional): assume the declared intent
+    # and treat position 1 as donated only if a simple inference fails;
+    # safer to return () than to guess
+    return ()
+
+
+class JaxConfig:
+    """donating_callables: dotted call patterns -> donated positions,
+    e.g. {"self.step": (1,)} for the engine's SlotDecodeStep seam."""
+
+    def __init__(self, donating_callables: Optional[Dict[str, Tuple[int, ...]]] = None):
+        self.donating_callables = dict(donating_callables or {})
+
+
+def run_jax_pass(
+    modules: Sequence[SourceFile], config: Optional[JaxConfig] = None
+) -> List[Finding]:
+    config = config or JaxConfig()
+    findings: List[Finding] = []
+    for module in modules:
+        findings.extend(_scan_module(module, config))
+    return findings
+
+
+def _scan_module(module: SourceFile, config: JaxConfig) -> List[Finding]:
+    findings: List[Finding] = []
+    jitted: List[Tuple[ast.AST, str]] = []       # (func node, qualname)
+    # wrapper name -> donated positions, for names assigned jax.jit(f,
+    # donate_argnums=...): both local names and self-attrs
+    donating: Dict[str, Tuple[int, ...]] = dict(config.donating_callables)
+
+    # index every function def by name for wrapper resolution
+    defs_by_name: Dict[str, List[ast.AST]] = {}
+    qualnames: Dict[int, str] = {}
+
+    def index(node, prefix: str) -> None:
+        for child in ast.iter_child_nodes(node):
+            if isinstance(child, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                defs_by_name.setdefault(child.name, []).append(child)
+                qualnames[id(child)] = f"{prefix}{child.name}"
+                index(child, f"{prefix}{child.name}.")
+            elif isinstance(child, ast.ClassDef):
+                index(child, f"{prefix}{child.name}.")
+            else:
+                index(child, prefix)
+
+    index(module.tree, "")
+
+    for node in ast.walk(module.tree):
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            for dec in node.decorator_list:
+                if _is_jax_jit(dec) is not None:
+                    jitted.append((node, qualnames.get(id(node), node.name)))
+                    break
+        elif isinstance(node, (ast.Assign, ast.AnnAssign)):
+            value = node.value if not isinstance(node, ast.Assign) else node.value
+            if value is None:
+                continue
+            jit_call = _is_jax_jit(value)
+            if jit_call is None or not getattr(jit_call, "args", None):
+                # partial(jax.jit, ...)(...) unsupported; plain form only
+                if jit_call is None:
+                    continue
+            targets = node.targets if isinstance(node, ast.Assign) else [node.target]
+            # the wrapped local function becomes jitted
+            if jit_call.args:
+                inner = jit_call.args[0]
+                if (
+                    dotted_name(jit_call.func) in ("jax.jit", "jit")
+                    and isinstance(inner, ast.Name)
+                    and inner.id in defs_by_name
+                ):
+                    for fn in defs_by_name[inner.id]:
+                        jitted.append((fn, qualnames.get(id(fn), inner.id)))
+                donated = _donated_positions(jit_call)
+                if donated:
+                    for target in targets:
+                        attr = is_self_attr(target)
+                        if attr is not None:
+                            donating.setdefault(f"self.{attr}", donated)
+                        elif isinstance(target, ast.Name):
+                            donating.setdefault(target.id, donated)
+
+    seen: Set[int] = set()
+    for fn, qualname in jitted:
+        if id(fn) in seen:
+            continue
+        seen.add(id(fn))
+        findings.extend(_scan_jitted(module, fn, qualname))
+
+    findings.extend(_scan_donation(module, donating, qualnames))
+    return findings
+
+
+def _scan_jitted(module: SourceFile, fn, qualname: str) -> List[Finding]:
+    findings: List[Finding] = []
+    params = {
+        a.arg for a in (fn.args.posonlyargs + fn.args.args + fn.args.kwonlyargs)
+    } - {"self", "cls"}
+
+    def emit(rule: str, line: int, message: str) -> None:
+        if not module.suppressed(line, rule):
+            findings.append(Finding(rule, module.path, line, message, qualname))
+
+    for node in ast.walk(fn):
+        if isinstance(node, ast.Call):
+            name = dotted_name(node.func) or ""
+            attr = node.func.attr if isinstance(node.func, ast.Attribute) else None
+            if any(name == d or name.endswith("." + d) for d in _HOST_SYNC_DOTTED):
+                emit(
+                    "jit-host-sync", node.lineno,
+                    f"host sync '{name.split('.')[-1]}()' inside jitted "
+                    f"function — fails under trace or forces a device "
+                    f"round-trip per step",
+                )
+            elif attr in _HOST_SYNC_METHODS and not node.args:
+                emit(
+                    "jit-host-sync", node.lineno,
+                    f"host sync '.{attr}()' inside jitted function",
+                )
+            elif name == "print":
+                emit(
+                    "jit-host-sync", node.lineno,
+                    "print() inside jitted function runs at trace time "
+                    "only (or forces a host callback) — use jax.debug.print",
+                )
+            elif name == "float" and node.args and "shape" not in ast.dump(
+                node.args[0]
+            ) and any(
+                isinstance(sub, ast.Name) and sub.id in params
+                for sub in ast.walk(node.args[0])
+            ):
+                # only flag float() over this function's own traced
+                # parameters; closure ints (static shapes etc.) are fine
+                emit(
+                    "jit-host-sync", node.lineno,
+                    "float() on a traced value inside jitted function "
+                    "concretizes the tracer (host sync / TracerError)",
+                )
+        elif isinstance(node, (ast.For,)):
+            it = node.iter
+            if isinstance(it, ast.Call) and (dotted_name(it.func) or "") == "range":
+                for arg in it.args:
+                    text = ast.dump(arg)
+                    if "attr='shape'" in text:
+                        emit(
+                            "jit-python-unroll", node.lineno,
+                            "Python range() loop over a tensor dim inside "
+                            "jitted function — jit unrolls it into the "
+                            "graph (compile time scales with the value); "
+                            "use lax.scan/fori_loop",
+                        )
+                        break
+                    if isinstance(arg, ast.Name) and arg.id in params:
+                        emit(
+                            "jit-python-unroll", node.lineno,
+                            f"Python range({arg.id}) loop over a parameter "
+                            f"inside jitted function unrolls per value — "
+                            f"use lax.scan/fori_loop or mark it static",
+                        )
+                        break
+    return findings
+
+
+def _scan_donation(
+    module: SourceFile, donating: Dict[str, Tuple[int, ...]], qualnames
+) -> List[Finding]:
+    """Use-after-donation: within one function body, a Name/self-attr
+    passed in a donated position is loaded again after the call and
+    before any reassignment."""
+    if not donating:
+        return []
+    findings: List[Finding] = []
+
+    def expr_key(node: ast.expr) -> Optional[str]:
+        attr = is_self_attr(node)
+        if attr is not None:
+            return f"self.{attr}"
+        if isinstance(node, ast.Name):
+            return node.id
+        return None
+
+    for fn in ast.walk(module.tree):
+        if not isinstance(fn, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            continue
+        qualname = qualnames.get(id(fn), fn.name)
+        # linear statement stream of this function body (no nested defs)
+        stmts: List[ast.stmt] = []
+
+        def flatten(body) -> None:
+            for stmt in body:
+                if isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                     ast.ClassDef)):
+                    continue
+                stmts.append(stmt)
+                for field in ("body", "orelse", "finalbody"):
+                    sub = getattr(stmt, field, None)
+                    if isinstance(sub, list):
+                        flatten([s for s in sub if isinstance(s, ast.stmt)])
+                if isinstance(stmt, ast.Try):
+                    for handler in stmt.handlers:
+                        flatten(handler.body)
+
+        flatten(fn.body)
+
+        def own_exprs(stmt: ast.stmt) -> List[ast.AST]:
+            """Expressions belonging to this statement alone — compound
+            statements contribute only their header (test/iter/items);
+            their bodies appear later in the flattened stream, so
+            walking them wholesale would double-scan every call."""
+            if isinstance(stmt, (ast.If, ast.While)):
+                return [stmt.test]
+            if isinstance(stmt, (ast.For, ast.AsyncFor)):
+                return [stmt.iter]
+            if isinstance(stmt, (ast.With, ast.AsyncWith)):
+                return [item.context_expr for item in stmt.items]
+            if isinstance(stmt, ast.Try):
+                return []
+            if isinstance(stmt, ast.Match):
+                return [stmt.subject]
+            if isinstance(stmt, ast.Assign):
+                return [stmt.value]
+            if isinstance(stmt, (ast.AnnAssign, ast.AugAssign)):
+                return [stmt.value] if stmt.value is not None else []
+            return [stmt]
+
+        # donated keys -> (donation line, callee) pending invalidation
+        donated_now: Dict[str, Tuple[int, str]] = {}
+        for stmt in stmts:
+            # reassignment first: `x, y = donating_call(... x ...)` is
+            # the donate-and-replace idiom and is CORRECT
+            assigned: Set[str] = set()
+            if isinstance(stmt, (ast.Assign, ast.AnnAssign, ast.AugAssign)):
+                targets = (
+                    stmt.targets if isinstance(stmt, ast.Assign)
+                    else [stmt.target]
+                )
+                for target in targets:
+                    for sub in ast.walk(target):
+                        key = expr_key(sub)
+                        if key:
+                            assigned.add(key)
+            elif isinstance(stmt, (ast.For, ast.AsyncFor)):
+                for sub in ast.walk(stmt.target):
+                    key = expr_key(sub)
+                    if key:
+                        assigned.add(key)
+            # loads of currently-donated keys (excluding this stmt's
+            # assignment targets)
+            value_nodes = own_exprs(stmt)
+            for root in value_nodes:
+                for sub in ast.walk(root):
+                    if isinstance(sub, ast.Call):
+                        continue  # calls handled below for new donations
+                    key = expr_key(sub)
+                    if key and key in donated_now and isinstance(
+                        getattr(sub, "ctx", None), ast.Load
+                    ):
+                        line0, callee = donated_now[key]
+                        if not module.suppressed(
+                            sub.lineno, "use-after-donation"
+                        ):
+                            findings.append(Finding(
+                                "use-after-donation", module.path, sub.lineno,
+                                f"'{key}' was donated to {callee}() at line "
+                                f"{line0} and read again before "
+                                f"reassignment — the buffer is invalid "
+                                f"after donation on TPU",
+                                qualname,
+                            ))
+                        donated_now.pop(key, None)
+            donated_now = {
+                k: v for k, v in donated_now.items() if k not in assigned
+            }
+            # new donations from calls in this statement's own exprs
+            for root in value_nodes:
+                for sub in ast.walk(root):
+                    if not isinstance(sub, ast.Call):
+                        continue
+                    callee = dotted_name(sub.func) or ""
+                    positions = _match_donating(
+                        donating, callee, qualname
+                    )
+                    if positions is None:
+                        continue
+                    for index in positions:
+                        if index < len(sub.args):
+                            key = expr_key(sub.args[index])
+                            if key and key not in assigned:
+                                donated_now[key] = (sub.lineno, callee)
+            if isinstance(stmt, (ast.Return, ast.Raise)):
+                # control flow ends here; statements after it in the
+                # linear stream are a different branch
+                donated_now = {}
+    return findings
+
+
+def _match_donating(
+    donating: Dict[str, Tuple[int, ...]], callee: str, qualname: str
+) -> Optional[Tuple[int, ...]]:
+    """Patterns may be class-scoped ('Engine:self.step') so two classes
+    with a `self.step` attribute don't cross-contaminate."""
+    for pattern, positions in donating.items():
+        scope = None
+        if ":" in pattern:
+            scope, pattern = pattern.split(":", 1)
+        if scope is not None and not qualname.startswith(scope + "."):
+            continue
+        if callee == pattern or callee.endswith("." + pattern):
+            return positions
+    return None

@@ -1,0 +1,817 @@
+"""Lock-discipline pass family: the `go test -race` stand-in.
+
+Builds a whole-package model of every lock (threading.Lock / RLock /
+Condition, or the utils/locks.py factory indirection), walks each
+function with a held-locks context (a lexical CFG approximation: with-
+blocks, including try/finally and branches, carry the held set), and
+derives:
+
+- ``lock-order-inversion`` — the package-wide lock acquisition graph
+  (nested with-blocks plus *transitive* acquisitions through resolved
+  method/function calls) contains a cycle: thread A can take L1 then
+  L2 while thread B takes L2 then L1 — the classic ABBA deadlock that
+  only manifests under production load.
+- ``nested-nonreentrant-lock`` — the same non-reentrant lock class
+  acquired while already held (self-deadlock on first contention).
+- ``blocking-under-lock`` — `time.sleep`, subprocess, socket/HTTP
+  calls, untimed `Queue.get()` / `Condition.wait()` / `Thread.join()`,
+  or jit dispatch executed while a lock is held: every other thread
+  needing the lock stalls behind device/IO latency.
+- ``callback-under-lock`` — a user callback (an attribute injected via
+  a constructor parameter, or a callable parameter) or telemetry/event
+  emission invoked while holding a lock: the callee can take arbitrary
+  locks, completing an inversion the package graph cannot see.
+- ``signal-handler-lock`` — a blocking lock acquisition reachable from
+  a `signal.signal` handler: the handler runs on the main thread
+  between bytecodes, so if the signal lands while that thread holds
+  the lock, the acquire deadlocks the process.
+
+Resolution is deliberately conservative-by-name: `self.m()` resolves
+through the class hierarchy, `ClassName.m()` / module functions by
+name, `self._attr.m()` through attributes constructed from package
+classes. Unresolvable calls contribute no order edges (no guessing) —
+except the signal rule, which matches method names against same-module
+classes because a handler's reachable set must err toward caution.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, List, Optional, Sequence, Set, Tuple
+
+from .core import Finding, SourceFile, dotted_name, is_self_attr, call_keyword
+
+# constructors recognized as lock objects (dotted-name suffix match)
+_LOCK_KINDS = {
+    "threading.Lock": "lock",
+    "threading.RLock": "rlock",
+    "threading.Condition": "condition",
+    "Lock": "lock",
+    "RLock": "rlock",
+    "Condition": "condition",
+    "make_lock": "lock",
+    "make_rlock": "rlock",
+    "make_condition": "condition",
+    "locks.make_lock": "lock",
+    "locks.make_rlock": "rlock",
+    "locks.make_condition": "condition",
+}
+_QUEUE_CTORS = ("queue.Queue", "Queue", "queue.SimpleQueue", "SimpleQueue",
+                "queue.LifoQueue", "queue.PriorityQueue")
+_EVENT_CTORS = ("threading.Event", "Event")
+_THREAD_CTORS = ("threading.Thread", "Thread", "threading.Timer", "Timer")
+
+# dotted-name suffixes that block the calling thread outright
+_BLOCKING_CALLS = (
+    "time.sleep",
+    "subprocess.run", "subprocess.Popen", "subprocess.call",
+    "subprocess.check_call", "subprocess.check_output",
+    "socket.create_connection", "urllib.request.urlopen", "urlopen",
+    "requests.get", "requests.post", "requests.request",
+)
+# telemetry/event sinks: emission under a lock serializes observers
+# behind it and takes the sink's own lock (a hidden order edge)
+_EMISSION_FUNCS = ("flight_record", "default_flight().record")
+
+
+class _ClassInfo:
+    def __init__(self, module: SourceFile, node: ast.ClassDef) -> None:
+        self.module = module
+        self.node = node
+        self.name = node.name
+        self.bases = [dotted_name(b) or "" for b in node.bases]
+        self.lock_attrs: Dict[str, str] = {}      # attr -> kind
+        self.queue_attrs: Set[str] = set()
+        self.event_attrs: Set[str] = set()
+        self.thread_attrs: Set[str] = set()
+        self.injected_attrs: Set[str] = set()     # assigned from a ctor param
+        self.composed_attrs: Dict[str, str] = {}  # attr -> package class name
+        self.methods: Dict[str, "_FuncInfo"] = {}
+
+
+class _FuncInfo:
+    def __init__(self, module: SourceFile, node, qualname: str,
+                 owner: Optional[_ClassInfo]) -> None:
+        self.module = module
+        self.node = node
+        self.qualname = qualname          # e.g. "WorkQueue.add"
+        self.owner = owner
+        # (lock_id, line, held-at-acquisition tuple, blocking?) —
+        # blocking=False for .acquire(timeout=)/acquire(False) forms
+        self.acquisitions: List[Tuple[str, int, Tuple[str, ...], bool]] = []
+        # (line, held tuple, resolved callee _FuncInfo key or method name)
+        self.calls: List[Tuple[int, Tuple[str, ...], "Optional[_FuncInfo]", str]] = []
+        self.transitive_locks: Set[str] = set()   # fixpoint fill
+        self.transitive_blocking: Set[str] = set()
+
+
+def _match_ctor(node: ast.expr, table) -> Optional[str]:
+    if not isinstance(node, ast.Call):
+        return None
+    name = dotted_name(node.func)
+    if name is None:
+        return None
+    if isinstance(table, dict):
+        for key, kind in table.items():
+            if name == key or name.endswith("." + key):
+                return kind
+        return None
+    for key in table:
+        if name == key or name.endswith("." + key):
+            return key
+    return None
+
+
+class LockModel:
+    """Whole-package lock/lock-user model shared by every rule."""
+
+    def __init__(self, modules: Sequence[SourceFile]) -> None:
+        self.modules = list(modules)
+        self.classes: Dict[str, List[_ClassInfo]] = {}
+        self.module_locks: Dict[str, Dict[str, str]] = {}  # path -> name -> lock id
+        self.functions: List[_FuncInfo] = []
+        self.module_funcs: Dict[str, Dict[str, _FuncInfo]] = {}
+        for module in self.modules:
+            self._collect_module(module)
+        self._resolve_class_attrs()
+
+    # -- collection --------------------------------------------------------
+
+    def _collect_module(self, module: SourceFile) -> None:
+        path = module.path
+        self.module_locks[path] = {}
+        self.module_funcs[path] = {}
+        for stmt in module.tree.body:
+            targets = []
+            if isinstance(stmt, ast.Assign):
+                targets = stmt.targets
+                value = stmt.value
+            elif isinstance(stmt, ast.AnnAssign) and stmt.value is not None:
+                targets = [stmt.target]
+                value = stmt.value
+            else:
+                continue
+            kind = _match_ctor(value, _LOCK_KINDS)
+            if kind:
+                for target in targets:
+                    if isinstance(target, ast.Name):
+                        self.module_locks[path][target.id] = (
+                            f"{module.module_name}.{target.id}"
+                        )
+
+        class_stack: List[_ClassInfo] = []
+
+        def visit(node, qual_prefix: str) -> None:
+            for child in ast.iter_child_nodes(node):
+                if isinstance(child, ast.ClassDef):
+                    info = _ClassInfo(module, child)
+                    self.classes.setdefault(child.name, []).append(info)
+                    class_stack.append(info)
+                    visit(child, f"{qual_prefix}{child.name}.")
+                    class_stack.pop()
+                elif isinstance(child, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                    owner = class_stack[-1] if class_stack else None
+                    func = _FuncInfo(
+                        module, child, f"{qual_prefix}{child.name}", owner
+                    )
+                    self.functions.append(func)
+                    # last definition wins, matching runtime rebinding
+                    self.module_funcs[path][child.name] = func
+                    if owner is not None and child.name not in owner.methods:
+                        owner.methods[child.name] = func
+                    if owner is not None:
+                        self._scan_attr_assignments(owner, child)
+                    visit(child, f"{qual_prefix}{child.name}.")
+                else:
+                    visit(child, qual_prefix)
+
+        visit(module.tree, "")
+
+    def _scan_attr_assignments(self, cls: _ClassInfo, func) -> None:
+        params = {
+            a.arg
+            for a in (func.args.posonlyargs + func.args.args
+                      + func.args.kwonlyargs)
+        } - {"self", "cls"}
+        for node in ast.walk(func.node if hasattr(func, "node") else func):
+            if not isinstance(node, (ast.Assign, ast.AnnAssign)):
+                continue
+            targets = node.targets if isinstance(node, ast.Assign) else [node.target]
+            value = node.value
+            if value is None:
+                continue
+            for target in targets:
+                attr = is_self_attr(target)
+                if attr is None:
+                    continue
+                kind = _match_ctor(value, _LOCK_KINDS)
+                if kind:
+                    cls.lock_attrs.setdefault(attr, kind)
+                    continue
+                if _match_ctor(value, _QUEUE_CTORS):
+                    cls.queue_attrs.add(attr)
+                    continue
+                if _match_ctor(value, _EVENT_CTORS):
+                    cls.event_attrs.add(attr)
+                    continue
+                if _match_ctor(value, _THREAD_CTORS):
+                    cls.thread_attrs.add(attr)
+                    continue
+                if isinstance(value, ast.Call):
+                    ctor = dotted_name(value.func)
+                    if ctor and ctor.split(".")[-1] in self.classes:
+                        cls.composed_attrs[attr] = ctor.split(".")[-1]
+                        continue
+                if self._is_param_value(value, params):
+                    cls.injected_attrs.add(attr)
+
+    @staticmethod
+    def _is_param_value(value: ast.expr, params: Set[str]) -> bool:
+        """True when the assigned value is (derived from) a bare ctor
+        parameter: `x`, `x or default`, `x if cond else default`."""
+        if isinstance(value, ast.Name):
+            return value.id in params
+        if isinstance(value, ast.BoolOp):
+            return any(
+                isinstance(v, ast.Name) and v.id in params
+                for v in value.values
+            )
+        if isinstance(value, ast.IfExp):
+            return LockModel._is_param_value(value.body, params) or \
+                LockModel._is_param_value(value.orelse, params)
+        return False
+
+    def _resolve_class_attrs(self) -> None:
+        """Pull inherited lock/queue/etc. attrs into subclasses so
+        `self._cond` inside DelayingQueue resolves to the id of the
+        DEFINING class (WorkQueue._cond)."""
+        self._lock_id_cache: Dict[Tuple[str, str], Optional[Tuple[str, str]]] = {}
+
+    def _mro(self, cls: _ClassInfo) -> List[_ClassInfo]:
+        out, seen, frontier = [], set(), [cls]
+        while frontier:
+            cur = frontier.pop(0)
+            if id(cur) in seen:
+                continue
+            seen.add(id(cur))
+            out.append(cur)
+            for base in cur.bases:
+                base_name = base.split(".")[-1]
+                for cand in self.classes.get(base_name, ()):
+                    frontier.append(cand)
+        return out
+
+    def lock_id_for_attr(self, cls: _ClassInfo, attr: str):
+        """-> (lock_id, kind) for self.<attr>, walking the hierarchy."""
+        for cand in self._mro(cls):
+            if attr in cand.lock_attrs:
+                return f"{cand.name}.{attr}", cand.lock_attrs[attr]
+        return None
+
+    def attr_kind(self, cls: _ClassInfo, attr: str, field: str) -> bool:
+        return any(attr in getattr(c, field) for c in self._mro(cls))
+
+    def resolve_method(self, cls: _ClassInfo, name: str) -> Optional[_FuncInfo]:
+        for cand in self._mro(cls):
+            if name in cand.methods:
+                return cand.methods[name]
+        return None
+
+
+class _FunctionWalker:
+    """Walks one function body carrying the held-locks context."""
+
+    def __init__(self, model: LockModel, func: _FuncInfo, config) -> None:
+        self.model = model
+        self.func = func
+        self.config = config
+        self.findings: List[Finding] = []
+        self.local_queues: Set[str] = set()
+        self.local_threads: Set[str] = set()
+        self.local_events: Set[str] = set()
+        self.params: Set[str] = set()
+        args = func.node.args
+        for a in (args.posonlyargs + args.args + args.kwonlyargs):
+            self.params.add(a.arg)
+        self.params -= {"self", "cls"}
+
+    # -- lock identification ----------------------------------------------
+
+    def _lock_of_expr(self, expr: ast.expr):
+        """-> (lock_id, kind) when expr denotes a known lock."""
+        attr = is_self_attr(expr)
+        if attr is not None and self.func.owner is not None:
+            resolved = self.model.lock_id_for_attr(self.func.owner, attr)
+            if resolved is not None:
+                return resolved
+            # `with self.<injected>:` — an unknown-kind lock handed in
+            # by the caller; model it as this class's own lock class
+            if self.model.attr_kind(self.func.owner, attr, "injected_attrs"):
+                return f"{self.func.owner.name}.{attr}", "lock"
+            return None
+        if isinstance(expr, ast.Name):
+            module_locks = self.model.module_locks.get(self.func.module.path, {})
+            if expr.id in module_locks:
+                return module_locks[expr.id], "lock"
+        # `with state.lock:` where the receiver is a plain variable the
+        # config declares a class for (closures over a state object)
+        if (
+            isinstance(expr, ast.Attribute)
+            and isinstance(expr.value, ast.Name)
+        ):
+            cls_name = self.config.receiver_types.get(expr.value.id)
+            if cls_name:
+                for cand in self.model.classes.get(cls_name, ()):
+                    resolved = self.model.lock_id_for_attr(cand, expr.attr)
+                    if resolved is not None:
+                        return resolved
+        return None
+
+    # -- walking -----------------------------------------------------------
+
+    def walk(self) -> None:
+        self._walk_body(self.func.node.body, ())
+
+    def _walk_body(self, body, held: Tuple[str, ...]) -> None:
+        for stmt in body:
+            self._walk_stmt(stmt, held)
+
+    def _walk_stmt(self, stmt, held: Tuple[str, ...]) -> None:
+        if isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef,
+                             ast.ClassDef)):
+            return  # nested scopes run later, not under this lock
+        if isinstance(stmt, (ast.With, ast.AsyncWith)):
+            new_held = held
+            for item in stmt.items:
+                self._scan_expr(item.context_expr, new_held)
+                lock = self._lock_of_expr(item.context_expr)
+                if lock is not None:
+                    lock_id, kind = lock
+                    self.func.acquisitions.append(
+                        (lock_id, stmt.lineno, new_held, True)
+                    )
+                    if lock_id in new_held and kind != "rlock":
+                        self._emit(
+                            "nested-nonreentrant-lock", stmt.lineno,
+                            f"'{lock_id}' ({kind}) acquired while already "
+                            f"held by this thread — self-deadlock on a "
+                            f"non-reentrant lock",
+                        )
+                    new_held = new_held + (lock_id,)
+            self._walk_body(stmt.body, new_held)
+            return
+        if isinstance(stmt, ast.Try):
+            self._walk_body(stmt.body, held)
+            for handler in stmt.handlers:
+                self._walk_body(handler.body, held)
+            self._walk_body(stmt.orelse, held)
+            self._walk_body(stmt.finalbody, held)
+            return
+        # locals typed by construction (queues/threads/events)
+        if isinstance(stmt, ast.Assign) and isinstance(stmt.value, ast.Call):
+            for target in stmt.targets:
+                if isinstance(target, ast.Name):
+                    if _match_ctor(stmt.value, _QUEUE_CTORS):
+                        self.local_queues.add(target.id)
+                    elif _match_ctor(stmt.value, _THREAD_CTORS):
+                        self.local_threads.add(target.id)
+                    elif _match_ctor(stmt.value, _EVENT_CTORS):
+                        self.local_events.add(target.id)
+        for field in ast.iter_child_nodes(stmt):
+            if isinstance(field, ast.stmt):
+                self._walk_stmt(field, held)
+            elif isinstance(field, ast.expr):
+                self._scan_expr(field, held)
+            elif isinstance(field, (ast.withitem, ast.ExceptHandler)):
+                pass  # handled above
+            elif isinstance(field, (ast.arguments, ast.keyword)):
+                self._scan_expr(field, held)
+
+    def _scan_expr(self, expr, held: Tuple[str, ...]) -> None:
+        for node in ast.walk(expr):
+            if isinstance(node, (ast.Lambda,)):
+                continue
+            if isinstance(node, ast.Call):
+                self._handle_call(node, held)
+
+    # -- call classification ------------------------------------------------
+
+    def _handle_call(self, call: ast.Call, held: Tuple[str, ...]) -> None:
+        name = dotted_name(call.func) or ""
+        attr = call.func.attr if isinstance(call.func, ast.Attribute) else None
+        receiver = call.func.value if isinstance(call.func, ast.Attribute) else None
+
+        # explicit .acquire() forms count as acquisitions for the
+        # order graph and the signal rule
+        if attr == "acquire" and receiver is not None:
+            lock = self._lock_of_expr(receiver)
+            if lock is not None:
+                blocking = self._acquire_is_blocking(call)
+                self.func.acquisitions.append(
+                    (lock[0], call.lineno, held, blocking)
+                )
+
+        target = self._resolve_call(call)
+        self.func.calls.append(
+            (call.lineno, held, target, attr or name.split(".")[-1])
+        )
+
+        if not held:
+            return
+        line = call.lineno
+        held_str = ", ".join(sorted(set(held)))
+
+        blocked = self._blocking_reason(call, name, attr, receiver)
+        if blocked:
+            self._emit(
+                "blocking-under-lock", line,
+                f"{blocked} while holding {held_str}",
+            )
+        cb = self._callback_reason(call, name, attr, receiver)
+        if cb:
+            self._emit(
+                "callback-under-lock", line,
+                f"{cb} invoked while holding {held_str} — the callee can "
+                f"take arbitrary locks or block, completing an inversion "
+                f"the analyzer cannot see",
+            )
+
+    @staticmethod
+    def _acquire_is_blocking(call: ast.Call) -> bool:
+        if call_keyword(call, "timeout") is not None:
+            return False
+        blocking_kw = call_keyword(call, "blocking")
+        if blocking_kw is not None:
+            return not (
+                isinstance(blocking_kw, ast.Constant)
+                and blocking_kw.value is False
+            )
+        if call.args:
+            first = call.args[0]
+            if isinstance(first, ast.Constant) and first.value is False:
+                return False
+            return len(call.args) < 2  # acquire(True, timeout) is timed
+        return True
+
+    def _blocking_reason(self, call, name, attr, receiver) -> Optional[str]:
+        for known in _BLOCKING_CALLS:
+            if name == known or name.endswith("." + known):
+                return f"blocking call {known}()"
+        for known in self.config.jit_dispatch_names:
+            if name == known or name.endswith("." + known):
+                return (
+                    f"jit dispatch {known}() (device compile/execute "
+                    f"latency serialized behind the lock)"
+                )
+        if attr is None or receiver is None:
+            return None
+        recv_attr = is_self_attr(receiver)
+        owner = self.func.owner
+        if attr == "get" and not self._has_timeout(call):
+            if (
+                (recv_attr and owner and
+                 self.model.attr_kind(owner, recv_attr, "queue_attrs"))
+                or (isinstance(receiver, ast.Name)
+                    and receiver.id in self.local_queues)
+            ):
+                return "untimed Queue.get()"
+        if attr == "wait" and not call.args and not call.keywords:
+            if recv_attr and owner and (
+                self.model.lock_id_for_attr(owner, recv_attr) is not None
+                and self.model.lock_id_for_attr(owner, recv_attr)[1]
+                == "condition"
+                or self.model.attr_kind(owner, recv_attr, "event_attrs")
+            ):
+                return "untimed wait()"
+            if isinstance(receiver, ast.Name) and receiver.id in self.local_events:
+                return "untimed wait()"
+        if attr == "join" and not self._has_timeout(call) and not call.args:
+            if (
+                (recv_attr and owner and
+                 self.model.attr_kind(owner, recv_attr, "thread_attrs"))
+                or (isinstance(receiver, ast.Name)
+                    and receiver.id in self.local_threads)
+            ):
+                return "untimed Thread.join()"
+        return None
+
+    @staticmethod
+    def _has_timeout(call: ast.Call) -> bool:
+        if call.args:
+            return True
+        timeout = call_keyword(call, "timeout")
+        return timeout is not None and not (
+            isinstance(timeout, ast.Constant) and timeout.value is None
+        )
+
+    def _callback_reason(self, call, name, attr, receiver) -> Optional[str]:
+        # f(...) where f is a parameter of this function
+        if isinstance(call.func, ast.Name) and call.func.id in self.params:
+            return f"callable parameter '{call.func.id}'"
+        for known in _EMISSION_FUNCS:
+            if name == known or name.endswith("." + known):
+                return f"event emission {known}()"
+        if receiver is None:
+            return None
+        # default_flight().record(...) style emission
+        recv_name = dotted_name(receiver) or ""
+        if attr == "record" and recv_name.endswith("default_flight()"):
+            return "event emission default_flight().record()"
+        recv_attr = is_self_attr(receiver)
+        if recv_attr and attr and self.func.owner is not None:
+            owner = self.func.owner
+            if self.model.attr_kind(
+                owner, recv_attr, "injected_attrs"
+            ) and self._callbackish(recv_attr, attr):
+                # composed/known-class attrs resolve through the call
+                # graph instead; injected ones are opaque collaborators
+                # — but only callback/emission-flavored calls flag,
+                # so `self._rng.uniform()` under a lock stays quiet
+                return (
+                    f"callback on injected collaborator "
+                    f"'self.{recv_attr}.{attr}'"
+                )
+        return self._callback_tail(call)
+
+    _CB_METHOD_PREFIXES = (
+        "on_", "emit", "notify", "publish", "subscribe", "unsubscribe",
+        "fire", "dispatch", "record", "broadcast", "send", "callback",
+        "trigger",
+    )
+    _CB_ATTR_MARKERS = (
+        "callback", "hook", "listener", "observer", "handler", "sink",
+        "metrics", "subscriber",
+    )
+
+    def _callbackish(self, recv_attr: str, method: str) -> bool:
+        """Only callback/notification-flavored calls on opaque injected
+        collaborators flag — anything else (rng.uniform, clock.now)
+        would be pure false-positive noise."""
+        low = method.lower()
+        if any(low.startswith(p) for p in self._CB_METHOD_PREFIXES):
+            return True
+        attr_low = recv_attr.lower()
+        return any(m in attr_low for m in self._CB_ATTR_MARKERS)
+
+    def _callback_tail(self, call: ast.Call) -> Optional[str]:
+        direct_attr = is_self_attr(call.func)
+        if direct_attr and self.func.owner is not None:
+            if self.model.attr_kind(
+                self.func.owner, direct_attr, "injected_attrs"
+            ):
+                return f"callback on injected callable 'self.{direct_attr}'"
+        return None
+
+    # -- call resolution -----------------------------------------------------
+
+    def _resolve_call(self, call: ast.Call) -> Optional[_FuncInfo]:
+        func = call.func
+        owner = self.func.owner
+        if isinstance(func, ast.Name):
+            return self.model.module_funcs.get(
+                self.func.module.path, {}
+            ).get(func.id)
+        if not isinstance(func, ast.Attribute):
+            return None
+        # self.m(...) / cls.m(...)
+        recv = func.value
+        if isinstance(recv, ast.Name) and recv.id in ("self", "cls") and owner:
+            return self.model.resolve_method(owner, func.attr)
+        # super().m(...)
+        if (
+            isinstance(recv, ast.Call)
+            and isinstance(recv.func, ast.Name)
+            and recv.func.id == "super"
+            and owner is not None
+        ):
+            for base in owner.bases:
+                for cand in self.model.classes.get(base.split(".")[-1], ()):
+                    method = self.model.resolve_method(cand, func.attr)
+                    if method is not None:
+                        return method
+            return None
+        # self._x.m(...) where _x was constructed from a package class
+        recv_attr = is_self_attr(recv)
+        if recv_attr and owner is not None:
+            for cand_cls in self.model._mro(owner):
+                cls_name = cand_cls.composed_attrs.get(recv_attr)
+                if cls_name:
+                    for cand in self.model.classes.get(cls_name, ()):
+                        method = self.model.resolve_method(cand, func.attr)
+                        if method is not None:
+                            return method
+        # ClassName.m(...)
+        if isinstance(recv, ast.Name):
+            for cand in self.model.classes.get(recv.id, ()):
+                method = self.model.resolve_method(cand, func.attr)
+                if method is not None:
+                    return method
+        return None
+
+    def _emit(self, rule: str, line: int, message: str) -> None:
+        if self.func.module.suppressed(line, rule):
+            return
+        self.findings.append(Finding(
+            rule, self.func.module.path, line, message, self.func.qualname
+        ))
+
+
+class LockConfig:
+    """Repo-specific knowledge injected by the CLI; the pass itself
+    stays generic."""
+
+    def __init__(
+        self,
+        jit_dispatch_names: Sequence[str] = (),
+        receiver_types: Optional[Dict[str, str]] = None,
+    ) -> None:
+        self.jit_dispatch_names = tuple(jit_dispatch_names)
+        # plain-variable receiver -> class name, for `with state.lock:`
+        # patterns where the lock owner is a closure variable not self
+        self.receiver_types = dict(receiver_types or {})
+
+
+def run_lock_pass(
+    modules: Sequence[SourceFile], config: Optional[LockConfig] = None
+) -> List[Finding]:
+    config = config or LockConfig()
+    model = LockModel(modules)
+    findings: List[Finding] = []
+
+    walkers = []
+    for func in model.functions:
+        walker = _FunctionWalker(model, func, config)
+        walker.walk()
+        walkers.append(walker)
+        findings.extend(walker.findings)
+
+    _fixpoint_transitive_locks(model)
+    findings.extend(_order_findings(model))
+    findings.extend(_signal_handler_findings(model))
+    return findings
+
+
+def _fixpoint_transitive_locks(model: LockModel) -> None:
+    """Per-function set of lock ids (transitively) acquired by calling
+    it, and of *blocking* acquisitions for the signal rule."""
+    for func in model.functions:
+        func.transitive_locks = {
+            lock_id for lock_id, _, _, _ in func.acquisitions
+        }
+        func.transitive_blocking = {
+            lock_id for lock_id, _, _, blocking in func.acquisitions
+            if blocking
+        }
+    changed = True
+    rounds = 0
+    while changed and rounds < 20:
+        changed = False
+        rounds += 1
+        for func in model.functions:
+            for _, _, target, _ in func.calls:
+                if target is None or target is func:
+                    continue
+                if not target.transitive_locks <= func.transitive_locks:
+                    func.transitive_locks |= target.transitive_locks
+                    changed = True
+                if not target.transitive_blocking <= func.transitive_blocking:
+                    func.transitive_blocking |= target.transitive_blocking
+                    changed = True
+
+
+def _order_findings(model: LockModel) -> List[Finding]:
+    # edge (a -> b): while holding a, b is (transitively) acquired
+    edges: Dict[Tuple[str, str], Tuple[str, int, str]] = {}
+
+    def add_edge(a: str, b: str, func: _FuncInfo, line: int) -> None:
+        if a == b:
+            return  # self-nesting reported lexically by the walker
+        edges.setdefault((a, b), (func.module.path, line, func.qualname))
+
+    for func in model.functions:
+        for lock_id, line, held, _ in func.acquisitions:
+            for h in held:
+                add_edge(h, lock_id, func, line)
+        for line, held, target, _ in func.calls:
+            if target is None or not held:
+                continue
+            for lock_id in target.transitive_locks:
+                for h in held:
+                    add_edge(h, lock_id, func, line)
+
+    graph: Dict[str, Set[str]] = {}
+    for (a, b) in edges:
+        graph.setdefault(a, set()).add(b)
+
+    def reachable(start: str, goal: str) -> Optional[List[str]]:
+        stack, seen = [(start, [start])], {start}
+        while stack:
+            node, trail = stack.pop()
+            for nxt in graph.get(node, ()):
+                if nxt == goal:
+                    return trail + [nxt]
+                if nxt not in seen:
+                    seen.add(nxt)
+                    stack.append((nxt, trail + [nxt]))
+        return None
+
+    findings: List[Finding] = []
+    reported: Set[Tuple[str, ...]] = set()
+    for (a, b), (path, line, qualname) in sorted(edges.items()):
+        trail = reachable(b, a)
+        if trail is None:
+            continue
+        cycle_key = tuple(sorted({a, b, *trail}))
+        if cycle_key in reported:
+            continue
+        reported.add(cycle_key)
+        back = " -> ".join(trail)
+        back_site = edges.get((trail[0], trail[1]))
+        module = next(m for m in model.modules if m.path == path)
+        if module.suppressed(line, "lock-order-inversion"):
+            continue
+        findings.append(Finding(
+            "lock-order-inversion", path, line,
+            f"'{a}' -> '{b}' here, but the reverse path {back} exists "
+            f"(first seen at {back_site[0]}:{back_site[1]} in "
+            f"{back_site[2]}) — ABBA deadlock under contention",
+            qualname,
+        ))
+    return findings
+
+
+def _signal_handler_findings(model: LockModel) -> List[Finding]:
+    """Blocking lock acquisition reachable from a signal handler.
+
+    Reachability is same-module and name-conservative: local function
+    calls resolve against every function in the module, `obj.m(...)`
+    against every same-module class method named `m` — a handler runs
+    on the main thread mid-bytecode, so err toward flagging."""
+    findings: List[Finding] = []
+    for module in model.modules:
+        funcs_by_name: Dict[str, List[_FuncInfo]] = {}
+        method_names: Dict[str, List[_FuncInfo]] = {}
+        for func in model.functions:
+            if func.module is not module:
+                continue
+            funcs_by_name.setdefault(func.node.name, []).append(func)
+            if func.owner is not None:
+                method_names.setdefault(func.node.name, []).append(func)
+
+        def blocking_reach(func: _FuncInfo, seen: Set[int]):
+            if id(func) in seen:
+                return None
+            seen.add(id(func))
+            for lock_id, line, _, blocking in func.acquisitions:
+                if blocking:
+                    return (func, lock_id, line)
+            for node in ast.walk(func.node):
+                if not isinstance(node, ast.Call):
+                    continue
+                if isinstance(node.func, ast.Name):
+                    cands = funcs_by_name.get(node.func.id, ())
+                elif isinstance(node.func, ast.Attribute):
+                    cands = method_names.get(node.func.attr, ())
+                else:
+                    cands = ()
+                for cand in cands:
+                    hit = blocking_reach(cand, seen)
+                    if hit is not None:
+                        return hit
+            # `with self._lock` in a method shows up as acquisition
+            # already; nothing else to do
+            return None
+
+        for func in model.functions:
+            if func.module is not module:
+                continue
+            for node in ast.walk(func.node):
+                if not isinstance(node, ast.Call):
+                    continue
+                name = dotted_name(node.func) or ""
+                if not (name == "signal" or name.endswith(".signal")):
+                    continue
+                if len(node.args) < 2:
+                    continue
+                handler = node.args[1]
+                cands: List[_FuncInfo] = []
+                if isinstance(handler, ast.Name):
+                    cands = list(funcs_by_name.get(handler.id, ()))
+                for cand in cands:
+                    hit = blocking_reach(cand, set())
+                    if hit is None:
+                        continue
+                    where, lock_id, line = hit
+                    if module.suppressed(node.lineno, "signal-handler-lock"):
+                        continue
+                    findings.append(Finding(
+                        "signal-handler-lock", module.path, node.lineno,
+                        f"signal handler '{handler.id}' reaches a blocking "
+                        f"acquire of '{lock_id}' "
+                        f"({where.module.path}:{line} in {where.qualname}) "
+                        f"— deadlocks if the signal lands while the main "
+                        f"thread holds it",
+                        func.qualname or module.module_name,
+                    ))
+                    break
+    return findings

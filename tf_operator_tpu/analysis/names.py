@@ -1,27 +1,26 @@
-"""Minimal AST linter: undefined names + unused imports (VERDICT r3 #7).
+"""Residual name lint: the hack/lint.py rules, folded into graftlint.
 
-The reference runs real lint in its py-test CI step
-(/root/reference/py/kubeflow/tf_operator/py_checks.py); this image has
-no pyflakes/flake8/ruff, so this is a small, conservative
-reimplementation of the two highest-value checks:
+This started life as the vendored two-check linter (hack/lint.py, now
+deleted): this image ships no pyflakes/ruff, so the highest-value
+pyflakes checks are reimplemented conservatively — zero false
+positives matter more than coverage (a noisy lint gate gets deleted).
 
-- F821 undefined-name: a Name load that no enclosing scope binds.
-- F401 unused-import: an import binding never referenced in the module.
+Rules:
 
-Conservative by construction — zero false positives matter more than
-coverage (a noisy lint gate gets deleted):
+- ``undefined-name`` (F821) — a Name load no enclosing scope binds.
+- ``unused-import`` (F401) — an import binding never referenced.
+- ``redefinition`` (F811) — a def/class/import name bound twice in the
+  same statement list (conditional redefinitions in if/try bodies are
+  separate lists and never flag; @overload / @property-setter chains
+  are exempt).
+- ``mutable-default-arg`` — a list/dict/set literal (or constructor
+  call) as a parameter default: shared across calls, the classic
+  aliasing bug.
+- ``bare-except-pass`` — `except: pass` silently eats KeyboardInterrupt
+  and real faults alike.
 
-- binding collection is whole-scope (no use-before-def analysis), so
-  ordering never trips it;
-- `from x import *` disables undefined-name checks for that file;
-- `__init__.py` files and `... as ...` self-re-exports (PEP 484 style,
-  `import x as x`) are exempt from unused-import;
-- a `# noqa` comment on the line suppresses findings on it;
-- names in `__all__` string lists count as uses.
-
-Exit 1 with file:line findings; exit 0 clean.
-
-    python hack/lint.py tf_operator_tpu tests bench.py
+Suppression: the historical `# noqa` comment (kept so existing
+annotations keep working) or `# graftlint: disable=<rule>`.
 """
 
 from __future__ import annotations
@@ -29,8 +28,10 @@ from __future__ import annotations
 import ast
 import builtins
 import os
-import sys
-from typing import Dict, List, Optional, Set, Tuple
+import re
+from typing import Dict, List, Optional, Sequence, Set, Tuple
+
+from .core import Finding, SourceFile
 
 BUILTIN_NAMES = set(dir(builtins)) | {
     "__file__", "__name__", "__doc__", "__builtins__", "__spec__",
@@ -92,8 +93,6 @@ def _collect_stmt(stmt: ast.stmt, scope: Scope) -> None:
             if item.optional_vars is not None:
                 _bind_target(item.optional_vars, scope)
     elif isinstance(stmt, ast.Global):
-        # treat as bound here (actual binding is at module level; the
-        # module pass sees the assignment too when it exists)
         scope.bindings.update(stmt.names)
     elif isinstance(stmt, ast.Nonlocal):
         scope.bindings.update(stmt.names)
@@ -105,15 +104,12 @@ def _collect_stmt(stmt: ast.stmt, scope: Scope) -> None:
         for case in stmt.cases:
             _bind_pattern(case.pattern, scope)
     # walrus operators anywhere in expressions of this statement bind
-    # into this scope (approximation: also true inside comprehensions,
-    # where the real target is the enclosing function — same set here)
+    # into this scope
     for node in ast.walk(stmt):
         if isinstance(node, ast.NamedExpr):
             _bind_target(node.target, scope)
         elif isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
                                ast.ClassDef, ast.Lambda)):
-            # don't harvest walruses from nested scopes... except walrus
-            # technically escapes comprehensions; acceptable slack
             continue
     # descend into control-flow bodies
     for field in ("body", "orelse", "finalbody"):
@@ -153,15 +149,16 @@ def _visible(name: str, scope: Scope) -> bool:
     return name in BUILTIN_NAMES
 
 
-class Linter(ast.NodeVisitor):
-    def __init__(self, path: str, source: str, tree: ast.Module):
-        self.path = path
-        self.findings: List[Tuple[int, str]] = []
+class _NameChecker(ast.NodeVisitor):
+    def __init__(self, module: SourceFile):
+        self.module = module
+        self.findings: List[Tuple[int, str, str]] = []  # (line, rule, msg)
         self.noqa_lines = {
             i + 1
-            for i, line in enumerate(source.splitlines())
+            for i, line in enumerate(module.lines)
             if "# noqa" in line
         }
+        tree = module.tree
         self.has_star_import = any(
             isinstance(node, ast.ImportFrom)
             and any(alias.name == "*" for alias in node.names)
@@ -197,6 +194,8 @@ class Linter(ast.NodeVisitor):
             self.visit(node.returns)
         for dec in getattr(node, "decorator_list", ()):  # Lambda has none
             self.visit(dec)
+        if not isinstance(node, ast.Lambda):
+            self._check_mutable_defaults(node)
         outer = self._enter(node, "function")
         for arg in (
             args.posonlyargs + args.args + args.kwonlyargs
@@ -209,6 +208,7 @@ class Linter(ast.NodeVisitor):
             self.visit(node.body)
         else:
             _collect_bindings(node.body, self.scope)
+            self._check_redefinitions(node.body)
             for stmt in body:
                 self.visit(stmt)
         self.scope = outer
@@ -229,6 +229,7 @@ class Linter(ast.NodeVisitor):
             self.visit(dec)
         outer = self._enter(node, "class")
         _collect_bindings(node.body, self.scope)
+        self._check_redefinitions(node.body)
         for stmt in node.body:
             self.visit(stmt)
         self.scope = outer
@@ -258,20 +259,25 @@ class Linter(ast.NodeVisitor):
 
     # -- checks ------------------------------------------------------------
 
+    def _note(self, line: int, rule: str, msg: str) -> None:
+        if line in self.noqa_lines:
+            return
+        if self.module.suppressed(line, rule):
+            return
+        self.findings.append((line, rule, msg))
+
     def visit_Name(self, node: ast.Name) -> None:
         if isinstance(node.ctx, ast.Load):
             self.used_names.add(node.id)
             if (
                 not self.has_star_import
-                and node.lineno not in self.noqa_lines
                 and not _visible(node.id, self.scope)
             ):
-                self.findings.append(
-                    (node.lineno, f"undefined name '{node.id}'")
+                self._note(
+                    node.lineno, "undefined-name",
+                    f"undefined name '{node.id}'",
                 )
         elif isinstance(node.ctx, (ast.Store, ast.Del)):
-            # walrus/loop binds inside comprehension visits land here;
-            # record so nested scopes resolving upward still see them
             self.scope.bindings.add(node.id)
         self.generic_visit(node)
 
@@ -288,20 +294,93 @@ class Linter(ast.NodeVisitor):
     def visit_ExceptHandler(self, node) -> None:
         if node.name:
             self.scope.bindings.add(node.name)
+        if (
+            node.type is None
+            and len(node.body) == 1
+            and isinstance(node.body[0], ast.Pass)
+        ):
+            self._note(
+                node.lineno, "bare-except-pass",
+                "bare 'except: pass' swallows KeyboardInterrupt and real "
+                "faults alike — catch a concrete exception or log",
+            )
         self.generic_visit(node)
 
     def visit_Constant(self, node: ast.Constant) -> None:
         # quoted annotations / typing strings: harvest identifier-like
-        # tokens (incl. the base of dotted paths) as "uses" so
-        # `if TYPE_CHECKING:` imports referenced only in string
-        # annotations don't flag as unused (they are NOT name-checked —
-        # conservative)
+        # tokens as "uses" so TYPE_CHECKING imports referenced only in
+        # string annotations don't flag as unused
         if isinstance(node.value, str) and len(node.value) < 200:
-            import re
-
             self.used_names.update(
                 re.findall(r"[A-Za-z_][A-Za-z0-9_]*", node.value)
             )
+
+    # -- new graftlint rules -----------------------------------------------
+
+    def _check_mutable_defaults(self, node) -> None:
+        defaults = list(node.args.defaults) + [
+            d for d in node.args.kw_defaults if d is not None
+        ]
+        for default in defaults:
+            mutable = isinstance(default, (ast.List, ast.Dict, ast.Set))
+            if not mutable and isinstance(default, ast.Call):
+                ctor = default.func
+                mutable = isinstance(ctor, ast.Name) and ctor.id in (
+                    "list", "dict", "set", "bytearray",
+                )
+            if mutable:
+                self._note(
+                    default.lineno, "mutable-default-arg",
+                    f"mutable default argument in {node.name}() is shared "
+                    f"across calls — default to None and create inside",
+                )
+
+    _REDEF_EXEMPT_DECORATORS = ("overload", "setter", "deleter", "getter")
+
+    def _check_redefinitions(self, body: List[ast.stmt]) -> None:
+        """F811 within ONE statement list: conditional redefinitions
+        (if/try bodies) are separate lists and never flag."""
+        bound: Dict[str, int] = {}
+        for stmt in body:
+            names: List[Tuple[str, int]] = []
+            if isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                 ast.ClassDef)):
+                decorators = getattr(stmt, "decorator_list", [])
+                exempt = False
+                for dec in decorators:
+                    target = dec.func if isinstance(dec, ast.Call) else dec
+                    tail = (
+                        target.attr if isinstance(target, ast.Attribute)
+                        else target.id if isinstance(target, ast.Name)
+                        else ""
+                    )
+                    if tail in self._REDEF_EXEMPT_DECORATORS:
+                        exempt = True
+                if exempt:
+                    continue
+                names = [(stmt.name, stmt.lineno)]
+            elif isinstance(stmt, (ast.Import, ast.ImportFrom)):
+                for alias in stmt.names:
+                    if alias.name == "*":
+                        continue
+                    # plain `import urllib.request` + `import
+                    # urllib.error` both bind `urllib` — compare the
+                    # FULL dotted module, not the bound name
+                    if isinstance(stmt, ast.Import) and alias.asname is None:
+                        names.append((alias.name, stmt.lineno))
+                    else:
+                        names.append((
+                            alias.asname or alias.name.split(".")[0],
+                            stmt.lineno,
+                        ))
+            for name, line in names:
+                if name in bound:
+                    self._note(
+                        line, "redefinition",
+                        f"redefinition of '{name}' (first bound at line "
+                        f"{bound[name]}) shadows the earlier def/import",
+                    )
+                bound[name] = line
 
     # -- imports -----------------------------------------------------------
 
@@ -328,62 +407,36 @@ class Linter(ast.NodeVisitor):
                         continue
                     self.imports[bound] = (node.lineno, alias.name)
 
-    def unused_imports(self) -> List[Tuple[int, str]]:
+    def unused_imports(self) -> List[Tuple[int, str, str]]:
         out = []
         for bound, (lineno, shown) in self.imports.items():
             if bound not in self.used_names:
-                out.append((lineno, f"'{shown}' imported but unused"))
+                if self.module.suppressed(lineno, "unused-import"):
+                    continue
+                out.append((
+                    lineno, "unused-import",
+                    f"'{shown}' imported but unused",
+                ))
         return out
 
 
-def lint_file(path: str, check_unused_imports: bool = True) -> List[str]:
-    with open(path, encoding="utf-8") as handle:
-        source = handle.read()
-    try:
-        tree = ast.parse(source, filename=path)
-    except SyntaxError as err:
-        return [f"{path}:{err.lineno}: syntax error: {err.msg}"]
-    linter = Linter(path, source, tree)
-    for stmt in tree.body:
-        linter.visit(stmt)
-    findings = list(linter.findings)
-    if check_unused_imports and os.path.basename(path) != "__init__.py":
-        linter.collect_imports()
-        findings.extend(linter.unused_imports())
-    findings.sort()
-    return [f"{path}:{line}: {msg}" for line, msg in findings]
+def check_module(module: SourceFile) -> List[Finding]:
+    checker = _NameChecker(module)
+    for stmt in module.tree.body:
+        checker.visit(stmt)
+    checker._check_redefinitions(module.tree.body)
+    rows = list(checker.findings)
+    if os.path.basename(module.path) != "__init__.py":
+        checker.collect_imports()
+        rows.extend(checker.unused_imports())
+    rows.sort()
+    return [
+        Finding(rule, module.path, line, msg) for line, rule, msg in rows
+    ]
 
 
-def iter_py_files(paths: List[str]):
-    for path in paths:
-        if os.path.isfile(path):
-            yield path
-            continue
-        for root, dirs, files in os.walk(path):
-            dirs[:] = [
-                d for d in dirs
-                if d not in ("__pycache__", ".git", "build", "_artifacts")
-            ]
-            for name in sorted(files):
-                if name.endswith(".py"):
-                    yield os.path.join(root, name)
-
-
-def main(argv: Optional[List[str]] = None) -> int:
-    argv = argv if argv is not None else sys.argv[1:]
-    if not argv:
-        print("usage: lint.py PATH [PATH...]", file=sys.stderr)
-        return 2
-    total = 0
-    for path in iter_py_files(argv):
-        for finding in lint_file(path):
-            print(finding)
-            total += 1
-    if total:
-        print(f"lint: {total} finding(s)", file=sys.stderr)
-        return 1
-    return 0
-
-
-if __name__ == "__main__":
-    sys.exit(main())
+def run_names_pass(modules: Sequence[SourceFile]) -> List[Finding]:
+    findings: List[Finding] = []
+    for module in modules:
+        findings.extend(check_module(module))
+    return findings

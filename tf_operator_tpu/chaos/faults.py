@@ -9,10 +9,11 @@ exactly from its seed (the determinism contract in docs/chaos.md).
 from __future__ import annotations
 
 import dataclasses
-import threading
 from typing import Dict, List, Optional, Tuple
 
 from ..telemetry.flight import FlightRecorder, default_flight
+
+from ..utils import locks
 
 # -- fault kinds ------------------------------------------------------------
 
@@ -100,7 +101,7 @@ class FaultLog:
         flight: Optional[FlightRecorder] = None,
         seed: Optional[int] = None,
     ) -> None:
-        self._lock = threading.Lock()
+        self._lock = locks.make_lock("FaultLog._lock")
         self._records: List[FaultRecord] = []
         self._flight = flight
         self.seed = seed

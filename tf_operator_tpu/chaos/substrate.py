@@ -29,13 +29,13 @@ under test must converge anyway — that is the whole point.
 from __future__ import annotations
 
 import dataclasses
-import threading
 import time
 from typing import Any, Dict, List, Optional, Tuple
 
 from ..api.serde import deep_copy
 from ..runtime.kube import ApiError
 from ..runtime.substrate import ADDED, Conflict, DELETED, MODIFIED
+from ..utils import locks
 from .faults import (
     FAULT_API_ERROR,
     FAULT_CONFLICT,
@@ -80,7 +80,7 @@ class ChaosSubstrate:
         self.metrics = metrics
         self.fault_log = FaultLog(flight=flight, seed=self.config.seed)
         self.rng = random.Random(self.config.seed)
-        self._lock = threading.RLock()
+        self._lock = locks.make_rlock("ChaosSubstrate._lock")
         self._counts: Dict[str, int] = {}
         # watch interposition: we are the only subscriber the inner
         # substrate sees; real subscribers register here so a "stream"
@@ -187,6 +187,7 @@ class ChaosSubstrate:
     # -- watch interposition ----------------------------------------------
 
     def subscribe(self, kind: str, callback) -> None:
+        register = None
         with self._lock:
             self._subs.setdefault(kind, []).append(callback)
             if kind not in self._forwarders:
@@ -194,7 +195,16 @@ class ChaosSubstrate:
                     self._on_inner_event(_kind, verb, obj)
 
                 self._forwarders[kind] = forwarder
-                self.inner.subscribe(kind, forwarder)
+                register = forwarder
+        if register is not None:
+            # registration with the inner substrate happens OUTSIDE our
+            # lock: inner.subscribe takes inner's own lock, and inner's
+            # watch thread calls back into _on_inner_event which takes
+            # ours — holding ours across the call is the ABBA recipe
+            # (graftlint: callback-under-lock). The _forwarders entry
+            # recorded above keeps a concurrent subscribe from
+            # double-registering.
+            self.inner.subscribe(kind, register)
 
     def unsubscribe(self, kind: str, callback) -> None:
         with self._lock:

@@ -18,13 +18,14 @@ in /debug/flightz even when the substrate shows one rolled-up Event.
 from __future__ import annotations
 
 import logging
-import threading
 from collections import OrderedDict
 from typing import Optional, Tuple
 
 from ..api import k8s
 from ..telemetry.flight import FlightRecorder, default_flight
 from .substrate import Substrate, now_iso
+
+from ..utils import locks
 
 logger = logging.getLogger("tf_operator_tpu.events")
 
@@ -43,7 +44,7 @@ class EventRecorder:
         self._substrate = substrate
         self.component = component
         self._flight = flight
-        self._lock = threading.Lock()
+        self._lock = locks.make_lock("EventRecorder._lock")
         self._agg: "OrderedDict[Tuple[str, str, str, str], k8s.Event]" = (
             OrderedDict()
         )

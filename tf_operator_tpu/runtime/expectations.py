@@ -11,9 +11,10 @@ SURVEY.md §7 ranks this the #2 hard part.
 
 from __future__ import annotations
 
-import threading
 import time
 from typing import Dict, Tuple
+
+from ..utils import locks
 
 EXPECTATION_TTL_SECONDS = 5 * 60.0  # k8s ExpectationsTimeout
 
@@ -21,7 +22,7 @@ EXPECTATION_TTL_SECONDS = 5 * 60.0  # k8s ExpectationsTimeout
 class ControllerExpectations:
     def __init__(self, ttl: float = EXPECTATION_TTL_SECONDS) -> None:
         self._ttl = ttl
-        self._lock = threading.Lock()
+        self._lock = locks.make_lock("ControllerExpectations._lock")
         # key -> (adds_expected, deletes_expected, timestamp)
         self._store: Dict[str, Tuple[int, int, float]] = {}
 

@@ -27,12 +27,13 @@ from __future__ import annotations
 
 import logging
 import random
-import threading
 import time
 import urllib.error
 from typing import Callable, Iterator, Optional
 
 from ..telemetry.flight import flight_record
+
+from ..utils import locks
 
 logger = logging.getLogger("tf_operator_tpu.retry")
 
@@ -73,7 +74,7 @@ class RetryPolicy:
         self.max_delay = max_delay
         self.sleep = sleep
         self._rng = rng or random.Random()
-        self._lock = threading.Lock()
+        self._lock = locks.make_lock("RetryPolicy._lock")
 
     def _uniform(self, low: float, high: float) -> float:
         with self._lock:

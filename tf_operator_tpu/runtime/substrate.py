@@ -23,12 +23,13 @@ from __future__ import annotations
 import dataclasses
 import datetime
 import itertools
-import threading
 from typing import Any, Callable, Dict, List, Optional, Protocol, Tuple
 
 from ..api import k8s
 from ..api.serde import deep_copy
 from ..api.types import TFJob
+
+from ..utils import locks
 
 ADDED = "ADDED"
 MODIFIED = "MODIFIED"
@@ -145,7 +146,7 @@ class InMemorySubstrate:
     """
 
     def __init__(self) -> None:
-        self._lock = threading.RLock()
+        self._lock = locks.make_rlock("InMemorySubstrate._lock")
         self._uid = itertools.count(1)
         self._rv = itertools.count(1)
         self._jobs: Dict[Tuple[str, str], TFJob] = {}

@@ -19,6 +19,8 @@ import threading
 import time
 from typing import Dict, Hashable, Optional, Set
 
+from ..utils import locks
+
 
 class ExponentialBackoff:
     """Per-item exponential failure backoff (client-go
@@ -44,7 +46,7 @@ class ExponentialBackoff:
         self._rng = rng or random.Random()
         self._failures: Dict[Hashable, int] = {}
         self._prev_delay: Dict[Hashable, float] = {}
-        self._lock = threading.Lock()
+        self._lock = locks.make_lock("ExponentialBackoff._lock")
 
     def when(self, item: Hashable) -> float:
         with self._lock:
@@ -86,7 +88,7 @@ class WorkQueue:
     """
 
     def __init__(self, metrics=None) -> None:
-        self._cond = threading.Condition()
+        self._cond = locks.make_condition("WorkQueue._cond")
         self._queue: list = []
         self._dirty: Set[Hashable] = set()
         self._processing: Set[Hashable] = set()
@@ -96,6 +98,10 @@ class WorkQueue:
         self._started_at: Dict[Hashable, float] = {}
 
     def add(self, item: Hashable) -> None:
+        # metric hooks run AFTER the condition is released: they are
+        # caller-supplied code and may take their own locks or call
+        # back into this queue (graftlint: callback-under-lock)
+        depth = None
         with self._cond:
             if self._shutting_down or item in self._dirty:
                 return
@@ -104,11 +110,14 @@ class WorkQueue:
                 self._queue.append(item)
                 if self._metrics is not None:
                     self._added_at.setdefault(item, time.monotonic())
-                    self._metrics.on_add(len(self._queue))
+                    depth = len(self._queue)
                 self._cond.notify()
+        if depth is not None:
+            self._metrics.on_add(depth)
 
     def get(self, timeout: Optional[float] = None) -> Optional[Hashable]:
         """Block for the next item; None on shutdown-and-drained or timeout."""
+        got = None
         with self._cond:
             deadline = None if timeout is None else time.monotonic() + timeout
             while not self._queue:
@@ -124,24 +133,28 @@ class WorkQueue:
             if self._metrics is not None:
                 now = time.monotonic()
                 self._started_at[item] = now
-                self._metrics.on_get(
-                    now - self._added_at.pop(item, now), len(self._queue)
-                )
-            return item
+                got = (now - self._added_at.pop(item, now), len(self._queue))
+        if got is not None:
+            self._metrics.on_get(*got)
+        return item
 
     def done(self, item: Hashable) -> None:
+        work_seconds = None
+        depth = None
         with self._cond:
             self._processing.discard(item)
             if self._metrics is not None and item in self._started_at:
-                self._metrics.on_done(
-                    time.monotonic() - self._started_at.pop(item)
-                )
+                work_seconds = time.monotonic() - self._started_at.pop(item)
             if item in self._dirty:
                 self._queue.append(item)
                 if self._metrics is not None:
                     self._added_at.setdefault(item, time.monotonic())
-                    self._metrics.on_add(len(self._queue))
+                    depth = len(self._queue)
                 self._cond.notify()
+        if work_seconds is not None:
+            self._metrics.on_done(work_seconds)
+        if depth is not None:
+            self._metrics.on_add(depth)
 
     def shut_down(self) -> None:
         with self._cond:
@@ -158,7 +171,7 @@ class DelayingQueue(WorkQueue):
 
     def __init__(self, metrics=None) -> None:
         super().__init__(metrics=metrics)
-        self._timer_lock = threading.Lock()
+        self._timer_lock = locks.make_lock("DelayingQueue._timer_lock")
         self._timers: Set[threading.Timer] = set()
 
     def add_after(self, item: Hashable, delay: float) -> None:

@@ -43,6 +43,7 @@ import time
 import numpy as np
 
 from ..telemetry.flight import current_correlation, default_flight
+from ..utils import locks
 
 _DONE = object()
 
@@ -196,6 +197,10 @@ class ContinuousBatchingEngine:
         self._free = list(range(s))
         self._queue: queue.Queue = queue.Queue()
         self._stop = threading.Event()
+        # serializes submit's stopped-check+enqueue against stop's
+        # drain: without it a put can land after the drain and strand
+        # the client until its result() timeout
+        self._lifecycle = locks.make_lock("ContinuousBatchingEngine._lifecycle")
         # counters (engine thread writes, observers read — stale reads
         # are fine for monitoring)
         self.steps = 0
@@ -290,7 +295,13 @@ class ContinuousBatchingEngine:
             "serve", corr=corr, op="submit",
             prompt_tokens=len(row), new=new,
         )
-        self._queue.put(req)
+        with self._lifecycle:
+            # re-check under the lock: stop() drains the queue under
+            # the same lock, so a put here either precedes the drain
+            # (and gets failed by it) or raises
+            if self._stop.is_set():
+                raise RuntimeError("engine is stopped")
+            self._queue.put(req)
         return req
 
     def generate(self, prompt, lens, new: int, timeout: float = 600.0):
@@ -319,11 +330,16 @@ class ContinuousBatchingEngine:
         if self.thread is not None:
             self.thread.join(timeout=10)
         stopped = RuntimeError("engine is stopped")
-        while True:  # fail queued requests so waiters don't hang
-            try:
-                req = self._queue.get_nowait()
-            except queue.Empty:
-                break
+        drained = []
+        with self._lifecycle:
+            # under the lifecycle lock no submit can enqueue between
+            # this drain and the stopped flag it already observed
+            while True:
+                try:
+                    drained.append(self._queue.get_nowait())
+                except queue.Empty:
+                    break
+        for req in drained:  # fail queued requests so waiters don't hang
             req._finish(stopped)
         for slot, req in enumerate(self._reqs):
             if req is not None:

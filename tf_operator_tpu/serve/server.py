@@ -66,6 +66,8 @@ from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from ..telemetry.flight import correlate, default_flight, render_flightz
 from . import export as export_mod
 
+from ..utils import locks
+
 logger = logging.getLogger("tf_operator_tpu.serve")
 
 # request correlation IDs: every POST gets req-N, bound for the whole
@@ -133,7 +135,7 @@ class _State:
         # the mesh-placed params under GSPMD and matches single-device
         # output (tests/test_serve.py TestShardedServing pins the
         # greedy path; beams share the same placed tree)
-        self.lock = threading.Lock()
+        self.lock = locks.make_lock("_State.lock")
         self.batcher = None  # set by make_server (batching="window")
         self.engine = None  # set by make_server (batching="continuous")
         # one labeled-metric registry + span tracer per server — the
@@ -178,7 +180,7 @@ class _State:
             "decodes_inflight",
             "Device decodes dispatched and not yet finished",
         )
-        self.inflight_lock = threading.Lock()
+        self.inflight_lock = locks.make_lock("_State.inflight_lock")
 
     decodes = _registry_scalar("_c_decodes")
     decode_batches = _registry_scalar("_c_decode_batches")

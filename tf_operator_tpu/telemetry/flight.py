@@ -43,9 +43,10 @@ import json
 import os
 import sys
 import tempfile
-import threading
 import time
 from typing import Any, Dict, Iterable, List, NamedTuple, Optional
+
+from ..utils import locks
 
 __all__ = [
     "FlightRecord",
@@ -138,7 +139,7 @@ class FlightRecorder:
         self.capacity = int(capacity)
         self.enabled = bool(enabled)
         self._clock = clock
-        self._lock = threading.Lock()
+        self._lock = locks.make_lock("FlightRecorder._lock")
         # preallocated ring: record() stores into an existing slot, it
         # never grows a list (no realloc jitter on the hot path)
         self._buf: List[Optional[FlightRecord]] = [None] * self.capacity
@@ -216,6 +217,33 @@ class FlightRecorder:
             )
         with open(path, "w") as f:
             f.write(self.to_jsonl(**filters))
+        return path
+
+    def crash_dump(self, path: str) -> str:
+        """Crash/signal-safe dump: never blocks indefinitely on the
+        ring lock. A signal handler runs on the main thread *between
+        bytecodes* — if the signal lands while this thread is inside
+        record() holding self._lock, a blocking acquire here would
+        deadlock the process (graftlint: signal-handler-lock). Take
+        the lock with a short timeout and, on failure, fall back to a
+        racy copy: slots are replaced whole, never mutated in place,
+        so the worst case is one torn (missing/duplicate) record in a
+        postmortem artifact."""
+        acquired = self._lock.acquire(timeout=0.25)
+        try:
+            seq = self._seq
+            buf = list(self._buf)
+        finally:
+            if acquired:
+                self._lock.release()
+        start = max(0, seq - self.capacity)
+        records = [
+            r for i in range(start, seq)
+            if (r := buf[i % self.capacity]) is not None
+        ]
+        with open(path, "w") as f:
+            for r in records:
+                f.write(json.dumps(r.to_dict()) + "\n")
         return path
 
 
@@ -311,7 +339,9 @@ def install_crash_handlers(
     def write_dump(tag: str) -> Optional[str]:
         path = os.path.join(directory, f"flight-{tag}-{os.getpid()}.jsonl")
         try:
-            rec.dump(path)
+            # crash_dump, not dump: both callers (excepthook, signal
+            # handler) can fire while THIS thread holds the ring lock
+            rec.crash_dump(path)
         except OSError:
             return None
         handles.dumps.append(path)

@@ -25,8 +25,9 @@ from __future__ import annotations
 
 import bisect
 import math
-import threading
 from typing import Dict, List, Optional, Sequence, Tuple
+
+from ..utils import locks
 
 # Prometheus' classic latency spread — wide enough for TTFT and
 # whole-request times on anything from CPU-tiny to TPU decode.
@@ -195,7 +196,7 @@ class _Family:
         self.help = help_text
         self.labelnames = labelnames
         self.buckets = buckets
-        self._lock = threading.Lock()
+        self._lock = locks.make_lock("_Family._lock")
         self._values: Dict[Tuple[str, ...], object] = {}
         self._children: Dict[Tuple[str, ...], _Child] = {}
         if not labelnames:
@@ -319,7 +320,7 @@ class MetricRegistry:
 
     def __init__(self, prefix: str = "") -> None:
         self.prefix = prefix
-        self._lock = threading.Lock()
+        self._lock = locks.make_lock("MetricRegistry._lock")
         self._families: Dict[str, _Family] = {}
 
     def full_name(self, name: str) -> str:

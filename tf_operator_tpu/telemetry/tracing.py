@@ -25,10 +25,11 @@ from __future__ import annotations
 
 import contextvars
 import itertools
-import threading
 import time
 from collections import deque
 from typing import Dict, List, Optional
+
+from ..utils import locks
 
 # process-wide span ids: log lines carry span_id (utils/logger.py) and
 # join against the exported trace, so ids must be unique across tracers
@@ -120,7 +121,7 @@ class SpanTracer:
         process_name: str = "tf_operator_tpu",
     ) -> None:
         self._clock = clock if clock is not None else time.perf_counter
-        self._lock = threading.Lock()
+        self._lock = locks.make_lock("SpanTracer._lock")
         self._epoch = float(self._clock())
         self._finished: deque = deque(maxlen=capacity)
         self._tracks = itertools.count(1)

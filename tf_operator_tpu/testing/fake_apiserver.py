@@ -30,6 +30,8 @@ from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Dict, List, Optional, Tuple
 from urllib.parse import parse_qs, urlparse
 
+from ..utils import locks
+
 
 _KILL = b"__KILL_WATCH__"
 _HISTORY_LIMIT = 1000
@@ -39,7 +41,7 @@ class _Store:
     """All resources, keyed by (collection_path, namespace, name)."""
 
     def __init__(self) -> None:
-        self.lock = threading.RLock()
+        self.lock = locks.make_rlock("_Store.lock")
         self.objects: Dict[Tuple[str, str, str], dict] = {}
         self.rv = itertools.count(1)
         self.last_rv = 0

@@ -1,0 +1,287 @@
+"""Lock factory with an opt-in runtime lockdep (ISSUE 5 tentpole #3).
+
+Concurrent modules create their primitives through
+``make_lock("Class.attr")`` / ``make_rlock`` / ``make_condition``
+instead of calling ``threading.Lock()`` directly. With lockdep
+disabled (the default, and the production path) the factories return
+the plain ``threading`` primitives — zero overhead, nothing changes.
+
+With lockdep enabled (``enable_lockdep()``, or pytest ``--lockdep``)
+the factories return instrumented wrappers that:
+
+- record each thread's stack of currently-held lock *names* (names are
+  class-level, e.g. ``"WorkQueue._cond"``, so every instance of a class
+  maps to one node — the same granularity as the static pass);
+- maintain a global acquired-while-holding order graph, adding an edge
+  ``A -> B`` the first time any thread takes B while holding A;
+- on each new edge, check whether the reverse path already exists —
+  if it does, two threads interleaving those paths can deadlock (ABBA),
+  and a ``LockdepViolation`` carrying both acquisition stacks is
+  recorded (never raised: the detection point is an arbitrary hot
+  path; the pytest plugin fails the test afterwards instead).
+
+This is the runtime complement to the static lock-order pass in
+``tf_operator_tpu.analysis.lockgraph``: the static pass sees code that
+never runs in tests; lockdep sees orders the static resolver cannot
+prove (callbacks, dynamic dispatch). Kernel lockdep is the model: one
+observed run of each order is enough, no actual deadlock required.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+import traceback
+from typing import Dict, List, Optional, Set, Tuple
+
+
+class LockdepViolation:
+    """One detected order inversion: edge `a -> b` observed while the
+    path `b -> ... -> a` already exists in the order graph."""
+
+    __slots__ = ("a", "b", "cycle", "stack", "prior_stack", "thread")
+
+    def __init__(self, a: str, b: str, cycle: List[str], stack: str,
+                 prior_stack: str, thread: str) -> None:
+        self.a = a
+        self.b = b
+        self.cycle = cycle
+        self.stack = stack              # where a->b was taken
+        self.prior_stack = prior_stack  # where the first reverse edge was
+        self.thread = thread
+
+    def render(self) -> str:
+        chain = " -> ".join(self.cycle)
+        return (
+            f"lock-order inversion: '{self.a}' -> '{self.b}' on thread "
+            f"{self.thread}, but the order graph already holds "
+            f"{chain}\n--- this acquisition ---\n{self.stack}"
+            f"--- first reverse edge ---\n{self.prior_stack}"
+        )
+
+    def __repr__(self) -> str:  # pragma: no cover - debugging aid
+        return f"LockdepViolation({self.a!r} -> {self.b!r})"
+
+
+class _LockdepState:
+    def __init__(self) -> None:
+        self._mu = threading.Lock()
+        # name -> names acquired at least once while it was held
+        self.edges: Dict[str, Set[str]] = {}
+        # (a, b) -> formatted stack of the first observation
+        self.sites: Dict[Tuple[str, str], str] = {}
+        self.violations: List[LockdepViolation] = []
+        self._tls = threading.local()
+
+    def held(self) -> List[str]:
+        stack = getattr(self._tls, "held", None)
+        if stack is None:
+            stack = self._tls.held = []
+        return stack
+
+    # -- order graph -------------------------------------------------------
+
+    def _path(self, start: str, goal: str) -> Optional[List[str]]:
+        frontier = [(start, [start])]
+        seen = {start}
+        while frontier:
+            node, trail = frontier.pop()
+            for nxt in self.edges.get(node, ()):
+                if nxt == goal:
+                    return trail + [nxt]
+                if nxt not in seen:
+                    seen.add(nxt)
+                    frontier.append((nxt, trail + [nxt]))
+        return None
+
+    def on_acquire(self, name: str) -> None:
+        held = self.held()
+        if held:
+            new_edges = [h for h in held if h != name]
+            if new_edges:
+                stack = None
+                with self._mu:
+                    for h in new_edges:
+                        if name in self.edges.get(h, ()):
+                            continue
+                        reverse = self._path(name, h)
+                        if stack is None:
+                            stack = "".join(traceback.format_stack(limit=12))
+                        if reverse is not None:
+                            prior = self.sites.get(
+                                (reverse[0], reverse[1]), "<unknown>\n"
+                            )
+                            self.violations.append(LockdepViolation(
+                                h, name, reverse + [name], stack, prior,
+                                threading.current_thread().name,
+                            ))
+                        self.edges.setdefault(h, set()).add(name)
+                        self.sites.setdefault((h, name), stack)
+        held.append(name)
+
+    def on_release(self, name: str) -> None:
+        held = self.held()
+        for i in range(len(held) - 1, -1, -1):
+            if held[i] == name:
+                del held[i]
+                return
+
+
+_state: Optional[_LockdepState] = None
+
+
+def enable_lockdep() -> None:
+    """Locks created AFTER this call are instrumented; existing plain
+    locks stay plain (module-level locks created at import time are
+    outside lockdep's view — documented limitation)."""
+    global _state
+    if _state is None:
+        _state = _LockdepState()
+
+
+def disable_lockdep() -> None:
+    global _state
+    _state = None
+
+
+def lockdep_enabled() -> bool:
+    return _state is not None
+
+
+def lockdep_violations() -> List[LockdepViolation]:
+    return list(_state.violations) if _state is not None else []
+
+
+def clear_lockdep_violations() -> None:
+    if _state is not None:
+        with _state._mu:
+            _state.violations.clear()
+
+
+def reset_lockdep_graph() -> None:
+    """Drop recorded edges (test isolation between unrelated suites)."""
+    if _state is not None:
+        with _state._mu:
+            _state.edges.clear()
+            _state.sites.clear()
+            _state.violations.clear()
+
+
+# -- instrumented wrappers ---------------------------------------------------
+
+class _InstrumentedBase:
+    def __init__(self, name: str, inner, state: _LockdepState) -> None:
+        self._name = name
+        self._inner = inner
+        self._state = state
+
+    def acquire(self, blocking: bool = True, timeout: float = -1):
+        got = self._inner.acquire(blocking, timeout)
+        if got:
+            self._state.on_acquire(self._name)
+        return got
+
+    def release(self) -> None:
+        self._state.on_release(self._name)
+        self._inner.release()
+
+    def __enter__(self):
+        self.acquire()
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.release()
+
+    def __repr__(self) -> str:  # pragma: no cover - debugging aid
+        return f"<{type(self).__name__} {self._name!r} {self._inner!r}>"
+
+
+class InstrumentedLock(_InstrumentedBase):
+    def locked(self) -> bool:
+        return self._inner.locked()
+
+
+class InstrumentedRLock(_InstrumentedBase):
+    pass
+
+
+class InstrumentedCondition:
+    """Condition wrapper: wait() releases the underlying lock, so the
+    held-stack must drop the name for the duration and re-push it on
+    wake — otherwise every post-wait acquisition would look nested."""
+
+    def __init__(self, name: str, state: _LockdepState,
+                 lock=None) -> None:
+        self._name = name
+        self._state = state
+        self._cond = threading.Condition(lock)
+
+    def acquire(self, blocking: bool = True, timeout: float = -1):
+        got = self._cond.acquire(blocking, timeout)
+        if got:
+            self._state.on_acquire(self._name)
+        return got
+
+    def release(self) -> None:
+        self._state.on_release(self._name)
+        self._cond.release()
+
+    def __enter__(self):
+        self.acquire()
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.release()
+
+    def wait(self, timeout: Optional[float] = None) -> bool:
+        self._state.on_release(self._name)
+        try:
+            return self._cond.wait(timeout)
+        finally:
+            self._state.on_acquire(self._name)
+
+    def wait_for(self, predicate, timeout: Optional[float] = None):
+        # reimplemented so the inner cond's wait() goes through OUR
+        # wait() and the held-stack stays truthful
+        endtime = None
+        remaining = timeout
+        result = predicate()
+        while not result:
+            if remaining is not None:
+                if endtime is None:
+                    endtime = time.monotonic() + remaining
+                else:
+                    remaining = endtime - time.monotonic()
+                    if remaining <= 0:
+                        break
+            self.wait(remaining)
+            result = predicate()
+        return result
+
+    def notify(self, n: int = 1) -> None:
+        self._cond.notify(n)
+
+    def notify_all(self) -> None:
+        self._cond.notify_all()
+
+
+# -- factories ---------------------------------------------------------------
+
+def make_lock(name: str):
+    """A mutex named for the order graph; plain threading.Lock when
+    lockdep is off."""
+    if _state is None:
+        return threading.Lock()
+    return InstrumentedLock(name, threading.Lock(), _state)
+
+
+def make_rlock(name: str):
+    if _state is None:
+        return threading.RLock()
+    return InstrumentedRLock(name, threading.RLock(), _state)
+
+
+def make_condition(name: str, lock=None):
+    if _state is None:
+        return threading.Condition(lock)
+    return InstrumentedCondition(name, _state, lock)
