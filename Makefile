@@ -24,7 +24,7 @@ IMAGE_DIR := build/images
 DIST      := build/dist
 
 .PHONY: ci presubmit lint analyze native native-test native-race test wire-test e2e e2e-kind bench \
-        chaos-soak images release mnist-acc clean
+        chaos-soak serve-soak images release mnist-acc clean
 
 # `test` already runs the whole tests/ tree (native bindings, wire,
 # E2E suites included) — native-test/wire-test exist for targeted runs,
@@ -83,6 +83,12 @@ wire-test:
 # substrate (docs/chaos.md); the fast seeded variant runs in `test`
 chaos-soak:
 	$(PY) -m pytest tests/test_chaos.py -q -m slow
+
+# multi-seed serve-fleet failover soak (docs/serving.md): real engine
+# replicas killed mid-stream, streams asserted bit-identical; the
+# single-seed fast variant runs in `test` and CI's serve-failover-soak
+serve-soak:
+	env JAX_PLATFORMS=cpu $(PY) -m pytest tests/test_serve_fleet.py -q -m slow
 
 # Hermetic E2E runs everywhere (operator process <-HTTP-> apiserver
 # <-HTTP-> process kubelet); the kind path self-activates when kind is

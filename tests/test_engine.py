@@ -382,3 +382,90 @@ def test_queued_requests_fail_on_stop(params):
     eng.stop()
     with pytest.raises(RuntimeError, match="stopped"):
         req.result(1)
+
+
+class TestEngineLifecycle:
+    """Drain-based rolling updates and fail-fast stop semantics — the
+    engine half of the ServeService fleet contract (docs/serving.md)."""
+
+    @pytest.fixture(scope="class")
+    def params2(self):
+        return gpt_lib.GPT(CFG).init(
+            jax.random.PRNGKey(1), jnp.zeros((1, 8), jnp.int32)
+        )["params"]
+
+    def test_stop_mid_stream_fails_fast(self, params):
+        """An in-flight stream gets a terminal error promptly on
+        stop(), not a hang until the stream timeout."""
+        import time as _time
+
+        eng = ContinuousBatchingEngine(CFG, params, n_slots=2)
+        req = eng.submit([1, 2, 3], 100)
+        stream = req.stream(timeout=120)
+        next(stream)  # placed and decoding
+        started = _time.monotonic()
+        eng.stop()
+        with pytest.raises(RuntimeError, match="stopped"):
+            for _ in stream:
+                pass
+        assert _time.monotonic() - started < 15
+
+    def test_queued_requests_fail_fast_on_stop(self, params):
+        """Queued-behind-full-slots requests fail terminally on
+        stop(), with the engine thread RUNNING (the start=False
+        variant lives in test_queued_requests_fail_on_stop)."""
+        import time as _time
+
+        eng = ContinuousBatchingEngine(CFG, params, n_slots=1)
+        blocker = eng.submit([1, 2], 64)
+        queued = eng.submit([3, 4], 4)
+        stream = blocker.stream(timeout=120)
+        next(stream)  # blocker occupies the only slot
+        started = _time.monotonic()
+        eng.stop()
+        with pytest.raises(RuntimeError, match="stopped"):
+            queued.result(30)
+        assert _time.monotonic() - started < 15
+
+    def test_drain_swap_resume_rolls_weights(self, params, params2):
+        """The in-place rolling-update sequence: in-flight work
+        completes on the OLD weights, queued work held through the
+        drain decodes on the NEW weights, and the compiled step is
+        reused (no recompile: same shapes)."""
+        eng = ContinuousBatchingEngine(CFG, params, n_slots=2)
+        try:
+            r1 = eng.submit([1, 2, 3], 6)
+            stream = r1.stream(timeout=120)
+            next(stream)  # in a slot, decoding
+            eng.pause_admission()
+            assert eng.draining
+            with pytest.raises(RuntimeError, match="drained"):
+                eng.swap_params(params2)  # undrained: refused
+            r2 = eng.submit([4, 5], 3)  # queues behind the gate
+            assert eng.drain(timeout=120)
+            assert eng.active_slots == 0
+            assert r1.result(1) == inline_chain(params, [1, 2, 3], 6)
+            assert eng.queue_depth == 1  # r2 held, not failed
+            eng.swap_params(params2)
+            eng.resume_admission()
+            assert not eng.draining
+            assert r2.result(120) == inline_chain(params2, [4, 5], 3)
+            assert eng.step.compiles == 1
+        finally:
+            eng.stop()
+
+    def test_drain_is_idempotent_per_cycle(self, params):
+        """A second pause+drain cycle must wait for ITS OWN quiesce —
+        a stale ack from the previous cycle cannot satisfy it."""
+        eng = ContinuousBatchingEngine(CFG, params, n_slots=2)
+        try:
+            assert eng.drain(timeout=120)  # idle: immediate
+            eng.resume_admission()
+            r1 = eng.submit([7, 8, 9], 4)
+            stream = r1.stream(timeout=120)
+            next(stream)
+            assert eng.drain(timeout=120)  # must wait for r1
+            assert r1.done.is_set()
+            eng.resume_admission()
+        finally:
+            eng.stop()

@@ -1,0 +1,865 @@
+"""ServeService fleet: API types, reconciler, controller, router,
+client retries, readiness phases, and the chaos failover/rolling
+update soaks (tf_operator_tpu/{api,controller/serve,serve/router,
+serve/fleet}.py — docs/serving.md)."""
+
+import http.server
+import json
+import threading
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from tf_operator_tpu.api import (
+    k8s,
+    set_serve_defaults,
+    validate_serve_service,
+)
+from tf_operator_tpu.api.types import (
+    LABEL_SERVE_NAME,
+    LABEL_SERVE_REPLICA_INDEX,
+    LABEL_SERVE_WEIGHTS,
+    SERVE_CONTAINER_NAME,
+    SERVE_KIND,
+    ConditionType,
+    ServeService,
+    ServeServiceSpec,
+    serve_labels,
+    serve_replica_name,
+)
+from tf_operator_tpu.api.validation import ValidationError
+from tf_operator_tpu.controller import Clock, ServeServiceController
+from tf_operator_tpu.controller.serve import ServeReconciler
+from tf_operator_tpu.models import gpt as gpt_lib
+from tf_operator_tpu.runtime import (
+    ControllerExpectations,
+    FakePodControl,
+    InMemorySubstrate,
+    NullRecorder,
+)
+from tf_operator_tpu.runtime.retry import (
+    RETRY_AFTER_CAP,
+    RetryPolicy,
+    call_with_retries,
+    retry_after_hint,
+)
+from tf_operator_tpu.serve.client import DecodeClient, DecodeError
+from tf_operator_tpu.serve.fleet import InProcessFleet, run_failover_soak
+from tf_operator_tpu.serve.router import LeastLoadedRouter, NoReadyReplicas
+from tf_operator_tpu.telemetry.flight import default_flight
+
+CFG = gpt_lib.GPT_TINY
+
+
+@pytest.fixture(scope="module")
+def params():
+    return gpt_lib.GPT(CFG).init(
+        jax.random.PRNGKey(0), jnp.zeros((1, 8), jnp.int32)
+    )["params"]
+
+
+@pytest.fixture(scope="module")
+def params2():
+    return gpt_lib.GPT(CFG).init(
+        jax.random.PRNGKey(1), jnp.zeros((1, 8), jnp.int32)
+    )["params"]
+
+
+def inline_chain(params, row, new):
+    out = gpt_lib.generate(
+        CFG, params, jnp.asarray([row], jnp.int32), max_new_tokens=new
+    )
+    return np.asarray(out)[0].tolist()
+
+
+def mk_svc(
+    name="fleet",
+    namespace="test",
+    replicas=2,
+    version="v1",
+    max_unavailable=1,
+    uid="svc-uid-1",
+):
+    svc = ServeService(
+        spec=ServeServiceSpec(
+            replicas=replicas,
+            max_unavailable=max_unavailable,
+            weights_version=version,
+        )
+    )
+    svc.metadata.name = name
+    svc.metadata.namespace = namespace
+    svc.metadata.uid = uid
+    set_serve_defaults(svc)
+    return svc
+
+
+# -- API types --------------------------------------------------------------
+
+
+class TestServeServiceAPI:
+    def test_serde_round_trip_camel_case(self):
+        svc = mk_svc(replicas=3, version="w-2024")
+        wire = svc.to_dict()
+        assert wire["spec"]["weightsVersion"] == "w-2024"
+        assert wire["spec"]["maxUnavailable"] == 1
+        assert wire["kind"] == SERVE_KIND
+        back = ServeService.from_dict(wire)
+        assert back.spec.weights_version == "w-2024"
+        assert back.spec.replicas == 3
+        assert back.to_dict() == wire
+
+    def test_defaults_fill_template_and_knobs(self):
+        svc = ServeService()
+        svc.metadata.name = "d"
+        set_serve_defaults(svc)
+        assert svc.spec.replicas == 1
+        assert svc.spec.max_unavailable == 1
+        assert svc.spec.slots == 8
+        containers = svc.spec.template.spec.containers
+        assert [c.name for c in containers] == [SERVE_CONTAINER_NAME]
+        assert "--batching" in containers[0].command
+        assert containers[0].ports[0].container_port == svc.spec.port
+
+    def test_validation_rejects_bad_specs(self):
+        svc = mk_svc(replicas=0)
+        with pytest.raises(ValidationError, match="replicas"):
+            validate_serve_service(svc)
+        svc = mk_svc()
+        svc.spec.max_unavailable = 5  # > replicas
+        with pytest.raises(ValidationError, match="maxUnavailable"):
+            validate_serve_service(svc)
+        validate_serve_service(mk_svc())  # defaulted spec is valid
+
+    def test_replica_names_and_labels(self):
+        assert serve_replica_name("fleet", 2) == "fleet-engine-2"
+        labels = serve_labels("fleet")
+        assert labels[LABEL_SERVE_NAME] == "fleet"
+
+
+# -- reconciler (table tests on FakePodControl) -----------------------------
+
+
+def mk_pod(svc, index, phase=k8s.POD_RUNNING, version=None, exit_code=None):
+    """A pod record as the reconciler would have created it."""
+    labels = serve_labels(svc.name)
+    labels[LABEL_SERVE_REPLICA_INDEX] = str(index)
+    labels[LABEL_SERVE_WEIGHTS] = (
+        svc.spec.weights_version if version is None else version
+    )
+    pod = k8s.Pod(
+        metadata=k8s.ObjectMeta(
+            name=serve_replica_name(svc.name, index),
+            namespace=svc.namespace,
+            labels=labels,
+            owner_references=[
+                k8s.OwnerReference(
+                    kind=SERVE_KIND, name=svc.name,
+                    uid=svc.metadata.uid, controller=True,
+                )
+            ],
+        ),
+    )
+    pod.status.phase = phase
+    if exit_code is not None:
+        pod.status.container_statuses = [
+            k8s.ContainerStatus(
+                name=SERVE_CONTAINER_NAME,
+                state=k8s.ContainerState(
+                    terminated=k8s.ContainerStateTerminated(
+                        exit_code=exit_code
+                    )
+                ),
+            )
+        ]
+    return pod
+
+
+def mk_reconciler(weight_update=None):
+    control = FakePodControl()
+    reconciler = ServeReconciler(
+        pod_control=control,
+        recorder=NullRecorder(),
+        expectations=ControllerExpectations(),
+        clock=Clock(),
+        weight_update=weight_update,
+    )
+    return reconciler, control
+
+
+class TestServeReconciler:
+    def test_creates_missing_indexed_replicas(self):
+        reconciler, control = mk_reconciler()
+        svc = mk_svc(replicas=3)
+        reconciler.reconcile(svc, [])
+        names = [p.metadata.name for p in control.created]
+        assert names == [f"fleet-engine-{i}" for i in range(3)]
+        for i, pod in enumerate(control.created):
+            assert pod.metadata.labels[LABEL_SERVE_NAME] == "fleet"
+            assert pod.metadata.labels[LABEL_SERVE_REPLICA_INDEX] == str(i)
+            assert pod.metadata.labels[LABEL_SERVE_WEIGHTS] == "v1"
+        assert svc.status.replicas == 0  # none live yet
+
+    def test_terminal_pod_reaped_and_replaced(self):
+        reconciler, control = mk_reconciler()
+        svc = mk_svc(replicas=2)
+        pods = [
+            mk_pod(svc, 0, phase=k8s.POD_FAILED, exit_code=137),
+            mk_pod(svc, 1),
+        ]
+        reconciler.reconcile(svc, pods)
+        assert control.deleted == ["fleet-engine-0"]
+        assert [p.metadata.name for p in control.created] == [
+            "fleet-engine-0"
+        ]
+        assert svc.status.restarts == 1
+        assert svc.status.ready_replicas == 1
+
+    def test_scale_down_deletes_excess(self):
+        reconciler, control = mk_reconciler()
+        svc = mk_svc(replicas=1)
+        pods = [mk_pod(svc, 0), mk_pod(svc, 1), mk_pod(svc, 2)]
+        reconciler.reconcile(svc, pods)
+        assert sorted(control.deleted) == [
+            "fleet-engine-1", "fleet-engine-2"
+        ]
+        assert not control.created
+
+    def test_foreign_pods_never_touched(self):
+        reconciler, control = mk_reconciler()
+        svc = mk_svc(replicas=1)
+        mine = mk_pod(svc, 0)
+        foreign = mk_pod(svc, 1)
+        foreign.metadata.owner_references[0].uid = "someone-else"
+        reconciler.reconcile(svc, [mine, foreign])
+        assert control.deleted == []
+        assert control.created == []
+
+    def test_rolling_update_respects_budget(self):
+        updated_batches = []
+
+        def weight_update(svc, pods):
+            updated_batches.append([p.metadata.name for p in pods])
+            return [p.metadata.name for p in pods]
+
+        reconciler, control = mk_reconciler(weight_update)
+        svc = mk_svc(replicas=3, version="v2", max_unavailable=1)
+        pods = [mk_pod(svc, i, version="v1") for i in range(3)]
+        reconciler.reconcile(svc, pods)
+        # budget 1: exactly one stale replica drained+updated this sync
+        assert updated_batches == [["fleet-engine-0"]]
+        assert control.patched == [
+            ("fleet-engine-0", {LABEL_SERVE_WEIGHTS: "v2"})
+        ]
+        assert svc.status.updated_replicas == 0  # label patch lands next sync
+
+    def test_rolling_update_pauses_while_capacity_is_down(self):
+        calls = []
+
+        def weight_update(svc, pods):
+            calls.append(pods)
+            return []
+
+        reconciler, control = mk_reconciler(weight_update)
+        svc = mk_svc(replicas=2, version="v2", max_unavailable=1)
+        pods = [
+            mk_pod(svc, 0, version="v1"),
+            mk_pod(svc, 1, phase=k8s.POD_PENDING, version="v1"),
+        ]
+        reconciler.reconcile(svc, pods)
+        # one replica is already unavailable (booting): the budget is
+        # spent, the rollout must not drain the last running replica
+        assert calls == []
+        assert control.patched == []
+
+    def test_rolling_update_without_hook_recreates(self):
+        reconciler, control = mk_reconciler(weight_update=None)
+        svc = mk_svc(replicas=2, version="v2", max_unavailable=1)
+        pods = [mk_pod(svc, i, version="v1") for i in range(2)]
+        reconciler.reconcile(svc, pods)
+        assert control.deleted == ["fleet-engine-0"]
+        assert control.patched == []
+
+    def test_all_running_sets_running_condition(self):
+        reconciler, _ = mk_reconciler()
+        svc = mk_svc(replicas=2)
+        reconciler.reconcile(svc, [mk_pod(svc, 0), mk_pod(svc, 1)])
+        assert svc.status.ready_replicas == 2
+        assert svc.status.updated_replicas == 2
+        assert svc.has_condition(ConditionType.RUNNING)
+
+
+# -- controller E2E on the substrate ---------------------------------------
+
+
+class TestServeServiceController:
+    def _boot(self, namespace="ctl"):
+        substrate = InMemorySubstrate()
+        controller = ServeServiceController(substrate, namespace=namespace)
+        return substrate, controller
+
+    def test_create_reconciles_replica_pods(self):
+        substrate, controller = self._boot()
+        svc = mk_svc(namespace="ctl", replicas=2, uid="")
+        substrate.create_serve_service(svc)
+        controller.run_until_quiet()
+        pods = substrate.list_pods("ctl", serve_labels("fleet"))
+        assert sorted(p.metadata.name for p in pods) == [
+            "fleet-engine-0", "fleet-engine-1"
+        ]
+        stored = substrate.get_serve_service("ctl", "fleet")
+        assert stored.has_condition(ConditionType.CREATED)
+        # pods carry the controller owner ref
+        owner = pods[0].metadata.owner_references[0]
+        assert owner.kind == SERVE_KIND
+        assert owner.uid == stored.metadata.uid
+
+    def test_exit_137_replica_is_replaced(self):
+        substrate, controller = self._boot()
+        svc = mk_svc(namespace="ctl", replicas=2, uid="")
+        substrate.create_serve_service(svc)
+        controller.run_until_quiet()
+        for pod in substrate.list_pods("ctl", serve_labels("fleet")):
+            substrate.mark_pod_running("ctl", pod.metadata.name)
+        controller.run_until_quiet()
+        stored = substrate.get_serve_service("ctl", "fleet")
+        assert stored.status.ready_replicas == 2
+        assert stored.has_condition(ConditionType.RUNNING)
+
+        substrate.terminate_pod("ctl", "fleet-engine-1", exit_code=137)
+        controller.run_until_quiet()
+        pods = {
+            p.metadata.name: p
+            for p in substrate.list_pods("ctl", serve_labels("fleet"))
+        }
+        assert sorted(pods) == ["fleet-engine-0", "fleet-engine-1"]
+        assert pods["fleet-engine-1"].status.phase == k8s.POD_PENDING
+        stored = substrate.get_serve_service("ctl", "fleet")
+        assert stored.status.restarts == 1
+        assert stored.status.ready_replicas == 1
+
+    def test_scale_down_via_spec_update(self):
+        substrate, controller = self._boot()
+        svc = mk_svc(namespace="ctl", replicas=3, uid="")
+        substrate.create_serve_service(svc)
+        controller.run_until_quiet()
+        fresh = substrate.get_serve_service("ctl", "fleet")
+        fresh.spec.replicas = 1
+        substrate.update_serve_service(fresh)
+        controller.run_until_quiet()
+        pods = substrate.list_pods("ctl", serve_labels("fleet"))
+        assert [p.metadata.name for p in pods] == ["fleet-engine-0"]
+
+    def test_invalid_spec_marked_failed(self):
+        substrate, controller = self._boot()
+        svc = mk_svc(namespace="ctl", replicas=0, uid="")
+        substrate.create_serve_service(svc)
+        controller.run_until_quiet()
+        stored = substrate.get_serve_service("ctl", "fleet")
+        assert stored.has_condition(ConditionType.FAILED)
+        assert not substrate.list_pods("ctl", serve_labels("fleet"))
+
+
+# -- router (stub replicas) -------------------------------------------------
+
+
+def scripted_chain(prompt, n):
+    """Deterministic stand-in for greedy decoding: the continuation is
+    a pure function of the last prompt token, so replaying
+    prompt+emitted on another stub continues the same chain — exactly
+    the property the router's failover leans on."""
+    out, last = [], prompt[-1]
+    for _ in range(n):
+        last = (last * 7 + 3) % 50
+        out.append(last)
+    return out
+
+
+class StubReplica:
+    def __init__(self, url):
+        self.url = url
+        self.ready_flag = True
+        self.queue_depth = 0.0
+        self.active_slots = 0.0
+        self.die_after = None    # raise after yielding k tokens, once
+        self.fail_status = None  # DecodeError raised at stream start
+        self.calls = 0
+
+    def ready(self):
+        return self.ready_flag
+
+    def metrics(self):
+        return {
+            "tf_operator_tpu_serve_engine_queue_depth": self.queue_depth,
+            "tf_operator_tpu_serve_engine_active_slots": self.active_slots,
+            "tf_operator_tpu_serve_engine_row_steps_total": 0.0,
+            "tf_operator_tpu_serve_engine_steps_total": 0.0,
+        }
+
+    def generate_stream(self, input_ids, max_new_tokens=16, **kw):
+        self.calls += 1
+        if self.fail_status is not None:
+            raise DecodeError(self.fail_status, "scripted failure")
+        prompt = list(input_ids)
+        chain = scripted_chain(prompt, max_new_tokens)
+        for i, tok in enumerate(chain):
+            if self.die_after is not None and i >= self.die_after:
+                self.die_after = None  # die once, then recover
+                raise ConnectionResetError("scripted mid-stream death")
+            yield {"token": tok, "index": len(prompt) + i}
+        yield {
+            "done": True,
+            "tokens": [prompt + chain],
+            "prompt_lens": [len(prompt)],
+        }
+
+
+def mk_router(n=2, **kw):
+    stubs = {}
+
+    def factory(url):
+        stubs[url] = StubReplica(url)
+        return stubs[url]
+
+    router = LeastLoadedRouter(
+        client_factory=factory, retry_wait=0.01, **kw
+    )
+    for i in range(n):
+        router.add_replica(f"r{i}", f"stub://r{i}")
+    return router, [stubs[f"stub://r{i}"] for i in range(n)]
+
+
+class TestLeastLoadedRouter:
+    def test_least_loaded_pick(self):
+        router, (a, b) = mk_router(2)
+        a.queue_depth = 9.0
+        router.probe()
+        out = router.generate([[3, 4]], 4)
+        assert out == [[3, 4] + scripted_chain([3, 4], 4)]
+        assert a.calls == 0 and b.calls == 1
+
+    def test_mid_stream_failover_is_bit_identical(self):
+        router, (a, b) = mk_router(2)
+        b.queue_depth = 9.0  # first pick is a
+        router.probe()
+        a.die_after = 2
+        corr = "route-test-failover"
+        events = list(
+            router.generate_stream([7, 9], 6, corr=corr)
+        )
+        final = events[-1]
+        assert final["done"]
+        # the chain is exactly what an uninterrupted replica produces
+        assert final["tokens"] == [[7, 9] + scripted_chain([7, 9], 6)]
+        assert final["failovers"] == 1
+        replicas = {e["replica"] for e in events if "token" in e}
+        assert replicas == {"r0", "r1"}
+        # failover is in the flight ring under the request's corr ID
+        records = default_flight().snapshot(kind="serve", corr=corr)
+        ops = [r.fields.get("op") for r in records]
+        assert "failover" in ops and "route-done" in ops
+
+    def test_4xx_propagates_without_failover(self):
+        router, (a, b) = mk_router(2)
+        a.fail_status = 400
+        b.fail_status = 400
+        with pytest.raises(DecodeError):
+            list(router.generate_stream([1, 2], 3))
+        assert router.failovers == 0
+
+    def test_500_fails_over(self):
+        router, (a, b) = mk_router(2)
+        b.queue_depth = 9.0
+        router.probe()
+        a.fail_status = 503
+        out = router.generate([[5, 6]], 3)
+        assert out == [[5, 6] + scripted_chain([5, 6], 3)]
+        assert router.failovers == 1
+
+    def test_draining_replica_excluded(self):
+        router, (a, b) = mk_router(2)
+        router.set_draining("r0", True)
+        for _ in range(3):
+            router.generate([[2, 3]], 2)
+        assert a.calls == 0 and b.calls == 3
+        router.set_draining("r0", False)
+        router.generate([[2, 3]], 2)
+        assert a.calls == 1  # readmitted (and now least-loaded)
+
+    def test_no_ready_replicas_deadline(self):
+        router, (a, b) = mk_router(2)
+        a.ready_flag = False
+        b.ready_flag = False
+        router.probe()
+        with pytest.raises(NoReadyReplicas):
+            list(router.generate_stream([1, 2], 2, timeout=0.2))
+
+    def test_single_replica_second_chance(self):
+        # the only replica dies once mid-stream: the router must retry
+        # it (tried-set cleared) instead of giving up
+        router, (a,) = mk_router(1)
+        a.die_after = 1
+        out = router.generate([[4, 5]], 4, timeout=10.0)
+        assert out == [[4, 5] + scripted_chain([4, 5], 4)]
+        assert a.calls == 2
+
+    def test_inflight_released_when_consumer_closes(self):
+        router, (a, b) = mk_router(2)
+        stream = router.generate_stream([6, 7], 8)
+        next(stream)  # a replica is acquired and streaming
+        stream.close()  # GeneratorExit into the generator
+        stats = router.stats()
+        assert all(
+            r["inflight"] == 0 for r in stats["replicas"].values()
+        )
+
+
+# -- client retries (scripted HTTP server) ----------------------------------
+
+
+def mk_scripted_server(script):
+    """One-shot HTTP server answering requests from a script of
+    (status, headers, body) tuples, recording each request path."""
+
+    class Handler(http.server.BaseHTTPRequestHandler):
+        requests = []
+        responses = list(script)
+
+        def _serve(self):
+            cls = type(self)
+            length = int(self.headers.get("Content-Length", 0) or 0)
+            if length:
+                self.rfile.read(length)
+            cls.requests.append(self.path)
+            status, headers, body = cls.responses.pop(0)
+            self.send_response(status)
+            for key, value in headers.items():
+                self.send_header(key, value)
+            self.send_header("Content-Length", str(len(body)))
+            self.end_headers()
+            self.wfile.write(body)
+
+        do_GET = _serve
+        do_POST = _serve
+
+        def log_message(self, *args):
+            pass
+
+    server = http.server.ThreadingHTTPServer(("127.0.0.1", 0), Handler)
+    thread = threading.Thread(target=server.serve_forever, daemon=True)
+    thread.start()
+    return server, Handler
+
+
+class TestDecodeClientRetry:
+    def _client(self, server):
+        host, port = server.server_address[:2]
+        return DecodeClient(
+            f"http://{host}:{port}",
+            timeout=5.0,
+            retry_policy=RetryPolicy(
+                max_attempts=3, base_delay=0.01, max_delay=0.02
+            ),
+        )
+
+    def test_503_with_retry_after_is_replayed(self):
+        ok = json.dumps({"tokens": [[1, 2, 9]]}).encode()
+        server, handler = mk_scripted_server([
+            (503, {"Retry-After": "0"}, b'{"error": "draining"}'),
+            (200, {}, ok),
+        ])
+        try:
+            client = self._client(server)
+            assert client.generate([[1, 2]], 1) == [[1, 2, 9]]
+            assert handler.requests == ["/generate", "/generate"]
+        finally:
+            server.shutdown()
+            server.server_close()
+
+    def test_400_is_not_retried(self):
+        server, handler = mk_scripted_server([
+            (400, {}, b'{"error": "bad tokens"}'),
+        ])
+        try:
+            client = self._client(server)
+            with pytest.raises(DecodeError) as err:
+                client.generate([[1, 2]], 1)
+            assert err.value.status == 400
+            assert handler.requests == ["/generate"]
+        finally:
+            server.shutdown()
+            server.server_close()
+
+    def test_stream_connect_retried_then_streams(self):
+        body = (
+            b'{"token": 9, "index": 2}\n'
+            b'{"done": true, "tokens": [[1, 2, 9]], "prompt_lens": [2]}\n'
+        )
+        server, handler = mk_scripted_server([
+            (503, {"Retry-After": "0"}, b'{"error": "warming"}'),
+            (200, {}, body),
+        ])
+        try:
+            client = self._client(server)
+            events = list(client.generate_stream([1, 2], 1))
+            assert events[-1]["done"]
+            assert handler.requests == [
+                "/generate_stream", "/generate_stream"
+            ]
+        finally:
+            server.shutdown()
+            server.server_close()
+
+    def test_mid_stream_error_not_retried(self):
+        # the error arrives AFTER the first body byte: the client must
+        # surface it, never re-POST (a replay would double tokens)
+        body = (
+            b'{"token": 9, "index": 2}\n'
+            b'{"error": "device lost"}\n'
+        )
+        server, handler = mk_scripted_server([(200, {}, body)])
+        try:
+            client = self._client(server)
+            with pytest.raises(DecodeError):
+                list(client.generate_stream([1, 2], 4))
+            assert handler.requests == ["/generate_stream"]
+        finally:
+            server.shutdown()
+            server.server_close()
+
+    def test_retry_after_hint_parsing_and_cap(self):
+        class Err(Exception):
+            pass
+
+        err = Err()
+        assert retry_after_hint(err) is None
+        err.headers = {"Retry-After": "2.5"}
+        assert retry_after_hint(err) == 2.5
+        err.headers = {"Retry-After": "not-a-number"}
+        assert retry_after_hint(err) is None
+
+        # an absurd server hint is capped, not honored verbatim
+        sleeps = []
+        policy = RetryPolicy(
+            max_attempts=2, base_delay=0.01, max_delay=0.02,
+            sleep=sleeps.append,
+        )
+        hinted = Err()
+        hinted.code = 503
+        hinted.headers = {"Retry-After": "999"}
+        calls = []
+
+        def flaky():
+            calls.append(1)
+            if len(calls) == 1:
+                raise hinted
+            return "ok"
+
+        out = call_with_retries(
+            flaky, policy=policy, classify=lambda e: True,
+            retry_after=retry_after_hint,
+        )
+        assert out == "ok"
+        assert sleeps == [RETRY_AFTER_CAP]
+
+
+# -- readiness phases (satellite: /readyz + draining healthz) ---------------
+
+
+class TestReadinessPhases:
+    @pytest.fixture(scope="class")
+    def server(self, params):
+        from tf_operator_tpu.serve import make_server
+
+        server = make_server(
+            CFG, params, port=0, model_name="phases",
+            batching="continuous", n_slots=2,
+        )
+        thread = threading.Thread(
+            target=server.serve_forever, daemon=True
+        )
+        thread.start()
+        yield server
+        server.shutdown()
+        server.state.engine.stop()
+        server.server_close()
+
+    def _client(self, server):
+        host, port = server.server_address[:2]
+        return DecodeClient(
+            f"http://{host}:{port}", timeout=10.0,
+            retry_policy=RetryPolicy(max_attempts=1),
+        )
+
+    def test_ready_server_answers_200(self, server):
+        client = self._client(server)
+        assert client.ready() is True
+        assert client.healthy()["status"] == "ok"
+
+    def test_draining_flips_readyz_but_not_liveness(self, server):
+        client = self._client(server)
+        server.state.phase = "draining"
+        try:
+            # readiness gone: the router stops routing here
+            assert client.ready() is False
+            # liveness intact, reporting the phase: the kubelet must
+            # NOT kill a draining pod
+            assert client.healthy()["status"] == "draining"
+            # new work refused while draining
+            with pytest.raises(DecodeError) as err:
+                client.generate([[1, 2]], 1)
+            assert err.value.status == 503
+        finally:
+            server.state.phase = "ready"
+        assert client.ready() is True
+
+    def test_warm_async_starts_not_ready(self, params):
+        from tf_operator_tpu.serve import make_server
+
+        server = make_server(
+            CFG, params, port=0, model_name="warmup",
+            batching="continuous", n_slots=2, warm_async=True,
+        )
+        thread = threading.Thread(
+            target=server.serve_forever, daemon=True
+        )
+        thread.start()
+        try:
+            client = self._client(server)
+            seen = []
+            deadline = time.monotonic() + 120
+            while time.monotonic() < deadline:
+                health = client.healthy()
+                seen.append(health["status"])
+                if client.ready():
+                    break
+                time.sleep(0.02)
+            else:
+                pytest.fail("server never became ready")
+            # every pre-ready poll reported the warming phase
+            assert all(s in ("warming", "ok") for s in seen)
+        finally:
+            warmup = getattr(server.state, "warmup_thread", None)
+            if warmup is not None:
+                warmup.join(timeout=120)
+            server.shutdown()
+            if server.state.engine is not None:
+                server.state.engine.stop()
+            server.server_close()
+
+
+# -- fleet soaks (real engines) ---------------------------------------------
+
+
+class TestFleetSoaks:
+    def test_failover_soak_fast(self):
+        summary = run_failover_soak(
+            seed=0, replicas=2, streams=4, kills=1, max_new=8,
+            conn_faults=1, namespace="soak-fast",
+        )
+        assert summary["ok"]
+        assert summary["kills"] == 1
+        assert summary["failovers"] >= 1
+        assert summary["recorded_failovers"] >= summary["failovers"]
+
+    def test_rolling_weight_update(self, params, params2):
+        substrate = InMemorySubstrate()
+        router = LeastLoadedRouter(retry_wait=0.02)
+        fleet = InProcessFleet(
+            substrate, router, CFG,
+            {"v1": params, "v2": params2},
+            slots=2, namespace="roll",
+        )
+        controller = ServeServiceController(
+            substrate, namespace="roll",
+            weight_update=fleet.update_weights,
+        )
+        svc = mk_svc(
+            name="roll", namespace="roll", replicas=3,
+            version="v1", max_unavailable=1, uid="",
+        )
+        prompt = [5, 11]
+        old = inline_chain(params, prompt, 4)
+        new = inline_chain(params2, prompt, 4)
+        assert old != new  # different weights, different chains
+
+        stop_flag = threading.Event()
+        chains, errors = [], []
+        lock = threading.Lock()
+
+        def traffic():
+            while not stop_flag.is_set():
+                try:
+                    out = router.generate([prompt], 4, timeout=60.0)[0]
+                except Exception as err:  # noqa: BLE001 — asserted below
+                    with lock:
+                        errors.append(repr(err))
+                    return
+                with lock:
+                    chains.append(out)
+
+        threads = [
+            threading.Thread(target=traffic) for _ in range(3)
+        ]
+        try:
+            substrate.create_serve_service(svc)
+            controller.run_until_quiet()
+            fleet.sync()
+            fleet.wait_ready(3)
+            for t in threads:
+                t.start()
+            # some traffic on the old weights first
+            deadline = time.monotonic() + 60
+            while time.monotonic() < deadline:
+                with lock:
+                    if len(chains) >= 3:
+                        break
+                time.sleep(0.02)
+
+            fresh = substrate.get_serve_service("roll", "roll")
+            fresh.spec.weights_version = "v2"
+            substrate.update_serve_service(fresh)
+            deadline = time.monotonic() + 120
+            while time.monotonic() < deadline:
+                controller.run_until_quiet()
+                status = substrate.get_serve_service(
+                    "roll", "roll"
+                ).status
+                if status.updated_replicas == 3:
+                    break
+                time.sleep(0.02)
+            assert status.updated_replicas == 3
+        finally:
+            stop_flag.set()
+            for t in threads:
+                t.join(timeout=60)
+            compiles = [
+                proc.server.state.engine.step.compiles
+                for proc in fleet._replicas.values()
+            ]
+            fleet.stop()
+            controller.stop()
+
+        # maxUnavailable=1 + router drain exclusion: no request ever
+        # failed — drain windows reroute, they don't reject
+        assert errors == []
+        # every chain is exactly an old-weights or new-weights greedy
+        # chain; the rollout has a clean cutover per replica
+        assert chains
+        assert all(c in (old, new) for c in chains)
+        assert old in chains  # pre-rollout traffic reached v1
+        # in-place swap reused the compiled step: same shapes, no
+        # recompile anywhere in the fleet
+        assert compiles == [1, 1, 1]
+
+    @pytest.mark.slow
+    def test_failover_soak_multi_seed(self):
+        for seed in (1, 2, 3):
+            summary = run_failover_soak(
+                seed=seed, replicas=3, streams=6, kills=2, max_new=12,
+                conn_faults=2, namespace=f"soak-{seed}",
+            )
+            assert summary["ok"], summary

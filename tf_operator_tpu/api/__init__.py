@@ -1,5 +1,22 @@
 from . import k8s, serde, types
-from .defaults import set_defaults
-from .validation import ValidationError, is_valid, validate
+from .defaults import set_defaults, set_serve_defaults
+from .validation import (
+    ValidationError,
+    is_valid,
+    is_valid_serve_service,
+    validate,
+    validate_serve_service,
+)
 
-__all__ = ["k8s", "serde", "types", "set_defaults", "validate", "is_valid", "ValidationError"]
+__all__ = [
+    "k8s",
+    "serde",
+    "types",
+    "set_defaults",
+    "set_serve_defaults",
+    "validate",
+    "validate_serve_service",
+    "is_valid",
+    "is_valid_serve_service",
+    "ValidationError",
+]

@@ -91,3 +91,44 @@ def set_defaults(job: t.TFJob) -> t.TFJob:
         if key == t.ReplicaType.TPU.value:
             _set_tpu_defaults(rspec)
     return job
+
+
+def set_serve_defaults(svc: t.ServeService) -> t.ServeService:
+    """Default a ServeService in place (and return it): replicas -> 1,
+    maxUnavailable -> 1, slots -> 8, port -> 8600, and a default serve
+    container (image + command + port) when the template declares none
+    — the in-process fleet only needs the pod as a reconcile unit, but
+    the template must still describe a runnable replica."""
+    spec = svc.spec
+    if spec.replicas is None:
+        spec.replicas = 1
+    if spec.max_unavailable is None:
+        spec.max_unavailable = 1
+    if spec.slots is None:
+        spec.slots = 8
+    if spec.port is None:
+        spec.port = t.DEFAULT_SERVE_PORT
+    pod_spec = spec.template.spec
+    if not pod_spec.containers:
+        pod_spec.containers.append(
+            Container(
+                name=t.SERVE_CONTAINER_NAME,
+                image="tf-operator-tpu/serve:latest",
+                command=[
+                    "python", "-m", "tf_operator_tpu.serve",
+                    "--preset", spec.preset,
+                    "--batching", "continuous",
+                    "--slots", str(spec.slots),
+                ],
+            )
+        )
+    container = pod_spec.container(t.SERVE_CONTAINER_NAME)
+    if container is not None and not any(
+        p.name == t.DEFAULT_SERVE_PORT_NAME for p in container.ports
+    ):
+        container.ports.append(
+            ContainerPort(
+                name=t.DEFAULT_SERVE_PORT_NAME, container_port=spec.port
+            )
+        )
+    return svc

@@ -333,6 +333,117 @@ def gen_labels(job_name: str) -> Dict[str, str]:
     }
 
 
+# --- ServeService (serving fleet CRD) ---------------------------------------
+#
+# The serving twin of TFJob: a reconciled fleet of continuous-batching
+# engine replicas behind the least-loaded router (serve/router.py).
+# Where TFJob describes a gang of training workers that run to
+# completion, ServeService describes a long-lived replica set with
+# drain-based rolling weight updates (spec.weightsVersion bump) bounded
+# by maxUnavailable. No reference counterpart — the reference operator
+# stops at training — but the wire shape follows the same conventions
+# (camelCase, conditions list, status subresource).
+
+SERVE_KIND = "ServeService"
+SERVE_PLURAL = "serveservices"
+SERVE_SINGULAR = "serveservice"
+
+SERVE_CONTAINER_NAME = "serve"
+DEFAULT_SERVE_PORT_NAME = "serve-port"
+DEFAULT_SERVE_PORT = 8600
+
+LABEL_SERVE_NAME = "serve-service-name"
+LABEL_SERVE_REPLICA_INDEX = "serve-replica-index"
+# stamped with spec.weightsVersion at pod creation and patched after a
+# successful in-place drain+swap: the reconciler's rolling-update
+# progress lives on the pods themselves, surviving controller restarts
+LABEL_SERVE_WEIGHTS = "serve-weights-version"
+
+
+@dataclass
+class ServeServiceSpec:
+    replicas: Optional[int] = None
+    # rolling-update budget: how many replicas may be draining /
+    # booting at once (1..replicas)
+    max_unavailable: Optional[int] = None
+    # model selection for the replica servers (presets in models/)
+    preset: str = "tiny"
+    # engine slot-grid width per replica
+    slots: Optional[int] = None
+    port: Optional[int] = None
+    # opaque version tag for the loaded weights; bumping it triggers a
+    # drain-based rolling update across the fleet
+    weights_version: str = field(
+        default="", metadata={"json": "weightsVersion"}
+    )
+    template: PodTemplateSpec = field(default_factory=PodTemplateSpec)
+    extra: Dict[str, Any] = field(default_factory=dict)
+
+
+@dataclass
+class ServeServiceStatus:
+    replicas: int = 0
+    ready_replicas: int = 0
+    # replicas whose pod carries the spec's current weightsVersion
+    updated_replicas: int = 0
+    # replica pods replaced after terminal exits (chaos 137s)
+    restarts: int = 0
+    conditions: List[JobCondition] = field(default_factory=list)
+    extra: Dict[str, Any] = field(default_factory=dict)
+
+
+@dataclass
+class ServeService:
+    api_version: str = API_VERSION
+    kind: str = SERVE_KIND
+    metadata: ObjectMeta = field(default_factory=ObjectMeta)
+    spec: ServeServiceSpec = field(default_factory=ServeServiceSpec)
+    status: ServeServiceStatus = field(default_factory=ServeServiceStatus)
+    extra: Dict[str, Any] = field(default_factory=dict)
+
+    @property
+    def name(self) -> str:
+        return self.metadata.name
+
+    @property
+    def namespace(self) -> str:
+        return self.metadata.namespace
+
+    def key(self) -> str:
+        if self.metadata.namespace:
+            return f"{self.metadata.namespace}/{self.metadata.name}"
+        return self.metadata.name
+
+    def has_condition(self, ctype: ConditionType) -> bool:
+        return any(
+            c.type == ctype and c.status == "True"
+            for c in self.status.conditions
+        )
+
+    def to_dict(self) -> Dict[str, Any]:
+        return to_jsonable(self)
+
+    @classmethod
+    def from_dict(cls, data: Dict[str, Any]) -> "ServeService":
+        return from_jsonable(data, cls)
+
+    def copy(self) -> "ServeService":
+        return from_jsonable(to_jsonable(self), ServeService)
+
+
+def serve_replica_name(service_name: str, index: int) -> str:
+    """Replica pod name: "{service}-engine-{index}"."""
+    return f"{service_name}-engine-{index}".replace("/", "-")
+
+
+def serve_labels(service_name: str) -> Dict[str, str]:
+    """Base selector labels for a ServeService's replica pods."""
+    return {
+        LABEL_GROUP_NAME: GROUP_NAME,
+        LABEL_SERVE_NAME: service_name.replace("/", "-"),
+    }
+
+
 def is_retryable_exit_code(exit_code: int) -> bool:
     """Exit-code classification for RestartPolicy ExitCode.
 

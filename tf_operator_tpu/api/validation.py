@@ -185,3 +185,57 @@ def is_valid(job: t.TFJob) -> bool:
         return True
     except ValidationError:
         return False
+
+
+def validate_serve_service(svc: t.ServeService) -> None:
+    """Raise ValidationError listing every problem found. Expects a
+    defaulted spec (set_serve_defaults) — None fields are reported."""
+    errs: List[str] = []
+    spec = svc.spec
+    if not svc.metadata.name:
+        errs.append("ServeService metadata.name must be specified")
+    if spec.replicas is None or spec.replicas < 1:
+        errs.append(
+            f"ServeServiceSpec.replicas must be >= 1, got {spec.replicas}"
+        )
+    if spec.max_unavailable is None or spec.max_unavailable < 1:
+        errs.append(
+            "ServeServiceSpec.maxUnavailable must be >= 1, got "
+            f"{spec.max_unavailable}"
+        )
+    elif spec.replicas is not None and spec.max_unavailable > spec.replicas:
+        errs.append(
+            f"ServeServiceSpec.maxUnavailable={spec.max_unavailable} "
+            f"exceeds replicas={spec.replicas}"
+        )
+    if spec.slots is None or spec.slots < 1:
+        errs.append(
+            f"ServeServiceSpec.slots must be >= 1, got {spec.slots}"
+        )
+    if spec.port is None or not (0 < spec.port < 65536):
+        errs.append(
+            f"ServeServiceSpec.port must be in 1..65535, got {spec.port}"
+        )
+    if not spec.preset:
+        errs.append("ServeServiceSpec.preset must be specified")
+    container = spec.template.spec.container(t.SERVE_CONTAINER_NAME)
+    if container is None:
+        errs.append(
+            "ServeServiceSpec.template is not valid: there must be a "
+            f"container named {t.SERVE_CONTAINER_NAME!r}"
+        )
+    elif not container.image:
+        errs.append(
+            "ServeServiceSpec.template is not valid: image is undefined "
+            f"in container {t.SERVE_CONTAINER_NAME!r}"
+        )
+    if errs:
+        raise ValidationError("; ".join(errs))
+
+
+def is_valid_serve_service(svc: t.ServeService) -> bool:
+    try:
+        validate_serve_service(svc)
+        return True
+    except ValidationError:
+        return False

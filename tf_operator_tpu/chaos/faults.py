@@ -23,6 +23,11 @@ FAULT_LATENCY = "latency"         # added request latency
 FAULT_WATCH_DROP = "watch_drop"   # watch stream dies; relist on re-establish
 FAULT_POD_DEATH = "pod_death"     # container exits 137 (OOM-kill class)
 FAULT_PREEMPTION = "preemption"   # SIGTERM-style exit 143 (slice preempted)
+# Router->replica connection severed (RST), pre-connect or mid-stream.
+# Deliberately NOT in ALL_FAULT_KINDS: the substrate gate never draws
+# it — the serve fleet's faulty client factory (serve/fleet.py)
+# injects it and logs through the same FaultLog.
+FAULT_CONN_RESET = "conn_reset"
 
 ALL_FAULT_KINDS = (
     FAULT_API_ERROR,

@@ -2,6 +2,7 @@ from .clock import Clock, FakeClock
 from .controller import TFJobController
 from .degraded import DegradedLatch
 from .reconciler import Reconciler, ReconcilerConfig
+from .serve import ServeReconciler, ServeServiceController
 from .status import (
     REASON_CREATED,
     REASON_FAILED,
@@ -18,6 +19,8 @@ __all__ = [
     "TFJobController",
     "Reconciler",
     "ReconcilerConfig",
+    "ServeReconciler",
+    "ServeServiceController",
     "set_condition",
     "REASON_CREATED",
     "REASON_RUNNING",

@@ -18,11 +18,15 @@ from ..api.types import API_VERSION, KIND, TFJob
 from .substrate import Substrate
 
 
-def owner_reference(job: TFJob) -> k8s.OwnerReference:
-    """Reference GenOwnerReference, jobcontroller.go:196-208."""
+def owner_reference(job) -> k8s.OwnerReference:
+    """Reference GenOwnerReference, jobcontroller.go:196-208.
+
+    Works for any owning resource carrying kind/api_version (TFJob,
+    ServeService); the TFJob constants remain the fallback for owner
+    objects predating the kind field."""
     return k8s.OwnerReference(
-        api_version=API_VERSION,
-        kind=KIND,
+        api_version=getattr(job, "api_version", API_VERSION),
+        kind=getattr(job, "kind", KIND),
         name=job.name,
         uid=job.metadata.uid,
         controller=True,
@@ -73,14 +77,16 @@ class RealPodControl:
             pod.metadata.owner_references.append(owner_reference(job))
         self._substrate.create_pod(pod)
         self._recorder.event(
-            KIND, job.name, namespace, "Normal", "SuccessfulCreatePod",
+            getattr(job, "kind", KIND), job.name, namespace,
+            "Normal", "SuccessfulCreatePod",
             f"Created pod: {pod.metadata.name}",
         )
 
     def delete_pod(self, namespace: str, name: str, job: TFJob) -> None:
         self._substrate.delete_pod(namespace, name)
         self._recorder.event(
-            KIND, job.name, namespace, "Normal", "SuccessfulDeletePod",
+            getattr(job, "kind", KIND), job.name, namespace,
+            "Normal", "SuccessfulDeletePod",
             f"Deleted pod: {name}",
         )
 
@@ -108,14 +114,16 @@ class RealServiceControl:
             service.metadata.owner_references.append(owner_reference(job))
         self._substrate.create_service(service)
         self._recorder.event(
-            KIND, job.name, namespace, "Normal", "SuccessfulCreateService",
+            getattr(job, "kind", KIND), job.name, namespace,
+            "Normal", "SuccessfulCreateService",
             f"Created service: {service.metadata.name}",
         )
 
     def delete_service(self, namespace: str, name: str, job: TFJob) -> None:
         self._substrate.delete_service(namespace, name)
         self._recorder.event(
-            KIND, job.name, namespace, "Normal", "SuccessfulDeleteService",
+            getattr(job, "kind", KIND), job.name, namespace,
+            "Normal", "SuccessfulDeleteService",
             f"Deleted service: {name}",
         )
 

@@ -89,6 +89,31 @@ class RetryPolicy:
             yield prev
 
 
+# Ceiling for a server-provided Retry-After hint: an overloaded server
+# asking for minutes must not stall a sync thread that long — past this
+# the caller is better off failing over / requeueing.
+RETRY_AFTER_CAP = 30.0
+
+
+def retry_after_hint(err: BaseException) -> Optional[float]:
+    """Seconds from an HTTP error's Retry-After header, or None.
+    Only the delta-seconds form is honored (the HTTP-date form is not
+    worth a date parser here)."""
+    headers = getattr(err, "headers", None)
+    if headers is None:
+        return None
+    try:
+        value = headers.get("Retry-After")
+    except AttributeError:
+        return None
+    if value is None:
+        return None
+    try:
+        return max(0.0, float(value))
+    except (TypeError, ValueError):
+        return None
+
+
 def call_with_retries(
     fn: Callable,
     *args,
@@ -96,13 +121,19 @@ def call_with_retries(
     classify: Callable[[BaseException], bool] = is_transient_error,
     on_retry: Optional[Callable[[str, int, BaseException], None]] = None,
     op: str = "",
+    retry_after: Optional[Callable[[BaseException], Optional[float]]] = None,
     **kwargs,
 ):
     """Run fn, replaying transient failures per the policy's schedule.
 
     Non-transient errors propagate immediately; the final transient
     failure (attempt budget exhausted) propagates unchanged so callers
-    keep their typed-exception handling."""
+    keep their typed-exception handling.
+
+    retry_after: optional hint extractor (e.g. retry_after_hint for
+    HTTP Retry-After). A non-None hint overrides the jitter delay for
+    that retry, capped at RETRY_AFTER_CAP; the attempt budget is
+    consumed either way."""
     policy = policy or RetryPolicy()
     name = op or getattr(fn, "__name__", "call")
     delays = policy.delays()
@@ -116,6 +147,10 @@ def call_with_retries(
             delay = next(delays, None)
             if delay is None:
                 raise
+            if retry_after is not None:
+                hinted = retry_after(err)
+                if hinted is not None:
+                    delay = min(hinted, RETRY_AFTER_CAP)
             attempt += 1
             if on_retry is not None:
                 on_retry(name, attempt, err)
@@ -139,6 +174,9 @@ def call_with_retries(
 RETRIED_SUBSTRATE_METHODS = frozenset({
     "list_jobs", "get_job", "create_job", "update_job",
     "update_job_status", "delete_job",
+    "list_serve_services", "get_serve_service", "create_serve_service",
+    "update_serve_service", "update_serve_service_status",
+    "delete_serve_service",
     "create_pod", "get_pod", "list_pods", "delete_pod",
     "patch_pod_labels", "patch_pod_owner_references",
     "create_service", "list_services", "delete_service",

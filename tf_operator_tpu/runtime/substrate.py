@@ -27,7 +27,7 @@ from typing import Any, Callable, Dict, List, Optional, Protocol, Tuple
 
 from ..api import k8s
 from ..api.serde import deep_copy
-from ..api.types import TFJob
+from ..api.types import ServeService, TFJob
 
 from ..utils import locks
 
@@ -150,6 +150,7 @@ class InMemorySubstrate:
         self._uid = itertools.count(1)
         self._rv = itertools.count(1)
         self._jobs: Dict[Tuple[str, str], TFJob] = {}
+        self._serve_services: Dict[Tuple[str, str], ServeService] = {}
         self._pods: Dict[Tuple[str, str], k8s.Pod] = {}
         self._services: Dict[Tuple[str, str], k8s.Service] = {}
         self._pod_groups: Dict[Tuple[str, str], Any] = {}
@@ -256,6 +257,76 @@ class InMemorySubstrate:
                 raise NotFound(f"tfjob {namespace}/{name}")
             self._notify("tfjob", DELETED, job)
             self._cascade_delete(job.metadata.uid)
+
+    # -- ServeServices -----------------------------------------------------
+    # Watch kind "serveservice". Same semantics as the TFJob store:
+    # optimistic concurrency on update, a status subresource, and
+    # cascade GC of owned children on delete.
+
+    def create_serve_service(self, svc: ServeService) -> ServeService:
+        with self._lock:
+            key = (svc.namespace, svc.name)
+            if key in self._serve_services:
+                raise AlreadyExists(f"serveservice {key} exists")
+            svc = svc.copy()
+            self._stamp(svc.metadata)
+            self._serve_services[key] = svc
+            self._notify("serveservice", ADDED, svc)
+            return svc.copy()
+
+    def list_serve_services(
+        self, namespace: Optional[str] = None
+    ) -> List[ServeService]:
+        with self._lock:
+            return [
+                svc.copy()
+                for (ns, _), svc in self._serve_services.items()
+                if namespace is None or ns == namespace
+            ]
+
+    def get_serve_service(self, namespace: str, name: str) -> ServeService:
+        with self._lock:
+            svc = self._serve_services.get((namespace, name))
+            if svc is None:
+                raise NotFound(f"serveservice {namespace}/{name}")
+            return svc.copy()
+
+    def update_serve_service(self, svc: ServeService) -> ServeService:
+        with self._lock:
+            key = (svc.namespace, svc.name)
+            if key not in self._serve_services:
+                raise NotFound(f"serveservice {key}")
+            stored = self._serve_services[key]
+            if (
+                svc.metadata.resource_version
+                and svc.metadata.resource_version
+                != stored.metadata.resource_version
+            ):
+                raise Conflict(f"serveservice {key}: stale resourceVersion")
+            svc = svc.copy()
+            svc.metadata.resource_version = str(next(self._rv))
+            self._serve_services[key] = svc
+            self._notify("serveservice", MODIFIED, svc)
+            return svc.copy()
+
+    def update_serve_service_status(self, svc: ServeService) -> ServeService:
+        with self._lock:
+            key = (svc.namespace, svc.name)
+            stored = self._serve_services.get(key)
+            if stored is None:
+                raise NotFound(f"serveservice {key}")
+            stored.status = deep_copy(svc.status)
+            stored.metadata.resource_version = str(next(self._rv))
+            self._notify("serveservice", MODIFIED, stored)
+            return stored.copy()
+
+    def delete_serve_service(self, namespace: str, name: str) -> None:
+        with self._lock:
+            svc = self._serve_services.pop((namespace, name), None)
+            if svc is None:
+                raise NotFound(f"serveservice {namespace}/{name}")
+            self._notify("serveservice", DELETED, svc)
+            self._cascade_delete(svc.metadata.uid)
 
     def _cascade_delete(self, owner_uid: str) -> None:
         """Garbage-collect children owned (via ownerReferences) by a gone

@@ -1,14 +1,18 @@
 from .client import DecodeClient, DecodeError
 from .engine import ContinuousBatchingEngine, DecodeCancelled, EngineRequest
-from .server import DecodeHandlerFactory, main, make_server
+from .router import LeastLoadedRouter, NoReadyReplicas
+from .server import DecodeHandlerFactory, DecodeHTTPServer, main, make_server
 
 __all__ = [
     "make_server",
     "main",
     "DecodeHandlerFactory",
+    "DecodeHTTPServer",
     "DecodeClient",
     "DecodeError",
     "ContinuousBatchingEngine",
     "EngineRequest",
     "DecodeCancelled",
+    "LeastLoadedRouter",
+    "NoReadyReplicas",
 ]

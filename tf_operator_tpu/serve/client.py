@@ -5,10 +5,21 @@
     client = DecodeClient("http://gpt-serve-tpu-0.kubeflow.svc:8600")
     chains = client.generate([[1, 2, 3], [7, 8]], max_new_tokens=16)
     client.healthy()      # -> dict from /healthz
+    client.ready()        # -> True iff /readyz is 200
     client.metrics()      # -> {"tf_operator_tpu_serve_decodes_total": ...}
 
 Stdlib-only (urllib), mirroring the SDK's zero-dependency posture;
 ragged prompt batches are the server's job to pad.
+
+Transient failures (connection reset, 429/502/503) are replayed with
+the shared decorrelated-jitter retry (runtime/retry.py), honoring a
+server Retry-After hint. The retry boundary is strict about
+idempotence: whole-request POSTs replay freely; for /generate_stream
+only the *connect* (request send through response headers) is retried
+— once the first byte of the body has arrived, a mid-stream failure
+propagates, because replaying a half-consumed stream would double
+tokens. Mid-stream failover is the router's job (serve/router.py),
+which replays with the already-emitted tokens appended to the prompt.
 """
 
 from __future__ import annotations
@@ -17,6 +28,23 @@ import json
 import urllib.error
 import urllib.request
 from typing import Dict, List, Optional
+
+from ..runtime.retry import RetryPolicy, call_with_retries, retry_after_hint
+
+# 500/504 are deliberately absent (unlike the substrate's transport
+# policy): a 500 from the decode server is "this decode failed", which
+# a blind replay re-pays a full decode for — the caller or router
+# decides, not the transport.
+RETRYABLE_DECODE_STATUSES = frozenset({429, 502, 503})
+
+
+def _is_retryable(err: BaseException) -> bool:
+    if isinstance(err, urllib.error.HTTPError):
+        return err.code in RETRYABLE_DECODE_STATUSES
+    # URLError without .code covers refused/reset/DNS
+    return isinstance(
+        err, (ConnectionError, TimeoutError, urllib.error.URLError)
+    )
 
 
 class DecodeError(RuntimeError):
@@ -27,10 +55,43 @@ class DecodeError(RuntimeError):
         self.status = status
 
 
+def _to_decode_error(err: urllib.error.HTTPError) -> DecodeError:
+    body = err.read().decode(errors="replace")
+    try:
+        message = json.loads(body).get("error", body)
+    except json.JSONDecodeError:
+        message = body
+    return DecodeError(err.code, message)
+
+
 class DecodeClient:
-    def __init__(self, base_url: str, timeout: float = 300.0) -> None:
+    def __init__(
+        self,
+        base_url: str,
+        timeout: float = 300.0,
+        retry_policy: Optional[RetryPolicy] = None,
+    ) -> None:
         self.base_url = base_url.rstrip("/")
         self.timeout = timeout
+        # RetryPolicy(max_attempts=1) disables retries (the router
+        # supplies its own failover and wants failures fast)
+        self.retry_policy = retry_policy or RetryPolicy(
+            max_attempts=3, base_delay=0.05, max_delay=1.0
+        )
+
+    def _open(self, req: urllib.request.Request, op: str):
+        """urlopen with transient-failure retries; the caller owns the
+        returned response object. Safe to replay: no body bytes have
+        been consumed until this returns."""
+        return call_with_retries(
+            urllib.request.urlopen,
+            req,
+            timeout=self.timeout,
+            policy=self.retry_policy,
+            classify=_is_retryable,
+            retry_after=retry_after_hint,
+            op=op,
+        )
 
     def _request(self, path: str, payload: Optional[dict] = None):
         data = json.dumps(payload).encode() if payload is not None else None
@@ -41,15 +102,10 @@ class DecodeClient:
             method="POST" if data is not None else "GET",
         )
         try:
-            with urllib.request.urlopen(req, timeout=self.timeout) as resp:
+            with self._open(req, f"decode{path.partition('?')[0]}") as resp:
                 return resp.read()
         except urllib.error.HTTPError as err:
-            body = err.read().decode(errors="replace")
-            try:
-                message = json.loads(body).get("error", body)
-            except json.JSONDecodeError:
-                message = body
-            raise DecodeError(err.code, message) from None
+            raise _to_decode_error(err) from None
 
     def generate(
         self,
@@ -87,7 +143,9 @@ class DecodeClient:
         {"done": true, "tokens": [[...]], "prompt_lens": [n]}.
         urllib de-chunks transparently; a server-side decode failure
         mid-stream arrives as an {"error": ...} line and raises
-        DecodeError here."""
+        DecodeError here. Retries cover the connect only — past the
+        first byte a failure propagates (a stream body is not
+        idempotent; the router owns mid-stream failover)."""
         data = json.dumps({
             "input_ids": [list(input_ids)],
             "max_new_tokens": max_new_tokens,
@@ -103,22 +161,18 @@ class DecodeClient:
             method="POST",
         )
         try:
-            with urllib.request.urlopen(req, timeout=self.timeout) as resp:
-                for line in resp:
-                    line = line.strip()
-                    if not line:
-                        continue
-                    event = json.loads(line)
-                    if "error" in event:
-                        raise DecodeError(200, event["error"])
-                    yield event
+            resp = self._open(req, "decode/generate_stream")
         except urllib.error.HTTPError as err:
-            body = err.read().decode(errors="replace")
-            try:
-                message = json.loads(body).get("error", body)
-            except json.JSONDecodeError:
-                message = body
-            raise DecodeError(err.code, message) from None
+            raise _to_decode_error(err) from None
+        with resp:
+            for line in resp:
+                line = line.strip()
+                if not line:
+                    continue
+                event = json.loads(line)
+                if "error" in event:
+                    raise DecodeError(200, event["error"])
+                yield event
 
     def beam_search(
         self,
@@ -137,6 +191,21 @@ class DecodeClient:
 
     def healthy(self) -> dict:
         return json.loads(self._request("/healthz"))
+
+    def ready(self) -> bool:
+        """True iff /readyz answers 200 (engine warm, not draining).
+        Deliberately un-retried: a health probe must be cheap and
+        honest, and its caller (the router) polls anyway."""
+        req = urllib.request.Request(
+            self.base_url + "/readyz", method="GET"
+        )
+        try:
+            with urllib.request.urlopen(
+                req, timeout=min(self.timeout, 5.0)
+            ) as resp:
+                return resp.status == 200
+        except (OSError, urllib.error.URLError):
+            return False
 
     def metrics(self) -> Dict[str, float]:
         """Flat {sample_name_with_labels: value}; histogram families

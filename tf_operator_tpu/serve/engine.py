@@ -201,6 +201,14 @@ class ContinuousBatchingEngine:
         # drain: without it a put can land after the drain and strand
         # the client until its result() timeout
         self._lifecycle = locks.make_lock("ContinuousBatchingEngine._lifecycle")
+        # admission gate (rolling weight updates): cleared by
+        # pause_admission(), the scheduler finishes in-flight slots but
+        # admits nothing new; _drained is set BY THE ENGINE THREAD once
+        # it observes the cleared gate with zero active slots, so a
+        # drain() waiter knows no _place() is racing its params swap
+        self._admit_gate = threading.Event()
+        self._admit_gate.set()
+        self._drained = threading.Event()
         # counters (engine thread writes, observers read — stale reads
         # are fine for monitoring)
         self.steps = 0
@@ -325,6 +333,58 @@ class ContinuousBatchingEngine:
                 req.cancel()
             raise
 
+    def pause_admission(self) -> None:
+        """Stop placing queued requests into slots. In-flight slots
+        keep decoding to completion; queued requests stay queued (they
+        decode after resume_admission()). First leg of the rolling
+        weight-update drain."""
+        # clear the ack BEFORE the gate: while the gate is set the
+        # engine thread never touches _drained, so a stale ack from a
+        # previous drain cycle cannot satisfy this one early
+        self._drained.clear()
+        self._admit_gate.clear()
+
+    def resume_admission(self) -> None:
+        self._admit_gate.set()
+
+    @property
+    def draining(self) -> bool:
+        return not self._admit_gate.is_set()
+
+    def drain(self, timeout: float = 60.0) -> bool:
+        """Pause admission and wait until every in-flight slot has
+        finished; -> True when fully drained. After a True return (and
+        until resume_admission()) the engine thread is guaranteed not
+        to touch self.params, so swap_params() is safe."""
+        self.pause_admission()
+        if self.thread is None or not self.thread.is_alive():
+            # manual mode (start=False) or stopped: nothing races
+            if self.active_slots == 0:
+                self._drained.set()
+            return self.active_slots == 0
+        drained = self._drained.wait(timeout)
+        (self._flight or default_flight()).record(
+            "serve", op="drain", ok=drained,
+            active_slots=self.active_slots, queued=self.queue_depth,
+        )
+        return drained
+
+    def swap_params(self, params) -> None:
+        """Replace the model weights in place (rolling update). Only
+        legal on a drained engine: with zero active slots no compiled
+        step is reading params, so a plain reference swap is race-free
+        and the next admitted request decodes with the new weights.
+        Same pytree structure/shapes as the old params -> the compiled
+        step is reused, no recompile."""
+        with self._lifecycle:
+            if self._admit_gate.is_set() or not self._drained.is_set():
+                raise RuntimeError(
+                    "swap_params requires a drained engine "
+                    "(pause_admission + drain first)"
+                )
+            self.params = params
+        (self._flight or default_flight()).record("serve", op="swap-params")
+
     def stop(self) -> None:
         self._stop.set()
         if self.thread is not None:
@@ -378,6 +438,18 @@ class ContinuousBatchingEngine:
 
     def _run(self) -> None:
         while not self._stop.is_set():
+            if not self._admit_gate.is_set():
+                # draining: finish in-flight slots, admit nothing. The
+                # _drained ack is set here — by this thread, after the
+                # last slot released — so a drain() waiter knows no
+                # _place/_step_once can race its swap_params()
+                self._evict_cancelled()
+                if self.active_slots:
+                    self._step_once()
+                else:
+                    self._drained.set()
+                    self._stop.wait(0.005)
+                continue
             self._admit()
             self._evict_cancelled()
             if self.active_slots == 0:

@@ -1,0 +1,563 @@
+"""In-process serve fleet: the kubelet of the ServeService world.
+
+The ServeService controller (controller/serve.py) reconciles pod
+*records* on the substrate; this module gives those records a live
+body — one real decode server (make_server, continuous batching) per
+replica pod, wired into a LeastLoadedRouter — so the failover and
+rolling-update semantics run against actual sockets, engines, and
+compiled decode steps instead of mocks.
+
+Three jobs:
+
+- InProcessFleet.sync() boots a server for each pending serve pod,
+  marks it Running, and registers it with the router; kill() is the
+  chaos hammer (RST every live connection, stop the engine, terminate
+  the pod record with exit 137); update_weights() is the controller's
+  weight_update hook — drain the engine through its lifecycle gate,
+  swap params in place, readmit.
+
+- FaultyClientFactory wraps the router's DecodeClient with seeded
+  connection-reset injection (pre-connect and mid-stream), logged to
+  a chaos FaultLog as FAULT_CONN_RESET.
+
+- run_failover_soak() is the end-to-end robustness proof (also the CI
+  step `serve-failover-soak`): N replicas, concurrent streams, seeded
+  137 kills mid-stream plus injected resets — every accepted stream
+  must complete with the bit-identical greedy chain the model produces
+  inline, with zero lost streams and failovers visible in the flight
+  recorder under each request's correlation ID.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import logging
+import random
+import threading
+import time
+from typing import Dict, List, Optional
+
+from ..api import k8s
+from ..api.types import (
+    LABEL_SERVE_NAME,
+    LABEL_SERVE_WEIGHTS,
+    ServeService,
+    ServeServiceSpec,
+)
+from ..chaos.faults import FAULT_CONN_RESET, FaultLog
+from ..runtime.retry import RetryPolicy
+from ..telemetry.flight import default_flight
+from ..utils import locks
+from .client import DecodeClient
+from .router import LeastLoadedRouter
+
+logger = logging.getLogger("tf_operator_tpu.serve.fleet")
+
+
+class _ReplicaProcess:
+    """One booted replica: server + serve_forever thread + pod name."""
+
+    def __init__(self, pod_name: str, server, thread) -> None:
+        self.pod_name = pod_name
+        self.server = server
+        self.thread = thread
+
+    @property
+    def url(self) -> str:
+        host, port = self.server.server_address[:2]
+        return f"http://{host}:{port}"
+
+
+class InProcessFleet:
+    """Boots/terminates real decode servers to match serve pod records.
+
+    The substrate's kubelet simulator flips pod phases; this flips the
+    matching processes. Deliberately pull-based (call sync() after
+    pumping the controller) so tests control exactly when replicas
+    come up — the router's probe loop covers the in-between."""
+
+    def __init__(
+        self,
+        substrate,
+        router: LeastLoadedRouter,
+        cfg,
+        params_by_version: Dict[str, object],
+        slots: int = 2,
+        namespace: Optional[str] = None,
+        fault_log: Optional[FaultLog] = None,
+    ) -> None:
+        self.substrate = substrate
+        self.router = router
+        self.cfg = cfg
+        # weightsVersion tag -> param tree; "" maps to the tag the
+        # fleet should serve for pods created before a version was set
+        self.params_by_version = params_by_version
+        self.slots = slots
+        self.namespace = namespace
+        self.fault_log = fault_log
+        self._lock = locks.make_lock("InProcessFleet._lock")
+        self._replicas: Dict[str, _ReplicaProcess] = {}
+        self.boots = 0
+        self.kills = 0
+
+    def _params_for(self, version: str):
+        try:
+            return self.params_by_version[version]
+        except KeyError:
+            raise KeyError(
+                f"no params registered for weights version {version!r} "
+                f"(have: {sorted(self.params_by_version)})"
+            ) from None
+
+    def sync(self) -> List[str]:
+        """Boot a server for every pending serve pod without one.
+        Returns the pod names booted this pass."""
+        from .server import make_server
+
+        booted: List[str] = []
+        pods = self.substrate.list_pods(self.namespace)
+        for pod in pods:
+            name = pod.metadata.name
+            if LABEL_SERVE_NAME not in pod.metadata.labels:
+                continue
+            if pod.status.phase != k8s.POD_PENDING:
+                continue
+            with self._lock:
+                if name in self._replicas:
+                    continue
+            version = pod.metadata.labels.get(LABEL_SERVE_WEIGHTS, "")
+            params = self._params_for(version)
+            # warm_async: the listener binds first, /readyz answers
+            # "warming" (503) through the engine's construction
+            # compile, and the router only admits the replica when its
+            # probe sees ready — the exact boot sequence a real pod
+            # would walk
+            server = make_server(
+                self.cfg, params, port=0, model_name=name,
+                batching="continuous", n_slots=self.slots,
+                warm_async=True,
+            )
+            thread = threading.Thread(
+                target=server.serve_forever, name=f"serve-{name}",
+                daemon=True,
+            )
+            thread.start()
+            proc = _ReplicaProcess(name, server, thread)
+            with self._lock:
+                self._replicas[name] = proc
+            self.boots += 1
+            self.substrate.mark_pod_running(
+                pod.metadata.namespace, name
+            )
+            self.router.add_replica(name, proc.url)
+            booted.append(name)
+            logger.info("booted replica %s at %s", name, proc.url)
+        return booted
+
+    def kill(self, pod_name: str, exit_code: int = 137) -> None:
+        """Chaos kill: sever every live connection with an RST (the
+        in-process analog of the kernel tearing down a dead process's
+        sockets), stop the listener and engine, and terminate the pod
+        record so the controller reaps and replaces it."""
+        with self._lock:
+            proc = self._replicas.pop(pod_name, None)
+        if proc is None:
+            raise KeyError(f"no live replica {pod_name!r}")
+        self.kills += 1
+        if self.fault_log is not None:
+            self.fault_log.append(
+                "fleet.kill", "pod_death", f"{pod_name} exit={exit_code}"
+            )
+        aborted = proc.server.abort_connections()
+        proc.server.shutdown()
+        # stop the engine BEFORE server_close joins handler threads: a
+        # handler blocked on a queued request would otherwise wait out
+        # its stream timeout (stop() fails queued requests fast)
+        self._quiesce_engine(proc)
+        proc.server.server_close()
+        self.router.remove_replica(pod_name)
+        # find the pod's namespace from the record (terminate_pod needs it)
+        for pod in self.substrate.list_pods(self.namespace):
+            if pod.metadata.name == pod_name:
+                self.substrate.terminate_pod(
+                    pod.metadata.namespace, pod_name, exit_code=exit_code
+                )
+                break
+        logger.info(
+            "killed replica %s (exit %d, %d connections reset)",
+            pod_name, exit_code, aborted,
+        )
+
+    @staticmethod
+    def _quiesce_engine(proc: _ReplicaProcess) -> None:
+        """Settle a replica's engine before teardown. An async warmup
+        still compiling must be JOINED, not abandoned: exiting the
+        process mid-compile tears down XLA's thread pools under a live
+        compile thread and aborts with std::terminate."""
+        warmup = getattr(proc.server.state, "warmup_thread", None)
+        if warmup is not None and warmup.is_alive():
+            warmup.join(timeout=120.0)
+        engine = getattr(proc.server.state, "engine", None)
+        if engine is not None:
+            engine.stop()
+
+    def update_weights(
+        self, svc: ServeService, pods: List[k8s.Pod]
+    ) -> List[str]:
+        """The controller's weight_update hook: in-place drain + swap
+        for each pod in the batch. Sequence per replica — router stops
+        picking it, server 503s new work, engine finishes in-flight
+        slots behind the admission gate, params swap under the
+        lifecycle lock, then everything readmits. Returns the names
+        actually updated (the reconciler patches their weights label)."""
+        version = svc.spec.weights_version
+        params = self._params_for(version)
+        updated: List[str] = []
+        for pod in pods:
+            name = pod.metadata.name
+            with self._lock:
+                proc = self._replicas.get(name)
+            if proc is None:
+                continue  # died since the controller listed it
+            state = proc.server.state
+            engine = state.engine
+            self.router.set_draining(name, True)
+            try:
+                state.phase = "draining"
+                if not engine.drain(timeout=60.0):
+                    raise RuntimeError(
+                        f"replica {name} did not drain within 60s"
+                    )
+                engine.swap_params(params)
+                # keep the non-engine paths (beam search) on the same
+                # weights the engine now serves
+                state.params = params
+                engine.resume_admission()
+                state.phase = "ready"
+                updated.append(name)
+            finally:
+                self.router.set_draining(name, False)
+                self.router.probe(name)
+        return updated
+
+    def wait_ready(self, want: int, timeout: float = 120.0) -> None:
+        """Block until `want` replicas answer ready at the router."""
+        deadline = time.monotonic() + timeout
+        while True:
+            self.router.probe()
+            stats = self.router.stats()
+            ready = sum(
+                1 for r in stats["replicas"].values() if r["ready"]
+            )
+            if ready >= want:
+                return
+            if time.monotonic() > deadline:
+                raise TimeoutError(
+                    f"only {ready}/{want} replicas ready after {timeout}s"
+                )
+            time.sleep(0.05)
+
+    def replica_names(self) -> List[str]:
+        with self._lock:
+            return sorted(self._replicas)
+
+    def stop(self) -> None:
+        with self._lock:
+            procs = list(self._replicas.values())
+            self._replicas.clear()
+        for proc in procs:
+            proc.server.shutdown()
+            self._quiesce_engine(proc)
+            proc.server.server_close()
+            self.router.remove_replica(proc.pod_name)
+
+
+# -- fault injection --------------------------------------------------------
+
+
+class _FaultyStream:
+    """Wraps a replica stream; raises an injected reset after k events."""
+
+    def __init__(self, inner, cut_after: int) -> None:
+        self._inner = inner
+        self._cut_after = cut_after
+        self._count = 0
+
+    def __iter__(self):
+        for event in self._inner:
+            if self._count >= self._cut_after:
+                self._inner.close()
+                raise ConnectionResetError(
+                    "chaos: injected mid-stream connection reset"
+                )
+            self._count += 1
+            yield event
+
+
+class _FaultyClient:
+    """DecodeClient proxy with seeded connection-reset injection on
+    generate_stream. Everything else passes straight through."""
+
+    def __init__(self, inner: DecodeClient, factory) -> None:
+        self._inner = inner
+        self._factory = factory
+
+    def __getattr__(self, name: str):
+        return getattr(self._inner, name)
+
+    def generate_stream(self, input_ids, max_new_tokens: int = 16, **kw):
+        cut_after = self._factory.draw(self._inner.base_url)
+        if cut_after == 0:
+            # pre-connect reset: the replica was never reached, so the
+            # router retries without any tokens at stake
+            raise ConnectionResetError(
+                "chaos: injected pre-connect connection reset"
+            )
+        inner = self._inner.generate_stream(
+            input_ids, max_new_tokens, **kw
+        )
+        if cut_after is None:
+            return inner
+        return iter(_FaultyStream(inner, cut_after))
+
+
+class FaultyClientFactory:
+    """Router client_factory that injects FAULT_CONN_RESET faults from
+    one seeded rng: per generate_stream call, with `probability`, the
+    connection is reset either before connect (cut_after 0) or after
+    1..3 events, at most `max_count` times total. Deterministic given
+    the seed AND the call order — concurrency shuffles which stream
+    draws which fault, so soaks assert on totals, not placements."""
+
+    def __init__(
+        self,
+        seed: int,
+        probability: float = 0.25,
+        max_count: int = 3,
+        fault_log: Optional[FaultLog] = None,
+    ) -> None:
+        self._rng = random.Random(seed)
+        self._lock = locks.make_lock("FaultyClientFactory._lock")
+        self.probability = probability
+        self.max_count = max_count
+        self.fault_log = fault_log
+        self.injected = 0
+
+    def draw(self, url: str) -> Optional[int]:
+        """None = no fault this call; 0 = pre-connect reset; k>0 =
+        reset after k stream events."""
+        with self._lock:
+            if self.injected >= self.max_count:
+                return None
+            if self._rng.random() >= self.probability:
+                return None
+            self.injected += 1
+            cut_after = self._rng.randint(0, 3)
+        if self.fault_log is not None:
+            self.fault_log.append(
+                "router.generate_stream", FAULT_CONN_RESET,
+                f"{url} cut_after={cut_after}",
+            )
+        return cut_after
+
+    def __call__(self, url: str) -> _FaultyClient:
+        return _FaultyClient(
+            DecodeClient(
+                url, timeout=60.0,
+                retry_policy=RetryPolicy(max_attempts=1),
+            ),
+            self,
+        )
+
+
+# -- the soak ---------------------------------------------------------------
+
+
+def run_failover_soak(
+    seed: int = 0,
+    replicas: int = 3,
+    streams: int = 6,
+    kills: int = 1,
+    max_new: int = 12,
+    conn_faults: int = 2,
+    namespace: str = "chaos",
+) -> dict:
+    """Chaos-prove the fleet: boot `replicas` engine replicas under
+    the ServeService controller, run `streams` concurrent streams
+    through the router while killing `kills` replicas with exit 137
+    mid-stream and injecting `conn_faults` connection resets, then
+    pin every accepted stream to the bit-identical inline greedy
+    chain. Raises AssertionError on any lost or diverged stream."""
+    import jax
+    import jax.numpy as jnp
+
+    from ..models import gpt as gpt_lib
+    from ..runtime import InMemorySubstrate
+    from ..controller.serve import ServeServiceController
+
+    cfg = gpt_lib.GPT_TINY
+    params = gpt_lib.GPT(cfg).init(
+        jax.random.PRNGKey(0), jnp.zeros((1, 8), jnp.int32)
+    )["params"]
+
+    rng = random.Random(seed)
+    flight = default_flight()
+    fault_log = FaultLog(flight=flight, seed=seed)
+    factory = FaultyClientFactory(
+        seed=seed + 1, probability=0.35, max_count=conn_faults,
+        fault_log=fault_log,
+    )
+    substrate = InMemorySubstrate()
+    router = LeastLoadedRouter(client_factory=factory, retry_wait=0.02)
+    fleet = InProcessFleet(
+        substrate, router, cfg, {"v1": params}, slots=2,
+        namespace=namespace, fault_log=fault_log,
+    )
+    controller = ServeServiceController(
+        substrate, namespace=namespace,
+        weight_update=fleet.update_weights,
+    )
+    svc = ServeService(
+        spec=ServeServiceSpec(
+            replicas=replicas, preset="tiny", slots=2,
+            weights_version="v1",
+        )
+    )
+    svc.metadata.name = "soak"
+    svc.metadata.namespace = namespace
+
+    prompts = [
+        [rng.randrange(1, cfg.vocab_size) for _ in range(rng.randint(2, 5))]
+        for _ in range(streams)
+    ]
+    # the ground truth each stream must match bit-for-bit, computed on
+    # the same params the fleet serves (greedy chains are pure
+    # functions of the prompt)
+    expected = [
+        [int(t) for t in gpt_lib.generate(
+            cfg, params, jnp.asarray([prompt], jnp.int32), max_new,
+        )[0]]
+        for prompt in prompts
+    ]
+
+    results: List[Optional[List[int]]] = [None] * streams
+    errors: List[Optional[str]] = [None] * streams
+    corrs = [f"soak-{seed}-{i}" for i in range(streams)]
+    first_token = threading.Event()
+
+    def _run_stream(i: int) -> None:
+        try:
+            final = None
+            for event in router.generate_stream(
+                prompts[i], max_new, corr=corrs[i], timeout=120.0,
+            ):
+                if "token" in event:
+                    first_token.set()
+                if event.get("done"):
+                    final = event
+            results[i] = final["tokens"][0] if final else None
+        except Exception as err:  # noqa: BLE001 — recorded, asserted below
+            errors[i] = f"{type(err).__name__}: {err}"
+
+    started = time.monotonic()
+    try:
+        substrate.create_serve_service(svc)
+        controller.run_until_quiet()
+        fleet.sync()
+        fleet.wait_ready(replicas)
+
+        threads = [
+            threading.Thread(
+                target=_run_stream, args=(i,), name=f"stream-{i}",
+            )
+            for i in range(streams)
+        ]
+        for t in threads:
+            t.start()
+
+        # wait for real traffic, then kill replicas mid-stream; pump
+        # the controller so each kill is reaped and replaced, and
+        # sync the fleet so the replacement pod gets a live server
+        first_token.wait(timeout=60.0)
+        performed_kills = 0
+        while performed_kills < kills:
+            live = fleet.replica_names()
+            if not live:
+                break
+            victim = rng.choice(live)
+            fleet.kill(victim, exit_code=137)
+            performed_kills += 1
+            controller.run_until_quiet()
+            fleet.sync()
+        # keep reconciling until every stream lands (replacement
+        # replicas come ready mid-loop; the router probes them in)
+        while any(t.is_alive() for t in threads):
+            controller.run_until_quiet()
+            fleet.sync()
+            time.sleep(0.05)
+        for t in threads:
+            t.join(timeout=120.0)
+    finally:
+        fleet.stop()
+        controller.stop()
+
+    lost = [i for i in range(streams) if results[i] is None]
+    diverged = [
+        i for i in range(streams)
+        if results[i] is not None and results[i] != expected[i]
+    ]
+    failovers = router.failovers
+    # every failover must be visible in the flight ring under the
+    # request's correlation ID
+    recorded_failovers = sum(
+        len([
+            rec for rec in flight.snapshot(kind="serve", corr=corr)
+            if rec.fields.get("op") == "failover"
+        ])
+        for corr in corrs
+    )
+    summary = {
+        "seed": seed,
+        "replicas": replicas,
+        "streams": streams,
+        "kills": performed_kills,
+        "conn_faults_injected": factory.injected,
+        "failovers": failovers,
+        "recorded_failovers": recorded_failovers,
+        "boots": fleet.boots,
+        "lost": [f"{i}: {errors[i]}" for i in lost],
+        "diverged": diverged,
+        "seconds": round(time.monotonic() - started, 2),
+        "ok": not lost and not diverged
+        and recorded_failovers >= failovers,
+    }
+    if not summary["ok"]:
+        raise AssertionError(
+            f"serve failover soak failed: {json.dumps(summary)}"
+        )
+    return summary
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    parser = argparse.ArgumentParser(
+        description="ServeService fleet failover soak"
+    )
+    parser.add_argument("--soak", action="store_true", required=True)
+    parser.add_argument("--seed", type=int, default=0)
+    parser.add_argument("--replicas", type=int, default=3)
+    parser.add_argument("--streams", type=int, default=6)
+    parser.add_argument("--kills", type=int, default=1)
+    parser.add_argument("--max-new", type=int, default=12)
+    args = parser.parse_args(argv)
+    logging.basicConfig(level=logging.INFO)
+    summary = run_failover_soak(
+        seed=args.seed, replicas=args.replicas, streams=args.streams,
+        kills=args.kills, max_new=args.max_new,
+    )
+    print(json.dumps(summary, indent=2))
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

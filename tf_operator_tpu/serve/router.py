@@ -1,0 +1,365 @@
+"""Least-loaded request router over a fleet of decode replicas.
+
+The serving half of the reconciler's robustness contract
+(docs/serving.md): the ServeService controller keeps N engine replica
+pods alive; this router keeps *streams* alive across their deaths.
+
+Placement: each replica is scored by live local inflight count plus
+the queue-depth / active-slots / mean-active-slots telemetry the
+engines already export on /metrics (PR 4); /readyz (503 during warmup
+compile and drain) gates membership. Lowest score wins.
+
+Failover: greedy decoding is deterministic — the chain after a prompt
+is a pure function of the prompt. So when a replica dies mid-stream
+(connection reset, 5xx, a terminal {"error": ...} event), the router
+re-submits to another ready replica with the already-emitted tokens
+APPENDED TO THE PROMPT and max_new reduced by the emitted count. The
+new replica treats the emitted prefix as forced prompt tokens and
+continues the argmax chain bit-identically; the client sees one
+uninterrupted stream. Every failover is flight-recorded under the
+request's correlation ID (kind "serve", op "failover") so
+/debug/flightz?request=<corr> shows the request's whole journey
+across replicas.
+
+PEP 567 footnote: generators run in their *consumer's* context, so
+binding `correlate(corr)` inside generate_stream would leak between
+yields — every flight record here passes corr= explicitly instead.
+"""
+
+from __future__ import annotations
+
+import http.client
+import itertools
+import time
+import urllib.error
+from typing import Callable, Dict, List, Optional
+
+from ..telemetry.flight import default_flight
+from ..utils import locks
+from .client import DecodeClient, DecodeError
+
+_ROUTE_IDS = itertools.count(1)
+
+# metric sample names scraped from each replica's /metrics
+_Q_DEPTH = "tf_operator_tpu_serve_engine_queue_depth"
+_ACTIVE = "tf_operator_tpu_serve_engine_active_slots"
+_ROW_STEPS = "tf_operator_tpu_serve_engine_row_steps_total"
+_STEPS = "tf_operator_tpu_serve_engine_steps_total"
+
+# connection-level failures that mean "this replica, this attempt" —
+# the stream fails over, the replica gets a probe before reuse
+FAILOVER_ERRORS = (
+    ConnectionError,
+    TimeoutError,
+    OSError,
+    http.client.HTTPException,  # IncompleteRead: stream cut mid-chunk
+    urllib.error.URLError,
+)
+
+
+class NoReadyReplicas(RuntimeError):
+    """No ready replica accepted the request within the deadline."""
+
+
+class Replica:
+    """Router-side record of one engine replica endpoint."""
+
+    def __init__(self, name: str, url: str, client: DecodeClient) -> None:
+        self.name = name
+        self.url = url
+        self.client = client
+        self.ready = False
+        self.draining = False
+        self.inflight = 0      # streams this router has on the replica
+        self.queue_depth = 0.0
+        self.active_slots = 0.0
+        self.mean_active = 0.0
+        self.failures = 0
+
+    def score(self) -> tuple:
+        """Lower routes sooner. Local inflight is the live signal
+        (updated per pick/finish); the scraped gauges add the engine's
+        own backlog; mean active slots breaks ties toward the replica
+        that has historically run emptier."""
+        return (
+            2 * self.inflight + self.queue_depth + self.active_slots,
+            self.mean_active,
+            self.name,
+        )
+
+
+class LeastLoadedRouter:
+    """Routes decode requests across replicas; fails streams over.
+
+    Membership is explicit (add_replica/remove_replica — the fleet
+    harness wires it to pod lifecycle); health is probed from each
+    replica's /readyz + /metrics with probe(). Thread-safe: many
+    streams route concurrently."""
+
+    def __init__(
+        self,
+        client_factory: Optional[Callable[[str], DecodeClient]] = None,
+        flight=None,
+        stream_deadline: float = 120.0,
+        retry_wait: float = 0.05,
+    ) -> None:
+        # router-owned clients do NOT retry at the transport layer:
+        # the router's failover IS the retry, and it must see failures
+        # fast to re-place the stream
+        from ..runtime.retry import RetryPolicy
+
+        self._client_factory = client_factory or (
+            lambda url: DecodeClient(
+                url, timeout=60.0,
+                retry_policy=RetryPolicy(max_attempts=1),
+            )
+        )
+        self._flight = flight
+        self.stream_deadline = stream_deadline
+        self.retry_wait = retry_wait
+        self._lock = locks.make_lock("LeastLoadedRouter._lock")
+        self._replicas: Dict[str, Replica] = {}
+        self.failovers = 0     # lifetime counter, for tests/metrics
+
+    # -- membership --------------------------------------------------------
+
+    def add_replica(self, name: str, url: str) -> None:
+        # construct the client before taking the lock: the factory is
+        # injected and may itself lock (FaultyClientFactory does)
+        client = self._client_factory(url)
+        with self._lock:
+            if name in self._replicas:
+                return
+            self._replicas[name] = Replica(name, url, client)
+        self.probe(name)
+
+    def remove_replica(self, name: str) -> None:
+        with self._lock:
+            self._replicas.pop(name, None)
+
+    def set_draining(self, name: str, draining: bool) -> None:
+        """Exclude/readmit a replica for a rolling weight update. The
+        fleet flips this BEFORE the replica's own /readyz goes 503, so
+        no pick races into the drain window."""
+        with self._lock:
+            replica = self._replicas.get(name)
+            if replica is not None:
+                replica.draining = draining
+
+    def replica_names(self) -> List[str]:
+        with self._lock:
+            return sorted(self._replicas)
+
+    # -- health ------------------------------------------------------------
+
+    def probe(self, name: Optional[str] = None) -> None:
+        """Refresh readiness + load telemetry from /readyz + /metrics
+        for one replica (or all). Failures mark the replica not-ready;
+        the next probe can readmit it."""
+        with self._lock:
+            targets = [
+                r for r in self._replicas.values()
+                if name is None or r.name == name
+            ]
+        for replica in targets:
+            try:
+                ok = replica.client.ready()
+                if ok:
+                    flat = replica.client.metrics()
+                    replica.queue_depth = flat.get(_Q_DEPTH, 0.0)
+                    replica.active_slots = flat.get(_ACTIVE, 0.0)
+                    steps = flat.get(_STEPS, 0.0)
+                    replica.mean_active = (
+                        flat.get(_ROW_STEPS, 0.0) / steps if steps else 0.0
+                    )
+                replica.ready = ok
+            except Exception:  # noqa: BLE001 — an unreachable replica
+                # is simply not ready; the reconciler replaces it
+                replica.ready = False
+
+    # -- routing -----------------------------------------------------------
+
+    def _record(self, corr, op, **fields) -> None:
+        (self._flight or default_flight()).record(
+            "serve", corr=corr, op=op, **fields
+        )
+
+    def _acquire(self, tried: set, deadline: float, corr) -> Replica:
+        """Pick the lowest-scored ready replica, preferring ones this
+        request hasn't failed on; blocks (probing) until one exists or
+        the deadline passes. Bumps the pick's inflight count."""
+        while True:
+            with self._lock:
+                ready = [
+                    r for r in self._replicas.values()
+                    if r.ready and not r.draining
+                ]
+                candidates = [r for r in ready if r.name not in tried]
+                if not candidates and ready and tried:
+                    # every ready replica already failed this request
+                    # once — second chances beat giving up (it may
+                    # have recovered; the probe below re-vetted it)
+                    tried.clear()
+                    candidates = ready
+                if candidates:
+                    best = min(candidates, key=Replica.score)
+                    best.inflight += 1
+                    return best
+            if time.monotonic() > deadline:
+                raise NoReadyReplicas(
+                    "no ready replica within the deadline "
+                    f"(known: {self.replica_names()})"
+                )
+            # a kill may have taken the whole ready set: re-probe (the
+            # reconciler is replacing the pod meanwhile) and wait
+            self.probe()
+            time.sleep(self.retry_wait)
+
+    def _release(self, replica: Replica) -> None:
+        with self._lock:
+            replica.inflight = max(0, replica.inflight - 1)
+
+    def _mark_failed(self, replica: Replica, err: BaseException) -> None:
+        with self._lock:
+            replica.ready = False
+            replica.failures += 1
+            self.failovers += 1
+
+    def generate_stream(
+        self,
+        input_ids: List[int],
+        max_new_tokens: int = 16,
+        corr: Optional[str] = None,
+        timeout: Optional[float] = None,
+    ):
+        """One logical stream across the fleet: yields {"token",
+        "index", "replica"} per generated token, then a final
+        {"done": True, "tokens": [[full chain]], "prompt_lens": [n],
+        "request_id": corr, "failovers": k}. Greedy-only, like the
+        engine path it rides. Mid-stream replica failures are replayed
+        on another replica with prompt+emitted (see module docstring);
+        4xx rejections propagate as DecodeError (replaying a request
+        the server called invalid cannot help)."""
+        prompt = [int(t) for t in input_ids]
+        new = int(max_new_tokens)
+        if corr is None:
+            corr = f"route-{next(_ROUTE_IDS)}"
+        deadline = time.monotonic() + (timeout or self.stream_deadline)
+        emitted: List[int] = []
+        failovers = 0
+        tried: set = set()
+        self._record(
+            corr, "route", prompt_tokens=len(prompt), new=new,
+        )
+        while len(emitted) < new:
+            replica = self._acquire(tried, deadline, corr)
+            try:
+                inner = replica.client.generate_stream(
+                    prompt + emitted, new - len(emitted)
+                )
+                for event in inner:
+                    if "token" in event:
+                        emitted.append(int(event["token"]))
+                        yield {
+                            "token": int(event["token"]),
+                            "index": len(prompt) + len(emitted) - 1,
+                            "replica": replica.name,
+                        }
+                    if event.get("done"):
+                        break
+            except DecodeError as err:
+                if err.status < 500 and err.status != 200:
+                    # the server judged the request itself bad; a
+                    # different replica will say the same thing
+                    self._release(replica)
+                    raise
+                # 5xx or a mid-stream {"error": ...} terminal event
+                # (status 200): replica-side failure — fail over
+                self._mark_failed(replica, err)
+                self._release(replica)
+                tried.add(replica.name)
+                failovers += 1
+                self._record(
+                    corr, "failover", replica=replica.name,
+                    error=f"{type(err).__name__}: {err}"[:200],
+                    emitted=len(emitted),
+                )
+                continue
+            except FAILOVER_ERRORS as err:
+                self._mark_failed(replica, err)
+                self._release(replica)
+                tried.add(replica.name)
+                failovers += 1
+                self._record(
+                    corr, "failover", replica=replica.name,
+                    error=f"{type(err).__name__}: {err}"[:200],
+                    emitted=len(emitted),
+                )
+                continue
+            except BaseException:
+                # consumer closed us (GeneratorExit) or something
+                # unclassified: don't leak the inflight count
+                self._release(replica)
+                raise
+            else:
+                self._release(replica)
+                if len(emitted) < new:
+                    # clean end-of-stream before the token budget was
+                    # met (e.g. the replica began draining and closed
+                    # politely): treat like a failover, resume elsewhere
+                    tried.add(replica.name)
+                    failovers += 1
+                    self._record(
+                        corr, "failover", replica=replica.name,
+                        error="short-stream", emitted=len(emitted),
+                    )
+        self._record(
+            corr, "route-done", tokens=len(emitted), failovers=failovers,
+        )
+        yield {
+            "done": True,
+            "tokens": [prompt + emitted],
+            "prompt_lens": [len(prompt)],
+            "request_id": corr,
+            "failovers": failovers,
+        }
+
+    def generate(
+        self,
+        input_ids: List[List[int]],
+        max_new_tokens: int = 16,
+        corr: Optional[str] = None,
+        timeout: Optional[float] = None,
+    ) -> List[List[int]]:
+        """Non-streaming fan-out: each row rides its own
+        generate_stream (so every row gets mid-request failover), and
+        the full chains come back together."""
+        chains: List[List[int]] = []
+        for row in input_ids:
+            final: Optional[dict] = None
+            for event in self.generate_stream(
+                row, max_new_tokens, corr=corr, timeout=timeout
+            ):
+                if event.get("done"):
+                    final = event
+            assert final is not None  # generate_stream always ends done
+            chains.append(final["tokens"][0])
+        return chains
+
+    def stats(self) -> dict:
+        """Telemetry snapshot for tests and debugging."""
+        with self._lock:
+            return {
+                "failovers": self.failovers,
+                "replicas": {
+                    r.name: {
+                        "ready": r.ready,
+                        "draining": r.draining,
+                        "inflight": r.inflight,
+                        "queue_depth": r.queue_depth,
+                        "active_slots": r.active_slots,
+                        "failures": r.failures,
+                    }
+                    for r in self._replicas.values()
+                },
+            }

@@ -21,7 +21,11 @@ benchmarks measure:
                   {"token": t, "index": i} event per generated token,
                   then {"done": true, "tokens": [[...]],
                         "prompt_lens": [n]}
-    GET  /healthz -> {"status": "ok", "model": "...", "decodes": N}
+    GET  /healthz -> {"status": "ok"|"warming"|"draining", ...}
+                  (always 200 while the process lives: liveness)
+    GET  /readyz  -> 200 {"status": "ready"} only while admitting;
+                  503 during warmup compile and drain (readiness —
+                  the router's replica-health signal)
 
 Ragged batches are first-class: rows are right-padded server-side and
 decoded in one scan with per-row prompt boundaries
@@ -129,6 +133,14 @@ class _State:
         self.max_new_cap = max_new_cap
         self.speculative = speculative
         self.weights_int8 = weights_int8
+        # replica lifecycle phase, read by /healthz and /readyz and
+        # flipped by make_server (warmup), the SIGTERM drain, and the
+        # fleet's rolling weight updates: "warming" -> "ready" ->
+        # "draining" (-> "ready" after a weight swap). POSTs are only
+        # admitted while "ready"; the router excludes non-ready
+        # replicas via /readyz. Plain str store/load (atomic in
+        # CPython) — no lock needed for a single-word phase flag.
+        self.phase = "warming"
         self.mesh = mesh  # sharded decode (generate(mesh=)); tp over
         # TRANSFORMER_RULES. Speculative is a single-device program
         # (refused with a mesh at make_server); beam_search runs over
@@ -448,13 +460,26 @@ def DecodeHandlerFactory(state: _State):
         def do_GET(self) -> None:  # noqa: N802
             self._request_corr = None
             if self.path == "/healthz":
+                # liveness stays 200 through warmup and drain (the
+                # process is alive and should not be restarted) but the
+                # status string tells pollers the truth — "ok" only
+                # while actually admitting requests
+                phase = state.phase
                 self._reply(200, {
-                    "status": "ok",
+                    "status": "ok" if phase == "ready" else phase,
                     "model": state.model_name,
                     "kv_int8": state.kv_quant_int8,
                     "weights_int8": state.weights_int8,
                     "decodes": int(state.decodes),
                 })
+            elif self.path == "/readyz":
+                # readiness: 503 during warmup compile and drain so the
+                # router (serve/router.py) excludes this replica
+                phase = state.phase
+                self._reply(
+                    200 if phase == "ready" else 503,
+                    {"status": phase, "model": state.model_name},
+                )
             elif self.path == "/metrics":
                 body = state.render_metrics().encode()
                 self.send_response(200)
@@ -528,6 +553,15 @@ def DecodeHandlerFactory(state: _State):
         def _handle_post(self) -> None:
             if self.path not in ("/generate", "/generate_stream"):
                 return self._reply(404, {"error": f"no route {self.path}"})
+            if state.phase != "ready":
+                # warming or draining: refuse new work loudly (503 is
+                # in the client/router retryable class) instead of
+                # queueing behind a paused engine
+                with state.lock:
+                    state.request_errors += 1
+                return self._reply(
+                    503, {"error": f"server is {state.phase}"}
+                )
             try:
                 length = int(self.headers.get("Content-Length") or 0)
                 # widen the socket budget for the upload only; the
@@ -726,9 +760,12 @@ def DecodeHandlerFactory(state: _State):
                         "request_id": self._request_corr,
                     })
                     self._end_stream()
-                except (BrokenPipeError, ConnectionError) as err:
-                    # the client went away mid-stream: cancel so the
-                    # slot frees before the next step instead of
+                except (BrokenPipeError, ConnectionError, OSError,
+                        ValueError) as err:
+                    # the client went away mid-stream (or the socket
+                    # was severed by DecodeHTTPServer.abort_connections
+                    # — a closed makefile raises ValueError): cancel so
+                    # the slot frees before the next step instead of
                     # decoding to nobody
                     req.cancel()
                     logger.info("stream client gone: %s", err)
@@ -745,7 +782,7 @@ def DecodeHandlerFactory(state: _State):
                             f"{type(err).__name__}: {err}"[:300]
                         })
                         self._end_stream()
-                    except OSError:
+                    except (OSError, ValueError):
                         self.close_connection = True
                     return
                 with state.lock:
@@ -801,6 +838,67 @@ def DecodeHandlerFactory(state: _State):
     return Handler
 
 
+class DecodeHTTPServer(ThreadingHTTPServer):
+    """ThreadingHTTPServer that tracks live connection sockets.
+
+    abort_connections() severs every in-flight connection with an RST
+    (SO_LINGER 0) — the in-process analog of a replica OOM-killed with
+    exit 137: clients observe a connection reset mid-stream, never a
+    graceful close. The fleet harness (serve/fleet.py) uses it to make
+    chaos kills abrupt; a plain shutdown() would let streams finish and
+    prove nothing about failover."""
+
+    def __init__(self, *args, **kwargs):
+        super().__init__(*args, **kwargs)
+        self._conn_lock = locks.make_lock("DecodeHTTPServer._conn_lock")
+        self._conns: set = set()
+
+    def process_request(self, request, client_address):
+        with self._conn_lock:
+            self._conns.add(request)
+        super().process_request(request, client_address)
+
+    def shutdown_request(self, request):
+        with self._conn_lock:
+            self._conns.discard(request)
+        super().shutdown_request(request)
+
+    def abort_connections(self) -> int:
+        """Hard-close every live connection; -> how many were severed."""
+        import socket as socket_mod
+        import struct
+
+        with self._conn_lock:
+            conns = list(self._conns)
+            self._conns.clear()
+        for sock in conns:
+            try:
+                # linger(on, 0): close() sends RST instead of FIN —
+                # the peer gets ECONNRESET, exactly what a killed
+                # process produces
+                sock.setsockopt(
+                    socket_mod.SOL_SOCKET, socket_mod.SO_LINGER,
+                    struct.pack("ii", 1, 0),
+                )
+            except OSError:
+                pass
+            try:
+                sock.close()
+            except OSError:
+                pass
+        return len(conns)
+
+    def handle_error(self, request, client_address):
+        # severed sockets make handler threads die on writes; that is
+        # expected during abort_connections/drain — keep the default
+        # traceback spew for everything else
+        exc = sys.exc_info()[1]
+        if isinstance(exc, (ConnectionError, BrokenPipeError, OSError,
+                            ValueError)):
+            return
+        super().handle_error(request, client_address)
+
+
 def make_server(
     cfg,
     params,
@@ -816,6 +914,7 @@ def make_server(
     warm_shapes=None,
     batching: str = "",
     n_slots: int = 8,
+    warm_async: bool = False,
 ) -> ThreadingHTTPServer:
     """In-process server (tests and embedders); caller owns
     serve_forever/shutdown. The CLI binds 0.0.0.0 (pods must be
@@ -861,6 +960,12 @@ def make_server(
                 "batching='continuous' and mesh are mutually exclusive: "
                 "the slot engine is a single-device program"
             )
+    if warm_async and batching != "continuous":
+        raise ValueError(
+            "warm_async requires batching='continuous': only the "
+            "engine has a construction-time compile worth overlapping "
+            "with the listener boot"
+        )
     if speculative and batch_window_ms > 0:
         raise ValueError(
             "speculative and batch_window_ms are mutually exclusive: "
@@ -931,14 +1036,36 @@ def make_server(
     elif batching == "continuous":
         from .engine import ContinuousBatchingEngine
 
-        # state.params is the final tree (post weights_int8 quantize,
-        # which the engine's step reads the same way generate does);
-        # the engine pays its ONE compile here, at startup
-        state.engine = ContinuousBatchingEngine(
-            cfg, state.params, n_slots=n_slots,
-            kv_quant_int8=kv_quant_int8, weights_int8=weights_int8,
-            registry=state.registry, tracer=state.tracer,
-        )
+        def _build_engine():
+            # state.params is the final tree (post weights_int8
+            # quantize, which the engine's step reads the same way
+            # generate does); the engine pays its ONE compile here, at
+            # startup
+            state.engine = ContinuousBatchingEngine(
+                cfg, state.params, n_slots=n_slots,
+                kv_quant_int8=kv_quant_int8, weights_int8=weights_int8,
+                registry=state.registry, tracer=state.tracer,
+            )
+
+        if warm_async:
+            # boot the listener first so /readyz answers ("warming",
+            # 503) during the engine's construction compile; the fleet
+            # and its router only admit the replica once phase flips
+            def _warm():
+                try:
+                    _build_engine()
+                except Exception:  # noqa: BLE001 — a dead warmup must
+                    # surface, not hang pollers at "warming" forever
+                    logger.exception("async engine warmup failed")
+                    state.phase = "failed"
+                    return
+                state.phase = "ready"
+
+            state.warmup_thread = threading.Thread(
+                target=_warm, name="engine-warmup", daemon=True
+            )
+        else:
+            _build_engine()
     if warm_shapes:
         # pre-compile the expected (batch, width, new) decode shapes at
         # startup: each distinct shape costs one XLA compile (~20-40s
@@ -961,8 +1088,15 @@ def make_server(
         state.decode_batches = 0
         state.decode_seconds = 0.0
         state.speculative_decodes = 0
-    server = ThreadingHTTPServer((host, port), DecodeHandlerFactory(state))
+    server = DecodeHTTPServer((host, port), DecodeHandlerFactory(state))
     server.state = state  # tests reach the batcher for shutdown
+    warmup = getattr(state, "warmup_thread", None)
+    if warmup is not None:
+        # listener exists: /readyz can answer "warming" while the
+        # engine compiles; phase flips to "ready" inside the thread
+        warmup.start()
+    else:
+        state.phase = "ready"
     return server
 
 
@@ -1338,6 +1472,10 @@ def main(argv=None) -> int:
 
     def _drain(signum, frame):
         logger.info("signal %d: draining in-flight requests", signum)
+        # flip the phase FIRST: /readyz goes 503 and /healthz reports
+        # "draining" immediately, so pollers and the router stop
+        # sending work before the listener even begins shutting down
+        server.state.phase = "draining"
         threading.Thread(target=server.shutdown, daemon=True).start()
 
     import signal
