@@ -24,7 +24,7 @@ IMAGE_DIR := build/images
 DIST      := build/dist
 
 .PHONY: ci presubmit lint analyze native native-test native-race test wire-test e2e e2e-kind bench \
-        chaos-soak serve-soak images release mnist-acc clean
+        chaos-soak serve-soak serve-paged images release mnist-acc clean
 
 # `test` already runs the whole tests/ tree (native bindings, wire,
 # E2E suites included) — native-test/wire-test exist for targeted runs,
@@ -89,6 +89,14 @@ chaos-soak:
 # single-seed fast variant runs in `test` and CI's serve-failover-soak
 serve-soak:
 	env JAX_PLATFORMS=cpu $(PY) -m pytest tests/test_serve_fleet.py -q -m slow
+
+# paged-KV engine smoke (docs/serving.md): small blocks + chunked
+# prefill, shared-prefix and near-max prompts, every chain checked
+# bit-identical against inline generate, prefix hits and the
+# one-compile-per-program contract asserted (CI's serve-paged-smoke)
+serve-paged:
+	env JAX_PLATFORMS=cpu $(PY) -m tf_operator_tpu.serve.engine --smoke \
+	    --layout paged --block-size 8 --prefill-chunk 6
 
 # Hermetic E2E runs everywhere (operator process <-HTTP-> apiserver
 # <-HTTP-> process kubelet); the kind path self-activates when kind is

@@ -26,6 +26,18 @@ Scenarios, one JSON artifact (SERVE_BENCH.json):
                     including the batch-min exposure at batch > 1
                     (the evidence for the server's single-row
                     speculative routing policy).
+6. ``paged_kv``   — the paged KV layout's three claims, engine-level
+                    A/Bs against the dense grid: ``shared_prefix``
+                    (prefix-cache hit rate and the TTFT p50/p95 win on
+                    a shared-system-prompt workload), ``capacity``
+                    (max concurrent sessions at EQUAL KV memory —
+                    the >= 4x acceptance pin, chains bit-identical),
+                    and ``long_prompt`` (chunked prefill: a max-length
+                    prompt's TTFT vs riding the forcing rule, with
+                    concurrent streams' inter-token p95 recorded
+                    during the ingestion; the no-stall property itself
+                    is asserted deterministically in
+                    tests/test_engine.py).
 
 Run:  BENCH_CPU=1 python benchmarks/serve_bench.py   (CPU shapes)
       python benchmarks/serve_bench.py               (TPU shapes)
@@ -360,6 +372,167 @@ def spec_scenarios(cfg, params, prompt_len: int, new: int) -> dict:
     return out
 
 
+def paged_scenarios(cfg, params) -> dict:
+    """Engine-level paged-vs-dense A/Bs (no HTTP: the layouts share
+    every other code path, so the engine IS the unit under test).
+    Raises on any acceptance regression — hit rate zero, TTFT p95 not
+    better on the shared-prefix workload, capacity ratio under 4x, or
+    any chain diverging from the dense grid's — so a stale
+    SERVE_BENCH.json can never hide one."""
+    from tf_operator_tpu.serve.engine import ContinuousBatchingEngine
+
+    bs = 16
+    max_total = cfg.max_seq_len
+    out = {"block_size": bs}
+
+    # -- shared prefix: N requests behind one long system prompt ------
+    system = [
+        int(x) for x in jax.random.randint(
+            jax.random.PRNGKey(11), (6 * bs,), 0, cfg.vocab_size
+        )
+    ]
+    tails = [
+        [int(t) for t in jax.random.randint(
+            jax.random.PRNGKey(100 + i), (3,), 0, cfg.vocab_size
+        )]
+        for i in range(24)
+    ]
+    new = 8
+    chains = {}
+    rows = {}
+    for layout in ("paged", "dense"):
+        eng = ContinuousBatchingEngine(
+            cfg, params, n_slots=8, kv_layout=layout,
+            block_size=bs, prefill_chunk=bs,
+        )
+        try:
+            # warm request: decodes the system prompt once; under
+            # paged its full blocks publish into the prefix cache
+            eng.submit(system, 2).result(600)
+            handles = [eng.submit(system + t, new) for t in tails]
+            chains[layout] = [h.result(600) for h in handles]
+            ttfts = sorted(h.ttft for h in handles)
+            rows[layout] = {
+                "requests": len(handles),
+                "prompt_len": len(system) + 3,
+                "ttft_p50_s": round(percentile(ttfts, 0.50), 4),
+                "ttft_p95_s": round(percentile(ttfts, 0.95), 4),
+            }
+            if layout == "paged":
+                pool = eng.pool
+                rows[layout]["prefix_hits"] = pool.hits
+                rows[layout]["prefix_hit_rate"] = round(
+                    pool.hits / max(pool.hits + pool.misses, 1), 3
+                )
+                rows[layout]["prefix_hit_tokens"] = pool.hit_tokens
+        finally:
+            eng.stop()
+    if chains["paged"] != chains["dense"]:
+        raise AssertionError("paged shared-prefix chains diverged")
+    if rows["paged"]["prefix_hits"] <= 0:
+        raise AssertionError("shared-prefix workload produced no hits")
+    if rows["paged"]["ttft_p95_s"] >= rows["dense"]["ttft_p95_s"]:
+        raise AssertionError(
+            "paged TTFT p95 not better than dense on shared prefixes"
+        )
+    out["shared_prefix"] = rows
+
+    # -- capacity at equal KV memory ----------------------------------
+    # dense: 4 slots x max_total tokens of KV; paged: the SAME token
+    # capacity as a block pool (+1 sentinel block), 16 slots over it
+    pool_tokens = 4 * max_total
+    jobs = [
+        ([int(t) for t in jax.random.randint(
+            jax.random.PRNGKey(200 + i), (8,), 0, cfg.vocab_size
+        )], 16)
+        for i in range(16)
+    ]
+    cap_rows = {}
+    cap_chains = {}
+    for layout, slots, blocks in (
+        ("paged", 16, pool_tokens // bs), ("dense", 4, 0),
+    ):
+        eng = ContinuousBatchingEngine(
+            cfg, params, n_slots=slots, kv_layout=layout,
+            block_size=bs, kv_blocks=blocks, prefill_chunk=0,
+        )
+        try:
+            handles = [eng.submit(row, n) for row, n in jobs]
+            cap_chains[layout] = [h.result(600) for h in handles]
+            cap_rows[layout] = {
+                "n_slots": slots,
+                "kv_tokens": pool_tokens,
+                "peak_concurrent": eng.peak_active,
+            }
+        finally:
+            eng.stop()
+    ratio = (
+        cap_rows["paged"]["peak_concurrent"]
+        / max(cap_rows["dense"]["peak_concurrent"], 1)
+    )
+    cap_rows["ratio"] = round(ratio, 2)
+    if cap_chains["paged"] != cap_chains["dense"]:
+        raise AssertionError("capacity-scenario chains diverged")
+    if ratio < 4.0:
+        raise AssertionError(
+            f"paged concurrency ratio {ratio} under the 4x pin"
+        )
+    out["capacity"] = cap_rows
+
+    # -- long prompt: chunked prefill vs the forcing rule -------------
+    long_row = [
+        int(t) for t in jax.random.randint(
+            jax.random.PRNGKey(31), (max_total - 5,), 0, cfg.vocab_size
+        )
+    ]
+    lp_rows = {}
+    for label, layout, chunk in (
+        ("paged_chunked", "paged", bs), ("dense", "dense", 0),
+    ):
+        eng = ContinuousBatchingEngine(
+            cfg, params, n_slots=4, kv_layout=layout,
+            block_size=bs, prefill_chunk=chunk,
+        )
+        try:
+            shorts = [eng.submit([3, 1 + i], 48) for i in range(2)]
+            gaps = []
+            glock = threading.Lock()
+
+            def consume(req):
+                last = None
+                for _ in req.stream(timeout=600):
+                    now = time.perf_counter()
+                    if last is not None:
+                        with glock:
+                            gaps.append(now - last)
+                    last = now
+
+            threads = [
+                threading.Thread(target=consume, args=(r,))
+                for r in shorts
+            ]
+            for t in threads:
+                t.start()
+            time.sleep(0.05)  # shorts are decoding
+            long_req = eng.submit(long_row, 4)
+            long_req.result(600)
+            for t in threads:
+                t.join(timeout=600)
+            lp_rows[label] = {
+                "long_prompt_len": len(long_row),
+                "long_ttft_s": round(long_req.ttft, 4),
+                "stream_itl_p95_s": round(
+                    percentile(sorted(gaps), 0.95), 4
+                ),
+            }
+            if layout == "paged":
+                lp_rows[label]["prefill_chunks"] = eng.prefill_chunks
+        finally:
+            eng.stop()
+    out["long_prompt"] = lp_rows
+    return out
+
+
 def run(write: bool = True) -> dict:
     on_tpu = jax.devices()[0].platform == "tpu"
     cfg, prompt_len, new, n_clients, reqs_per_client = _shapes(on_tpu)
@@ -411,6 +584,7 @@ def run(write: bool = True) -> dict:
             moe_cfg, moe_params, moe_prompts, moe_new, n_clients
         ),
         "speculative": spec_scenarios(cfg, params, prompt_len, new),
+        "paged_kv": paged_scenarios(cfg, params),
         "notes": (
             "plain/batched/continuous drive the live HTTP server "
             "(in-process, loopback) with single-row greedy requests "
@@ -430,7 +604,19 @@ def run(write: bool = True) -> dict:
             "the batch-min exposure (one random row dragging three "
             "high-acceptance rows). moe_plain serves the MoE family "
             "through the same live-HTTP harness (plain server; the "
-            "batcher is a gpt-family feature)."
+            "batcher is a gpt-family feature). paged_kv A/Bs the "
+            "paged KV layout against the dense grid at the engine "
+            "level: shared_prefix (prefix-cache hit rate + TTFT "
+            "p50/p95 behind one system prompt), capacity (peak "
+            "concurrent sessions at equal KV token memory; the >= 4x "
+            "pin, chains bit-identical), long_prompt (a near-max "
+            "prompt's TTFT chunk-ingested vs riding the forcing "
+            "rule, with concurrent streams' inter-token p95 during "
+            "the ingestion — the no-stall property is asserted "
+            "deterministically in tests/test_engine.py). The "
+            "scenario raises on hit-rate-zero, TTFT-not-better, or "
+            "ratio-under-4x, so the artifact cannot go stale past an "
+            "acceptance regression."
         ),
     }
     if write:

@@ -52,11 +52,14 @@ RECEIVER_TYPES = {
 
 # Call sites whose arguments are donated to XLA, scoped per class so
 # two classes with a `self.step` attribute don't cross-contaminate:
-# the serve engine's SlotDecodeStep donates the KV cache (position 1,
-# off-CPU); the trainer's train step donates the TrainState
-# (position 0).
+# the serve engine's SlotDecodeStep/PagedSlotDecodeStep donates the
+# KV cache (position 1, off-CPU) through its decode step and the
+# paged prefill-chunk step, and through copy_block (cache at position
+# 0); the trainer's train step donates the TrainState (position 0).
 DONATING_CALLABLES = {
     "ContinuousBatchingEngine:self.step": (1,),
+    "ContinuousBatchingEngine:self.step.prefill": (1,),
+    "ContinuousBatchingEngine:self.step.copy_block": (0,),
     "Trainer:self.step": (0,),
 }
 

@@ -258,6 +258,8 @@ class TestContinuousServing:
         srv = make_server(
             CFG, params, model_name="gpt-test", max_new_cap=64,
             batching="continuous", n_slots=4,
+            block_size=8, kv_blocks=8,  # bounded pool: over-pool
+            # prompts must come back as 400s, not mid-stream kills
         )
         thread = threading.Thread(target=srv.serve_forever, daemon=True)
         thread.start()
@@ -308,6 +310,28 @@ class TestContinuousServing:
         with pytest.raises(urllib.error.HTTPError) as err:
             urllib.request.urlopen(req, timeout=30)
         assert err.value.code == 400
+
+    def test_oversized_prompt_is_a_client_error(self, server):
+        """The submit-time rejection reaches the client as a 400 with
+        the engine's message — not a mid-stream kill, not a 500. A
+        70-token prompt + 8 new needs 10 KV blocks of this server's
+        8-block pool, but passes the generic max_seq_len check."""
+        port = server
+        for path in ("/generate", "/generate_stream"):
+            req = urllib.request.Request(
+                f"http://127.0.0.1:{port}{path}",
+                data=json.dumps({
+                    "input_ids": [list(range(1, 71))],
+                    "max_new_tokens": 8,
+                }).encode(),
+                headers={"Content-Type": "application/json"},
+                method="POST",
+            )
+            with pytest.raises(urllib.error.HTTPError) as err:
+                urllib.request.urlopen(req, timeout=30)
+            assert err.value.code == 400
+            body = json.loads(err.value.read().decode())
+            assert "KV blocks" in body["error"]
 
     def test_sampled_keeps_inline_path(self, server):
         port = server
@@ -469,3 +493,261 @@ class TestEngineLifecycle:
             eng.resume_admission()
         finally:
             eng.stop()
+
+
+class TestPagedEngine:
+    """The paged KV layout: bit-identity with the dense grid under
+    random admit/evict/cancel churn, prefix-cache sharing + CoW,
+    chunked prefill's no-stall contract, refcount invariants, and the
+    over-pool rejection. All manual-drive (start=False) so schedule
+    points are deterministic and seeded failures reproduce."""
+
+    @staticmethod
+    def drive(engine, handles, cancel_at=None, max_iters=5000):
+        """The scheduler loop, by hand: admit, evict, one quantum.
+        cancel_at: {iteration: [handle, ...]} fired between quanta."""
+        cancel_at = cancel_at or {}
+        for it in range(max_iters):
+            for handle in cancel_at.get(it, ()):
+                handle.cancel()
+            if all(h.done.is_set() for h in handles):
+                return
+            engine._admit()
+            engine._evict_cancelled()
+            if engine.active_slots:
+                engine._work_once()
+        raise AssertionError("drive() did not converge")
+
+    def test_paged_matches_dense_random_soak(self, params):
+        """The acceptance pin: for a seeded random mix of lengths,
+        budgets, shared prefixes, and mid-flight cancels — under a
+        pool SMALL enough to force head-of-line waits and LRU reclaim
+        — every completed paged chain equals the dense grid's chain
+        token-for-token, one compile each, and the pool ends with
+        zero leaked or double-freed blocks."""
+        rng = np.random.default_rng(7)
+        system = rng.integers(0, CFG.vocab_size, size=16).tolist()
+        jobs = []
+        for _ in range(20):
+            new = int(rng.integers(1, 6))
+            p_len = int(rng.integers(1, 36))
+            row = rng.integers(0, CFG.vocab_size, size=p_len).tolist()
+            if rng.random() < 0.5:
+                row = (system + row)[:CFG.max_seq_len - new]
+            jobs.append((row, new))
+        paged = ContinuousBatchingEngine(
+            CFG, params, n_slots=3, start=False, kv_layout="paged",
+            block_size=8, kv_blocks=22, prefill_chunk=5,
+        )
+        handles = [paged.submit(row, new) for row, new in jobs]
+        cancel_at = {
+            3: [handles[4]], 9: [handles[11]], 15: [handles[17]],
+        }
+        self.drive(paged, handles, cancel_at=cancel_at)
+        results = []
+        for handle in handles:
+            try:
+                results.append(handle.result(1))
+            except DecodeCancelled:
+                results.append(None)
+        paged.stop()
+        assert paged.step.compiles == 1
+        assert paged.step.prefill_compiles == 1
+        assert paged.pool.hits > 0          # the shared prefix paid off
+        paged.pool.check()                  # no leak / double-free
+        assert paged.pool.in_use() == 0     # every slot block returned
+        dense = ContinuousBatchingEngine(
+            CFG, params, n_slots=3, start=False, kv_layout="dense",
+        )
+        survivors = [
+            (job, got) for job, got in zip(jobs, results)
+            if got is not None
+        ]
+        dense_handles = [
+            dense.submit(row, new) for (row, new), _ in survivors
+        ]
+        self.drive(dense, dense_handles)
+        for ((row, new), got), ref in zip(survivors, dense_handles):
+            assert got == ref.result(1)
+        dense.stop()
+        assert dense.step.compiles == 1
+
+    def test_prefix_cache_shares_and_copies_on_write(self, params):
+        """A decoded prompt's full blocks become shareable at first
+        emit: an identical re-submission reuses ALL of them (one
+        copy-on-write for the tail), a same-prefix submission reuses
+        the full-block prefix — both bit-identical to cold decode."""
+        eng = ContinuousBatchingEngine(
+            CFG, params, n_slots=2, start=False, kv_layout="paged",
+            block_size=8, prefill_chunk=0,
+        )
+        system = [7 * (i % 5) + 1 for i in range(16)]  # 2 full blocks
+        r1 = eng.submit(system, 4)
+        self.drive(eng, [r1])
+        cold = r1.result(1)
+        assert eng.pool.hits == 0
+        assert eng.pool.cached_blocks() == 2
+        r2 = eng.submit(system, 4)           # whole prompt cached
+        r3 = eng.submit(system + [9, 9], 4)  # prefix cached
+        self.drive(eng, [r2, r3])
+        assert r2.result(1) == cold
+        assert r3.result(1)[:16] == system
+        assert eng.pool.cow_copies == 1   # r2's tail block was copied
+        # r2 hit both blocks (CoW counts); r3 hit both full blocks
+        assert eng.pool.hits == 4
+        assert eng.pool.hit_tokens > 0
+        eng.stop()
+        eng.pool.check()
+        assert eng.pool.in_use() == 0
+
+    def test_chunked_prefill_does_not_stall_active_streams(self, params):
+        """The no-stall acceptance pin: while a near-max-length prompt
+        ingests chunk-by-chunk, an already-decoding stream emits a
+        token EVERY quantum — prompt admission never freezes it."""
+        eng = ContinuousBatchingEngine(
+            CFG, params, n_slots=2, start=False, kv_layout="paged",
+            block_size=8, prefill_chunk=8,
+        )
+        short = eng.submit([3, 1], 40)
+        eng._admit()
+        eng._work_once()
+        eng._work_once()   # short is past its prompt, emitting
+        emitted = len(short.tokens)
+        assert emitted > 0
+        long_row = [int(t) for t in
+                    np.arange(120) % (CFG.vocab_size - 1)]
+        long = eng.submit(long_row, 4)
+        eng._admit()
+        assert 1 in eng._prefilling  # parked, chunking in slot 1
+        stalls = 0
+        while 1 in eng._prefilling:
+            eng._work_once()
+            stalls += len(short.tokens) == emitted
+            emitted = len(short.tokens)
+        assert stalls == 0  # a token per quantum, even mid-ingestion
+        assert eng.prefill_chunks == 14  # ceil-free: (120-1-0)//8
+        self.drive(eng, [short, long])
+        assert short.result(1) == inline_chain(params, [3, 1], 40)
+        assert long.result(1) == inline_chain(params, long_row, 4)
+        eng.stop()
+        eng.pool.check()
+        assert eng.pool.in_use() == 0
+
+    def test_cancel_during_prefill_releases_blocks(self, params):
+        eng = ContinuousBatchingEngine(
+            CFG, params, n_slots=2, start=False, kv_layout="paged",
+            block_size=8, prefill_chunk=8,
+        )
+        long_row = list(range(100))
+        req = eng.submit(long_row, 4)
+        eng._admit()
+        eng._work_once()  # one chunk in, still prefilling
+        assert eng._prefilling
+        assert eng.pool.in_use() > 0
+        req.cancel()
+        eng._evict_cancelled()
+        with pytest.raises(DecodeCancelled):
+            req.result(1)
+        assert not eng._prefilling
+        assert eng.pool.in_use() == 0
+        eng.pool.check()
+        eng.stop()
+
+    def test_pool_exhaustion_queues_fifo(self, params):
+        """More concurrent demand than blocks: the head waits (no
+        overtaking, no mid-stream eviction) and peak concurrency is
+        bounded by the pool, not the slot count."""
+        eng = ContinuousBatchingEngine(
+            CFG, params, n_slots=4, start=False, kv_layout="paged",
+            block_size=8, kv_blocks=8, prefill_chunk=0,
+            prefix_cache=False,
+        )
+        # each request needs ceil((16+8-1)/8) = 3 blocks: the 8-block
+        # pool runs at most two concurrently despite 4 slots
+        jobs = [list(range(i, i + 16)) for i in range(4)]
+        handles = [eng.submit(row, 8) for row in jobs]
+        self.drive(eng, handles)
+        for row, handle in zip(jobs, handles):
+            assert handle.result(1) == inline_chain(params, row, 8)
+        assert eng.peak_active <= 2
+        assert eng.finished == 4
+        eng.stop()
+        eng.pool.check()
+        assert eng.pool.in_use() == 0
+
+    def test_over_pool_prompt_rejected_at_submit(self, params):
+        eng = ContinuousBatchingEngine(
+            CFG, params, n_slots=2, start=False, kv_layout="paged",
+            block_size=8, kv_blocks=4,
+        )
+        with pytest.raises(ValueError, match="KV blocks"):
+            eng.submit(list(range(40)), 8)  # needs 6 of 4 blocks
+        eng.stop()
+
+    def test_paged_device_error_recovery_flushes_cache(self, params):
+        """A failed step fans out, the pool ends empty, and the prefix
+        cache is dropped (its device contents died with the cache) —
+        then the engine decodes correctly again."""
+        eng = ContinuousBatchingEngine(
+            CFG, params, n_slots=2, start=False, kv_layout="paged",
+            block_size=8, prefill_chunk=0,
+        )
+        warm = eng.submit(list(range(16)), 4)
+        self.drive(eng, [warm])
+        assert eng.pool.cached_blocks() == 2
+        real_step = eng.step
+
+        class Boom:
+            armed = True
+            prefill_compiles = real_step.prefill_compiles
+
+            @property
+            def compiles(self):
+                return real_step.compiles
+
+            def init_cache(self):
+                return real_step.init_cache()
+
+            def prefill(self, *args):
+                return real_step.prefill(*args)
+
+            def copy_block(self, *args):
+                return real_step.copy_block(*args)
+
+            def __call__(self, *args):
+                if self.armed:
+                    self.armed = False
+                    raise RuntimeError("injected device failure")
+                return real_step(*args)
+
+        eng.step = Boom()
+        r1 = eng.submit([1, 2, 3], 3)
+        eng._admit()
+        eng._work_once()
+        with pytest.raises(RuntimeError, match="injected"):
+            r1.result(1)
+        assert eng.pool.cached_blocks() == 0  # flushed with the cache
+        assert eng.pool.in_use() == 0
+        eng.pool.check()
+        r2 = eng.submit([1, 2, 3], 3)
+        self.drive(eng, [r2])
+        assert r2.result(1) == inline_chain(params, [1, 2, 3], 3)
+        eng.stop()
+
+    def test_paged_int8_matches_dense_int8(self, params):
+        """kv_quant_int8 composes with the paged layout: the block
+        pool carries the same per-(position, head) scales, so paged
+        int8 chains equal dense int8 chains."""
+        jobs = [(list(range(1, 12)), 5), ([9, 4, 2], 6),
+                (list(range(20, 44)), 4)]
+        chains = {}
+        for layout in ("paged", "dense"):
+            eng = ContinuousBatchingEngine(
+                CFG, params, n_slots=2, start=False, kv_layout=layout,
+                kv_quant_int8=True, block_size=8, prefill_chunk=6,
+            )
+            handles = [eng.submit(row, new) for row, new in jobs]
+            self.drive(eng, handles)
+            chains[layout] = [h.result(1) for h in handles]
+            eng.stop()
+        assert chains["paged"] == chains["dense"]

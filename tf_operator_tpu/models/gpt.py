@@ -927,6 +927,453 @@ class SlotDecodeStep:
         return self._step(params, cache, tok, index, prompt, lens)
 
 
+# -- paged KV decode (block-pool cache, continuous batching) -----------------
+
+
+def _paged_store_kv(
+    mod: nn.Module, name: str, new: jax.Array, num_blocks: int,
+    block_size: int, dtype, kv_quant_int8: bool, phys, off,
+):
+    """THE paged cache write — scatter `new` ([n, heads, head_dim])
+    into the shared block pool at physical (block, offset) pairs. One
+    implementation for both phases (decode passes one token per slot;
+    chunked prefill a run of consecutive tokens for one slot), so the
+    int8/bf16 pool layout can never desynchronize between them.
+
+    The pool is [num_blocks, block_size, heads, head_dim] in a "cache"
+    variable — the paged twin of _store_kv's dense [rows, max_len, ...]
+    grid, through the same _absmax_quantize, so the two layouts hold
+    byte-identical contents for the same vectors. Rows parked on the
+    sentinel block (phys == 0) scatter garbage there; every reader
+    masks those positions, so the sentinel's contents are never
+    observable."""
+    _, heads, head_dim = new.shape
+    if kv_quant_int8:
+        pool = mod.variable(
+            "cache", name,
+            lambda: jnp.zeros(
+                (num_blocks, block_size, heads, head_dim), jnp.int8
+            ),
+        )
+        scale = mod.variable(
+            "cache", name + "_scale",
+            lambda: jnp.zeros(
+                (num_blocks, block_size, heads), jnp.float32
+            ),
+        )
+        quantized, scale_new = _absmax_quantize(new)
+        pool.value = pool.value.at[phys, off].set(quantized)
+        scale.value = scale.value.at[phys, off].set(scale_new)
+        return pool.value, scale.value
+    pool = mod.variable(
+        "cache", name,
+        lambda: jnp.zeros(
+            (num_blocks, block_size, heads, head_dim), dtype
+        ),
+    )
+    pool.value = pool.value.at[phys, off].set(new.astype(dtype))
+    return pool.value, None
+
+
+class PagedSelfAttention(nn.Module):
+    """Single-token decode attention over the paged block pool — the
+    paged twin of CachedSelfAttention (identical child param paths:
+    query/key/value/attn_out), with each slot's KV addressed through
+    its block table instead of a private dense cache row.
+
+    Gathering pool[tables] materializes each slot's logical KV
+    sequence in logical-position order, so with max_blocks *
+    block_size == the dense grid's max_total the attention consumes
+    identical keys at identical positions through the identical einsum
+    shapes — and the masked softmax matches the dense path bit for bit
+    (tail positions are finfo.min-masked in both layouts; their exp
+    underflows to exactly 0.0, so garbage past a slot's index — or in
+    the sentinel block — never contributes)."""
+
+    num_heads: int
+    head_dim: int
+    num_blocks: int
+    block_size: int
+    dtype: jnp.dtype = jnp.bfloat16
+    kv_quant_int8: bool = False
+    weights_int8: bool = False
+
+    @nn.compact
+    def __call__(self, x, index, tables):
+        # x: [slots, hidden]; index: [slots]; tables: [slots, blocks]
+        proj = _projections(self.weights_int8)
+        dense = lambda name: proj.head(  # noqa: E731
+            self.num_heads, self.head_dim, self.dtype, name
+        )
+        query = dense("query")(x)[:, None]  # [s, 1, h, d]
+        key_new = dense("key")(x)           # [s, h, d]
+        value_new = dense("value")(x)
+        bs = self.block_size
+        phys = jnp.take_along_axis(
+            tables, (index // bs)[:, None], axis=1
+        )[:, 0]
+        off = index % bs
+        key_pool, key_scale = _paged_store_kv(
+            self, "k", key_new, self.num_blocks, bs, self.dtype,
+            self.kv_quant_int8, phys, off,
+        )
+        value_pool, value_scale = _paged_store_kv(
+            self, "v", value_new, self.num_blocks, bs, self.dtype,
+            self.kv_quant_int8, phys, off,
+        )
+        slots, max_blocks = tables.shape
+        length = max_blocks * bs
+        keys = key_pool[tables].reshape(
+            slots, length, self.num_heads, self.head_dim
+        )
+        values = value_pool[tables].reshape(
+            slots, length, self.num_heads, self.head_dim
+        )
+        if key_scale is not None:
+            key_scale = key_scale[tables].reshape(
+                slots, length, self.num_heads
+            )
+            value_scale = value_scale[tables].reshape(
+                slots, length, self.num_heads
+            )
+        valid = (
+            jnp.arange(length)[None, :] <= index[:, None]
+        )[:, None, None, :]
+        out = _cache_attention(
+            query, keys, key_scale, values, value_scale, valid
+        )  # [s, 1, h, d]
+        return proj.general(
+            features=x.shape[-1], axis=(-2, -1), dtype=self.dtype,
+            name="attn_out",
+        )(out[:, 0])
+
+
+class PagedPrefillSelfAttention(nn.Module):
+    """One prefill CHUNK's attention + pool write for a single slot —
+    the paged twin of PrefillSelfAttention (identical child param
+    paths). x: [1, chunk, hidden] at logical positions [start, start +
+    chunk); the slot's block table maps them to pool blocks. Writes
+    FIRST, then attends over the stored representation (the int8-
+    parity discipline of PrefillSelfAttention): the chunk's queries
+    see the same cache bytes a later decode step would."""
+
+    num_heads: int
+    head_dim: int
+    num_blocks: int
+    block_size: int
+    dtype: jnp.dtype = jnp.bfloat16
+    kv_quant_int8: bool = False
+    weights_int8: bool = False
+
+    @nn.compact
+    def __call__(self, x, start, table):
+        # x: [1, chunk, hidden]; start: scalar; table: [max_blocks]
+        chunk = x.shape[1]
+        proj = _projections(self.weights_int8)
+        dense = lambda name: proj.head(  # noqa: E731
+            self.num_heads, self.head_dim, self.dtype, name
+        )
+        query = dense("query")(x)       # [1, c, h, d]
+        key_new = dense("key")(x)[0]    # [c, h, d]
+        value_new = dense("value")(x)[0]
+        bs = self.block_size
+        pos = start + jnp.arange(chunk)
+        phys = table[pos // bs]
+        off = pos % bs
+        key_pool, key_scale = _paged_store_kv(
+            self, "k", key_new, self.num_blocks, bs, self.dtype,
+            self.kv_quant_int8, phys, off,
+        )
+        value_pool, value_scale = _paged_store_kv(
+            self, "v", value_new, self.num_blocks, bs, self.dtype,
+            self.kv_quant_int8, phys, off,
+        )
+        max_blocks = table.shape[0]
+        length = max_blocks * bs
+        keys = key_pool[table].reshape(
+            1, length, self.num_heads, self.head_dim
+        )
+        values = value_pool[table].reshape(
+            1, length, self.num_heads, self.head_dim
+        )
+        if key_scale is not None:
+            key_scale = key_scale[table].reshape(
+                1, length, self.num_heads
+            )
+            value_scale = value_scale[table].reshape(
+                1, length, self.num_heads
+            )
+        mask = (
+            jnp.arange(length)[None, :] <= pos[:, None]
+        )[None, None]  # [1, 1, c, L]
+        out = _cache_attention(
+            query, keys, key_scale, values, value_scale, mask
+        )
+        return proj.general(
+            features=x.shape[-1], axis=(-2, -1), dtype=self.dtype,
+            name="attn_out",
+        )(out)
+
+
+class _PagedBlock(nn.Module):
+    """One decoder block over the paged pool for either phase: 2-D x
+    is the per-slot one-token decode step, 3-D x a prefill chunk — the
+    two attention classes share param paths ("attention"), so the
+    dispatch only switches dataflow (the dense twin is _CachedBlock).
+    """
+
+    config: GPTConfig
+    num_blocks: int
+    block_size: int
+    kv_quant_int8: bool = False
+    weights_int8: bool = False
+
+    @nn.compact
+    def __call__(self, x, index=None, tables=None, start=None,
+                 table=None):
+        from .bert import transformer_mlp
+
+        cfg = self.config
+        kwargs = dict(
+            num_heads=cfg.num_heads, head_dim=cfg.head_dim,
+            num_blocks=self.num_blocks, block_size=self.block_size,
+            dtype=cfg.dtype, kv_quant_int8=self.kv_quant_int8,
+            weights_int8=self.weights_int8, name="attention",
+        )
+        y = nn.LayerNorm(dtype=jnp.float32, name="ln_attn")(x)
+        if x.ndim == 2:
+            y = PagedSelfAttention(**kwargs)(
+                y.astype(cfg.dtype), index, tables
+            )
+        else:
+            y = PagedPrefillSelfAttention(**kwargs)(
+                y.astype(cfg.dtype), start, table
+            )
+        x = x + y
+        y = nn.LayerNorm(dtype=jnp.float32, name="ln_mlp")(x)
+        return x + transformer_mlp(
+            cfg, y, dense_cls=_projections(self.weights_int8).dense
+        )
+
+
+class PagedDecodeStep(nn.Module):
+    """One-token forward over the paged pool — param-path identical to
+    GPTDecodeStep (token_embed/position_embed/layer_i/ln_final/
+    lm_head), so the same trained weights drive the dense and paged
+    engines."""
+
+    config: GPTConfig
+    num_blocks: int
+    block_size: int
+    kv_quant_int8: bool = False
+    weights_int8: bool = False
+
+    @nn.compact
+    def __call__(self, token, index, tables):
+        cfg = self.config
+        x = nn.Embed(
+            cfg.vocab_size, cfg.hidden_size, dtype=cfg.dtype,
+            name="token_embed",
+        )(token)
+        x = x + nn.Embed(
+            cfg.max_seq_len, cfg.hidden_size, dtype=cfg.dtype,
+            name="position_embed",
+        )(index)
+        for layer in range(cfg.num_layers):
+            x = _PagedBlock(
+                cfg, num_blocks=self.num_blocks,
+                block_size=self.block_size,
+                kv_quant_int8=self.kv_quant_int8,
+                weights_int8=self.weights_int8, name=f"layer_{layer}",
+            )(x, index=index, tables=tables)
+        x = nn.LayerNorm(dtype=jnp.float32, name="ln_final")(x)
+        return _projections(self.weights_int8).dense(
+            cfg.vocab_size, dtype=cfg.dtype, name="lm_head"
+        )(x.astype(cfg.dtype))
+
+
+class PagedPrefillChunk(nn.Module):
+    """One prefill chunk's forward for a single slot: embeds the chunk
+    at positions [start, start + chunk) and writes K/V through every
+    layer's paged attention. No ln_final/lm_head — a chunk never emits
+    a token (the prompt's LAST token always rides a decode step, which
+    produces the first generated logits), so the head matmul would be
+    dead weight; flax ignores the unused params."""
+
+    config: GPTConfig
+    num_blocks: int
+    block_size: int
+    kv_quant_int8: bool = False
+    weights_int8: bool = False
+
+    @nn.compact
+    def __call__(self, tokens, start, table):  # [1, chunk], scalar
+        cfg = self.config
+        chunk = tokens.shape[1]
+        x = nn.Embed(
+            cfg.vocab_size, cfg.hidden_size, dtype=cfg.dtype,
+            name="token_embed",
+        )(tokens)
+        x = x + nn.Embed(
+            cfg.max_seq_len, cfg.hidden_size, dtype=cfg.dtype,
+            name="position_embed",
+        )(start + jnp.arange(chunk)[None, :])
+        for layer in range(cfg.num_layers):
+            x = _PagedBlock(
+                cfg, num_blocks=self.num_blocks,
+                block_size=self.block_size,
+                kv_quant_int8=self.kv_quant_int8,
+                weights_int8=self.weights_int8, name=f"layer_{layer}",
+            )(x, start=start, table=table)
+        return x
+
+
+class PagedSlotDecodeStep:
+    """ONE compiled single-token decode over a fixed [n_slots] grid
+    whose KV lives in a shared pool of fixed-size blocks — the paged
+    twin of SlotDecodeStep and the device half of the paged engine
+    (serve/engine.py kv_layout="paged").
+
+    Three compiled programs, each counted by its own trace counter:
+
+    - `step(...)`: identical contract to SlotDecodeStep.__call__ plus
+      a [n_slots, max_blocks] block-table argument; gather/scatter by
+      block index inside the jit, cache donated. Exactly ONE compile
+      per (config, n_slots, max_total, block_size, num_blocks, int8
+      flags) — same invariant, same assertion style.
+    - `prefill(...)`: one chunked-prefill chunk for one slot (always
+      exactly `prefill_chunk` tokens, so it too compiles once).
+    - `copy_block(...)`: device-side block copy for prefix-cache
+      copy-on-write (one compile; src/dst are traced scalars).
+
+    max_total must divide evenly into blocks: the gathered attention
+    width is max_blocks * block_size, and only when that equals the
+    dense grid's max_total do the two layouts run the same einsum
+    shapes — the bit-identity contract (tests/test_engine.py) depends
+    on it."""
+
+    def __init__(self, cfg: GPTConfig, n_slots: int, max_total: int,
+                 block_size: int, num_blocks: int,
+                 kv_quant_int8: bool = False,
+                 weights_int8: bool = False):
+        if max_total > cfg.max_seq_len:
+            raise ValueError(
+                f"max_total {max_total} exceeds max_seq_len "
+                f"{cfg.max_seq_len}"
+            )
+        if block_size < 1:
+            raise ValueError(f"block_size must be >= 1, got {block_size}")
+        if max_total % block_size:
+            raise ValueError(
+                f"max_total {max_total} must be a multiple of "
+                f"block_size {block_size} (the gathered attention "
+                "width must equal the dense grid's for bit-identity)"
+            )
+        if num_blocks < 2:
+            raise ValueError(
+                f"num_blocks must be >= 2 (sentinel + 1), got "
+                f"{num_blocks}"
+            )
+        self.cfg = cfg
+        self.n_slots = int(n_slots)
+        self.max_total = int(max_total)
+        self.block_size = int(block_size)
+        self.num_blocks = int(num_blocks)
+        self.max_blocks = self.max_total // self.block_size
+        self.compiles = 0
+        self.prefill_compiles = 0
+        self.copy_compiles = 0
+        model = PagedDecodeStep(
+            cfg, num_blocks=self.num_blocks, block_size=self.block_size,
+            kv_quant_int8=kv_quant_int8, weights_int8=weights_int8,
+        )
+        self._cache_shapes = jax.eval_shape(
+            lambda: model.init(
+                jax.random.PRNGKey(0),
+                jnp.zeros((self.n_slots,), jnp.int32),
+                jnp.zeros((self.n_slots,), jnp.int32),
+                jnp.zeros((self.n_slots, self.max_blocks), jnp.int32),
+            )["cache"]
+        )
+
+        def step(params, cache, tok, index, prompt, lens, tables):
+            # trace-time side effect: runs once per compilation, so the
+            # counter IS the compile count for this step function
+            self.compiles += 1
+            logits, updates = model.apply(
+                {"params": params, "cache": cache}, tok, index, tables,
+                mutable=["cache"],
+            )
+            nxt = jnp.argmax(logits, axis=-1)
+            # the ragged forcing rule, verbatim from SlotDecodeStep:
+            # rows still inside their prompt emit the prompt's next
+            # token instead of the model's
+            in_prompt = index + 1 < lens
+            forced = jnp.take_along_axis(
+                prompt,
+                jnp.minimum(index + 1, prompt.shape[1] - 1)[:, None],
+                axis=1,
+            )[:, 0]
+            nxt = jnp.where(in_prompt, forced, nxt).astype(jnp.int32)
+            return updates["cache"], nxt
+
+        # donation keeps the pool a single fixed allocation on TPU;
+        # the CPU runtime cannot donate (it would only warn per compile)
+        donate = (1,) if jax.default_backend() != "cpu" else ()
+        self._step = jax.jit(step, donate_argnums=donate)
+
+        prefill_model = PagedPrefillChunk(
+            cfg, num_blocks=self.num_blocks, block_size=self.block_size,
+            kv_quant_int8=kv_quant_int8, weights_int8=weights_int8,
+        )
+
+        def prefill(params, cache, tokens, start, table):
+            self.prefill_compiles += 1
+            _, updates = prefill_model.apply(
+                {"params": params, "cache": cache}, tokens, start,
+                table, mutable=["cache"],
+            )
+            return updates["cache"]
+
+        self._prefill = jax.jit(prefill, donate_argnums=donate)
+
+        def copy_block(cache, src, dst):
+            self.copy_compiles += 1
+            return jax.tree_util.tree_map(
+                lambda pool: pool.at[dst].set(pool[src]), cache
+            )
+
+        copy_donate = (0,) if jax.default_backend() != "cpu" else ()
+        self._copy = jax.jit(copy_block, donate_argnums=copy_donate)
+
+    def init_cache(self):
+        """Fresh zero pool — created from abstract shapes, one
+        [num_blocks, block_size, ...] allocation per layer per k/v
+        (+ scales under int8)."""
+        return jax.tree_util.tree_map(
+            lambda s: jnp.zeros(s.shape, s.dtype), self._cache_shapes
+        )
+
+    def __call__(self, params, cache, tok, index, prompt, lens, tables):
+        """One step for every slot — SlotDecodeStep's contract plus
+        `tables` [n_slots, max_blocks] int32 (each row's block table;
+        unused tail entries point at the sentinel block 0)."""
+        return self._step(params, cache, tok, index, prompt, lens,
+                          tables)
+
+    def prefill(self, params, cache, tokens, start, table):
+        """Ingest one chunk for one slot: tokens [1, chunk] int32 at
+        logical positions [start, start + chunk), mapped through
+        `table` [max_blocks] int32. Returns the updated cache."""
+        return self._prefill(params, cache, tokens, int(start), table)
+
+    def copy_block(self, cache, src: int, dst: int):
+        """Device-side pool-block copy (every layer's k/v + scales) —
+        the copy-on-write primitive for tail blocks admitted from the
+        prefix cache."""
+        return self._copy(cache, int(src), int(dst))
+
+
 # -- speculative decoding (prompt-lookup drafting) --------------------------
 
 

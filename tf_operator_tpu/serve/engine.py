@@ -31,10 +31,33 @@ Scope, deliberately (same contract as the batcher): GREEDY requests
 only — sampled requests keep the inline path so each owns its rng
 stream — and the gpt family only. kv_quant_int8 composes: the slot
 cache layout carries the same per-(position, head) int8 scales.
+
+PAGED KV (kv_layout="paged", the default): the dense n_slots x
+max_total grid pays worst-case memory for every request. The paged
+layout replaces it with a fixed pool of fixed-size blocks
+(PagedAttention, Kwon et al.) addressed through per-slot block
+tables inside the SAME one-compile decode step:
+
+- admission reserves EXACTLY ceil((p + new - 1) / block_size) blocks
+  up front (greedy requests always run their full budget), so a slot
+  can never starve mid-decode; when the pool is short the queue head
+  waits FIFO — no overtaking, no mid-stream eviction;
+- a prefix cache keyed on exact prompt-token chunks shares full
+  prompt blocks by refcount (a repeated system prompt costs zero
+  prefill and zero extra blocks); when the WHOLE prompt is cached the
+  tail block is copied device-side (copy-on-write) and decode starts
+  at the last prompt position — TTFT is one step;
+- chunked prefill (Sarathi-Serve): long prompts ingest in
+  prefill_chunk-token chunks interleaved one-per-loop with decode
+  steps, so admitting a max-length prompt no longer stalls every
+  active stream's inter-token latency.
+
+kv_layout="dense" keeps the original grid (the bench baseline).
 """
 
 from __future__ import annotations
 
+import collections
 import json
 import queue
 import threading
@@ -63,7 +86,181 @@ METRIC_HELP = {
         "XLA compilations of the slot decode step (expected: 1)",
     "engine_active_slots": "Slots currently occupied by a request",
     "engine_queue_depth": "Requests waiting for a free slot",
+    "engine_peak_active_slots":
+        "High-water mark of concurrently occupied slots",
+    "engine_kv_blocks_total": "Usable KV blocks in the paged pool",
+    "engine_kv_blocks_in_use":
+        "KV blocks held by live slots (excludes idle prefix-cache "
+        "blocks)",
+    "engine_prefix_cache_blocks":
+        "Blocks currently indexed by the prefix cache",
+    "engine_prefix_cache_hits_total":
+        "Prompt blocks served from the prefix cache",
+    "engine_prefix_cache_misses_total":
+        "Prompt blocks that missed the prefix cache",
+    "engine_prefix_hit_tokens_total":
+        "Prompt tokens whose prefill was skipped via the prefix cache",
+    "engine_cow_copies_total":
+        "Tail blocks copied on admit (prefix-cache copy-on-write)",
+    "engine_kv_blocks_reclaimed_total":
+        "Idle prefix-cache blocks reclaimed (LRU) to satisfy "
+        "allocations",
+    "engine_prefill_chunks_total": "Chunked-prefill chunks executed",
+    "engine_prefill_seconds_total":
+        "Wall-clock seconds spent inside prefill chunks",
 }
+
+
+class BlockPool:
+    """Refcounted allocator over the paged KV pool + the prefix cache.
+
+    Host-side bookkeeping only (the blocks themselves live in the
+    donated device pool); single-writer — only the engine thread
+    allocates/releases — with read-only counter access from observer
+    threads.
+
+    Block 0 is the SENTINEL: never allocated, permanently referenced.
+    Parked rows and unused table tail entries point at it, so the
+    compiled step always has a valid scatter/gather target; its
+    contents are garbage by design and masked out of every read.
+
+    The prefix cache maps exact prompt-token tuples (one key per FULL
+    prompt block: prompt[:block_size], prompt[:2*block_size], ...) to
+    block ids. A cached block carries one reference from the cache
+    itself plus one per slot sharing it; cache-only blocks (ref == 1)
+    are "idle" — still counted available, reclaimed LRU when the free
+    list runs dry. Token-tuple keys make collisions impossible and the
+    LRU tick is a monotonic counter, not wall time, so eviction order
+    is deterministic (the bit-identity soak replays it)."""
+
+    def __init__(self, num_blocks: int, block_size: int):
+        self.block_size = int(block_size)
+        self.num_blocks = int(num_blocks)  # includes the sentinel
+        self.total = self.num_blocks - 1   # usable
+        self._ref = [0] * self.num_blocks
+        self._ref[0] = 1  # sentinel: pinned forever
+        self._free = collections.deque(range(1, self.num_blocks))
+        self._cached: dict = {}  # token-tuple -> block id
+        self._lru: dict = {}     # token-tuple -> last-use tick
+        self._tick = 0
+        self.hits = 0
+        self.misses = 0
+        self.hit_tokens = 0
+        self.cow_copies = 0
+        self.reclaimed = 0
+
+    # -- accounting --------------------------------------------------------
+
+    def cached_idle(self) -> int:
+        """Cached blocks no live slot shares (ref == 1: cache only)."""
+        # list() snapshot: observer threads call this mid-mutation
+        return sum(
+            1 for b in list(self._cached.values()) if self._ref[b] == 1
+        )
+
+    def available(self) -> int:
+        """Blocks an allocation burst could obtain right now: the free
+        list plus idle cached blocks (reclaimable)."""
+        return len(self._free) + self.cached_idle()
+
+    def in_use(self) -> int:
+        return self.total - len(self._free) - self.cached_idle()
+
+    # -- refcounts ---------------------------------------------------------
+
+    def retain(self, block: int) -> None:
+        self._ref[block] += 1
+
+    def release(self, block: int) -> None:
+        if self._ref[block] <= 0:
+            raise RuntimeError(f"double free of KV block {block}")
+        self._ref[block] -= 1
+        if self._ref[block] == 0:
+            # a cached block always keeps the cache's own reference,
+            # so ref 0 means fully private and dead
+            self._free.append(block)
+
+    def alloc(self) -> int:
+        """One fresh private block (ref 1): free list first, then LRU
+        reclaim of an idle cached block. Callers gate admission on
+        available(), so exhaustion here is a bug, not backpressure."""
+        if self._free:
+            block = self._free.popleft()
+        else:
+            block = self._reclaim()
+            if block is None:
+                raise RuntimeError(
+                    "KV block pool exhausted despite reservation"
+                )
+        self._ref[block] = 1
+        return block
+
+    def _reclaim(self):
+        victim_key = None
+        victim_tick = None
+        for key, tick in self._lru.items():
+            if self._ref[self._cached[key]] != 1:
+                continue  # shared with a live slot: not reclaimable
+            if victim_tick is None or tick < victim_tick:
+                victim_key, victim_tick = key, tick
+        if victim_key is None:
+            return None
+        block = self._cached.pop(victim_key)
+        self._lru.pop(victim_key)
+        self.reclaimed += 1
+        self._ref[block] = 0
+        return block
+
+    # -- prefix cache ------------------------------------------------------
+
+    def lookup(self, key):
+        """Cached block for one full-prompt-prefix key, bumping its
+        LRU tick; None on miss."""
+        block = self._cached.get(key)
+        if block is not None:
+            self._tick += 1
+            self._lru[key] = self._tick
+        return block
+
+    def publish(self, key, block: int) -> None:
+        """Index a slot's prompt block under its token key (called at
+        the slot's first emit, when all prompt K/V is written). The
+        cache takes its own reference; already-cached keys are left
+        alone (their existing block stays authoritative)."""
+        if key in self._cached:
+            return
+        self._cached[key] = block
+        self._ref[block] += 1
+        self._tick += 1
+        self._lru[key] = self._tick
+
+    def cached_blocks(self) -> int:
+        return len(self._cached)
+
+    def flush(self) -> None:
+        """Drop the whole prefix cache (weights swapped or the device
+        pool was rebuilt: cached K/V no longer matches)."""
+        for block in list(self._cached.values()):
+            self.release(block)
+        self._cached.clear()
+        self._lru.clear()
+
+    def check(self) -> None:
+        """Invariant audit for tests: the sentinel stays pinned, free
+        blocks have ref 0 (and vice versa), cached blocks are alive,
+        and nothing is double-listed."""
+        assert self._ref[0] == 1, "sentinel reference lost"
+        free = list(self._free)
+        assert len(set(free)) == len(free), "block double-freed"
+        for b in free:
+            assert self._ref[b] == 0, f"free block {b} has refs"
+        assert set(self._cached) == set(self._lru), "LRU out of sync"
+        for key, b in self._cached.items():
+            assert self._ref[b] >= 1, f"cached block {b} unreferenced"
+        free_set = set(free)
+        for b in range(1, self.num_blocks):
+            if self._ref[b] == 0:
+                assert b in free_set, f"block {b} leaked"
 
 
 class DecodeCancelled(RuntimeError):
@@ -156,9 +353,17 @@ class ContinuousBatchingEngine:
 
     One background thread owns the device loop and ALL slot state;
     submit()/cancel() only touch the queue and per-request flags, so
-    there is no lock on the hot path. The KV cache is a single fixed
-    [n_slots, max_total, ...] allocation per layer, donated through
+    there is no lock on the hot path. Under kv_layout="paged" (the
+    default) the KV lives in a fixed pool of fixed-size blocks mapped
+    through per-slot block tables (see the module docstring); under
+    "dense" it is the original [n_slots, max_total, ...] grid. Either
+    way it is a single fixed allocation per layer, donated through
     every step.
+
+    Paged knobs: block_size (tokens per block; max_total must divide
+    evenly), kv_blocks (usable pool blocks; 0 sizes the pool to the
+    dense equivalent, n_slots * max_total / block_size), prefill_chunk
+    (chunked-prefill width; 0 disables chunking), prefix_cache.
     """
 
     def __init__(
@@ -173,21 +378,68 @@ class ContinuousBatchingEngine:
         registry=None,
         tracer=None,
         flight=None,
+        kv_layout: str = "paged",
+        block_size: int = 64,
+        kv_blocks: int = 0,
+        prefill_chunk: int = 64,
+        prefix_cache: bool = True,
     ):
         from ..models import gpt as gpt_lib
 
         if n_slots < 1:
             raise ValueError(f"n_slots must be >= 1, got {n_slots}")
+        if kv_layout not in ("paged", "dense"):
+            raise ValueError(
+                f"kv_layout must be 'paged' or 'dense', got {kv_layout!r}"
+            )
         max_total = int(max_total) or cfg.max_seq_len
         self.cfg = cfg
         self.params = params
         self.n_slots = int(n_slots)
         self.max_total = max_total
-        self.step = gpt_lib.SlotDecodeStep(
-            cfg, self.n_slots, max_total,
-            kv_quant_int8=kv_quant_int8, weights_int8=weights_int8,
-        )
+        self.kv_layout = kv_layout
+        self._paged = kv_layout == "paged"
         s = self.n_slots
+        if self._paged:
+            block_size = int(block_size)
+            if block_size < 1 or max_total % block_size:
+                raise ValueError(
+                    f"block_size {block_size} must be >= 1 and divide "
+                    f"max_total {max_total}"
+                )
+            self.max_blocks = max_total // block_size
+            usable = int(kv_blocks) or s * self.max_blocks
+            if usable < 1:
+                raise ValueError(
+                    f"kv_blocks must be >= 1, got {usable}"
+                )
+            self.step = gpt_lib.PagedSlotDecodeStep(
+                cfg, s, max_total, block_size, usable + 1,
+                kv_quant_int8=kv_quant_int8, weights_int8=weights_int8,
+            )
+            self.pool = BlockPool(usable + 1, block_size)
+            self.prefill_chunk = int(prefill_chunk)
+            self._prefix_cache = bool(prefix_cache)
+            self._tables = np.zeros((s, self.max_blocks), np.int32)
+            # per-slot block bookkeeping (engine-thread-owned):
+            # blocks held (table order), keys to publish at first
+            # emit, and the full numpy table row
+            self._slot_blocks: list = [[] for _ in range(s)]
+            self._slot_keys: list = [[] for _ in range(s)]
+            self._slot_table = [
+                np.zeros((self.max_blocks,), np.int32) for _ in range(s)
+            ]
+        else:
+            self.step = gpt_lib.SlotDecodeStep(
+                cfg, s, max_total,
+                kv_quant_int8=kv_quant_int8, weights_int8=weights_int8,
+            )
+            self.pool = None
+            self.prefill_chunk = 0
+            self._prefix_cache = False
+        # slot -> {"offset", "decode_start"} while chunk-prefilling;
+        # always present (empty under dense) so the loop can test it
+        self._prefilling: dict = {}
         self._cache = self.step.init_cache()
         self._tok = np.zeros((s,), np.int32)
         self._index = np.zeros((s,), np.int32)
@@ -196,6 +448,9 @@ class ContinuousBatchingEngine:
         self._reqs: list = [None] * s
         self._free = list(range(s))
         self._queue: queue.Queue = queue.Queue()
+        # scheduler-owned FIFO the queue drains into: under paged the
+        # head may be waiting for blocks, and it must not be overtaken
+        self._pending: collections.deque = collections.deque()
         self._stop = threading.Event()
         # serializes submit's stopped-check+enqueue against stop's
         # drain: without it a put can land after the drain and strand
@@ -217,6 +472,9 @@ class ContinuousBatchingEngine:
         self.finished = 0
         self.cancelled = 0
         self.decode_seconds = 0.0
+        self.peak_active = 0
+        self.prefill_chunks = 0
+        self.prefill_seconds = 0.0
         # latency distributions + request spans (telemetry.MetricRegistry
         # / SpanTracer, both optional): TTFT and queue-wait are per
         # request, inter-token per emitted token, batch size per step.
@@ -228,7 +486,7 @@ class ContinuousBatchingEngine:
         # test swapping the default after construction still captures
         self._flight = flight
         self._h_ttft = self._h_itl = self._h_queue_wait = None
-        self._h_batch = None
+        self._h_batch = self._h_prefill = None
         if registry is not None:
             from ..telemetry import FAST_BUCKETS, LATENCY_BUCKETS, SIZE_BUCKETS
 
@@ -253,12 +511,34 @@ class ContinuousBatchingEngine:
                 "Occupied slots per decode step",
                 buckets=SIZE_BUCKETS,
             )
-        # THE one compile, paid at construction instead of inside the
-        # first request's latency (the engine twin of serve --warm)
-        self._cache, _ = self.step(
-            self.params, self._cache, self._tok, self._index,
-            self._prompt, self._lens,
-        )
+            if self._paged and self.prefill_chunk > 0:
+                self._h_prefill = registry.histogram(
+                    "prefill_chunk_seconds",
+                    "Wall-clock latency of one chunked-prefill chunk",
+                    buckets=FAST_BUCKETS,
+                )
+        # THE one compile (per program), paid at construction instead
+        # of inside the first request's latency (the engine twin of
+        # serve --warm). Paged additionally warms the prefill-chunk
+        # and copy-on-write programs against the sentinel block, whose
+        # contents are garbage by contract
+        if self._paged:
+            self._cache, _ = self.step(
+                self.params, self._cache, self._tok, self._index,
+                self._prompt, self._lens, self._tables,
+            )
+            if self.prefill_chunk > 0:
+                self._cache = self.step.prefill(
+                    self.params, self._cache,
+                    np.zeros((1, self.prefill_chunk), np.int32),
+                    0, np.zeros((self.max_blocks,), np.int32),
+                )
+            self._cache = self.step.copy_block(self._cache, 0, 0)
+        else:
+            self._cache, _ = self.step(
+                self.params, self._cache, self._tok, self._index,
+                self._prompt, self._lens,
+            )
         # start=False: no scheduler thread — tests drive _admit /
         # _evict_cancelled / _step_once by hand for deterministic
         # ordering assertions
@@ -290,6 +570,19 @@ class ContinuousBatchingEngine:
                 f"prompt {len(row)} + new {new} exceeds the engine's "
                 f"max_total {self.max_total}"
             )
+        if self._paged:
+            # the request reserves its worst-case blocks at admission
+            # (positions 0 .. p+new-2 are written); one that can never
+            # fit the pool must be rejected HERE, client-visible, not
+            # left to starve the queue head forever
+            bs = self.pool.block_size
+            blocks = (len(row) + new - 1 + bs - 1) // bs
+            if blocks > self.pool.total:
+                raise ValueError(
+                    f"prompt {len(row)} + new {new} needs {blocks} KV "
+                    f"blocks; the pool holds {self.pool.total} "
+                    f"({bs}-token blocks)"
+                )
         if corr is None:
             corr = current_correlation()
         req = EngineRequest(row, new, corr=corr)
@@ -318,12 +611,16 @@ class ContinuousBatchingEngine:
         new tokens). Rows are independent engine streams, so they
         interleave with every other in-flight request."""
         prompt = np.asarray(prompt, np.int32)
-        reqs = [
-            self.submit(prompt[i, :int(lens[i])].tolist(), new)
-            for i in range(prompt.shape[0])
-        ]
+        reqs: list = []
         deadline = time.monotonic() + timeout
+        # one try covers the submit loop too: a row rejected mid-batch
+        # (validation) must cancel the rows already in flight instead
+        # of leaking them into the slot grid
         try:
+            for i in range(prompt.shape[0]):
+                reqs.append(
+                    self.submit(prompt[i, :int(lens[i])].tolist(), new)
+                )
             return [
                 req.result(max(deadline - time.monotonic(), 1e-3))
                 for req in reqs
@@ -383,6 +680,9 @@ class ContinuousBatchingEngine:
                     "(pause_admission + drain first)"
                 )
             self.params = params
+            if self._paged:
+                # cached prompt K/V was computed under the OLD weights
+                self.pool.flush()
         (self._flight or default_flight()).record("serve", op="swap-params")
 
     def stop(self) -> None:
@@ -399,6 +699,10 @@ class ContinuousBatchingEngine:
                     drained.append(self._queue.get_nowait())
                 except queue.Empty:
                     break
+            # the scheduler-owned stage too: its thread is joined (or
+            # never ran), so nothing races this
+            drained.extend(self._pending)
+            self._pending.clear()
         for req in drained:  # fail queued requests so waiters don't hang
             req._finish(stopped)
         for slot, req in enumerate(self._reqs):
@@ -413,7 +717,7 @@ class ContinuousBatchingEngine:
 
     @property
     def queue_depth(self) -> int:
-        return self._queue.qsize()
+        return self._queue.qsize() + len(self._pending)
 
     def slots(self) -> tuple:
         """Per-slot request handles (None = free) — test/debug view."""
@@ -421,7 +725,7 @@ class ContinuousBatchingEngine:
 
     def metrics(self) -> dict:
         """(name, kind) -> value rows for the server's /metrics."""
-        return {
+        out = {
             ("engine_steps_total", "counter"): self.steps,
             ("engine_row_steps_total", "counter"): self.row_steps,
             ("engine_admitted_total", "counter"): self.admitted,
@@ -432,7 +736,31 @@ class ContinuousBatchingEngine:
             ("engine_compiles_total", "counter"): self.step.compiles,
             ("engine_active_slots", "gauge"): self.active_slots,
             ("engine_queue_depth", "gauge"): self.queue_depth,
+            ("engine_peak_active_slots", "gauge"): self.peak_active,
         }
+        if self._paged:
+            pool = self.pool
+            out.update({
+                ("engine_kv_blocks_total", "gauge"): pool.total,
+                ("engine_kv_blocks_in_use", "gauge"): pool.in_use(),
+                ("engine_prefix_cache_blocks", "gauge"):
+                    pool.cached_blocks(),
+                ("engine_prefix_cache_hits_total", "counter"):
+                    pool.hits,
+                ("engine_prefix_cache_misses_total", "counter"):
+                    pool.misses,
+                ("engine_prefix_hit_tokens_total", "counter"):
+                    pool.hit_tokens,
+                ("engine_cow_copies_total", "counter"):
+                    pool.cow_copies,
+                ("engine_kv_blocks_reclaimed_total", "counter"):
+                    pool.reclaimed,
+                ("engine_prefill_chunks_total", "counter"):
+                    self.prefill_chunks,
+                ("engine_prefill_seconds_total", "counter"):
+                    self.prefill_seconds,
+            })
+        return out
 
     # -- engine thread -----------------------------------------------------
 
@@ -445,7 +773,7 @@ class ContinuousBatchingEngine:
                 # _place/_step_once can race its swap_params()
                 self._evict_cancelled()
                 if self.active_slots:
-                    self._step_once()
+                    self._work_once()
                 else:
                     self._drained.set()
                     self._stop.wait(0.005)
@@ -453,24 +781,86 @@ class ContinuousBatchingEngine:
             self._admit()
             self._evict_cancelled()
             if self.active_slots == 0:
-                # idle: park on the queue instead of spinning
+                # idle (a pending head can always place on an empty
+                # grid — submit() bounds every request to the pool):
+                # park on the queue instead of spinning
                 try:
-                    req = self._queue.get(timeout=0.05)
+                    self._pending.append(self._queue.get(timeout=0.05))
                 except queue.Empty:
                     continue
-                self._place(req)
+                self._admit()
                 continue
-            self._step_once()
+            self._work_once()
 
     def _admit(self) -> None:
-        while self._free:
+        # drain the client queue into the scheduler-owned stage first:
+        # FIFO must hold across the two hops
+        while True:
             try:
-                req = self._queue.get_nowait()
+                self._pending.append(self._queue.get_nowait())
             except queue.Empty:
-                return
-            self._place(req)
+                break
+        while self._pending and self._free:
+            req = self._pending[0]
+            plan = None
+            if not req.cancelled.is_set() and self._paged:
+                plan = self._plan(req)
+                if plan[4] > self.pool.available():
+                    # the HEAD waits for blocks (freed as running
+                    # slots finish) — strict FIFO, no overtaking, no
+                    # mid-stream eviction of anyone else
+                    break
+            self._pending.popleft()
+            self._place(req, plan)
 
-    def _place(self, req: EngineRequest) -> None:
+    def _plan(self, req: EngineRequest):
+        """Prefix-cache match + block budget for one request ->
+        (shared cached blocks, CoW source or None, first decode index,
+        fresh blocks to allocate, blocks the admission must see
+        available). `new` is exact (greedy always runs its full
+        budget) and positions 0 .. p+new-2 are the ones written, so
+        the reservation guarantees the slot can never run out of
+        blocks mid-decode.
+
+        The reserve is larger than the fresh count when shared/CoW
+        blocks are currently IDLE in the cache: retaining them removes
+        them from the reclaimable set, so admission must budget for
+        that shrinkage or the allocs below could exhaust the pool."""
+        pool = self.pool
+        bs = pool.block_size
+        p = len(req.prompt)
+        full = p // bs          # whole blocks the prompt fills
+        limit = (p - 1) // bs   # shareable without CoW: the block
+        #                         holding p-1 is rewritten at decode
+        shared: list = []
+        cow_src = None
+        if self._prefix_cache:
+            for j in range(full):
+                block = pool.lookup(tuple(req.prompt[:(j + 1) * bs]))
+                if block is None:
+                    break
+                shared.append(block)
+        if len(shared) > limit:
+            # the WHOLE prompt is cached (p % bs == 0): its last block
+            # still needs position p-1's K/V rewritten to launch the
+            # argmax chain, so it is copied (CoW), never shared
+            cow_src = shared.pop()
+        blocks = (p + req.new - 1 + bs - 1) // bs  # ceil over written
+        if cow_src is not None and blocks >= pool.total:
+            # CoW transiently holds source + copy; at a full-pool
+            # reservation that extra block could NEVER become
+            # available — degrade to plain sharing (the tail block is
+            # recomputed via the forcing rule) instead of deadlocking
+            cow_src = None
+        m = len(shared)
+        start = p - 1 if cow_src is not None else m * bs
+        held_idle = sum(
+            1 for b in shared + ([cow_src] if cow_src is not None else [])
+            if pool._ref[b] == 1
+        )
+        return shared, cow_src, start, blocks - m, blocks - m + held_idle
+
+    def _place(self, req: EngineRequest, plan=None) -> None:
         if req.cancelled.is_set():
             self.cancelled += 1
             if req.span is not None:
@@ -495,10 +885,77 @@ class ContinuousBatchingEngine:
         n = len(req.prompt)
         self._prompt[slot, :] = 0
         self._prompt[slot, :n] = req.prompt
-        self._lens[slot] = n
-        self._index[slot] = 0
-        self._tok[slot] = req.prompt[0]
         self.admitted += 1
+        self.peak_active = max(self.peak_active, self.active_slots)
+        if not self._paged:
+            self._lens[slot] = n
+            self._index[slot] = 0
+            self._tok[slot] = req.prompt[0]
+            return
+        pool = self.pool
+        shared, cow_src, start, need, _ = plan or self._plan(req)
+        bs = pool.block_size
+        # prefix-cache accounting: one hit per reused prompt block
+        # (CoW counts — its prefill is skipped), one miss per prompt
+        # block computed from scratch
+        reused = len(shared) + (1 if cow_src is not None else 0)
+        pool.hits += reused
+        pool.misses += n // bs - reused
+        pool.hit_tokens += start
+        # retain BEFORE any alloc: a retained block has ref >= 2 and
+        # can never be LRU-reclaimed out from under this request
+        for block in shared:
+            pool.retain(block)
+        if cow_src is not None:
+            pool.retain(cow_src)
+        fresh = [pool.alloc() for _ in range(need)]
+        if cow_src is not None:
+            self._cache = self.step.copy_block(
+                self._cache, cow_src, fresh[0]
+            )
+            pool.release(cow_src)  # the slot keeps only the copy
+            pool.cow_copies += 1
+        blocks = shared + fresh
+        self._slot_blocks[slot] = blocks
+        # keys for the slot's FULL prompt blocks, published at first
+        # emit (all prompt K/V is in the pool by then)
+        self._slot_keys[slot] = [
+            (tuple(req.prompt[:(j + 1) * bs]), blocks[j])
+            for j in range(n // bs)
+        ]
+        table = self._slot_table[slot]
+        table[:] = 0
+        table[:len(blocks)] = blocks
+        (self._flight or default_flight()).record(
+            "serve", corr=req.corr, op="kv-plan", slot=slot,
+            shared=len(shared), fresh=need,
+            cow=cow_src is not None, start=start,
+        )
+        chunk = self.prefill_chunk
+        n_chunks = (n - 1 - start) // chunk if chunk > 0 else 0
+        if n_chunks > 0:
+            # park the row on the sentinel while its chunks run; it
+            # joins the decode grid in _activate
+            self._prefilling[slot] = {
+                "offset": start,
+                "decode_start": start + n_chunks * chunk,
+            }
+            self._tables[slot, :] = 0
+            self._lens[slot] = 1
+            self._index[slot] = 0
+            self._tok[slot] = 0
+        else:
+            self._activate(slot, start)
+
+    def _activate(self, slot: int, start: int) -> None:
+        """Join the decode grid at index `start`: positions < start
+        came from the prefix cache and/or prefill chunks; the rest of
+        the prompt rides the forcing rule."""
+        req = self._reqs[slot]
+        self._tables[slot, :] = self._slot_table[slot]
+        self._lens[slot] = len(req.prompt)
+        self._index[slot] = start
+        self._tok[slot] = req.prompt[start]
 
     def _evict_cancelled(self) -> None:
         for slot, req in enumerate(self._reqs):
@@ -516,6 +973,14 @@ class ContinuousBatchingEngine:
         self._tok[slot] = 0
         self._index[slot] = 0
         self._lens[slot] = 1
+        if self._paged:
+            self._prefilling.pop(slot, None)
+            self._tables[slot, :] = 0  # back onto the sentinel
+            self._slot_table[slot][:] = 0
+            for block in self._slot_blocks[slot]:
+                self.pool.release(block)
+            self._slot_blocks[slot] = []
+            self._slot_keys[slot] = []
         if req is not None:
             if error is None:
                 outcome = "finished"
@@ -539,26 +1004,86 @@ class ContinuousBatchingEngine:
             )
             req._finish(error)
 
+    def _work_once(self) -> None:
+        """One scheduler quantum: at most ONE prefill chunk (so a long
+        prompt's ingestion is amortized across quanta), then a decode
+        step whenever any non-prefilling slot is live — active streams
+        keep emitting while a long prompt chunks in, which is the
+        whole point of chunked prefill."""
+        if self._prefilling:
+            self._prefill_once()
+        if any(
+            req is not None and slot not in self._prefilling
+            for slot, req in enumerate(self._reqs)
+        ):
+            self._step_once()
+
+    def _prefill_once(self) -> None:
+        slot, state = next(iter(self._prefilling.items()))
+        req = self._reqs[slot]
+        off = state["offset"]
+        chunk = self.prefill_chunk
+        tokens = np.asarray(
+            [req.prompt[off:off + chunk]], np.int32
+        )
+        start = time.perf_counter()
+        try:
+            self._cache = self.step.prefill(
+                self.params, self._cache, tokens, off,
+                self._slot_table[slot],
+            )
+        except Exception as err:  # noqa: BLE001 — fan out, stay alive
+            self._fail_all(err)
+            return
+        took = time.perf_counter() - start
+        self.prefill_chunks += 1
+        self.prefill_seconds += took
+        if self._h_prefill is not None:
+            self._h_prefill.observe(took)
+        (self._flight or default_flight()).record(
+            "serve", corr=req.corr, op="prefill-chunk", slot=slot,
+            offset=off, tokens=chunk,
+        )
+        state["offset"] = off + chunk
+        self._prefilling.pop(slot)
+        if state["offset"] >= state["decode_start"]:
+            self._activate(slot, state["decode_start"])
+        else:
+            # reinsert at the back: concurrent prefills round-robin
+            self._prefilling[slot] = state
+
+    def _fail_all(self, err) -> None:
+        """The donated cache's state is unknown after a failed device
+        call; rebuild it, fail every in-flight request as JSON-able
+        errors (a dead engine would hang all later requests), and drop
+        the prefix cache — its blocks' device contents just went."""
+        (self._flight or default_flight()).record(
+            "serve", op="step-error", error=type(err).__name__,
+            slots=self.active_slots,
+        )
+        self._cache = self.step.init_cache()
+        for slot, req in enumerate(self._reqs):
+            if req is not None:
+                self._release(slot, error=err)
+        if self._paged:
+            self.pool.flush()
+
     def _step_once(self) -> None:
         start = time.perf_counter()
         try:
-            self._cache, nxt = self.step(
-                self.params, self._cache, self._tok, self._index,
-                self._prompt, self._lens,
-            )
+            if self._paged:
+                self._cache, nxt = self.step(
+                    self.params, self._cache, self._tok, self._index,
+                    self._prompt, self._lens, self._tables,
+                )
+            else:
+                self._cache, nxt = self.step(
+                    self.params, self._cache, self._tok, self._index,
+                    self._prompt, self._lens,
+                )
             nxt = np.asarray(nxt)
         except Exception as err:  # noqa: BLE001 — fan out, stay alive
-            # the donated cache's state is unknown after a failed step;
-            # rebuild it and fail every in-flight request as JSON-able
-            # errors (a dead engine would hang all later requests)
-            (self._flight or default_flight()).record(
-                "serve", op="step-error", error=type(err).__name__,
-                slots=self.active_slots,
-            )
-            self._cache = self.step.init_cache()
-            for slot, req in enumerate(self._reqs):
-                if req is not None:
-                    self._release(slot, error=err)
+            self._fail_all(err)
             return
         self.decode_seconds += time.perf_counter() - start
         self.steps += 1
@@ -573,7 +1098,10 @@ class ContinuousBatchingEngine:
         )
         now = time.monotonic()
         for slot, req in enumerate(self._reqs):
-            if req is None:
+            if req is None or slot in self._prefilling:
+                # prefilling slots ride the batch as parked rows aimed
+                # at the sentinel block — their lane's output is noise
+                # until _activate() points the row at real blocks
                 continue
             pos = int(self._index[slot]) + 1
             self._tok[slot] = nxt[slot]
@@ -585,6 +1113,13 @@ class ContinuousBatchingEngine:
                         self._h_ttft.observe(now - req.created)
                     if req.span is not None:
                         req.span.annotate("first-token")
+                    if self._paged and self._slot_keys[slot]:
+                        # the prompt's full blocks now hold final K/V:
+                        # publish them so later prompts sharing the
+                        # prefix skip prefill (cache takes its own ref)
+                        for key, block in self._slot_keys[slot]:
+                            self.pool.publish(key, block)
+                        self._slot_keys[slot] = []
                 elif self._h_itl is not None:
                     self._h_itl.observe(now - req.last_token_at)
                 req.last_token_at = now
@@ -603,6 +1138,11 @@ def main(argv=None) -> int:
     parser = argparse.ArgumentParser()
     parser.add_argument("--slots", type=int, default=4)
     parser.add_argument("--requests", type=int, default=12)
+    parser.add_argument("--layout", choices=("paged", "dense"),
+                        default="dense")
+    parser.add_argument("--block-size", type=int, default=64)
+    parser.add_argument("--kv-blocks", type=int, default=0)
+    parser.add_argument("--prefill-chunk", type=int, default=64)
     parser.add_argument("--smoke", action="store_true",
                         help="accepted for CI-invocation clarity")
     args = parser.parse_args(argv)
@@ -616,7 +1156,12 @@ def main(argv=None) -> int:
     params = gpt_lib.GPT(cfg).init(
         jax.random.PRNGKey(0), jnp.zeros((1, 8), jnp.int32)
     )["params"]
-    engine = ContinuousBatchingEngine(cfg, params, n_slots=args.slots)
+    engine = ContinuousBatchingEngine(
+        cfg, params, n_slots=args.slots, kv_layout=args.layout,
+        block_size=args.block_size, kv_blocks=args.kv_blocks,
+        prefill_chunk=args.prefill_chunk,
+    )
+    paged = args.layout == "paged"
     rng = np.random.default_rng(0)
     jobs = []
     for i in range(args.requests):
@@ -624,6 +1169,27 @@ def main(argv=None) -> int:
         new = int(rng.integers(1, 8))
         row = rng.integers(0, cfg.vocab_size, size=p_len).tolist()
         jobs.append((row, new, engine.submit(row, new)))
+    if paged:
+        # shared-prefix traffic (the prefix cache's reason to exist)
+        # and one near-max prompt (exercises chunked prefill)
+        sys_blocks = max(
+            1, min(3, (engine.max_total - 16) // args.block_size)
+        )
+        system = rng.integers(
+            0, cfg.vocab_size, size=sys_blocks * args.block_size
+        ).tolist()
+        first = engine.submit(system, 4)
+        jobs.append((system, 4, first))
+        first.result(timeout=120)  # prefix blocks published at emit
+        # repeat prompt -> whole-prompt cache hit -> copy-on-write
+        jobs.append((system, 4, engine.submit(system, 4)))
+        for i in range(3):
+            tail = rng.integers(0, cfg.vocab_size, size=2 + i).tolist()
+            jobs.append((system + tail, 4,
+                         engine.submit(system + tail, 4)))
+        long_len = engine.max_total - 5
+        long_row = rng.integers(0, cfg.vocab_size, size=long_len).tolist()
+        jobs.append((long_row, 4, engine.submit(long_row, 4)))
     mismatches = 0
     for row, new, req in jobs:
         got = req.result(timeout=120)
@@ -631,14 +1197,27 @@ def main(argv=None) -> int:
             cfg, params, jnp.asarray([row], jnp.int32), new,
         ))[0].tolist()
         mismatches += got != want
-    engine.stop()
     report = {
+        "layout": args.layout,
         "requests": len(jobs),
         "mismatches": mismatches,
         "compiles": engine.step.compiles,
         "steps": engine.steps,
-        "ok": mismatches == 0 and engine.step.compiles == 1,
     }
+    ok = mismatches == 0 and engine.step.compiles == 1
+    if paged:
+        report["prefill_compiles"] = engine.step.prefill_compiles
+        report["prefill_chunks"] = engine.prefill_chunks
+        report["prefix_hits"] = engine.pool.hits
+        report["cow_copies"] = engine.pool.cow_copies
+        ok = ok and engine.step.prefill_compiles <= 1
+        ok = ok and engine.pool.hits > 0
+        engine.stop()
+        engine.pool.check()
+        ok = ok and engine.pool.in_use() == 0
+    else:
+        engine.stop()
+    report["ok"] = ok
     print(json.dumps(report, indent=1))
     return 0 if report["ok"] else 1
 

@@ -45,6 +45,8 @@ _Q_DEPTH = "tf_operator_tpu_serve_engine_queue_depth"
 _ACTIVE = "tf_operator_tpu_serve_engine_active_slots"
 _ROW_STEPS = "tf_operator_tpu_serve_engine_row_steps_total"
 _STEPS = "tf_operator_tpu_serve_engine_steps_total"
+_KV_IN_USE = "tf_operator_tpu_serve_engine_kv_blocks_in_use"
+_KV_TOTAL = "tf_operator_tpu_serve_engine_kv_blocks_total"
 
 # connection-level failures that mean "this replica, this attempt" —
 # the stream fails over, the replica gets a probe before reuse
@@ -74,15 +76,21 @@ class Replica:
         self.queue_depth = 0.0
         self.active_slots = 0.0
         self.mean_active = 0.0
+        self.kv_occupancy = 0.0  # paged pool fill fraction, 0..1
         self.failures = 0
 
     def score(self) -> tuple:
         """Lower routes sooner. Local inflight is the live signal
         (updated per pick/finish); the scraped gauges add the engine's
-        own backlog; mean active slots breaks ties toward the replica
-        that has historically run emptier."""
+        own backlog; KV occupancy (paged engines: blocks in use over
+        pool size, scaled to weigh like a few inflight streams) keeps
+        a memory-full replica from winning ties on slot count alone —
+        its next admit would queue behind the block pool; mean active
+        slots breaks remaining ties toward the replica that has
+        historically run emptier."""
         return (
-            2 * self.inflight + self.queue_depth + self.active_slots,
+            2 * self.inflight + self.queue_depth + self.active_slots
+            + 4 * self.kv_occupancy,
             self.mean_active,
             self.name,
         )
@@ -171,6 +179,11 @@ class LeastLoadedRouter:
                     steps = flat.get(_STEPS, 0.0)
                     replica.mean_active = (
                         flat.get(_ROW_STEPS, 0.0) / steps if steps else 0.0
+                    )
+                    kv_total = flat.get(_KV_TOTAL, 0.0)
+                    replica.kv_occupancy = (
+                        flat.get(_KV_IN_USE, 0.0) / kv_total
+                        if kv_total else 0.0  # dense engines: no gauge
                     )
                 replica.ready = ok
             except Exception:  # noqa: BLE001 — an unreachable replica
@@ -358,6 +371,7 @@ class LeastLoadedRouter:
                         "inflight": r.inflight,
                         "queue_depth": r.queue_depth,
                         "active_slots": r.active_slots,
+                        "kv_occupancy": r.kv_occupancy,
                         "failures": r.failures,
                     }
                     for r in self._replicas.values()

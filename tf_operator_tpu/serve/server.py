@@ -632,6 +632,13 @@ def DecodeHandlerFactory(state: _State):
                 # greedy-only, same scoping as the batcher).
                 try:
                     chains = state.engine.generate(prompt, lens, new)
+                except ValueError as err:
+                    # the engine judged the request itself invalid
+                    # (oversized prompt, over-budget KV reservation):
+                    # client error, not server failure
+                    with state.lock:
+                        state.request_errors += 1
+                    return self._reply(400, {"error": str(err)})
                 except TimeoutError as err:
                     with state.lock:
                         state.request_errors += 1
@@ -738,6 +745,12 @@ def DecodeHandlerFactory(state: _State):
                     req = state.engine.submit(
                         prompt[0, :lens[0]].tolist(), new
                     )
+                except ValueError as err:
+                    # invalid request (oversized prompt / KV budget):
+                    # reject before the 200 goes on the wire
+                    with state.lock:
+                        state.request_errors += 1
+                    return self._reply(400, {"error": str(err)})
                 except Exception as err:  # noqa: BLE001 — pre-stream
                     with state.lock:
                         state.request_errors += 1
@@ -915,6 +928,10 @@ def make_server(
     batching: str = "",
     n_slots: int = 8,
     warm_async: bool = False,
+    kv_layout: str = "paged",
+    block_size: int = 64,
+    kv_blocks: int = 0,
+    prefill_chunk: int = 64,
 ) -> ThreadingHTTPServer:
     """In-process server (tests and embedders); caller owns
     serve_forever/shutdown. The CLI binds 0.0.0.0 (pods must be
@@ -1045,6 +1062,8 @@ def make_server(
                 cfg, state.params, n_slots=n_slots,
                 kv_quant_int8=kv_quant_int8, weights_int8=weights_int8,
                 registry=state.registry, tracer=state.tracer,
+                kv_layout=kv_layout, block_size=block_size,
+                kv_blocks=kv_blocks, prefill_chunk=prefill_chunk,
             )
 
         if warm_async:
@@ -1274,6 +1293,31 @@ def main(argv=None) -> int:
         "batch; excess requests queue)",
     )
     parser.add_argument(
+        "--kv-layout", choices=["paged", "dense"], default="paged",
+        help="KV cache layout for --batching continuous: paged (block "
+        "pool + per-slot block tables, prefix cache, chunked prefill "
+        "— serve/engine.py) or dense (the original n_slots x "
+        "max_total grid)",
+    )
+    parser.add_argument(
+        "--block-size", type=int, default=64,
+        help="tokens per KV block under --kv-layout paged; must "
+        "divide the model's max_seq_len",
+    )
+    parser.add_argument(
+        "--kv-blocks", type=int, default=0,
+        help="usable blocks in the paged KV pool (0 = size the pool "
+        "to the dense equivalent, slots x max_seq_len / block_size); "
+        "smaller pools trade queueing for memory",
+    )
+    parser.add_argument(
+        "--prefill-chunk", type=int, default=64,
+        help="chunked-prefill width under --kv-layout paged: long "
+        "prompts ingest this many tokens per engine quantum, "
+        "interleaved with decode steps (0 = prompt ingestion rides "
+        "the decode forcing rule only)",
+    )
+    parser.add_argument(
         "--speculative", action="store_true",
         help="prompt-lookup speculative decoding for greedy "
         "uniform-length requests (output-exact; repetitive "
@@ -1341,6 +1385,16 @@ def main(argv=None) -> int:
             )
     if args.slots < 1:
         parser.error("--slots must be >= 1")
+    if args.batching == "continuous" and args.kv_layout == "paged":
+        if args.block_size < 1 or _max_seq(cfg) % args.block_size:
+            parser.error(
+                f"--block-size {args.block_size} must be >= 1 and "
+                f"divide the preset's max_seq_len {_max_seq(cfg)}"
+            )
+        if args.kv_blocks < 0:
+            parser.error("--kv-blocks must be >= 0 (0 = auto)")
+        if args.prefill_chunk < 0:
+            parser.error("--prefill-chunk must be >= 0 (0 = off)")
     if args.preset.startswith("moe"):
         offending = [
             flag for flag, on in (
@@ -1459,6 +1513,8 @@ def main(argv=None) -> int:
         mesh=mesh,
         warm_shapes=warm_shapes,
         batching=args.batching, n_slots=args.slots,
+        kv_layout=args.kv_layout, block_size=args.block_size,
+        kv_blocks=args.kv_blocks, prefill_chunk=args.prefill_chunk,
     )
     logger.info("decode server on :%d", server.server_address[1])
     # graceful drain — the serving sibling of the training-side
