@@ -24,7 +24,7 @@ IMAGE_DIR := build/images
 DIST      := build/dist
 
 .PHONY: ci presubmit lint analyze native native-test native-race test wire-test e2e e2e-kind bench \
-        chaos-soak serve-soak serve-paged images release mnist-acc clean
+        chaos-soak serve-soak serve-paged controller-profile images release mnist-acc clean
 
 # `test` already runs the whole tests/ tree (native bindings, wire,
 # E2E suites included) — native-test/wire-test exist for targeted runs,
@@ -109,6 +109,14 @@ e2e-kind:
 
 bench:
 	$(PY) bench.py
+
+# profiled controller scale run (docs/monitoring.md "Profiling"): the
+# design-point and headroom bursts with OperatorMetrics + the sampling
+# profiler attached; writes CONTROLLER_PROFILE.json with per-phase
+# reconcile attribution, top-N stacks, and the per-phase scale factors
+# that name the dominant superlinear phase (ROADMAP item 5's input)
+controller-profile:
+	env JAX_PLATFORMS=cpu $(PY) benchmarks/controller_scale.py --profile
 
 mnist-acc:
 	$(PY) -m tf_operator_tpu.train.mnist --steps 1200 --batch-size 256 \

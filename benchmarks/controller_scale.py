@@ -12,6 +12,14 @@ machine marking the job Running.
 
 Usage:  python benchmarks/controller_scale.py [--jobs 100] [--workers 2]
 Prints one JSON line and writes CONTROLLER_SCALE.json at the repo root.
+
+--profile runs the design-point AND headroom bursts with the full
+observability stack attached — OperatorMetrics (phase + substrate-verb
+histograms) on the controller and the sampling profiler over every
+thread — and writes CONTROLLER_PROFILE.json: per-phase reconcile
+attribution for both bursts, top-N profiler tables, and the per-phase
+scale factors between the two burst sizes that name the dominant
+superlinear phase (ROADMAP item 5's input).
 """
 
 from __future__ import annotations
@@ -33,9 +41,9 @@ from tf_operator_tpu.runtime import InMemorySubstrate
 
 
 def run_burst(jobs: int, workers: int, threadiness: int,
-              timeout: float) -> dict:
+              timeout: float, metrics=None) -> dict:
     substrate = InMemorySubstrate()
-    controller = TFJobController(substrate)
+    controller = TFJobController(substrate, metrics=metrics)
     controller.run(threadiness=threadiness, resync_period=10.0)
 
     stop = threading.Event()
@@ -96,8 +104,36 @@ def run_burst(jobs: int, workers: int, threadiness: int,
         teardown_start = time.monotonic()
         for name in names:
             substrate.delete_job("default", name)
-        if substrate.list_pods("default", None):
-            raise RuntimeError("pods survived cascade delete")
+        # an in-flight reconcile (the resync storm overlaps teardown at
+        # larger burst sizes) can land a child AFTER its owner's cascade
+        # delete; real clusters GC those by owner reference — simulate
+        # that here, and only fail on pods whose owner still exists
+        gc_deadline = time.monotonic() + 10
+        while True:
+            leftovers = substrate.list_pods("default", None)
+            if not leftovers:
+                break
+            if time.monotonic() > gc_deadline:
+                raise RuntimeError(
+                    f"{len(leftovers)} pods survived cascade delete + GC"
+                )
+            for pod in leftovers:
+                owner = pod.metadata.labels.get(t.LABEL_JOB_NAME)
+                try:
+                    substrate.get_job("default", owner)
+                except Exception:
+                    try:
+                        substrate.delete_pod(
+                            "default", pod.metadata.name
+                        )
+                    except Exception:
+                        pass  # raced another deleter: already gone
+                else:
+                    raise RuntimeError(
+                        f"pod {pod.metadata.name} survived cascade "
+                        f"delete with live owner {owner}"
+                    )
+            time.sleep(0.02)
         teardown_seconds = time.monotonic() - teardown_start
     finally:
         stop.set()
@@ -121,6 +157,142 @@ def run_burst(jobs: int, workers: int, threadiness: int,
     }
 
 
+def _family_stats(family) -> dict:
+    """{labelvalue: {"seconds": sum, "count": n}} for one single-label
+    histogram family."""
+    return {
+        key[0]: {"seconds": round(s, 6), "count": c}
+        for key, (s, c) in sorted(family.labeled_stats().items())
+    }
+
+
+def profile_burst(jobs: int, workers: int, threadiness: int,
+                  timeout: float, hz: int = 99, top: int = 15) -> dict:
+    """One burst with the observability stack attached: OperatorMetrics
+    on the controller (phase/substrate/queue histograms) and the
+    sampling profiler over every thread. Returns the burst numbers plus
+    the parsed attribution."""
+    from tf_operator_tpu.server.metrics import OperatorMetrics
+    from tf_operator_tpu.telemetry import SamplingProfiler
+    from tf_operator_tpu.telemetry.profiler import top_table
+
+    metrics = OperatorMetrics()
+    profiler = SamplingProfiler(hz=hz)
+    profiler.start()
+    try:
+        burst = run_burst(jobs, workers, threadiness, timeout,
+                          metrics=metrics)
+        # read while still running: elapsed_seconds (the duty-cycle
+        # denominator) is only live on a running sampler
+        stats = profiler.stats()
+    finally:
+        profiler.stop()
+
+    phases = _family_stats(metrics.reconcile_phase)
+    substrate_calls = _family_stats(metrics.substrate_call)
+    # total reconcile wall across outcomes (process_next times sync())
+    wall = sum(
+        s for s, _ in metrics.reconcile_duration.labeled_stats().values()
+    )
+    phase_total = sum(v["seconds"] for v in phases.values())
+    queue_family = metrics.registry.get("workqueue_queue_duration_seconds")
+    queue_wait = _family_stats(queue_family) if queue_family else {}
+
+    folded = profiler.folded()
+    tables = top_table(folded, n=top)
+    total_samples = sum(folded.values()) or 1
+
+    def rows(pairs):
+        return [
+            {
+                "frame": name,
+                "samples": count,
+                "percent": round(100.0 * count / total_samples, 1),
+            }
+            for name, count in pairs
+        ]
+
+    return {
+        **burst,
+        "reconcile_wall_seconds": round(wall, 6),
+        "phase_seconds": phases,
+        "phase_total_seconds": round(phase_total, 6),
+        "phase_coverage_of_reconcile_wall": (
+            round(phase_total / wall, 4) if wall else None
+        ),
+        "substrate_call_seconds": substrate_calls,
+        "queue_wait_seconds": queue_wait,
+        "profile": {
+            "hz": stats["hz"],
+            "samples": stats["samples_total"],
+            "elapsed_seconds": stats["elapsed_seconds"],
+            "sampler_duty_cycle": (
+                round(stats["sample_seconds"] / stats["elapsed_seconds"], 5)
+                if stats["elapsed_seconds"] else 0.0
+            ),
+            "roles": rows(tables["roles"]),
+            "top_self": rows(tables["self"]),
+            "top_cumulative": rows(tables["cumulative"]),
+        },
+    }
+
+
+def profile_main(args) -> None:
+    """--profile: both bursts with attribution, then the comparison
+    that names the dominant superlinear phase."""
+    base = profile_burst(
+        args.jobs, args.workers, args.threadiness, args.timeout
+    )
+    head = profile_burst(
+        args.headroom, args.workers, args.threadiness, args.timeout
+    )
+    ratio = args.headroom / float(args.jobs)
+    scale: dict = {}
+    for phase, rec in head["phase_seconds"].items():
+        b = base["phase_seconds"].get(phase, {}).get("seconds", 0.0)
+        scale[phase] = round(rec["seconds"] / b, 2) if b else None
+    # superlinear = grew faster than the job count; dominant = the one
+    # carrying the most wall time at the larger size among those
+    superlinear = [
+        p for p, s in scale.items() if s is not None and s > ratio
+    ]
+    pool = superlinear or [p for p in scale if scale[p] is not None]
+    dominant = max(
+        pool, key=lambda p: head["phase_seconds"][p]["seconds"],
+        default=None,
+    )
+    result = {
+        "metric": "controller_profile",
+        "hz": base["profile"]["hz"],
+        "design_point": base,
+        "headroom": head,
+        "jobs_ratio": round(ratio, 2),
+        "phase_scale_factors": scale,
+        "superlinear_phases": sorted(
+            superlinear,
+            key=lambda p: -head["phase_seconds"][p]["seconds"],
+        ),
+        "dominant_superlinear_phase": dominant,
+        "note": (
+            f"phase_scale_factors = per-phase wall-time growth from "
+            f"{args.jobs} to {args.headroom} jobs; a linear phase grows "
+            f"~{ratio:g}x, so factors well above {ratio:g} are "
+            "superlinear. dominant_superlinear_phase is the superlinear "
+            "phase carrying the most wall time at the larger size — "
+            "the first target for ROADMAP item 5 (closing the "
+            "superlinear gap)."
+        ),
+    }
+    line = json.dumps(result, indent=1)
+    print(line)
+    out = args.out or os.path.join(
+        os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+        "CONTROLLER_PROFILE.json",
+    )
+    with open(out, "w") as handle:
+        handle.write(line + "\n")
+
+
 def main() -> None:
     parser = argparse.ArgumentParser()
     parser.add_argument("--jobs", type=int, default=100)
@@ -133,8 +305,21 @@ def main() -> None:
         "fresh substrate to show how far past O(100) the controller "
         "holds (0 = skip)",
     )
+    parser.add_argument(
+        "--profile", action="store_true",
+        help="attach OperatorMetrics + the sampling profiler to both "
+        "bursts and write CONTROLLER_PROFILE.json (per-phase "
+        "attribution, top-N stacks, superlinear-phase comparison) "
+        "instead of CONTROLLER_SCALE.json",
+    )
     parser.add_argument("--out", default=None)
     args = parser.parse_args()
+
+    if args.profile:
+        if not args.headroom:
+            parser.error("--profile needs --headroom > 0 to compare")
+        profile_main(args)
+        return
 
     burst = run_burst(
         args.jobs, args.workers, args.threadiness, args.timeout

@@ -1,0 +1,406 @@
+"""The sampling profiler (tf_operator_tpu/telemetry/profiler.py): ring
+wraparound, thread-role attribution, start/stop idempotency, folded
+determinism under a scripted workload, the asserted duty-cycle overhead
+bound, the /debug/profilez render surface, the SIGUSR2 snapshot writer,
+the analysis helpers — and phase-level latency attribution on a live
+controller sync pass (the other half of the observatory).
+"""
+
+import contextlib
+import json
+import os
+import threading
+import time
+
+import pytest
+
+from tf_operator_tpu.telemetry.flight import (
+    FlightRecorder,
+    default_flight,
+    set_default_flight,
+)
+from tf_operator_tpu.telemetry.profiler import (
+    SamplingProfiler,
+    profile_chrome_events,
+    render_profilez,
+    speedscope_from_folded,
+    top_table,
+    write_signal_snapshot,
+)
+
+
+@contextlib.contextmanager
+def parked_thread(name):
+    """A thread parked on an Event so every sample of it sees the same
+    stack (the scripted-workload fixture)."""
+    evt = threading.Event()
+    thread = threading.Thread(target=evt.wait, name=name, daemon=True)
+    thread.start()
+    try:
+        yield thread
+    finally:
+        evt.set()
+        thread.join(timeout=2)
+
+
+@pytest.fixture()
+def flight():
+    prev = default_flight()
+    rec = set_default_flight(FlightRecorder(capacity=1024))
+    try:
+        yield rec
+    finally:
+        set_default_flight(prev)
+
+
+class TestRing:
+    def test_wraparound_keeps_newest(self):
+        prof = SamplingProfiler(hz=99, capacity=4)
+        with parked_thread("ring-park"):
+            for _ in range(12):
+                assert prof._sample_once() >= 1
+        total = prof.total_sampled
+        assert total >= 12  # at least the parked thread per tick
+        assert len(prof) == 4
+        snap = prof.snapshot()
+        # the ring keeps exactly the newest `capacity` samples, in
+        # order, with seq still counting across overwrites
+        assert [s.seq for s in snap] == list(range(total - 4, total))
+
+    def test_clear_resets_ring_and_seq(self):
+        prof = SamplingProfiler(capacity=8)
+        with parked_thread("clear-park"):
+            prof._sample_once()
+        assert prof.total_sampled > 0
+        prof.clear()
+        assert prof.total_sampled == 0
+        assert len(prof) == 0
+        assert prof.snapshot() == []
+
+    def test_validates_arguments(self):
+        with pytest.raises(ValueError):
+            SamplingProfiler(hz=0)
+        with pytest.raises(ValueError):
+            SamplingProfiler(capacity=0)
+
+
+class TestRoles:
+    def test_known_thread_names_map_to_plane_roles(self):
+        prof = SamplingProfiler(capacity=256)
+        with parked_thread("decode-engine"), \
+                parked_thread("tfjob-worker-0"), \
+                parked_thread("serveservice-resync"):
+            prof._sample_once()
+        roles = {s.role for s in prof.snapshot()}
+        assert "engine" in roles
+        assert "controller-worker" in roles
+        assert "controller-resync" in roles
+
+    def test_sampler_skips_its_calling_thread(self):
+        prof = SamplingProfiler(capacity=64)
+        with parked_thread("skip-park"):
+            prof._sample_once()
+        # the sampling thread never profiles itself: no sample's stack
+        # contains the frame that drove the tick
+        assert not any(
+            "test_sampler_skips_its_calling_thread" in s.stack
+            for s in prof.snapshot()
+        )
+
+    def test_register_role_overrides_defaults(self):
+        prof = SamplingProfiler(capacity=64)
+        prof.register_role("decode-engine", "custom-plane")
+        with parked_thread("decode-engine"):
+            prof._sample_once()
+        roles = {s.role for s in prof.snapshot()}
+        assert "custom-plane" in roles
+        assert "engine" not in roles
+
+    def test_unknown_thread_name_falls_back_to_itself(self):
+        prof = SamplingProfiler(capacity=64)
+        with parked_thread("totally-bespoke-thread"):
+            prof._sample_once()
+        assert "totally-bespoke-thread" in {
+            s.role for s in prof.snapshot()
+        }
+
+
+class TestLifecycle:
+    def test_start_stop_idempotent(self):
+        prof = SamplingProfiler(hz=200)
+        try:
+            assert prof.start() is True
+            assert prof.running
+            assert prof.start() is False  # second start: no-op
+        finally:
+            assert prof.stop() is True
+            assert prof.stop() is False  # second stop: no-op
+        assert not prof.running
+
+    def test_start_can_retune_hz(self):
+        prof = SamplingProfiler(hz=50)
+        try:
+            prof.start(hz=200)
+            assert prof.hz == 200
+        finally:
+            prof.stop()
+
+    def test_capture_is_blocking_and_bounded(self):
+        prof = SamplingProfiler(hz=200)
+        taken = prof.capture(0.05)
+        # MainThread slept through the window, so the sampler saw it
+        assert taken > 0
+        assert not prof.running  # capture stops what it started
+        assert prof.stats()["samples_total"] == prof.total_sampled
+
+
+class TestFoldedDeterminism:
+    """A scripted nested-call workload must fold to ONE stable stack:
+    root-first ordering, leaf last, same string every tick."""
+
+    @staticmethod
+    def _leaf(evt):
+        evt.wait()
+
+    @staticmethod
+    def _inner(evt):
+        TestFoldedDeterminism._leaf(evt)
+
+    @staticmethod
+    def _outer(evt):
+        TestFoldedDeterminism._inner(evt)
+
+    def test_nested_calls_fold_root_first_and_identically(self):
+        prof = SamplingProfiler(capacity=256)
+        evt = threading.Event()
+        thread = threading.Thread(
+            target=self._outer, args=(evt,),
+            name="decode-engine", daemon=True,
+        )
+        thread.start()
+        try:
+            # let the thread reach the Event.wait parking spot
+            deadline = time.monotonic() + 2
+            while time.monotonic() < deadline:
+                prof.clear()
+                prof._sample_once()
+                engine = [
+                    s for s in prof.snapshot() if s.role == "engine"
+                ]
+                if engine and "_leaf" in engine[0].stack:
+                    break
+                time.sleep(0.01)
+            prof.clear()
+            for _ in range(5):
+                prof._sample_once()
+        finally:
+            evt.set()
+            thread.join(timeout=2)
+
+        folded = prof.folded()
+        engine_keys = [k for k in folded if k.startswith("engine;")]
+        # determinism: a parked workload folds to exactly one stack,
+        # sampled once per tick
+        assert len(engine_keys) == 1
+        key = engine_keys[0]
+        assert folded[key] == 5
+        # root-first: outer before inner before leaf, leaf toward the
+        # end (flamegraph convention), no line numbers in frames
+        i_outer = key.index("test_profiler.py:_outer")
+        i_inner = key.index("test_profiler.py:_inner")
+        i_leaf = key.index("test_profiler.py:_leaf")
+        assert i_outer < i_inner < i_leaf
+
+
+class TestOverheadBound:
+    def test_duty_cycle_under_two_percent_at_99hz(self):
+        """THE overhead assertion: the sampler self-accounts the time
+        it spends inside _sample_once; at the default 99 Hz with live
+        threads to walk, that duty cycle must stay under the 2% budget
+        /debug/profilez advertises."""
+        prof = SamplingProfiler(hz=99)
+        with parked_thread("duty-a"), parked_thread("duty-b"):
+            assert prof.start()
+            try:
+                time.sleep(0.3)
+                stats = prof.stats()  # read while running: elapsed set
+            finally:
+                prof.stop()
+        assert stats["ticks"] > 10
+        assert stats["elapsed_seconds"] > 0
+        duty = stats["sample_seconds"] / stats["elapsed_seconds"]
+        assert duty < 0.02, f"sampler duty cycle {duty:.4f} >= 2%"
+
+
+class TestRenderProfilez:
+    def test_start_stop_actions(self):
+        prof = SamplingProfiler(hz=200)
+        try:
+            ctype, body = render_profilez(prof, "action=start&hz=200")
+            assert ctype == "application/json"
+            assert json.loads(body)["started"] is True
+            assert json.loads(
+                render_profilez(prof, "action=start")[1]
+            )["started"] is False
+        finally:
+            assert json.loads(
+                render_profilez(prof, "action=stop")[1]
+            )["stopped"] is True
+        assert json.loads(
+            render_profilez(prof, "action=stop")[1]
+        )["stopped"] is False
+
+    def test_snapshot_formats(self):
+        prof = SamplingProfiler(capacity=256)
+        with parked_thread("decode-engine"):
+            for _ in range(3):
+                prof._sample_once()
+        ctype, body = render_profilez(prof, "format=json")
+        payload = json.loads(body)
+        assert payload["profile"] == "tf-operator-tpu-sampling"
+        assert payload["samples"] > 0
+        assert any(k.startswith("engine") for k in payload["folded"])
+
+        ctype, body = render_profilez(prof, "format=speedscope")
+        assert "speedscope" in json.loads(body)["$schema"]
+
+        ctype, body = render_profilez(prof, "")
+        assert ctype.startswith("text/plain")
+        lines = body.decode().strip().splitlines()
+        assert lines and all(
+            line.rsplit(" ", 1)[1].isdigit() for line in lines
+        )
+
+    def test_snapshot_with_seconds_blocking_captures(self):
+        prof = SamplingProfiler(hz=200)
+        assert not prof.running
+        _, body = render_profilez(prof, "seconds=0.05&format=json")
+        payload = json.loads(body)
+        assert payload["samples"] > 0  # captured right here
+        assert not prof.running  # and stopped again after the window
+
+    def test_bad_params_fall_back_to_defaults(self):
+        prof = SamplingProfiler()
+        with parked_thread("param-park"):
+            prof._sample_once()
+        _, body = render_profilez(prof, "seconds=bogus&hz=nan&format=json")
+        assert json.loads(body)["samples"] >= 1
+
+
+class TestSignalSnapshot:
+    def test_writes_profile_json_without_blocking_caller(self, tmp_path):
+        prof = SamplingProfiler(hz=200)
+        before = time.monotonic()
+        path = write_signal_snapshot(
+            str(tmp_path), seconds=0.05, hz=200, profiler=prof
+        )
+        # the caller (a signal handler in production) returns at once
+        assert time.monotonic() - before < 0.1
+        assert os.path.basename(path).startswith("profile-usr2-")
+        deadline = time.monotonic() + 5
+        while not os.path.exists(path) and time.monotonic() < deadline:
+            time.sleep(0.02)
+        with open(path) as handle:
+            payload = json.load(handle)
+        assert payload["profile"] == "tf-operator-tpu-sampling"
+        assert payload["samples"] > 0
+
+
+class TestAnalysis:
+    FOLDED = {
+        "engine;a.py:f;b.py:g": 3,
+        "engine;a.py:f": 2,
+        "main;c.py:h": 1,
+    }
+
+    def test_top_table_self_cumulative_roles(self):
+        tables = top_table(self.FOLDED, n=5)
+        assert tables["self"][0] == ("b.py:g", 3)
+        assert ("a.py:f", 2) in tables["self"]
+        # cumulative credits a.py:f for both stacks it appears in
+        assert tables["cumulative"][0] == ("a.py:f", 5)
+        assert tables["roles"][0] == ("engine", 5)
+        assert tables["roles"][1] == ("main", 1)
+
+    def test_speedscope_from_folded(self):
+        doc = speedscope_from_folded({"folded": self.FOLDED, "hz": 100})
+        assert [p["name"] for p in doc["profiles"]] == ["engine", "main"]
+        engine = doc["profiles"][0]
+        assert engine["type"] == "sampled"
+        # 3 samples at 1/100 s + 2 at 1/100 s = 0.05 s of engine time
+        assert abs(engine["endValue"] - 0.05) < 1e-9
+
+    def test_profile_chrome_events_tracks_per_role(self):
+        events = profile_chrome_events(
+            {"folded": self.FOLDED, "wall_start": 1.0}
+        )
+        names = {e["args"]["name"] for e in events if e["ph"] == "M"}
+        assert names == {"profile:engine", "profile:main"}
+        instants = [e for e in events if e["ph"] == "i"]
+        assert sum(e["args"]["count"] for e in instants) == 6
+
+
+class TestPhaseAttribution:
+    """The other tentpole half: one controller sync pass must emit a
+    per-phase histogram observation set and a single kind="phase"
+    flight record whose laps cover the pass."""
+
+    def test_controller_sync_records_phases(self, flight):
+        from tests.test_api import make_job
+        from tf_operator_tpu.controller import TFJobController
+        from tf_operator_tpu.runtime import InMemorySubstrate
+        from tf_operator_tpu.server.metrics import OperatorMetrics
+
+        sub = InMemorySubstrate()
+        metrics = OperatorMetrics()
+        controller = TFJobController(sub, metrics=metrics)
+        sub.create_job(make_job({"Worker": 1}, name="phased"))
+        controller.sync("default/phased")
+
+        stats = {
+            key[0]: (seconds, count)
+            for key, (seconds, count)
+            in metrics.reconcile_phase.labeled_stats().items()
+        }
+        # a full pass walks every phase of the typed breakdown
+        for phase in (
+            "get", "admission", "expectations",
+            "list", "reconcile", "status-write",
+        ):
+            assert phase in stats, f"phase {phase} not observed"
+            seconds, count = stats[phase]
+            assert count >= 1
+            assert seconds >= 0.0
+        # substrate verbs drill into the reconcile phase: the pass
+        # created one worker pod and its service
+        verbs = {
+            key[0] for key in metrics.substrate_call.labeled_stats()
+        }
+        assert "create-pod" in verbs
+        assert "create-service" in verbs
+
+        records = flight.snapshot(kind="phase")
+        assert len(records) == 1
+        fields = records[0].fields
+        assert fields["key"] == "default/phased"
+        assert set(fields) >= {
+            "key", "get", "admission", "expectations",
+            "list", "reconcile", "status-write",
+        }
+
+    def test_short_circuit_sync_still_records_get_phase(self, flight):
+        from tf_operator_tpu.controller import TFJobController
+        from tf_operator_tpu.runtime import InMemorySubstrate
+        from tf_operator_tpu.server.metrics import OperatorMetrics
+
+        sub = InMemorySubstrate()
+        metrics = OperatorMetrics()
+        controller = TFJobController(sub, metrics=metrics)
+        controller.sync("default/never-existed")
+        stats = {
+            key[0] for key in metrics.reconcile_phase.labeled_stats()
+        }
+        assert stats == {"get"}  # NotFound short-circuits after the get
+        records = flight.snapshot(kind="phase")
+        assert len(records) == 1
+        assert records[0].fields["key"] == "default/never-existed"

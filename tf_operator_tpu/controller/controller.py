@@ -350,7 +350,15 @@ class TFJobController:
         """Process one key (reference syncTFJob, controller.go:299-343).
         Everything after the job fetch runs under the job's correlation
         ID (its UID), so every flight record, event, span, and JSON log
-        line one reconcile pass emits joins on one key."""
+        line one reconcile pass emits joins on one key.
+
+        Phase attribution: each pass splits its wall time into named
+        phases (get, admission, expectations, list, reconcile,
+        status-write) observed into reconcile_phase_seconds{phase=} and
+        emitted as ONE kind="phase" flight record per pass, so a slow
+        sync names its slow segment instead of one opaque duration."""
+        phases: dict = {}
+        mark = time.perf_counter()
         try:
             namespace, name = key.split("/", 1)
         except ValueError:
@@ -362,11 +370,41 @@ class TFJobController:
             self.expectations.delete_expectations(key)
             self._port_wait.discard(key)
             flight_record("reconcile", op="sync", key=key, decision="gone")
+            phases["get"] = time.perf_counter() - mark
+            self._record_phases(key, phases)
             return
+        phases["get"] = time.perf_counter() - mark
         with correlate(job.metadata.uid or key):
-            self._sync_job(key, job)
+            try:
+                self._sync_job(key, job, phases)
+            finally:
+                self._record_phases(key, phases)
 
-    def _sync_job(self, key: str, job: TFJob) -> None:
+    def _record_phases(self, key: str, phases: dict) -> None:
+        """Persist one pass's phase split: histogram per phase plus a
+        single typed flight record carrying every phase as a field."""
+        if not phases:
+            return
+        for phase, seconds in phases.items():
+            self._telemetry("observe_phase", phase, seconds)
+        flight_record(
+            "phase", key=key,
+            **{phase: round(seconds, 6) for phase, seconds in phases.items()},
+        )
+
+    def _sync_job(self, key: str, job: TFJob, phases: Optional[dict] = None) -> None:
+        if phases is None:
+            phases = {}
+        mark = time.perf_counter()
+
+        def lap(phase: str) -> None:
+            # accumulate (not assign): admission may run twice in one
+            # pass via the resync backstop re-entering _admit
+            nonlocal mark
+            now = time.perf_counter()
+            phases[phase] = phases.get(phase, 0.0) + (now - mark)
+            mark = now
+
         namespace, name = job.namespace, job.name
         set_defaults(job)
 
@@ -378,6 +416,7 @@ class TFJobController:
             flight_record(
                 "reconcile", op="sync", key=key, decision="pending-deletion",
             )
+            lap("admission")
             return
 
         if not job.status.conditions:
@@ -386,6 +425,7 @@ class TFJobController:
             # must run before reconcile so pods aren't created without
             # their hostNetwork ports
             self._admit(job)
+            lap("admission")
             return
 
         if self.degraded.degraded:
@@ -399,7 +439,9 @@ class TFJobController:
             )
             self._mark_degraded(job)
             self.queue.add_after(key, self.degraded.probe_interval)
+            lap("admission")
             return
+        lap("admission")
 
         needs_sync = job.spec.enable_dynamic_worker or self._satisfied_expectations(job)
         if not needs_sync:
@@ -407,7 +449,9 @@ class TFJobController:
                 "reconcile", op="sync", key=key,
                 decision="expectations-pending",
             )
+            lap("expectations")
             return
+        lap("expectations")
 
         old_status = to_jsonable(job.status)
         # reaching here means the latch is clear: flip the Degraded
@@ -429,7 +473,9 @@ class TFJobController:
         # mislabeled child that reaches it.
         pods = self.substrate.list_pods(namespace, gen_labels(name))
         services = self.substrate.list_services(namespace, gen_labels(name))
+        lap("list")
         self.reconciler.reconcile(job, pods, services)
+        lap("reconcile")
         status_changed = to_jsonable(job.status) != old_status
         flight_record(
             "reconcile", op="sync", key=key, decision="reconciled",
@@ -452,6 +498,7 @@ class TFJobController:
             # their pods are gone: the host ports go back to the pool
             # (reference DeAllocate on pod deletion, port.go:258-295)
             self.port_allocator.release(job.key())
+        lap("status-write")
 
     def _mark_degraded(self, job: TFJob) -> None:
         """Stamp the Degraded condition + Warning event once per

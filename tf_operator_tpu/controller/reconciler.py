@@ -13,6 +13,7 @@ from __future__ import annotations
 
 import dataclasses
 import logging
+import time
 from typing import Callable, List, Optional, Tuple
 
 from ..api import k8s
@@ -162,6 +163,18 @@ class Reconciler:
     def _job_event(self, job: TFJob, etype: str, reason: str, message: str) -> None:
         self.recorder.event(job.kind, job.name, job.namespace, etype, reason, message)
 
+    def _observe_substrate(self, verb: str, started: float) -> None:
+        """Attribute one substrate write to substrate_call_seconds{verb=}
+        — the drill-down INSIDE the sync pass's "reconcile" phase
+        (duck-typed like the rest of the metrics surface)."""
+        fn = (
+            getattr(self.metrics, "observe_substrate_call", None)
+            if self.metrics is not None
+            else None
+        )
+        if fn is not None:
+            fn(verb, time.perf_counter() - started)
+
     def _schedule_deadline_sync(self, job: TFJob) -> None:
         deadline = job.spec.run_policy.active_deadline_seconds
         if deadline is not None:
@@ -217,6 +230,7 @@ class Reconciler:
                     ref for ref in meta.owner_references
                     if ref.uid != job.metadata.uid
                 ]
+                started = time.perf_counter()
                 try:
                     patch_refs(meta.namespace, meta.name, released, meta.uid)
                 except Exception as err:
@@ -224,6 +238,8 @@ class Reconciler:
                         "job %s: failed to release %s: %s",
                         job.name, meta.name, err,
                     )
+                finally:
+                    self._observe_substrate("patch-owner-refs", started)
                 continue
             if not matches or any(ref.controller for ref in meta.owner_references):
                 continue
@@ -238,6 +254,7 @@ class Reconciler:
                 continue
             adopted = [deep_copy(ref) for ref in meta.owner_references]
             adopted.append(_owner_reference(job))
+            started = time.perf_counter()
             try:
                 # meta.uid in the patch: if the name was reused by a new
                 # object between LIST and patch, the write 409s instead
@@ -248,6 +265,8 @@ class Reconciler:
                     "job %s: failed to adopt %s: %s", job.name, meta.name, err
                 )
                 continue
+            finally:
+                self._observe_substrate("patch-owner-refs", started)
             meta.owner_references = adopted  # act on the fresh truth now
             claimed.append(obj)
         return claimed
@@ -559,6 +578,7 @@ class Reconciler:
 
         key = expectation_pods_key(job.key(), rt)
         self.expectations.raise_expectations(key, 1, 0)
+        started = time.perf_counter()
         try:
             self.pod_control.create_pod(job.namespace, pod, job)
         except Exception:
@@ -566,6 +586,8 @@ class Reconciler:
             # (reference pod_control.go:69-74 semantics)
             self.expectations.creation_observed(key)
             raise
+        finally:
+            self._observe_substrate("create-pod", started)
         # first successful pod create marks the span phase (idempotent:
         # job_phase records each phase name once per job span)
         job_phase = getattr(self.metrics, "job_phase", None)
@@ -580,6 +602,7 @@ class Reconciler:
         twice); the reference's PodControl treats IsNotFound the same."""
         key = expectation_pods_key(job.key(), rt)
         self.expectations.raise_expectations(key, 0, 1)
+        started = time.perf_counter()
         try:
             self.pod_control.delete_pod(job.namespace, pod.metadata.name, job)
         except NotFound:
@@ -588,10 +611,13 @@ class Reconciler:
         except Exception:
             self.expectations.deletion_observed(key)
             raise
+        finally:
+            self._observe_substrate("delete-pod", started)
 
     def _delete_service(self, job: TFJob, svc: k8s.Service, rt: str) -> None:
         key = expectation_services_key(job.key(), rt)
         self.expectations.raise_expectations(key, 0, 1)
+        started = time.perf_counter()
         try:
             self.service_control.delete_service(job.namespace, svc.metadata.name, job)
         except NotFound:
@@ -599,6 +625,8 @@ class Reconciler:
         except Exception:
             self.expectations.deletion_observed(key)
             raise
+        finally:
+            self._observe_substrate("delete-service", started)
 
     def _rewrite_host_ports(
         self, job: TFJob, template: k8s.PodTemplateSpec, rt: str, index: int
@@ -683,11 +711,14 @@ class Reconciler:
         )
         key = expectation_services_key(job.key(), rt)
         self.expectations.raise_expectations(key, 1, 0)
+        started = time.perf_counter()
         try:
             self.service_control.create_service(job.namespace, service, job)
         except Exception:
             self.expectations.creation_observed(key)
             raise
+        finally:
+            self._observe_substrate("create-service", started)
 
     # -- end of life -------------------------------------------------------
 

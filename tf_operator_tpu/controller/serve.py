@@ -27,6 +27,7 @@ from __future__ import annotations
 
 import logging
 import threading
+import time
 from typing import Callable, List, Optional
 
 from ..api import k8s, set_serve_defaults, validate_serve_service
@@ -347,6 +348,12 @@ class ServeServiceController:
         substrate.subscribe("serveservice", self._on_serve_service)
         substrate.subscribe("pod", self._on_pod)
 
+    def _telemetry(self, method: str, *args) -> None:
+        """Best-effort duck-typed metrics call (TFJobController's twin)."""
+        fn = getattr(self.metrics, method, None) if self.metrics is not None else None
+        if fn is not None:
+            fn(*args)
+
     # -- event handlers ----------------------------------------------------
 
     def _in_scope(self, namespace: str) -> bool:
@@ -450,6 +457,12 @@ class ServeServiceController:
         self.queue.add(key)
 
     def sync(self, key: str) -> None:
+        """Phase-attributed like TFJobController.sync: each pass splits
+        into get/admission/expectations/list/reconcile/status-write,
+        observed into reconcile_phase_seconds{phase=} and emitted as one
+        kind="phase" flight record."""
+        phases: dict = {}
+        mark = time.perf_counter()
         try:
             namespace, name = key.split("/", 1)
         except ValueError:
@@ -460,20 +473,50 @@ class ServeServiceController:
         except NotFound:
             self.expectations.delete_expectations(key)
             flight_record("reconcile", op="serve-sync", key=key, decision="gone")
+            phases["get"] = time.perf_counter() - mark
+            self._record_phases(key, phases)
             return
+        phases["get"] = time.perf_counter() - mark
         with correlate(svc.metadata.uid or key):
-            self._sync_service(key, svc)
+            try:
+                self._sync_service(key, svc, phases)
+            finally:
+                self._record_phases(key, phases)
 
-    def _sync_service(self, key: str, svc: ServeService) -> None:
+    def _record_phases(self, key: str, phases: dict) -> None:
+        if not phases:
+            return
+        for phase, seconds in phases.items():
+            self._telemetry("observe_phase", phase, seconds)
+        flight_record(
+            "phase", key=key,
+            **{phase: round(seconds, 6) for phase, seconds in phases.items()},
+        )
+
+    def _sync_service(
+        self, key: str, svc: ServeService, phases: Optional[dict] = None
+    ) -> None:
+        if phases is None:
+            phases = {}
+        mark = time.perf_counter()
+
+        def lap(phase: str) -> None:
+            nonlocal mark
+            now = time.perf_counter()
+            phases[phase] = phases.get(phase, 0.0) + (now - mark)
+            mark = now
+
         set_serve_defaults(svc)
         if svc.metadata.deletion_timestamp is not None:
             flight_record(
                 "reconcile", op="serve-sync", key=key,
                 decision="pending-deletion",
             )
+            lap("admission")
             return
         if not svc.status.conditions:
             self._admit(svc)
+            lap("admission")
             return
         if svc.has_condition(ConditionType.FAILED):
             # failed validation is terminal for the spec that failed;
@@ -482,19 +525,25 @@ class ServeServiceController:
             flight_record(
                 "reconcile", op="serve-sync", key=key, decision="failed",
             )
+            lap("admission")
             return
+        lap("admission")
         ekey = expectation_pods_key(key, SERVE_REPLICA_TYPE)
         if not self.expectations.satisfied(ekey):
             flight_record(
                 "reconcile", op="serve-sync", key=key,
                 decision="expectations-pending",
             )
+            lap("expectations")
             return
+        lap("expectations")
         old_status = to_jsonable(svc.status)
         pods = self.substrate.list_pods(
             svc.namespace, serve_labels(svc.name)
         )
+        lap("list")
         self.reconciler.reconcile(svc, pods)
+        lap("reconcile")
         status_changed = to_jsonable(svc.status) != old_status
         flight_record(
             "reconcile", op="serve-sync", key=key, decision="reconciled",
@@ -502,6 +551,7 @@ class ServeServiceController:
         )
         if status_changed:
             self._update_status(svc)
+        lap("status-write")
 
     def _update_status(self, svc: ServeService) -> None:
         try:
@@ -533,14 +583,17 @@ class ServeServiceController:
         key = self.queue.get(timeout=timeout)
         if key is None:
             return False
+        started = time.monotonic()
         try:
             self.sync(key)
         except Exception:
             logger.exception("error syncing %r; requeueing", key)
+            self._telemetry("observe_reconcile", time.monotonic() - started, "error")
             if self.metrics is not None:
                 self.metrics.reconcile_panic()
             self.queue.add_rate_limited(key)
         else:
+            self._telemetry("observe_reconcile", time.monotonic() - started, "success")
             self.queue.forget(key)
         finally:
             self.queue.done(key)

@@ -235,11 +235,15 @@ class DecodeClient:
         request: Optional[str] = None,
         kind: Optional[str] = None,
         limit: Optional[int] = None,
+        since: Optional[float] = None,
     ) -> List[dict]:
         """Parsed flight-recorder records from /debug/flightz, newest
         last. request filters on the correlation ID the server echoes
         as "request_id" (so a client can pull exactly its own
-        admit/evict/step records); kind/limit filter server-side."""
+        admit/evict/step records); kind/limit/since filter server-side
+        (since = unix timestamp, records at or after it — pass a
+        profile payload's wall_start to fetch the overlapping
+        flight window)."""
         from urllib.parse import urlencode
 
         params = {}
@@ -249,8 +253,31 @@ class DecodeClient:
             params["kind"] = kind
         if limit is not None:
             params["limit"] = str(limit)
+        if since is not None:
+            params["since"] = repr(float(since))
         path = "/debug/flightz"
         if params:
             path += "?" + urlencode(params)
         raw = self._request(path).decode()
         return [json.loads(line) for line in raw.splitlines() if line]
+
+    def profilez(
+        self,
+        seconds: Optional[float] = None,
+        hz: Optional[int] = None,
+        format: str = "json",
+    ):
+        """Sampling-profiler snapshot from /debug/profilez (requires
+        the server's --enable-debug-endpoints). format="json" returns
+        the parsed to_json() payload; "folded"/"speedscope" return the
+        raw bytes. seconds triggers a blocking capture window when the
+        remote profiler isn't already running."""
+        from urllib.parse import urlencode
+
+        params = {"action": "snapshot", "format": format}
+        if seconds is not None:
+            params["seconds"] = repr(float(seconds))
+        if hz is not None:
+            params["hz"] = str(int(hz))
+        raw = self._request("/debug/profilez?" + urlencode(params))
+        return json.loads(raw) if format == "json" else raw

@@ -108,6 +108,18 @@ METRIC_HELP = {
     "engine_prefill_chunks_total": "Chunked-prefill chunks executed",
     "engine_prefill_seconds_total":
         "Wall-clock seconds spent inside prefill chunks",
+    "engine_admit_seconds_total":
+        "Wall-clock seconds the scheduler spent admitting requests "
+        "into slots (queue drain + block planning + placement)",
+    "engine_dispatch_seconds_total":
+        "Wall-clock seconds spent dispatching the compiled decode "
+        "step (call until the device future returns)",
+    "engine_device_sync_seconds_total":
+        "Wall-clock seconds blocked materializing step outputs on "
+        "the host (device sync)",
+    "engine_fanout_seconds_total":
+        "Wall-clock seconds spent fanning step outputs out to "
+        "request streams (per-slot emit loop)",
 }
 
 
@@ -475,6 +487,13 @@ class ContinuousBatchingEngine:
         self.peak_active = 0
         self.prefill_chunks = 0
         self.prefill_seconds = 0.0
+        # quantum attribution (engine-thread-owned, like the above):
+        # where each scheduler quantum's wall time goes — admission,
+        # compiled-step dispatch, host-side device sync, stream fan-out
+        self.admit_seconds = 0.0
+        self.dispatch_seconds = 0.0
+        self.sync_seconds = 0.0
+        self.fanout_seconds = 0.0
         # latency distributions + request spans (telemetry.MetricRegistry
         # / SpanTracer, both optional): TTFT and queue-wait are per
         # request, inter-token per emitted token, batch size per step.
@@ -488,12 +507,20 @@ class ContinuousBatchingEngine:
         self._h_ttft = self._h_itl = self._h_queue_wait = None
         self._h_batch = self._h_prefill = None
         if registry is not None:
-            from ..telemetry import FAST_BUCKETS, LATENCY_BUCKETS, SIZE_BUCKETS
+            from ..telemetry import (
+                FAST_BUCKETS,
+                LATENCY_BUCKETS,
+                SIZE_BUCKETS,
+                TTFT_BUCKETS,
+            )
 
+            # TTFT_BUCKETS: paged TTFT sits at 0.015-0.071s, below
+            # LATENCY_BUCKETS' useful resolution — sub-ms buckets keep
+            # the p50/p95 quantile estimates honest
             self._h_ttft = registry.histogram(
                 "ttft_seconds",
                 "Time from submit to a request's first generated token",
-                buckets=LATENCY_BUCKETS,
+                buckets=TTFT_BUCKETS,
             )
             self._h_itl = registry.histogram(
                 "inter_token_seconds",
@@ -515,7 +542,7 @@ class ContinuousBatchingEngine:
                 self._h_prefill = registry.histogram(
                     "prefill_chunk_seconds",
                     "Wall-clock latency of one chunked-prefill chunk",
-                    buckets=FAST_BUCKETS,
+                    buckets=TTFT_BUCKETS,
                 )
         # THE one compile (per program), paid at construction instead
         # of inside the first request's latency (the engine twin of
@@ -733,6 +760,14 @@ class ContinuousBatchingEngine:
             ("engine_cancelled_total", "counter"): self.cancelled,
             ("engine_decode_seconds_total", "counter"):
                 self.decode_seconds,
+            ("engine_admit_seconds_total", "counter"):
+                self.admit_seconds,
+            ("engine_dispatch_seconds_total", "counter"):
+                self.dispatch_seconds,
+            ("engine_device_sync_seconds_total", "counter"):
+                self.sync_seconds,
+            ("engine_fanout_seconds_total", "counter"):
+                self.fanout_seconds,
             ("engine_compiles_total", "counter"): self.step.compiles,
             ("engine_active_slots", "gauge"): self.active_slots,
             ("engine_queue_depth", "gauge"): self.queue_depth,
@@ -793,6 +828,7 @@ class ContinuousBatchingEngine:
             self._work_once()
 
     def _admit(self) -> None:
+        started = time.perf_counter()
         # drain the client queue into the scheduler-owned stage first:
         # FIFO must hold across the two hops
         while True:
@@ -812,6 +848,7 @@ class ContinuousBatchingEngine:
                     break
             self._pending.popleft()
             self._place(req, plan)
+        self.admit_seconds += time.perf_counter() - started
 
     def _plan(self, req: EngineRequest):
         """Prefix-cache match + block budget for one request ->
@@ -1081,21 +1118,20 @@ class ContinuousBatchingEngine:
                     self.params, self._cache, self._tok, self._index,
                     self._prompt, self._lens,
                 )
+            dispatched = time.perf_counter()
             nxt = np.asarray(nxt)
         except Exception as err:  # noqa: BLE001 — fan out, stay alive
             self._fail_all(err)
             return
-        self.decode_seconds += time.perf_counter() - start
+        synced = time.perf_counter()
+        self.decode_seconds += synced - start
+        self.dispatch_seconds += dispatched - start
+        self.sync_seconds += synced - dispatched
         self.steps += 1
-        self.row_steps += self.active_slots
+        slots_now = self.active_slots
+        self.row_steps += slots_now
         if self._h_batch is not None:
-            self._h_batch.observe(self.active_slots)
-        # the per-step breadcrumb: the slot grid's occupancy over time
-        # IS the engine's narrative (one ring slot per step, no
-        # allocation beyond the record tuple — SERVE_BENCH stays flat)
-        (self._flight or default_flight()).record(
-            "serve", op="step", step=self.steps, slots=self.active_slots,
-        )
+            self._h_batch.observe(slots_now)
         now = time.monotonic()
         for slot, req in enumerate(self._reqs):
             if req is None or slot in self._prefilling:
@@ -1126,6 +1162,19 @@ class ContinuousBatchingEngine:
                 if pos == int(self._lens[slot]) + req.new - 1:
                     self.finished += 1
                     self._release(slot)
+        fanout = time.perf_counter() - synced
+        self.fanout_seconds += fanout
+        # the per-step breadcrumb: the slot grid's occupancy over time
+        # IS the engine's narrative (one ring slot per step, no
+        # allocation beyond the record tuple — SERVE_BENCH stays flat).
+        # Emitted AFTER the fan-out so the record carries the full
+        # quantum split: dispatch / device sync / stream fan-out.
+        (self._flight or default_flight()).record(
+            "serve", op="step", step=self.steps, slots=slots_now,
+            dispatch=round(dispatched - start, 6),
+            sync=round(synced - dispatched, 6),
+            fanout=round(fanout, 6),
+        )
 
 
 def main(argv=None) -> int:

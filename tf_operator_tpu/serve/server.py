@@ -68,6 +68,7 @@ import threading
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 
 from ..telemetry.flight import correlate, default_flight, render_flightz
+from ..telemetry.profiler import default_profiler, render_profilez
 from . import export as export_mod
 
 from ..utils import locks
@@ -150,6 +151,11 @@ class _State:
         self.lock = locks.make_lock("_State.lock")
         self.batcher = None  # set by make_server (batching="window")
         self.engine = None  # set by make_server (batching="continuous")
+        # opt-in debug surface (make_server enable_debug_endpoints /
+        # --enable-debug-endpoints): /debug/profilez samples live
+        # thread stacks, the same sensitivity class as the operator's
+        # /debug/threads — off unless deployed with it on
+        self.enable_debug = False
         # one labeled-metric registry + span tracer per server — the
         # same telemetry core the operator plane uses
         # (telemetry/registry.py), so one scrape config covers both
@@ -511,6 +517,24 @@ def DecodeHandlerFactory(state: _State):
                 )
                 self.send_response(200)
                 self.send_header("Content-Type", "application/x-ndjson")
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+            elif (
+                self.path.partition("?")[0] == "/debug/profilez"
+                and state.enable_debug
+            ):
+                # sampling profiler (telemetry/profiler.py): thread
+                # stacks ARE sensitive, so unlike flightz this rides
+                # the --enable-debug-endpoints gate. ?action=start|
+                # stop|snapshot, ?seconds=/?hz=, ?format=folded|
+                # speedscope|json; a snapshot with seconds= against a
+                # stopped profiler blocking-captures that window.
+                ctype, body = render_profilez(
+                    default_profiler(), self.path.partition("?")[2]
+                )
+                self.send_response(200)
+                self.send_header("Content-Type", ctype)
                 self.send_header("Content-Length", str(len(body)))
                 self.end_headers()
                 self.wfile.write(body)
@@ -932,6 +956,7 @@ def make_server(
     block_size: int = 64,
     kv_blocks: int = 0,
     prefill_chunk: int = 64,
+    enable_debug_endpoints: bool = False,
 ) -> ThreadingHTTPServer:
     """In-process server (tests and embedders); caller owns
     serve_forever/shutdown. The CLI binds 0.0.0.0 (pods must be
@@ -1040,6 +1065,7 @@ def make_server(
         cfg, params, kv_quant_int8, model_name, max_new_cap,
         speculative=speculative, weights_int8=weights_int8, mesh=mesh,
     )
+    state.enable_debug = bool(enable_debug_endpoints)
     if batching == "window":
         from .batching import DynamicBatcher
 
@@ -1339,6 +1365,14 @@ def main(argv=None) -> int:
         "--speculative",
     )
     parser.add_argument(
+        "--enable-debug-endpoints", action="store_true",
+        help="serve GET /debug/profilez (sampling wall-clock profiler: "
+        "start/stop/snapshot, folded or speedscope output — "
+        "telemetry/profiler.py). Off by default: live thread stacks "
+        "are the same sensitivity class as the operator's "
+        "/debug/threads",
+    )
+    parser.add_argument(
         "--smoke", action="store_true",
         help="self-contained telemetry smoke: boot a tiny continuous-"
         "batching server, drive two requests, validate the /metrics "
@@ -1515,6 +1549,7 @@ def main(argv=None) -> int:
         batching=args.batching, n_slots=args.slots,
         kv_layout=args.kv_layout, block_size=args.block_size,
         kv_blocks=args.kv_blocks, prefill_chunk=args.prefill_chunk,
+        enable_debug_endpoints=args.enable_debug_endpoints,
     )
     logger.info("decode server on :%d", server.server_address[1])
     # graceful drain — the serving sibling of the training-side

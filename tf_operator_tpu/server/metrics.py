@@ -29,7 +29,9 @@ from ..telemetry import (
     MetricRegistry,
     SpanTracer,
     default_flight,
+    default_profiler,
     render_flightz,
+    render_profilez,
 )
 
 _COUNTER_HELP = {
@@ -130,6 +132,26 @@ class OperatorMetrics:
             "Wall time of one per-key reconcile (sync) pass",
             buckets=LATENCY_BUCKETS, labelnames=("result",),
         )
+        # phase-level attribution INSIDE a sync pass (get, admission,
+        # expectation check, pod/service list, pod diff, status write):
+        # the sum over phases accounts for a pass's wall time, so
+        # "which phase is superlinear" reads straight off /metrics
+        self.reconcile_phase = self.registry.histogram(
+            "reconcile_phase_seconds",
+            "Wall time of one phase of a reconcile pass "
+            "(phases sum to ~the pass's wall time)",
+            buckets=LATENCY_BUCKETS, labelnames=("phase",),
+        )
+        # substrate calls by verb (create-pod, delete-pod,
+        # create-service, delete-service, patch-owner-refs): the verb
+        # breakdown WITHIN the reconcile phase — not summed with the
+        # phases above, it's their drill-down
+        self.substrate_call = self.registry.histogram(
+            "substrate_call_seconds",
+            "Wall time of one substrate/apiserver call, by verb "
+            "(a drill-down within the reconcile phase)",
+            buckets=LATENCY_BUCKETS, labelnames=("verb",),
+        )
         self._workqueues: Dict[str, WorkqueueMetrics] = {}
         # job-lifecycle spans: observed -> pods-created -> running ->
         # terminal, keyed by "namespace/name"
@@ -175,6 +197,14 @@ class OperatorMetrics:
         self.reconcile_duration.labels(result=result).observe(
             max(0.0, seconds)
         )
+
+    def observe_phase(self, phase: str, seconds: float) -> None:
+        self.reconcile_phase.labels(phase=phase).observe(
+            max(0.0, seconds)
+        )
+
+    def observe_substrate_call(self, verb: str, seconds: float) -> None:
+        self.substrate_call.labels(verb=verb).observe(max(0.0, seconds))
 
     def workqueue(self, name: str = "tfjob") -> WorkqueueMetrics:
         wq = self._workqueues.get(name)
@@ -306,12 +336,22 @@ class MonitoringServer:
                 path, _, query = self.path.partition("?")
                 if path == "/debug/flightz" and server.enable_debug:
                     # JSONL black-box dump; ?corr= / ?job= / ?kind= /
-                    # ?limit= filter (telemetry/flight.py render_flightz)
+                    # ?since= / ?limit= filter (flight.py render_flightz)
                     body = render_flightz(metrics.flight, query)
                     self.send_response(200)
                     self.send_header(
                         "Content-Type", "application/x-ndjson"
                     )
+                elif path == "/debug/profilez" and server.enable_debug:
+                    # sampling profiler (telemetry/profiler.py):
+                    # ?action=start|stop|snapshot, ?seconds=/?hz=,
+                    # ?format=folded|speedscope|json. Resolved per
+                    # request so tests swapping the default see theirs.
+                    ctype, body = render_profilez(
+                        default_profiler(), query
+                    )
+                    self.send_response(200)
+                    self.send_header("Content-Type", ctype)
                 elif self.path == "/metrics":
                     body = metrics.render().encode()
                     self.send_response(200)

@@ -64,7 +64,8 @@ def parse_args(argv: Optional[List[str]] = None) -> ServerOptions:
     parser.add_argument(
         "--enable-debug-endpoints", action="store_true",
         default=opts.enable_debug_endpoints,
-        help="Serve /debug/threads and /debug/vars on the monitoring port",
+        help="Serve /debug/threads, /debug/vars, /debug/trace, "
+        "/debug/flightz and /debug/profilez on the monitoring port",
     )
     parser.add_argument(
         "--json-log-format", action=argparse.BooleanOptionalAction,

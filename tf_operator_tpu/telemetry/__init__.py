@@ -39,6 +39,7 @@ from .registry import (
     LATENCY_BUCKETS,
     SIZE_BUCKETS,
     STEP_BUCKETS,
+    TTFT_BUCKETS,
     WORKQUEUE_BUCKETS,
     MetricRegistry,
     format_value,
@@ -54,6 +55,14 @@ from .flight import (
     install_crash_handlers,
     render_flightz,
     set_default_flight,
+)
+from .profiler import (
+    ProfileSample,
+    SamplingProfiler,
+    default_profiler,
+    render_profilez,
+    set_default_profiler,
+    write_signal_snapshot,
 )
 from .tracing import Span, SpanTracer, current_span
 
@@ -71,6 +80,12 @@ __all__ = [
     "flight_record",
     "install_crash_handlers",
     "render_flightz",
+    "ProfileSample",
+    "SamplingProfiler",
+    "default_profiler",
+    "set_default_profiler",
+    "render_profilez",
+    "write_signal_snapshot",
     "format_value",
     "histogram_quantile",
     "parse_text",
@@ -80,6 +95,7 @@ __all__ = [
     "ExpositionError",
     "LATENCY_BUCKETS",
     "FAST_BUCKETS",
+    "TTFT_BUCKETS",
     "WORKQUEUE_BUCKETS",
     "SIZE_BUCKETS",
     "STEP_BUCKETS",

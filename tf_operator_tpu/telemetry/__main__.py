@@ -1,4 +1,4 @@
-"""Flight-dump inspector: `python -m tf_operator_tpu.telemetry`.
+"""Flight-dump inspector + profile viewer: `python -m tf_operator_tpu.telemetry`.
 
 Takes one or more JSONL flight dumps (from /debug/flightz, a crash
 dump, or a SIGUSR2 snapshot), merges them into one timeline sorted by
@@ -14,6 +14,20 @@ postmortem loads the flight narrative next to the span tracer's
 
 --trace merges a saved /debug/trace JSON (span events) into the
 Perfetto output, so spans and flight instants share one file.
+
+The `profile` subcommand is the sampling profiler's viewer
+(telemetry/profiler.py): capture from a live /debug/profilez endpoint
+or load a saved snapshot, render top-N self/cumulative tables, write
+folded/speedscope output, and merge the samples with span JSON and
+flight dumps into one Perfetto file:
+
+    python -m tf_operator_tpu.telemetry profile \
+        --url http://127.0.0.1:8443 --seconds 5
+    python -m tf_operator_tpu.telemetry profile \
+        --input profile-usr2-123.json --top 20
+    python -m tf_operator_tpu.telemetry profile --input p.json \
+        --perfetto merged.json --trace debug-trace.json \
+        --flight flight-usr2-123.jsonl
 """
 
 from __future__ import annotations
@@ -24,6 +38,11 @@ import sys
 from typing import List
 
 from .flight import flight_chrome_events
+from .profiler import (
+    profile_chrome_events,
+    speedscope_from_folded,
+    top_table,
+)
 
 
 def load_dump(path: str) -> List[dict]:
@@ -67,7 +86,171 @@ def format_record(rec: dict, multi_source: bool) -> str:
     )
 
 
+def fetch_profile(
+    url: str, seconds: float, hz: int, timeout: float = 120.0
+) -> dict:
+    """GET a to_json() snapshot from a live /debug/profilez endpoint
+    (blocking-captures `seconds` when the profiler isn't running)."""
+    from urllib.request import urlopen
+
+    query = f"action=snapshot&format=json&seconds={seconds}&hz={hz}"
+    full = url.rstrip("/") + "/debug/profilez?" + query
+    with urlopen(full, timeout=max(timeout, seconds + 30.0)) as resp:
+        return json.load(resp)
+
+
+def print_profile_tables(payload: dict, n: int) -> None:
+    folded = payload.get("folded") or {}
+    total = sum(folded.values()) or 1
+    tables = top_table(folded, n=n)
+    print(
+        f"# {payload.get('samples', total)} samples @ "
+        f"{payload.get('hz', '?')} Hz over "
+        f"{payload.get('duration_seconds', 0.0)}s"
+    )
+
+    def emit(title: str, rows) -> None:
+        print(f"# {title}")
+        for name, count in rows:
+            print(f"{count:8d}  {100.0 * count / total:5.1f}%  {name}")
+
+    emit("roles", tables["roles"])
+    emit(f"top {n} self", tables["self"])
+    emit(f"top {n} cumulative", tables["cumulative"])
+
+
+def profile_main(argv) -> int:
+    parser = argparse.ArgumentParser(
+        prog="python -m tf_operator_tpu.telemetry profile",
+        description="Capture/inspect sampling-profiler snapshots.",
+    )
+    source = parser.add_mutually_exclusive_group(required=True)
+    source.add_argument(
+        "--url", help="base URL of a server exposing /debug/profilez "
+        "(operator monitoring port or serve port, both behind "
+        "--enable-debug-endpoints)",
+    )
+    source.add_argument(
+        "--input", help="saved profile JSON (a /debug/profilez "
+        "format=json snapshot or a SIGUSR2 profile-usr2-<pid>.json)",
+    )
+    parser.add_argument(
+        "--seconds", type=float, default=5.0,
+        help="capture window when fetching from --url (blocking "
+        "capture if the remote profiler is stopped)",
+    )
+    parser.add_argument(
+        "--hz", type=int, default=99, help="sampling rate for --url"
+    )
+    parser.add_argument(
+        "--top", type=int, default=15,
+        help="rows in the self/cumulative tables",
+    )
+    parser.add_argument(
+        "--out", metavar="PATH",
+        help="also save the raw profile JSON payload here",
+    )
+    parser.add_argument(
+        "--folded", metavar="PATH",
+        help="write collapsed 'role;stack count' lines here "
+        "(flamegraph.pl / speedscope importable)",
+    )
+    parser.add_argument(
+        "--speedscope", metavar="PATH",
+        help="write speedscope file-format JSON here",
+    )
+    parser.add_argument(
+        "--perfetto", metavar="PATH",
+        help="write Chrome/Perfetto trace-event JSON here (profile "
+        "sample tracks; --trace/--flight merge into the same file)",
+    )
+    parser.add_argument(
+        "--trace", metavar="PATH",
+        help="merge a saved /debug/trace JSON's span events into "
+        "--perfetto",
+    )
+    parser.add_argument(
+        "--flight", metavar="PATH", action="append", default=[],
+        help="merge a flight JSONL dump's instants into --perfetto "
+        "(repeatable; fetch the overlapping window with "
+        "/debug/flightz?since=<the payload's wall_start>)",
+    )
+    parser.add_argument(
+        "--quiet", action="store_true",
+        help="skip the top-N tables (export only)",
+    )
+    args = parser.parse_args(argv)
+
+    try:
+        if args.input:
+            with open(args.input) as f:
+                payload = json.load(f)
+        else:
+            payload = fetch_profile(args.url, args.seconds, args.hz)
+    except (OSError, json.JSONDecodeError) as e:
+        print(f"error: {e}", file=sys.stderr)
+        return 1
+    if not isinstance(payload, dict) or "folded" not in payload:
+        print("error: not a profile payload (no 'folded')", file=sys.stderr)
+        return 1
+
+    if not args.quiet:
+        print_profile_tables(payload, args.top)
+
+    if args.out:
+        with open(args.out, "w") as f:
+            json.dump(payload, f, indent=1)
+        print(f"wrote {args.out}")
+    if args.folded:
+        lines = [
+            f"{stack} {count}"
+            for stack, count in sorted(
+                (payload.get("folded") or {}).items()
+            )
+        ]
+        with open(args.folded, "w") as f:
+            f.write(("\n".join(lines) + "\n") if lines else "")
+        print(f"wrote {args.folded} ({len(lines)} stacks)")
+    if args.speedscope:
+        with open(args.speedscope, "w") as f:
+            json.dump(speedscope_from_folded(payload), f)
+        print(f"wrote {args.speedscope}")
+
+    if args.perfetto:
+        events = profile_chrome_events(payload)
+        if args.trace:
+            try:
+                with open(args.trace) as f:
+                    trace = json.load(f)
+            except (OSError, json.JSONDecodeError) as e:
+                print(
+                    f"error: --trace {args.trace}: {e}", file=sys.stderr
+                )
+                return 1
+            events = list(trace.get("traceEvents", [])) + events
+        for dump_path in args.flight:
+            try:
+                events += flight_chrome_events(load_dump(dump_path))
+            except (OSError, ValueError) as e:
+                print(
+                    f"error: --flight {dump_path}: {e}", file=sys.stderr
+                )
+                return 1
+        with open(args.perfetto, "w") as f:
+            json.dump(
+                {"traceEvents": events, "displayTimeUnit": "ms"}, f
+            )
+        print(f"wrote {args.perfetto} ({len(events)} events)")
+
+    return 0
+
+
 def main(argv=None) -> int:
+    argv = list(sys.argv[1:] if argv is None else argv)
+    if argv and argv[0] == "profile":
+        # subcommand dispatch; the bare form stays the flight-dump
+        # inspector (serve --smoke invokes it with positional dumps)
+        return profile_main(argv[1:])
     parser = argparse.ArgumentParser(
         prog="python -m tf_operator_tpu.telemetry",
         description="Merge and inspect flight-recorder JSONL dumps.",

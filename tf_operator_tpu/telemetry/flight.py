@@ -383,6 +383,20 @@ def install_crash_handlers(
                 except OSError:
                     pass
                 write_dump("usr2")
+                # one signal answers both "what happened" (the dump
+                # above) and "what is it DOING" (a 5s sampled profile).
+                # write_signal_snapshot only spawns a daemon capture
+                # thread — nothing here blocks or takes a lock the
+                # interrupted thread could be holding
+                from .profiler import write_signal_snapshot
+
+                try:
+                    handles.dumps.append(
+                        write_signal_snapshot(directory)
+                    )
+                except Exception:  # noqa: BLE001 — diagnostics must
+                    # never crash the process they observe
+                    pass
 
             prev_handler = signal_mod.signal(signum, on_signal)
 
@@ -409,9 +423,11 @@ def render_flightz(recorder: FlightRecorder, query: str = "") -> bytes:
     """The shared /debug/flightz page: JSONL, one record per line,
     filtered by query-string params — `corr=` / `request=` (alias) on
     the correlation ID, `job=` on job-identifying fields OR the corr,
-    `kind=` on the record kind, `limit=` keeps the newest N. Served by
-    both the operator monitoring server and the serve server so one
-    curl works against either plane."""
+    `kind=` on the record kind, `since=<unix_ts>` keeps records whose
+    wall clock is >= the timestamp (how the telemetry CLI fetches just
+    the window overlapping a profile capture), `limit=` keeps the
+    newest N. Served by both the operator monitoring server and the
+    serve server so one curl works against either plane."""
     from urllib.parse import parse_qs
 
     params = parse_qs(query or "", keep_blank_values=False)
@@ -423,6 +439,13 @@ def render_flightz(recorder: FlightRecorder, query: str = "") -> bytes:
     corr = first("corr") or first("request")
     kind = first("kind")
     job = first("job")
+    since = None
+    raw_since = first("since")
+    if raw_since:
+        try:
+            since = float(raw_since)
+        except ValueError:
+            since = None
     limit = None
     raw_limit = first("limit")
     if raw_limit:
@@ -431,6 +454,8 @@ def render_flightz(recorder: FlightRecorder, query: str = "") -> bytes:
         except ValueError:
             limit = None
     records = recorder.snapshot(kind=kind, corr=corr)
+    if since is not None:
+        records = [r for r in records if r.wall >= since]
     if job is not None:
         records = [
             r for r in records

@@ -41,6 +41,15 @@ FAST_BUCKETS: Tuple[float, ...] = (
     0.0001, 0.00025, 0.0005, 0.001, 0.0025, 0.005, 0.01, 0.025,
     0.05, 0.1, 0.25, 0.5, 1.0,
 )
+# serve-side first-token / prefill-chunk latencies: paged-KV TTFT
+# measured 0.015-0.071s and chunked prefill sits in the low
+# milliseconds (SERVE_BENCH.json), so the classic latency spread
+# quantizes a scraped p95 to whole bucket edges. Sub-millisecond
+# resolution below 1 ms, then ~1.5x steps through the measured band.
+TTFT_BUCKETS: Tuple[float, ...] = (
+    0.0005, 0.001, 0.0025, 0.005, 0.0075, 0.01, 0.015, 0.02, 0.03,
+    0.05, 0.075, 0.1, 0.15, 0.25, 0.5, 1.0, 2.5, 5.0,
+)
 # client-go workqueue convention (queue/work duration): microseconds
 # up to ~10s, the spread the k8s dashboards assume
 WORKQUEUE_BUCKETS: Tuple[float, ...] = (
@@ -294,6 +303,16 @@ class HistogramFamily(_Family):
 
     def cumulative_buckets(self):
         return self._only().cumulative_buckets()
+
+    def labeled_stats(self) -> Dict[Tuple[str, ...], Tuple[float, int]]:
+        """{labelvalues: (sum, count)} snapshot across every child —
+        the aggregation consumers (benchmarks, profile artifacts) need
+        without scraping the exposition text."""
+        with self._lock:
+            return {
+                key: (float(v[1][0]), int(v[1][1]))
+                for key, v in self._values.items()
+            }
 
     def _render_samples(self, full: str, lines: List[str]) -> None:
         with self._lock:
