@@ -24,7 +24,7 @@ IMAGE_DIR := build/images
 DIST      := build/dist
 
 .PHONY: ci presubmit lint analyze native native-test native-race test wire-test e2e e2e-kind bench \
-        chaos-soak serve-soak serve-paged controller-profile images release mnist-acc clean
+        chaos-soak serve-soak serve-paged ha-soak controller-profile images release mnist-acc clean
 
 # `test` already runs the whole tests/ tree (native bindings, wire,
 # E2E suites included) — native-test/wire-test exist for targeted runs,
@@ -50,7 +50,7 @@ presubmit:
 # lint analog; this image ships no pyflakes/ruff, so the checker is
 # vendored in tf_operator_tpu/analysis). The name rules run baseline-
 # free: they must stay at zero, no exceptions accrue.
-LINT_RULES := syntax-error,undefined-name,unused-import,redefinition,mutable-default-arg,bare-except-pass
+LINT_RULES := syntax-error,undefined-name,unused-import,redefinition,mutable-default-arg,bare-except-pass,wall-clock-interval
 lint:
 	$(PY) -m compileall -q tf_operator_tpu tests benchmarks hack bench.py __graft_entry__.py
 	$(PY) hack/graftlint.py --no-baseline --rules $(LINT_RULES) \
@@ -89,6 +89,13 @@ chaos-soak:
 # single-seed fast variant runs in `test` and CI's serve-failover-soak
 serve-soak:
 	env JAX_PLATFORMS=cpu $(PY) -m pytest tests/test_serve_fleet.py -q -m slow
+
+# multi-seed leader-kill chaos soak (docs/ha.md): seeds 0-3, both kill
+# modes, 200-job bursts — duplicate pods / lost jobs / stale-epoch
+# writes / takeover latency all asserted; the single-seed fast variant
+# runs in `test` and CI's ha-failover-soak
+ha-soak:
+	env JAX_PLATFORMS=cpu $(PY) -m pytest tests/test_ha.py -q -m slow
 
 # paged-KV engine smoke (docs/serving.md): small blocks + chunked
 # prefill, shared-prefix and near-max prompts, every chain checked

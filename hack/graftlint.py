@@ -63,6 +63,15 @@ DONATING_CALLABLES = {
     "Trainer:self.step": (0,),
 }
 
+# Modules that time leases, retries, or drains: raw time.time() there
+# is the wall-clock-interval hazard (an NTP step bends the duration —
+# see runtime/leader.py and docs/ha.md). Path fragments, matched
+# against each analyzed file's path.
+WALL_CLOCK_PATHS = (
+    "tf_operator_tpu/runtime/",
+    "tf_operator_tpu/controller/clock.py",
+)
+
 
 def build_configs():
     lock = LockConfig(
@@ -111,7 +120,7 @@ def main(argv=None) -> int:
         lock_config, jax_config = build_configs()
         findings = analysis.run(
             paths, lock_config=lock_config, jax_config=jax_config,
-            rules=rules or None,
+            rules=rules or None, wall_clock_paths=WALL_CLOCK_PATHS,
         )
     except analysis.AnalysisError as err:
         print(f"graftlint: error: {err}", file=sys.stderr)

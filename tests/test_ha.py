@@ -1,0 +1,455 @@
+"""HA control-plane tests (docs/ha.md).
+
+Four tiers:
+
+- `TestLeaderElector` / `TestFencing`: unit tests for the lease
+  elector (epoch monotonicity, takeover, surrender) and the fencing
+  token path (stale writes rejected, audit rows, flight records).
+- `TestRebuildFromRelist`: a fresh "new leader" controller rebuilds
+  from a relist over a converged / under-replicated / orphaned world
+  and performs no spurious creates or deletes.
+- `TestServerWiring`: the operator server entry point in lease mode
+  (elects, fences, reconciles) and in single-replica mode with
+  election disabled.
+- `TestLeaderKillSoak`: the chaos soak — kill the leader mid-burst,
+  assert the five HA invariants. Seed 0 both kill modes in tier-1,
+  seeds 0-3 behind `-m slow` (`make ha-soak`).
+"""
+
+import threading
+import time
+
+import pytest
+
+from tf_operator_tpu.api import k8s, types as t
+from tf_operator_tpu.controller import TFJobController
+from tf_operator_tpu.controller.ha import (
+    KILL_MODES,
+    OperatorReplica,
+    _make_job,
+    run_ha_soak,
+)
+from tf_operator_tpu.runtime import InMemorySubstrate
+from tf_operator_tpu.runtime.leader import FencedSubstrate, LeaderElector
+from tf_operator_tpu.runtime.substrate import FencedWrite
+from tf_operator_tpu.telemetry.flight import (
+    FlightRecorder,
+    default_flight,
+    render_flightz,
+    set_default_flight,
+)
+
+NS = "default"
+
+
+def wait_until(predicate, timeout=10.0, interval=0.01):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if predicate():
+            return True
+        time.sleep(interval)
+    return bool(predicate())
+
+
+@pytest.fixture
+def substrate():
+    return InMemorySubstrate()
+
+
+@pytest.fixture
+def kubelet(substrate):
+    """Background pod-lifecycle driver, like the chaos suite's."""
+    stop = threading.Event()
+
+    def loop():
+        while not stop.is_set():
+            substrate.run_all_pending()
+            time.sleep(0.01)
+
+    thread = threading.Thread(target=loop, daemon=True)
+    thread.start()
+    yield substrate
+    stop.set()
+    thread.join(timeout=2)
+
+
+def make_elector(substrate, identity, ttl=0.5, **kwargs):
+    return LeaderElector(
+        substrate, identity=identity, lease_duration=ttl, **kwargs
+    )
+
+
+class TestLeaderElector:
+    def test_single_elector_acquires_epoch_one(self, substrate):
+        elector = make_elector(substrate, "a").start()
+        try:
+            assert elector.wait_for_leadership(5.0)
+            assert elector.is_leader
+            assert elector.epoch == 1
+        finally:
+            elector.stop()
+        # graceful stop surrenders the lease for the next holder
+        lease = substrate.get_lease("kube-system", "tfjob-tpu-operator")
+        assert lease is not None and lease.holder == ""
+        assert not elector.is_leader
+
+    def test_exactly_one_of_two_leads(self, substrate):
+        a = make_elector(substrate, "a").start()
+        b = make_elector(substrate, "b").start()
+        try:
+            assert wait_until(lambda: a.is_leader or b.is_leader, 5.0)
+            # steady state: never both, across several renew periods
+            for _ in range(10):
+                assert not (a.is_leader and b.is_leader)
+                time.sleep(0.05)
+            assert a.is_leader != b.is_leader
+        finally:
+            a.stop()
+            b.stop()
+
+    def test_kill_hands_over_within_two_ttl_with_next_epoch(self, substrate):
+        ttl = 0.5
+        a = make_elector(substrate, "a", ttl=ttl).start()
+        b = make_elector(substrate, "b", ttl=ttl).start()
+        try:
+            assert wait_until(lambda: a.is_leader or b.is_leader, 5.0)
+            leader, follower = (a, b) if a.is_leader else (b, a)
+            first_epoch = leader.epoch
+            leader.kill()
+            started = time.monotonic()
+            assert follower.wait_for_leadership(4 * ttl), "no takeover"
+            takeover = time.monotonic() - started
+            assert takeover < 2 * ttl, f"takeover {takeover:.2f}s > 2x TTL"
+            assert follower.epoch == first_epoch + 1
+            # the corpse still believes nothing: is_leader frozen, and
+            # its stale epoch is now below the fence
+            assert leader.epoch == first_epoch
+        finally:
+            a.stop()
+            b.stop()
+
+    def test_killed_elector_does_not_release_lease(self, substrate):
+        elector = make_elector(substrate, "a").start()
+        assert elector.wait_for_leadership(5.0)
+        elector.kill()
+        elector.stop()
+        # a dead process releases nothing: takeover must come from
+        # expiry, not from a polite handoff the corpse cannot perform
+        lease = substrate.get_lease("kube-system", "tfjob-tpu-operator")
+        assert lease is not None and lease.holder == "a"
+
+
+class _StubElector:
+    """Duck-typed leadership for fencing unit tests."""
+
+    def __init__(self, identity, epoch, is_leader=True):
+        self.identity = identity
+        self.epoch = epoch
+        self.is_leader = is_leader
+
+
+def _get_pod(substrate, name):
+    try:
+        return substrate.get_pod(NS, name)
+    except KeyError:
+        return None
+
+
+def _bare_pod(name):
+    return k8s.Pod(
+        metadata=k8s.ObjectMeta(name=name, namespace=NS),
+        spec=k8s.PodSpec(
+            containers=[k8s.Container(name="tensorflow", image="i")]
+        ),
+    )
+
+
+class TestFencing:
+    def _advance_fence_to(self, substrate, epoch):
+        substrate.create_lease(
+            k8s.Lease(namespace="kube-system", holder="x", epoch=epoch)
+        )
+
+    def test_stale_token_rejected_and_audited(self, substrate):
+        self._advance_fence_to(substrate, 2)
+        stale = FencedSubstrate(substrate, _StubElector("old", epoch=1))
+        with pytest.raises(FencedWrite) as exc:
+            stale.create_pod(_bare_pod("p0"))
+        assert exc.value.op == "create-pod"
+        assert exc.value.token == 1
+        assert exc.value.fence == 2
+        assert substrate.fence_rejections, "rejection not audited"
+        row = substrate.fence_rejections[-1]
+        assert (row.op, row.token, row.fence) == ("create-pod", 1, 2)
+        assert _get_pod(substrate, "p0") is None
+
+    def test_current_token_accepted(self, substrate):
+        self._advance_fence_to(substrate, 2)
+        fresh = FencedSubstrate(substrate, _StubElector("new", epoch=2))
+        fresh.create_pod(_bare_pod("p1"))
+        assert _get_pod(substrate, "p1") is not None
+        assert ("create-pod", 2, 2) in substrate.fenced_writes_accepted
+
+    def test_unfenced_writer_passes(self, substrate):
+        # single-replica mode: no elector, no token, every write passes
+        self._advance_fence_to(substrate, 5)
+        substrate.create_pod(_bare_pod("p2"))
+        assert _get_pod(substrate, "p2") is not None
+
+    def test_reads_pass_through_unfenced(self, substrate):
+        self._advance_fence_to(substrate, 2)
+        stale = FencedSubstrate(substrate, _StubElector("old", epoch=1))
+        # a deposed leader may still read (to discover it was deposed)
+        assert stale.list_pods(NS) == []
+        assert stale.get_lease("kube-system", "tfjob-tpu-operator") is not None
+
+    def test_rejection_flight_recorded_with_epoch(self, substrate):
+        prior = set_default_flight(FlightRecorder(capacity=1024))
+        try:
+            self._advance_fence_to(substrate, 3)
+            stale = FencedSubstrate(substrate, _StubElector("old", epoch=2))
+            with pytest.raises(FencedWrite):
+                stale.create_pod(_bare_pod("p3"))
+            records = default_flight().snapshot(kind="leader")
+            rejected = [
+                r for r in records
+                if r.fields.get("event") == "fenced-write-rejected"
+            ]
+            assert rejected, "no fenced-write-rejected flight record"
+            rec = rejected[-1]
+            assert rec.fields["epoch"] == 2
+            assert rec.fields["fence"] == 3
+            assert rec.fields["op"] == "create-pod"
+            assert rec.corr.startswith("leader:")
+        finally:
+            set_default_flight(prior)
+
+    def test_flightz_kind_leader_filter(self, substrate):
+        """/debug/flightz?kind=leader shows only leadership records."""
+        prior = set_default_flight(FlightRecorder(capacity=4096))
+        try:
+            elector = make_elector(substrate, "flt").start()
+            try:
+                assert elector.wait_for_leadership(5.0)
+            finally:
+                elector.stop()
+            body = render_flightz(default_flight(), "kind=leader")
+            text = body.decode() if isinstance(body, bytes) else body
+            lines = [ln for ln in text.splitlines() if '"kind"' in ln]
+            assert lines, "flightz kind=leader returned no records"
+            assert all('"kind": "leader"' in ln for ln in lines)
+            assert any('"event": "acquired"' in ln for ln in lines)
+        finally:
+            set_default_flight(prior)
+
+
+class _CountingSubstrate:
+    """Counts child mutations so rebuild tests can assert 'no spurious
+    creates/deletes' exactly, not just final-state equality."""
+
+    def __init__(self, inner):
+        self._inner = inner
+        self.pod_creates = 0
+        self.pod_deletes = 0
+        self.service_creates = 0
+        self.service_deletes = 0
+
+    def create_pod(self, pod):
+        self.pod_creates += 1
+        return self._inner.create_pod(pod)
+
+    def delete_pod(self, namespace, name):
+        self.pod_deletes += 1
+        return self._inner.delete_pod(namespace, name)
+
+    def create_service(self, service):
+        self.service_creates += 1
+        return self._inner.create_service(service)
+
+    def delete_service(self, namespace, name):
+        self.service_deletes += 1
+        return self._inner.delete_service(namespace, name)
+
+    def __getattr__(self, name):
+        return getattr(self._inner, name)
+
+
+def _converge_first_leader(substrate, workers=2, name="re-job"):
+    """Run a first-term controller until the job is Running, then stop
+    it — the world a new leader inherits."""
+    job = _make_job(name, NS, workers)
+    substrate.create_job(job)
+    first = TFJobController(substrate, namespace=NS)
+    first.run(threadiness=1, resync_period=0.2)
+    try:
+        assert wait_until(
+            lambda: (
+                (substrate.get_job(NS, name) or job).has_condition(
+                    t.ConditionType.RUNNING
+                )
+            ),
+            15.0,
+        ), "first leader never converged the job"
+    finally:
+        first.stop()
+    return substrate.get_job(NS, name)
+
+
+def _drain_new_leader(counting):
+    """A takeover, synchronously: rebuild from relist, then drain the
+    re-primed queue in this thread until it stays empty."""
+    controller = TFJobController(counting, namespace=NS)
+    try:
+        controller.rebuild_from_relist()
+        idle = 0
+        while idle < 3:
+            idle = 0 if controller.process_next(timeout=0.05) else idle + 1
+    finally:
+        controller.stop()
+    return controller
+
+
+class TestRebuildFromRelist:
+    def test_satisfied_job_untouched(self, kubelet):
+        substrate = kubelet
+        _converge_first_leader(substrate, workers=2)
+        before = sorted(p.metadata.name for p in substrate.list_pods(NS))
+        counting = _CountingSubstrate(substrate)
+        _drain_new_leader(counting)
+        assert counting.pod_creates == 0
+        assert counting.pod_deletes == 0
+        assert counting.service_deletes == 0
+        after = sorted(p.metadata.name for p in substrate.list_pods(NS))
+        assert after == before
+
+    def test_under_replicated_creates_only_missing(self, kubelet):
+        substrate = kubelet
+        job = _converge_first_leader(substrate, workers=3)
+        victim = t.replica_name(job.name, "worker", 1)
+        substrate.delete_pod(NS, victim)
+        assert wait_until(
+            lambda: _get_pod(substrate, victim) is None, 5.0
+        )
+        counting = _CountingSubstrate(substrate)
+        _drain_new_leader(counting)
+        assert counting.pod_creates == 1, "must create exactly the gap"
+        assert counting.pod_deletes == 0
+        recreated = _get_pod(substrate, victim)
+        assert recreated is not None
+        names = [p.metadata.name for p in substrate.list_pods(NS)]
+        assert len(names) == len(set(names)) == 3
+
+    def test_orphan_adopted_not_duplicated(self, kubelet):
+        substrate = kubelet
+        job = _converge_first_leader(substrate, workers=2)
+        orphan = t.replica_name(job.name, "worker", 0)
+        substrate.patch_pod_owner_references(NS, orphan, [])
+        assert not substrate.get_pod(NS, orphan).metadata.owner_references
+        counting = _CountingSubstrate(substrate)
+        _drain_new_leader(counting)
+        assert counting.pod_creates == 0, "orphan must be adopted, not doubled"
+        assert counting.pod_deletes == 0
+        adopted = substrate.get_pod(NS, orphan)
+        assert adopted.metadata.owner_references, "orphan not re-adopted"
+        assert adopted.metadata.owner_references[0].name == job.name
+
+
+class TestServerWiring:
+    def _run_server(self, argv, substrate):
+        from tf_operator_tpu.server.options import parse_args
+        from tf_operator_tpu.server.server import OperatorServer
+
+        server = OperatorServer(parse_args(argv), substrate=substrate)
+        thread = threading.Thread(target=server.run, daemon=True)
+        thread.start()
+        return server, thread
+
+    def _assert_reconciles(self, substrate, name):
+        substrate.create_job(_make_job(name, NS, 1))
+        assert wait_until(
+            lambda: (
+                (job := substrate.get_job(NS, name)) is not None
+                and job.has_condition(t.ConditionType.RUNNING)
+            ),
+            15.0,
+        ), f"{name} never reached Running"
+
+    def test_lease_mode_elects_and_reconciles(self, kubelet):
+        substrate = kubelet
+        server, thread = self._run_server(
+            [
+                "--substrate", "memory", "--enable-leader-election",
+                "--leader-lock", "lease", "--monitoring-port", "0",
+            ],
+            substrate,
+        )
+        try:
+            assert server._lease_elector is not None
+            assert server._lease_elector.wait_for_leadership(5.0)
+            self._assert_reconciles(substrate, "srv-lease-job")
+            # the controller's writes went through the fence
+            assert any(
+                token == server._lease_elector.epoch
+                for _op, token, _fence in substrate.fenced_writes_accepted
+                if token is not None
+            )
+        finally:
+            server.shutdown()
+            thread.join(timeout=5)
+        assert not thread.is_alive()
+
+    def test_single_replica_no_election(self, kubelet):
+        substrate = kubelet
+        server, thread = self._run_server(
+            [
+                "--substrate", "memory", "--no-enable-leader-election",
+                "--monitoring-port", "0",
+            ],
+            substrate,
+        )
+        try:
+            assert server._lease_elector is None
+            self._assert_reconciles(substrate, "srv-solo-job")
+        finally:
+            server.shutdown()
+            thread.join(timeout=5)
+        assert not thread.is_alive()
+
+
+def _assert_soak_clean(result):
+    assert result["violations"] == [], (
+        f"HA soak violated invariants: {result}"
+    )
+    assert result["jobs_running"] == result["jobs"]
+    assert result["stale_writes_accepted"] == 0
+    assert result["jobs_with_duplicate_or_missing_pods"] == 0
+    assert result["takeover_seconds"] < 2 * result["lease_duration"]
+
+
+class TestLeaderKillSoak:
+    """Kill the leader mid-200-job burst; the five invariants hold."""
+
+    @pytest.mark.parametrize("kill_mode", KILL_MODES)
+    def test_fast_seed(self, kill_mode):
+        _assert_soak_clean(run_ha_soak(seed=0, kill_mode=kill_mode))
+
+    def test_sigkill_zombie_is_fenced(self):
+        result = run_ha_soak(seed=1, kill_mode="sigkill")
+        _assert_soak_clean(result)
+        # the zombie kept writing with its stale epoch; every attempt
+        # must have bounced — a zero here means the fence went untested
+        assert result["stale_writes_rejected"] > 0
+
+    @pytest.mark.slow
+    @pytest.mark.parametrize("kill_mode", KILL_MODES)
+    @pytest.mark.parametrize("seed", [0, 1, 2, 3])
+    def test_multi_seed_soak(self, seed, kill_mode):
+        _assert_soak_clean(run_ha_soak(seed=seed, kill_mode=kill_mode))
+
+
+class TestOperatorReplicaUnit:
+    def test_kill_rejects_unknown_mode(self, substrate):
+        replica = OperatorReplica(substrate, identity="u")
+        with pytest.raises(ValueError):
+            replica.kill("sigterm")
+        replica.stop()

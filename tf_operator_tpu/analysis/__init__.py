@@ -49,6 +49,7 @@ ALL_RULES = (
     "redefinition",
     "mutable-default-arg",
     "bare-except-pass",
+    "wall-clock-interval",
     # parse failures
     "syntax-error",
 )
@@ -59,6 +60,7 @@ def run(
     lock_config: Optional[LockConfig] = None,
     jax_config: Optional[JaxConfig] = None,
     rules: Optional[Sequence[str]] = None,
+    wall_clock_paths: Sequence[str] = (),
 ) -> List[Finding]:
     """Parse every .py under `paths` once and run all passes.
 
@@ -73,7 +75,9 @@ def run(
     modules, findings = load_paths(paths)
     findings.extend(run_lock_pass(modules, lock_config or LockConfig()))
     findings.extend(run_jax_pass(modules, jax_config or JaxConfig()))
-    findings.extend(run_names_pass(modules))
+    findings.extend(
+        run_names_pass(modules, wall_clock_paths=wall_clock_paths)
+    )
     if rules:
         keep = set(rules) | {"syntax-error"}
         findings = [f for f in findings if f.rule in keep]

@@ -18,6 +18,13 @@ Rules:
   aliasing bug.
 - ``bare-except-pass`` — `except: pass` silently eats KeyboardInterrupt
   and real faults alike.
+- ``wall-clock-interval`` — a raw ``time.time()`` call in a module that
+  times leases, retries, or drains (the path set is configured by the
+  caller; hack/graftlint.py scopes it to ``tf_operator_tpu/runtime/``
+  and ``controller/clock.py``). Durations must come from the monotonic
+  clock: an NTP step over a wall-clock interval can expire a healthy
+  lease or keep a dead one alive (docs/ha.md). Wall time is for values
+  that leave the process, not for measuring.
 
 Suppression: the historical `# noqa` comment (kept so existing
 annotations keep working) or `# graftlint: disable=<rule>`.
@@ -420,11 +427,52 @@ class _NameChecker(ast.NodeVisitor):
         return out
 
 
-def check_module(module: SourceFile) -> List[Finding]:
+def _check_wall_clock(checker: _NameChecker) -> None:
+    """Flag raw wall-clock reads in interval-timing modules: both the
+    ``time.time()`` attribute form and a bare ``time()`` bound by
+    ``from time import time``. Aliased imports (``import time as t``)
+    are followed; anything cleverer (getattr, indirection) is out of
+    conservative-lint scope."""
+    time_modules = {"time"}  # names bound to the time module
+    time_funcs = set()  # names bound to time.time itself
+    for node in ast.walk(checker.tree):
+        if isinstance(node, ast.Import):
+            for alias in node.names:
+                if alias.name == "time":
+                    time_modules.add(alias.asname or "time")
+        elif isinstance(node, ast.ImportFrom) and node.module == "time":
+            for alias in node.names:
+                if alias.name == "time":
+                    time_funcs.add(alias.asname or "time")
+    for node in ast.walk(checker.tree):
+        if not isinstance(node, ast.Call):
+            continue
+        func = node.func
+        hit = (
+            isinstance(func, ast.Attribute)
+            and func.attr == "time"
+            and isinstance(func.value, ast.Name)
+            and func.value.id in time_modules
+        ) or (
+            isinstance(func, ast.Name) and func.id in time_funcs
+        )
+        if hit:
+            checker._note(
+                node.lineno, "wall-clock-interval",
+                "wall-clock time.time() in an interval-timing module — "
+                "leases/retries/drains must use time.monotonic() (or the "
+                "Clock.monotonic seam) so an NTP step can't bend a "
+                "duration",
+            )
+
+
+def check_module(module: SourceFile, wall_clock: bool = False) -> List[Finding]:
     checker = _NameChecker(module)
     for stmt in module.tree.body:
         checker.visit(stmt)
     checker._check_redefinitions(module.tree.body)
+    if wall_clock:
+        _check_wall_clock(checker)
     rows = list(checker.findings)
     if os.path.basename(module.path) != "__init__.py":
         checker.collect_imports()
@@ -435,8 +483,17 @@ def check_module(module: SourceFile) -> List[Finding]:
     ]
 
 
-def run_names_pass(modules: Sequence[SourceFile]) -> List[Finding]:
+def run_names_pass(
+    modules: Sequence[SourceFile],
+    wall_clock_paths: Sequence[str] = (),
+) -> List[Finding]:
+    """`wall_clock_paths` are path fragments (compared against the
+    module path with / separators); matching modules also get the
+    wall-clock-interval check."""
+    fragments = [p.replace(os.sep, "/") for p in wall_clock_paths]
     findings: List[Finding] = []
     for module in modules:
-        findings.extend(check_module(module))
+        path = module.path.replace(os.sep, "/")
+        wall_clock = any(fragment in path for fragment in fragments)
+        findings.extend(check_module(module, wall_clock=wall_clock))
     return findings

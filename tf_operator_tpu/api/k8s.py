@@ -9,7 +9,7 @@ reference's usage sites, cited per class.
 
 from __future__ import annotations
 
-from dataclasses import dataclass, field
+from dataclasses import dataclass, field, replace
 from typing import Any, Dict, List, Optional
 
 # Pod phases (k8s core/v1 PodPhase) — consumed by the status machine,
@@ -206,6 +206,48 @@ class Event:
     extra: Dict[str, Any] = field(default_factory=dict)
 
 
+# single source for the default lease duration (reference server.go:53);
+# leader election and kube.py must not restate the number
+DEFAULT_LEASE_DURATION = 15.0
+
+
+@dataclass
+class Lease:
+    """Coordination lease record (k8s coordination.k8s.io/v1 Lease
+    shape, reduced to the fields leader election uses). Stored by
+    substrates; consumed by server.leader.LeaseLock and
+    runtime.leader.LeaderElector.
+
+    ``epoch`` is the fencing token (carried as leaseTransitions on the
+    wire): it increments every time leadership changes hands, and
+    substrates reject writes stamped with an older epoch — a
+    paused-then-resumed old leader cannot double-create children or
+    clobber status (docs/ha.md).
+
+    acquire_time/renew_time are CHANGE MARKERS, not cross-process
+    timestamps: followers judge expiry by how long the record sits
+    unchanged on their OWN monotonic clock (clock-skew safety), so the
+    values themselves are opaque.
+    """
+
+    namespace: str = "default"
+    name: str = "tfjob-tpu-operator"
+    holder: str = ""
+    acquire_time: float = 0.0
+    renew_time: float = 0.0
+    lease_duration_seconds: float = DEFAULT_LEASE_DURATION
+    resource_version: str = ""
+    epoch: int = 0
+
+    # NOTE: deliberately no expired(now) helper — judging expiry by
+    # comparing a local clock against the holder's written renewTime is
+    # skew-unsafe; the lock tracks locally-observed change instead
+    # (see runtime/leader.py and test_clock_skew_does_not_steal_healthy_lease).
+
+    def copy(self) -> "Lease":
+        return replace(self)
+
+
 def pod_main_exit_code(pod: Pod, container_name: str) -> Optional[int]:
     """Exit code of the job container, if it has terminated.
 
@@ -243,5 +285,7 @@ __all__ = [
     "ServiceSpec",
     "Service",
     "Event",
+    "DEFAULT_LEASE_DURATION",
+    "Lease",
     "pod_main_exit_code",
 ]

@@ -1,10 +1,24 @@
 """Injectable clock so the policy machine (deadlines, TTL, backoff) is
 deterministic under test — the role metav1.Now() plays in the reference,
-made a seam instead of a global."""
+made a seam instead of a global.
+
+Two faces, deliberately separate (docs/ha.md):
+
+- ``now()``/``now_iso()`` — WALL time, for values that leave the
+  process (condition timestamps, event times). Comparable across
+  machines, but steppable by NTP.
+- ``monotonic()`` — INTERVAL time, for anything that measures a
+  duration locally: lease expiry, retry backoff, drain deadlines. A
+  wall-clock step must never expire a healthy lease or extend a dead
+  one, so durations in runtime/ and the controllers go through this
+  face (enforced by graftlint's wall-clock-interval rule, which flags
+  raw ``time.time()`` in those modules).
+"""
 
 from __future__ import annotations
 
 import datetime
+import time
 
 
 def parse_iso(ts: str) -> datetime.datetime:
@@ -21,15 +35,24 @@ class Clock:
     def seconds_since(self, ts: str) -> float:
         return (self.now() - parse_iso(ts)).total_seconds()
 
+    def monotonic(self) -> float:
+        return time.monotonic()
+
 
 class FakeClock(Clock):
-    """Starts at a fixed instant; advances only when told."""
+    """Starts at a fixed instant; advances only when told. Both faces
+    advance together so tests stay oblivious to which one code reads."""
 
     def __init__(self, start: str = "2026-01-01T00:00:00Z") -> None:
         self._now = parse_iso(start)
+        self._mono = 0.0
 
     def now(self) -> datetime.datetime:
         return self._now
 
+    def monotonic(self) -> float:
+        return self._mono
+
     def advance(self, seconds: float) -> None:
         self._now += datetime.timedelta(seconds=seconds)
+        self._mono += seconds

@@ -15,7 +15,13 @@ from typing import List, Optional
 
 from ..api import k8s, set_defaults, validate
 from ..api.serde import to_jsonable
-from ..api.types import LABEL_JOB_NAME, ConditionType, TFJob, gen_labels
+from ..api.types import (
+    LABEL_JOB_NAME,
+    LABEL_REPLICA_TYPE,
+    ConditionType,
+    TFJob,
+    gen_labels,
+)
 from ..api.validation import ValidationError
 from .ports import PortRangeExhausted
 from ..utils.logger import logger_for_job
@@ -69,12 +75,20 @@ class TFJobController:
         gang=None,
         port_allocator=None,
         degraded: Optional[DegradedLatch] = None,
+        leadership=None,
     ) -> None:
         self.substrate = substrate
         self.clock = clock or Clock()
         self.namespace = namespace
         self.metrics = metrics
         self.port_allocator = port_allocator
+        # HA gate (docs/ha.md): anything exposing `is_leader` (property
+        # or nullary method — runtime.leader.LeaderElector is the
+        # intended one). None = single-replica mode, always "leading".
+        # Followers drop informer events and park their workers; the
+        # takeover rebuild (rebuild_from_relist) replays what they
+        # ignored, and write fencing covers the gate's inherent race.
+        self._leadership = leadership
         # circuit-breaker against a failing apiserver: consecutive
         # transient substrate errors latch it; while latched, sync
         # degrades to a read-only probe (no pod churn)
@@ -136,6 +150,12 @@ class TFJobController:
 
     # -- event handlers (the informer side) --------------------------------
 
+    def _is_leading(self) -> bool:
+        if self._leadership is None:
+            return True
+        flag = getattr(self._leadership, "is_leader", True)
+        return bool(flag() if callable(flag) else flag)
+
     def _in_scope(self, namespace: str) -> bool:
         return self.namespace is None or namespace == self.namespace
 
@@ -146,6 +166,10 @@ class TFJobController:
         on InMemorySubstrate that would poison the mutator that
         emitted the event. Isolate, count, and requeue the key so the
         level-triggered sync replays whatever the handler missed."""
+        if not self._is_leading():
+            # follower: stay subscribed (cheap) but act on nothing; the
+            # takeover rebuild relists instead of replaying this gap
+            return
         try:
             handler(verb, obj)
         except Exception:
@@ -569,6 +593,8 @@ class TFJobController:
         existed before this controller subscribed (informer initial list
         + resync in the reference, server.go:119-133 / options.go:24).
         Jobs that never went through admission get admitted now."""
+        if not self._is_leading():
+            return
         jobs = self.substrate.list_jobs(self.namespace)
         if self.port_allocator is not None:
             if not self._ports_synced:
@@ -596,6 +622,11 @@ class TFJobController:
                 self.enqueue(job.key())
 
     def process_next(self, timeout: Optional[float] = None) -> bool:
+        if not self._is_leading():
+            # park, don't drain: keys queued while following must still
+            # be there when (if) this replica is promoted
+            self._stop.wait(min(timeout if timeout is not None else 0.2, 0.2))
+            return False
         key = self.queue.get(timeout=timeout)
         if key is None:
             return False
@@ -675,3 +706,68 @@ class TFJobController:
         self.queue.shut_down()
         for worker in self._workers:
             worker.join(timeout=2)
+        # detach from the watch fan-out: a stopped controller must not
+        # keep running handlers in other replicas' mutator threads
+        for kind, handler in (
+            ("tfjob", self._on_job),
+            ("pod", self._on_pod),
+            ("service", self._on_service),
+        ):
+            try:
+                self.substrate.unsubscribe(kind, handler)
+            except Exception:  # pragma: no cover — already detached
+                pass
+
+    # -- leadership takeover -----------------------------------------------
+
+    def rebuild_from_relist(self) -> None:
+        """Crash-recovery rebuild on leadership takeover (docs/ha.md).
+
+        Everything this replica accumulated while following — or while
+        leading a previous term — describes a world some OTHER process
+        has since been mutating: expectations count watch events it
+        never saw, the degraded latch reflects an outage that may have
+        ended, per-episode marker sets pin conditions that were since
+        rewritten. Trusting any of it risks exactly the double-create /
+        stale-status failures HA exists to prevent. So the new leader
+        relists, clears expectations across the relist-derived key
+        universe (jobs × replica types PLUS labeled children, so
+        orphans whose owner vanished are covered), resets the degraded
+        latch and its once-per-episode marker, and re-primes the
+        workqueue through resync() — the level-triggered syncs then
+        recompute all state from observation."""
+        namespace = self.namespace
+        jobs = self.substrate.list_jobs(namespace)
+        pods = self.substrate.list_pods(namespace)
+        keys: set = set()
+        namespaces: set = set()
+        for job in jobs:
+            namespaces.add(job.namespace)
+            for rtype in job.replica_types():
+                rt = rtype.value.lower()
+                keys.add(expectation_pods_key(job.key(), rt))
+                keys.add(expectation_services_key(job.key(), rt))
+        for pod in pods:
+            namespaces.add(pod.metadata.namespace)
+            owner_name = pod.metadata.labels.get(LABEL_JOB_NAME)
+            if owner_name:
+                owner_key = f"{pod.metadata.namespace}/{owner_name}"
+                rt = pod.metadata.labels.get(LABEL_REPLICA_TYPE, "")
+                keys.add(expectation_pods_key(owner_key, rt))
+        for ns in sorted(namespaces):
+            for svc in self.substrate.list_services(ns):
+                owner_name = svc.metadata.labels.get(LABEL_JOB_NAME)
+                if owner_name:
+                    owner_key = f"{svc.metadata.namespace}/{owner_name}"
+                    rt = svc.metadata.labels.get(LABEL_REPLICA_TYPE, "")
+                    keys.add(expectation_services_key(owner_key, rt))
+        self.expectations.rebuild_from_observed(keys)
+        self.degraded.reset()
+        self._degraded_marked.clear()
+        self._port_wait.clear()
+        epoch = getattr(self._leadership, "epoch", 0) if self._leadership else 0
+        flight_record(
+            "leader", event="rebuild", controller="tfjob", epoch=epoch,
+            jobs=len(jobs), pods=len(pods), keys=len(keys),
+        )
+        self.resync()

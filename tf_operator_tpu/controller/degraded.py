@@ -73,6 +73,22 @@ class DegradedLatch:
             logger.info("degraded mode cleared: substrate healthy again")
             self._notify(False)
 
+    def reset(self) -> None:
+        """Takeover rebuild (docs/ha.md): a new leader recomputes
+        degraded state instead of trusting it — the errors that tripped
+        this latch were seen by a replica whose term is over, possibly
+        against an apiserver that recovered while nobody was leading.
+        Drops both streaks and unlatches; if the outage is real, the
+        first syncs of the new term re-trip it within error_threshold."""
+        with self._lock:
+            clear = self._degraded
+            self._errors = 0
+            self._successes = 0
+            self._degraded = False
+        if clear:
+            logger.info("degraded latch reset on leadership takeover")
+            self._notify(False)
+
     def _notify(self, degraded: bool) -> None:
         if self.metrics is not None:
             self.metrics.set_degraded(degraded)

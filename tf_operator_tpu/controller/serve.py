@@ -318,8 +318,13 @@ class ServeServiceController:
         weight_update: Optional[
             Callable[[ServeService, List[k8s.Pod]], List[str]]
         ] = None,
+        leadership=None,
     ) -> None:
         self.substrate = substrate
+        # HA gate, same contract as TFJobController: None means
+        # single-replica (always leading); otherwise followers drop
+        # events and park workers until promoted (docs/ha.md)
+        self._leadership = leadership
         self.clock = clock or Clock()
         self.namespace = namespace
         self.metrics = metrics
@@ -356,6 +361,12 @@ class ServeServiceController:
 
     # -- event handlers ----------------------------------------------------
 
+    def _is_leading(self) -> bool:
+        if self._leadership is None:
+            return True
+        flag = getattr(self._leadership, "is_leader", True)
+        return bool(flag() if callable(flag) else flag)
+
     def _in_scope(self, namespace: str) -> bool:
         return self.namespace is None or namespace == self.namespace
 
@@ -363,6 +374,8 @@ class ServeServiceController:
         """HandleCrash analog (see TFJobController._guard_handler): an
         informer-callback exception must never poison the substrate's
         watch dispatcher; isolate and requeue."""
+        if not self._is_leading():
+            return  # follower: the takeover rebuild relists this gap
         try:
             handler(verb, obj)
         except Exception:
@@ -573,6 +586,8 @@ class ServeServiceController:
     # -- run loops ---------------------------------------------------------
 
     def resync(self) -> None:
+        if not self._is_leading():
+            return
         for svc in self.substrate.list_serve_services(self.namespace):
             if not svc.status.conditions:
                 self._admit(svc)
@@ -580,6 +595,10 @@ class ServeServiceController:
                 self.enqueue(svc.key())
 
     def process_next(self, timeout: Optional[float] = None) -> bool:
+        if not self._is_leading():
+            # park, don't drain (TFJobController.process_next's twin)
+            self._stop.wait(min(timeout if timeout is not None else 0.2, 0.2))
+            return False
         key = self.queue.get(timeout=timeout)
         if key is None:
             return False
@@ -638,3 +657,38 @@ class ServeServiceController:
         self.queue.shut_down()
         for worker in self._workers:
             worker.join(timeout=2)
+        for kind, handler in (
+            ("serveservice", self._on_serve_service),
+            ("pod", self._on_pod),
+        ):
+            try:
+                self.substrate.unsubscribe(kind, handler)
+            except Exception:  # pragma: no cover — already detached
+                pass
+
+    # -- leadership takeover -----------------------------------------------
+
+    def rebuild_from_relist(self) -> None:
+        """Takeover rebuild, TFJobController.rebuild_from_relist's twin:
+        clear expectations over the relist-derived key universe
+        (services plus labeled serve pods, so orphans count) and
+        re-prime the queue via resync()."""
+        namespace = self.namespace
+        services = self.substrate.list_serve_services(namespace)
+        pods = self.substrate.list_pods(namespace)
+        keys = {
+            expectation_pods_key(svc.key(), SERVE_REPLICA_TYPE)
+            for svc in services
+        }
+        for pod in pods:
+            owner_name = pod.metadata.labels.get(LABEL_SERVE_NAME)
+            if owner_name:
+                owner_key = f"{pod.metadata.namespace}/{owner_name}"
+                keys.add(expectation_pods_key(owner_key, SERVE_REPLICA_TYPE))
+        self.expectations.rebuild_from_observed(keys)
+        epoch = getattr(self._leadership, "epoch", 0) if self._leadership else 0
+        flight_record(
+            "leader", event="rebuild", controller="serveservice",
+            epoch=epoch, services=len(services), keys=len(keys),
+        )
+        self.resync()

@@ -8,6 +8,7 @@ from .control import (
 )
 from .events import EventRecorder, NullRecorder
 from .expectations import ControllerExpectations
+from .leader import FencedSubstrate, LeaderElector
 from .retry import (
     RetryingSubstrate,
     RetryPolicy,
@@ -20,6 +21,7 @@ from .substrate import (
     MODIFIED,
     AlreadyExists,
     Conflict,
+    FencedWrite,
     InMemorySubstrate,
     NotFound,
     Substrate,
@@ -34,12 +36,15 @@ __all__ = [
     "DELETED",
     "AlreadyExists",
     "Conflict",
+    "FencedWrite",
     "NotFound",
     "Substrate",
     "InMemorySubstrate",
     "match_labels",
     "now_iso",
     "ControllerExpectations",
+    "FencedSubstrate",
+    "LeaderElector",
     "RetryPolicy",
     "RetryingSubstrate",
     "call_with_retries",

@@ -12,7 +12,7 @@ SURVEY.md §7 ranks this the #2 hard part.
 from __future__ import annotations
 
 import time
-from typing import Dict, Tuple
+from typing import Dict, Iterable, Tuple
 
 from ..utils import locks
 
@@ -77,3 +77,22 @@ class ControllerExpectations:
     def delete_expectations(self, key: str) -> None:
         with self._lock:
             self._store.pop(key, None)
+
+    def rebuild_from_observed(self, keys: Iterable[str]) -> None:
+        """Crash-recovery reset (docs/ha.md): a leader taking over must
+        not trust counters accumulated by a previous term — they count
+        watch events a different process saw, so any nonzero residue
+        would either block syncs until the TTL failsafe or, worse, let
+        a sync run against a cache it shouldn't trust. Clear every key
+        derivable from the relist (jobs × replica types plus observed
+        children, orphans included) so each next sync starts from
+        "satisfied" and recomputes the world purely from what it lists.
+
+        `keys` is the relist-derived universe. This implementation can
+        go further and drop everything (entries outside the universe
+        belong to owners that no longer exist); the parameter exists so
+        NativeExpectations — whose store cannot be enumerated from
+        Python — implements the same contract by per-key deletion."""
+        del keys  # see docstring: full clear subsumes the key set
+        with self._lock:
+            self._store.clear()

@@ -706,6 +706,11 @@ class KubeSubstrate:
                 "acquireTime": self._epoch_to_micro_time(lease.acquire_time),
                 "renewTime": self._epoch_to_micro_time(lease.renew_time),
                 "leaseDurationSeconds": int(lease.lease_duration_seconds),
+                # the fencing token rides the standard leaseTransitions
+                # field ("number of times the lease has transitioned
+                # between holders"), so kubectl shows it and no CRD or
+                # annotation is needed
+                "leaseTransitions": int(getattr(lease, "epoch", 0) or 0),
             },
         }
 
@@ -725,6 +730,7 @@ class KubeSubstrate:
                 spec.get("leaseDurationSeconds") or DEFAULT_LEASE_DURATION
             ),
             resource_version=obj.get("metadata", {}).get("resourceVersion", ""),
+            epoch=int(spec.get("leaseTransitions") or 0),
         )
 
     def create_lease(self, lease) -> None:

@@ -1,0 +1,419 @@
+"""Epoch-aware leader election and write fencing for the HA control plane.
+
+The reference tf-operator runs multiple replicas behind client-go
+leader election (reference server.go:157-182) so a standby takes over
+without double-driving jobs. This module is that layer for our
+substrate-backed control plane, with one hardening the reference
+delegates to etcd semantics and we make explicit: a **fencing token**.
+
+Two cooperating pieces:
+
+- :class:`LeaderElector` — a background-thread elector over the
+  substrate ``Lease`` record. It times everything on the MONOTONIC
+  clock (wall clock jumps must never expire or extend a lease), renews
+  at TTL/3, and judges a foreign lease expired only by how long the
+  record has sat *unchanged on its own clock* — never by comparing its
+  clock to the holder's written renewTime (cross-replica skew safety,
+  same as client-go). Every acquisition by a new holder increments the
+  lease ``epoch``; that epoch is the fencing token.
+
+- :class:`FencedSubstrate` — a proxy that stamps every mutating
+  substrate verb with the elector's current epoch (via the
+  ``_write_token`` contextvar the substrate checks under its own lock).
+  A leader that was paused (GC stall, SIGSTOP, partitioned) and then
+  resumes after its lease expired keeps a stale epoch: the substrate
+  rejects those writes with :class:`~.substrate.FencedWrite`, so the
+  zombie can neither double-create children nor clobber status the new
+  leader already rewrote. Gating the controllers on ``is_leader`` alone
+  cannot give that guarantee — the pause can happen *between* the gate
+  check and the write.
+
+Transitions are flight-recorded as ``kind="leader"`` with the epoch in
+every record under a ``leader:<identity>`` correlation ID, so
+``/debug/flightz?kind=leader`` replays the takeover timeline
+(docs/ha.md walks one).
+"""
+
+from __future__ import annotations
+
+import logging
+import threading
+import time
+from typing import Callable, Optional
+
+from ..api.k8s import DEFAULT_LEASE_DURATION, Lease
+from ..telemetry.flight import correlate, flight_record
+from .substrate import AlreadyExists, Conflict, FencedWrite, _write_token
+
+logger = logging.getLogger("tf_operator_tpu.runtime.leader")
+
+
+def _metrics_hook(metrics, name: str):
+    """Duck-typed metrics: missing methods are skipped, not errors —
+    the elector must run identically with metrics=None in tests."""
+    return getattr(metrics, name, None) if metrics is not None else None
+
+
+class LeaderElector:
+    """Lease-based election with a monotonic heart and a fenced epoch.
+
+    Unlike the blocking server-level elector (server/leader.py, kept
+    for the FileLock single-node path), this one is built to gate live
+    controllers: ``start()`` returns immediately, ``is_leader`` is a
+    cheap property the reconcile loop checks per event, and callbacks
+    fire from the elector thread on every transition.
+
+    Timing (client-go proportions, reference server.go:52-57):
+    renew/poll period = lease_duration / 3. Leadership is surrendered
+    when a renewal fails with Conflict/NotFound (stolen or deleted) or
+    when no renewal has SUCCEEDED within lease_duration — a leader that
+    cannot reach the store must stop acting before a rival can have
+    legally stolen the lease.
+
+    ``kill()`` exists for chaos tests: it freezes the elector exactly
+    as SIGKILL/SIGSTOP would — renewals stop, nothing is released, and
+    ``is_leader`` stays frozen at its last value. The fencing token is
+    what protects the cluster from that zombie, and the HA soak proves
+    it (tests/test_ha.py).
+    """
+
+    def __init__(
+        self,
+        substrate,
+        identity: str,
+        namespace: str = "kube-system",
+        name: str = "tfjob-tpu-operator",
+        lease_duration: float = DEFAULT_LEASE_DURATION,
+        clock: Callable[[], float] = time.monotonic,
+        on_started_leading: Optional[Callable[[], None]] = None,
+        on_stopped_leading: Optional[Callable[[], None]] = None,
+        metrics=None,
+    ) -> None:
+        if lease_duration <= 0:
+            raise ValueError("lease_duration must be positive")
+        self.substrate = substrate
+        self.identity = identity
+        self.namespace = namespace
+        self.name = name
+        self.lease_duration = lease_duration
+        # TTL/3: two renew attempts can fail outright and the third
+        # still lands inside the lease (client-go's proportions)
+        self.renew_period = lease_duration / 3.0
+        self.clock = clock
+        self.on_started_leading = on_started_leading
+        self.on_stopped_leading = on_stopped_leading
+        self.metrics = metrics
+
+        self._lock = threading.Lock()
+        self._leading = threading.Event()
+        self._epoch = 0
+        self._last_renew = 0.0
+        self._stop = threading.Event()
+        self._killed = False
+        self._thread: Optional[threading.Thread] = None
+        # skew-safe expiry observation (same scheme as server/leader.py
+        # LeaseLock): last distinct foreign record + the local monotonic
+        # instant we first saw it; "expired" = unchanged for longer than
+        # its advertised duration on OUR clock.
+        self._observed_record: Optional[tuple] = None
+        self._observed_at = 0.0
+
+    # -- public surface ----------------------------------------------------
+
+    @property
+    def is_leader(self) -> bool:
+        return self._leading.is_set()
+
+    @property
+    def epoch(self) -> int:
+        """The fencing token: the lease epoch under which this replica
+        last held leadership. Only meaningful for stamping writes while
+        ``is_leader``; a zombie keeps its stale value, which is the
+        point."""
+        return self._epoch
+
+    def start(self) -> "LeaderElector":
+        if self._thread is not None:
+            raise RuntimeError("elector already started")
+        self._thread = threading.Thread(
+            target=self._run, name=f"leader-elector-{self.identity}",
+            daemon=True,
+        )
+        self._thread.start()
+        return self
+
+    def stop(self) -> None:
+        """Graceful shutdown: stop the loop and release the lease so a
+        peer can take over immediately instead of waiting out the TTL."""
+        self._stop.set()
+        thread = self._thread
+        if thread is not None:
+            thread.join(timeout=5.0)
+        if self._killed:
+            return  # frozen by kill(): a dead process releases nothing
+        if self._leading.is_set():
+            self._release()
+            self._demote("released")
+
+    def kill(self) -> None:
+        """Chaos hook: freeze as an abrupt process death would — no
+        release, no demotion, is_leader stuck at its last value."""
+        self._killed = True
+        self._stop.set()
+
+    def wait_for_leadership(self, timeout: float) -> bool:
+        return self._leading.wait(timeout)
+
+    # -- elector loop ------------------------------------------------------
+
+    def _run(self) -> None:
+        while not self._stop.is_set():
+            try:
+                if self._leading.is_set():
+                    self._renew_or_demote()
+                else:
+                    self._try_acquire()
+            except Exception:
+                logger.exception("elector %s: loop error", self.identity)
+            self._stop.wait(self.renew_period)
+
+    def _observe(self, current: Lease) -> None:
+        record = (
+            current.holder,
+            current.renew_time,
+            current.acquire_time,
+            current.resource_version,
+        )
+        if record != self._observed_record:
+            self._observed_record = record
+            self._observed_at = self.clock()
+
+    def _locally_expired(self, current: Lease) -> bool:
+        return (
+            self.clock() - self._observed_at
+            > current.lease_duration_seconds
+        )
+
+    def _try_acquire(self) -> None:
+        now = self.clock()
+        current = self.substrate.get_lease(self.namespace, self.name)
+        if current is None:
+            fresh = Lease(
+                namespace=self.namespace,
+                name=self.name,
+                holder=self.identity,
+                acquire_time=now,
+                renew_time=now,
+                lease_duration_seconds=self.lease_duration,
+                epoch=1,
+            )
+            try:
+                self.substrate.create_lease(fresh)
+            except AlreadyExists:
+                return  # lost the creation race; poll again next period
+            self._promote(fresh.epoch, takeover=False)
+            return
+        self._observe(current)
+        held_by_other = current.holder not in ("", self.identity)
+        if held_by_other and not self._locally_expired(current):
+            return
+        fresh = current.copy()
+        takeover = fresh.holder != self.identity
+        if takeover:
+            # the fencing token: a NEW holder means every write stamped
+            # with the old epoch must start bouncing, atomically with
+            # this CAS landing (the substrate advances its fence under
+            # the same lock that serializes this update)
+            fresh.epoch = current.epoch + 1
+            fresh.acquire_time = now
+        fresh.holder = self.identity
+        fresh.renew_time = now
+        fresh.lease_duration_seconds = self.lease_duration
+        try:
+            self.substrate.update_lease(fresh)
+        except Conflict:
+            return  # a rival's CAS landed first
+        except Exception as err:
+            logger.warning(
+                "elector %s: acquire failed: %s", self.identity, err
+            )
+            return
+        self._promote(fresh.epoch, takeover=takeover)
+
+    def _renew_or_demote(self) -> None:
+        started = self.clock()
+        try:
+            current = self.substrate.get_lease(self.namespace, self.name)
+            if current is None or current.holder != self.identity:
+                self._demote("stolen" if current is not None else "deleted")
+                return
+            fresh = current.copy()
+            fresh.renew_time = started
+            self.substrate.update_lease(fresh)
+        except Conflict:
+            self._demote("conflict")
+            return
+        except Exception as err:
+            logger.warning(
+                "elector %s: renew failed: %s", self.identity, err
+            )
+            # transient store trouble: keep leading only while a rival
+            # could not yet have legally stolen the lease
+            if self.clock() - self._last_renew > self.lease_duration:
+                self._demote("renew-deadline")
+            return
+        elapsed = self.clock() - started
+        self._last_renew = self.clock()
+        hook = _metrics_hook(self.metrics, "observe_lease_renew")
+        if hook:
+            hook(elapsed)
+        with correlate(f"leader:{self.identity}"):
+            flight_record(
+                "leader", event="renewed", identity=self.identity,
+                epoch=self._epoch, lease=f"{self.namespace}/{self.name}",
+            )
+
+    def _release(self) -> None:
+        try:
+            current = self.substrate.get_lease(self.namespace, self.name)
+            if current is not None and current.holder == self.identity:
+                fresh = current.copy()
+                fresh.holder = ""
+                self.substrate.update_lease(fresh)
+        except Exception as err:
+            logger.debug(
+                "elector %s: release failed: %s", self.identity, err
+            )
+
+    # -- transitions -------------------------------------------------------
+
+    def _promote(self, epoch: int, takeover: bool) -> None:
+        self._epoch = epoch
+        self._last_renew = self.clock()
+        self._leading.set()
+        logger.info(
+            "elector %s: became leader (epoch %d)", self.identity, epoch
+        )
+        hook = _metrics_hook(self.metrics, "set_leader")
+        if hook:
+            hook(True)
+        hook = _metrics_hook(self.metrics, "leader_transition")
+        if hook:
+            hook()
+        with correlate(f"leader:{self.identity}"):
+            flight_record(
+                "leader", event="acquired", identity=self.identity,
+                epoch=epoch, takeover=takeover,
+                lease=f"{self.namespace}/{self.name}",
+            )
+            # inside the correlation on purpose: the takeover rebuild's
+            # own flight records then join this leader's timeline
+            if self.on_started_leading is not None:
+                self.on_started_leading()
+
+    def _demote(self, reason: str) -> None:
+        if not self._leading.is_set():
+            return
+        self._leading.clear()
+        logger.info(
+            "elector %s: lost leadership (%s, epoch %d)",
+            self.identity, reason, self._epoch,
+        )
+        with correlate(f"leader:{self.identity}"):
+            flight_record(
+                "leader", event="lost", identity=self.identity,
+                epoch=self._epoch, reason=reason,
+                lease=f"{self.namespace}/{self.name}",
+            )
+        hook = _metrics_hook(self.metrics, "set_leader")
+        if hook:
+            hook(False)
+        hook = _metrics_hook(self.metrics, "leader_transition")
+        if hook:
+            hook()
+        if self.on_stopped_leading is not None:
+            self.on_stopped_leading()
+
+
+# every InMemorySubstrate / KubeSubstrate verb that mutates cluster
+# state; reads, watches, and the lease verbs themselves (CAS-protected,
+# and the elector must write them BEFORE it holds a token) stay bare
+WRITE_VERBS = frozenset(
+    {
+        "create_job",
+        "update_job",
+        "update_job_status",
+        "delete_job",
+        "create_serve_service",
+        "update_serve_service",
+        "update_serve_service_status",
+        "delete_serve_service",
+        "create_pod",
+        "delete_pod",
+        "patch_pod_labels",
+        "patch_pod_owner_references",
+        "create_service",
+        "delete_service",
+        "patch_service_owner_references",
+        "create_pod_group",
+        "update_pod_group",
+        "delete_pod_group",
+    }
+)
+
+
+class FencedSubstrate:
+    """Substrate proxy that stamps every write with the elector's epoch.
+
+    Reads and subscriptions pass through untouched. Each write verb is
+    wrapped to bind the ``_write_token`` contextvar to the elector's
+    CURRENT epoch for exactly the duration of the call — contextvar
+    binding (not a plain attribute) so a controller callback running
+    synchronously inside another replica's mutation thread stamps its
+    OWN stale epoch, not the mutator's fresh one. Rejected writes are
+    flight-recorded (``event="fenced-write-rejected"``) and re-raised;
+    FencedWrite subclasses Conflict, which retry.py already classifies
+    as semantic — callers re-observe instead of blindly retrying.
+    """
+
+    def __init__(self, substrate, elector) -> None:
+        self._substrate = substrate
+        self._elector = elector
+
+    @property
+    def raw(self):
+        return self._substrate
+
+    def __getattr__(self, name: str):
+        attr = getattr(self._substrate, name)
+        if name not in WRITE_VERBS:
+            return attr
+
+        def fenced(*args, **kwargs):
+            token = self._elector.epoch
+            bound = _write_token.set(token)
+            try:
+                return attr(*args, **kwargs)
+            except FencedWrite as err:
+                with correlate(f"leader:{self._elector.identity}"):
+                    flight_record(
+                        "leader", event="fenced-write-rejected",
+                        identity=self._elector.identity, op=err.op,
+                        epoch=err.token, fence=err.fence,
+                    )
+                raise
+            finally:
+                _write_token.reset(bound)
+
+        fenced.__name__ = f"fenced_{name}"
+        # cache so repeated lookups skip __getattr__; the closure reads
+        # the epoch at call time, so caching cannot stale the token
+        self.__dict__[name] = fenced
+        return fenced
+
+
+__all__ = [
+    "FencedSubstrate",
+    "LeaderElector",
+    "WRITE_VERBS",
+]

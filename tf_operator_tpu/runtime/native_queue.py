@@ -124,6 +124,15 @@ class NativeExpectations:
     def delete_expectations(self, key: str) -> None:
         self._lib.tfoprt_exp_delete(self._h, _encode(key))
 
+    def rebuild_from_observed(self, keys) -> None:
+        """Takeover reset, same contract as the Python dual: every key
+        in the relist-derived universe is cleared to "satisfied". The
+        native store offers no enumeration, so unlike the Python
+        implementation keys outside the universe survive — harmless,
+        since no relisted owner maps to them and the TTL reaps them."""
+        for key in keys:
+            self._lib.tfoprt_exp_delete(self._h, _encode(key))
+
     def __del__(self) -> None:
         h, self._h = getattr(self, "_h", None), None
         if h and getattr(self, "_lib", None):

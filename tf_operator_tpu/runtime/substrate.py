@@ -20,12 +20,14 @@ on top exactly like the reference (jobcontroller/pod.go:20-160).
 
 from __future__ import annotations
 
+import contextvars
 import dataclasses
 import datetime
 import itertools
-from typing import Any, Callable, Dict, List, Optional, Protocol, Tuple
+from typing import Any, Callable, Dict, List, Optional, Protocol, Set, Tuple
 
 from ..api import k8s
+from ..api.k8s import DEFAULT_LEASE_DURATION, Lease  # noqa: F401 — re-export
 from ..api.serde import deep_copy
 from ..api.types import ServeService, TFJob
 
@@ -55,40 +57,50 @@ class BadRequest(ValueError):
     log read naming a container the pod does not have)."""
 
 
-# single source for the default lease duration (reference server.go:53);
-# leader.py and kube.py must not restate the number
-DEFAULT_LEASE_DURATION = 15.0
-
-
-@dataclasses.dataclass
-class Lease:
-    """Coordination lease record (k8s coordination.k8s.io/v1 Lease
-    shape, reduced to the fields client-go leader election uses).
-    Stored by substrates; consumed by server.leader.LeaseLock."""
-
-    namespace: str = "default"
-    name: str = "tfjob-tpu-operator"
-    holder: str = ""
-    acquire_time: float = 0.0
-    renew_time: float = 0.0
-    lease_duration_seconds: float = DEFAULT_LEASE_DURATION
-    resource_version: str = ""
-
-    # NOTE: deliberately no expired(now) helper — judging expiry by
-    # comparing a local clock against the holder's written renewTime is
-    # skew-unsafe; LeaseLock tracks locally-observed change instead
-    # (see server/leader.py and test_clock_skew_does_not_steal_healthy_lease).
-
-    def copy(self) -> "Lease":
-        return dataclasses.replace(self)
-
-
 class AlreadyExists(ValueError):
     pass
 
 
 class Conflict(RuntimeError):
     """Optimistic-concurrency failure (stale resourceVersion)."""
+
+
+class FencedWrite(Conflict):
+    """A write carried a fencing token (leader epoch) older than the
+    newest lease epoch the substrate has seen: the writer is a deposed
+    leader that does not know it yet. Subclasses Conflict because the
+    correct reaction is the same — re-read the world, don't replay —
+    and is_transient_error already classifies Conflict as semantic
+    (never blindly retried)."""
+
+    def __init__(self, op: str, token: int, fence: int) -> None:
+        super().__init__(
+            f"{op}: fencing token {token} is stale (current epoch {fence})"
+        )
+        self.op = op
+        self.token = token
+        self.fence = fence
+
+
+# Ambient fencing token for the CURRENT thread of control: bound by
+# FencedSubstrate (runtime/leader.py) around each mutating call; None
+# means the writer is unfenced (single-replica mode, tests, clients)
+# and passes every check. A contextvar, not a thread-local: informer
+# callbacks run synchronously inside the mutator's call, and a nested
+# FencedSubstrate re-binds its OWN epoch for writes it issues from a
+# handler — each writer is judged by its own token.
+_write_token: contextvars.ContextVar[Optional[int]] = contextvars.ContextVar(
+    "substrate_write_token", default=None
+)
+
+
+@dataclasses.dataclass
+class FenceRejection:
+    """Audit row for one rejected stale-epoch write."""
+
+    op: str
+    token: int
+    fence: int
 
 
 class Substrate(Protocol):
@@ -158,8 +170,90 @@ class InMemorySubstrate:
         self._pod_logs: Dict[Tuple[str, str], str] = {}
         self.events: List[k8s.Event] = []
         self._subscribers: Dict[str, List[WatchCallback]] = {}
+        # namespace+label inverted index over pods/services: the
+        # apiserver answers selector LISTs from etcd + an index; a full
+        # O(all pods) scan per sync made "list" the dominant superlinear
+        # phase at scale (CONTROLLER_PROFILE.json). Maintained — i.e.
+        # invalidated — on every write that touches labels or
+        # membership, so a selector LIST costs O(matching).
+        # (ns, label_key, label_value) -> set of object keys
+        self._pod_index: Dict[Tuple[str, str, str], Set[Tuple[str, str]]] = {}
+        self._service_index: Dict[
+            Tuple[str, str, str], Set[Tuple[str, str]]
+        ] = {}
+        # fencing: the newest lease epoch ever written here; writes
+        # carrying an older ambient token raise FencedWrite. Audit
+        # trails let the HA soak assert "zero stale writes accepted"
+        # from the substrate's own books (tests/test_ha.py).
+        self._fence_epoch = 0
+        self.fence_rejections: List[FenceRejection] = []
+        # (op, token, fence_epoch_at_accept) for every ACCEPTED write
+        # that carried a token — must never contain token < fence
+        self.fenced_writes_accepted: List[Tuple[str, int, int]] = []
 
     # -- plumbing ----------------------------------------------------------
+
+    def _fence(self, op: str) -> None:
+        """Reject stale-epoch writes (call first, inside self._lock, in
+        every mutating verb): the check-and-write must be atomic with
+        lease-epoch advancement or a write racing a takeover could slip
+        through after the new leader's epoch landed."""
+        token = _write_token.get()
+        if token is None:
+            return  # unfenced writer (single-replica mode, clients, tests)
+        if token < self._fence_epoch:
+            self.fence_rejections.append(
+                FenceRejection(op=op, token=token, fence=self._fence_epoch)
+            )
+            raise FencedWrite(op, token, self._fence_epoch)
+        self.fenced_writes_accepted.append((op, token, self._fence_epoch))
+
+    @property
+    def fence_epoch(self) -> int:
+        with self._lock:
+            return self._fence_epoch
+
+    @staticmethod
+    def _index_add(
+        index: Dict[Tuple[str, str, str], Set[Tuple[str, str]]],
+        key: Tuple[str, str],
+        labels: Dict[str, str],
+    ) -> None:
+        ns = key[0]
+        for lk, lv in labels.items():
+            index.setdefault((ns, lk, lv), set()).add(key)
+
+    @staticmethod
+    def _index_remove(
+        index: Dict[Tuple[str, str, str], Set[Tuple[str, str]]],
+        key: Tuple[str, str],
+        labels: Dict[str, str],
+    ) -> None:
+        ns = key[0]
+        for lk, lv in labels.items():
+            bucket = index.get((ns, lk, lv))
+            if bucket is not None:
+                bucket.discard(key)
+                if not bucket:
+                    del index[(ns, lk, lv)]
+
+    def _index_candidates(
+        self,
+        index: Dict[Tuple[str, str, str], Set[Tuple[str, str]]],
+        namespace: str,
+        selector: Dict[str, str],
+    ) -> Set[Tuple[str, str]]:
+        """Smallest posting set among the selector's terms (standard
+        inverted-index intersection order); the caller still verifies
+        the FULL selector against each candidate's labels."""
+        smallest: Optional[Set[Tuple[str, str]]] = None
+        for lk, lv in selector.items():
+            bucket = index.get((namespace, lk, lv))
+            if not bucket:
+                return set()
+            if smallest is None or len(bucket) < len(smallest):
+                smallest = bucket
+        return smallest if smallest is not None else set()
 
     def _stamp(self, meta: k8s.ObjectMeta) -> None:
         if not meta.uid:
@@ -193,6 +287,7 @@ class InMemorySubstrate:
 
     def create_job(self, job: TFJob) -> TFJob:
         with self._lock:
+            self._fence("create-job")
             key = (job.namespace, job.name)
             if key in self._jobs:
                 raise AlreadyExists(f"tfjob {key} exists")
@@ -219,6 +314,7 @@ class InMemorySubstrate:
 
     def update_job(self, job: TFJob) -> TFJob:
         with self._lock:
+            self._fence("update-job")
             key = (job.namespace, job.name)
             if key not in self._jobs:
                 raise NotFound(f"tfjob {key}")
@@ -241,6 +337,7 @@ class InMemorySubstrate:
         client (status.go:176-184, k8sutil/client.go).
         """
         with self._lock:
+            self._fence("update-job-status")
             key = (job.namespace, job.name)
             stored = self._jobs.get(key)
             if stored is None:
@@ -252,6 +349,7 @@ class InMemorySubstrate:
 
     def delete_job(self, namespace: str, name: str) -> None:
         with self._lock:
+            self._fence("delete-job")
             job = self._jobs.pop((namespace, name), None)
             if job is None:
                 raise NotFound(f"tfjob {namespace}/{name}")
@@ -265,6 +363,7 @@ class InMemorySubstrate:
 
     def create_serve_service(self, svc: ServeService) -> ServeService:
         with self._lock:
+            self._fence("create-serveservice")
             key = (svc.namespace, svc.name)
             if key in self._serve_services:
                 raise AlreadyExists(f"serveservice {key} exists")
@@ -293,6 +392,7 @@ class InMemorySubstrate:
 
     def update_serve_service(self, svc: ServeService) -> ServeService:
         with self._lock:
+            self._fence("update-serveservice")
             key = (svc.namespace, svc.name)
             if key not in self._serve_services:
                 raise NotFound(f"serveservice {key}")
@@ -311,6 +411,7 @@ class InMemorySubstrate:
 
     def update_serve_service_status(self, svc: ServeService) -> ServeService:
         with self._lock:
+            self._fence("update-serveservice-status")
             key = (svc.namespace, svc.name)
             stored = self._serve_services.get(key)
             if stored is None:
@@ -322,6 +423,7 @@ class InMemorySubstrate:
 
     def delete_serve_service(self, namespace: str, name: str) -> None:
         with self._lock:
+            self._fence("delete-serveservice")
             svc = self._serve_services.pop((namespace, name), None)
             if svc is None:
                 raise NotFound(f"serveservice {namespace}/{name}")
@@ -331,7 +433,10 @@ class InMemorySubstrate:
     def _cascade_delete(self, owner_uid: str) -> None:
         """Garbage-collect children owned (via ownerReferences) by a gone
         object — the role the k8s GC controller plays for the reference."""
-        for store, kind in ((self._pods, "pod"), (self._services, "service")):
+        for store, index, kind in (
+            (self._pods, self._pod_index, "pod"),
+            (self._services, self._service_index, "service"),
+        ):
             doomed = [
                 key
                 for key, obj in store.items()
@@ -339,12 +444,16 @@ class InMemorySubstrate:
             ]
             for key in doomed:
                 obj = store.pop(key)
+                self._index_remove(index, key, obj.metadata.labels)
+                if kind == "pod":
+                    self._pod_logs.pop(key, None)
                 self._notify(kind, DELETED, obj)
 
     # -- Pods --------------------------------------------------------------
 
     def create_pod(self, pod: k8s.Pod) -> k8s.Pod:
         with self._lock:
+            self._fence("create-pod")
             key = (pod.metadata.namespace, pod.metadata.name)
             if key in self._pods:
                 raise AlreadyExists(f"pod {key} exists")
@@ -352,6 +461,7 @@ class InMemorySubstrate:
             self._stamp(pod.metadata)
             pod.status.phase = k8s.POD_PENDING
             self._pods[key] = pod
+            self._index_add(self._pod_index, key, pod.metadata.labels)
             self._notify("pod", ADDED, pod)
             return deep_copy(pod)
 
@@ -366,8 +476,19 @@ class InMemorySubstrate:
         self, namespace: Optional[str], selector: Optional[Dict[str, str]] = None
     ) -> List[k8s.Pod]:
         """namespace=None lists across all namespaces (the apiserver's
-        cluster-scoped GET /api/v1/pods)."""
+        cluster-scoped GET /api/v1/pods). Namespaced selector LISTs —
+        the controller's per-sync shape — answer from the label index
+        in O(matching) instead of scanning every pod."""
         with self._lock:
+            if namespace is not None and selector:
+                candidates = self._index_candidates(
+                    self._pod_index, namespace, selector
+                )
+                return [
+                    deep_copy(self._pods[key])
+                    for key in sorted(candidates)
+                    if match_labels(selector, self._pods[key].metadata.labels)
+                ]
             return [
                 deep_copy(pod)
                 for (ns, _), pod in self._pods.items()
@@ -377,9 +498,13 @@ class InMemorySubstrate:
 
     def delete_pod(self, namespace: str, name: str) -> None:
         with self._lock:
+            self._fence("delete-pod")
             pod = self._pods.pop((namespace, name), None)
             if pod is None:
                 raise NotFound(f"pod {namespace}/{name}")
+            self._index_remove(
+                self._pod_index, (namespace, name), pod.metadata.labels
+            )
             # a pod recreated at the same name must start with fresh logs
             self._pod_logs.pop((namespace, name), None)
             self._notify("pod", DELETED, pod)
@@ -388,10 +513,17 @@ class InMemorySubstrate:
         self, namespace: str, name: str, labels: Dict[str, str]
     ) -> k8s.Pod:
         with self._lock:
+            self._fence("patch-pod-labels")
             pod = self._pods.get((namespace, name))
             if pod is None:
                 raise NotFound(f"pod {namespace}/{name}")
+            self._index_remove(
+                self._pod_index, (namespace, name), pod.metadata.labels
+            )
             pod.metadata.labels.update(labels)
+            self._index_add(
+                self._pod_index, (namespace, name), pod.metadata.labels
+            )
             pod.metadata.resource_version = str(next(self._rv))
             self._notify("pod", MODIFIED, pod)
             return deep_copy(pod)
@@ -406,6 +538,7 @@ class InMemorySubstrate:
         is rejected if the name now belongs to a different object (uid
         is immutable; the apiserver behaves the same)."""
         with self._lock:
+            self._fence("patch-pod-owner-refs")
             pod = self._pods.get((namespace, name))
             if pod is None:
                 raise NotFound(f"pod {namespace}/{name}")
@@ -423,12 +556,14 @@ class InMemorySubstrate:
 
     def create_service(self, service: k8s.Service) -> k8s.Service:
         with self._lock:
+            self._fence("create-service")
             key = (service.metadata.namespace, service.metadata.name)
             if key in self._services:
                 raise AlreadyExists(f"service {key} exists")
             service = deep_copy(service)
             self._stamp(service.metadata)
             self._services[key] = service
+            self._index_add(self._service_index, key, service.metadata.labels)
             self._notify("service", ADDED, service)
             return deep_copy(service)
 
@@ -436,6 +571,17 @@ class InMemorySubstrate:
         self, namespace: str, selector: Optional[Dict[str, str]] = None
     ) -> List[k8s.Service]:
         with self._lock:
+            if selector:
+                candidates = self._index_candidates(
+                    self._service_index, namespace, selector
+                )
+                return [
+                    deep_copy(self._services[key])
+                    for key in sorted(candidates)
+                    if match_labels(
+                        selector, self._services[key].metadata.labels
+                    )
+                ]
             return [
                 deep_copy(svc)
                 for (ns, _), svc in self._services.items()
@@ -445,9 +591,13 @@ class InMemorySubstrate:
 
     def delete_service(self, namespace: str, name: str) -> None:
         with self._lock:
+            self._fence("delete-service")
             svc = self._services.pop((namespace, name), None)
             if svc is None:
                 raise NotFound(f"service {namespace}/{name}")
+            self._index_remove(
+                self._service_index, (namespace, name), svc.metadata.labels
+            )
             self._notify("service", DELETED, svc)
 
     def patch_service_owner_references(
@@ -455,6 +605,7 @@ class InMemorySubstrate:
         expected_uid: str = "",
     ) -> k8s.Service:
         with self._lock:
+            self._fence("patch-service-owner-refs")
             svc = self._services.get((namespace, name))
             if svc is None:
                 raise NotFound(f"service {namespace}/{name}")
@@ -472,6 +623,7 @@ class InMemorySubstrate:
 
     def create_pod_group(self, group) -> None:
         with self._lock:
+            self._fence("create-podgroup")
             key = (group.namespace, group.name)
             if key in self._pod_groups:
                 raise AlreadyExists(f"podgroup {key} exists")
@@ -485,11 +637,13 @@ class InMemorySubstrate:
 
     def update_pod_group(self, group) -> None:
         with self._lock:
+            self._fence("update-podgroup")
             self._pod_groups[(group.namespace, group.name)] = group.copy()
             self._notify("podgroup", MODIFIED, group)
 
     def delete_pod_group(self, namespace: str, name: str) -> None:
         with self._lock:
+            self._fence("delete-podgroup")
             group = self._pod_groups.pop((namespace, name), None)
             if group is not None:
                 self._notify("podgroup", DELETED, group)
@@ -509,6 +663,7 @@ class InMemorySubstrate:
             lease = lease.copy()
             lease.resource_version = str(next(self._rv))
             self._leases[key] = lease
+            self._advance_fence(lease)
 
     def update_lease(self, lease) -> None:
         """Compare-and-swap on resourceVersion — two operators renewing
@@ -527,6 +682,15 @@ class InMemorySubstrate:
             lease = lease.copy()
             lease.resource_version = str(next(self._rv))
             self._leases[key] = lease
+            self._advance_fence(lease)
+
+    def _advance_fence(self, lease) -> None:
+        """The fence follows the newest lease epoch written (under
+        self._lock with the write, so a takeover and a stale write
+        serialize). Monotonic: a replayed old lease body can't lower it."""
+        epoch = int(getattr(lease, "epoch", 0) or 0)
+        if epoch > self._fence_epoch:
+            self._fence_epoch = epoch
 
     # -- Events ------------------------------------------------------------
 

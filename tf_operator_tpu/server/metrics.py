@@ -46,6 +46,8 @@ _COUNTER_HELP = {
         "Counts watch streams re-established after a drop or 410",
     "reconcile_panics_total":
         "Counts reconcile worker exceptions isolated per key",
+    "leader_transitions_total":
+        "Counts leadership transitions (gained or lost) on this replica",
 }
 _GAUGE_HELP = {
     "is_leader": "1 when this replica holds leadership",
@@ -152,6 +154,14 @@ class OperatorMetrics:
             "(a drill-down within the reconcile phase)",
             buckets=LATENCY_BUCKETS, labelnames=("verb",),
         )
+        # lease renew latency: the HA heartbeat (docs/ha.md). Renew
+        # times approaching the lease TTL forecast a spurious failover
+        # before it happens
+        self.lease_renew = self.registry.histogram(
+            "lease_renew_seconds",
+            "Wall time of one leader-lease renewal round-trip",
+            buckets=LATENCY_BUCKETS,
+        )
         self._workqueues: Dict[str, WorkqueueMetrics] = {}
         # job-lifecycle spans: observed -> pods-created -> running ->
         # terminal, keyed by "namespace/name"
@@ -187,6 +197,12 @@ class OperatorMetrics:
 
     def set_leader(self, is_leader: bool) -> None:
         self._gauges["is_leader"].set(1 if is_leader else 0)
+
+    def leader_transition(self) -> None:
+        self._inc("leader_transitions_total")
+
+    def observe_lease_renew(self, seconds: float) -> None:
+        self.lease_renew.observe(max(0.0, seconds))
 
     def set_degraded(self, degraded: bool) -> None:
         self._gauges["degraded"].set(1 if degraded else 0)

@@ -19,9 +19,11 @@ from typing import Optional
 from ..controller import ReconcilerConfig, TFJobController
 from ..controller.ports import PortAllocator
 from ..runtime import InMemorySubstrate
+from ..runtime.leader import FencedSubstrate
+from ..runtime.leader import LeaderElector as LeaseLeaderElector
 from ..utils import JsonFieldFormatter, version_info
 from ..utils.logger import TextFieldFormatter
-from .leader import FileLock, LeaderElector, LeaseLock
+from .leader import FileLock, LeaderElector, default_identity
 from .metrics import MonitoringServer, OperatorMetrics
 from .options import ServerOptions, parse_args
 
@@ -92,8 +94,33 @@ class OperatorServer:
             substrate if substrate is not None
             else build_substrate(options, metrics=self.metrics)
         )
+        # lease mode runs the epoch-fenced elector (runtime/leader.py,
+        # docs/ha.md): the controller reconciles only while leading and
+        # every write it issues carries the leader epoch, so a deposed
+        # replica's in-flight writes bounce instead of racing the new
+        # leader. file mode keeps the legacy blocking flock elector.
+        self._lease_elector: Optional[LeaseLeaderElector] = None
+        controller_substrate = self.substrate
+        leadership = None
+        if (
+            options.enable_leader_election
+            and options.leader_lock == "lease"
+            and hasattr(self.substrate, "get_lease")
+        ):
+            self._lease_elector = LeaseLeaderElector(
+                self.substrate,
+                identity=default_identity(),
+                namespace=options.leader_lease_namespace,
+                name=options.leader_lease_name,
+                on_started_leading=self._on_started_leading,
+                metrics=self.metrics,
+            )
+            controller_substrate = FencedSubstrate(
+                self.substrate, self._lease_elector
+            )
+            leadership = self._lease_elector
         self.controller = TFJobController(
-            self.substrate,
+            controller_substrate,
             config=ReconcilerConfig(
                 enable_gang_scheduling=options.enable_gang_scheduling,
                 gang_scheduler_name=options.gang_scheduler_name,
@@ -101,9 +128,12 @@ class OperatorServer:
             namespace=options.namespace,
             metrics=self.metrics,
             port_allocator=PortAllocator(options.bport, options.eport),
+            leadership=leadership,
         )
         self._stop = threading.Event()
         self._elector: Optional[LeaderElector] = None
+        self._workers_lock = threading.Lock()
+        self._workers_started = False
 
     def run(self) -> int:
         self.monitoring.start()
@@ -112,6 +142,25 @@ class OperatorServer:
         finally:
             # error returns must not leak the bound monitoring socket
             self.monitoring.stop()
+
+    def _on_started_leading(self) -> None:
+        """Lease-elector promotion hook: rebuild, then start workers.
+
+        Runs in the elector thread with the leader correlation bound.
+        The relist rebuild (docs/ha.md "Takeover") re-derives
+        expectations/latches from observed children before any worker
+        can pull a key for the new term; workers start once and then
+        park behind the leadership gate across later transitions.
+        """
+        self.controller.rebuild_from_relist()
+        with self._workers_lock:
+            if self._workers_started:
+                return
+            self._workers_started = True
+        self.controller.run(
+            threadiness=self.options.threadiness,
+            resync_period=self.options.resync_period,
+        )
 
     def _run(self) -> int:
         logger.info("monitoring on :%d", self.monitoring.port)
@@ -137,7 +186,7 @@ class OperatorServer:
 
         if self.options.enable_leader_election:
             if self.options.leader_lock == "lease":
-                if not hasattr(self.substrate, "get_lease"):
+                if self._lease_elector is None:
                     # silently downgrading to a node-local flock would
                     # let every replica elect itself (split brain) —
                     # fail loudly; --leader-lock=file is the opt-out
@@ -148,19 +197,22 @@ class OperatorServer:
                         type(self.substrate).__name__,
                     )
                     return 1
-                lock = LeaseLock(
-                    self.substrate,
-                    namespace=self.options.leader_lease_namespace,
-                    name=self.options.leader_lease_name,
-                )
+                # non-blocking epoch elector: the replica stays resident
+                # as a follower (workers parked behind the leadership
+                # gate) instead of exiting on lost leadership — fenced
+                # writes make the overlap safe (docs/ha.md)
+                self._lease_elector.start()
+                self._stop.wait()
+                self.controller.stop()
+                self._lease_elector.stop()
             else:
                 lock = FileLock(self.options.leader_lock_path)
-            self._elector = LeaderElector(
-                lock,
-                on_started_leading=lead,
-                on_stopped_leading=stopped_leading,
-            )
-            self._elector.run()
+                self._elector = LeaderElector(
+                    lock,
+                    on_started_leading=lead,
+                    on_stopped_leading=stopped_leading,
+                )
+                self._elector.run()
         else:
             lead()
         return 0
