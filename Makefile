@@ -24,7 +24,7 @@ IMAGE_DIR := build/images
 DIST      := build/dist
 
 .PHONY: ci presubmit lint analyze native native-test native-race test wire-test e2e e2e-kind bench \
-        chaos-soak serve-soak serve-paged ha-soak controller-profile images release mnist-acc clean
+        chaos-soak serve-soak serve-paged serve-sharded ha-soak controller-profile images release mnist-acc clean
 
 # `test` already runs the whole tests/ tree (native bindings, wire,
 # E2E suites included) — native-test/wire-test exist for targeted runs,
@@ -104,6 +104,14 @@ ha-soak:
 serve-paged:
 	env JAX_PLATFORMS=cpu $(PY) -m tf_operator_tpu.serve.engine --smoke \
 	    --layout paged --block-size 8 --prefill-chunk 6
+
+# sharded decode smoke (docs/serving.md "Sharded decode"): the same
+# paged workload over a 1x2 ('batch','model') virtual-CPU mesh, every
+# chain still bit-identical to inline generate, KV pool sharded 1/2
+# per shard, one compile per program (CI's serve-sharded-smoke)
+serve-sharded:
+	env JAX_PLATFORMS=cpu $(PY) -m tf_operator_tpu.serve.engine --smoke \
+	    --layout paged --block-size 8 --prefill-chunk 6 --mesh 1x2
 
 # Hermetic E2E runs everywhere (operator process <-HTTP-> apiserver
 # <-HTTP-> process kubelet); the kind path self-activates when kind is

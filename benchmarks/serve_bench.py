@@ -38,6 +38,17 @@ Scenarios, one JSON artifact (SERVE_BENCH.json):
                     during the ingestion; the no-stall property itself
                     is asserted deterministically in
                     tests/test_engine.py).
+7. ``sharded``    — the tensor-parallel decode step over a
+                    ('batch','model') mesh (docs/serving.md "Sharded
+                    decode"), run in a CHILD process so the CPU
+                    virtual devices exist before JAX loads:
+                    ``single`` (the unsharded paged engine),
+                    ``mesh_1x1`` (the pjit path at mesh size 1 —
+                    parity with the single-device numbers), and
+                    ``mesh_1x2`` (two model shards: tokens/sec plus
+                    the per-shard-KV = pool/2 gauge). Chains must be
+                    bit-identical across all three and every program
+                    must compile exactly once, or the child raises.
 
 Run:  BENCH_CPU=1 python benchmarks/serve_bench.py   (CPU shapes)
       python benchmarks/serve_bench.py               (TPU shapes)
@@ -533,6 +544,112 @@ def paged_scenarios(cfg, params) -> dict:
     return out
 
 
+def _sharded_child() -> dict:
+    """Runs in a subprocess (see sharded_scenarios): JAX_PLATFORMS=cpu
+    with --xla_force_host_platform_device_count=2 already in the
+    environment, so the 1x2 mesh is real. Measures the same paged
+    workload unsharded, at mesh 1x1, and at mesh 1x2, and raises on
+    any chain divergence, recompile, or mesh/KV-gauge violation."""
+    from tf_operator_tpu.models import gpt as gpt_lib
+    from tf_operator_tpu.serve.engine import ContinuousBatchingEngine
+
+    cfg = gpt_lib.GPT_TINY
+    params = _make_params(cfg)
+    bs = 16
+    new = 16
+    jobs = [
+        [int(t) for t in jax.random.randint(
+            jax.random.PRNGKey(300 + i), (16,), 0, cfg.vocab_size
+        )]
+        for i in range(24)
+    ]
+    out = {"block_size": bs, "requests": len(jobs), "new_tokens": new}
+    warm = [
+        int(t) for t in jax.random.randint(
+            jax.random.PRNGKey(299), (2 * bs + 3,), 0, cfg.vocab_size
+        )
+    ]
+    chains = {}
+    for label, mesh_shape in (
+        ("single", None), ("mesh_1x1", (1, 1)), ("mesh_1x2", (1, 2)),
+    ):
+        eng = ContinuousBatchingEngine(
+            cfg, params, n_slots=8, kv_layout="paged",
+            block_size=bs, prefill_chunk=bs, mesh_shape=mesh_shape,
+        )
+        try:
+            # warm: the decode step compiled at construction; one
+            # multi-chunk submit compiles the prefill program outside
+            # the measured window (values differ per request, shape is
+            # what compiles)
+            eng.submit(warm, 2).result(600)
+            start = time.perf_counter()
+            handles = [eng.submit(row, new) for row in jobs]
+            chains[label] = [h.result(600) for h in handles]
+            wall = time.perf_counter() - start
+            row = {
+                "tokens_per_sec": round(len(jobs) * new / wall, 2),
+                "engine_compiles": eng.step.compiles,
+                "prefill_compiles": eng.step.prefill_compiles,
+            }
+            if eng.step.compiles != 1 or eng.step.prefill_compiles > 1:
+                raise AssertionError(
+                    f"{label}: compile discipline broken "
+                    f"({eng.step.compiles}/{eng.step.prefill_compiles})"
+                )
+            if mesh_shape is not None:
+                gauges = eng.metrics()
+                devices = gauges[("engine_mesh_devices", "gauge")]
+                shard = gauges[("engine_kv_shard_bytes", "gauge")]
+                pool = gauges[("engine_kv_pool_bytes", "gauge")]
+                row["mesh_devices"] = devices
+                row["kv_shard_bytes"] = shard
+                row["kv_pool_bytes"] = pool
+                if devices != mesh_shape[0] * mesh_shape[1]:
+                    raise AssertionError(
+                        f"{label}: mesh collapsed to {devices} devices"
+                    )
+                if shard * mesh_shape[1] != pool:
+                    raise AssertionError(
+                        f"{label}: per-shard KV {shard} is not "
+                        f"pool/{mesh_shape[1]} of {pool}"
+                    )
+            out[label] = row
+        finally:
+            eng.stop()
+    for label in ("mesh_1x1", "mesh_1x2"):
+        if chains[label] != chains["single"]:
+            raise AssertionError(f"{label} chains diverged from single")
+    return out
+
+
+def sharded_scenarios() -> dict:
+    """Parent half of the ``sharded`` section: the virtual CPU devices
+    must exist before JAX initializes, and this process imported jax
+    long ago — so the measurement runs in a child with the flag set
+    (the same trick serve/server.py --mesh-shape plays, deliberately
+    CPU-pinned so the section means the same thing on every host)."""
+    import subprocess
+
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    flags = env.get("XLA_FLAGS", "")
+    if "--xla_force_host_platform_device_count" not in flags:
+        env["XLA_FLAGS"] = (
+            flags + " --xla_force_host_platform_device_count=2"
+        ).strip()
+    proc = subprocess.run(
+        [sys.executable, os.path.abspath(__file__), "--sharded-child"],
+        env=env, capture_output=True, text=True, timeout=1800,
+    )
+    if proc.returncode != 0:
+        raise AssertionError(
+            f"sharded child failed (rc={proc.returncode}):\n"
+            f"{proc.stdout[-2000:]}\n{proc.stderr[-2000:]}"
+        )
+    return json.loads(proc.stdout.splitlines()[-1])
+
+
 def run(write: bool = True) -> dict:
     on_tpu = jax.devices()[0].platform == "tpu"
     cfg, prompt_len, new, n_clients, reqs_per_client = _shapes(on_tpu)
@@ -585,6 +702,7 @@ def run(write: bool = True) -> dict:
         ),
         "speculative": spec_scenarios(cfg, params, prompt_len, new),
         "paged_kv": paged_scenarios(cfg, params),
+        "sharded": sharded_scenarios(),
         "notes": (
             "plain/batched/continuous drive the live HTTP server "
             "(in-process, loopback) with single-row greedy requests "
@@ -616,7 +734,12 @@ def run(write: bool = True) -> dict:
             "deterministically in tests/test_engine.py). The "
             "scenario raises on hit-rate-zero, TTFT-not-better, or "
             "ratio-under-4x, so the artifact cannot go stale past an "
-            "acceptance regression."
+            "acceptance regression. sharded runs the tensor-parallel "
+            "paged step in a CPU-pinned child process (two virtual "
+            "devices provisioned before JAX loads): unsharded vs "
+            "mesh 1x1 vs mesh 1x2, chains bit-identical across all "
+            "three, one compile per program, per-shard KV = pool/2 "
+            "at 1x2 — the child raises on any violation."
         ),
     }
     if write:
@@ -629,4 +752,7 @@ def run(write: bool = True) -> dict:
 
 
 if __name__ == "__main__":
+    if len(sys.argv) > 1 and sys.argv[1] == "--sharded-child":
+        print(json.dumps(_sharded_child()))
+        sys.exit(0)
     print(json.dumps(run(), indent=1))

@@ -60,6 +60,15 @@ DONATING_CALLABLES = {
     "ContinuousBatchingEngine:self.step": (1,),
     "ContinuousBatchingEngine:self.step.prefill": (1,),
     "ContinuousBatchingEngine:self.step.copy_block": (0,),
+    # the compiled programs INSIDE PagedSlotDecodeStep (and, via
+    # inherited wrappers, ShardedPagedSlotDecodeStep — method qualnames
+    # keep the defining class, so one scope covers both): donation is
+    # platform-computed there (`(1,) if backend != "cpu" else ()`), a
+    # form the literal donate_argnums detector can't see, so the jit'd
+    # entry points are declared here instead
+    "PagedSlotDecodeStep:self._step": (1,),
+    "PagedSlotDecodeStep:self._prefill": (1,),
+    "PagedSlotDecodeStep:self._copy": (0,),
     "Trainer:self.step": (0,),
 }
 

@@ -1,0 +1,32 @@
+"""Use-after-donation through the sharded decode step's wrappers,
+with the PLATFORM-COMPUTED donate_argnums form the literal detector
+cannot see (`(1,) if backend != "cpu" else ()`) — coverage comes from
+the DONATING_CALLABLES config (hack/graftlint.py), which names the
+jit'd entry points per class scope. Must fire use-after-donation in
+all three wrappers (step, prefill, copy_block)."""
+
+import jax
+
+
+class PagedSlotDecodeStep:
+    def __init__(self, step, prefill, copy_block):
+        donate = (1,) if jax.default_backend() != "cpu" else ()
+        self._step = jax.jit(step, donate_argnums=donate)
+        self._prefill = jax.jit(prefill, donate_argnums=donate)
+        self._copy = jax.jit(
+            copy_block,
+            donate_argnums=(0,) if jax.default_backend() != "cpu" else (),
+        )
+
+    def __call__(self, params, cache, tok, index, prompt, lens, tables):
+        out = self._step(params, cache, tok, index, prompt, lens, tables)
+        return out, cache  # BAD: cache was donated at position 1
+
+    def prefill(self, params, cache, tokens, start, table):
+        new_cache = self._prefill(params, cache, tokens, start, table)
+        cache.clear()  # BAD: reads the donated buffer
+        return new_cache
+
+    def copy_block(self, cache, src, dst):
+        new_cache = self._copy(cache, src, dst)
+        return new_cache, cache  # BAD: cache donated at position 0

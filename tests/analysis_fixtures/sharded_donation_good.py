@@ -1,0 +1,40 @@
+"""The donate-and-replace idiom over the sharded decode step's
+wrappers — same computed donate_argnums form and config-declared entry
+points as sharded_donation_bad.py, but every donated buffer is either
+returned without re-reading or reassigned before its next load. Must
+stay clean."""
+
+import jax
+
+
+class PagedSlotDecodeStep:
+    def __init__(self, step, prefill, copy_block):
+        donate = (1,) if jax.default_backend() != "cpu" else ()
+        self._step = jax.jit(step, donate_argnums=donate)
+        self._prefill = jax.jit(prefill, donate_argnums=donate)
+        self._copy = jax.jit(
+            copy_block,
+            donate_argnums=(0,) if jax.default_backend() != "cpu" else (),
+        )
+
+    def __call__(self, params, cache, tok, index, prompt, lens, tables):
+        return self._step(params, cache, tok, index, prompt, lens,
+                          tables)
+
+    def prefill(self, params, cache, tokens, start, table):
+        cache = self._prefill(params, cache, tokens, start, table)
+        return cache
+
+    def copy_block(self, cache, src, dst):
+        cache = self._copy(cache, src, dst)
+        return cache
+
+
+class OtherStep:
+    """An UNSCOPED class with the same attribute names: the per-class
+    scoping in DONATING_CALLABLES must keep these call sites out of
+    the donation analysis entirely."""
+
+    def __call__(self, params, cache):
+        out = self._step(params, cache)
+        return out, cache  # fine: OtherStep is not a declared scope

@@ -172,6 +172,44 @@ class TestJaxRules:
         )
         assert findings == []
 
+    def test_sharded_decode_donation_entries_cover_computed_form(self):
+        """The sharded decode step computes donate_argnums from the
+        backend (`(1,) if backend != "cpu" else ()`), which the literal
+        detector can't see — graftlint's DONATING_CALLABLES must carry
+        the PagedSlotDecodeStep entries, and they must fire on the
+        known-bad fixture while the donate-and-replace fixture (plus an
+        unscoped same-named attribute) stays clean."""
+        import importlib.util
+
+        spec = importlib.util.spec_from_file_location(
+            "graftlint", os.path.join(REPO, "hack", "graftlint.py"))
+        graftlint = importlib.util.module_from_spec(spec)
+        spec.loader.exec_module(graftlint)
+        for key, donated in (
+            ("PagedSlotDecodeStep:self._step", (1,)),
+            ("PagedSlotDecodeStep:self._prefill", (1,)),
+            ("PagedSlotDecodeStep:self._copy", (0,)),
+        ):
+            assert graftlint.DONATING_CALLABLES.get(key) == donated
+
+        config = JaxConfig(
+            donating_callables=graftlint.DONATING_CALLABLES)
+        bad = analysis.run(
+            [os.path.join(FIXTURES, "sharded_donation_bad.py")],
+            jax_config=config,
+        )
+        hits = [f for f in bad if f.rule == "use-after-donation"]
+        assert {f.symbol for f in hits} == {
+            "PagedSlotDecodeStep.__call__",
+            "PagedSlotDecodeStep.prefill",
+            "PagedSlotDecodeStep.copy_block",
+        }
+        good = analysis.run(
+            [os.path.join(FIXTURES, "sharded_donation_good.py")],
+            jax_config=config,
+        )
+        assert [f for f in good if f.rule == "use-after-donation"] == []
+
 
 class TestNamesRules:
     def test_names_bad_fires_every_rule(self):

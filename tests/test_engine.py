@@ -751,3 +751,137 @@ class TestPagedEngine:
             chains[layout] = [h.result(1) for h in handles]
             eng.stop()
         assert chains["paged"] == chains["dense"]
+
+
+class TestShardedEngine:
+    """The SPMD decode step over a ('batch','model') mesh
+    (models/gpt.py ShardedPagedSlotDecodeStep) on CPU virtual devices
+    (conftest forces 8): greedy chains bit-identical to the
+    single-device paged engine — including the shared-prefix CoW
+    family, chunked prefill of a near-max prompt, and int8 KV — with
+    exactly one compile per (model, mesh shape) and the block pool
+    sharded 1/N per model shard. Manual-drive, same as TestPagedEngine."""
+
+    drive = staticmethod(TestPagedEngine.drive)
+
+    def _jobs(self, rng):
+        """Seeded mix: shared-prefix family (prefix cache + CoW), a
+        near-max-length prompt (chunked prefill), and random fill."""
+        system = rng.integers(0, CFG.vocab_size, size=16).tolist()
+        jobs = [(system, 4), (system, 4), (system + [9, 9], 4)]
+        long_row = rng.integers(
+            0, CFG.vocab_size, size=CFG.max_seq_len - 6
+        ).tolist()
+        jobs.append((long_row, 4))
+        for _ in range(8):
+            new = int(rng.integers(1, 6))
+            p_len = int(rng.integers(1, 36))
+            jobs.append(
+                (rng.integers(0, CFG.vocab_size, size=p_len).tolist(),
+                 new)
+            )
+        return jobs
+
+    # the chain/shard tests compile three pjit programs per engine
+    # (~5s each on CPU) — slow-marked to keep tier-1 under its 870s
+    # cap; CI's unit step runs them, and serve-sharded-smoke is the
+    # always-on executable pin
+    @pytest.mark.slow
+    @pytest.mark.parametrize("mesh_shape", [(1, 2), (2, 2)])
+    def test_sharded_matches_single_device(self, params, mesh_shape):
+        jobs = self._jobs(np.random.default_rng(11))
+        sharded = ContinuousBatchingEngine(
+            CFG, params, n_slots=4, start=False, kv_layout="paged",
+            block_size=8, prefill_chunk=8, mesh_shape=mesh_shape,
+        )
+        # decode the family head first so its blocks are in the prefix
+        # cache before the identical / same-prefix peers admit (peers
+        # admitted in the same pass would miss a cache that only
+        # registers blocks at first emit)
+        head = sharded.submit(*jobs[0])
+        self.drive(sharded, [head])
+        handles = [head] + [
+            sharded.submit(row, new) for row, new in jobs[1:]
+        ]
+        self.drive(sharded, handles)
+        got = [h.result(1) for h in handles]
+        sharded.stop()
+        # one compile per (model, mesh shape) — retraces would show here
+        assert sharded.step.compiles == 1
+        assert sharded.step.prefill_compiles == 1
+        assert sharded.pool.hits > 0        # shared prefix reused
+        assert sharded.pool.cow_copies >= 1  # identical resubmit CoW'd
+        sharded.pool.check()
+        assert sharded.pool.in_use() == 0
+        single = ContinuousBatchingEngine(
+            CFG, params, n_slots=4, start=False, kv_layout="paged",
+            block_size=8, prefill_chunk=8,
+        )
+        refs = [single.submit(row, new) for row, new in jobs]
+        self.drive(single, refs)
+        for (row, new), chain, ref in zip(jobs, got, refs):
+            assert chain == ref.result(1), (len(row), new)
+        single.stop()
+        assert single.step.compiles == 1
+
+    @pytest.mark.slow
+    def test_sharded_int8_kv_matches_single_device(self, params):
+        jobs = [(list(range(1, 12)), 5), ([9, 4, 2], 6),
+                (list(range(20, 44)), 4)]
+        chains = {}
+        for mesh_shape in (None, (1, 2)):
+            eng = ContinuousBatchingEngine(
+                CFG, params, n_slots=2, start=False, kv_layout="paged",
+                kv_quant_int8=True, block_size=8, prefill_chunk=6,
+                mesh_shape=mesh_shape,
+            )
+            handles = [eng.submit(row, new) for row, new in jobs]
+            self.drive(eng, handles)
+            chains[mesh_shape] = [h.result(1) for h in handles]
+            eng.stop()
+        assert chains[(1, 2)] == chains[None]
+
+    @pytest.mark.slow
+    def test_kv_pool_shards_one_over_n(self, params):
+        """The memory claim the mesh exists for: per-shard pool bytes
+        are exactly total / model_shards, and the gauges agree."""
+        eng = ContinuousBatchingEngine(
+            CFG, params, n_slots=4, start=False, kv_layout="paged",
+            block_size=8, mesh_shape=(1, 2),
+        )
+        step = eng.step
+        assert step.kv_bytes_per_shard * 2 == step.kv_bytes_total
+        flat = {name: val for (name, _), val in eng.metrics().items()}
+        assert flat["engine_mesh_devices"] == 2
+        assert flat["engine_mesh_model_shards"] == 2
+        assert (flat["engine_kv_shard_bytes"] * 2
+                == flat["engine_kv_pool_bytes"])
+        eng.stop()
+        # the single-device engine exports the same families at 1 /
+        # full-pool, so the router's scrape never conditions on shape
+        single = ContinuousBatchingEngine(
+            CFG, params, n_slots=4, start=False, kv_layout="paged",
+            block_size=8,
+        )
+        flat1 = {name: val for (name, _), val in single.metrics().items()}
+        assert flat1["engine_mesh_devices"] == 1
+        assert flat1["engine_kv_shard_bytes"] == flat1["engine_kv_pool_bytes"]
+        single.stop()
+
+    def test_invalid_sharded_configs_refused(self, params):
+        with pytest.raises(ValueError, match="paged"):
+            ContinuousBatchingEngine(
+                CFG, params, n_slots=2, start=False, kv_layout="dense",
+                mesh_shape=(1, 2),
+            )
+        with pytest.raises(ValueError, match="weights_int8"):
+            ContinuousBatchingEngine(
+                CFG, params, n_slots=2, start=False, kv_layout="paged",
+                block_size=8, weights_int8=True, mesh_shape=(1, 2),
+            )
+        # n_slots must divide over the batch axis rows
+        with pytest.raises(ValueError, match="slots"):
+            ContinuousBatchingEngine(
+                CFG, params, n_slots=3, start=False, kv_layout="paged",
+                block_size=8, mesh_shape=(2, 2),
+            )

@@ -134,6 +134,24 @@ class TestServeServiceAPI:
             validate_serve_service(svc)
         validate_serve_service(mk_svc())  # defaulted spec is valid
 
+    def test_mesh_shape_serde_defaults_and_validation(self):
+        svc = ServeService()
+        svc.metadata.name = "m"
+        svc.spec.mesh_shape = "1x2"
+        set_serve_defaults(svc)
+        cmd = svc.spec.template.spec.containers[0].command
+        assert cmd[cmd.index("--mesh-shape") + 1] == "1x2"
+        wire = svc.to_dict()
+        assert wire["spec"]["meshShape"] == "1x2"
+        assert ServeService.from_dict(wire).spec.mesh_shape == "1x2"
+        validate_serve_service(svc)
+        svc.spec.mesh_shape = "2x"
+        with pytest.raises(ValidationError, match="meshShape"):
+            validate_serve_service(svc)
+        svc.spec.mesh_shape = "0x2"
+        with pytest.raises(ValidationError, match="meshShape"):
+            validate_serve_service(svc)
+
     def test_replica_names_and_labels(self):
         assert serve_replica_name("fleet", 2) == "fleet-engine-2"
         labels = serve_labels("fleet")
@@ -386,18 +404,24 @@ class StubReplica:
         self.active_slots = 0.0
         self.die_after = None    # raise after yielding k tokens, once
         self.fail_status = None  # DecodeError raised at stream start
+        self.mesh_devices = None  # exported as the mesh gauge when set
         self.calls = 0
 
     def ready(self):
         return self.ready_flag
 
     def metrics(self):
-        return {
+        out = {
             "tf_operator_tpu_serve_engine_queue_depth": self.queue_depth,
             "tf_operator_tpu_serve_engine_active_slots": self.active_slots,
             "tf_operator_tpu_serve_engine_row_steps_total": 0.0,
             "tf_operator_tpu_serve_engine_steps_total": 0.0,
         }
+        if self.mesh_devices is not None:
+            out["tf_operator_tpu_serve_engine_mesh_devices"] = (
+                self.mesh_devices
+            )
+        return out
 
     def generate_stream(self, input_ids, max_new_tokens=16, **kw):
         self.calls += 1
@@ -505,6 +529,29 @@ class TestLeastLoadedRouter:
         out = router.generate([[4, 5]], 4, timeout=10.0)
         assert out == [[4, 5] + scripted_chain([4, 5], 4)]
         assert a.calls == 2
+
+    def test_mesh_devices_scales_compute_load_only(self):
+        # a sharded replica steps its whole batch faster, so its
+        # compute backlog (queue depth, inflight) is worth 1/mesh of an
+        # unsharded replica's...
+        router, (a, b) = mk_router(2)
+        a.queue_depth = 3.0           # effective 3
+        b.queue_depth = 8.0           # 4-way sharded: effective 2
+        b.mesh_devices = 4.0
+        router.probe()
+        router.generate([[1, 2]], 2)
+        assert b.calls == 1 and a.calls == 0
+        stats = router.stats()["replicas"]
+        assert stats["r1"]["mesh_devices"] == 4.0
+        assert stats["r0"]["mesh_devices"] == 1.0  # no gauge -> 1
+        # ...but structural occupancy is per-replica — a slot held on
+        # the sharded replica is held on every shard, so the mesh must
+        # not dilute it
+        a.queue_depth = b.queue_depth = 0.0
+        b.active_slots = 3.0
+        router.probe()
+        router.generate([[1, 2]], 2)
+        assert a.calls == 1
 
     def test_inflight_released_when_consumer_closes(self):
         router, (a, b) = mk_router(2)

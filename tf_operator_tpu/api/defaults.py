@@ -119,7 +119,10 @@ def set_serve_defaults(svc: t.ServeService) -> t.ServeService:
                     "--preset", spec.preset,
                     "--batching", "continuous",
                     "--slots", str(spec.slots),
-                ],
+                ] + (
+                    ["--mesh-shape", spec.mesh_shape]
+                    if spec.mesh_shape else []
+                ),
             )
         )
     container = pod_spec.container(t.SERVE_CONTAINER_NAME)

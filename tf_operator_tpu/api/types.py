@@ -370,6 +370,13 @@ class ServeServiceSpec:
     preset: str = "tiny"
     # engine slot-grid width per replica
     slots: Optional[int] = None
+    # ('batch','model') decode mesh per replica as "BATCHxMODEL"
+    # ("1x2"); "" = single-device. A sharded replica is ONE replica
+    # that steps faster, not N replicas — the router folds the mesh
+    # size into its compute terms, never into replica count
+    mesh_shape: str = field(
+        default="", metadata={"json": "meshShape"}
+    )
     port: Optional[int] = None
     # opaque version tag for the loaded weights; bumping it triggers a
     # drain-based rolling update across the fleet

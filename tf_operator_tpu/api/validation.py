@@ -212,6 +212,15 @@ def validate_serve_service(svc: t.ServeService) -> None:
         errs.append(
             f"ServeServiceSpec.slots must be >= 1, got {spec.slots}"
         )
+    if spec.mesh_shape:
+        parts = spec.mesh_shape.lower().split("x")
+        if len(parts) != 2 or not all(
+            p.isdigit() and int(p) >= 1 for p in parts
+        ):
+            errs.append(
+                "ServeServiceSpec.meshShape must be 'BATCHxMODEL' "
+                f"with axes >= 1, got {spec.mesh_shape!r}"
+            )
     if spec.port is None or not (0 < spec.port < 65536):
         errs.append(
             f"ServeServiceSpec.port must be in 1..65535, got {spec.port}"

@@ -58,19 +58,28 @@ BERT_TINY = BertConfig(
 )
 
 
-def transformer_mlp(cfg, x: jax.Array, dense_cls=None) -> jax.Array:
+def transformer_mlp(
+    cfg, x: jax.Array, dense_cls=None, constrain=None
+) -> jax.Array:
     """The LN'd-input MLP half of a transformer block. A free function
     creating layers in the CALLER's scope (flax attaches them to the
     calling module), so TransformerBlock and the GPT decode-path
     _CachedBlock share one implementation with identical param paths
     (mlp_in/mlp_out). dense_cls swaps the projection implementation
     at the same param paths (the decode path's int8-weight twin,
-    ops/quant.py QuantDense)."""
+    ops/quant.py QuantDense). constrain, when given, is applied to the
+    hidden activation before mlp_out — the sharded decode step uses it
+    to force an all-gather of the 'model'-sharded hidden dim so the
+    down-projection contracts at full width on every shard (a partial
+    contraction + psum would re-associate the reduction and break the
+    engine's bit-identity contract)."""
     dense = dense_cls if dense_cls is not None else nn.Dense
     y = dense(cfg.intermediate_size, dtype=cfg.dtype, name="mlp_in")(
         x.astype(cfg.dtype)
     )
     y = nn.gelu(y)
+    if constrain is not None:
+        y = constrain(y)
     return dense(cfg.hidden_size, dtype=cfg.dtype, name="mlp_out")(y)
 
 

@@ -19,7 +19,8 @@ from __future__ import annotations
 
 import dataclasses
 import functools
-from typing import Optional
+import math
+from typing import Any, Optional
 
 import jax
 import jax.numpy as jnp
@@ -975,6 +976,24 @@ def _paged_store_kv(
     return pool.value, None
 
 
+def _gather_model_axis(mesh, y, rows: bool):
+    """All-gather a 'model'-sharded activation so the NEXT contraction
+    (attn_out / mlp_out) runs at full width on every shard. Without
+    the explicit constraint GSPMD is free to contract each shard's
+    partial slice and psum — the same bytes on the wire, but the psum
+    re-associates the floating-point reduction and the sharded engine
+    owes bit-identical chains to the single-device step
+    (tests/test_engine.py TestShardedEngine). rows=True keeps the
+    leading slot-row dim sharded on 'batch'; only the model-sharded
+    trailing dims gather."""
+    from jax.sharding import NamedSharding, PartitionSpec
+
+    spec = ["batch" if rows else None] + [None] * (y.ndim - 1)
+    return jax.lax.with_sharding_constraint(
+        y, NamedSharding(mesh, PartitionSpec(*spec))
+    )
+
+
 class PagedSelfAttention(nn.Module):
     """Single-token decode attention over the paged block pool — the
     paged twin of CachedSelfAttention (identical child param paths:
@@ -997,6 +1016,7 @@ class PagedSelfAttention(nn.Module):
     dtype: jnp.dtype = jnp.bfloat16
     kv_quant_int8: bool = False
     weights_int8: bool = False
+    mesh: Any = None
 
     @nn.compact
     def __call__(self, x, index, tables):
@@ -1041,11 +1061,13 @@ class PagedSelfAttention(nn.Module):
         )[:, None, None, :]
         out = _cache_attention(
             query, keys, key_scale, values, value_scale, valid
-        )  # [s, 1, h, d]
+        )[:, 0]  # [s, h, d]
+        if self.mesh is not None:
+            out = _gather_model_axis(self.mesh, out, rows=True)
         return proj.general(
             features=x.shape[-1], axis=(-2, -1), dtype=self.dtype,
             name="attn_out",
-        )(out[:, 0])
+        )(out)
 
 
 class PagedPrefillSelfAttention(nn.Module):
@@ -1064,6 +1086,7 @@ class PagedPrefillSelfAttention(nn.Module):
     dtype: jnp.dtype = jnp.bfloat16
     kv_quant_int8: bool = False
     weights_int8: bool = False
+    mesh: Any = None
 
     @nn.compact
     def __call__(self, x, start, table):
@@ -1109,6 +1132,8 @@ class PagedPrefillSelfAttention(nn.Module):
         out = _cache_attention(
             query, keys, key_scale, values, value_scale, mask
         )
+        if self.mesh is not None:
+            out = _gather_model_axis(self.mesh, out, rows=False)
         return proj.general(
             features=x.shape[-1], axis=(-2, -1), dtype=self.dtype,
             name="attn_out",
@@ -1127,6 +1152,7 @@ class _PagedBlock(nn.Module):
     block_size: int
     kv_quant_int8: bool = False
     weights_int8: bool = False
+    mesh: Any = None
 
     @nn.compact
     def __call__(self, x, index=None, tables=None, start=None,
@@ -1139,6 +1165,7 @@ class _PagedBlock(nn.Module):
             num_blocks=self.num_blocks, block_size=self.block_size,
             dtype=cfg.dtype, kv_quant_int8=self.kv_quant_int8,
             weights_int8=self.weights_int8, name="attention",
+            mesh=self.mesh,
         )
         y = nn.LayerNorm(dtype=jnp.float32, name="ln_attn")(x)
         if x.ndim == 2:
@@ -1151,8 +1178,14 @@ class _PagedBlock(nn.Module):
             )
         x = x + y
         y = nn.LayerNorm(dtype=jnp.float32, name="ln_mlp")(x)
+        constrain = None
+        if self.mesh is not None:
+            constrain = lambda h: _gather_model_axis(  # noqa: E731
+                self.mesh, h, rows=h.ndim == 2
+            )
         return x + transformer_mlp(
-            cfg, y, dense_cls=_projections(self.weights_int8).dense
+            cfg, y, dense_cls=_projections(self.weights_int8).dense,
+            constrain=constrain,
         )
 
 
@@ -1167,6 +1200,7 @@ class PagedDecodeStep(nn.Module):
     block_size: int
     kv_quant_int8: bool = False
     weights_int8: bool = False
+    mesh: Any = None
 
     @nn.compact
     def __call__(self, token, index, tables):
@@ -1185,6 +1219,7 @@ class PagedDecodeStep(nn.Module):
                 block_size=self.block_size,
                 kv_quant_int8=self.kv_quant_int8,
                 weights_int8=self.weights_int8, name=f"layer_{layer}",
+                mesh=self.mesh,
             )(x, index=index, tables=tables)
         x = nn.LayerNorm(dtype=jnp.float32, name="ln_final")(x)
         return _projections(self.weights_int8).dense(
@@ -1205,6 +1240,7 @@ class PagedPrefillChunk(nn.Module):
     block_size: int
     kv_quant_int8: bool = False
     weights_int8: bool = False
+    mesh: Any = None
 
     @nn.compact
     def __call__(self, tokens, start, table):  # [1, chunk], scalar
@@ -1224,6 +1260,7 @@ class PagedPrefillChunk(nn.Module):
                 block_size=self.block_size,
                 kv_quant_int8=self.kv_quant_int8,
                 weights_int8=self.weights_int8, name=f"layer_{layer}",
+                mesh=self.mesh,
             )(x, start=start, table=table)
         return x
 
@@ -1255,7 +1292,8 @@ class PagedSlotDecodeStep:
     def __init__(self, cfg: GPTConfig, n_slots: int, max_total: int,
                  block_size: int, num_blocks: int,
                  kv_quant_int8: bool = False,
-                 weights_int8: bool = False):
+                 weights_int8: bool = False,
+                 mesh=None):
         if max_total > cfg.max_seq_len:
             raise ValueError(
                 f"max_total {max_total} exceeds max_seq_len "
@@ -1286,15 +1324,101 @@ class PagedSlotDecodeStep:
         model = PagedDecodeStep(
             cfg, num_blocks=self.num_blocks, block_size=self.block_size,
             kv_quant_int8=kv_quant_int8, weights_int8=weights_int8,
+            mesh=mesh,
         )
-        self._cache_shapes = jax.eval_shape(
+        init_shapes = jax.eval_shape(
             lambda: model.init(
                 jax.random.PRNGKey(0),
                 jnp.zeros((self.n_slots,), jnp.int32),
                 jnp.zeros((self.n_slots,), jnp.int32),
                 jnp.zeros((self.n_slots, self.max_blocks), jnp.int32),
-            )["cache"]
+            )
         )
+        self._cache_shapes = init_shapes["cache"]
+        cache_leaves = jax.tree_util.tree_leaves(self._cache_shapes)
+        self.kv_bytes_total = sum(
+            math.prod(leaf.shape) * leaf.dtype.itemsize
+            for leaf in cache_leaves
+        )
+        self.mesh = mesh
+        if mesh is not None:
+            # pjit placement over a ('batch','model') mesh: slot rows
+            # ride 'batch', heads / MLP hidden ride 'model' through
+            # SERVE_DECODE_RULES, the KV pool shards its heads axis,
+            # tables and scalars replicate. Every program below pins
+            # BOTH in_ and out_shardings — load-bearing for the
+            # one-compile invariant: an inferred output sharding could
+            # hand the next call a differently-placed cache and
+            # silently retrace the step.
+            from jax.sharding import NamedSharding, PartitionSpec
+
+            from ..parallel import sharding as sharding_lib
+
+            if "batch" not in mesh.shape or "model" not in mesh.shape:
+                raise ValueError(
+                    "the sharded decode step needs a ('batch','model') "
+                    f"mesh, got axes {tuple(mesh.shape)}"
+                )
+            if weights_int8:
+                raise ValueError(
+                    "weights_int8 is not supported on the sharded "
+                    "decode step (the int8 kernel/scale layout has no "
+                    "'model'-axis rules yet)"
+                )
+            self.batch_shards = int(mesh.shape["batch"])
+            self.model_shards = int(mesh.shape["model"])
+            if cfg.num_heads % self.model_shards:
+                raise ValueError(
+                    f"num_heads {cfg.num_heads} must divide over "
+                    f"{self.model_shards} 'model' shards (the KV pool "
+                    "and qkv projections split on heads)"
+                )
+            if self.n_slots % self.batch_shards:
+                raise ValueError(
+                    f"n_slots {self.n_slots} must divide over "
+                    f"{self.batch_shards} 'batch' shards"
+                )
+            self.param_shardings = sharding_lib.shardings_for_tree(
+                init_shapes["params"], mesh,
+                sharding_lib.SERVE_DECODE_RULES,
+            )
+            self.cache_shardings = sharding_lib.shardings_for_tree(
+                self._cache_shapes, mesh, sharding_lib.SERVE_CACHE_RULES
+            )
+            self.kv_bytes_per_shard = sum(
+                math.prod(sh.shard_shape(leaf.shape))
+                * leaf.dtype.itemsize
+                for leaf, sh in zip(
+                    cache_leaves,
+                    jax.tree_util.tree_leaves(self.cache_shardings),
+                )
+            )
+            rep = NamedSharding(mesh, PartitionSpec())
+            rows = NamedSharding(mesh, PartitionSpec("batch"))
+            rows2 = NamedSharding(mesh, PartitionSpec("batch", None))
+            step_shardings = dict(
+                in_shardings=(
+                    self.param_shardings, self.cache_shardings,
+                    rows, rows, rows2, rows, rep,
+                ),
+                out_shardings=(self.cache_shardings, rows),
+            )
+            prefill_shardings = dict(
+                in_shardings=(
+                    self.param_shardings, self.cache_shardings,
+                    rep, rep, rep,
+                ),
+                out_shardings=self.cache_shardings,
+            )
+            copy_shardings = dict(
+                in_shardings=(self.cache_shardings, rep, rep),
+                out_shardings=self.cache_shardings,
+            )
+        else:
+            self.batch_shards = self.model_shards = 1
+            self.param_shardings = self.cache_shardings = None
+            self.kv_bytes_per_shard = self.kv_bytes_total
+            step_shardings = prefill_shardings = copy_shardings = {}
 
         def step(params, cache, tok, index, prompt, lens, tables):
             # trace-time side effect: runs once per compilation, so the
@@ -1320,11 +1444,13 @@ class PagedSlotDecodeStep:
         # donation keeps the pool a single fixed allocation on TPU;
         # the CPU runtime cannot donate (it would only warn per compile)
         donate = (1,) if jax.default_backend() != "cpu" else ()
-        self._step = jax.jit(step, donate_argnums=donate)
+        self._step = jax.jit(step, donate_argnums=donate,
+                             **step_shardings)
 
         prefill_model = PagedPrefillChunk(
             cfg, num_blocks=self.num_blocks, block_size=self.block_size,
             kv_quant_int8=kv_quant_int8, weights_int8=weights_int8,
+            mesh=mesh,
         )
 
         def prefill(params, cache, tokens, start, table):
@@ -1335,7 +1461,8 @@ class PagedSlotDecodeStep:
             )
             return updates["cache"]
 
-        self._prefill = jax.jit(prefill, donate_argnums=donate)
+        self._prefill = jax.jit(prefill, donate_argnums=donate,
+                                **prefill_shardings)
 
         def copy_block(cache, src, dst):
             self.copy_compiles += 1
@@ -1344,12 +1471,22 @@ class PagedSlotDecodeStep:
             )
 
         copy_donate = (0,) if jax.default_backend() != "cpu" else ()
-        self._copy = jax.jit(copy_block, donate_argnums=copy_donate)
+        self._copy = jax.jit(copy_block, donate_argnums=copy_donate,
+                             **copy_shardings)
 
     def init_cache(self):
         """Fresh zero pool — created from abstract shapes, one
         [num_blocks, block_size, ...] allocation per layer per k/v
-        (+ scales under int8)."""
+        (+ scales under int8). Sharded steps hand back pools already
+        placed on the mesh (heads axis on 'model'), so the first step
+        never pays a surprise reshard."""
+        if self.cache_shardings is not None:
+            return jax.tree_util.tree_map(
+                lambda s, sh: jax.device_put(
+                    jnp.zeros(s.shape, s.dtype), sh
+                ),
+                self._cache_shapes, self.cache_shardings,
+            )
         return jax.tree_util.tree_map(
             lambda s: jnp.zeros(s.shape, s.dtype), self._cache_shapes
         )
@@ -1372,6 +1509,44 @@ class PagedSlotDecodeStep:
         the copy-on-write primitive for tail blocks admitted from the
         prefix cache."""
         return self._copy(cache, int(src), int(dst))
+
+
+class ShardedPagedSlotDecodeStep(PagedSlotDecodeStep):
+    """The tensor-parallel PagedSlotDecodeStep: the same three
+    compiled programs (step / prefill / copy_block, each with its
+    trace counter and the platform-gated cache donation) pjit'd over a
+    required ('batch','model') mesh — parallel/mesh.py
+    make_device_mesh builds one, with CPU virtual devices standing in
+    when XLA_FLAGS forces a host device count.
+
+    Placement (parallel/sharding.py SERVE_DECODE_RULES /
+    SERVE_CACHE_RULES): slot rows shard on 'batch'; attention heads
+    and the MLP hidden dim shard on 'model'; the paged KV pool shards
+    its heads axis on 'model' (per-shard pool bytes =
+    kv_bytes_total / model_shards — the memory win that lets a model
+    bigger than one device's HBM serve at all); block tables and
+    scalars replicate. Only output dims are partitioned, and the paged
+    modules pin an explicit all-gather (_gather_model_axis) on every
+    'model'-sharded activation before its down-projection — replicated
+    kernels alone would let GSPMD psum partial contractions, which
+    re-associates the FP reduction — so greedy chains stay
+    bit-identical to the single-device engine (tests/test_engine.py
+    TestShardedEngine pins this on 1x2 and 2x2 virtual meshes)."""
+
+    def __init__(self, cfg: GPTConfig, n_slots: int, max_total: int,
+                 block_size: int, num_blocks: int, mesh,
+                 kv_quant_int8: bool = False,
+                 weights_int8: bool = False):
+        if mesh is None:
+            raise ValueError(
+                "ShardedPagedSlotDecodeStep requires a mesh "
+                "(parallel/mesh.py make_device_mesh)"
+            )
+        super().__init__(
+            cfg, n_slots, max_total, block_size, num_blocks,
+            kv_quant_int8=kv_quant_int8, weights_int8=weights_int8,
+            mesh=mesh,
+        )
 
 
 # -- speculative decoding (prompt-lookup drafting) --------------------------

@@ -55,26 +55,62 @@ class MeshConfig:
         return (dp, self.pp, self.fsdp, self.ep, self.sp, self.tp)
 
 
+def make_device_mesh(
+    shape: Sequence[int],
+    axis_names: Sequence[str] = ("batch", "model"),
+    devices: Optional[Sequence[jax.Device]] = None,
+) -> Mesh:
+    """THE mesh constructor — both the trainer (via build_mesh) and the
+    serve engine's sharded decode step build their meshes here instead
+    of ad-hoc np.reshape calls.
+
+    Device order matters: jax.devices() enumerates TPU devices in
+    ICI-contiguous order, so reshaping that order keeps the innermost
+    axes on directly-wired neighbors and pushes the outer axes across
+    hosts/DCN. On CPU the same shapes work against virtual devices
+    (XLA_FLAGS=--xla_force_host_platform_device_count=N, set before
+    jax imports — tests/conftest.py and the engine smoke do this).
+
+    Device-count fallback: when the host has FEWER devices than the
+    requested shape, collapse onto the first axis — (len(devices),
+    1, ...) — so small hosts run the same code replicated-but-correct
+    rather than failing at mesh construction. When it has MORE, only
+    the first prod(shape) devices join the mesh.
+    """
+    shape = tuple(int(dim) for dim in shape)
+    if len(shape) != len(axis_names):
+        raise ValueError(
+            f"mesh shape {shape} has {len(shape)} axes for axis names "
+            f"{tuple(axis_names)}"
+        )
+    if any(dim < 1 for dim in shape):
+        raise ValueError(f"mesh axes must be >= 1, got {shape}")
+    devs = list(devices if devices is not None else jax.devices())
+    want = int(np.prod(shape))
+    if want > len(devs):
+        shape = (len(devs),) + (1,) * (len(shape) - 1)
+        want = len(devs)
+    return Mesh(
+        np.array(devs[:want]).reshape(shape), tuple(axis_names)
+    )
+
+
 def build_mesh(
     config: Optional[MeshConfig] = None,
     devices: Optional[Sequence[jax.Device]] = None,
 ) -> Mesh:
-    """Build a Mesh over the given (default: all) devices.
-
-    Device order matters: jax.devices() enumerates TPU devices in
-    ICI-contiguous order, so reshaping that order into
-    (dp, pp, fsdp, ep, sp, tp) keeps the innermost axes (tp, sp, ep) on
-    directly-wired neighbors and pushes the dp/pp axes across hosts/DCN.
-    """
+    """Build the canonical six-axis training Mesh over the given
+    (default: all) devices; MeshConfig.resolve guarantees the shape
+    matches the device count exactly, so make_device_mesh's fallback
+    never engages on this path."""
     config = config or MeshConfig()
     devs = list(devices if devices is not None else jax.devices())
     shape = config.resolve(len(devs))
-    device_array = np.array(devs).reshape(shape)
-    return Mesh(device_array, AXES)
+    return make_device_mesh(shape, AXES, devs)
 
 
 def single_device_mesh() -> Mesh:
-    return Mesh(np.array(jax.devices()[:1]).reshape((1,) * len(AXES)), AXES)
+    return make_device_mesh((1,) * len(AXES), AXES, jax.devices()[:1])
 
 
 def batch_sharding(mesh: Mesh) -> NamedSharding:

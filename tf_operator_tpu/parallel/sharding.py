@@ -55,6 +55,39 @@ CONV_RULES: Rules = (
 
 REPLICATED_RULES: Rules = ((r".*", PartitionSpec()),)
 
+# Serve-engine decode mesh ('batch', 'model') — the tensor-parallel
+# recipe for the sharded paged decode step (models/gpt.py
+# ShardedPagedSlotDecodeStep). Deliberately OUTPUT-dim-only: the qkv
+# head projections ([hidden, heads, head_dim]) split heads on 'model'
+# and the MLP up-projection splits its hidden dim, while attn_out /
+# mlp_out / lm_head / embeddings REPLICATE. Replicated down-projection
+# kernels alone do NOT pin the dataflow: GSPMD may still contract each
+# shard's activation slice against the matching kernel rows and psum
+# the partials — same wire bytes as a gather, but the psum
+# re-associates the floating-point reduction, and the engine's
+# acceptance bar is greedy chains bit-identical to the single-device
+# step (tests/test_engine.py TestShardedEngine). The paged modules
+# therefore force the all-gather with an explicit sharding constraint
+# on the activation before every down-projection (models/gpt.py
+# _gather_model_axis), so each contraction runs full-width per shard.
+SERVE_DECODE_RULES: Rules = (
+    (r".*(query|key|value)/kernel$", PartitionSpec(None, "model", None)),
+    (r".*(query|key|value)/bias$", PartitionSpec("model", None)),
+    (r".*mlp_in/kernel$", PartitionSpec(None, "model")),
+    (r".*mlp_in/bias$", PartitionSpec("model")),
+    (r".*", PartitionSpec()),
+)
+
+# The paged KV block pool: [num_blocks, block_size, heads, head_dim]
+# pools shard the heads dim on 'model' (aligned with the qkv head
+# split above, so the scatter/gather never crosses shards); _spec_for
+# truncates the spec to (None, None, 'model') for the 3-D int8
+# *_scale pools — the same heads dim. Block tables stay host-side /
+# replicated; per-shard pool bytes = total / model_shards.
+SERVE_CACHE_RULES: Rules = (
+    (r".*", PartitionSpec(None, None, "model", None)),
+)
+
 
 def _path_str(path) -> str:
     parts = []

@@ -120,7 +120,36 @@ METRIC_HELP = {
     "engine_fanout_seconds_total":
         "Wall-clock seconds spent fanning step outputs out to "
         "request streams (per-slot emit loop)",
+    "engine_mesh_devices":
+        "Devices in the engine's decode mesh (1 = single-device)",
+    "engine_mesh_model_shards":
+        "Size of the decode mesh's 'model' axis (tensor-parallel "
+        "shards)",
+    "engine_kv_pool_bytes": "Total bytes of the paged KV block pool",
+    "engine_kv_shard_bytes":
+        "Paged KV pool bytes resident per device shard "
+        "(= pool bytes / model shards)",
 }
+
+
+def _parse_mesh_shape(mesh_shape):
+    """('batch','model') mesh shape from a (rows, cols) tuple or an
+    'RxC' string ('1x2', '2x2' — the --mesh-shape flag's wire form)."""
+    if isinstance(mesh_shape, str):
+        try:
+            parts = tuple(
+                int(dim) for dim in mesh_shape.lower().split("x")
+            )
+        except ValueError:
+            parts = ()
+    else:
+        parts = tuple(int(dim) for dim in mesh_shape)
+    if len(parts) != 2 or any(dim < 1 for dim in parts):
+        raise ValueError(
+            f"mesh_shape must be 'BATCHxMODEL' or (batch, model) with "
+            f"axes >= 1, got {mesh_shape!r}"
+        )
+    return parts
 
 
 class BlockPool:
@@ -395,6 +424,7 @@ class ContinuousBatchingEngine:
         kv_blocks: int = 0,
         prefill_chunk: int = 64,
         prefix_cache: bool = True,
+        mesh_shape=None,
     ):
         from ..models import gpt as gpt_lib
 
@@ -425,10 +455,35 @@ class ContinuousBatchingEngine:
                 raise ValueError(
                     f"kv_blocks must be >= 1, got {usable}"
                 )
-            self.step = gpt_lib.PagedSlotDecodeStep(
-                cfg, s, max_total, block_size, usable + 1,
-                kv_quant_int8=kv_quant_int8, weights_int8=weights_int8,
-            )
+            if mesh_shape is not None:
+                # SPMD tensor-parallel serving: the same engine loop,
+                # the same host-side BlockPool bookkeeping, but the
+                # three compiled programs pjit over a ('batch','model')
+                # mesh with the KV pool's heads axis sharded on
+                # 'model'. Params are placed ONCE here (and on
+                # swap_params) so every step hits its pinned
+                # in_shardings without a per-call reshard.
+                from ..parallel import mesh as mesh_lib
+                from ..parallel import sharding as sharding_lib
+
+                self.mesh = mesh_lib.make_device_mesh(
+                    _parse_mesh_shape(mesh_shape)
+                )
+                self.step = gpt_lib.ShardedPagedSlotDecodeStep(
+                    cfg, s, max_total, block_size, usable + 1,
+                    self.mesh, kv_quant_int8=kv_quant_int8,
+                    weights_int8=weights_int8,
+                )
+                self.params = sharding_lib.place(
+                    params, self.step.param_shardings
+                )
+            else:
+                self.mesh = None
+                self.step = gpt_lib.PagedSlotDecodeStep(
+                    cfg, s, max_total, block_size, usable + 1,
+                    kv_quant_int8=kv_quant_int8,
+                    weights_int8=weights_int8,
+                )
             self.pool = BlockPool(usable + 1, block_size)
             self.prefill_chunk = int(prefill_chunk)
             self._prefix_cache = bool(prefix_cache)
@@ -442,6 +497,12 @@ class ContinuousBatchingEngine:
                 np.zeros((self.max_blocks,), np.int32) for _ in range(s)
             ]
         else:
+            if mesh_shape is not None:
+                raise ValueError(
+                    "mesh_shape requires kv_layout='paged' (only the "
+                    "paged step compiles a sharded variant)"
+                )
+            self.mesh = None
             self.step = gpt_lib.SlotDecodeStep(
                 cfg, s, max_total,
                 kv_quant_int8=kv_quant_int8, weights_int8=weights_int8,
@@ -449,6 +510,12 @@ class ContinuousBatchingEngine:
             self.pool = None
             self.prefill_chunk = 0
             self._prefix_cache = False
+        self.mesh_devices = (
+            int(self.mesh.size) if self.mesh is not None else 1
+        )
+        self.model_shards = (
+            int(self.mesh.shape["model"]) if self.mesh is not None else 1
+        )
         # slot -> {"offset", "decode_start"} while chunk-prefilling;
         # always present (empty under dense) so the loop can test it
         self._prefilling: dict = {}
@@ -706,7 +773,16 @@ class ContinuousBatchingEngine:
                     "swap_params requires a drained engine "
                     "(pause_admission + drain first)"
                 )
-            self.params = params
+            if self.mesh is not None:
+                # re-place on the mesh: the compiled step's pinned
+                # in_shardings expect 'model'-sharded kernels
+                from ..parallel import sharding as sharding_lib
+
+                self.params = sharding_lib.place(
+                    params, self.step.param_shardings
+                )
+            else:
+                self.params = params
             if self._paged:
                 # cached prompt K/V was computed under the OLD weights
                 self.pool.flush()
@@ -772,6 +848,8 @@ class ContinuousBatchingEngine:
             ("engine_active_slots", "gauge"): self.active_slots,
             ("engine_queue_depth", "gauge"): self.queue_depth,
             ("engine_peak_active_slots", "gauge"): self.peak_active,
+            ("engine_mesh_devices", "gauge"): self.mesh_devices,
+            ("engine_mesh_model_shards", "gauge"): self.model_shards,
         }
         if self._paged:
             pool = self.pool
@@ -794,6 +872,10 @@ class ContinuousBatchingEngine:
                     self.prefill_chunks,
                 ("engine_prefill_seconds_total", "counter"):
                     self.prefill_seconds,
+                ("engine_kv_pool_bytes", "gauge"):
+                    self.step.kv_bytes_total,
+                ("engine_kv_shard_bytes", "gauge"):
+                    self.step.kv_bytes_per_shard,
             })
         return out
 
@@ -1192,9 +1274,32 @@ def main(argv=None) -> int:
     parser.add_argument("--block-size", type=int, default=64)
     parser.add_argument("--kv-blocks", type=int, default=0)
     parser.add_argument("--prefill-chunk", type=int, default=64)
+    parser.add_argument(
+        "--mesh", default="",
+        help="('batch','model') mesh shape for the sharded paged "
+             "step, e.g. 1x2; hosts short on devices get CPU virtual "
+             "devices via --xla_force_host_platform_device_count",
+    )
     parser.add_argument("--smoke", action="store_true",
                         help="accepted for CI-invocation clarity")
     args = parser.parse_args(argv)
+
+    mesh_shape = None
+    if args.mesh:
+        if args.layout != "paged":
+            parser.error("--mesh requires --layout paged")
+        mesh_shape = _parse_mesh_shape(args.mesh)
+        # must land BEFORE the first jax import: XLA reads the flag at
+        # backend init, and this module deliberately defers jax to
+        # here (tests/conftest.py and bench.py use the same idiom)
+        import os
+
+        want = mesh_shape[0] * mesh_shape[1]
+        flags = os.environ.get("XLA_FLAGS", "")
+        if "--xla_force_host_platform_device_count" not in flags:
+            os.environ["XLA_FLAGS"] = (
+                flags + f" --xla_force_host_platform_device_count={want}"
+            ).strip()
 
     import jax
     import jax.numpy as jnp
@@ -1208,7 +1313,7 @@ def main(argv=None) -> int:
     engine = ContinuousBatchingEngine(
         cfg, params, n_slots=args.slots, kv_layout=args.layout,
         block_size=args.block_size, kv_blocks=args.kv_blocks,
-        prefill_chunk=args.prefill_chunk,
+        prefill_chunk=args.prefill_chunk, mesh_shape=mesh_shape,
     )
     paged = args.layout == "paged"
     rng = np.random.default_rng(0)
@@ -1261,6 +1366,23 @@ def main(argv=None) -> int:
         report["cow_copies"] = engine.pool.cow_copies
         ok = ok and engine.step.prefill_compiles <= 1
         ok = ok and engine.pool.hits > 0
+        if mesh_shape is not None:
+            # the sharded acceptance bar, read off the gauges the
+            # router scrapes: the requested mesh actually formed (no
+            # silent single-device fallback) and the KV pool's
+            # per-shard residency is exactly 1/N of the pool
+            gauges = engine.metrics()
+            devices = gauges[("engine_mesh_devices", "gauge")]
+            shards = gauges[("engine_mesh_model_shards", "gauge")]
+            pool_bytes = gauges[("engine_kv_pool_bytes", "gauge")]
+            shard_bytes = gauges[("engine_kv_shard_bytes", "gauge")]
+            report["mesh_devices"] = devices
+            report["model_shards"] = shards
+            report["kv_pool_bytes"] = pool_bytes
+            report["kv_shard_bytes"] = shard_bytes
+            ok = ok and devices == mesh_shape[0] * mesh_shape[1]
+            ok = ok and shards == mesh_shape[1]
+            ok = ok and shard_bytes * shards == pool_bytes
         engine.stop()
         engine.pool.check()
         ok = ok and engine.pool.in_use() == 0

@@ -84,6 +84,7 @@ class InProcessFleet:
         cfg,
         params_by_version: Dict[str, object],
         slots: int = 2,
+        mesh_shape: str = "",
         namespace: Optional[str] = None,
         fault_log: Optional[FaultLog] = None,
     ) -> None:
@@ -94,6 +95,10 @@ class InProcessFleet:
         # fleet should serve for pods created before a version was set
         self.params_by_version = params_by_version
         self.slots = slots
+        # ServeServiceSpec.mesh_shape ("1x2"); every replica this
+        # fleet boots shares the one decode mesh shape, mirroring the
+        # one --mesh-shape flag the default pod command carries
+        self.mesh_shape = mesh_shape
         self.namespace = namespace
         self.fault_log = fault_log
         self._lock = locks.make_lock("InProcessFleet._lock")
@@ -136,6 +141,7 @@ class InProcessFleet:
             server = make_server(
                 self.cfg, params, port=0, model_name=name,
                 batching="continuous", n_slots=self.slots,
+                mesh_shape=self.mesh_shape or None,
                 warm_async=True,
             )
             thread = threading.Thread(

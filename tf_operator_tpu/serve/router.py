@@ -47,6 +47,7 @@ _ROW_STEPS = "tf_operator_tpu_serve_engine_row_steps_total"
 _STEPS = "tf_operator_tpu_serve_engine_steps_total"
 _KV_IN_USE = "tf_operator_tpu_serve_engine_kv_blocks_in_use"
 _KV_TOTAL = "tf_operator_tpu_serve_engine_kv_blocks_total"
+_MESH_DEVICES = "tf_operator_tpu_serve_engine_mesh_devices"
 
 # connection-level failures that mean "this replica, this attempt" —
 # the stream fails over, the replica gets a probe before reuse
@@ -77,6 +78,7 @@ class Replica:
         self.active_slots = 0.0
         self.mean_active = 0.0
         self.kv_occupancy = 0.0  # paged pool fill fraction, 0..1
+        self.mesh_devices = 1.0  # decode mesh size (1 = single-device)
         self.failures = 0
 
     def score(self) -> tuple:
@@ -87,10 +89,19 @@ class Replica:
         a memory-full replica from winning ties on slot count alone —
         its next admit would queue behind the block pool; mean active
         slots breaks remaining ties toward the replica that has
-        historically run emptier."""
+        historically run emptier.
+
+        Mesh capacity: a sharded replica is ONE replica, not N — its
+        slot grid and block pool don't multiply — but its N devices
+        step every slot faster, so queued work drains sooner. Only the
+        COMPUTE-bound terms (inflight, queue depth) divide by the mesh
+        size; the structural terms (active slots, KV occupancy) stay
+        per-replica because a full slot grid or block pool blocks the
+        next admit no matter how many shards serve it."""
         return (
-            2 * self.inflight + self.queue_depth + self.active_slots
-            + 4 * self.kv_occupancy,
+            (2 * self.inflight + self.queue_depth)
+            / max(1.0, self.mesh_devices)
+            + self.active_slots + 4 * self.kv_occupancy,
             self.mean_active,
             self.name,
         )
@@ -184,6 +195,10 @@ class LeastLoadedRouter:
                     replica.kv_occupancy = (
                         flat.get(_KV_IN_USE, 0.0) / kv_total
                         if kv_total else 0.0  # dense engines: no gauge
+                    )
+                    # pre-gauge replicas (older engines) stay at 1
+                    replica.mesh_devices = max(
+                        1.0, flat.get(_MESH_DEVICES, 1.0)
                     )
                 replica.ready = ok
             except Exception:  # noqa: BLE001 — an unreachable replica
@@ -372,6 +387,7 @@ class LeastLoadedRouter:
                         "queue_depth": r.queue_depth,
                         "active_slots": r.active_slots,
                         "kv_occupancy": r.kv_occupancy,
+                        "mesh_devices": r.mesh_devices,
                         "failures": r.failures,
                     }
                     for r in self._replicas.values()

@@ -948,6 +948,7 @@ def make_server(
     speculative: bool = False,
     weights_int8: bool = False,
     mesh=None,
+    mesh_shape=None,
     warm_shapes=None,
     batching: str = "",
     n_slots: int = 8,
@@ -1000,7 +1001,20 @@ def make_server(
         if mesh is not None:
             raise ValueError(
                 "batching='continuous' and mesh are mutually exclusive: "
-                "the slot engine is a single-device program"
+                "the generate(mesh=) path belongs to inline decode; the "
+                "engine shards through mesh_shape instead "
+                "(ShardedPagedSlotDecodeStep)"
+            )
+    if mesh_shape is not None:
+        if batching != "continuous":
+            raise ValueError(
+                "mesh_shape requires batching='continuous': only the "
+                "slot engine compiles the sharded decode step"
+            )
+        if kv_layout != "paged":
+            raise ValueError(
+                "mesh_shape requires kv_layout='paged': the sharded "
+                "step partitions the paged block pool"
             )
     if warm_async and batching != "continuous":
         raise ValueError(
@@ -1090,6 +1104,7 @@ def make_server(
                 registry=state.registry, tracer=state.tracer,
                 kv_layout=kv_layout, block_size=block_size,
                 kv_blocks=kv_blocks, prefill_chunk=prefill_chunk,
+                mesh_shape=mesh_shape,
             )
 
         if warm_async:
@@ -1358,6 +1373,17 @@ def main(argv=None) -> int:
         "warm the batcher's power-of-two batch buckets",
     )
     parser.add_argument(
+        "--mesh-shape", default="",
+        metavar="BATCHxMODEL",
+        help="('batch','model') mesh for the sharded continuous-"
+        "batching decode step, e.g. 1x2: attention heads and the "
+        "paged KV pool partition on the model axis, slot rows on the "
+        "batch axis (models/gpt.py ShardedPagedSlotDecodeStep). "
+        "Requires --batching continuous and --kv-layout paged; hosts "
+        "short on devices get CPU virtual devices via "
+        "--xla_force_host_platform_device_count",
+    )
+    parser.add_argument(
         "--tp", type=int, default=1,
         help="tensor-parallel degree for sharded decode: params place "
         "by TRANSFORMER_RULES over a dp x tp mesh and GSPMD shards "
@@ -1384,6 +1410,33 @@ def main(argv=None) -> int:
     logging.basicConfig(level=logging.INFO, stream=sys.stderr)
     if args.smoke:
         return _smoke()
+
+    mesh_shape = None
+    if args.mesh_shape:
+        if args.batching != "continuous":
+            parser.error("--mesh-shape requires --batching continuous")
+        if args.kv_layout != "paged":
+            parser.error("--mesh-shape requires --kv-layout paged")
+        if args.weights_int8:
+            parser.error(
+                "--mesh-shape and --weights-int8 are mutually "
+                "exclusive: the sharded step has no int8-kernel "
+                "partition rules yet"
+            )
+        from .engine import _parse_mesh_shape
+
+        try:
+            mesh_shape = _parse_mesh_shape(args.mesh_shape)
+        except ValueError as exc:
+            parser.error(str(exc))
+        # must land BEFORE the first jax import: XLA reads the flag at
+        # backend init (same idiom as the engine smoke's --mesh)
+        want = mesh_shape[0] * mesh_shape[1]
+        flags = os.environ.get("XLA_FLAGS", "")
+        if "--xla_force_host_platform_device_count" not in flags:
+            os.environ["XLA_FLAGS"] = (
+                flags + f" --xla_force_host_platform_device_count={want}"
+            ).strip()
 
     import jax
     import jax.numpy as jnp
@@ -1544,7 +1597,7 @@ def main(argv=None) -> int:
         max_new_cap=args.max_new_cap,
         host=args.host, batch_window_ms=args.batch_window_ms,
         speculative=args.speculative, weights_int8=args.weights_int8,
-        mesh=mesh,
+        mesh=mesh, mesh_shape=mesh_shape,
         warm_shapes=warm_shapes,
         batching=args.batching, n_slots=args.slots,
         kv_layout=args.kv_layout, block_size=args.block_size,
