@@ -24,7 +24,7 @@ IMAGE_DIR := build/images
 DIST      := build/dist
 
 .PHONY: ci presubmit lint analyze native native-test native-race test wire-test e2e e2e-kind bench \
-        chaos-soak serve-soak serve-paged serve-sharded ha-soak controller-profile images release mnist-acc clean
+        chaos-soak serve-soak serve-paged serve-sharded serve-disagg ha-soak controller-profile images release mnist-acc clean
 
 # `test` already runs the whole tests/ tree (native bindings, wire,
 # E2E suites included) — native-test/wire-test exist for targeted runs,
@@ -112,6 +112,15 @@ serve-paged:
 serve-sharded:
 	env JAX_PLATFORMS=cpu $(PY) -m tf_operator_tpu.serve.engine --smoke \
 	    --layout paged --block-size 8 --prefill-chunk 6 --mesh 1x2
+
+# disaggregated prefill/decode smoke (docs/serving.md "Disaggregated
+# prefill/decode"): 1 prefill + 1 decode replica via role-typed
+# replicaGroups through the real controller, shared-prefix streams
+# routed prefix-aware, at least one KV block-set migration asserted,
+# every chain bit-identical, both pools audited clean at shutdown
+# (CI's serve-disagg-smoke)
+serve-disagg:
+	env JAX_PLATFORMS=cpu $(PY) -m tf_operator_tpu.serve.fleet --disagg
 
 # Hermetic E2E runs everywhere (operator process <-HTTP-> apiserver
 # <-HTTP-> process kubelet); the kind path self-activates when kind is

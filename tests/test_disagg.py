@@ -1,0 +1,413 @@
+"""Disaggregated prefill/decode: KV block-set export/import across
+two in-process engines (refcount discipline, prefix-cache keys,
+bit-identical mid-stream continuation), pool audits on drain/stop,
+role-typed replica groups (serde, validation, reconciler fan-out), and
+the router's prefix-overlap scoring."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from tf_operator_tpu.api import defaults, types as t, validation
+from tf_operator_tpu.api.serde import deep_copy, from_jsonable, to_jsonable
+from tf_operator_tpu.controller.serve import _desired_replicas
+from tf_operator_tpu.models import gpt as gpt_lib
+from tf_operator_tpu.serve.engine import ContinuousBatchingEngine
+from tf_operator_tpu.serve.prefix import block_prefix_hashes, prefix_hash
+from tf_operator_tpu.serve.router import Replica
+from tf_operator_tpu.telemetry.flight import FlightRecorder
+
+CFG = gpt_lib.GPT_TINY
+BS = 8  # block_size small enough that short prompts span whole blocks
+
+
+@pytest.fixture(scope="module")
+def params():
+    return gpt_lib.GPT(CFG).init(
+        jax.random.PRNGKey(0), jnp.zeros((1, 8), jnp.int32)
+    )["params"]
+
+
+def inline_chain(params, row, new):
+    out = gpt_lib.generate(
+        CFG, params, jnp.asarray([row], jnp.int32), max_new_tokens=new
+    )
+    return np.asarray(out)[0].tolist()
+
+
+def make_engine(params, flight=None):
+    return ContinuousBatchingEngine(
+        CFG, params, n_slots=2, block_size=BS, prefill_chunk=BS,
+        flight=flight,
+    )
+
+
+PROMPT = [3, 1, 4, 1, 5, 9, 2, 6, 5, 3, 5, 8, 9, 7, 9, 3, 2, 3, 8]
+# 19 tokens at BS=8: two full (migratable) blocks + a 3-token tail
+
+
+@pytest.mark.slow
+class TestKvMigration:
+    """Export -> import round trip across two live engines.
+
+    slow: every test boots (and compiles) two live engines; the class
+    costs ~50s on the CPU box, which doesn't fit tier-1's headroom.
+    CI's unit step runs the full tree unfiltered, and the disagg smoke
+    exercises the same path end-to-end.
+    """
+
+    @pytest.fixture()
+    def pair(self, params):
+        src = make_engine(params)
+        dst = make_engine(params)
+        yield src, dst
+        src.stop()
+        dst.stop()
+
+    def _prefill_and_export(self, src):
+        # decoding one token publishes the prompt's full blocks into
+        # the prefix cache; export then walks that cached chain
+        src.submit(list(PROMPT), 1).result(timeout=120.0)
+        payload = src.export_prefix_blocks(PROMPT)
+        assert payload is not None
+        return payload
+
+    def test_export_payload_shape(self, pair):
+        src, _ = pair
+        payload = self._prefill_and_export(src)
+        assert payload["block_size"] == BS
+        assert payload["blocks"] == 2
+        assert payload["tokens"] == PROMPT[:16]
+        # one encoded array per cache leaf, m blocks leading each
+        leaves, _ = jax.tree_util.tree_flatten(src._cache)
+        assert len(payload["leaves"]) == len(leaves)
+        for enc in payload["leaves"]:
+            assert enc["shape"][0] == 2
+        assert src.migrations_out == 1
+        assert src.kv_blocks_exported == 2
+        # export is read-only: the source pool still audits clean
+        src.pool.check()
+
+    def test_export_unknown_prompt_returns_none(self, pair):
+        src, _ = pair
+        assert src.export_prefix_blocks([42] * 16) is None
+        # sub-block prompts have no full block to export
+        src.submit([7, 7, 7], 1).result(timeout=120.0)
+        assert src.export_prefix_blocks([7, 7, 7]) is None
+
+    def test_import_refcounts_and_keys(self, pair):
+        src, dst = pair
+        payload = self._prefill_and_export(src)
+        assert dst.import_prefix_blocks(payload) == 2
+        pool = dst.pool
+        for j in (1, 2):
+            block = pool._cached.get(tuple(PROMPT[:j * BS]))
+            assert block is not None and block != 0
+            # refcount 1 = cache's own ref only (idle, reclaimable):
+            # indistinguishable from a prefix this engine prefilled
+            assert pool._ref[block] == 1
+        pool.check()
+        assert pool.in_use() == 0
+        assert dst.migrations_in == 1
+        assert dst.kv_blocks_imported == 2
+        # the digest now advertises both prefix keys
+        digest = set(dst.prefix_digest())
+        assert prefix_hash(PROMPT[:8]) in digest
+        assert prefix_hash(PROMPT[:16]) in digest
+
+    def test_import_is_idempotent(self, pair):
+        src, dst = pair
+        payload = self._prefill_and_export(src)
+        assert dst.import_prefix_blocks(payload) == 2
+        # a second import keeps the existing blocks authoritative:
+        # same count back, no refcount drift, no extra blocks written
+        assert dst.import_prefix_blocks(payload) == 2
+        assert dst.kv_blocks_imported == 2
+        for j in (1, 2):
+            block = dst.pool._cached[tuple(PROMPT[:j * BS])]
+            assert dst.pool._ref[block] == 1
+        dst.pool.check()
+
+    def test_import_rejects_mismatched_payloads(self, pair):
+        src, dst = pair
+        payload = self._prefill_and_export(src)
+        with pytest.raises(ValueError, match="block_size mismatch"):
+            dst.import_prefix_blocks({**payload, "block_size": BS * 2})
+        with pytest.raises(ValueError, match="malformed"):
+            dst.import_prefix_blocks({**payload, "tokens": PROMPT[:3]})
+        with pytest.raises(ValueError, match="structure mismatch"):
+            dst.import_prefix_blocks(
+                {**payload, "leaves": payload["leaves"][:1]}
+            )
+        # failed imports leave the pool untouched
+        assert dst.pool.cached_blocks() == 0
+        dst.pool.check()
+
+    def test_migrated_chain_bit_identical(self, pair):
+        """The acceptance invariant: a prompt whose prefix K/V arrived
+        by migration decodes the exact chain a monolithic engine
+        produces — with ZERO prefill chunks on the decode engine (the
+        sub-block tail rides the forcing rule)."""
+        src, dst = pair
+        payload = self._prefill_and_export(src)
+        dst.import_prefix_blocks(payload)
+        new = 12
+        got = dst.submit(list(PROMPT), new).result(timeout=120.0)
+        assert got == inline_chain(dst.params, PROMPT, new)
+        assert dst.prefill_chunks == 0
+        assert dst.pool.hits == 2
+        assert dst.pool.hit_tokens == 16
+
+    def test_mid_stream_continuation_across_migration(self, pair):
+        """The router's failover replay composed with migration: the
+        first k tokens stream on one engine, the continuation prompt
+        (prompt + emitted tokens) migrates and finishes on the other,
+        and the stitched chain is bit-identical."""
+        src, dst = pair
+        new, k = 10, 4
+        req = src.submit(list(PROMPT), new)
+        emitted = []
+        for tok in req.stream():
+            emitted.append(int(tok))
+            if len(emitted) >= k:
+                req.cancel()
+                break
+        assert len(emitted) >= k
+        continuation = list(PROMPT) + emitted[:k]
+        # prefill the continuation on the source and ship its blocks
+        src.submit(list(continuation), 1).result(timeout=120.0)
+        payload = src.export_prefix_blocks(continuation)
+        dst.import_prefix_blocks(payload)
+        rest = dst.submit(
+            list(continuation), new - k
+        ).result(timeout=120.0)
+        assert rest == inline_chain(dst.params, PROMPT, new)
+
+
+@pytest.mark.slow
+class TestPoolAudits:
+    """BlockPool.check() runs automatically on drain and stop,
+    surfaced as a flight record + counter, never a crash.
+
+    slow: boots a live engine per test (see TestKvMigration).
+    """
+
+    def test_drain_and_stop_audit_clean(self, params):
+        flight = FlightRecorder(capacity=256)
+        eng = make_engine(params, flight=flight)
+        try:
+            eng.submit(list(PROMPT), 2).result(timeout=120.0)
+            assert eng.drain(timeout=60.0)
+            audits = [
+                r for r in flight.snapshot(kind="serve")
+                if r.fields.get("op") == "pool-audit"
+            ]
+            assert audits and audits[-1].fields["where"] == "drain"
+            assert audits[-1].fields["ok"] is True
+            eng.resume_admission()
+        finally:
+            eng.stop()
+        audits = [
+            r for r in flight.snapshot(kind="serve")
+            if r.fields.get("op") == "pool-audit"
+        ]
+        assert audits[-1].fields["where"] == "stop"
+        assert eng.pool_audit_failures == 0
+
+    def test_corrupt_pool_surfaces_as_counter(self, params):
+        flight = FlightRecorder(capacity=64)
+        eng = make_engine(params, flight=flight)
+        try:
+            eng.drain(timeout=60.0)
+            # sabotage an invariant: a failed audit must be a counter
+            # and a flight record, not an unhandled assertion
+            eng.pool._ref[0] = 0
+            assert eng.audit_pool("test") is False
+            assert eng.pool_audit_failures == 1
+            bad = [
+                r for r in flight.snapshot(kind="serve")
+                if r.fields.get("op") == "pool-audit"
+                and r.fields.get("ok") is False
+            ]
+            assert bad and "sentinel" in bad[-1].fields["error"]
+        finally:
+            eng.pool._ref[0] = 1
+            eng.stop()
+
+    def test_metrics_expose_migration_counters(self, params):
+        eng = make_engine(params)
+        try:
+            eng.submit(list(PROMPT), 1).result(timeout=120.0)
+            payload = eng.export_prefix_blocks(PROMPT)
+            assert payload is not None
+            flat = {
+                (name, kind): value
+                for (name, kind), value in eng.metrics().items()
+            }
+            assert flat[("engine_kv_blocks_exported_total", "counter")] == 2
+            assert flat[("engine_migrations_out_total", "counter")] == 1
+            assert flat[
+                ("engine_pool_audit_failures_total", "counter")
+            ] == 0
+        finally:
+            eng.stop()
+
+
+class TestPrefixHashes:
+    def test_rolling_hashes_match_prefix_hash(self):
+        row = list(range(1, 30))
+        hashes = block_prefix_hashes(row, 8)
+        assert len(hashes) == 3  # 29 tokens -> 3 full blocks
+        for j, h in enumerate(hashes):
+            assert h == prefix_hash(row[:(j + 1) * 8])
+
+    def test_limit_and_degenerate_inputs(self):
+        assert block_prefix_hashes([1, 2, 3], 8) == []
+        assert block_prefix_hashes([], 8) == []
+        assert block_prefix_hashes(list(range(100)), 4, limit=2) == [
+            prefix_hash(list(range(4))), prefix_hash(list(range(8))),
+        ]
+
+    def test_hash_is_value_sensitive(self):
+        assert prefix_hash([1, 2, 3]) != prefix_hash([1, 2, 4])
+        assert prefix_hash([1, 2, 3]) != prefix_hash([1, 2])
+        # tuples and lists hash identically (cache keys are tuples)
+        assert prefix_hash((1, 2, 3)) == prefix_hash([1, 2, 3])
+
+
+class TestRouterScoring:
+    """Prefix overlap folds into placement as a bounded discount."""
+
+    def _replica(self, name, digest, block_size=8):
+        r = Replica(name, f"http://x/{name}", client=None)
+        r.healthy = True
+        r.block_size = block_size
+        r.digest = set(digest)
+        return r
+
+    def test_overlap_counts_matching_block_hashes(self):
+        row = list(range(16))
+        hashes = {8: set(block_prefix_hashes(row, 8))}
+        full = self._replica("full", block_prefix_hashes(row, 8))
+        cold = self._replica("cold", [])
+        other = self._replica("other", block_prefix_hashes(row, 4), 4)
+        assert full.overlap(hashes) == 2
+        assert cold.overlap(hashes) == 0
+        # digest in a different block-size vocabulary never matches
+        assert other.overlap(hashes) == 0
+        assert full.overlap(None) == 0
+
+    def test_overlap_discount_breaks_load_ties(self):
+        row = list(range(16))
+        hashes = {8: set(block_prefix_hashes(row, 8))}
+        warm = self._replica("warm", block_prefix_hashes(row, 8))
+        cold = self._replica("cold", [])
+        assert warm.score(warm.overlap(hashes)) < cold.score(0)
+        comps = warm.score_components(warm.overlap(hashes))
+        assert comps["prefix_overlap"] == 2
+        assert comps["overlap_discount"] > 0
+        # score() returns (score, mean_active tiebreak, name tiebreak)
+        assert comps["score"] == warm.score(2)[0]
+
+    def test_overlap_discount_is_capped(self):
+        r = self._replica("r", [])
+        assert r.score(8) == r.score(100)  # _OVERLAP_CAP
+
+
+class TestRoleGroups:
+    """ServeServiceSpec.replica_groups: serde, defaults, validation,
+    and the reconciler's role-aware fan-out."""
+
+    def _svc(self, groups):
+        svc = t.ServeService(
+            spec=t.ServeServiceSpec(
+                preset="tiny", slots=4, weights_version="v1",
+                replica_groups=groups,
+            )
+        )
+        svc.metadata.name = "svc"
+        svc.metadata.namespace = "ns"
+        return svc
+
+    def test_serde_round_trip_camel_case(self):
+        svc = self._svc({
+            "prefill": t.ServeReplicaGroup(
+                replicas=2, slots=1, prefill_chunk=128
+            ),
+            "decode": t.ServeReplicaGroup(replicas=3),
+        })
+        wire = to_jsonable(svc)
+        groups = wire["spec"]["replicaGroups"]
+        assert groups["prefill"]["prefillChunk"] == 128
+        back = from_jsonable(wire, t.ServeService)
+        assert back.spec.replica_groups["prefill"].replicas == 2
+        assert back.spec.replica_groups["decode"].replicas == 3
+        assert deep_copy(svc).spec.replica_groups == svc.spec.replica_groups
+
+    def test_defaults_fill_group_fields(self):
+        svc = self._svc({
+            "Prefill": t.ServeReplicaGroup(),  # case-normalized
+        })
+        defaults.set_serve_defaults(svc)
+        groups = svc.spec.replica_groups
+        assert "prefill" in groups and "Prefill" not in groups
+        assert groups["prefill"].replicas == 1
+        assert groups["prefill"].slots == 4  # inherits spec.slots
+
+    def test_validation_rejects_bad_groups(self):
+        bad = [
+            ({"router": t.ServeReplicaGroup()}, "not a serve role"),
+            (
+                {"prefill": t.ServeReplicaGroup(replicas=0)},
+                r"replicaGroups\['prefill'\].replicas",
+            ),
+            ({"decode": t.ServeReplicaGroup(slots=0)}, "slots"),
+            (
+                {"decode": t.ServeReplicaGroup(prefill_chunk=-1)},
+                "prefillChunk",
+            ),
+        ]
+        for groups, needle in bad:
+            svc = self._svc(groups)
+            defaults.set_serve_defaults(svc)
+            with pytest.raises(
+                validation.ValidationError, match=needle
+            ):
+                validation.validate_serve_service(svc)
+        ok = self._svc({
+            "prefill": t.ServeReplicaGroup(replicas=1),
+            "decode": t.ServeReplicaGroup(replicas=2),
+        })
+        defaults.set_serve_defaults(ok)
+        validation.validate_serve_service(ok)  # no raise
+
+    def test_desired_replicas_role_fan_out(self):
+        svc = self._svc({
+            "decode": t.ServeReplicaGroup(replicas=2),
+            "prefill": t.ServeReplicaGroup(replicas=1),
+        })
+        desired = _desired_replicas(svc)
+        # SERVE_ROLES order (prefill before decode), index within role
+        assert [name for name, _, _, _ in desired] == [
+            "svc-prefill-0", "svc-decode-0", "svc-decode-1",
+        ]
+        assert [(role, i) for _, i, role, _ in desired] == [
+            ("prefill", 0), ("decode", 0), ("decode", 1),
+        ]
+
+    def test_desired_replicas_without_groups_is_flat(self):
+        svc = self._svc(None)
+        svc.spec.replica_groups = {}
+        svc.spec.replicas = 2
+        desired = _desired_replicas(svc)
+        assert [name for name, _, _, _ in desired] == [
+            "svc-engine-0", "svc-engine-1",
+        ]
+        assert all(role == "" for _, _, role, _ in desired)
+
+    def test_role_replica_names(self):
+        assert t.serve_role_replica_name("svc", "prefill", 0) == (
+            "svc-prefill-0"
+        )
+        assert t.SERVE_ROLES == ("prefill", "decode")

@@ -108,6 +108,24 @@ def set_serve_defaults(svc: t.ServeService) -> t.ServeService:
         spec.slots = 8
     if spec.port is None:
         spec.port = t.DEFAULT_SERVE_PORT
+    # role-typed replica groups (disaggregated prefill/decode):
+    # normalize role-key case to the SERVE_ROLES spellings, then
+    # default each group's scale to 1 and its slots to the fleet-wide
+    # spec.slots (prefill_chunk stays None = engine default unless the
+    # spec pins it per role)
+    if spec.replica_groups:
+        canonical = {role.lower(): role for role in t.SERVE_ROLES}
+        spec.replica_groups = {
+            canonical.get(key.lower(), key): group
+            for key, group in spec.replica_groups.items()
+        }
+        for group in spec.replica_groups.values():
+            if group is None:
+                continue  # validation reports nil groups; don't crash
+            if group.replicas is None:
+                group.replicas = 1
+            if group.slots is None:
+                group.slots = spec.slots
     pod_spec = spec.template.spec
     if not pod_spec.containers:
         pod_spec.containers.append(

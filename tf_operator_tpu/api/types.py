@@ -358,6 +358,37 @@ LABEL_SERVE_REPLICA_INDEX = "serve-replica-index"
 # successful in-place drain+swap: the reconciler's rolling-update
 # progress lives on the pods themselves, surviving controller restarts
 LABEL_SERVE_WEIGHTS = "serve-weights-version"
+# disaggregated serving: which role pool the replica belongs to
+# ("prefill" / "decode"); absent on monolithic fleets
+LABEL_SERVE_ROLE = "serve-replica-role"
+
+# the role vocabulary for spec.replicaGroups — the serving twin of the
+# tfReplicaSpecs role map (Chief/Worker/PS), scoped to the two phases
+# disaggregated serving splits (DistServe/Splitwise): prefill-heavy
+# replicas ingest prompts and ship the resulting KV block set; decode-
+# heavy replicas admit the migrated blocks and stream tokens
+SERVE_ROLE_PREFILL = "prefill"
+SERVE_ROLE_DECODE = "decode"
+SERVE_ROLES = (SERVE_ROLE_PREFILL, SERVE_ROLE_DECODE)
+
+
+@dataclass
+class ServeReplicaGroup:
+    """Per-role replica group (spec.replicaGroups values) — mirrors
+    the shape of ReplicaSpec for the serving fleet: a scale plus the
+    role-differentiating engine knobs."""
+
+    replicas: Optional[int] = None
+    # engine slot-grid width for this role's replicas; None inherits
+    # spec.slots (prefill pools usually run narrow, decode pools wide)
+    slots: Optional[int] = None
+    # chunked-prefill width for this role's replicas; None inherits
+    # the engine default. Decode replicas can pin it small — migrated
+    # prompts arrive as cached blocks and skip prefill entirely
+    prefill_chunk: Optional[int] = field(
+        default=None, metadata={"json": "prefillChunk"}
+    )
+    extra: Dict[str, Any] = field(default_factory=dict)
 
 
 @dataclass
@@ -383,7 +414,25 @@ class ServeServiceSpec:
     weights_version: str = field(
         default="", metadata={"json": "weightsVersion"}
     )
+    # role-typed replica groups (disaggregated prefill/decode) — the
+    # serving analog of tfReplicaSpecs. Empty = monolithic: the fleet
+    # is spec.replicas role-less engines, today's behavior. Non-empty
+    # = keys from SERVE_ROLES, each scaled/rolled/reported per role;
+    # spec.replicas is then ignored in favor of the groups' sum
+    replica_groups: Dict[str, ServeReplicaGroup] = field(
+        default_factory=dict, metadata={"json": "replicaGroups"}
+    )
     template: PodTemplateSpec = field(default_factory=PodTemplateSpec)
+    extra: Dict[str, Any] = field(default_factory=dict)
+
+
+@dataclass
+class ServeRoleStatus:
+    """Per-role slice of ServeServiceStatus (status.roleStatuses)."""
+
+    replicas: int = 0
+    ready_replicas: int = 0
+    updated_replicas: int = 0
     extra: Dict[str, Any] = field(default_factory=dict)
 
 
@@ -395,6 +444,12 @@ class ServeServiceStatus:
     updated_replicas: int = 0
     # replica pods replaced after terminal exits (chaos 137s)
     restarts: int = 0
+    # per-role readiness when spec.replicaGroups is set (empty for
+    # monolithic fleets): role -> counts, so "the decode pool is
+    # short" is visible without reading pod labels
+    role_statuses: Dict[str, ServeRoleStatus] = field(
+        default_factory=dict, metadata={"json": "roleStatuses"}
+    )
     conditions: List[JobCondition] = field(default_factory=list)
     extra: Dict[str, Any] = field(default_factory=dict)
 
@@ -441,6 +496,11 @@ class ServeService:
 def serve_replica_name(service_name: str, index: int) -> str:
     """Replica pod name: "{service}-engine-{index}"."""
     return f"{service_name}-engine-{index}".replace("/", "-")
+
+
+def serve_role_replica_name(service_name: str, role: str, index: int) -> str:
+    """Role-group replica pod name: "{service}-{role}-{index}"."""
+    return f"{service_name}-{role}-{index}".replace("/", "-")
 
 
 def serve_labels(service_name: str) -> Dict[str, str]:

@@ -227,6 +227,33 @@ def validate_serve_service(svc: t.ServeService) -> None:
         )
     if not spec.preset:
         errs.append("ServeServiceSpec.preset must be specified")
+    for role, group in spec.replica_groups.items():
+        if role not in t.SERVE_ROLES:
+            errs.append(
+                f"ServeServiceSpec.replicaGroups key {role!r} is not a "
+                f"serve role ({'/'.join(t.SERVE_ROLES)})"
+            )
+        if group is None:
+            errs.append(
+                f"ServeServiceSpec.replicaGroups[{role!r}] must be "
+                "specified"
+            )
+            continue
+        if group.replicas is None or group.replicas < 1:
+            errs.append(
+                f"ServeServiceSpec.replicaGroups[{role!r}].replicas "
+                f"must be >= 1, got {group.replicas}"
+            )
+        if group.slots is not None and group.slots < 1:
+            errs.append(
+                f"ServeServiceSpec.replicaGroups[{role!r}].slots "
+                f"must be >= 1, got {group.slots}"
+            )
+        if group.prefill_chunk is not None and group.prefill_chunk < 0:
+            errs.append(
+                f"ServeServiceSpec.replicaGroups[{role!r}].prefillChunk "
+                f"must be >= 0, got {group.prefill_chunk}"
+            )
     container = spec.template.spec.container(t.SERVE_CONTAINER_NAME)
     if container is None:
         errs.append(

@@ -35,13 +35,17 @@ from ..api.serde import deep_copy, to_jsonable
 from ..api.types import (
     LABEL_SERVE_NAME,
     LABEL_SERVE_REPLICA_INDEX,
+    LABEL_SERVE_ROLE,
     LABEL_SERVE_WEIGHTS,
     SERVE_CONTAINER_NAME,
     SERVE_KIND,
+    SERVE_ROLES,
     ConditionType,
+    ServeRoleStatus,
     ServeService,
     serve_labels,
     serve_replica_name,
+    serve_role_replica_name,
 )
 from ..api.validation import ValidationError
 from ..runtime import (
@@ -76,6 +80,33 @@ def _controller_owner(meta: k8s.ObjectMeta) -> Optional[k8s.OwnerReference]:
         if ref.controller:
             return ref
     return None
+
+
+def _desired_replicas(svc: ServeService):
+    """The pods this spec asks for, as (name, index, role, group).
+
+    Empty replicaGroups keeps the classic flat fan-out (role "" and a
+    None group); role-typed groups fan out per role in SERVE_ROLES
+    order so prefill/decode pools get stable, disjoint name ranges."""
+    groups = svc.spec.replica_groups
+    if not groups:
+        want = int(svc.spec.replicas or 0)
+        return [
+            (serve_replica_name(svc.name, i), i, "", None)
+            for i in range(want)
+        ]
+    desired = []
+    ordered = [r for r in SERVE_ROLES if r in groups]
+    ordered += [r for r in sorted(groups) if r not in SERVE_ROLES]
+    for role in ordered:
+        group = groups[role]
+        if group is None:
+            continue  # validation reports nil groups
+        for i in range(int(group.replicas or 0)):
+            desired.append(
+                (serve_role_replica_name(svc.name, role, i), i, role, group)
+            )
+    return desired
 
 
 class ServeReconciler:
@@ -142,7 +173,8 @@ class ServeReconciler:
 
     def reconcile(self, svc: ServeService, pods: List[k8s.Pod]) -> None:
         pods = self.claim_pods(svc, pods)
-        want = int(svc.spec.replicas or 0)
+        desired = _desired_replicas(svc)
+        want = len(desired)
         key = svc.key()
         namespace = svc.namespace
 
@@ -168,24 +200,25 @@ class ServeReconciler:
                 live.append(pod)
 
         by_name = {p.metadata.name: p for p in live}
-        desired = [serve_replica_name(svc.name, i) for i in range(want)]
+        desired_names = {name for name, _, _, _ in desired}
 
-        # 2. Scale down: anything live outside the desired index range
+        # 2. Scale down: anything live outside the desired name set
+        # (covers index-range shrink AND a role group being removed)
         for pod in live:
-            if pod.metadata.name not in desired:
+            if pod.metadata.name not in desired_names:
                 self._delete_pod(svc, pod)
-        live = [p for p in live if p.metadata.name in desired]
+        live = [p for p in live if p.metadata.name in desired_names]
 
         # 3. Create missing indexed replicas (a reaped pod's index is
         # missing here on the SAME sync, so replacement is immediate)
-        for index, name in enumerate(desired):
+        for name, index, role, group in desired:
             if name not in by_name:
-                self._create_pod(svc, index)
+                self._create_pod(svc, index, role=role, group=group)
 
         # 4. Rolling weight update over RUNNING pods that carry a stale
         # weights label, bounded by maxUnavailable minus the capacity
         # already lost to dead/booting replicas.
-        self._rolling_update(svc, live)
+        self._rolling_update(svc, live, want)
 
         # 5. Status + conditions from observed truth
         running = [p for p in live if p.status.phase == k8s.POD_RUNNING]
@@ -196,6 +229,7 @@ class ServeReconciler:
             if p.metadata.labels.get(LABEL_SERVE_WEIGHTS)
             == svc.spec.weights_version
         ])
+        svc.status.role_statuses = self._role_statuses(svc, live, running)
         now = self.clock.now_iso()
         if running and len(running) == want:
             set_condition(
@@ -208,11 +242,42 @@ class ServeReconciler:
                 f"{len(running)}/{want} serve replicas running.", now,
             )
 
+    def _role_statuses(
+        self,
+        svc: ServeService,
+        live: List[k8s.Pod],
+        running: List[k8s.Pod],
+    ):
+        """Per-role observed counts for role-typed replica groups
+        (empty when the spec is monolithic)."""
+        if not svc.spec.replica_groups:
+            return {}
+        version = svc.spec.weights_version
+        statuses = {}
+        for role, group in svc.spec.replica_groups.items():
+            if group is None:
+                continue
+            role_live = [
+                p for p in live
+                if p.metadata.labels.get(LABEL_SERVE_ROLE) == role
+            ]
+            role_running = [
+                p for p in role_live if p.status.phase == k8s.POD_RUNNING
+            ]
+            statuses[role] = ServeRoleStatus(
+                replicas=len(role_live),
+                ready_replicas=len(role_running),
+                updated_replicas=len([
+                    p for p in role_running
+                    if p.metadata.labels.get(LABEL_SERVE_WEIGHTS) == version
+                ]),
+            )
+        return statuses
+
     def _rolling_update(
-        self, svc: ServeService, live: List[k8s.Pod]
+        self, svc: ServeService, live: List[k8s.Pod], want: int
     ) -> None:
         version = svc.spec.weights_version
-        want = int(svc.spec.replicas or 0)
         max_unavailable = int(svc.spec.max_unavailable or 1)
         running = [p for p in live if p.status.phase == k8s.POD_RUNNING]
         stale = sorted(
@@ -261,12 +326,34 @@ class ServeReconciler:
 
     # -- pod CRUD with expectation accounting ------------------------------
 
-    def _create_pod(self, svc: ServeService, index: int) -> None:
+    def _create_pod(
+        self, svc: ServeService, index: int, role: str = "", group=None
+    ) -> None:
         labels = serve_labels(svc.name)
         labels[LABEL_SERVE_REPLICA_INDEX] = str(index)
         labels[LABEL_SERVE_WEIGHTS] = svc.spec.weights_version
+        if role:
+            labels[LABEL_SERVE_ROLE] = role
         template = deep_copy(svc.spec.template)
-        template.metadata.name = serve_replica_name(svc.name, index)
+        if role:
+            template.metadata.name = serve_role_replica_name(
+                svc.name, role, index
+            )
+            container = template.spec.container(SERVE_CONTAINER_NAME)
+            if container is not None and container.command:
+                # per-role engine tuning rides the command line; argparse
+                # last-wins lets the role flags override template-wide
+                # defaults like --slots
+                container.command = list(container.command)
+                container.command += ["--role", role]
+                if group is not None and group.slots is not None:
+                    container.command += ["--slots", str(group.slots)]
+                if group is not None and group.prefill_chunk is not None:
+                    container.command += [
+                        "--prefill-chunk", str(group.prefill_chunk)
+                    ]
+        else:
+            template.metadata.name = serve_replica_name(svc.name, index)
         template.metadata.labels.update(labels)
         pod = k8s.Pod(metadata=template.metadata, spec=template.spec)
         pod.metadata.namespace = svc.namespace

@@ -189,6 +189,45 @@ class DecodeClient:
         }))
         return body["beams"], body["beam_scores"]
 
+    # -- disaggregated prefill/decode (KV block-set migration) ---------
+
+    def prefill(
+        self,
+        input_ids: List[int],
+        migrate_to: Optional[str] = None,
+    ) -> dict:
+        """Run chunked prefill for ONE prompt row on this (prefill)
+        replica and — when migrate_to names a decode replica's base
+        URL — ship the resulting KV block set there. Returns the
+        server's {"blocks": n, "migrated": bool, "imported": n}
+        report (plus "error" when the ship failed; the blocks stay
+        cached on the prefill replica either way)."""
+        body: dict = {
+            "input_ids": [list(input_ids)],
+            "max_new_tokens": 1,
+        }
+        if migrate_to:
+            body["migrate_to"] = migrate_to
+        return json.loads(self._request("/prefill", body))
+
+    def kv_export(self, input_ids: List[int]) -> dict:
+        """This prompt's cached full-block prefix K/V as a JSON-able
+        block set: {"payload": <block set>|None, "blocks": n}."""
+        return json.loads(self._request("/kv/export", {
+            "input_ids": [list(input_ids)],
+        }))
+
+    def kv_import(self, payload: dict) -> dict:
+        """Admit an exported block set into this replica's prefix
+        cache; -> {"imported": total cached prefix blocks}."""
+        return json.loads(self._request("/kv/import", payload))
+
+    def kv_digest(self) -> dict:
+        """The replica's rolling prefix digest: {"role", "block_size",
+        "digest": [hash, ...]} with hashes MRU-first (serve/prefix.py
+        prefix_hash vocabulary)."""
+        return json.loads(self._request("/kv/digest"))
+
     def healthy(self) -> dict:
         return json.loads(self._request("/healthz"))
 

@@ -57,6 +57,7 @@ kv_layout="dense" keeps the original grid (the bench baseline).
 
 from __future__ import annotations
 
+import base64
 import collections
 import json
 import queue
@@ -67,6 +68,7 @@ import numpy as np
 
 from ..telemetry.flight import current_correlation, default_flight
 from ..utils import locks
+from .prefix import prefix_hash
 
 _DONE = object()
 
@@ -129,6 +131,18 @@ METRIC_HELP = {
     "engine_kv_shard_bytes":
         "Paged KV pool bytes resident per device shard "
         "(= pool bytes / model shards)",
+    "engine_kv_blocks_exported_total":
+        "KV blocks serialized out of the pool for prefill->decode "
+        "migration",
+    "engine_kv_blocks_imported_total":
+        "KV blocks written into the pool from a migrated block set",
+    "engine_migrations_out_total":
+        "Block-set exports shipped to another replica",
+    "engine_migrations_in_total":
+        "Block-set imports admitted from another replica",
+    "engine_pool_audit_failures_total":
+        "BlockPool.check() audits (drain/stop) that found a refcount "
+        "leak or double free",
 }
 
 
@@ -531,6 +545,10 @@ class ContinuousBatchingEngine:
         # head may be waiting for blocks, and it must not be overtaken
         self._pending: collections.deque = collections.deque()
         self._stop = threading.Event()
+        # engine-thread op queue: pool/cache mutations requested from
+        # other threads (KV export/import, digest, audits) run between
+        # scheduler quanta so the single-writer discipline holds
+        self._ops: collections.deque = collections.deque()
         # serializes submit's stopped-check+enqueue against stop's
         # drain: without it a put can land after the drain and strand
         # the client until its result() timeout
@@ -554,6 +572,13 @@ class ContinuousBatchingEngine:
         self.peak_active = 0
         self.prefill_chunks = 0
         self.prefill_seconds = 0.0
+        # KV migration + pool-audit accounting (disaggregated
+        # prefill/decode serving)
+        self.kv_blocks_exported = 0
+        self.kv_blocks_imported = 0
+        self.migrations_out = 0
+        self.migrations_in = 0
+        self.pool_audit_failures = 0
         # quantum attribution (engine-thread-owned, like the above):
         # where each scheduler quantum's wall time goes — admission,
         # compiled-step dispatch, host-side device sync, stream fan-out
@@ -568,8 +593,8 @@ class ContinuousBatchingEngine:
         # the queued mark), and the registry children are internally
         # locked, so no new synchronization rides the hot path.
         self._tracer = tracer
-        # resolved per call (self._flight or default_flight()) so a
-        # test swapping the default after construction still captures
+        # resolved per record via _fl() so a test swapping the
+        # default after construction still captures
         self._flight = flight
         self._h_ttft = self._h_itl = self._h_queue_wait = None
         self._h_batch = self._h_prefill = None
@@ -686,7 +711,7 @@ class ContinuousBatchingEngine:
                 span_args["corr"] = corr
             req.span = self._tracer.begin("serve-request", **span_args)
             req.span.annotate("queued")
-        (self._flight or default_flight()).record(
+        self._fl().record(
             "serve", corr=corr, op="submit",
             prompt_tokens=len(row), new=new,
         )
@@ -752,12 +777,16 @@ class ContinuousBatchingEngine:
             # manual mode (start=False) or stopped: nothing races
             if self.active_slots == 0:
                 self._drained.set()
+                self.audit_pool("drain")
             return self.active_slots == 0
         drained = self._drained.wait(timeout)
-        (self._flight or default_flight()).record(
+        self._fl().record(
             "serve", op="drain", ok=drained,
             active_slots=self.active_slots, queued=self.queue_depth,
         )
+        if drained:
+            # quiesced grid: audit the pool while nothing is decoding
+            self.audit_pool("drain")
         return drained
 
     def swap_params(self, params) -> None:
@@ -786,12 +815,194 @@ class ContinuousBatchingEngine:
             if self._paged:
                 # cached prompt K/V was computed under the OLD weights
                 self.pool.flush()
-        (self._flight or default_flight()).record("serve", op="swap-params")
+        self._fl().record("serve", op="swap-params")
+
+    # -- KV block-set migration (disaggregated prefill/decode) -------------
+
+    def export_prefix_blocks(self, prompt, corr=None):
+        """Serialize the prompt's cached full-block prefix K/V into a
+        JSON-able block set (the prefill half of a prefill->decode
+        migration). Walks the prefix cache longest-unbroken-chain from
+        the front — exactly the blocks a later ``_plan`` for the same
+        prompt would share — and copies each block's slice of every
+        cache leaf to the host. Read-only on the pool (refcounts
+        untouched, sentinel never included) and runs on the engine
+        thread, so nothing can reclaim a block mid-copy. Returns None
+        when the prompt has no published full-block prefix yet."""
+        if not self._paged:
+            raise RuntimeError("KV export requires kv_layout='paged'")
+        row = [int(t) for t in prompt]
+
+        def op():
+            import jax
+
+            pool = self.pool
+            bs = pool.block_size
+            blocks: list = []
+            for j in range(len(row) // bs):
+                block = pool._cached.get(tuple(row[:(j + 1) * bs]))
+                if block is None:
+                    break
+                blocks.append(block)
+            if not blocks:
+                return None
+            idx = np.asarray(blocks, np.int64)
+            leaves, _ = jax.tree_util.tree_flatten(self._cache)
+            encoded = []
+            for leaf in leaves:
+                arr = np.asarray(leaf[idx])
+                encoded.append({
+                    "dtype": str(arr.dtype),
+                    "shape": list(arr.shape),
+                    "data": base64.b64encode(arr.tobytes()).decode("ascii"),
+                })
+            self.kv_blocks_exported += len(blocks)
+            self.migrations_out += 1
+            self._fl().record(
+                "serve", corr=corr, op="kv-export",
+                blocks=len(blocks), tokens=len(blocks) * bs,
+            )
+            return {
+                "block_size": bs,
+                "blocks": len(blocks),
+                "tokens": row[:len(blocks) * bs],
+                "leaves": encoded,
+            }
+
+        return self._submit_op(op)
+
+    def import_prefix_blocks(self, payload, corr=None):
+        """Admit a migrated block set into this engine's pool: for each
+        block-aligned prefix key, allocate a fresh block, write the
+        serialized K/V into every cache leaf, publish it under the key
+        and drop the private ref — ending at refcount 1 (idle cached),
+        indistinguishable from a prefix this engine prefilled itself.
+        Already-cached keys are kept (their K/V is authoritative and
+        bit-identical by construction); a short pool stops the walk
+        early rather than evicting live work. Returns the number of
+        leading prefix blocks now cached — the prefill a follow-up
+        request for these tokens will skip."""
+        if not self._paged:
+            raise RuntimeError("KV import requires kv_layout='paged'")
+        bs = int(payload.get("block_size", 0))
+        if bs != self.pool.block_size:
+            raise ValueError(
+                f"block_size mismatch: payload {bs}, "
+                f"pool {self.pool.block_size}"
+            )
+        m = int(payload.get("blocks", 0))
+        tokens = [int(t) for t in payload.get("tokens", [])]
+        if m < 1 or len(tokens) < m * bs:
+            raise ValueError("malformed KV block-set payload")
+
+        def op():
+            import jax
+
+            leaves, treedef = jax.tree_util.tree_flatten(self._cache)
+            encoded = payload.get("leaves", [])
+            if len(encoded) != len(leaves):
+                raise ValueError(
+                    f"cache structure mismatch: payload has "
+                    f"{len(encoded)} leaves, engine has {len(leaves)}"
+                )
+            arrays = []
+            for leaf, enc in zip(leaves, encoded):
+                arr = np.frombuffer(
+                    base64.b64decode(enc["data"]),
+                    dtype=np.dtype(str(enc["dtype"])),
+                ).reshape([int(d) for d in enc["shape"]])
+                want_shape = (m,) + tuple(leaf.shape[1:])
+                if tuple(arr.shape) != want_shape or (
+                    np.dtype(str(enc["dtype"])) != np.dtype(leaf.dtype)
+                ):
+                    raise ValueError(
+                        f"cache leaf mismatch: payload "
+                        f"{arr.dtype}{list(arr.shape)}, engine "
+                        f"{np.dtype(leaf.dtype)}{[m] + list(leaf.shape[1:])}"
+                    )
+                arrays.append(arr)
+            pool = self.pool
+            cached = 0
+            plan = []  # (payload row j, freshly allocated block)
+            for j in range(m):
+                key = tuple(tokens[:(j + 1) * bs])
+                if pool.lookup(key) is not None:
+                    cached += 1
+                    continue
+                if pool.available() < 1:
+                    break  # never evict live work for an import
+                block = pool.alloc()
+                pool.publish(key, block)
+                pool.release(block)  # cache's own ref keeps it idle
+                plan.append((j, block))
+                cached += 1
+            written = len(plan)
+            if written:
+                # one scatter per cache leaf, not one per block: the
+                # import runs between scheduler quanta, so its dispatch
+                # count is inter-token latency on the decode replica
+                rows = np.asarray([j for j, _ in plan], np.int64)
+                idx = np.asarray([b for _, b in plan], np.int64)
+                for i in range(len(leaves)):
+                    leaves[i] = leaves[i].at[idx].set(arrays[i][rows])
+                self._cache = jax.tree_util.tree_unflatten(treedef, leaves)
+            self.kv_blocks_imported += written
+            self.migrations_in += 1
+            self._fl().record(
+                "serve", corr=corr, op="kv-import",
+                blocks=m, written=written, cached=cached,
+            )
+            return cached
+
+        return self._submit_op(op)
+
+    def prefix_digest(self, limit: int = 128) -> list:
+        """Hashes of the prefix cache's keys, most-recently-used first
+        (capped) — the rolling digest the router folds into placement."""
+        if not self._paged:
+            return []
+
+        def op():
+            items = sorted(
+                self.pool._lru.items(), key=lambda kv: kv[1], reverse=True
+            )
+            return [prefix_hash(key) for key, _ in items[:int(limit)]]
+
+        return self._submit_op(op)
+
+    def audit_pool(self, where: str = "audit") -> bool:
+        """Run BlockPool.check() on the engine thread; a failed audit
+        is surfaced as a flight record + counter (never an unhandled
+        assertion in a drain/stop path). True when clean."""
+        if not self._paged:
+            return True
+
+        def op():
+            try:
+                self.pool.check()
+            except AssertionError as err:
+                self.pool_audit_failures += 1
+                self._fl().record(
+                    "serve", op="pool-audit", ok=False, where=where,
+                    error=str(err),
+                )
+                return False
+            self._fl().record(
+                "serve", op="pool-audit", ok=True, where=where,
+                in_use=self.pool.in_use(),
+                cached=self.pool.cached_blocks(),
+            )
+            return True
+
+        return self._submit_op(op)
 
     def stop(self) -> None:
         self._stop.set()
         if self.thread is not None:
             self.thread.join(timeout=10)
+        # run (inline) any op that raced the stop flag so its waiter
+        # unblocks with a result instead of a timeout
+        self._drain_ops()
         stopped = RuntimeError("engine is stopped")
         drained = []
         with self._lifecycle:
@@ -811,6 +1022,9 @@ class ContinuousBatchingEngine:
         for slot, req in enumerate(self._reqs):
             if req is not None:
                 self._release(slot, error=stopped)
+        # leak/double-free audit on every stop (runs inline: the
+        # scheduler thread is down), surfaced via flight + counter
+        self.audit_pool("stop")
 
     # -- observers ---------------------------------------------------------
 
@@ -876,6 +1090,16 @@ class ContinuousBatchingEngine:
                     self.step.kv_bytes_total,
                 ("engine_kv_shard_bytes", "gauge"):
                     self.step.kv_bytes_per_shard,
+                ("engine_kv_blocks_exported_total", "counter"):
+                    self.kv_blocks_exported,
+                ("engine_kv_blocks_imported_total", "counter"):
+                    self.kv_blocks_imported,
+                ("engine_migrations_out_total", "counter"):
+                    self.migrations_out,
+                ("engine_migrations_in_total", "counter"):
+                    self.migrations_in,
+                ("engine_pool_audit_failures_total", "counter"):
+                    self.pool_audit_failures,
             })
         return out
 
@@ -883,6 +1107,7 @@ class ContinuousBatchingEngine:
 
     def _run(self) -> None:
         while not self._stop.is_set():
+            self._drain_ops()
             if not self._admit_gate.is_set():
                 # draining: finish in-flight slots, admit nothing. The
                 # _drained ack is set here — by this thread, after the
@@ -908,6 +1133,41 @@ class ContinuousBatchingEngine:
                 self._admit()
                 continue
             self._work_once()
+
+    def _fl(self):
+        """The injected flight recorder, else the process default. An
+        explicit None check: FlightRecorder defines __len__, so a
+        freshly injected (empty) recorder is falsy and `or` would
+        silently discard it."""
+        return self._flight if self._flight is not None else default_flight()
+
+    def _drain_ops(self) -> None:
+        """Run queued cross-thread ops (engine thread only)."""
+        while self._ops:
+            fn, box, done = self._ops.popleft()
+            try:
+                box["result"] = fn()
+            except BaseException as err:  # noqa: BLE001 — relayed to caller
+                box["error"] = err
+            done.set()
+
+    def _submit_op(self, fn, timeout: float = 60.0):
+        """Run ``fn`` on the engine thread between scheduler quanta and
+        return its result (exceptions re-raise here). The pool and the
+        device cache are single-writer — owned by the engine thread —
+        so every cross-thread mutation (KV export/import, audits) goes
+        through this queue. With no live scheduler thread (start=False
+        manual mode, or after stop) the op runs inline: nothing races."""
+        if self.thread is None or not self.thread.is_alive():
+            return fn()
+        box: dict = {}
+        done = threading.Event()
+        self._ops.append((fn, box, done))
+        if not done.wait(timeout):
+            raise TimeoutError("engine op timed out")
+        if box.get("error") is not None:
+            raise box["error"]
+        return box.get("result")
 
     def _admit(self) -> None:
         started = time.perf_counter()
@@ -984,7 +1244,7 @@ class ContinuousBatchingEngine:
             self.cancelled += 1
             if req.span is not None:
                 req.span.finish(outcome="cancelled")
-            (self._flight or default_flight()).record(
+            self._fl().record(
                 "serve", corr=req.corr, op="evict",
                 outcome="cancelled-before-admission",
             )
@@ -995,7 +1255,7 @@ class ContinuousBatchingEngine:
             self._h_queue_wait.observe(req.admitted_at - req.created)
         if req.span is not None:
             req.span.annotate("admitted")
-        (self._flight or default_flight()).record(
+        self._fl().record(
             "serve", corr=req.corr, op="admit", slot=self._free[0],
             queue_wait=round(req.admitted_at - req.created, 6),
         )
@@ -1045,7 +1305,7 @@ class ContinuousBatchingEngine:
         table = self._slot_table[slot]
         table[:] = 0
         table[:len(blocks)] = blocks
-        (self._flight or default_flight()).record(
+        self._fl().record(
             "serve", corr=req.corr, op="kv-plan", slot=slot,
             shared=len(shared), fresh=need,
             cow=cow_src is not None, start=start,
@@ -1117,7 +1377,7 @@ class ContinuousBatchingEngine:
                     req.span.finish(
                         outcome="error", error=type(error).__name__
                     )
-            (self._flight or default_flight()).record(
+            self._fl().record(
                 "serve", corr=req.corr, op="evict", slot=slot,
                 outcome=outcome, tokens=len(req.tokens),
             )
@@ -1159,7 +1419,7 @@ class ContinuousBatchingEngine:
         self.prefill_seconds += took
         if self._h_prefill is not None:
             self._h_prefill.observe(took)
-        (self._flight or default_flight()).record(
+        self._fl().record(
             "serve", corr=req.corr, op="prefill-chunk", slot=slot,
             offset=off, tokens=chunk,
         )
@@ -1176,7 +1436,7 @@ class ContinuousBatchingEngine:
         call; rebuild it, fail every in-flight request as JSON-able
         errors (a dead engine would hang all later requests), and drop
         the prefix cache — its blocks' device contents just went."""
-        (self._flight or default_flight()).record(
+        self._fl().record(
             "serve", op="step-error", error=type(err).__name__,
             slots=self.active_slots,
         )
@@ -1251,7 +1511,7 @@ class ContinuousBatchingEngine:
         # allocation beyond the record tuple — SERVE_BENCH stays flat).
         # Emitted AFTER the fan-out so the record carries the full
         # quantum split: dispatch / device sync / stream fan-out.
-        (self._flight or default_flight()).record(
+        self._fl().record(
             "serve", op="step", step=self.steps, slots=slots_now,
             dispatch=round(dispatched - start, 6),
             sync=round(synced - dispatched, 6),

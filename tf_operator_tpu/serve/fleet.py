@@ -41,7 +41,10 @@ from typing import Dict, List, Optional
 from ..api import k8s
 from ..api.types import (
     LABEL_SERVE_NAME,
+    LABEL_SERVE_ROLE,
     LABEL_SERVE_WEIGHTS,
+    SERVE_CONTAINER_NAME,
+    ServeReplicaGroup,
     ServeService,
     ServeServiceSpec,
 )
@@ -87,6 +90,8 @@ class InProcessFleet:
         mesh_shape: str = "",
         namespace: Optional[str] = None,
         fault_log: Optional[FaultLog] = None,
+        block_size: int = 64,
+        prefill_chunk: int = 64,
     ) -> None:
         self.substrate = substrate
         self.router = router
@@ -95,6 +100,11 @@ class InProcessFleet:
         # fleet should serve for pods created before a version was set
         self.params_by_version = params_by_version
         self.slots = slots
+        # paged-KV geometry every replica boots with unless its pod
+        # command overrides it (role groups append --slots /
+        # --prefill-chunk; _command_int honors the override)
+        self.block_size = block_size
+        self.prefill_chunk = prefill_chunk
         # ServeServiceSpec.mesh_shape ("1x2"); every replica this
         # fleet boots shares the one decode mesh shape, mirroring the
         # one --mesh-shape flag the default pod command carries
@@ -115,6 +125,24 @@ class InProcessFleet:
                 f"(have: {sorted(self.params_by_version)})"
             ) from None
 
+    @staticmethod
+    def _command_int(pod: k8s.Pod, flag: str, default: int) -> int:
+        """Read an int flag off the pod's serve-container command,
+        last occurrence winning (argparse semantics — role groups
+        APPEND their overrides after the template-wide defaults)."""
+        value = default
+        for container in pod.spec.containers:
+            if container.name != SERVE_CONTAINER_NAME:
+                continue
+            command = container.command or []
+            for i, tok in enumerate(command):
+                if tok == flag and i + 1 < len(command):
+                    try:
+                        value = int(command[i + 1])
+                    except ValueError:
+                        pass
+        return value
+
     def sync(self) -> List[str]:
         """Boot a server for every pending serve pod without one.
         Returns the pod names booted this pass."""
@@ -133,6 +161,14 @@ class InProcessFleet:
                     continue
             version = pod.metadata.labels.get(LABEL_SERVE_WEIGHTS, "")
             params = self._params_for(version)
+            # role-typed replica groups: the controller stamps the
+            # role label and appends per-role --slots/--prefill-chunk
+            # to the pod command; the fleet is the kubelet that obeys
+            role = pod.metadata.labels.get(LABEL_SERVE_ROLE, "")
+            n_slots = self._command_int(pod, "--slots", self.slots)
+            prefill_chunk = self._command_int(
+                pod, "--prefill-chunk", self.prefill_chunk
+            )
             # warm_async: the listener binds first, /readyz answers
             # "warming" (503) through the engine's construction
             # compile, and the router only admits the replica when its
@@ -140,9 +176,12 @@ class InProcessFleet:
             # would walk
             server = make_server(
                 self.cfg, params, port=0, model_name=name,
-                batching="continuous", n_slots=self.slots,
+                batching="continuous", n_slots=n_slots,
                 mesh_shape=self.mesh_shape or None,
                 warm_async=True,
+                block_size=self.block_size,
+                prefill_chunk=prefill_chunk,
+                role=role,
             )
             thread = threading.Thread(
                 target=server.serve_forever, name=f"serve-{name}",
@@ -156,9 +195,12 @@ class InProcessFleet:
             self.substrate.mark_pod_running(
                 pod.metadata.namespace, name
             )
-            self.router.add_replica(name, proc.url)
+            self.router.add_replica(name, proc.url, role=role)
             booted.append(name)
-            logger.info("booted replica %s at %s", name, proc.url)
+            logger.info(
+                "booted replica %s at %s%s", name, proc.url,
+                f" (role {role})" if role else "",
+            )
         return booted
 
     def kill(self, pod_name: str, exit_code: int = 137) -> None:
@@ -545,11 +587,188 @@ def run_failover_soak(
     return summary
 
 
+def run_disagg_smoke(
+    seed: int = 0,
+    streams: int = 4,
+    max_new: int = 12,
+    namespace: str = "disagg",
+) -> dict:
+    """End-to-end proof of the disaggregated prefill/decode path (CI
+    step `serve-disagg-smoke`): a ServeService with role-typed replica
+    groups (1 prefill + 1 decode) reconciled by the real controller,
+    booted by the fleet, routed by the prefix-aware router. A
+    shared-prefix request family streams through the router; every
+    chain must be bit-identical to the inline greedy reference, at
+    least one KV block-set migration must actually happen, the decode
+    pool must have served the streams, per-role status must be
+    reported, and both block pools must audit clean at shutdown.
+    Raises AssertionError on any violation."""
+    import jax
+    import jax.numpy as jnp
+
+    from ..controller.serve import ServeServiceController
+    from ..models import gpt as gpt_lib
+    from ..runtime import InMemorySubstrate
+
+    cfg = gpt_lib.GPT_TINY
+    params = gpt_lib.GPT(cfg).init(
+        jax.random.PRNGKey(0), jnp.zeros((1, 8), jnp.int32)
+    )["params"]
+
+    rng = random.Random(seed)
+    block_size = 8
+    substrate = InMemorySubstrate()
+    router = LeastLoadedRouter(retry_wait=0.02)
+    fleet = InProcessFleet(
+        substrate, router, cfg, {"v1": params}, slots=2,
+        namespace=namespace, block_size=block_size,
+        prefill_chunk=block_size,
+    )
+    controller = ServeServiceController(
+        substrate, namespace=namespace,
+        weight_update=fleet.update_weights,
+    )
+    svc = ServeService(
+        spec=ServeServiceSpec(
+            preset="tiny", slots=2, weights_version="v1",
+            replica_groups={
+                "prefill": ServeReplicaGroup(replicas=1),
+                "decode": ServeReplicaGroup(replicas=1),
+            },
+        )
+    )
+    svc.metadata.name = "disagg"
+    svc.metadata.namespace = namespace
+
+    # a shared-prefix family: every prompt opens with the same two
+    # full blocks (the migratable prefix), then its own short tail
+    shared = [
+        rng.randrange(1, cfg.vocab_size) for _ in range(2 * block_size)
+    ]
+    prompts = [
+        shared + [
+            rng.randrange(1, cfg.vocab_size)
+            for _ in range(rng.randint(1, 3))
+        ]
+        for _ in range(streams)
+    ]
+    expected = [
+        [int(t) for t in gpt_lib.generate(
+            cfg, params, jnp.asarray([prompt], jnp.int32), max_new,
+        )[0]]
+        for prompt in prompts
+    ]
+
+    results: List[Optional[List[int]]] = [None] * streams
+    errors: List[Optional[str]] = [None] * streams
+    started = time.monotonic()
+    role_status = {}
+    try:
+        substrate.create_serve_service(svc)
+        controller.run_until_quiet()
+        fleet.sync()
+        fleet.wait_ready(2)
+
+        for i, prompt in enumerate(prompts):
+            try:
+                final = None
+                for event in router.generate_stream(
+                    prompt, max_new, corr=f"disagg-{seed}-{i}",
+                    timeout=120.0,
+                ):
+                    if event.get("done"):
+                        final = event
+                results[i] = final["tokens"][0] if final else None
+            except Exception as err:  # noqa: BLE001 — asserted below
+                errors[i] = f"{type(err).__name__}: {err}"
+
+        controller.run_until_quiet()
+        fresh = substrate.get_serve_service(namespace, "disagg")
+        role_status = {
+            role: {
+                "replicas": rs.replicas,
+                "ready": rs.ready_replicas,
+            }
+            for role, rs in fresh.status.role_statuses.items()
+        }
+        stats = router.stats()
+        with fleet._lock:
+            engines = {
+                name: proc.server.state.engine
+                for name, proc in fleet._replicas.items()
+            }
+    finally:
+        fleet.stop()
+        controller.stop()
+
+    # fleet.stop() -> engine.stop() runs the pool audit on every
+    # replica; a failed audit is a counter, never a crash
+    audit_failures = {
+        name: engine.pool_audit_failures
+        for name, engine in engines.items()
+    }
+    pools_empty = all(
+        engine.pool is None or engine.pool.in_use() == 0
+        for engine in engines.values()
+    )
+    migrations_out = sum(
+        engine.migrations_out for engine in engines.values()
+    )
+    migrations_in = sum(
+        engine.migrations_in for engine in engines.values()
+    )
+    decode_picks = sum(
+        1 for d in stats["decisions"]
+        if d["role_requested"] == "decode" and d["pool"] == "role"
+    )
+    lost = [i for i in range(streams) if results[i] is None]
+    diverged = [
+        i for i in range(streams)
+        if results[i] is not None and results[i] != expected[i]
+    ]
+    summary = {
+        "seed": seed,
+        "streams": streams,
+        "migrations": stats["migrations"],
+        "migrate_failures": stats["migrate_failures"],
+        "migrations_out": migrations_out,
+        "migrations_in": migrations_in,
+        "decode_pool_picks": decode_picks,
+        "role_status": role_status,
+        "audit_failures": audit_failures,
+        "pools_empty": pools_empty,
+        "lost": [f"{i}: {errors[i]}" for i in lost],
+        "diverged": diverged,
+        "seconds": round(time.monotonic() - started, 2),
+        "ok": (
+            not lost and not diverged
+            and stats["migrations"] >= 1
+            and migrations_out >= 1 and migrations_in >= 1
+            and decode_picks >= streams
+            and role_status.get("prefill", {}).get("ready") == 1
+            and role_status.get("decode", {}).get("ready") == 1
+            and not any(audit_failures.values())
+            and pools_empty
+        ),
+    }
+    if not summary["ok"]:
+        raise AssertionError(
+            f"serve disagg smoke failed: {json.dumps(summary)}"
+        )
+    return summary
+
+
 def main(argv: Optional[List[str]] = None) -> int:
     parser = argparse.ArgumentParser(
-        description="ServeService fleet failover soak"
+        description="ServeService fleet soaks (failover / disagg)"
     )
-    parser.add_argument("--soak", action="store_true", required=True)
+    mode = parser.add_mutually_exclusive_group(required=True)
+    mode.add_argument("--soak", action="store_true")
+    mode.add_argument(
+        "--disagg", action="store_true",
+        help="disaggregated prefill/decode smoke: role-group "
+        "ServeService, KV block-set migration, prefix-aware routing",
+    )
     parser.add_argument("--seed", type=int, default=0)
     parser.add_argument("--replicas", type=int, default=3)
     parser.add_argument("--streams", type=int, default=6)
@@ -557,10 +776,16 @@ def main(argv: Optional[List[str]] = None) -> int:
     parser.add_argument("--max-new", type=int, default=12)
     args = parser.parse_args(argv)
     logging.basicConfig(level=logging.INFO)
-    summary = run_failover_soak(
-        seed=args.seed, replicas=args.replicas, streams=args.streams,
-        kills=args.kills, max_new=args.max_new,
-    )
+    if args.disagg:
+        summary = run_disagg_smoke(
+            seed=args.seed, streams=min(args.streams, 4),
+            max_new=args.max_new,
+        )
+    else:
+        summary = run_failover_soak(
+            seed=args.seed, replicas=args.replicas, streams=args.streams,
+            kills=args.kills, max_new=args.max_new,
+        )
     print(json.dumps(summary, indent=2))
     return 0
 

@@ -28,6 +28,7 @@ yields — every flight record here passes corr= explicitly instead.
 
 from __future__ import annotations
 
+import collections
 import http.client
 import itertools
 import time
@@ -37,6 +38,7 @@ from typing import Callable, Dict, List, Optional
 from ..telemetry.flight import default_flight
 from ..utils import locks
 from .client import DecodeClient, DecodeError
+from .prefix import block_prefix_hashes
 
 _ROUTE_IDS = itertools.count(1)
 
@@ -48,6 +50,14 @@ _STEPS = "tf_operator_tpu_serve_engine_steps_total"
 _KV_IN_USE = "tf_operator_tpu_serve_engine_kv_blocks_in_use"
 _KV_TOTAL = "tf_operator_tpu_serve_engine_kv_blocks_total"
 _MESH_DEVICES = "tf_operator_tpu_serve_engine_mesh_devices"
+_PREFIX_HITS = "tf_operator_tpu_serve_engine_prefix_cache_hits_total"
+_PREFIX_HIT_TOKENS = "tf_operator_tpu_serve_engine_prefix_hit_tokens_total"
+
+# prefix-overlap discount: each already-cached full block of the
+# request's prompt shaves this much off the load score (capped, so a
+# giant shared prefix can't route every stream onto one hot replica)
+_OVERLAP_WEIGHT = 2.0
+_OVERLAP_CAP = 8
 
 # connection-level failures that mean "this replica, this attempt" —
 # the stream fails over, the replica gets a probe before reuse
@@ -67,10 +77,13 @@ class NoReadyReplicas(RuntimeError):
 class Replica:
     """Router-side record of one engine replica endpoint."""
 
-    def __init__(self, name: str, url: str, client: DecodeClient) -> None:
+    def __init__(
+        self, name: str, url: str, client: DecodeClient, role: str = ""
+    ) -> None:
         self.name = name
         self.url = url
         self.client = client
+        self.role = role       # "" (monolithic) / "prefill" / "decode"
         self.ready = False
         self.draining = False
         self.inflight = 0      # streams this router has on the replica
@@ -79,9 +92,23 @@ class Replica:
         self.mean_active = 0.0
         self.kv_occupancy = 0.0  # paged pool fill fraction, 0..1
         self.mesh_devices = 1.0  # decode mesh size (1 = single-device)
+        self.prefix_hits = 0.0        # engine_prefix_cache_hits_total
+        self.prefix_hit_tokens = 0.0  # engine_prefix_hit_tokens_total
+        self.block_size = 0    # paged block width, from /kv/digest
+        self.digest: set = set()  # rolling prefix digest (hash strings)
         self.failures = 0
 
-    def score(self) -> tuple:
+    def overlap(self, prefix_hashes: Optional[dict]) -> int:
+        """Full prompt blocks this replica already caches: the size of
+        the intersection between the request's block-aligned prefix
+        hashes (keyed by block size — replicas may differ) and the
+        replica's published digest."""
+        if not prefix_hashes or not self.block_size:
+            return 0
+        mine = prefix_hashes.get(self.block_size)
+        return len(mine & self.digest) if mine else 0
+
+    def score(self, overlap: int = 0) -> tuple:
         """Lower routes sooner. Local inflight is the live signal
         (updated per pick/finish); the scraped gauges add the engine's
         own backlog; KV occupancy (paged engines: blocks in use over
@@ -97,14 +124,35 @@ class Replica:
         COMPUTE-bound terms (inflight, queue depth) divide by the mesh
         size; the structural terms (active slots, KV occupancy) stay
         per-replica because a full slot grid or block pool blocks the
-        next admit no matter how many shards serve it."""
+        next admit no matter how many shards serve it.
+
+        Prefix overlap: each full prompt block the replica already
+        caches is prefill work nobody repeats — it discounts the load
+        term so shared-prefix request families land hot, capped so a
+        popular prefix can't drown the load signal entirely."""
         return (
             (2 * self.inflight + self.queue_depth)
             / max(1.0, self.mesh_devices)
-            + self.active_slots + 4 * self.kv_occupancy,
+            + self.active_slots + 4 * self.kv_occupancy
+            - _OVERLAP_WEIGHT * min(overlap, _OVERLAP_CAP),
             self.mean_active,
             self.name,
         )
+
+    def score_components(self, overlap: int = 0) -> dict:
+        """Every input to score(), itemized — the /debug routing dump
+        (stats()) serves these so a placement can be audited."""
+        return {
+            "inflight": self.inflight,
+            "queue_depth": self.queue_depth,
+            "active_slots": self.active_slots,
+            "kv_occupancy": round(self.kv_occupancy, 4),
+            "mesh_devices": self.mesh_devices,
+            "mean_active": round(self.mean_active, 4),
+            "prefix_overlap": overlap,
+            "overlap_discount": _OVERLAP_WEIGHT * min(overlap, _OVERLAP_CAP),
+            "score": round(self.score(overlap)[0], 4),
+        }
 
 
 class LeastLoadedRouter:
@@ -139,17 +187,23 @@ class LeastLoadedRouter:
         self._lock = locks.make_lock("LeastLoadedRouter._lock")
         self._replicas: Dict[str, Replica] = {}
         self.failovers = 0     # lifetime counter, for tests/metrics
+        self.migrations = 0    # prefill->decode block-set handoffs
+        self.migrate_failures = 0
+        # recent placement decisions (ring buffer), served by stats()
+        # as the routing dump: what was asked, who won, and every
+        # candidate's itemized score at decision time
+        self._decisions: collections.deque = collections.deque(maxlen=64)
 
     # -- membership --------------------------------------------------------
 
-    def add_replica(self, name: str, url: str) -> None:
+    def add_replica(self, name: str, url: str, role: str = "") -> None:
         # construct the client before taking the lock: the factory is
         # injected and may itself lock (FaultyClientFactory does)
         client = self._client_factory(url)
         with self._lock:
             if name in self._replicas:
                 return
-            self._replicas[name] = Replica(name, url, client)
+            self._replicas[name] = Replica(name, url, client, role=role)
         self.probe(name)
 
     def remove_replica(self, name: str) -> None:
@@ -200,6 +254,24 @@ class LeastLoadedRouter:
                     replica.mesh_devices = max(
                         1.0, flat.get(_MESH_DEVICES, 1.0)
                     )
+                    replica.prefix_hits = flat.get(_PREFIX_HITS, 0.0)
+                    replica.prefix_hit_tokens = flat.get(
+                        _PREFIX_HIT_TOKENS, 0.0
+                    )
+                    # rolling prefix digest (paged engines; dense ones
+                    # answer block_size 0 + empty digest, which keeps
+                    # their overlap at 0)
+                    try:
+                        dig = replica.client.kv_digest()
+                        replica.block_size = int(
+                            dig.get("block_size", 0) or 0
+                        )
+                        replica.digest = set(dig.get("digest") or [])
+                        if not replica.role and dig.get("role"):
+                            replica.role = str(dig["role"])
+                    except Exception:  # noqa: BLE001 — pre-digest
+                        # servers (older builds) just don't share
+                        pass
                 replica.ready = ok
             except Exception:  # noqa: BLE001 — an unreachable replica
                 # is simply not ready; the reconciler replaces it
@@ -208,29 +280,64 @@ class LeastLoadedRouter:
     # -- routing -----------------------------------------------------------
 
     def _record(self, corr, op, **fields) -> None:
-        (self._flight or default_flight()).record(
-            "serve", corr=corr, op=op, **fields
-        )
+        # explicit None check: FlightRecorder defines __len__, so an
+        # injected empty recorder is falsy and `or` would discard it
+        flight = self._flight if self._flight is not None else default_flight()
+        flight.record("serve", corr=corr, op=op, **fields)
 
-    def _acquire(self, tried: set, deadline: float, corr) -> Replica:
+    def _acquire(
+        self,
+        tried: set,
+        deadline: float,
+        corr,
+        role: Optional[str] = None,
+        prefix_hashes: Optional[dict] = None,
+    ) -> Replica:
         """Pick the lowest-scored ready replica, preferring ones this
         request hasn't failed on; blocks (probing) until one exists or
-        the deadline passes. Bumps the pick's inflight count."""
+        the deadline passes. Bumps the pick's inflight count.
+
+        role asks for a pool ("prefill"/"decode"); when no ready
+        replica carries it the pick gracefully degrades to the whole
+        ready set (the monolithic path — every replica serves every
+        route). prefix_hashes ({block_size: set-of-hashes}) folds
+        prefix overlap into the score so shared-prefix families land
+        where their blocks already live."""
         while True:
             with self._lock:
                 ready = [
                     r for r in self._replicas.values()
                     if r.ready and not r.draining
                 ]
-                candidates = [r for r in ready if r.name not in tried]
-                if not candidates and ready and tried:
+                pool = ready
+                if role:
+                    in_role = [r for r in ready if r.role == role]
+                    if in_role:
+                        pool = in_role
+                candidates = [r for r in pool if r.name not in tried]
+                if not candidates and pool and tried:
                     # every ready replica already failed this request
                     # once — second chances beat giving up (it may
                     # have recovered; the probe below re-vetted it)
                     tried.clear()
-                    candidates = ready
+                    candidates = pool
                 if candidates:
-                    best = min(candidates, key=Replica.score)
+                    best = min(
+                        candidates,
+                        key=lambda r: r.score(r.overlap(prefix_hashes)),
+                    )
+                    self._decisions.append({
+                        "corr": corr,
+                        "role_requested": role or "",
+                        "pool": "role" if pool is not ready else "all",
+                        "picked": best.name,
+                        "candidates": {
+                            r.name: r.score_components(
+                                r.overlap(prefix_hashes)
+                            )
+                            for r in candidates
+                        },
+                    })
                     best.inflight += 1
                     return best
             if time.monotonic() > deadline:
@@ -246,6 +353,93 @@ class LeastLoadedRouter:
     def _release(self, replica: Replica) -> None:
         with self._lock:
             replica.inflight = max(0, replica.inflight - 1)
+
+    # -- disaggregated prefill/decode --------------------------------------
+
+    def _prompt_hashes(self, tokens: List[int]) -> dict:
+        """{block_size: hash set} over the fleet's distinct paged block
+        sizes — computed once per request, matched against each
+        candidate's published digest in _acquire (serve/prefix.py is
+        the shared hash vocabulary)."""
+        with self._lock:
+            sizes = {
+                r.block_size for r in self._replicas.values()
+                if r.block_size
+            }
+        return {
+            bs: set(block_prefix_hashes(tokens, bs)) for bs in sizes
+        }
+
+    def _maybe_migrate(
+        self,
+        decode_replica: Replica,
+        prompt: List[int],
+        corr,
+        prefix_hashes: dict,
+    ) -> None:
+        """The disaggregated fast path: when a prefill pool exists and
+        the decode target doesn't already cache the prompt's full-block
+        prefix, run chunked prefill on a prefill replica and ship the
+        KV block set to the decode target, so the decode stream admits
+        with its prefix hot (zero prefill chunks stealing decode
+        quanta). EVERY failure degrades to the monolithic path — the
+        decode replica just prefills for itself — flight-recorded
+        (op "migrate-failed"), never raised: greedy chains are a pure
+        function of the prompt, so the degraded stream is bit-identical,
+        only slower."""
+        bs = decode_replica.block_size
+        if decode_replica.role != "decode" or not bs or len(prompt) < bs:
+            return
+        if decode_replica.overlap(prefix_hashes) >= len(prompt) // bs:
+            return  # the target already caches the whole prefix
+        with self._lock:
+            pool = [
+                r for r in self._replicas.values()
+                if r.ready and not r.draining and r.role == "prefill"
+            ]
+            if not pool:
+                return  # no prefill pool: monolithic path
+            pre = min(
+                pool, key=lambda r: r.score(r.overlap(prefix_hashes))
+            )
+            pre.inflight += 1
+        try:
+            report = pre.client.prefill(
+                prompt, migrate_to=decode_replica.url
+            )
+        except Exception as err:  # noqa: BLE001 — degradation, not
+            # failure: the decode replica prefills for itself
+            with self._lock:
+                self.migrate_failures += 1
+            self._record(
+                corr, "migrate-failed", prefill=pre.name,
+                decode=decode_replica.name,
+                error=f"{type(err).__name__}: {err}"[:200],
+            )
+            return
+        finally:
+            self._release(pre)
+        if report.get("migrated"):
+            with self._lock:
+                self.migrations += 1
+                # optimistic digest update: the next probe would learn
+                # this anyway, but sibling requests in a shared-prefix
+                # family route hot NOW
+                decode_replica.digest |= prefix_hashes.get(bs, set())
+            self._record(
+                corr, "migrate", prefill=pre.name,
+                decode=decode_replica.name,
+                blocks=int(report.get("blocks", 0)),
+                imported=int(report.get("imported", 0)),
+            )
+        else:
+            with self._lock:
+                self.migrate_failures += 1
+            self._record(
+                corr, "migrate-failed", prefill=pre.name,
+                decode=decode_replica.name,
+                error=str(report.get("error", "no cached blocks"))[:200],
+            )
 
     def _mark_failed(self, replica: Replica, err: BaseException) -> None:
         with self._lock:
@@ -279,8 +473,27 @@ class LeastLoadedRouter:
         self._record(
             corr, "route", prompt_tokens=len(prompt), new=new,
         )
+        # token streams always target the decode pool (prefill
+        # replicas take /prefill work; with no role pools _acquire
+        # degrades to the whole ready set — today's monolithic path).
+        # Resumed streams (emitted tokens appended) re-acquire with
+        # the same preference, keeping failover inside the pool.
+        prefix_hashes = self._prompt_hashes(prompt)
+        migrate_tried = False
         while len(emitted) < new:
-            replica = self._acquire(tried, deadline, corr)
+            replica = self._acquire(
+                tried, deadline, corr, role="decode",
+                prefix_hashes=prefix_hashes,
+            )
+            if not emitted and not migrate_tried:
+                # one migration attempt per request, before the first
+                # byte: prefill happens on the prefill pool, the block
+                # set ships to THIS decode target, and the stream below
+                # admits with its prefix cached
+                migrate_tried = True
+                self._maybe_migrate(
+                    replica, prompt, corr, prefix_hashes
+                )
             try:
                 inner = replica.client.generate_stream(
                     prompt + emitted, new - len(emitted)
@@ -375,21 +588,33 @@ class LeastLoadedRouter:
         return chains
 
     def stats(self) -> dict:
-        """Telemetry snapshot for tests and debugging."""
+        """Telemetry snapshot for tests and debugging — THE routing
+        dump: per-replica state with every score component itemized
+        (score_components), the prefix-cache counters scraped from
+        each engine, and the recent placement-decision ring."""
         with self._lock:
             return {
                 "failovers": self.failovers,
+                "migrations": self.migrations,
+                "migrate_failures": self.migrate_failures,
                 "replicas": {
                     r.name: {
                         "ready": r.ready,
                         "draining": r.draining,
+                        "role": r.role,
                         "inflight": r.inflight,
                         "queue_depth": r.queue_depth,
                         "active_slots": r.active_slots,
                         "kv_occupancy": r.kv_occupancy,
                         "mesh_devices": r.mesh_devices,
+                        "prefix_hits": r.prefix_hits,
+                        "prefix_hit_tokens": r.prefix_hit_tokens,
+                        "block_size": r.block_size,
+                        "digest_size": len(r.digest),
                         "failures": r.failures,
+                        "score_components": r.score_components(),
                     }
                     for r in self._replicas.values()
                 },
+                "decisions": list(self._decisions),
             }

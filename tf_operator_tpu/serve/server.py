@@ -125,7 +125,7 @@ class _State:
 
     def __init__(self, cfg, params, kv_quant_int8: bool, model_name: str,
                  max_new_cap: int, speculative: bool = False,
-                 weights_int8: bool = False, mesh=None):
+                 weights_int8: bool = False, mesh=None, role: str = ""):
         self.cfg = cfg
         self.family = _family(cfg)
         self.params = params
@@ -134,6 +134,13 @@ class _State:
         self.max_new_cap = max_new_cap
         self.speculative = speculative
         self.weights_int8 = weights_int8
+        # disaggregated prefill/decode: "" (monolithic, the default),
+        # "prefill" or "decode". Advisory — the role changes nothing
+        # about what this server CAN do (every role serves the full
+        # route set); the router reads it from /healthz and /kv/digest
+        # to steer prefill-heavy work at prefill replicas and resumed
+        # decode at decode replicas
+        self.role = role
         # replica lifecycle phase, read by /healthz and /readyz and
         # flipped by make_server (warmup), the SIGTERM drain, and the
         # fleet's rolling weight updates: "warming" -> "ready" ->
@@ -474,6 +481,7 @@ def DecodeHandlerFactory(state: _State):
                 self._reply(200, {
                     "status": "ok" if phase == "ready" else phase,
                     "model": state.model_name,
+                    "role": state.role,
                     "kv_int8": state.kv_quant_int8,
                     "weights_int8": state.weights_int8,
                     "decodes": int(state.decodes),
@@ -486,6 +494,21 @@ def DecodeHandlerFactory(state: _State):
                     200 if phase == "ready" else 503,
                     {"status": phase, "model": state.model_name},
                 )
+            elif self.path.partition("?")[0] == "/kv/digest":
+                # rolling prefix digest: hashes of the paged prefix
+                # cache's keys, MRU first. The router polls this to
+                # score prefix overlap; non-paged servers answer an
+                # empty digest (same wire shape, nothing to share)
+                engine = state.engine
+                if engine is None or getattr(engine, "pool", None) is None:
+                    return self._reply(200, {
+                        "role": state.role, "block_size": 0, "digest": [],
+                    })
+                self._reply(200, {
+                    "role": state.role,
+                    "block_size": int(engine.pool.block_size),
+                    "digest": engine.prefix_digest(),
+                })
             elif self.path == "/metrics":
                 body = state.render_metrics().encode()
                 self.send_response(200)
@@ -575,7 +598,8 @@ def DecodeHandlerFactory(state: _State):
                 self._request_corr = None
 
         def _handle_post(self) -> None:
-            if self.path not in ("/generate", "/generate_stream"):
+            if self.path not in ("/generate", "/generate_stream",
+                                 "/prefill", "/kv/export", "/kv/import"):
                 return self._reply(404, {"error": f"no route {self.path}"})
             if state.phase != "ready":
                 # warming or draining: refuse new work loudly (503 is
@@ -600,6 +624,8 @@ def DecodeHandlerFactory(state: _State):
                 with state.lock:
                     state.request_errors += 1
                 return self._reply(400, {"error": f"bad JSON: {err}"})
+            if self.path in ("/prefill", "/kv/export", "/kv/import"):
+                return self._do_migration(self.path, body)
             result = _validate(state, body)
             if isinstance(result[0], int):  # (status, payload)
                 with state.lock:  # += races other request threads
@@ -738,6 +764,146 @@ def DecodeHandlerFactory(state: _State):
                 "tokens": tokens,
                 "prompt_lens": lens,
             })
+
+        def _do_migration(self, route: str, body) -> None:
+            """Disaggregated prefill/decode endpoints, all gated on the
+            paged continuous engine (the paged layout is what makes KV
+            a serializable block set):
+
+                POST /kv/export {"input_ids": [[...]]}
+                    -> {"payload": <block set>|null, "blocks": n}
+                POST /kv/import <block set>
+                    -> {"imported": cached_prefix_blocks}
+                POST /prefill   {"input_ids": [[...]],
+                                 "migrate_to": "http://decode:port"?}
+                    -> {"blocks": n, "migrated": bool, "imported": n}
+
+            /prefill runs chunked prefill to completion (a 1-token
+            decode publishes the prompt's full-block prefix into the
+            prefix cache), exports the block set and — when migrate_to
+            names a decode replica — ships it there. A failed ship is
+            reported in the reply and flight-recorded, never a 5xx:
+            the router degrades to the monolithic path on it."""
+            engine = state.engine
+            if engine is None or getattr(engine, "pool", None) is None:
+                with state.lock:
+                    state.request_errors += 1
+                return self._reply(400, {
+                    "error": f"{route} requires --batching continuous "
+                    "with --kv-layout paged"
+                })
+            if route == "/kv/import":
+                try:
+                    imported = engine.import_prefix_blocks(
+                        body, corr=self._request_corr
+                    )
+                except ValueError as err:
+                    with state.lock:
+                        state.request_errors += 1
+                    return self._reply(400, {"error": str(err)})
+                except Exception as err:  # noqa: BLE001 — same 5xx
+                    # contract as decode: JSON, never a dropped socket
+                    with state.lock:
+                        state.request_errors += 1
+                    return self._reply(500, {
+                        "error": f"import failed: "
+                        f"{type(err).__name__}: {err}"[:300]
+                    })
+                return self._reply(200, {"imported": imported})
+            result = _validate(state, body)
+            if isinstance(result[0], int):
+                with state.lock:
+                    state.request_errors += 1
+                return self._reply(*result)
+            prompt, lens = result[0], result[1]
+            if len(lens) != 1:
+                with state.lock:
+                    state.request_errors += 1
+                return self._reply(400, {
+                    "error": f"{route} takes exactly one prompt row"
+                })
+            row = prompt[0, :lens[0]].tolist()
+            if route == "/kv/export":
+                try:
+                    payload = engine.export_prefix_blocks(
+                        row, corr=self._request_corr
+                    )
+                except Exception as err:  # noqa: BLE001
+                    with state.lock:
+                        state.request_errors += 1
+                    return self._reply(500, {
+                        "error": f"export failed: "
+                        f"{type(err).__name__}: {err}"[:300]
+                    })
+                return self._reply(200, {
+                    "payload": payload,
+                    "blocks": 0 if payload is None else payload["blocks"],
+                })
+            # /prefill: ingest the prompt through the engine's normal
+            # chunked-prefill path (1 generated token; eviction
+            # publishes the full-block prefix into the prefix cache),
+            # then export + optionally ship
+            try:
+                req = engine.submit(row, 1, corr=self._request_corr)
+                for _ in req.stream():
+                    pass
+                payload = engine.export_prefix_blocks(
+                    row, corr=self._request_corr
+                )
+            except ValueError as err:
+                with state.lock:
+                    state.request_errors += 1
+                return self._reply(400, {"error": str(err)})
+            except TimeoutError as err:
+                with state.lock:
+                    state.request_errors += 1
+                return self._reply(503, {"error": str(err)})
+            except Exception as err:  # noqa: BLE001
+                with state.lock:
+                    state.request_errors += 1
+                return self._reply(500, {
+                    "error": f"prefill failed: "
+                    f"{type(err).__name__}: {err}"[:300]
+                })
+            with state.lock:
+                state.decodes += 1
+                state.tokens_generated += 1
+            out = {
+                "blocks": 0 if payload is None else payload["blocks"],
+                "migrated": False,
+                "imported": 0,
+            }
+            migrate_to = body.get("migrate_to")
+            if payload is not None and migrate_to:
+                from ..runtime.retry import RetryPolicy
+                from .client import DecodeClient
+
+                try:
+                    resp = DecodeClient(
+                        str(migrate_to), timeout=self.body_timeout,
+                        # fail fast: the router owns the degradation
+                        # decision and a handler thread blocked on
+                        # retry backoff holds the caller's TTFT
+                        retry_policy=RetryPolicy(
+                            max_attempts=2, base_delay=0.05,
+                            max_delay=0.2,
+                        ),
+                    ).kv_import(payload)
+                    out["migrated"] = True
+                    out["imported"] = int(resp.get("imported", 0))
+                except Exception as err:  # noqa: BLE001 — the blocks
+                    # stay cached HERE; the caller can re-route or fall
+                    # back to decoding on any replica (degradation, not
+                    # failure)
+                    default_flight().record(
+                        "serve", op="migrate-failed",
+                        target=str(migrate_to),
+                        error=f"{type(err).__name__}: {err}"[:200],
+                    )
+                    out["error"] = (
+                        f"migrate failed: {type(err).__name__}: {err}"
+                    )[:300]
+            return self._reply(200, out)
 
         def _do_stream(
             self, prompt, lens, new, temperature, seed, top_k, top_p,
@@ -958,6 +1124,7 @@ def make_server(
     kv_blocks: int = 0,
     prefill_chunk: int = 64,
     enable_debug_endpoints: bool = False,
+    role: str = "",
 ) -> ThreadingHTTPServer:
     """In-process server (tests and embedders); caller owns
     serve_forever/shutdown. The CLI binds 0.0.0.0 (pods must be
@@ -1075,9 +1242,14 @@ def make_server(
                 params, mesh, sharding_lib.TRANSFORMER_RULES
             ),
         )
+    if role and role not in ("prefill", "decode"):
+        raise ValueError(
+            f"role must be '', 'prefill' or 'decode', got {role!r}"
+        )
     state = _State(
         cfg, params, kv_quant_int8, model_name, max_new_cap,
         speculative=speculative, weights_int8=weights_int8, mesh=mesh,
+        role=role,
     )
     state.enable_debug = bool(enable_debug_endpoints)
     if batching == "window":
@@ -1391,6 +1563,15 @@ def main(argv=None) -> int:
         "--speculative",
     )
     parser.add_argument(
+        "--role", choices=["", "prefill", "decode"], default="",
+        help="disaggregated serving role advertised on /healthz and "
+        "/kv/digest: prefill replicas take the prefix-ingest half of "
+        "the workload (POST /prefill + KV block-set export), decode "
+        "replicas admit migrated block sets (POST /kv/import) and "
+        "serve the token streams. Default '': monolithic, both halves "
+        "in one engine",
+    )
+    parser.add_argument(
         "--enable-debug-endpoints", action="store_true",
         help="serve GET /debug/profilez (sampling wall-clock profiler: "
         "start/stop/snapshot, folded or speedscope output — "
@@ -1603,6 +1784,7 @@ def main(argv=None) -> int:
         kv_layout=args.kv_layout, block_size=args.block_size,
         kv_blocks=args.kv_blocks, prefill_chunk=args.prefill_chunk,
         enable_debug_endpoints=args.enable_debug_endpoints,
+        role=args.role,
     )
     logger.info("decode server on :%d", server.server_address[1])
     # graceful drain — the serving sibling of the training-side
