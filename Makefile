@@ -24,7 +24,7 @@ IMAGE_DIR := build/images
 DIST      := build/dist
 
 .PHONY: ci presubmit lint analyze native native-test native-race test wire-test e2e e2e-kind bench \
-        chaos-soak serve-soak serve-paged serve-sharded serve-disagg ha-soak controller-profile images release mnist-acc clean
+        chaos-soak serve-soak serve-paged serve-sharded serve-disagg trace-smoke ha-soak controller-profile images release mnist-acc clean
 
 # `test` already runs the whole tests/ tree (native bindings, wire,
 # E2E suites included) — native-test/wire-test exist for targeted runs,
@@ -121,6 +121,14 @@ serve-sharded:
 # (CI's serve-disagg-smoke)
 serve-disagg:
 	env JAX_PLATFORMS=cpu $(PY) -m tf_operator_tpu.serve.fleet --disagg
+
+# distributed-tracing smoke (docs/monitoring.md "Distributed
+# tracing"): disagg fleet, migrated request, the merged /debug/tracez
+# timeline must contain all 8 hops exactly once with monotone
+# non-overlapping boundaries, zero orphan spans, and >= 95% of the
+# client-measured TTFT attributed (CI's trace-smoke)
+trace-smoke:
+	env JAX_PLATFORMS=cpu $(PY) -m tf_operator_tpu.serve.fleet --trace-smoke
 
 # Hermetic E2E runs everywhere (operator process <-HTTP-> apiserver
 # <-HTTP-> process kubelet); the kind path self-activates when kind is

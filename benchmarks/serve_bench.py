@@ -685,6 +685,7 @@ def _route_stream(router, prompt, new, corr, results):
     gaps = []
     ttft = None
     tokens = None
+    trace = None
     for event in router.generate_stream(
         prompt, new, corr=corr, timeout=600.0
     ):
@@ -697,7 +698,40 @@ def _route_stream(router, prompt, new, corr, results):
             last = now
         if event.get("done"):
             tokens = event["tokens"][0]
-    results[corr] = {"ttft": ttft, "gaps": gaps, "tokens": tokens}
+            trace = event.get("trace_id")
+    results[corr] = {
+        "ttft": ttft, "gaps": gaps, "tokens": tokens, "trace": trace,
+    }
+
+
+def _trace_breakdowns(router, results) -> dict:
+    """Per-hop TTFT decompositions for every migrated request in a
+    result window: pull each done-event trace id back through the
+    fleet collector (observatory.router_trace — the same path
+    /debug/tracez serves) and keep the traces that decomposed into
+    the full 8-hop disaggregated timeline."""
+    from tf_operator_tpu.serve.observatory import router_trace
+
+    out = {}
+    for corr, r in sorted(results.items()):
+        tid = r.get("trace")
+        if not tid or not r.get("ttft"):
+            continue
+        page = router_trace(router, tid, handshake_samples=1)
+        bd = page["breakdown"]
+        if bd["mode"] != "disaggregated" or bd["missing"]:
+            continue
+        hop_sum = sum(h["duration_s"] for h in bd["hops"])
+        out[corr] = {
+            "hops_s": {
+                h["name"]: h["duration_s"] for h in bd["hops"]
+            },
+            "hop_sum_s": round(hop_sum, 6),
+            "client_ttft_s": round(r["ttft"], 6),
+            "coverage": round(hop_sum / r["ttft"], 4),
+            "orphans": len(page["orphans"]),
+        }
+    return out
 
 
 def disagg_scenarios() -> dict:
@@ -711,7 +745,13 @@ def disagg_scenarios() -> dict:
     for migrated prompts. Raises on any diverged chain, failed pool
     audit, chat ITL p95 not strictly better, chat TTFT p95 over the
     0.071s paged pin, or a migration-free disaggregated run — so the
-    artifact cannot go stale past an acceptance regression."""
+    artifact cannot go stale past an acceptance regression. The
+    distributed-tracing acceptance rides along (``ttft_breakdown`` /
+    ``slo_observatory`` sections): every migrated request's merged
+    trace must decompose into per-hop spans summing to >= 95% of the
+    client-measured TTFT with zero orphans, and the SLO observatory's
+    fleet TTFT/ITL p95 must sit within 10% of the exact client-side
+    percentiles."""
     from tf_operator_tpu.models import gpt as gpt_lib
     from tf_operator_tpu.serve.client import DecodeClient
 
@@ -720,8 +760,10 @@ def disagg_scenarios() -> dict:
     bs = 8
     prefill_chunk = 32  # heavy chunks: each one is a whole quantum
     n_slots = 8
-    repeats = 2  # best-of-N windows: both fleets share one CPU, so a
-    # noisy-neighbor window must not decide the A/B
+    repeats = 3  # best-of-N windows: both fleets share one CPU, so a
+    # noisy-neighbor window must not decide the A/B (two windows
+    # proved too few — the mono/disagg ITL margin is a few percent on
+    # a saturated CPU box and a single bad window flips it)
     chat_n, chat_new = 5, 32
     long_n, long_new = 6, 8
     long_stagger_s = 0.025  # long prompts keep landing mid-window
@@ -792,6 +834,10 @@ def disagg_scenarios() -> dict:
             router.probe()  # refresh digests/gauges post-warm
 
             windows = []
+            # every measured stream's client-side numbers, across
+            # windows — the population /debug/slozz must agree with
+            all_ttfts: list = []
+            all_gaps: list = []
             for rep in range(repeats):
                 results: dict = {}
                 chat_threads = [
@@ -842,6 +888,10 @@ def disagg_scenarios() -> dict:
                 gaps = sorted(g for r in chat for g in r["gaps"])
                 chat_ttfts = sorted(r["ttft"] for r in chat)
                 long_ttfts = sorted(r["ttft"] for r in longs)
+                all_ttfts += chat_ttfts + long_ttfts
+                all_gaps += [
+                    g for r in chat + longs for g in r["gaps"]
+                ]
                 total = chat_n * chat_new + long_n * long_new
                 windows.append({
                     "chat_itl_p50_s": percentile(gaps, 0.50),
@@ -851,6 +901,86 @@ def disagg_scenarios() -> dict:
                     "tokens_per_sec": total / wall,
                 })
             stats = router.stats()
+            if mode == "disaggregated":
+                # the observability acceptance rides the disagg
+                # workload: (a) every migrated request's merged trace
+                # must decompose into hops covering >= 95% of the
+                # client-measured TTFT with zero orphan records, and
+                # (b) the SLO observatory's fleet p95s (scraped
+                # histograms, bucket-interpolated) must agree with
+                # the exact client-side percentiles to +-10%
+                from tf_operator_tpu.serve.observatory import fleet_slo
+
+                breakdowns = _trace_breakdowns(router, results)
+                if not breakdowns:
+                    raise AssertionError(
+                        "disaggregated window produced no migrated "
+                        "trace to decompose"
+                    )
+                bad_cov = {
+                    corr: b["coverage"]
+                    for corr, b in breakdowns.items()
+                    if b["coverage"] < 0.95
+                }
+                if bad_cov:
+                    raise AssertionError(
+                        f"per-hop spans cover < 95% of client TTFT: "
+                        f"{bad_cov}"
+                    )
+                orphaned = {
+                    corr: b["orphans"]
+                    for corr, b in breakdowns.items() if b["orphans"]
+                }
+                if orphaned:
+                    raise AssertionError(
+                        f"orphan records in merged traces: {orphaned}"
+                    )
+                hop_names = next(
+                    iter(breakdowns.values())
+                )["hops_s"].keys()
+                out["ttft_breakdown"] = {
+                    "traces_decomposed": len(breakdowns),
+                    "min_coverage": min(
+                        b["coverage"] for b in breakdowns.values()
+                    ),
+                    "mean_hops_s": {
+                        hop: round(
+                            sum(
+                                b["hops_s"][hop]
+                                for b in breakdowns.values()
+                            ) / len(breakdowns), 6,
+                        )
+                        for hop in hop_names
+                    },
+                    "per_trace": breakdowns,
+                }
+
+                slo = fleet_slo(router)
+                ttft_client = percentile(sorted(all_ttfts), 0.95)
+                itl_client = percentile(sorted(all_gaps), 0.95)
+                ttft_slo = slo["router"]["ttft"]["p95"]
+                itl_slo = slo["router"]["itl"]["p95"]
+                checks = {
+                    "ttft_p95": (ttft_slo, ttft_client),
+                    "itl_p95": (itl_slo, itl_client),
+                }
+                for name, (observed, exact) in checks.items():
+                    if observed is None or abs(
+                        observed - exact
+                    ) > 0.10 * exact:
+                        raise AssertionError(
+                            f"/debug/slozz {name} {observed} not "
+                            f"within 10% of client-side {exact:.6f}"
+                        )
+                out["slo_observatory"] = {
+                    "ttft_p95_s": round(ttft_slo, 6),
+                    "ttft_p95_client_s": round(ttft_client, 6),
+                    "itl_p95_s": round(itl_slo, 6),
+                    "itl_p95_client_s": round(itl_client, 6),
+                    "fleet_queue_depth": slo["fleet"]["queue_depth"],
+                    "fleet_kv_occupancy": slo["fleet"]["kv_occupancy"],
+                    "hops_p95_s": slo["hops_p95"],
+                }
             best = {
                 key: min(w[key] for w in windows)
                 for key in windows[0]

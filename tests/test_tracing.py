@@ -1,0 +1,509 @@
+"""Fleet-wide distributed tracing: the trace-context primitive, its
+flight-recorder integration, the cross-process collector's hop
+decomposition, the SLO observatory endpoints, and the trace-header
+lint over every outbound serve HTTP call site.
+
+The full cross-process proof (router -> prefill replica -> decode
+replica sharing one trace id over real sockets, merged through
+/debug/tracez) lives in serve/fleet.py run_trace_smoke (CI step
+`trace-smoke`); these tests pin each layer in isolation so a
+regression names the layer that broke."""
+
+import ast
+import json
+import os
+import sys
+import threading
+import urllib.request
+
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+from tf_operator_tpu.telemetry import tracecontext as tc  # noqa: E402
+from tf_operator_tpu.telemetry.collector import (  # noqa: E402
+    KNOWN_OPS,
+    HOP_NAMES,
+    ClockMap,
+    clock_offset,
+    collect_trace,
+    hop_breakdown,
+)
+from tf_operator_tpu.telemetry.flight import FlightRecorder  # noqa: E402
+
+
+class TestTraceContext:
+    def test_format_parse_round_trip(self):
+        ctx = tc.TraceContext(tc.new_trace_id(), tc.new_span_id())
+        assert tc.parse_traceparent(tc.format_traceparent(ctx)) == ctx
+
+    def test_ids_are_hex_of_spec_length(self):
+        assert len(tc.new_trace_id()) == 32
+        assert len(tc.new_span_id()) == 16
+        int(tc.new_trace_id(), 16)
+        int(tc.new_span_id(), 16)
+
+    @pytest.mark.parametrize("bad", [
+        None,
+        "",
+        "garbage",
+        "00-short-span-01",
+        "00-" + "g" * 32 + "-" + "1" * 16 + "-01",   # non-hex
+        "00-" + "0" * 32 + "-" + "1" * 16 + "-01",   # all-zero trace
+        "00-" + "1" * 32 + "-" + "0" * 16 + "-01",   # all-zero span
+        "ff-" + "1" * 32 + "-" + "2" * 16 + "-01",   # unknown version
+    ])
+    def test_malformed_headers_degrade_to_untraced(self, bad):
+        assert tc.parse_traceparent(bad) is None
+
+    def test_uppercase_hex_is_normalized_not_rejected(self):
+        # W3C wants lowercase on the wire; be liberal on receive
+        parsed = tc.parse_traceparent(
+            "00-" + "A" * 32 + "-" + "2" * 16 + "-01"
+        )
+        assert parsed == tc.TraceContext("a" * 32, "2" * 16)
+
+    def test_scope_binds_and_restores(self):
+        assert tc.current_trace() is None
+        with tc.trace_scope() as outer:
+            assert tc.current_trace() == outer
+            with tc.trace_scope(parent=outer) as inner:
+                # child of the same trace, new span
+                assert inner.trace_id == outer.trace_id
+                assert inner.span_id != outer.span_id
+                assert tc.current_trace() == inner
+            assert tc.current_trace() == outer
+        assert tc.current_trace() is None
+
+    def test_headers_helper_injects_only_when_bound(self):
+        base = {"Content-Type": "application/json"}
+        assert tc.trace_headers(base) == base
+        with tc.trace_scope() as ctx:
+            out = tc.trace_headers(base)
+            assert out[tc.TRACEPARENT_HEADER] == tc.format_traceparent(ctx)
+            assert out["Content-Type"] == "application/json"
+        # the helper must not mutate the caller's dict
+        assert tc.TRACEPARENT_HEADER not in base
+
+
+class TestFlightTraceInjection:
+    def test_ambient_trace_lands_in_fields(self):
+        fl = FlightRecorder(capacity=8)
+        with tc.trace_scope() as ctx:
+            fl.record("serve", op="request")
+        rec = fl.snapshot()[0]
+        assert rec.fields["trace"] == ctx.trace_id
+        assert rec.fields["span"] == ctx.span_id
+
+    def test_explicit_trace_wins_over_ambient(self):
+        fl = FlightRecorder(capacity=8)
+        with tc.trace_scope():
+            fl.record("serve", op="admit", trace="feedbead" * 4)
+        assert fl.snapshot()[0].fields["trace"] == "feedbead" * 4
+
+    def test_explicit_none_means_untraced(self):
+        # scheduler-thread call sites pass trace=req.trace
+        # unconditionally; None must mean "no field", not field=None
+        fl = FlightRecorder(capacity=8)
+        fl.record("serve", op="evict", trace=None)
+        assert "trace" not in fl.snapshot()[0].fields
+
+    def test_render_flightz_trace_filter(self):
+        from tf_operator_tpu.telemetry.flight import render_flightz
+
+        fl = FlightRecorder(capacity=16)
+        with tc.trace_scope() as ctx:
+            fl.record("serve", op="request")
+        fl.record("serve", op="request")
+        body = render_flightz(fl, f"trace={ctx.trace_id}")
+        lines = [json.loads(x) for x in body.splitlines() if x.strip()]
+        assert len(lines) == 1
+        assert lines[0]["fields"]["trace"] == ctx.trace_id
+
+
+class _FakeClock:
+    """Deterministic clockz endpoint + flightz store for collector
+    tests: the replica's monotonic clock runs `skew` seconds behind
+    the collector's."""
+
+    def __init__(self, skew: float, records=None):
+        self.skew = skew
+        self.records = records or []
+
+    def clockz(self):
+        import time
+
+        now = time.monotonic()
+        return {
+            "mono": now - self.skew, "perf": now - self.skew,
+            "wall": 0.0, "tracer_epoch_perf": 0.0, "pid": 1,
+        }
+
+    def flightz(self, trace=None):
+        return [dict(r) for r in self.records]
+
+
+def _rec(seq, t, corr, op, trace="t" * 32, **fields):
+    fields = {"op": op, "trace": trace, **fields}
+    return {
+        "seq": seq, "t": t, "wall": 1000.0 + t, "kind": "serve",
+        "corr": corr, "fields": fields,
+    }
+
+
+def _disagg_records(trace="t" * 32, base=100.0):
+    """A synthetic migrated request: router group, /prefill handler
+    group, /kv/import handler group, /generate_stream handler group —
+    boundary instants 10ms apart in hop order."""
+    t = [base + 0.01 * i for i in range(9)]
+    return [
+        _rec(1, t[0], "r-1", "route", trace=trace),
+        _rec(2, t[1], "r-1", "pick", trace=trace),
+        _rec(3, t[2], "req-1", "request", trace=trace, path="/prefill"),
+        _rec(4, t[2] + 0.002, "req-1", "prefill-chunk", trace=trace),
+        _rec(5, t[3], "req-1", "evict", trace=trace),
+        _rec(6, t[4], "req-1", "kv-export", trace=trace),
+        _rec(7, t[5], "req-2", "request", trace=trace, path="/kv/import"),
+        _rec(8, t[6], "req-2", "kv-import", trace=trace),
+        _rec(
+            9, t[6] + 0.001, "req-3", "request", trace=trace,
+            path="/generate_stream",
+        ),
+        _rec(10, t[7], "req-3", "admit", trace=trace),
+        _rec(11, t[8], "req-3", "first-token", trace=trace),
+    ]
+
+
+class TestCollector:
+    def test_clock_offset_recovers_skew(self):
+        cm = clock_offset(_FakeClock(skew=5.0), samples=3)
+        assert abs(cm.offset_mono - 5.0) < 0.05
+        assert cm.rtt >= 0.0
+
+    def test_disagg_breakdown_all_eight_hops(self):
+        bd = hop_breakdown(_disagg_records())
+        assert bd["mode"] == "disaggregated"
+        assert bd["missing"] == []
+        assert [h["name"] for h in bd["hops"]] == list(HOP_NAMES)
+        # contiguous: hops tile route -> first-token exactly
+        assert bd["ttft_s"] == pytest.approx(0.08, abs=1e-6)
+        assert sum(
+            h["duration_s"] for h in bd["hops"]
+        ) == pytest.approx(bd["ttft_s"], abs=1e-5)
+        for prev, cur in zip(bd["hops"], bd["hops"][1:]):
+            assert cur["start_s"] == prev["end_s"]
+
+    def test_monolithic_breakdown_four_hops(self):
+        trace = "m" * 32
+        t = [200.0 + 0.01 * i for i in range(5)]
+        records = [
+            _rec(1, t[0], "r-2", "route", trace=trace),
+            _rec(2, t[1], "r-2", "pick", trace=trace),
+            _rec(
+                3, t[2], "req-9", "request", trace=trace,
+                path="/generate_stream",
+            ),
+            _rec(4, t[3], "req-9", "admit", trace=trace),
+            _rec(5, t[4], "req-9", "first-token", trace=trace),
+        ]
+        bd = hop_breakdown(records)
+        assert bd["mode"] == "monolithic"
+        assert [h["name"] for h in bd["hops"]] == [
+            "queue_wait", "route_decision", "decode_admit", "first_token",
+        ]
+        assert bd["missing"] == []
+
+    def test_missing_boundary_is_named_not_invented(self):
+        records = [
+            r for r in _disagg_records()
+            if r["fields"]["op"] != "kv-export"
+        ]
+        bd = hop_breakdown(records)
+        assert bd["missing"] == ["kv_export"]
+        assert bd["hops"] == []
+
+    def test_last_pick_wins_after_failover(self):
+        records = _disagg_records()
+        # an earlier pick from a failed placement attempt
+        records.insert(1, _rec(99, 99.999, "r-1", "pick", trace="t" * 32))
+        bd = hop_breakdown(records)
+        assert bd["missing"] == []
+        # queue_wait ends at the LAST pick, not the stale one
+        assert bd["hops"][0]["end_s"] == pytest.approx(100.01)
+
+    def test_monotone_clamp_absorbs_handshake_skew(self):
+        records = _disagg_records()
+        # kv-import timed 3ms "before" the /kv/import request that
+        # caused it — cross-replica offset error
+        for r in records:
+            if r["fields"]["op"] == "kv-import":
+                r["t"] = 100.048
+        bd = hop_breakdown(records)
+        assert bd["missing"] == []
+        assert bd["clamped_s"] == pytest.approx(0.002, abs=1e-6)
+        assert all(h["duration_s"] >= 0 for h in bd["hops"])
+
+    def test_collect_trace_dedupes_shared_ring_fetches(self):
+        # two replicas of an in-process fleet serve the SAME ring:
+        # every record arrives once per fetch path, plus the local copy
+        records = _disagg_records()
+        replicas = {
+            "a": _FakeClock(skew=0.0, records=records),
+            "b": _FakeClock(skew=0.0, records=records),
+        }
+        page = collect_trace(
+            "t" * 32, replicas, local_records=records,
+            handshake_samples=1,
+        )
+        assert len(page["records"]) == len(records)
+        assert page["breakdown"]["missing"] == []
+        assert page["orphans"] == []
+        assert set(page["replicas"]) == {"a", "b"}
+
+    def test_collect_trace_flags_unknown_ops_as_orphans(self):
+        records = _disagg_records()
+        records.append(
+            _rec(50, 100.05, "req-1", "mystery-op", trace="t" * 32)
+        )
+        page = collect_trace(
+            "t" * 32, {}, local_records=records, handshake_samples=1
+        )
+        assert len(page["orphans"]) == 1
+        assert page["orphans"][0]["fields"]["op"] == "mystery-op"
+
+    def test_collect_trace_filters_other_traces(self):
+        records = _disagg_records() + _disagg_records(trace="u" * 32)
+        page = collect_trace(
+            "t" * 32, {}, local_records=records, handshake_samples=1
+        )
+        assert all(
+            r["fields"]["trace"] == "t" * 32 for r in page["records"]
+        )
+
+    def test_perfetto_events_cover_hops_and_records(self):
+        page = collect_trace(
+            "t" * 32, {}, local_records=_disagg_records(),
+            handshake_samples=1,
+        )
+        events = page["perfetto"]["traceEvents"]
+        hop_events = [e for e in events if e.get("cat") == "hop"]
+        assert [e["name"] for e in hop_events] == list(HOP_NAMES)
+        assert all(e["ts"] >= 0 for e in events if "ts" in e)
+
+    def test_boundary_ops_stay_in_known_vocabulary(self):
+        # every op the synthetic timeline uses must be non-orphan; if
+        # an op is renamed, this fails before the smoke does
+        for r in _disagg_records():
+            assert r["fields"]["op"] in KNOWN_OPS
+
+    def test_clock_normalization_aligns_skewed_replica(self):
+        import time
+
+        # replica clock 2s behind: records fetched from it land at
+        # (local) base once normalized
+        base_local = time.monotonic()
+        records = [
+            _rec(1, base_local - 2.0, "r-1", "route"),
+        ]
+        page = collect_trace(
+            "t" * 32, {"skewed": _FakeClock(skew=2.0, records=records)},
+            handshake_samples=3,
+        )
+        assert len(page["records"]) == 1
+        assert page["records"][0]["t"] == pytest.approx(
+            base_local, abs=0.1
+        )
+
+
+class TestObservatory:
+    @pytest.fixture
+    def router(self):
+        from tf_operator_tpu.serve.router import LeastLoadedRouter
+
+        return LeastLoadedRouter()
+
+    def test_fleet_slo_shape_and_gauges(self, router):
+        from tf_operator_tpu.serve.observatory import fleet_slo
+
+        router._ttft_window.extend([0.010, 0.020, 0.030, 0.040])
+        router._itl_window.extend([0.001, 0.002, 0.003])
+        slo = fleet_slo(router)
+        assert slo["fleet"]["replicas_scraped"] == 0
+        assert slo["router"]["ttft"]["p95"] == pytest.approx(
+            0.0385, abs=1e-9
+        )
+        assert slo["router"]["itl"]["p50"] == pytest.approx(0.002)
+        page = router.registry.render()
+        assert "fleet_ttft_seconds" in page
+        assert "fleet_queue_depth" in page
+
+    def test_http_endpoints(self, router):
+        from tf_operator_tpu.serve.observatory import make_observatory
+
+        obs = make_observatory(router)
+        thread = threading.Thread(target=obs.serve_forever, daemon=True)
+        thread.start()
+        host, port = obs.server_address[:2]
+        base = f"http://{host}:{port}"
+        try:
+            def get(path):
+                with urllib.request.urlopen(base + path, timeout=10) as r:
+                    return r.status, r.read()
+
+            status, body = get("/debug/routez")
+            assert status == 200
+            assert "decisions" in json.loads(body)
+
+            status, body = get("/debug/slozz")
+            assert status == 200
+            assert "fleet" in json.loads(body)
+
+            status, body = get("/metrics")
+            assert status == 200
+            assert b"tf_operator_tpu_router" in body
+
+            status, body = get(
+                "/debug/tracez?trace=" + "a" * 32
+            )
+            assert status == 200
+            page = json.loads(body)
+            assert page["records"] == []
+            assert page["breakdown"]["missing"]
+
+            with pytest.raises(urllib.error.HTTPError) as err:
+                get("/debug/tracez")
+            assert err.value.code == 400
+        finally:
+            obs.shutdown()
+            obs.server_close()
+
+
+class TestControllerEpisodeTrace:
+    def test_reconcile_episode_is_stamped(self):
+        from tf_operator_tpu.api.types import (
+            ServeService,
+            ServeServiceSpec,
+        )
+        from tf_operator_tpu.controller.serve import (
+            ServeServiceController,
+        )
+        from tf_operator_tpu.runtime import InMemorySubstrate
+        from tf_operator_tpu.telemetry.flight import default_flight
+
+        substrate = InMemorySubstrate()
+        controller = ServeServiceController(substrate, namespace="tr")
+        svc = ServeService(
+            spec=ServeServiceSpec(preset="tiny", weights_version="v1")
+        )
+        svc.metadata.name = "episodes"
+        svc.metadata.namespace = "tr"
+        try:
+            substrate.create_serve_service(svc)
+            controller.run_until_quiet()
+        finally:
+            controller.stop()
+        episodes = [
+            r for r in default_flight().snapshot(kind="reconcile")
+            if r.fields.get("op") == "serve-sync"
+            and r.fields.get("decision") == "episode"
+        ]
+        assert episodes, "no traced reconcile episode recorded"
+        rec = episodes[-1]
+        parsed = tc.parse_traceparent(rec.fields["traceparent"])
+        assert parsed is not None
+        # the header-shaped stamp and the ambient injection agree
+        assert rec.fields["trace"] == parsed.trace_id
+
+
+class TestProfilerRoles:
+    def test_disagg_engine_threads_get_distinct_roles(self):
+        from tf_operator_tpu.telemetry.profiler import SamplingProfiler
+
+        p = SamplingProfiler()
+        assert p._role_of("decode-engine-prefill") == "engine-prefill"
+        assert p._role_of("decode-engine-decode") == "engine-decode"
+        # the role-less engine thread keeps its generic bucket
+        assert p._role_of("decode-engine") not in (
+            "engine-prefill", "engine-decode",
+        )
+
+
+SERVE_DIR = os.path.join(REPO, "tf_operator_tpu", "serve")
+
+
+def _outbound_call_sites(path):
+    """(lineno, source_segment, context_lines) for every outbound
+    HTTP construction in a serve module: urllib Request() builds and
+    urlopen() calls whose argument is built inline (not a prebuilt
+    Request variable)."""
+    with open(path) as f:
+        source = f.read()
+    lines = source.splitlines()
+    tree = ast.parse(source)
+    sites = []
+    for node in ast.walk(tree):
+        if not isinstance(node, ast.Call):
+            continue
+        target = ast.unparse(node.func)
+        if target.endswith("Request") and "urllib" in target:
+            pass  # a request object is being built: must carry headers
+        elif target.endswith("urlopen") and node.args and not isinstance(
+            node.args[0], ast.Name
+        ):
+            pass  # urlopen on an inline URL builds an implicit request
+        else:
+            continue
+        segment = ast.get_source_segment(source, node) or ""
+        context = lines[max(0, node.lineno - 4):node.lineno]
+        sites.append((node.lineno, segment, context))
+    return sites
+
+
+class TestTraceHeaderLint:
+    """Graftlint-style sweep: every outbound serve HTTP call site
+    either goes through the blessed trace_headers() helper or carries
+    an explicit `# trace-exempt: <reason>` comment. A new call site
+    that silently drops correlation context fails here, not in a
+    3am debugging session."""
+
+    def test_every_serve_call_site_traced_or_exempt(self):
+        offenders = []
+        for name in sorted(os.listdir(SERVE_DIR)):
+            if not name.endswith(".py"):
+                continue
+            path = os.path.join(SERVE_DIR, name)
+            for lineno, segment, context in _outbound_call_sites(path):
+                traced = "trace_headers(" in segment
+                exempt = any(
+                    "trace-exempt:" in line for line in context
+                )
+                if not traced and not exempt:
+                    offenders.append(f"serve/{name}:{lineno}: {segment}")
+        assert not offenders, (
+            "outbound serve HTTP call sites without trace_headers() "
+            "or a '# trace-exempt: <reason>' comment:\n"
+            + "\n".join(offenders)
+        )
+
+    def test_lint_actually_fires_on_seeded_offender(self, tmp_path):
+        seeded = tmp_path / "bad.py"
+        seeded.write_text(
+            "import urllib.request\n"
+            "req = urllib.request.Request('http://x/generate')\n"
+        )
+        sites = _outbound_call_sites(str(seeded))
+        assert len(sites) == 1
+        traced = "trace_headers(" in sites[0][1]
+        exempt = any("trace-exempt:" in x for x in sites[0][2])
+        assert not traced and not exempt
+
+    def test_lint_honors_exemption_comment(self, tmp_path):
+        seeded = tmp_path / "ok.py"
+        seeded.write_text(
+            "import urllib.request\n"
+            "# trace-exempt: liveness probe\n"
+            "req = urllib.request.Request('http://x/readyz')\n"
+        )
+        (lineno, segment, context), = _outbound_call_sites(str(seeded))
+        assert any("trace-exempt:" in x for x in context)

@@ -59,6 +59,7 @@ from ..runtime import (
 )
 from ..runtime.control import owner_reference
 from ..telemetry.flight import correlate, flight_record
+from ..telemetry.tracecontext import format_traceparent, trace_scope
 from .clock import Clock
 from .reconciler import expectation_pods_key
 from .status import clear_condition, set_condition
@@ -577,7 +578,16 @@ class ServeServiceController:
             self._record_phases(key, phases)
             return
         phases["get"] = time.perf_counter() - mark
-        with correlate(svc.metadata.uid or key):
+        # each reconcile episode is its own trace, stamped in the same
+        # traceparent header shape the serve planes propagate — so a
+        # flightz trace filter (or the fleet collector) isolates one
+        # episode's records exactly like one request's
+        with correlate(svc.metadata.uid or key), trace_scope() as tctx:
+            flight_record(
+                "reconcile", op="serve-sync", key=key,
+                decision="episode",
+                traceparent=format_traceparent(tctx),
+            )
             try:
                 self._sync_service(key, svc, phases)
             finally:

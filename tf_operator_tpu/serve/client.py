@@ -30,6 +30,7 @@ import urllib.request
 from typing import Dict, List, Optional
 
 from ..runtime.retry import RetryPolicy, call_with_retries, retry_after_hint
+from ..telemetry.tracecontext import trace_headers
 
 # 500/504 are deliberately absent (unlike the substrate's transport
 # policy): a 500 from the decode server is "this decode failed", which
@@ -78,6 +79,10 @@ class DecodeClient:
         self.retry_policy = retry_policy or RetryPolicy(
             max_attempts=3, base_delay=0.05, max_delay=1.0
         )
+        # the fleet trace id of the most recent completed stream (the
+        # server echoes it in the done event), so a caller can join
+        # its request to /debug/tracez without parsing events itself
+        self.last_trace_id: Optional[str] = None
 
     def _open(self, req: urllib.request.Request, op: str):
         """urlopen with transient-failure retries; the caller owns the
@@ -98,7 +103,7 @@ class DecodeClient:
         req = urllib.request.Request(
             self.base_url + path,
             data=data,
-            headers={"Content-Type": "application/json"},
+            headers=trace_headers({"Content-Type": "application/json"}),
             method="POST" if data is not None else "GET",
         )
         try:
@@ -145,7 +150,13 @@ class DecodeClient:
         mid-stream arrives as an {"error": ...} line and raises
         DecodeError here. Retries cover the connect only — past the
         first byte a failure propagates (a stream body is not
-        idempotent; the router owns mid-stream failover)."""
+        idempotent; the router owns mid-stream failover).
+
+        NOT a generator function: the request is built and connected
+        HERE, so an ambient trace context (telemetry trace_scope) at
+        the call site lands in the outbound traceparent header. A
+        generator body would run in its consumer's context (PEP 567)
+        and silently drop the binding the router set up."""
         data = json.dumps({
             "input_ids": [list(input_ids)],
             "max_new_tokens": max_new_tokens,
@@ -157,22 +168,28 @@ class DecodeClient:
         req = urllib.request.Request(
             self.base_url + "/generate_stream",
             data=data,
-            headers={"Content-Type": "application/json"},
+            headers=trace_headers({"Content-Type": "application/json"}),
             method="POST",
         )
         try:
             resp = self._open(req, "decode/generate_stream")
         except urllib.error.HTTPError as err:
             raise _to_decode_error(err) from None
-        with resp:
-            for line in resp:
-                line = line.strip()
-                if not line:
-                    continue
-                event = json.loads(line)
-                if "error" in event:
-                    raise DecodeError(200, event["error"])
-                yield event
+
+        def events():
+            with resp:
+                for line in resp:
+                    line = line.strip()
+                    if not line:
+                        continue
+                    event = json.loads(line)
+                    if "error" in event:
+                        raise DecodeError(200, event["error"])
+                    if event.get("done") and event.get("trace_id"):
+                        self.last_trace_id = event["trace_id"]
+                    yield event
+
+        return events()
 
     def beam_search(
         self,
@@ -235,6 +252,7 @@ class DecodeClient:
         """True iff /readyz answers 200 (engine warm, not draining).
         Deliberately un-retried: a health probe must be cheap and
         honest, and its caller (the router) polls anyway."""
+        # trace-exempt: a liveness probe belongs to no request trace
         req = urllib.request.Request(
             self.base_url + "/readyz", method="GET"
         )
@@ -269,20 +287,29 @@ class DecodeClient:
         load it in ui.perfetto.dev as-is."""
         return json.loads(self._request("/debug/trace"))
 
+    def clockz(self) -> dict:
+        """The replica's clock handshake from /debug/clockz:
+        {"mono", "perf", "wall", "tracer_epoch_perf", "pid"} — the
+        collector (telemetry/collector.py) samples it a few times,
+        keeps the min-RTT sample, and maps each replica's monotonic
+        timestamps onto its own clock."""
+        return json.loads(self._request("/debug/clockz"))
+
     def flightz(
         self,
         request: Optional[str] = None,
         kind: Optional[str] = None,
         limit: Optional[int] = None,
         since: Optional[float] = None,
+        trace: Optional[str] = None,
     ) -> List[dict]:
         """Parsed flight-recorder records from /debug/flightz, newest
         last. request filters on the correlation ID the server echoes
         as "request_id" (so a client can pull exactly its own
-        admit/evict/step records); kind/limit/since filter server-side
-        (since = unix timestamp, records at or after it — pass a
-        profile payload's wall_start to fetch the overlapping
-        flight window)."""
+        admit/evict/step records); kind/limit/since/trace filter
+        server-side (since = unix timestamp, records at or after it —
+        pass a profile payload's wall_start to fetch the overlapping
+        flight window; trace = fleet trace id, the collector's key)."""
         from urllib.parse import urlencode
 
         params = {}
@@ -294,6 +321,8 @@ class DecodeClient:
             params["limit"] = str(limit)
         if since is not None:
             params["since"] = repr(float(since))
+        if trace is not None:
+            params["trace"] = trace
         path = "/debug/flightz"
         if params:
             path += "?" + urlencode(params)

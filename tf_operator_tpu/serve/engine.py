@@ -67,6 +67,7 @@ import time
 import numpy as np
 
 from ..telemetry.flight import current_correlation, default_flight
+from ..telemetry.tracecontext import current_trace
 from ..utils import locks
 from .prefix import prefix_hash
 
@@ -330,16 +331,23 @@ class EngineRequest:
     __slots__ = (
         "prompt", "new", "tokens", "error", "done", "cancelled",
         "created", "first_token_at", "admitted_at", "last_token_at",
-        "span", "corr", "_stream",
+        "span", "corr", "trace", "_stream",
     )
 
-    def __init__(self, prompt, new: int, corr=None):
+    def __init__(self, prompt, new: int, corr=None, trace=None):
         self.prompt = [int(t) for t in prompt]
         self.new = int(new)
         # correlation ID (the server's request id): carried from the
         # HTTP thread into the engine thread, so slot-side flight
         # records join the request's server-side records and span
         self.corr = corr
+        # fleet trace id (telemetry/tracecontext.py): captured at
+        # submit() from the HTTP thread's bound scope. The scheduler
+        # thread runs OUTSIDE any request context, so per-request
+        # records there must pass trace=req.trace explicitly — ambient
+        # lookup would silently yield nothing (the same PEP 567 edge
+        # the router's docstring documents for generators)
+        self.trace = trace
         self.tokens: list = []  # generated tokens, appended live
         self.error = None
         self.done = threading.Event()
@@ -439,6 +447,7 @@ class ContinuousBatchingEngine:
         prefill_chunk: int = 64,
         prefix_cache: bool = True,
         mesh_shape=None,
+        role: str = "",
     ):
         from ..models import gpt as gpt_lib
 
@@ -663,8 +672,14 @@ class ContinuousBatchingEngine:
         # ordering assertions
         self.thread = None
         if start:
+            # role-suffixed thread name ("decode-engine-prefill" /
+            # "decode-engine-decode"): the sampling profiler's role
+            # table (telemetry/profiler.py) matches the suffix first,
+            # so folded stacks from a disagg fleet attribute to the
+            # right pool instead of one generic "engine" bucket
+            name = "decode-engine" + (f"-{role}" if role else "")
             self.thread = threading.Thread(
-                target=self._run, name="decode-engine", daemon=True
+                target=self._run, name=name, daemon=True
             )
             self.thread.start()
 
@@ -704,7 +719,11 @@ class ContinuousBatchingEngine:
                 )
         if corr is None:
             corr = current_correlation()
-        req = EngineRequest(row, new, corr=corr)
+        ctx = current_trace()
+        req = EngineRequest(
+            row, new, corr=corr,
+            trace=ctx.trace_id if ctx is not None else None,
+        )
         if self._tracer is not None:
             span_args = {"prompt_tokens": len(row), "max_new_tokens": new}
             if corr is not None:
@@ -832,6 +851,10 @@ class ContinuousBatchingEngine:
         if not self._paged:
             raise RuntimeError("KV export requires kv_layout='paged'")
         row = [int(t) for t in prompt]
+        # capture the caller's trace HERE: op() runs on the engine
+        # thread, outside the request's bound scope
+        ctx = current_trace()
+        trace = ctx.trace_id if ctx is not None else None
 
         def op():
             import jax
@@ -859,7 +882,7 @@ class ContinuousBatchingEngine:
             self.kv_blocks_exported += len(blocks)
             self.migrations_out += 1
             self._fl().record(
-                "serve", corr=corr, op="kv-export",
+                "serve", corr=corr, trace=trace, op="kv-export",
                 blocks=len(blocks), tokens=len(blocks) * bs,
             )
             return {
@@ -894,6 +917,8 @@ class ContinuousBatchingEngine:
         tokens = [int(t) for t in payload.get("tokens", [])]
         if m < 1 or len(tokens) < m * bs:
             raise ValueError("malformed KV block-set payload")
+        ctx = current_trace()
+        trace = ctx.trace_id if ctx is not None else None
 
         def op():
             import jax
@@ -949,7 +974,7 @@ class ContinuousBatchingEngine:
             self.kv_blocks_imported += written
             self.migrations_in += 1
             self._fl().record(
-                "serve", corr=corr, op="kv-import",
+                "serve", corr=corr, trace=trace, op="kv-import",
                 blocks=m, written=written, cached=cached,
             )
             return cached
@@ -1245,7 +1270,7 @@ class ContinuousBatchingEngine:
             if req.span is not None:
                 req.span.finish(outcome="cancelled")
             self._fl().record(
-                "serve", corr=req.corr, op="evict",
+                "serve", corr=req.corr, trace=req.trace, op="evict",
                 outcome="cancelled-before-admission",
             )
             req._finish(DecodeCancelled("cancelled before admission"))
@@ -1256,7 +1281,8 @@ class ContinuousBatchingEngine:
         if req.span is not None:
             req.span.annotate("admitted")
         self._fl().record(
-            "serve", corr=req.corr, op="admit", slot=self._free[0],
+            "serve", corr=req.corr, trace=req.trace, op="admit",
+            slot=self._free[0],
             queue_wait=round(req.admitted_at - req.created, 6),
         )
         slot = self._free.pop(0)
@@ -1306,8 +1332,8 @@ class ContinuousBatchingEngine:
         table[:] = 0
         table[:len(blocks)] = blocks
         self._fl().record(
-            "serve", corr=req.corr, op="kv-plan", slot=slot,
-            shared=len(shared), fresh=need,
+            "serve", corr=req.corr, trace=req.trace, op="kv-plan",
+            slot=slot, shared=len(shared), fresh=need,
             cow=cow_src is not None, start=start,
         )
         chunk = self.prefill_chunk
@@ -1378,8 +1404,8 @@ class ContinuousBatchingEngine:
                         outcome="error", error=type(error).__name__
                     )
             self._fl().record(
-                "serve", corr=req.corr, op="evict", slot=slot,
-                outcome=outcome, tokens=len(req.tokens),
+                "serve", corr=req.corr, trace=req.trace, op="evict",
+                slot=slot, outcome=outcome, tokens=len(req.tokens),
             )
             req._finish(error)
 
@@ -1420,8 +1446,8 @@ class ContinuousBatchingEngine:
         if self._h_prefill is not None:
             self._h_prefill.observe(took)
         self._fl().record(
-            "serve", corr=req.corr, op="prefill-chunk", slot=slot,
-            offset=off, tokens=chunk,
+            "serve", corr=req.corr, trace=req.trace, op="prefill-chunk",
+            slot=slot, offset=off, tokens=chunk,
         )
         state["offset"] = off + chunk
         self._prefilling.pop(slot)
@@ -1491,6 +1517,13 @@ class ContinuousBatchingEngine:
                         self._h_ttft.observe(now - req.created)
                     if req.span is not None:
                         req.span.annotate("first-token")
+                    # the TTFT endpoint is a hop boundary the trace
+                    # collector decomposes on (telemetry/collector.py)
+                    self._fl().record(
+                        "serve", corr=req.corr, trace=req.trace,
+                        op="first-token", slot=slot,
+                        ttft=round(now - req.created, 6),
+                    )
                     if self._paged and self._slot_keys[slot]:
                         # the prompt's full blocks now hold final K/V:
                         # publish them so later prompts sharing the

@@ -758,6 +758,205 @@ def run_disagg_smoke(
     return summary
 
 
+def run_trace_smoke(
+    seed: int = 0,
+    max_new: int = 12,
+    namespace: str = "tracez",
+) -> dict:
+    """End-to-end proof of fleet-wide distributed tracing (CI step
+    `trace-smoke`): a 1-prefill + 1-decode disaggregated fleet serves
+    shared-prefix requests; at least one must migrate, and that
+    request's merged trace — fetched through the observatory's
+    /debug/tracez HTTP endpoint, i.e. the full collector path with
+    clock handshakes — must contain every one of the 8 hops exactly
+    once, with monotone non-overlapping boundaries, ZERO orphan
+    records, and a hop sum covering >= 95% of the client-measured
+    TTFT. Also sanity-checks /debug/routez (decisions carry trace
+    ids) and /debug/slozz (fleet quantiles present). Raises
+    AssertionError on any violation."""
+    import urllib.request
+
+    import jax
+    import jax.numpy as jnp
+
+    from ..controller.serve import ServeServiceController
+    from ..models import gpt as gpt_lib
+    from ..runtime import InMemorySubstrate
+    from ..telemetry.collector import HOP_NAMES
+    from .observatory import make_observatory
+
+    cfg = gpt_lib.GPT_TINY
+    params = gpt_lib.GPT(cfg).init(
+        jax.random.PRNGKey(0), jnp.zeros((1, 8), jnp.int32)
+    )["params"]
+
+    rng = random.Random(seed)
+    block_size = 8
+    streams = 2
+    substrate = InMemorySubstrate()
+    router = LeastLoadedRouter(retry_wait=0.02)
+    fleet = InProcessFleet(
+        substrate, router, cfg, {"v1": params}, slots=2,
+        namespace=namespace, block_size=block_size,
+        prefill_chunk=block_size,
+    )
+    controller = ServeServiceController(
+        substrate, namespace=namespace,
+        weight_update=fleet.update_weights,
+    )
+    svc = ServeService(
+        spec=ServeServiceSpec(
+            preset="tiny", slots=2, weights_version="v1",
+            replica_groups={
+                "prefill": ServeReplicaGroup(replicas=1),
+                "decode": ServeReplicaGroup(replicas=1),
+            },
+        )
+    )
+    svc.metadata.name = "tracez"
+    svc.metadata.namespace = namespace
+
+    shared = [
+        rng.randrange(1, cfg.vocab_size) for _ in range(2 * block_size)
+    ]
+    prompts = [
+        shared + [
+            rng.randrange(1, cfg.vocab_size)
+            for _ in range(rng.randint(1, 3))
+        ]
+        for _ in range(streams)
+    ]
+
+    started = time.monotonic()
+    # per-stream: (trace_id, client-measured TTFT seconds)
+    measured: List[Optional[dict]] = [None] * streams
+    obs = None
+    obs_thread = None
+    try:
+        substrate.create_serve_service(svc)
+        controller.run_until_quiet()
+        fleet.sync()
+        fleet.wait_ready(2)
+
+        for i, prompt in enumerate(prompts):
+            t0 = time.perf_counter()
+            first_at = None
+            final = None
+            for event in router.generate_stream(
+                prompt, max_new, corr=f"trace-{seed}-{i}", timeout=120.0,
+            ):
+                if first_at is None and event.get("token") is not None:
+                    first_at = time.perf_counter()
+                if event.get("done"):
+                    final = event
+            measured[i] = {
+                "trace": final.get("trace_id") if final else None,
+                "client_ttft": (
+                    first_at - t0 if first_at is not None else None
+                ),
+            }
+
+        obs = make_observatory(router)
+        obs_thread = threading.Thread(
+            target=obs.serve_forever, daemon=True, name="observatory"
+        )
+        obs_thread.start()
+        host, port = obs.server_address[:2]
+        base = f"http://{host}:{port}"
+
+        def get(path: str) -> dict:
+            # trace-exempt: observatory debug fetches are reads about
+            # traces, not members of one
+            with urllib.request.urlopen(base + path, timeout=30) as resp:
+                return json.loads(resp.read())
+
+        pages = {}
+        for m in measured:
+            if m and m["trace"]:
+                pages[m["trace"]] = get(f"/debug/tracez?trace={m['trace']}")
+        routez = get("/debug/routez")
+        slozz = get("/debug/slozz")
+        stats = router.stats()
+    finally:
+        if obs is not None:
+            obs.shutdown()
+            obs.server_close()
+        fleet.stop()
+        controller.stop()
+
+    # the migrated request is the one whose merged trace decomposed
+    # into the 8-hop disaggregated timeline
+    migrated = {
+        tid: page for tid, page in pages.items()
+        if page["breakdown"]["mode"] == "disaggregated"
+    }
+    problems: List[str] = []
+    if stats["migrations"] < 1:
+        problems.append(f"no migrations (got {stats['migrations']})")
+    if not migrated:
+        problems.append("no trace decomposed as disaggregated")
+    for tid, page in migrated.items():
+        bd = page["breakdown"]
+        names = [h["name"] for h in bd["hops"]]
+        if names != list(HOP_NAMES):
+            problems.append(f"{tid}: hops {names} != {list(HOP_NAMES)}")
+        if bd["missing"]:
+            problems.append(f"{tid}: missing boundaries {bd['missing']}")
+        if page["orphans"]:
+            ops = [r["fields"].get("op") for r in page["orphans"]]
+            problems.append(f"{tid}: orphan records with ops {ops}")
+        for prev, cur in zip(bd["hops"], bd["hops"][1:]):
+            if cur["start_s"] != prev["end_s"]:
+                problems.append(
+                    f"{tid}: {cur['name']} start {cur['start_s']} != "
+                    f"{prev['name']} end {prev['end_s']}"
+                )
+        if any(h["duration_s"] < 0 for h in bd["hops"]):
+            problems.append(f"{tid}: negative hop duration")
+        client_ttft = next(
+            (m["client_ttft"] for m in measured if m["trace"] == tid),
+            None,
+        )
+        hop_sum = sum(h["duration_s"] for h in bd["hops"])
+        if client_ttft is None:
+            problems.append(f"{tid}: no client TTFT measured")
+        elif hop_sum < 0.95 * client_ttft:
+            problems.append(
+                f"{tid}: hops cover {hop_sum:.6f}s of client TTFT "
+                f"{client_ttft:.6f}s (< 95%)"
+            )
+    traced_decisions = [
+        d for d in routez.get("decisions", []) if d.get("trace")
+    ]
+    if not traced_decisions:
+        problems.append("/debug/routez decisions carry no trace ids")
+    if slozz["fleet"]["ttft"]["p95"] is None:
+        problems.append("/debug/slozz fleet ttft p95 missing")
+
+    summary = {
+        "seed": seed,
+        "streams": streams,
+        "traces": sorted(pages),
+        "migrated_traces": sorted(migrated),
+        "breakdowns": {
+            tid: page["breakdown"] for tid, page in pages.items()
+        },
+        "client_ttft": {
+            m["trace"]: round(m["client_ttft"], 6)
+            for m in measured if m and m["trace"]
+        },
+        "traced_decisions": len(traced_decisions),
+        "problems": problems,
+        "seconds": round(time.monotonic() - started, 2),
+        "ok": not problems,
+    }
+    if not summary["ok"]:
+        raise AssertionError(
+            f"trace smoke failed: {json.dumps(summary)}"
+        )
+    return summary
+
+
 def main(argv: Optional[List[str]] = None) -> int:
     parser = argparse.ArgumentParser(
         description="ServeService fleet soaks (failover / disagg)"
@@ -768,6 +967,11 @@ def main(argv: Optional[List[str]] = None) -> int:
         "--disagg", action="store_true",
         help="disaggregated prefill/decode smoke: role-group "
         "ServeService, KV block-set migration, prefix-aware routing",
+    )
+    mode.add_argument(
+        "--trace-smoke", action="store_true",
+        help="distributed-tracing smoke: disagg fleet, migrated "
+        "request, merged /debug/tracez timeline with all 8 hops",
     )
     parser.add_argument("--seed", type=int, default=0)
     parser.add_argument("--replicas", type=int, default=3)
@@ -781,6 +985,8 @@ def main(argv: Optional[List[str]] = None) -> int:
             seed=args.seed, streams=min(args.streams, 4),
             max_new=args.max_new,
         )
+    elif args.trace_smoke:
+        summary = run_trace_smoke(seed=args.seed, max_new=args.max_new)
     else:
         summary = run_failover_soak(
             seed=args.seed, replicas=args.replicas, streams=args.streams,

@@ -1,0 +1,275 @@
+"""SLO observatory: the router's own debug/metrics HTTP plane.
+
+The serve replicas each expose /metrics and /debug/flightz for
+THEMSELVES; nothing fleet-level lives anywhere. This module gives the
+router the same treatment the replicas get — a small threaded HTTP
+server over the router object:
+
+  /debug/routez            router.stats(): per-replica load/score state
+                           plus the recent placement-decision ring
+                           (each decision carries its trace id)
+  /debug/tracez?trace=<id> ONE merged cross-process timeline for a
+                           trace: fan out to every replica's flightz,
+                           normalize clocks, decompose per-hop TTFT
+                           (telemetry/collector.py)
+  /debug/slozz             fleet SLOs: per-replica histograms summed
+                           bucket-wise into fleet TTFT/ITL/queue-wait
+                           quantiles, fleet queue depth + kv occupancy,
+                           per-hop p95s, and the router's own
+                           client-visible TTFT/ITL histograms
+  /metrics                 the router registry's exposition page
+                           (includes the fleet_* gauges, refreshed on
+                           every /debug/slozz scrape)
+
+Summing cumulative bucket counts across replicas is exact for
+quantile estimation (histogram_quantile interpolates within the
+merged buckets) — unlike averaging per-replica quantiles, which is
+wrong whenever load is skewed.
+
+Stdlib-only, like serve/server.py.
+"""
+
+from __future__ import annotations
+
+import json
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Dict, List, Optional, Tuple
+from urllib.parse import parse_qs, urlparse
+
+from ..telemetry.collector import collect_trace
+from ..telemetry.exposition import bucket_pairs
+from ..telemetry.flight import default_flight
+from ..telemetry.registry import histogram_quantile
+
+__all__ = ["fleet_slo", "router_trace", "make_observatory"]
+
+_SERVE = "tf_operator_tpu_serve_"
+# replica histogram families merged fleet-wide (engine.py registers
+# them; serve_bench.py asserts against the same names)
+_FLEET_FAMILIES = {
+    "ttft": _SERVE + "ttft_seconds",
+    "itl": _SERVE + "inter_token_seconds",
+    "queue_wait": _SERVE + "queue_wait_seconds",
+    "prefill_chunk": _SERVE + "prefill_chunk_seconds",
+}
+_Q_DEPTH = _SERVE + "engine_queue_depth"
+_KV_IN_USE = _SERVE + "engine_kv_blocks_in_use"
+_KV_TOTAL = _SERVE + "engine_kv_blocks_total"
+_ROUTER = "tf_operator_tpu_router_"
+# router-registry families: the hops only the router can time, plus
+# the client-visible end-to-end numbers (observed per streamed token,
+# across failovers — the ones serve_bench's client-side measurements
+# must agree with)
+_ROUTER_FAMILIES = {
+    "route_decision": _ROUTER + "route_decision_seconds",
+    "migration": _ROUTER + "migration_seconds",
+    "ttft": _ROUTER + "ttft_seconds",
+    "itl": _ROUTER + "itl_seconds",
+}
+
+
+def _flat(text: str) -> Dict[str, float]:
+    """Exposition page -> {sample_name_with_labels: value} (the
+    DecodeClient.metrics() shape bucket_pairs consumes)."""
+    out: Dict[str, float] = {}
+    for line in text.splitlines():
+        if line and not line.startswith("#"):
+            name, value = line.split()
+            out[name] = float(value)
+    return out
+
+
+def _merge(acc: Dict[float, float], pairs: List[Tuple[float, float]]):
+    for le, count in pairs:
+        acc[le] = acc.get(le, 0.0) + count
+
+
+def _quantiles(pairs: List[Tuple[float, float]]) -> Dict[str, Optional[float]]:
+    return {
+        "p50": histogram_quantile(0.50, pairs),
+        "p95": histogram_quantile(0.95, pairs),
+    }
+
+
+def _exact_quantiles(samples: List[float]) -> Dict[str, Optional[float]]:
+    """Linear-interpolated percentiles over raw samples (the router's
+    slo_window reservoirs) — sharp where bucket interpolation
+    quantizes to edges."""
+    if not samples:
+        return {"p50": None, "p95": None}
+    ordered = sorted(samples)
+
+    def pick(q: float) -> float:
+        rank = q * (len(ordered) - 1)
+        lo = int(rank)
+        hi = min(lo + 1, len(ordered) - 1)
+        return ordered[lo] + (ordered[hi] - ordered[lo]) * (rank - lo)
+
+    return {"p50": pick(0.50), "p95": pick(0.95)}
+
+
+def fleet_slo(router) -> dict:
+    """Scrape every replica once, sum histogram buckets fleet-wide,
+    and return the SLO snapshot. Side effect: refreshes the fleet_*
+    gauges on router.registry so a plain Prometheus scrape of the
+    observatory's /metrics sees the same numbers."""
+    merged: Dict[str, Dict[float, float]] = {
+        key: {} for key in _FLEET_FAMILIES
+    }
+    queue_depth = 0.0
+    kv_in_use = 0.0
+    kv_total = 0.0
+    unreachable: List[str] = []
+    clients = router.clients()
+    for name, client in clients.items():
+        try:
+            flat = client.metrics()
+        except Exception:
+            unreachable.append(name)
+            continue
+        for key, family in _FLEET_FAMILIES.items():
+            _merge(merged[key], bucket_pairs(flat, family))
+        queue_depth += flat.get(_Q_DEPTH, 0.0)
+        kv_in_use += flat.get(_KV_IN_USE, 0.0)
+        kv_total += flat.get(_KV_TOTAL, 0.0)
+
+    fleet = {
+        key: _quantiles(sorted(acc.items()))
+        for key, acc in merged.items()
+    }
+    kv_occupancy = kv_in_use / kv_total if kv_total else 0.0
+
+    router_flat = _flat(router.registry.render())
+    router_slo = {
+        key: _quantiles(bucket_pairs(router_flat, family))
+        for key, family in _ROUTER_FAMILIES.items()
+    }
+    # the client-visible end-to-end quantiles come from the exact
+    # reservoirs (slo_window) — these are the numbers the +-10%
+    # acceptance holds against client-side measurements; bucket
+    # interpolation stays for the hop histograms, where no tight
+    # agreement is promised
+    window = router.slo_window()
+    for key in ("ttft", "itl"):
+        exact = _exact_quantiles(window[key])
+        if exact["p95"] is not None:
+            router_slo[key] = exact
+
+    hops_p95 = {
+        "route_decision": router_slo["route_decision"]["p95"],
+        "migration": router_slo["migration"]["p95"],
+        "queue_wait": fleet["queue_wait"]["p95"],
+        "prefill_chunk": fleet["prefill_chunk"]["p95"],
+    }
+
+    reg = router.registry
+    g = reg.gauge(
+        "fleet_ttft_seconds",
+        "Fleet TTFT quantile (bucket-summed across replicas)",
+        labelnames=("quantile",),
+    )
+    g.labels(quantile="0.5").set(fleet["ttft"]["p50"] or 0.0)
+    g.labels(quantile="0.95").set(fleet["ttft"]["p95"] or 0.0)
+    g = reg.gauge(
+        "fleet_itl_seconds",
+        "Fleet inter-token-latency quantile (bucket-summed)",
+        labelnames=("quantile",),
+    )
+    g.labels(quantile="0.5").set(fleet["itl"]["p50"] or 0.0)
+    g.labels(quantile="0.95").set(fleet["itl"]["p95"] or 0.0)
+    reg.gauge(
+        "fleet_queue_depth", "Queued requests summed across replicas",
+    ).set(queue_depth)
+    reg.gauge(
+        "fleet_kv_occupancy", "KV blocks in use / total, fleet-wide",
+    ).set(kv_occupancy)
+    g = reg.gauge(
+        "fleet_hop_p95_seconds", "Per-hop p95 across the fleet",
+        labelnames=("hop",),
+    )
+    for hop, value in hops_p95.items():
+        g.labels(hop=hop).set(value or 0.0)
+
+    return {
+        "fleet": {
+            **fleet,
+            "queue_depth": queue_depth,
+            "kv_occupancy": round(kv_occupancy, 6),
+            "replicas_scraped": len(clients) - len(unreachable),
+            "unreachable": unreachable,
+        },
+        "router": {
+            **router_slo,
+            "failovers": router.failovers,
+            "migrations": router.migrations,
+            "migrate_failures": router.migrate_failures,
+        },
+        "hops_p95": hops_p95,
+    }
+
+
+def router_trace(router, trace_id: str, handshake_samples: int = 3) -> dict:
+    """collect_trace() anchored at this router: its own flight ring
+    supplies the local (exact-clock) records, its replica clients the
+    remote fetches."""
+    fl = router._flight if router._flight is not None else default_flight()
+    local = [r.to_dict() for r in fl.snapshot()]
+    return collect_trace(
+        trace_id,
+        router.clients(),
+        local_records=local,
+        local_name="router",
+        handshake_samples=handshake_samples,
+    )
+
+
+def make_observatory(
+    router, host: str = "127.0.0.1", port: int = 0
+) -> ThreadingHTTPServer:
+    """In-process observatory server over `router`; caller owns
+    serve_forever/shutdown (same contract as serve/server.py
+    make_server). GET-only by design — the observatory observes."""
+
+    class Handler(BaseHTTPRequestHandler):
+        protocol_version = "HTTP/1.1"
+        timeout = 5
+
+        def _reply_json(self, code: int, payload: dict) -> None:
+            body = json.dumps(payload).encode()
+            self.send_response(code)
+            self.send_header("Content-Type", "application/json")
+            self.send_header("Content-Length", str(len(body)))
+            self.end_headers()
+            self.wfile.write(body)
+
+        def do_GET(self) -> None:  # noqa: N802
+            parsed = urlparse(self.path)
+            if parsed.path == "/metrics":
+                body = router.registry.render().encode()
+                self.send_response(200)
+                self.send_header(
+                    "Content-Type", "text/plain; version=0.0.4"
+                )
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+            elif parsed.path == "/debug/routez":
+                self._reply_json(200, router.stats())
+            elif parsed.path == "/debug/slozz":
+                self._reply_json(200, fleet_slo(router))
+            elif parsed.path == "/debug/tracez":
+                query = parse_qs(parsed.query)
+                trace = (query.get("trace") or [None])[0]
+                if not trace:
+                    self._reply_json(
+                        400, {"error": "missing ?trace=<trace id>"}
+                    )
+                    return
+                self._reply_json(200, router_trace(router, trace))
+            else:
+                self._reply_json(404, {"error": f"no route {parsed.path}"})
+
+        def log_message(self, *args) -> None:
+            pass
+
+    return ThreadingHTTPServer((host, port), Handler)

@@ -24,6 +24,10 @@ across replicas.
 PEP 567 footnote: generators run in their *consumer's* context, so
 binding `correlate(corr)` inside generate_stream would leak between
 yields — every flight record here passes corr= explicitly instead.
+The fleet trace context (telemetry/tracecontext.py) follows the same
+rule: each routed request mints ONE trace id, records carry it
+explicitly, and `trace_scope` is only ever held around non-yielding
+blocks (the outbound connect calls), never across a yield.
 """
 
 from __future__ import annotations
@@ -36,6 +40,12 @@ import urllib.error
 from typing import Callable, Dict, List, Optional
 
 from ..telemetry.flight import default_flight
+from ..telemetry.tracecontext import (
+    TraceContext,
+    new_span_id,
+    new_trace_id,
+    trace_scope,
+)
 from ..utils import locks
 from .client import DecodeClient, DecodeError
 from .prefix import block_prefix_hashes
@@ -189,6 +199,50 @@ class LeastLoadedRouter:
         self.failovers = 0     # lifetime counter, for tests/metrics
         self.migrations = 0    # prefill->decode block-set handoffs
         self.migrate_failures = 0
+        # router-side SLO registry: the hops only the router can time
+        # live (route decision, migration round-trip, client-visible
+        # TTFT/ITL across failovers) land in histograms here; the
+        # observatory (serve/observatory.py /debug/slozz) merges them
+        # with the per-replica histograms it scrapes
+        from ..telemetry import (
+            FAST_BUCKETS,
+            MetricRegistry,
+            TTFT_BUCKETS,
+        )
+
+        self.registry = MetricRegistry("tf_operator_tpu_router")
+        self._h_route = self.registry.histogram(
+            "route_decision_seconds",
+            "Request arrival to replica pick (queue + scoring)",
+            buckets=FAST_BUCKETS,
+        )
+        self._h_migrate = self.registry.histogram(
+            "migration_seconds",
+            "Prefill + KV block-set ship round-trip (disagg fast path)",
+            buckets=TTFT_BUCKETS,
+        )
+        self._h_ttft = self.registry.histogram(
+            "ttft_seconds",
+            "Request arrival to first streamed token, across failovers",
+            buckets=TTFT_BUCKETS,
+        )
+        self._h_itl = self.registry.histogram(
+            "itl_seconds",
+            "Gap between consecutive streamed tokens, across failovers",
+            buckets=FAST_BUCKETS,
+        )
+        # exact-sample reservoirs behind the histograms: a bucket-
+        # interpolated p95 is only as sharp as its bucket edges (a
+        # (0.5, 1.0] bucket quantizes to +-2x), and the SLO
+        # observatory promises fleet p95s within 10% of what clients
+        # measure — so /debug/slozz computes the router's client-
+        # visible quantiles from these windows instead
+        self._ttft_window: collections.deque = collections.deque(
+            maxlen=4096
+        )
+        self._itl_window: collections.deque = collections.deque(
+            maxlen=4096
+        )
         # recent placement decisions (ring buffer), served by stats()
         # as the routing dump: what was asked, who won, and every
         # candidate's itemized score at decision time
@@ -222,6 +276,23 @@ class LeastLoadedRouter:
     def replica_names(self) -> List[str]:
         with self._lock:
             return sorted(self._replicas)
+
+    def clients(self) -> Dict[str, DecodeClient]:
+        """name -> client snapshot for fan-out consumers: the trace
+        collector (telemetry/collector.py) and the SLO observatory
+        (serve/observatory.py) scrape every replica through these."""
+        with self._lock:
+            return {name: r.client for name, r in self._replicas.items()}
+
+    def slo_window(self) -> Dict[str, List[float]]:
+        """Exact recent client-visible samples — TTFT and inter-token
+        gaps, one float per observation, newest last — for the
+        observatory's quantile math (bounded reservoirs; the
+        histograms carry the same observations for Prometheus)."""
+        return {
+            "ttft": list(self._ttft_window),
+            "itl": list(self._itl_window),
+        }
 
     # -- health ------------------------------------------------------------
 
@@ -292,6 +363,7 @@ class LeastLoadedRouter:
         corr,
         role: Optional[str] = None,
         prefix_hashes: Optional[dict] = None,
+        trace: Optional[str] = None,
     ) -> Replica:
         """Pick the lowest-scored ready replica, preferring ones this
         request hasn't failed on; blocks (probing) until one exists or
@@ -328,6 +400,10 @@ class LeastLoadedRouter:
                     )
                     self._decisions.append({
                         "corr": corr,
+                        # the fleet trace id: /debug/routez consumers
+                        # join a placement decision to its merged
+                        # /debug/tracez timeline through this
+                        "trace": trace,
                         "role_requested": role or "",
                         "pool": "role" if pool is not ready else "all",
                         "picked": best.name,
@@ -376,6 +452,7 @@ class LeastLoadedRouter:
         prompt: List[int],
         corr,
         prefix_hashes: dict,
+        trace: Optional[TraceContext] = None,
     ) -> None:
         """The disaggregated fast path: when a prefill pool exists and
         the decode target doesn't already cache the prompt's full-block
@@ -403,23 +480,36 @@ class LeastLoadedRouter:
                 pool, key=lambda r: r.score(r.overlap(prefix_hashes))
             )
             pre.inflight += 1
+        tid = trace.trace_id if trace is not None else None
+        start = time.perf_counter()
         try:
-            report = pre.client.prefill(
-                prompt, migrate_to=decode_replica.url
-            )
+            if trace is not None:
+                # bind the trace only around the outbound connect (no
+                # yield in scope — the module-docstring rule), so the
+                # /prefill hop (and its onward /kv/import ship) joins
+                # the request's fleet trace
+                with trace_scope(trace_id=trace.trace_id):
+                    report = pre.client.prefill(
+                        prompt, migrate_to=decode_replica.url
+                    )
+            else:
+                report = pre.client.prefill(
+                    prompt, migrate_to=decode_replica.url
+                )
         except Exception as err:  # noqa: BLE001 — degradation, not
             # failure: the decode replica prefills for itself
             with self._lock:
                 self.migrate_failures += 1
             self._record(
                 corr, "migrate-failed", prefill=pre.name,
-                decode=decode_replica.name,
+                decode=decode_replica.name, trace=tid,
                 error=f"{type(err).__name__}: {err}"[:200],
             )
             return
         finally:
             self._release(pre)
         if report.get("migrated"):
+            self._h_migrate.observe(time.perf_counter() - start)
             with self._lock:
                 self.migrations += 1
                 # optimistic digest update: the next probe would learn
@@ -428,7 +518,7 @@ class LeastLoadedRouter:
                 decode_replica.digest |= prefix_hashes.get(bs, set())
             self._record(
                 corr, "migrate", prefill=pre.name,
-                decode=decode_replica.name,
+                decode=decode_replica.name, trace=tid,
                 blocks=int(report.get("blocks", 0)),
                 imported=int(report.get("imported", 0)),
             )
@@ -437,7 +527,7 @@ class LeastLoadedRouter:
                 self.migrate_failures += 1
             self._record(
                 corr, "migrate-failed", prefill=pre.name,
-                decode=decode_replica.name,
+                decode=decode_replica.name, trace=tid,
                 error=str(report.get("error", "no cached blocks"))[:200],
             )
 
@@ -457,21 +547,31 @@ class LeastLoadedRouter:
         """One logical stream across the fleet: yields {"token",
         "index", "replica"} per generated token, then a final
         {"done": True, "tokens": [[full chain]], "prompt_lens": [n],
-        "request_id": corr, "failovers": k}. Greedy-only, like the
-        engine path it rides. Mid-stream replica failures are replayed
-        on another replica with prompt+emitted (see module docstring);
-        4xx rejections propagate as DecodeError (replaying a request
-        the server called invalid cannot help)."""
+        "request_id": corr, "trace_id": <fleet trace>,
+        "failovers": k}. Greedy-only, like the engine path it rides.
+        Mid-stream replica failures are replayed on another replica
+        with prompt+emitted (see module docstring); 4xx rejections
+        propagate as DecodeError (replaying a request the server
+        called invalid cannot help). Every hop — the stream itself,
+        migrations, failover replays — carries the request's ONE
+        trace id, so /debug/tracez?trace=<id> merges the whole
+        cross-replica journey."""
         prompt = [int(t) for t in input_ids]
         new = int(max_new_tokens)
         if corr is None:
             corr = f"route-{next(_ROUTE_IDS)}"
+        # one fleet-wide trace per routed request; records pass it
+        # explicitly (this is a generator — no ambient binding may
+        # span a yield), outbound connects bind it in a scope
+        trace = TraceContext(new_trace_id(), new_span_id())
+        t_start = time.perf_counter()
         deadline = time.monotonic() + (timeout or self.stream_deadline)
         emitted: List[int] = []
         failovers = 0
         tried: set = set()
         self._record(
-            corr, "route", prompt_tokens=len(prompt), new=new,
+            corr, "route", trace=trace.trace_id,
+            prompt_tokens=len(prompt), new=new,
         )
         # token streams always target the decode pool (prefill
         # replicas take /prefill work; with no role pools _acquire
@@ -480,11 +580,22 @@ class LeastLoadedRouter:
         # the same preference, keeping failover inside the pool.
         prefix_hashes = self._prompt_hashes(prompt)
         migrate_tried = False
+        first_token_at = None
+        last_token_at = None
         while len(emitted) < new:
             replica = self._acquire(
                 tried, deadline, corr, role="decode",
-                prefix_hashes=prefix_hashes,
+                prefix_hashes=prefix_hashes, trace=trace.trace_id,
             )
+            if not emitted:
+                if not migrate_tried:
+                    # the pick that will serve the first byte: the
+                    # route_decision hop ends here
+                    self._h_route.observe(time.perf_counter() - t_start)
+                self._record(
+                    corr, "pick", trace=trace.trace_id,
+                    replica=replica.name, role=replica.role,
+                )
             if not emitted and not migrate_tried:
                 # one migration attempt per request, before the first
                 # byte: prefill happens on the prefill pool, the block
@@ -492,14 +603,28 @@ class LeastLoadedRouter:
                 # admits with its prefix cached
                 migrate_tried = True
                 self._maybe_migrate(
-                    replica, prompt, corr, prefix_hashes
+                    replica, prompt, corr, prefix_hashes, trace=trace,
                 )
             try:
-                inner = replica.client.generate_stream(
-                    prompt + emitted, new - len(emitted)
-                )
+                # bind the trace around the CONNECT only (the client's
+                # generate_stream builds + sends the request eagerly
+                # and returns an iterator): the traceparent header
+                # rides out, and no yield happens inside the scope
+                with trace_scope(trace_id=trace.trace_id):
+                    inner = replica.client.generate_stream(
+                        prompt + emitted, new - len(emitted)
+                    )
                 for event in inner:
                     if "token" in event:
+                        now = time.perf_counter()
+                        if first_token_at is None:
+                            first_token_at = now
+                            self._h_ttft.observe(now - t_start)
+                            self._ttft_window.append(now - t_start)
+                        elif last_token_at is not None:
+                            self._h_itl.observe(now - last_token_at)
+                            self._itl_window.append(now - last_token_at)
+                        last_token_at = now
                         emitted.append(int(event["token"]))
                         yield {
                             "token": int(event["token"]),
@@ -521,7 +646,8 @@ class LeastLoadedRouter:
                 tried.add(replica.name)
                 failovers += 1
                 self._record(
-                    corr, "failover", replica=replica.name,
+                    corr, "failover", trace=trace.trace_id,
+                    replica=replica.name,
                     error=f"{type(err).__name__}: {err}"[:200],
                     emitted=len(emitted),
                 )
@@ -532,7 +658,8 @@ class LeastLoadedRouter:
                 tried.add(replica.name)
                 failovers += 1
                 self._record(
-                    corr, "failover", replica=replica.name,
+                    corr, "failover", trace=trace.trace_id,
+                    replica=replica.name,
                     error=f"{type(err).__name__}: {err}"[:200],
                     emitted=len(emitted),
                 )
@@ -551,17 +678,20 @@ class LeastLoadedRouter:
                     tried.add(replica.name)
                     failovers += 1
                     self._record(
-                        corr, "failover", replica=replica.name,
+                        corr, "failover", trace=trace.trace_id,
+                        replica=replica.name,
                         error="short-stream", emitted=len(emitted),
                     )
         self._record(
-            corr, "route-done", tokens=len(emitted), failovers=failovers,
+            corr, "route-done", trace=trace.trace_id,
+            tokens=len(emitted), failovers=failovers,
         )
         yield {
             "done": True,
             "tokens": [prompt + emitted],
             "prompt_lens": [len(prompt)],
             "request_id": corr,
+            "trace_id": trace.trace_id,
             "failovers": failovers,
         }
 

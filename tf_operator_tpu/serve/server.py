@@ -69,6 +69,11 @@ from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 
 from ..telemetry.flight import correlate, default_flight, render_flightz
 from ..telemetry.profiler import default_profiler, render_profilez
+from ..telemetry.tracecontext import (
+    TRACEPARENT_HEADER,
+    parse_traceparent,
+    trace_scope,
+)
 from . import export as export_mod
 
 from ..utils import locks
@@ -456,13 +461,17 @@ def DecodeHandlerFactory(state: _State):
         # the idle keep-alive timeout (ADVICE r4)
         body_timeout = 60
 
-        # per-connection state: the correlation ID of the POST being
-        # handled (None outside one; keep-alive reuses the instance)
+        # per-connection state: the correlation ID and fleet trace id
+        # of the POST being handled (None outside one; keep-alive
+        # reuses the instance)
         _request_corr = None
+        _request_trace = None
 
         def _reply(self, code: int, payload: dict) -> None:
             if self._request_corr is not None:
                 payload.setdefault("request_id", self._request_corr)
+            if self._request_trace is not None:
+                payload.setdefault("trace_id", self._request_trace)
             body = json.dumps(payload).encode()
             self.send_response(code)
             self.send_header("Content-Type", "application/json")
@@ -472,6 +481,7 @@ def DecodeHandlerFactory(state: _State):
 
         def do_GET(self) -> None:  # noqa: N802
             self._request_corr = None
+            self._request_trace = None
             if self.path == "/healthz":
                 # liveness stays 200 through warmup and drain (the
                 # process is alive and should not be restarted) but the
@@ -529,6 +539,24 @@ def DecodeHandlerFactory(state: _State):
                 self.send_header("Content-Length", str(len(body)))
                 self.end_headers()
                 self.wfile.write(body)
+            elif self.path == "/debug/clockz":
+                # clock handshake for the trace collector
+                # (telemetry/collector.py): this process's monotonic /
+                # perf_counter / wall clocks read back-to-back, plus
+                # the span tracer's perf_counter epoch so exported
+                # span timestamps can be mapped onto the same axis as
+                # flight-record monotonic times. The collector samples
+                # this a few times and keeps the min-RTT sample (clock
+                # offset error is bounded by RTT/2).
+                import time as _time
+
+                self._reply(200, {
+                    "mono": _time.monotonic(),
+                    "perf": _time.perf_counter(),
+                    "wall": _time.time(),
+                    "tracer_epoch_perf": state.tracer._epoch,
+                    "pid": os.getpid(),
+                })
             elif self.path.partition("?")[0] == "/debug/flightz":
                 # JSONL flight-recorder dump; ?request=req-N (alias
                 # ?corr=) / ?kind= / ?limit= filter. Like /debug/trace
@@ -585,17 +613,28 @@ def DecodeHandlerFactory(state: _State):
         def do_POST(self) -> None:  # noqa: N802
             # one correlation ID per request, bound for the whole
             # handler: the engine slot, its span, its flight records,
-            # and any log line emitted while decoding all join on it
+            # and any log line emitted while decoding all join on it.
+            # A traceparent header (telemetry/tracecontext.py) joins
+            # this hop to the caller's fleet-wide trace; absent one,
+            # a fresh trace starts here so standalone servers still
+            # get per-request trace ids. Everything the handler does —
+            # including outbound hops like /prefill's kv_import ship —
+            # runs inside the scope, so the trace propagates onward.
             corr = f"req-{next(_REQ_IDS)}"
             self._request_corr = corr
+            parent = parse_traceparent(
+                self.headers.get(TRACEPARENT_HEADER)
+            )
             try:
-                with correlate(corr):
+                with correlate(corr), trace_scope(parent=parent) as ctx:
+                    self._request_trace = ctx.trace_id
                     default_flight().record(
                         "serve", op="request", path=self.path,
                     )
                     self._handle_post()
             finally:
                 self._request_corr = None
+                self._request_trace = None
 
         def _handle_post(self) -> None:
             if self.path not in ("/generate", "/generate_stream",
@@ -961,6 +1000,7 @@ def DecodeHandlerFactory(state: _State):
                         "tokens": [req.prompt + req.tokens],
                         "prompt_lens": lens,
                         "request_id": self._request_corr,
+                        "trace_id": self._request_trace,
                     })
                     self._end_stream()
                 except (BrokenPipeError, ConnectionError, OSError,
@@ -1030,6 +1070,7 @@ def DecodeHandlerFactory(state: _State):
                     "done": True, "tokens": [chain],
                     "prompt_lens": lens,
                     "request_id": self._request_corr,
+                    "trace_id": self._request_trace,
                 })
                 self._end_stream()
             except (BrokenPipeError, ConnectionError):
@@ -1276,7 +1317,7 @@ def make_server(
                 registry=state.registry, tracer=state.tracer,
                 kv_layout=kv_layout, block_size=block_size,
                 kv_blocks=kv_blocks, prefill_chunk=prefill_chunk,
-                mesh_shape=mesh_shape,
+                mesh_shape=mesh_shape, role=role,
             )
 
         if warm_async:

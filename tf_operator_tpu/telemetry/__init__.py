@@ -64,6 +64,16 @@ from .profiler import (
     set_default_profiler,
     write_signal_snapshot,
 )
+from .tracecontext import (
+    TraceContext,
+    current_trace,
+    format_traceparent,
+    new_span_id,
+    new_trace_id,
+    parse_traceparent,
+    trace_headers,
+    trace_scope,
+)
 from .tracing import Span, SpanTracer, current_span
 
 __all__ = [
@@ -80,6 +90,14 @@ __all__ = [
     "flight_record",
     "install_crash_handlers",
     "render_flightz",
+    "TraceContext",
+    "current_trace",
+    "trace_scope",
+    "trace_headers",
+    "new_trace_id",
+    "new_span_id",
+    "format_traceparent",
+    "parse_traceparent",
     "ProfileSample",
     "SamplingProfiler",
     "default_profiler",

@@ -28,6 +28,16 @@ flight dumps into one Perfetto file:
     python -m tf_operator_tpu.telemetry profile --input p.json \
         --perfetto merged.json --trace debug-trace.json \
         --flight flight-usr2-123.jsonl
+
+The `tracez` subcommand is the fleet trace collector's CLI
+(telemetry/collector.py): give it a trace id plus replica URLs (or a
+running observatory) and it prints the per-hop TTFT decomposition and
+exports the merged cross-process Perfetto timeline:
+
+    python -m tf_operator_tpu.telemetry tracez --trace <32-hex id> \
+        http://127.0.0.1:8443 http://127.0.0.1:8444 --perfetto t.json
+    python -m tf_operator_tpu.telemetry tracez --trace <id> \
+        --observatory http://127.0.0.1:9090
 """
 
 from __future__ import annotations
@@ -245,12 +255,113 @@ def profile_main(argv) -> int:
     return 0
 
 
+def tracez_main(argv) -> int:
+    """The fleet trace collector as a CLI (`tracez` subcommand): fan
+    out to replica /debug/flightz endpoints (or ask a running
+    observatory for its already-merged page), print the per-hop TTFT
+    decomposition, and optionally export the merged Perfetto file."""
+    parser = argparse.ArgumentParser(
+        prog="python -m tf_operator_tpu.telemetry tracez",
+        description="Merge one trace's flight records fleet-wide and "
+        "decompose per-hop TTFT (telemetry/collector.py).",
+    )
+    parser.add_argument("--trace", required=True, help="32-hex trace id")
+    parser.add_argument(
+        "replicas", nargs="*", metavar="URL",
+        help="replica base URLs to fan out to directly",
+    )
+    parser.add_argument(
+        "--observatory", metavar="URL",
+        help="fetch the merged page from a router observatory's "
+        "/debug/tracez instead of fanning out from here",
+    )
+    parser.add_argument(
+        "--samples", type=int, default=3,
+        help="clock-handshake round trips per replica (default 3)",
+    )
+    parser.add_argument(
+        "--perfetto", metavar="PATH",
+        help="write the merged Perfetto trace-event JSON here",
+    )
+    parser.add_argument(
+        "--quiet", action="store_true",
+        help="skip the breakdown print (export only)",
+    )
+    args = parser.parse_args(argv)
+    if bool(args.observatory) == bool(args.replicas):
+        print(
+            "error: give replica URLs or --observatory, not both/neither",
+            file=sys.stderr,
+        )
+        return 2
+
+    if args.observatory:
+        import urllib.request
+
+        url = (
+            args.observatory.rstrip("/")
+            + f"/debug/tracez?trace={args.trace}"
+        )
+        try:
+            with urllib.request.urlopen(url, timeout=60) as resp:
+                page = json.loads(resp.read())
+        except OSError as e:
+            print(f"error: {url}: {e}", file=sys.stderr)
+            return 1
+    else:
+        from ..serve.client import DecodeClient
+        from .collector import collect_trace
+
+        clients = {u: DecodeClient(u) for u in args.replicas}
+        try:
+            page = collect_trace(
+                args.trace, clients, handshake_samples=args.samples
+            )
+        except OSError as e:
+            print(f"error: {e}", file=sys.stderr)
+            return 1
+
+    if not args.quiet:
+        bd = page["breakdown"]
+        print(
+            f"# trace {page['trace']}: {len(page['records'])} records, "
+            f"mode {bd['mode']}, "
+            f"ttft {bd['ttft_s']}s, clamped {bd['clamped_s']}s"
+        )
+        for name, info in sorted(page.get("replicas", {}).items()):
+            print(
+                f"#   {name}: rtt {info['rtt_s']}s "
+                f"offset {info['offset_s']}s"
+            )
+        for hop in bd["hops"]:
+            bar = "#" * max(1, int(hop["duration_s"] * 200))
+            print(f"{hop['name']:>16} {hop['duration_s']:>10.6f}s {bar}")
+        if bd["missing"]:
+            print(f"missing boundaries: {', '.join(bd['missing'])}")
+        if page["orphans"]:
+            ops = sorted(
+                {
+                    str((r.get("fields") or {}).get("op"))
+                    for r in page["orphans"]
+                }
+            )
+            print(f"ORPHANS: {len(page['orphans'])} records, ops {ops}")
+    if args.perfetto:
+        with open(args.perfetto, "w") as f:
+            json.dump(page["perfetto"], f)
+        n = len(page["perfetto"]["traceEvents"])
+        print(f"wrote {args.perfetto} ({n} events)")
+    return 0
+
+
 def main(argv=None) -> int:
     argv = list(sys.argv[1:] if argv is None else argv)
     if argv and argv[0] == "profile":
         # subcommand dispatch; the bare form stays the flight-dump
         # inspector (serve --smoke invokes it with positional dumps)
         return profile_main(argv[1:])
+    if argv and argv[0] == "tracez":
+        return tracez_main(argv[1:])
     parser = argparse.ArgumentParser(
         prog="python -m tf_operator_tpu.telemetry",
         description="Merge and inspect flight-recorder JSONL dumps.",

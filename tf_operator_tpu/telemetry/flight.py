@@ -66,6 +66,28 @@ _correlation: contextvars.ContextVar = contextvars.ContextVar(
 )
 
 
+class _LazyTraceVar:
+    """Indirection to tracecontext's contextvar without importing it
+    at module load (flight.py is the telemetry core's bottom layer;
+    tracecontext imports nothing from here, but keeping the edge lazy
+    keeps the core import-order-proof)."""
+
+    __slots__ = ("_get",)
+
+    def __init__(self) -> None:
+        self._get = None
+
+    def get(self):
+        if self._get is None:
+            from .tracecontext import current_trace
+
+            self._get = current_trace
+        return self._get()
+
+
+_trace_context = _LazyTraceVar()
+
+
 def current_correlation() -> Optional[str]:
     """The correlation ID bound to the current context, or None."""
     return _correlation.get()
@@ -149,11 +171,23 @@ class FlightRecorder:
         self, kind: str, corr: Optional[str] = None, **fields
     ) -> Optional[FlightRecord]:
         """Append one record; -> it, or None when disabled. corr
-        defaults to the context's `correlate()` binding."""
+        defaults to the context's `correlate()` binding; a bound trace
+        context (tracecontext.trace_scope) lands in fields["trace"] /
+        fields["span"] the same way, so records on different replicas
+        join on one fleet-wide key. An explicit trace= field wins —
+        threads outside the request context (the engine scheduler)
+        pass the trace captured at submit()."""
         if not self.enabled:
             return None
         if corr is None:
             corr = _correlation.get()
+        if fields.get("trace") is None:
+            ctx = _trace_context.get()
+            if ctx is not None:
+                fields["trace"] = ctx.trace_id
+                fields["span"] = ctx.span_id
+            elif "trace" in fields:
+                del fields["trace"]  # explicit None = unset, not a field
         t = self._clock()
         wall = time.time()
         with self._lock:
@@ -423,11 +457,13 @@ def render_flightz(recorder: FlightRecorder, query: str = "") -> bytes:
     """The shared /debug/flightz page: JSONL, one record per line,
     filtered by query-string params — `corr=` / `request=` (alias) on
     the correlation ID, `job=` on job-identifying fields OR the corr,
-    `kind=` on the record kind, `since=<unix_ts>` keeps records whose
-    wall clock is >= the timestamp (how the telemetry CLI fetches just
-    the window overlapping a profile capture), `limit=` keeps the
-    newest N. Served by both the operator monitoring server and the
-    serve server so one curl works against either plane."""
+    `kind=` on the record kind, `trace=` on the fleet-wide trace id in
+    fields (how the collector pulls one request's records off every
+    replica), `since=<unix_ts>` keeps records whose wall clock is >=
+    the timestamp (how the telemetry CLI fetches just the window
+    overlapping a profile capture), `limit=` keeps the newest N.
+    Served by both the operator monitoring server and the serve server
+    so one curl works against either plane."""
     from urllib.parse import parse_qs
 
     params = parse_qs(query or "", keep_blank_values=False)
@@ -439,6 +475,7 @@ def render_flightz(recorder: FlightRecorder, query: str = "") -> bytes:
     corr = first("corr") or first("request")
     kind = first("kind")
     job = first("job")
+    trace = first("trace")
     since = None
     raw_since = first("since")
     if raw_since:
@@ -454,6 +491,8 @@ def render_flightz(recorder: FlightRecorder, query: str = "") -> bytes:
         except ValueError:
             limit = None
     records = recorder.snapshot(kind=kind, corr=corr)
+    if trace is not None:
+        records = [r for r in records if r.fields.get("trace") == trace]
     if since is not None:
         records = [r for r in records if r.wall >= since]
     if job is not None:

@@ -82,6 +82,12 @@ _DEFAULT_ROLES: Tuple[Tuple[str, str], ...] = (
     ("serveservice-worker", "controller-worker"),
     ("tfjob-resync", "controller-resync"),
     ("serveservice-resync", "controller-resync"),
+    # disagg roles BEFORE the generic engine fragments (first hit
+    # wins): a prefill replica's scheduler thread is named
+    # "decode-engine-prefill" (serve/engine.py role=), so a disagg
+    # fleet's folded stacks attribute to the right pool
+    ("decode-engine-prefill", "engine-prefill"),
+    ("decode-engine-decode", "engine-decode"),
     ("decode-engine", "engine"),
     ("engine-warmup", "engine"),
     ("router", "router"),

@@ -1,0 +1,152 @@
+"""W3C-style trace context for the serve fleet.
+
+The flight recorder's correlation IDs (flight.py `correlate`) join
+records *within* one process; since PR 7/PR 12 a single request
+crosses four — router -> prefill replica -> KV migration -> decode
+replica, plus failover replays — and each process binds its own corr
+(`route-N` on the router, `req-N` on each replica), so nothing joins
+the hops. This module adds the missing cross-process key: a W3C
+`traceparent`-shaped header
+
+    00-<32 hex trace id>-<16 hex parent span id>-01
+
+injected by every outbound serve request (client.py trace_headers())
+and extracted by the serve server's request handler, so every flight
+record and span on every replica touched by one request carries ONE
+trace id. The collector (telemetry/collector.py, /debug/tracez) joins
+on it.
+
+Same contextvar discipline as flight.correlate — and the same PEP 567
+pitfall: a generator body runs in its CONSUMER's context, so trace
+bindings must wrap the code that *builds the outbound request*, never
+live inside a generator between yields (serve/router.py's docstring
+walks through the failure mode; client.generate_stream connects
+eagerly for exactly this reason).
+
+Stdlib only, like the rest of the telemetry core.
+"""
+
+from __future__ import annotations
+
+import contextvars
+import os
+import re
+from typing import Dict, NamedTuple, Optional
+
+__all__ = [
+    "TraceContext",
+    "current_trace",
+    "trace_scope",
+    "new_trace_id",
+    "new_span_id",
+    "format_traceparent",
+    "parse_traceparent",
+    "trace_headers",
+    "TRACEPARENT_HEADER",
+]
+
+TRACEPARENT_HEADER = "traceparent"
+
+# version 00, all-zero ids invalid, flags fixed at 01 (sampled): we
+# implement the subset the fleet needs, not the full W3C state machine
+_TRACEPARENT_RE = re.compile(
+    r"^00-([0-9a-f]{32})-([0-9a-f]{16})-[0-9a-f]{2}$"
+)
+
+
+class TraceContext(NamedTuple):
+    """The propagated pair: the request's fleet-wide trace id plus the
+    span id of the hop that emitted it (the parent of whatever work
+    the receiver starts)."""
+
+    trace_id: str
+    span_id: str
+
+
+_trace: contextvars.ContextVar = contextvars.ContextVar(
+    "telemetry_trace_context", default=None
+)
+
+
+def new_trace_id() -> str:
+    """32 lowercase hex chars (128 random bits)."""
+    return os.urandom(16).hex()
+
+
+def new_span_id() -> str:
+    """16 lowercase hex chars (64 random bits)."""
+    return os.urandom(8).hex()
+
+
+def current_trace() -> Optional[TraceContext]:
+    """The trace context bound in this execution context, or None."""
+    return _trace.get()
+
+
+class trace_scope:
+    """Bind a trace context for a block::
+
+        with trace_scope() as ctx:            # fresh trace
+            ...
+        with trace_scope(parent=incoming):    # same trace, child span
+            ...
+
+    Every flight record, span, and outbound trace_headers() emitted
+    inside carries it. Nests; the previous binding is restored on
+    exit. Yields the bound TraceContext."""
+
+    __slots__ = ("ctx", "_token")
+
+    def __init__(
+        self,
+        parent: Optional[TraceContext] = None,
+        trace_id: Optional[str] = None,
+        span_id: Optional[str] = None,
+    ) -> None:
+        tid = trace_id or (parent.trace_id if parent else new_trace_id())
+        self.ctx = TraceContext(tid, span_id or new_span_id())
+
+    def __enter__(self) -> TraceContext:
+        self._token = _trace.set(self.ctx)
+        return self.ctx
+
+    def __exit__(self, exc_type, exc, tb) -> None:
+        _trace.reset(self._token)
+
+
+def format_traceparent(ctx: TraceContext) -> str:
+    return f"00-{ctx.trace_id}-{ctx.span_id}-01"
+
+
+def parse_traceparent(value: Optional[str]) -> Optional[TraceContext]:
+    """The TraceContext a traceparent header carries, or None for a
+    missing/malformed one (a bad header must degrade to an untraced
+    request, never 500 it)."""
+    if not value:
+        return None
+    m = _TRACEPARENT_RE.match(value.strip().lower())
+    if m is None:
+        return None
+    trace_id, span_id = m.group(1), m.group(2)
+    if trace_id == "0" * 32 or span_id == "0" * 16:
+        return None
+    return TraceContext(trace_id, span_id)
+
+
+def trace_headers(
+    base: Optional[Dict[str, str]] = None,
+    ctx: Optional[TraceContext] = None,
+) -> Dict[str, str]:
+    """The blessed way to build outbound serve-request headers: `base`
+    plus a traceparent for the ambient (or given) trace context. Every
+    cross-process call site in serve/ must route headers through here
+    (tests/test_tracing.py's AST audit enforces it) — a plain
+    urllib Request drops the trace and orphans the downstream hop.
+    With no context bound, returns `base` unchanged: probes and
+    standalone clients stay header-free."""
+    headers = dict(base or {})
+    if ctx is None:
+        ctx = _trace.get()
+    if ctx is not None:
+        headers[TRACEPARENT_HEADER] = format_traceparent(ctx)
+    return headers

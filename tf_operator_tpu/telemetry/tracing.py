@@ -136,13 +136,21 @@ class SpanTracer:
         overlapping requests render as parallel rows in the viewer;
         pass track= to pin related spans to one row. A flight
         correlation ID active in this context (flight.correlate) lands
-        in args["corr"] so spans join flight records and log lines."""
+        in args["corr"] so spans join flight records and log lines; a
+        bound trace context (tracecontext.trace_scope) lands in
+        args["trace"] so the span joins its fleet-wide timeline."""
         if "corr" not in args:
             from .flight import current_correlation
 
             corr = current_correlation()
             if corr is not None:
                 args["corr"] = corr
+        if "trace" not in args:
+            from .tracecontext import current_trace
+
+            ctx = current_trace()
+            if ctx is not None:
+                args["trace"] = ctx.trace_id
         with self._lock:
             if track is None:
                 track = next(self._tracks)
