@@ -24,7 +24,7 @@ IMAGE_DIR := build/images
 DIST      := build/dist
 
 .PHONY: ci presubmit lint analyze native native-test native-race test wire-test e2e e2e-kind bench \
-        chaos-soak serve-soak serve-paged serve-sharded serve-disagg trace-smoke ha-soak controller-profile images release mnist-acc clean
+        chaos-soak serve-soak serve-paged serve-sharded serve-disagg trace-smoke alert-smoke bench-regression ha-soak controller-profile images release mnist-acc clean
 
 # `test` already runs the whole tests/ tree (native bindings, wire,
 # E2E suites included) — native-test/wire-test exist for targeted runs,
@@ -129,6 +129,20 @@ serve-disagg:
 # client-measured TTFT attributed (CI's trace-smoke)
 trace-smoke:
 	env JAX_PLATFORMS=cpu $(PY) -m tf_operator_tpu.serve.fleet --trace-smoke
+
+# burn-rate alerting proof (docs/monitoring.md "History & alerting"):
+# a live 2-replica fleet, chaos-injected TTFT latency, the fast burn
+# window must fire, the fault clears, the alert must RESOLVE — with
+# trace-correlated kind="alert" flight records (CI's alert-smoke)
+alert-smoke:
+	env JAX_PLATFORMS=cpu $(PY) -m tf_operator_tpu.serve.fleet --alert-smoke
+
+# perf-regression sentinel (docs/monitoring.md "Regression sentinel"):
+# replay the committed benchmark artifacts against noise-banded
+# baselines; exits nonzero when a guarded metric left its band and
+# appends the run to BENCH_TREND.json
+bench-regression:
+	$(PY) -m benchmarks.regression --dry-run
 
 # Hermetic E2E runs everywhere (operator process <-HTTP-> apiserver
 # <-HTTP-> process kubelet); the kind path self-activates when kind is

@@ -1,0 +1,285 @@
+"""Perf-regression sentinel over the committed benchmark artifacts.
+
+The benchmarks write their numbers to artifact files at the repo root
+(SERVE_BENCH.json, CONTROLLER_SCALE.json, CONTROLLER_PROFILE.json)
+and CI commits them; nothing ever *reads* them back. This module is
+the reader: it replays the committed artifacts against a table of
+noise-banded baselines and exits nonzero when a guarded metric walked
+out of its band — the observability PR's answer to "the fleet alerts
+on SLO burn at runtime, but who alerts on the repo getting slower?"
+
+Band policy (CPU CI is noisy; structure is not):
+
+- wall-clock latencies get a generous multiplicative band (default
+  2x) — they catch "the p95 doubled", not 10% jitter;
+- structural counts (engine recompiles, paged-KV capacity ratio) and
+  ratios the code controls (prefix hit rate, phase coverage) get
+  tight bands — a second XLA compile or a dropped cache hit IS the
+  regression, there is no noise to absorb.
+
+Every run appends one row to BENCH_TREND.json (bounded to the last
+200 runs) so the trend survives in-repo next to the artifacts it
+guards, and the CI step `make bench-regression` fails the presubmit
+on any out-of-band check.
+
+Usage:
+    python -m benchmarks.regression                 # check + append trend
+    python -m benchmarks.regression --dry-run       # check only
+    python -m benchmarks.regression --trend /tmp/t.json
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import time
+from typing import Dict, List, Optional
+
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+TREND_KEEP = 200
+
+# direction "max": value must stay <= baseline * band (lower is
+# better: latencies, duty cycles, compile counts). direction "min":
+# value must stay >= baseline * band (higher is better: hit rates,
+# coverage, capacity ratios) — band < 1.0 there.
+BASELINES = [
+    # -- serve plane (SERVE_BENCH.json) ----------------------------------
+    {
+        "check": "serve-ttft-p95",
+        "artifact": "serve_bench",
+        "path": "continuous.ttft_p95_s",
+        "baseline": 0.0798,
+        "direction": "max",
+        "band": 2.0,
+    },
+    {
+        "check": "serve-server-ttft-p95",
+        "artifact": "serve_bench",
+        "path": "continuous.server_ttft_p95_s",
+        "baseline": 0.0737,
+        "direction": "max",
+        "band": 2.0,
+    },
+    {
+        "check": "serve-engine-compiles",
+        "artifact": "serve_bench",
+        "path": "continuous.engine_compiles",
+        "baseline": 1,
+        "direction": "max",
+        "band": 1.0,  # a second compile IS the regression
+    },
+    {
+        "check": "serve-prefix-hit-rate",
+        "artifact": "serve_bench",
+        "path": "paged_kv.shared_prefix.paged.prefix_hit_rate",
+        "baseline": 0.96,
+        "direction": "min",
+        "band": 0.95,
+    },
+    {
+        "check": "serve-paged-capacity-ratio",
+        "artifact": "serve_bench",
+        "path": "paged_kv.capacity.ratio",
+        "baseline": 4.0,
+        "direction": "min",
+        "band": 1.0,  # slot arithmetic, not a measurement
+    },
+    # -- controller scale (CONTROLLER_SCALE.json) ------------------------
+    {
+        "check": "controller-all-ready-100",
+        "artifact": "controller_scale",
+        "path": "all_ready_seconds",
+        "baseline": 1.258,
+        "direction": "max",
+        "band": 2.0,
+    },
+    {
+        "check": "controller-all-ready-500",
+        "artifact": "controller_scale",
+        "path": "headroom.all_ready_seconds",
+        "baseline": 6.66,
+        "direction": "max",
+        "band": 2.0,
+    },
+    # -- controller profile (CONTROLLER_PROFILE.json) --------------------
+    {
+        "check": "profile-phase-coverage",
+        "artifact": "controller_profile",
+        "path": "design_point.phase_coverage_of_reconcile_wall",
+        "baseline": 0.9963,
+        "direction": "min",
+        "band": 0.9,  # unattributed reconcile time reappearing
+    },
+    {
+        "check": "profile-sampler-duty-cycle",
+        "artifact": "controller_profile",
+        "path": "design_point.profile.sampler_duty_cycle",
+        "baseline": 0.00803,
+        "direction": "max",
+        "band": 3.0,  # observer overhead must stay ~free
+    },
+]
+
+ARTIFACTS = {
+    "serve_bench": "SERVE_BENCH.json",
+    "controller_scale": "CONTROLLER_SCALE.json",
+    "controller_profile": "CONTROLLER_PROFILE.json",
+}
+
+
+def _resolve(doc: dict, dotted: str):
+    node = doc
+    for part in dotted.split("."):
+        if not isinstance(node, dict) or part not in node:
+            return None
+        node = node[part]
+    return node
+
+
+def run_checks(
+    artifacts: Dict[str, Optional[dict]],
+    baselines: Optional[List[dict]] = None,
+) -> List[dict]:
+    """Evaluate every baseline against the loaded artifact docs.
+    Returns one row per check; a missing artifact or metric path is
+    itself a failure (a benchmark that stopped reporting a guarded
+    number must not pass silently)."""
+    rows = []
+    for spec in baselines if baselines is not None else BASELINES:
+        doc = artifacts.get(spec["artifact"])
+        row = {
+            "check": spec["check"],
+            "artifact": spec["artifact"],
+            "path": spec["path"],
+            "baseline": spec["baseline"],
+            "direction": spec["direction"],
+            "band": spec["band"],
+        }
+        if doc is None:
+            row.update(value=None, bound=None, ok=False,
+                       reason="artifact missing")
+            rows.append(row)
+            continue
+        value = _resolve(doc, spec["path"])
+        if not isinstance(value, (int, float)):
+            row.update(value=None, bound=None, ok=False,
+                       reason="metric missing")
+            rows.append(row)
+            continue
+        bound = spec["baseline"] * spec["band"]
+        if spec["direction"] == "max":
+            ok = value <= bound
+        else:
+            ok = value >= bound
+        row.update(value=value, bound=round(bound, 6), ok=ok)
+        if not ok:
+            row["reason"] = (
+                f"{value} "
+                f"{'>' if spec['direction'] == 'max' else '<'} "
+                f"bound {round(bound, 6)} "
+                f"(baseline {spec['baseline']}, band {spec['band']}x)"
+            )
+        rows.append(row)
+    return rows
+
+
+def load_artifacts(paths: Dict[str, str]) -> Dict[str, Optional[dict]]:
+    out: Dict[str, Optional[dict]] = {}
+    for key, path in paths.items():
+        try:
+            with open(path) as fh:
+                out[key] = json.load(fh)
+        except (OSError, ValueError):
+            out[key] = None
+    return out
+
+
+def append_trend(trend_path: str, rows: List[dict]) -> dict:
+    """Append this run's summary to the trend file (a bounded list —
+    the in-repo history the sentinel's own deltas read from)."""
+    try:
+        with open(trend_path) as fh:
+            doc = json.load(fh)
+        runs = doc.get("runs", [])
+    except (OSError, ValueError):
+        runs = []
+    entry = {
+        "at": time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime()),
+        "ok": all(r["ok"] for r in rows),
+        "regressions": [r["check"] for r in rows if not r["ok"]],
+        "values": {
+            r["check"]: r["value"] for r in rows if r["value"] is not None
+        },
+    }
+    runs.append(entry)
+    doc = {"keep": TREND_KEEP, "runs": runs[-TREND_KEEP:]}
+    with open(trend_path, "w") as fh:
+        json.dump(doc, fh, indent=1, sort_keys=True)
+        fh.write("\n")
+    return entry
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    parser = argparse.ArgumentParser(
+        description="benchmark regression sentinel"
+    )
+    parser.add_argument(
+        "--serve-bench",
+        default=os.path.join(REPO_ROOT, ARTIFACTS["serve_bench"]),
+    )
+    parser.add_argument(
+        "--controller-scale",
+        default=os.path.join(REPO_ROOT, ARTIFACTS["controller_scale"]),
+    )
+    parser.add_argument(
+        "--controller-profile",
+        default=os.path.join(REPO_ROOT, ARTIFACTS["controller_profile"]),
+    )
+    parser.add_argument(
+        "--trend", default=os.path.join(REPO_ROOT, "BENCH_TREND.json")
+    )
+    parser.add_argument(
+        "--dry-run", action="store_true",
+        help="check only; do not append to the trend file",
+    )
+    args = parser.parse_args(argv)
+
+    artifacts = load_artifacts(
+        {
+            "serve_bench": args.serve_bench,
+            "controller_scale": args.controller_scale,
+            "controller_profile": args.controller_profile,
+        }
+    )
+    rows = run_checks(artifacts)
+    width = max(len(r["check"]) for r in rows)
+    for r in rows:
+        status = "ok  " if r["ok"] else "FAIL"
+        value = "-" if r["value"] is None else f"{r['value']:g}"
+        bound = "-" if r["bound"] is None else f"{r['bound']:g}"
+        line = (
+            f"[{status}] {r['check']:<{width}}  value={value:<10} "
+            f"bound={bound:<10} ({r['direction']} {r['band']}x "
+            f"of {r['baseline']:g})"
+        )
+        if not r["ok"]:
+            line += f"  <- {r.get('reason', 'regressed')}"
+        print(line)
+    if not args.dry_run:
+        entry = append_trend(args.trend, rows)
+        print(
+            f"trend: appended run (ok={entry['ok']}) to {args.trend}"
+        )
+    failed = [r["check"] for r in rows if not r["ok"]]
+    if failed:
+        print(f"REGRESSION: {', '.join(failed)}", file=sys.stderr)
+        return 1
+    print(f"all {len(rows)} checks within band")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,300 @@
+"""AlertManager (telemetry/alerts.py): declarative rules over the
+metric history ring — multi-window burn-rate for SLOs, threshold with
+hysteresis for depth/occupancy, the firing -> resolved state machine
+on FakeClock, alert flight-record emission with trace correlation,
+and the packaged rule sets the three planes install."""
+
+import json
+
+import pytest
+
+from tf_operator_tpu.controller.clock import FakeClock
+from tf_operator_tpu.telemetry import (
+    AlertManager,
+    BurnRateRule,
+    FlightRecorder,
+    MetricRegistry,
+    ThresholdRule,
+    fleet_rules,
+    operator_rules,
+    render_alertz,
+    serve_replica_rules,
+)
+from tf_operator_tpu.telemetry.history import MetricHistory
+
+INF = float("inf")
+SLO = 0.25  # aligned with a TTFT bucket edge, like the real rules
+
+
+class _TtftFeed:
+    """Pushes cumulative (0.25, +Inf) bucket vectors — the
+    fleet-summed ingest path — with good/bad observation batches."""
+
+    def __init__(self, history, clock, series="ttft"):
+        self.history = history
+        self.clock = clock
+        self.series = series
+        self.good = 0.0
+        self.total = 0.0
+
+    def tick(self, good=0, bad=0, dt=10.0):
+        self.clock.advance(dt)
+        self.good += good
+        self.total += good + bad
+        self.history.ingest_histogram(
+            self.series, [(SLO, self.good), (INF, self.total)]
+        )
+
+
+def make_manager(rules, capacity=512):
+    clock = FakeClock()
+    history = MetricHistory(capacity=capacity, clock=clock)
+    flight = FlightRecorder()
+    registry = MetricRegistry("t")
+    manager = AlertManager(
+        history, rules, registry=registry, clock=clock, flight=flight
+    )
+    return manager, history, clock, flight, registry
+
+
+class TestBurnRate:
+    def rule(self):
+        return BurnRateRule(
+            "ttft-slo", "ttft", threshold_s=SLO, objective=0.95,
+            windows=((60.0, 14.4), (300.0, 6.0)),
+        )
+
+    def test_fast_and_slow_windows_fire_independently(self):
+        manager, history, clock, _, _ = make_manager([self.rule()])
+        feed = _TtftFeed(history, clock)
+        # long healthy baseline
+        for _ in range(40):
+            feed.tick(good=10)
+            manager.evaluate()
+        assert manager.firing() == []
+        # a spike: 60s of all-bad traffic trips ONLY the fast window
+        # (the slow window's 300s dilutes it below its 6x threshold)
+        for _ in range(6):
+            feed.tick(bad=10)
+            manager.evaluate()
+        assert manager.firing() == ["ttft-slo[60s]"]
+        # sustained burn: the slow window crosses too
+        for _ in range(13):
+            feed.tick(bad=10)
+            manager.evaluate()
+        assert set(manager.firing()) == {
+            "ttft-slo[60s]", "ttft-slo[300s]",
+        }
+        # recovery: the fast window drains first — fast resolved while
+        # slow still firing proves the windows resolve independently
+        for _ in range(7):
+            feed.tick(good=10)
+            manager.evaluate()
+        assert manager.firing() == ["ttft-slo[300s]"]
+        for _ in range(30):
+            feed.tick(good=10)
+            manager.evaluate()
+        assert manager.firing() == []
+
+    def test_no_data_holds_state(self):
+        manager, history, clock, _, _ = make_manager([self.rule()])
+        feed = _TtftFeed(history, clock)
+        for _ in range(8):
+            feed.tick(bad=10)
+            manager.evaluate()
+        assert "ttft-slo[60s]" in manager.firing()
+        # the series goes silent (scrape gap): a firing alert must
+        # hold — no data is not "healthy"
+        clock.advance(600.0)
+        manager.evaluate()
+        assert "ttft-slo[60s]" in manager.firing()
+
+    def test_partial_suppresses_resolve_only(self):
+        manager, history, clock, _, _ = make_manager([self.rule()])
+        feed = _TtftFeed(history, clock)
+        for _ in range(8):
+            feed.tick(bad=10)
+            manager.evaluate()
+        assert "ttft-slo[60s]" in manager.firing()
+        # healthy traffic again, but the scrape is partial: resolve is
+        # suppressed (missing replicas could still be burning)
+        for _ in range(12):
+            feed.tick(good=10)
+            manager.evaluate(partial=True)
+        assert "ttft-slo[60s]" in manager.firing()
+        # the same healthy data with a complete scrape resolves
+        feed.tick(good=10)
+        manager.evaluate(partial=False)
+        assert "ttft-slo[60s]" not in manager.firing()
+
+    def test_objective_validation(self):
+        with pytest.raises(ValueError):
+            BurnRateRule("r", "s", threshold_s=0.25, objective=1.0)
+
+
+class TestThreshold:
+    def test_hysteresis_does_not_flap(self):
+        rule = ThresholdRule(
+            "queue-depth", "depth", fire_above=16.0, resolve_below=8.0
+        )
+        manager, history, clock, flight, _ = make_manager([rule])
+        fire_count = 0
+        # oscillate across the FIRE boundary: 17, 15, 17, 15 ... once
+        # firing, dips that stay above resolve_below must not resolve
+        for value in (17.0, 15.0, 17.0, 15.0, 17.0, 15.0):
+            clock.advance(5.0)
+            history.ingest_value("depth", "gauge", value)
+            for t in manager.evaluate():
+                if t["state"] == "firing":
+                    fire_count += 1
+            assert manager.firing() == ["queue-depth"]
+        assert fire_count == 1
+        # only crossing resolve_below clears it
+        clock.advance(5.0)
+        history.ingest_value("depth", "gauge", 5.0)
+        transitions = manager.evaluate()
+        assert [t["state"] for t in transitions] == ["resolved"]
+        assert manager.firing() == []
+        records = flight.snapshot(kind="alert")
+        assert [r.fields["state"] for r in records] == [
+            "firing", "resolved",
+        ]
+
+    def test_for_s_damper(self):
+        rule = ThresholdRule(
+            "depth", "depth", fire_above=10.0, resolve_below=5.0,
+            for_s=30.0,
+        )
+        manager, history, clock, _, _ = make_manager([rule])
+        # one 10s blip above the line: pending, never fires
+        clock.advance(10.0)
+        history.ingest_value("depth", "gauge", 20.0)
+        manager.evaluate()
+        clock.advance(10.0)
+        history.ingest_value("depth", "gauge", 2.0)
+        manager.evaluate()
+        assert manager.firing() == []
+        # sustained breach outlasting for_s fires
+        for _ in range(5):
+            clock.advance(10.0)
+            history.ingest_value("depth", "gauge", 20.0)
+            manager.evaluate()
+        assert manager.firing() == ["depth"]
+
+    def test_ratio_mode(self):
+        rule = ThresholdRule(
+            "kv-occupancy", "in_use", fire_above=0.9,
+            resolve_below=0.75, mode="ratio", denominator="total",
+        )
+        manager, history, clock, _, _ = make_manager([rule])
+        clock.advance(1.0)
+        history.ingest_value("in_use", "gauge", 95.0)
+        history.ingest_value("total", "gauge", 100.0)
+        manager.evaluate()
+        assert manager.firing() == ["kv-occupancy"]
+
+    def test_validation(self):
+        with pytest.raises(ValueError):
+            ThresholdRule("r", "s", fire_above=1.0, resolve_below=2.0)
+        with pytest.raises(ValueError):
+            ThresholdRule("r", "s", fire_above=1.0, mode="ratio")
+        with pytest.raises(ValueError):
+            ThresholdRule("r", "s", fire_above=1.0, mode="nope")
+
+
+class TestTransitions:
+    def test_flight_records_carry_traces(self):
+        manager, history, clock, flight, _ = make_manager(
+            [ThresholdRule("depth", "depth", fire_above=10.0)]
+        )
+        # in-flight requests leave trace-carrying records; the alert
+        # transition samples them so the operator can jump straight
+        # from the alert to affected request timelines
+        flight.record("serve", op="route", trace="aaaa1111")
+        flight.record("serve", op="route", trace="bbbb2222")
+        clock.advance(1.0)
+        history.ingest_value("depth", "gauge", 50.0)
+        manager.evaluate()
+        (record,) = flight.snapshot(kind="alert")
+        assert record.fields["state"] == "firing"
+        assert record.fields["rule"] == "depth"
+        traces = set(record.fields["traces"].split(","))
+        assert {"aaaa1111", "bbbb2222"} <= traces
+
+    def test_firing_gauge_tracks_state(self):
+        manager, history, clock, _, registry = make_manager(
+            [ThresholdRule(
+                "depth", "depth", fire_above=10.0, resolve_below=5.0,
+            )]
+        )
+        clock.advance(1.0)
+        history.ingest_value("depth", "gauge", 50.0)
+        manager.evaluate()
+        assert 'alerts_firing{rule="depth"} 1' in registry.render()
+        clock.advance(1.0)
+        history.ingest_value("depth", "gauge", 1.0)
+        manager.evaluate()
+        assert 'alerts_firing{rule="depth"} 0' in registry.render()
+
+    def test_broken_rule_does_not_stop_others(self):
+        class Broken:
+            name = "broken"
+            series = "x"
+
+            def instances(self):
+                from tf_operator_tpu.telemetry.alerts import _Instance
+
+                def boom(history, now):
+                    raise RuntimeError("rule bug")
+
+                return [_Instance(
+                    rule=self, key="broken", evaluate=boom,
+                    fire_above=1.0, resolve_below=1.0, for_s=0.0,
+                )]
+
+            def describe(self):
+                return {"rule": "broken"}
+
+        manager, history, clock, _, _ = make_manager(
+            [Broken(), ThresholdRule("depth", "depth", fire_above=10.0)]
+        )
+        clock.advance(1.0)
+        history.ingest_value("depth", "gauge", 50.0)
+        manager.evaluate()
+        assert manager.firing() == ["depth"]
+
+
+class TestRulePacksAndRender:
+    def test_packaged_rule_sets_instantiate(self):
+        for pack in (serve_replica_rules(), operator_rules(),
+                     fleet_rules()):
+            manager, _, _, _, _ = make_manager(pack)
+            status = manager.status()
+            assert status["instances"]
+            assert status["firing"] == []
+        keys = {
+            i["instance"]
+            for i in make_manager(serve_replica_rules())[0]
+            .status()["instances"]
+        }
+        assert "ttft-slo[60s]" in keys and "ttft-slo[300s]" in keys
+        assert "queue-depth" in keys and "kv-occupancy" in keys
+
+    def test_render_alertz_firing_filter(self):
+        manager, history, clock, _, _ = make_manager(
+            [
+                ThresholdRule("hot", "a", fire_above=1.0),
+                ThresholdRule("cold", "b", fire_above=100.0),
+            ]
+        )
+        clock.advance(1.0)
+        history.ingest_value("a", "gauge", 9.0)
+        history.ingest_value("b", "gauge", 9.0)
+        manager.evaluate()
+        doc = json.loads(render_alertz(manager, ""))
+        assert {i["instance"] for i in doc["instances"]} == {
+            "hot", "cold",
+        }
+        assert doc["firing"] == ["hot"]
+        doc = json.loads(render_alertz(manager, "firing=1"))
+        assert [i["instance"] for i in doc["instances"]] == ["hot"]

@@ -1,0 +1,319 @@
+"""MetricHistory (telemetry/history.py): the bounded time-series ring
+behind /debug/historyz — flight-ring storage discipline, windowed
+rate/delta queries, and the never-average quantile rule (windowed
+quantiles come from edge-differenced cumulative bucket vectors, fleet
+quantiles from bucket sums). Plus the collector's ClockCache
+(per-replica offset TTL + RTT-degrade invalidation)."""
+
+import json
+
+import pytest
+
+from tf_operator_tpu.controller.clock import FakeClock
+from tf_operator_tpu.telemetry import MetricRegistry, render_historyz
+from tf_operator_tpu.telemetry.collector import ClockCache
+from tf_operator_tpu.telemetry.history import MetricHistory
+from tf_operator_tpu.telemetry.registry import (
+    TTFT_BUCKETS,
+    histogram_quantile,
+)
+
+
+def make_history(capacity=64):
+    clock = FakeClock()
+    return MetricHistory(capacity=capacity, clock=clock), clock
+
+
+class TestRing:
+    def test_capacity_floor(self):
+        with pytest.raises(ValueError):
+            MetricHistory(capacity=1)
+
+    def test_wraparound_keeps_newest(self):
+        history, clock = make_history(capacity=8)
+        for i in range(20):
+            clock.advance(1.0)
+            history.ingest_value("depth", "gauge", float(i))
+        samples = history.samples("depth", window_s=1e9)
+        assert len(samples) == 8
+        assert [s[2] for s in samples] == [float(i) for i in range(12, 20)]
+        # oldest-first reconstruction: timestamps strictly increase
+        times = [s[0] for s in samples]
+        assert times == sorted(times)
+
+    def test_wraparound_mid_window(self):
+        """A window that reaches past the ring's oldest retained
+        sample degrades to what is retained — never crashes, never
+        resurrects overwritten samples."""
+        history, clock = make_history(capacity=4)
+        reg = MetricRegistry("t")
+        c = reg.counter("ops_total", "ops")
+        history.track_registry(reg)
+        for _ in range(10):
+            clock.advance(5.0)
+            c.inc(2)
+            history.tick()
+        # 10 ticks, ring keeps 4: window of 100s only sees 4 samples
+        samples = history.samples("t_ops_total", window_s=100.0)
+        assert len(samples) == 4
+        # delta over the retained span: 3 inter-sample increments
+        assert history.delta("t_ops_total", 100.0) == pytest.approx(6.0)
+
+
+class TestQueries:
+    def test_counter_delta_and_rate(self):
+        history, clock = make_history()
+        reg = MetricRegistry("t")
+        c = reg.counter("reqs_total", "requests")
+        history.track_registry(reg)
+        for _ in range(5):
+            clock.advance(10.0)
+            c.inc(3)
+            history.tick()
+        assert history.delta("t_reqs_total", 40.0) == pytest.approx(12.0)
+        assert history.rate("t_reqs_total", 40.0) == pytest.approx(0.3)
+        # a window holding < 2 samples answers None, not garbage
+        assert history.delta("t_reqs_total", 5.0) is None
+
+    def test_counter_reset_falls_back_to_last(self):
+        history, clock = make_history()
+        values = iter([100.0, 120.0, 5.0])
+        history.track_provider(
+            "restarts_total", "counter", lambda: next(values)
+        )
+        for _ in range(3):
+            clock.advance(10.0)
+            history.tick()
+        # 120 -> 5 is a reset: Prometheus-style, report the post-reset
+        # level instead of a negative increase
+        assert history.delta("restarts_total", 100.0) == pytest.approx(5.0)
+
+    def test_labeled_family_sums_across_children(self):
+        history, clock = make_history()
+        reg = MetricRegistry("t")
+        fam = reg.counter("ops_total", "ops", labelnames=("verb",))
+        history.track_registry(reg)
+        for _ in range(3):
+            clock.advance(1.0)
+            fam.labels(verb="get").inc(1)
+            fam.labels(verb="put").inc(2)
+            history.tick()
+        # exact child key resolves that child; the family name sums
+        assert history.delta('t_ops_total{verb="get"}', 10.0) == 2.0
+        assert history.delta("t_ops_total", 10.0) == pytest.approx(6.0)
+
+    def test_track_flat_provider(self):
+        """Engine-style flat metrics ({(name, kind): value}) ride the
+        same ring as registry families."""
+        history, clock = make_history()
+        state = {"depth": 0.0}
+        history.track_flat(
+            lambda: {("engine_queue_depth", "gauge"): state["depth"]}
+        )
+        for depth in (1.0, 4.0, 2.0):
+            clock.advance(1.0)
+            state["depth"] = depth
+            history.tick()
+        assert history.latest("engine_queue_depth") == 2.0
+
+    def test_provider_exception_counted_not_fatal(self):
+        history, clock = make_history()
+
+        def broken():
+            raise RuntimeError("boom")
+
+        history.track_provider("bad", "gauge", broken)
+        history.ingest_value("good", "gauge", 1.0)
+        clock.advance(1.0)
+        history.tick()
+        assert history.sample_errors >= 1
+        assert history.latest("good") == 1.0
+
+
+class TestHistogramWindows:
+    def _observe_and_tick(self, history, clock, hist, values):
+        for v in values:
+            hist.observe(v)
+        clock.advance(5.0)
+        history.tick()
+
+    def test_windowed_quantile_sees_only_window(self):
+        history, clock = make_history()
+        reg = MetricRegistry("t")
+        h = reg.histogram("lat_seconds", "latency", buckets=TTFT_BUCKETS)
+        history.track_registry(reg)
+        clock.advance(5.0)
+        history.tick()  # baseline edge
+        # old observations: all fast
+        self._observe_and_tick(history, clock, h, [0.004] * 50)
+        # new observations: all slow — a recent window must see ONLY
+        # these, while the cumulative histogram still holds both
+        self._observe_and_tick(history, clock, h, [0.4] * 50)
+        recent = history.quantile_over_window("t_lat_seconds", 0.95, 6.0)
+        assert recent is not None and recent > 0.25
+        overall = history.quantile_over_window("t_lat_seconds", 0.5, 60.0)
+        assert overall is not None and overall < 0.25
+
+    def test_quantile_matches_exact_reservoir_p95(self):
+        """Acceptance check: quantile_over_window on router-TTFT
+        buckets agrees with the exact reservoir p95 to within the
+        containing bucket (bucket interpolation can't do better)."""
+        history, clock = make_history()
+        reg = MetricRegistry("t")
+        h = reg.histogram("ttft_seconds", "ttft", buckets=TTFT_BUCKETS)
+        history.track_registry(reg)
+        clock.advance(1.0)
+        history.tick()
+        # deterministic spread across several buckets
+        values = [0.001 + (i % 40) * 0.004 for i in range(400)]
+        for v in values:
+            h.observe(v)
+        clock.advance(1.0)
+        history.tick()
+        est = history.quantile_over_window("t_ttft_seconds", 0.95, 10.0)
+        ordered = sorted(values)
+        rank = 0.95 * (len(ordered) - 1)
+        lo = int(rank)
+        exact = ordered[lo] + (ordered[min(lo + 1, len(ordered) - 1)]
+                               - ordered[lo]) * (rank - lo)
+        edges = [b for b in TTFT_BUCKETS if b >= exact]
+        upper = edges[0]
+        lower = max(
+            [b for b in TTFT_BUCKETS if b < exact], default=0.0
+        )
+        assert est is not None
+        assert lower <= est <= upper, (
+            f"estimate {est} outside exact p95 {exact}'s bucket "
+            f"({lower}, {upper}]"
+        )
+
+    def test_bad_fraction(self):
+        history, clock = make_history()
+        reg = MetricRegistry("t")
+        h = reg.histogram("ttft_seconds", "ttft", buckets=TTFT_BUCKETS)
+        history.track_registry(reg)
+        clock.advance(1.0)
+        history.tick()
+        for v in [0.01] * 75 + [0.4] * 25:
+            h.observe(v)
+        clock.advance(1.0)
+        history.tick()
+        # 0.25 is a bucket edge: 25% of observations exceeded it
+        frac = history.bad_fraction("t_ttft_seconds", 0.25, 10.0)
+        assert frac == pytest.approx(0.25)
+        # no observations in a stale window: None, not 0.0 (the
+        # alerting layer must hold state rather than read "healthy")
+        clock.advance(100.0)
+        history.tick()
+        assert history.bad_fraction("t_ttft_seconds", 0.25, 50.0) in (
+            None,
+            0.0,
+        )
+
+    def test_ingest_histogram_push_and_reset_clamp(self):
+        history, clock = make_history()
+        les = (0.1, 0.5, float("inf"))
+        clock.advance(1.0)
+        history.ingest_histogram(
+            "fleet_ttft_seconds", [(0.1, 10.0), (0.5, 15.0), (les[2], 20.0)]
+        )
+        clock.advance(1.0)
+        history.ingest_histogram(
+            "fleet_ttft_seconds", [(0.1, 12.0), (0.5, 25.0), (les[2], 30.0)]
+        )
+        pairs = history.bucket_delta("fleet_ttft_seconds", 10.0)
+        assert pairs == [(0.1, 2.0), (0.5, 10.0), (les[2], 10.0)]
+        assert histogram_quantile(0.5, pairs) is not None
+        # a replica restart drops cumulative counts: negative
+        # per-bucket diffs clamp to zero instead of going negative
+        clock.advance(1.0)
+        history.ingest_histogram(
+            "fleet_ttft_seconds", [(0.1, 1.0), (0.5, 2.0), (les[2], 3.0)]
+        )
+        pairs = history.bucket_delta("fleet_ttft_seconds", 2.5)
+        assert all(count >= 0.0 for _, count in pairs)
+
+    def test_bucket_schema_change_empties_window(self):
+        history, clock = make_history()
+        clock.advance(1.0)
+        history.ingest_histogram("h", [(0.1, 1.0), (float("inf"), 2.0)])
+        clock.advance(1.0)
+        history.ingest_histogram(
+            "h", [(0.2, 1.0), (0.4, 2.0), (float("inf"), 3.0)]
+        )
+        assert history.bucket_delta("h", 10.0) == []
+
+
+class TestRenderHistoryz:
+    def test_page_shape_and_filter(self):
+        history, clock = make_history()
+        reg = MetricRegistry("t")
+        c = reg.counter("reqs_total", "requests")
+        h = reg.histogram("lat_seconds", "latency", buckets=TTFT_BUCKETS)
+        history.track_registry(reg)
+        for _ in range(3):
+            clock.advance(5.0)
+            c.inc()
+            h.observe(0.01)
+            history.tick()
+        doc = json.loads(render_historyz(history, ""))
+        assert doc["ticks"] == 3
+        names = {row["series"] for row in doc["series"]}
+        assert names == {"t_reqs_total", "t_lat_seconds"}
+        doc = json.loads(
+            render_historyz(history, "series=t_lat&q=0.95&window=60")
+        )
+        assert [r["series"] for r in doc["series"]] == ["t_lat_seconds"]
+        assert "p95" in doc["series"][0]
+
+
+class _FakeClockzClient:
+    """clock_offset() target: counts handshakes."""
+
+    def __init__(self):
+        self.calls = 0
+
+    def clockz(self):
+        self.calls += 1
+        return {"mono": 0.0, "perf": 0.0, "wall": 0.0}
+
+
+class TestClockCache:
+    def test_ttl_hit_then_rehandshake(self):
+        now = [0.0]
+        cache = ClockCache(ttl_s=30.0, samples=2, clock=lambda: now[0])
+        client = _FakeClockzClient()
+        cache.get("r0", client)
+        assert client.calls == 2  # the handshake's sample count
+        now[0] = 10.0
+        cache.get("r0", client)
+        assert client.calls == 2  # fresh: served from cache
+        now[0] = 45.0
+        cache.get("r0", client)
+        assert client.calls == 4  # stale: re-handshaken
+        assert cache.stats()["hits"] == 1
+        assert cache.stats()["misses"] == 2
+
+    def test_rtt_degrade_invalidates(self):
+        now = [0.0]
+        cache = ClockCache(
+            ttl_s=1e9, samples=1, degrade_floor_s=0.01,
+            clock=lambda: now[0],
+        )
+        client = _FakeClockzClient()
+        cache.get("r0", client)
+        assert client.calls == 1
+        # a fetch within the bound keeps the entry
+        cache.observe_rtt("r0", 0.005)
+        cache.get("r0", client)
+        assert client.calls == 1
+        # a fetch far beyond the cached handshake's RTT drops it
+        cache.observe_rtt("r0", 5.0)
+        assert cache.stats()["invalidations"] == 1
+        cache.get("r0", client)
+        assert client.calls == 2
+
+    def test_observe_rtt_unknown_replica_is_noop(self):
+        cache = ClockCache()
+        cache.observe_rtt("nope", 100.0)
+        assert cache.stats()["invalidations"] == 0

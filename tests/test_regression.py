@@ -1,0 +1,116 @@
+"""benchmarks/regression.py: the perf-regression sentinel. The
+committed benchmark artifacts must be green against their baselines;
+a synthetically regressed artifact must turn the matching check red;
+missing artifacts/metrics are failures (not silent passes); the trend
+file stays bounded."""
+
+import copy
+import json
+import os
+
+from benchmarks.regression import (
+    ARTIFACTS,
+    BASELINES,
+    REPO_ROOT,
+    TREND_KEEP,
+    append_trend,
+    load_artifacts,
+    run_checks,
+)
+
+
+def committed_artifacts():
+    return load_artifacts(
+        {
+            key: os.path.join(REPO_ROOT, name)
+            for key, name in ARTIFACTS.items()
+        }
+    )
+
+
+class TestCommittedArtifacts:
+    def test_all_checks_green(self):
+        rows = run_checks(committed_artifacts())
+        assert len(rows) == len(BASELINES)
+        bad = [r for r in rows if not r["ok"]]
+        assert bad == [], f"committed artifacts regressed: {bad}"
+
+    def test_every_check_reads_a_real_value(self):
+        for row in run_checks(committed_artifacts()):
+            assert isinstance(row["value"], (int, float)), row
+
+
+class TestRegressionDetection:
+    def test_ttft_regression_trips_only_its_check(self):
+        artifacts = committed_artifacts()
+        regressed = copy.deepcopy(artifacts)
+        doc = regressed["serve_bench"]
+        doc["continuous"]["ttft_p95_s"] = (
+            doc["continuous"]["ttft_p95_s"] * 100.0
+        )
+        rows = run_checks(regressed)
+        by_check = {r["check"]: r for r in rows}
+        assert not by_check["serve-ttft-p95"]["ok"]
+        assert "bound" in by_check["serve-ttft-p95"]["reason"]
+        # the untouched checks stay green
+        others = [
+            r for r in rows
+            if r["check"] not in ("serve-ttft-p95",) and not r["ok"]
+        ]
+        assert others == []
+
+    def test_min_direction_regression(self):
+        regressed = committed_artifacts()
+        regressed = copy.deepcopy(regressed)
+        regressed["serve_bench"]["paged_kv"]["shared_prefix"]["paged"][
+            "prefix_hit_rate"
+        ] = 0.1
+        rows = run_checks(regressed)
+        by_check = {r["check"]: r for r in rows}
+        assert not by_check["serve-prefix-hit-rate"]["ok"]
+
+    def test_value_inside_noise_band_passes(self):
+        """The band exists so run-to-run noise doesn't page anyone: a
+        value slightly past baseline but inside baseline*band is ok."""
+        artifacts = copy.deepcopy(committed_artifacts())
+        base = next(
+            b for b in BASELINES if b["check"] == "serve-ttft-p95"
+        )
+        artifacts["serve_bench"]["continuous"]["ttft_p95_s"] = (
+            base["baseline"] * base["band"] * 0.99
+        )
+        rows = run_checks(artifacts)
+        by_check = {r["check"]: r for r in rows}
+        assert by_check["serve-ttft-p95"]["ok"]
+
+    def test_missing_artifact_is_a_failure(self):
+        artifacts = committed_artifacts()
+        artifacts = {
+            k: v for k, v in artifacts.items() if k != "controller_scale"
+        }
+        rows = run_checks(artifacts)
+        bad = {r["check"] for r in rows if not r["ok"]}
+        assert "controller-all-ready-100" in bad
+        assert "controller-all-ready-500" in bad
+
+    def test_missing_metric_is_a_failure(self):
+        artifacts = copy.deepcopy(committed_artifacts())
+        del artifacts["serve_bench"]["continuous"]["ttft_p95_s"]
+        rows = run_checks(artifacts)
+        by_check = {r["check"]: r for r in rows}
+        assert not by_check["serve-ttft-p95"]["ok"]
+        assert "missing" in by_check["serve-ttft-p95"]["reason"]
+
+
+class TestTrend:
+    def test_append_bounded_and_shaped(self, tmp_path):
+        trend = tmp_path / "BENCH_TREND.json"
+        rows = run_checks(committed_artifacts())
+        for _ in range(TREND_KEEP + 10):
+            append_trend(trend, rows)
+        doc = json.loads(trend.read_text())
+        assert len(doc["runs"]) == TREND_KEEP
+        entry = doc["runs"][-1]
+        assert entry["ok"] is True
+        assert entry["regressions"] == []
+        assert set(entry["values"]) == {b["check"] for b in BASELINES}

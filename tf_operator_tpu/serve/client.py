@@ -349,3 +349,38 @@ class DecodeClient:
             params["hz"] = str(int(hz))
         raw = self._request("/debug/profilez?" + urlencode(params))
         return json.loads(raw) if format == "json" else raw
+
+    def historyz(
+        self,
+        series: Optional[str] = None,
+        window: Optional[float] = None,
+        q: Optional[float] = None,
+        points: bool = False,
+    ) -> dict:
+        """The replica's metric-history page from /debug/historyz
+        (telemetry/history.py): per-series windowed summaries, plus
+        raw sample points when points=True and a series filter is
+        given."""
+        from urllib.parse import urlencode
+
+        params = {}
+        if series is not None:
+            params["series"] = series
+        if window is not None:
+            params["window"] = repr(float(window))
+        if q is not None:
+            params["q"] = repr(float(q))
+        if points:
+            params["points"] = "1"
+        path = "/debug/historyz"
+        if params:
+            path += "?" + urlencode(params)
+        return json.loads(self._request(path))
+
+    def alertz(self, firing: bool = False) -> dict:
+        """The replica's alert states from /debug/alertz
+        (telemetry/alerts.py): rules, instances, firing list."""
+        path = "/debug/alertz"
+        if firing:
+            path += "?firing=1"
+        return json.loads(self._request(path))

@@ -48,7 +48,7 @@ from ..api.types import (
     ServeService,
     ServeServiceSpec,
 )
-from ..chaos.faults import FAULT_CONN_RESET, FaultLog
+from ..chaos.faults import FAULT_CONN_RESET, FAULT_LATENCY, FaultLog
 from ..runtime.retry import RetryPolicy
 from ..telemetry.flight import default_flight
 from ..utils import locks
@@ -411,6 +411,79 @@ class FaultyClientFactory:
 
     def __call__(self, url: str) -> _FaultyClient:
         return _FaultyClient(
+            DecodeClient(
+                url, timeout=60.0,
+                retry_policy=RetryPolicy(max_attempts=1),
+            ),
+            self,
+        )
+
+
+class _SlowStream:
+    """Stream proxy that sleeps once before the first event — added
+    TTFT, not added ITL, so the burn-rate rule on the router's TTFT
+    series is what trips."""
+
+    def __init__(self, inner, delay_s: float) -> None:
+        self._inner = iter(inner)
+        self._delay_s = delay_s
+
+    def __iter__(self):
+        return self
+
+    def __next__(self):
+        if self._delay_s > 0:
+            time.sleep(self._delay_s)
+            self._delay_s = 0.0
+        return next(self._inner)
+
+
+class _SlowClient:
+    """DecodeClient proxy adding the factory's current pre-first-token
+    latency. Everything else passes straight through."""
+
+    def __init__(self, inner: DecodeClient, factory) -> None:
+        self._inner = inner
+        self._factory = factory
+
+    def __getattr__(self, name: str):
+        return getattr(self._inner, name)
+
+    def generate_stream(self, input_ids, max_new_tokens: int = 16, **kw):
+        delay = self._factory.draw(self._inner.base_url)
+        inner = self._inner.generate_stream(
+            input_ids, max_new_tokens, **kw
+        )
+        if delay <= 0:
+            return inner
+        return iter(_SlowStream(inner, delay))
+
+
+class LatencyClientFactory:
+    """Router client_factory injecting FAULT_LATENCY through the chaos
+    layer: while `delay_s` > 0, every generate_stream gains that much
+    TTFT and the injection is logged to the FaultLog (which forwards
+    to the flight recorder). The alert smoke flips delay_s on to push
+    the fleet out of SLO and back off to let it recover."""
+
+    def __init__(self, fault_log: Optional[FaultLog] = None) -> None:
+        self.delay_s = 0.0
+        self.fault_log = fault_log
+        self.injected = 0
+
+    def draw(self, url: str) -> float:
+        delay = self.delay_s
+        if delay > 0:
+            self.injected += 1
+            if self.fault_log is not None:
+                self.fault_log.append(
+                    "router.generate_stream", FAULT_LATENCY,
+                    f"{url} +{delay:.3f}s ttft",
+                )
+        return delay
+
+    def __call__(self, url: str) -> _SlowClient:
+        return _SlowClient(
             DecodeClient(
                 url, timeout=60.0,
                 retry_policy=RetryPolicy(max_attempts=1),
@@ -957,6 +1030,200 @@ def run_trace_smoke(
     return summary
 
 
+def run_alert_smoke(
+    seed: int = 0,
+    max_new: int = 8,
+    namespace: str = "alertz",
+    slo_s: float = 0.25,
+    delay_s: float = 0.4,
+) -> dict:
+    """End-to-end proof of the burn-rate alerting loop (CI step
+    `alert-smoke`): boot a 2-replica fleet, run baseline traffic
+    (nothing fires), inject FAULT_LATENCY through the chaos layer so
+    every TTFT blows the SLO (the fast burn window must fire), then
+    clear the fault and keep serving until the alert RESOLVES. The
+    firing->resolved transitions must exist as kind="alert" flight
+    records whose trace samples intersect the slowed requests' trace
+    ids. Raises AssertionError on any violation."""
+    import jax
+    import jax.numpy as jnp
+
+    from ..controller.serve import ServeServiceController
+    from ..models import gpt as gpt_lib
+    from ..runtime import InMemorySubstrate
+    from ..telemetry.alerts import AlertManager, BurnRateRule
+    from ..telemetry.history import MetricHistory
+
+    cfg = gpt_lib.GPT_TINY
+    params = gpt_lib.GPT(cfg).init(
+        jax.random.PRNGKey(0), jnp.zeros((1, 8), jnp.int32)
+    )["params"]
+
+    rng = random.Random(seed)
+    flight = default_flight()
+    fault_log = FaultLog(flight=flight, seed=seed)
+    factory = LatencyClientFactory(fault_log=fault_log)
+    substrate = InMemorySubstrate()
+    router = LeastLoadedRouter(client_factory=factory, retry_wait=0.02)
+    fleet = InProcessFleet(
+        substrate, router, cfg, {"v1": params}, slots=2,
+        namespace=namespace, fault_log=fault_log,
+    )
+    controller = ServeServiceController(
+        substrate, namespace=namespace,
+        weight_update=fleet.update_weights,
+    )
+    svc = ServeService(
+        spec=ServeServiceSpec(
+            replicas=2, preset="tiny", slots=2, weights_version="v1",
+        )
+    )
+    svc.metadata.name = "alertz"
+    svc.metadata.namespace = namespace
+
+    # smoke-scaled burn windows: same rule shape production uses
+    # (serve_replica_rules / fleet_rules), just seconds instead of
+    # minutes so the whole fire->resolve arc fits in a CI step
+    series = "tf_operator_tpu_router_ttft_seconds"
+    fast_key, slow_key = "ttft-slo[2s]", "ttft-slo[6s]"
+    history = MetricHistory(capacity=1024)
+    history.track_registry(router.registry)
+    manager = AlertManager(
+        history,
+        [
+            BurnRateRule(
+                "ttft-slo", series, threshold_s=slo_s,
+                windows=((2.0, 2.0), (6.0, 1.5)),
+            ),
+        ],
+        registry=router.registry,
+        flight=flight,
+    )
+
+    def drive(corr: str) -> Optional[str]:
+        prompt = [
+            rng.randrange(1, cfg.vocab_size)
+            for _ in range(rng.randint(2, 5))
+        ]
+        final = None
+        for event in router.generate_stream(
+            prompt, max_new, corr=corr, timeout=120.0,
+        ):
+            if event.get("done"):
+                final = event
+        history.tick()
+        manager.evaluate()
+        return final.get("trace_id") if final else None
+
+    started = time.monotonic()
+    fired_during_baseline: List[str] = []
+    slow_traces: List[str] = []
+    fired: List[str] = []
+    resolved = False
+    try:
+        substrate.create_serve_service(svc)
+        controller.run_until_quiet()
+        fleet.sync()
+        fleet.wait_ready(2)
+
+        # phase 1 — baseline: in-SLO traffic, nothing may fire
+        for i in range(6):
+            drive(f"alert-base-{seed}-{i}")
+        fired_during_baseline = list(manager.firing())
+
+        # phase 2 — chaos: every request +delay_s TTFT until the fast
+        # window fires (bounded; each request costs ~delay_s wall)
+        factory.delay_s = delay_s
+        deadline = time.monotonic() + 30.0
+        i = 0
+        while time.monotonic() < deadline:
+            trace = drive(f"alert-slow-{seed}-{i}")
+            if trace:
+                slow_traces.append(trace)
+            i += 1
+            if fast_key in manager.firing():
+                break
+        fired = list(manager.firing())
+
+        # phase 3 — recovery: fault off, healthy traffic until both
+        # windows drain and every instance resolves
+        factory.delay_s = 0.0
+        deadline = time.monotonic() + 45.0
+        i = 0
+        while time.monotonic() < deadline:
+            drive(f"alert-heal-{seed}-{i}")
+            i += 1
+            if not manager.firing():
+                resolved = True
+                break
+            time.sleep(0.1)
+    finally:
+        fleet.stop()
+        controller.stop()
+
+    problems: List[str] = []
+    if fired_during_baseline:
+        problems.append(
+            f"alerts fired on baseline traffic: {fired_during_baseline}"
+        )
+    if fast_key not in fired:
+        problems.append(
+            f"fast burn window never fired under chaos (firing={fired})"
+        )
+    if not resolved:
+        problems.append(
+            f"alert did not resolve after fault cleared "
+            f"(still firing: {manager.firing()})"
+        )
+    if factory.injected < 1:
+        problems.append("chaos layer injected no latency faults")
+    if fault_log.counts().get(FAULT_LATENCY, 0) < 1:
+        problems.append("no FAULT_LATENCY records in the fault log")
+
+    # the alert flight records: at least one firing and one resolved
+    # transition, trace-correlated with the requests that burned the
+    # budget
+    alert_records = [r.to_dict() for r in flight.snapshot(kind="alert")]
+    states = {}
+    for rec in alert_records:
+        states.setdefault(rec["fields"].get("state"), []).append(rec)
+    if not states.get("firing"):
+        problems.append("no firing alert flight records")
+    if not states.get("resolved"):
+        problems.append("no resolved alert flight records")
+    sampled = {
+        t
+        for rec in alert_records
+        for t in str(rec["fields"].get("traces", "")).split(",")
+        if t
+    }
+    if not sampled & set(slow_traces):
+        problems.append(
+            f"alert trace samples {sorted(sampled)[:4]} do not "
+            f"intersect the slowed requests {slow_traces[:4]}"
+        )
+
+    summary = {
+        "seed": seed,
+        "fired": fired,
+        "fast_window": fast_key,
+        "slow_window": slow_key,
+        "slow_window_fired": slow_key in fired,
+        "resolved": resolved,
+        "latency_faults": fault_log.counts().get(FAULT_LATENCY, 0),
+        "slow_traces": slow_traces,
+        "alert_records": len(alert_records),
+        "problems": problems,
+        "seconds": round(time.monotonic() - started, 2),
+        "ok": not problems,
+    }
+    if not summary["ok"]:
+        raise AssertionError(
+            f"alert smoke failed: {json.dumps(summary)}"
+        )
+    return summary
+
+
 def main(argv: Optional[List[str]] = None) -> int:
     parser = argparse.ArgumentParser(
         description="ServeService fleet soaks (failover / disagg)"
@@ -973,6 +1240,12 @@ def main(argv: Optional[List[str]] = None) -> int:
         help="distributed-tracing smoke: disagg fleet, migrated "
         "request, merged /debug/tracez timeline with all 8 hops",
     )
+    mode.add_argument(
+        "--alert-smoke", action="store_true",
+        help="burn-rate alerting smoke: chaos latency pushes TTFT out "
+        "of SLO, the fast burn window fires, the fault clears, the "
+        "alert resolves — with trace-correlated alert flight records",
+    )
     parser.add_argument("--seed", type=int, default=0)
     parser.add_argument("--replicas", type=int, default=3)
     parser.add_argument("--streams", type=int, default=6)
@@ -987,6 +1260,8 @@ def main(argv: Optional[List[str]] = None) -> int:
         )
     elif args.trace_smoke:
         summary = run_trace_smoke(seed=args.seed, max_new=args.max_new)
+    elif args.alert_smoke:
+        summary = run_alert_smoke(seed=args.seed, max_new=args.max_new)
     else:
         summary = run_failover_soak(
             seed=args.seed, replicas=args.replicas, streams=args.streams,

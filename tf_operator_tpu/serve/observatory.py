@@ -32,16 +32,24 @@ Stdlib-only, like serve/server.py.
 from __future__ import annotations
 
 import json
+import threading
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Dict, List, Optional, Tuple
 from urllib.parse import parse_qs, urlparse
 
-from ..telemetry.collector import collect_trace
+from ..telemetry.alerts import AlertManager, fleet_rules, render_alertz
+from ..telemetry.collector import ClockCache, collect_trace
 from ..telemetry.exposition import bucket_pairs
 from ..telemetry.flight import default_flight
+from ..telemetry.history import MetricHistory, render_historyz
 from ..telemetry.registry import histogram_quantile
 
-__all__ = ["fleet_slo", "router_trace", "make_observatory"]
+__all__ = [
+    "fleet_slo",
+    "router_trace",
+    "make_observatory",
+    "observatory_tick",
+]
 
 _SERVE = "tf_operator_tpu_serve_"
 # replica histogram families merged fleet-wide (engine.py registers
@@ -108,11 +116,18 @@ def _exact_quantiles(samples: List[float]) -> Dict[str, Optional[float]]:
     return {"p50": pick(0.50), "p95": pick(0.95)}
 
 
-def fleet_slo(router) -> dict:
+def fleet_slo(router, history=None, alerts=None) -> dict:
     """Scrape every replica once, sum histogram buckets fleet-wide,
     and return the SLO snapshot. Side effect: refreshes the fleet_*
     gauges on router.registry so a plain Prometheus scrape of the
-    observatory's /metrics sees the same numbers."""
+    observatory's /metrics sees the same numbers.
+
+    With `history`, the fleet-summed cumulative buckets and gauges are
+    also pushed into the MetricHistory ring (fleet_ttft_seconds etc. —
+    the series fleet_rules() watch). With `alerts`, the AlertManager is
+    evaluated against that history after ingestion; a scrape that
+    missed any replica marks the sample `partial`, which holds firing
+    alerts instead of resolving them on missing data."""
     merged: Dict[str, Dict[float, float]] = {
         key: {} for key in _FLEET_FAMILIES
     }
@@ -189,14 +204,38 @@ def fleet_slo(router) -> dict:
     )
     for hop, value in hops_p95.items():
         g.labels(hop=hop).set(value or 0.0)
+    partial = bool(unreachable)
+    reg.gauge(
+        "fleet_scrape_errors",
+        "Replicas that failed the last fleet_slo scrape",
+    ).set(float(len(unreachable)))
 
-    return {
+    if history is not None:
+        # cumulative fleet-summed buckets: edge-diffing two scrapes in
+        # history.bucket_delta() recovers the per-window distribution,
+        # so burn-rate math over fleet_ttft_seconds stays exact
+        history.ingest_histogram(
+            "fleet_ttft_seconds", sorted(merged["ttft"].items())
+        )
+        history.ingest_histogram(
+            "fleet_itl_seconds", sorted(merged["itl"].items())
+        )
+        history.ingest_value("fleet_queue_depth", "gauge", queue_depth)
+        history.ingest_value("fleet_kv_blocks_in_use", "gauge", kv_in_use)
+        history.ingest_value("fleet_kv_blocks_total", "gauge", kv_total)
+        history.ingest_value(
+            "fleet_scrape_errors", "gauge", float(len(unreachable))
+        )
+
+    report = {
         "fleet": {
             **fleet,
             "queue_depth": queue_depth,
             "kv_occupancy": round(kv_occupancy, 6),
             "replicas_scraped": len(clients) - len(unreachable),
             "unreachable": unreachable,
+            "scrape_errors": len(unreachable),
+            "partial": partial,
         },
         "router": {
             **router_slo,
@@ -206,12 +245,26 @@ def fleet_slo(router) -> dict:
         },
         "hops_p95": hops_p95,
     }
+    if alerts is not None:
+        alerts.evaluate(partial=partial)
+        report["alerts"] = {
+            "firing": alerts.firing(),
+            "partial": partial,
+        }
+    return report
 
 
-def router_trace(router, trace_id: str, handshake_samples: int = 3) -> dict:
+def router_trace(
+    router,
+    trace_id: str,
+    handshake_samples: int = 3,
+    clock_cache: Optional[ClockCache] = None,
+) -> dict:
     """collect_trace() anchored at this router: its own flight ring
     supplies the local (exact-clock) records, its replica clients the
-    remote fetches."""
+    remote fetches. A shared ClockCache keeps per-replica clock
+    offsets warm across calls, so repeated tracez queries skip the
+    handshake until the TTL lapses or the observed RTT degrades."""
     fl = router._flight if router._flight is not None else default_flight()
     local = [r.to_dict() for r in fl.snapshot()]
     return collect_trace(
@@ -220,15 +273,50 @@ def router_trace(router, trace_id: str, handshake_samples: int = 3) -> dict:
         local_records=local,
         local_name="router",
         handshake_samples=handshake_samples,
+        clock_cache=clock_cache,
     )
 
 
+def observatory_tick(router, history, alerts) -> dict:
+    """One observatory cadence step: scrape the fleet into history,
+    snapshot any tracked sources, evaluate alert rules. Returns the
+    fleet_slo report (with the alerts summary folded in)."""
+    report = fleet_slo(router, history=history, alerts=alerts)
+    history.tick()
+    return report
+
+
 def make_observatory(
-    router, host: str = "127.0.0.1", port: int = 0
+    router,
+    host: str = "127.0.0.1",
+    port: int = 0,
+    history: Optional[MetricHistory] = None,
+    alerts: Optional[AlertManager] = None,
+    history_capacity: int = 512,
+    interval_s: float = 0.0,
 ) -> ThreadingHTTPServer:
     """In-process observatory server over `router`; caller owns
     serve_forever/shutdown (same contract as serve/server.py
-    make_server). GET-only by design — the observatory observes."""
+    make_server). GET-only by design — the observatory observes.
+
+    The server carries a fleet-level MetricHistory + AlertManager
+    (fleet_rules) and a ClockCache shared across tracez fetches; when
+    interval_s > 0 a daemon ticker drives observatory_tick() so the
+    burn-rate windows fill without anyone polling /debug/slozz."""
+    if history is None:
+        history = MetricHistory(capacity=history_capacity)
+    if alerts is None:
+        alerts = AlertManager(
+            history,
+            fleet_rules(),
+            registry=router.registry,
+            flight=(
+                router._flight
+                if router._flight is not None
+                else default_flight()
+            ),
+        )
+    clock_cache = ClockCache()
 
     class Handler(BaseHTTPRequestHandler):
         protocol_version = "HTTP/1.1"
@@ -256,7 +344,23 @@ def make_observatory(
             elif parsed.path == "/debug/routez":
                 self._reply_json(200, router.stats())
             elif parsed.path == "/debug/slozz":
-                self._reply_json(200, fleet_slo(router))
+                self._reply_json(
+                    200, fleet_slo(router, history=history, alerts=alerts)
+                )
+            elif parsed.path == "/debug/historyz":
+                raw = render_historyz(history, parsed.query)
+                self.send_response(200)
+                self.send_header("Content-Type", "application/json")
+                self.send_header("Content-Length", str(len(raw)))
+                self.end_headers()
+                self.wfile.write(raw)
+            elif parsed.path == "/debug/alertz":
+                raw = render_alertz(alerts, parsed.query)
+                self.send_response(200)
+                self.send_header("Content-Type", "application/json")
+                self.send_header("Content-Length", str(len(raw)))
+                self.end_headers()
+                self.wfile.write(raw)
             elif parsed.path == "/debug/tracez":
                 query = parse_qs(parsed.query)
                 trace = (query.get("trace") or [None])[0]
@@ -265,11 +369,44 @@ def make_observatory(
                         400, {"error": "missing ?trace=<trace id>"}
                     )
                     return
-                self._reply_json(200, router_trace(router, trace))
+                self._reply_json(
+                    200,
+                    router_trace(router, trace, clock_cache=clock_cache),
+                )
             else:
                 self._reply_json(404, {"error": f"no route {parsed.path}"})
 
         def log_message(self, *args) -> None:
             pass
 
-    return ThreadingHTTPServer((host, port), Handler)
+    class ObservatoryServer(ThreadingHTTPServer):
+        def server_close(self) -> None:
+            stop = getattr(self, "_tick_stop", None)
+            if stop is not None:
+                stop.set()
+                thread = getattr(self, "_tick_thread", None)
+                if thread is not None:
+                    thread.join(timeout=2.0)
+            super().server_close()
+
+    server = ObservatoryServer((host, port), Handler)
+    server.history = history  # type: ignore[attr-defined]
+    server.alerts = alerts  # type: ignore[attr-defined]
+    server.clock_cache = clock_cache  # type: ignore[attr-defined]
+    if interval_s > 0:
+        stop = threading.Event()
+
+        def _ticker() -> None:
+            while not stop.wait(interval_s):
+                try:
+                    observatory_tick(router, history, alerts)
+                except Exception:
+                    pass
+
+        thread = threading.Thread(
+            target=_ticker, name="observatory-tick", daemon=True
+        )
+        thread.start()
+        server._tick_stop = stop  # type: ignore[attr-defined]
+        server._tick_thread = thread  # type: ignore[attr-defined]
+    return server

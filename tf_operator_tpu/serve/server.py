@@ -163,6 +163,12 @@ class _State:
         self.lock = locks.make_lock("_State.lock")
         self.batcher = None  # set by make_server (batching="window")
         self.engine = None  # set by make_server (batching="continuous")
+        # metric history + alert manager (telemetry/history.py,
+        # telemetry/alerts.py), wired by make_server so the capacity /
+        # rule knobs stay construction params; served at
+        # /debug/historyz and /debug/alertz
+        self.history = None
+        self.alerts = None
         # opt-in debug surface (make_server enable_debug_endpoints /
         # --enable-debug-endpoints): /debug/profilez samples live
         # thread stacks, the same sensitivity class as the operator's
@@ -568,6 +574,41 @@ def DecodeHandlerFactory(state: _State):
                 )
                 self.send_response(200)
                 self.send_header("Content-Type", "application/x-ndjson")
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+            elif self.path.partition("?")[0] == "/debug/historyz":
+                # windowed metric history (telemetry/history.py):
+                # ?series= / ?window= / ?q= / ?points=1. Like flightz
+                # it holds series shapes, not payloads — ungated.
+                if state.history is None:
+                    return self._reply(
+                        404, {"error": "history not enabled"}
+                    )
+                from ..telemetry import render_historyz
+
+                body = render_historyz(
+                    state.history, self.path.partition("?")[2]
+                )
+                self.send_response(200)
+                self.send_header("Content-Type", "application/json")
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+            elif self.path.partition("?")[0] == "/debug/alertz":
+                # alert rule states (telemetry/alerts.py): ?firing=1
+                # keeps only the instances currently firing
+                if state.alerts is None:
+                    return self._reply(
+                        404, {"error": "alerts not enabled"}
+                    )
+                from ..telemetry import render_alertz
+
+                body = render_alertz(
+                    state.alerts, self.path.partition("?")[2]
+                )
+                self.send_response(200)
+                self.send_header("Content-Type", "application/json")
                 self.send_header("Content-Length", str(len(body)))
                 self.end_headers()
                 self.wfile.write(body)
@@ -1097,6 +1138,17 @@ class DecodeHTTPServer(ThreadingHTTPServer):
         self._conn_lock = locks.make_lock("DecodeHTTPServer._conn_lock")
         self._conns: set = set()
 
+    def server_close(self):
+        # stop the history/alert cadence threads with the listener so
+        # an embedder's shutdown sequence leaves no ticker behind
+        state = getattr(self, "state", None)
+        if state is not None:
+            if getattr(state, "alerts", None) is not None:
+                state.alerts.stop()
+            if getattr(state, "history", None) is not None:
+                state.history.stop()
+        super().server_close()
+
     def process_request(self, request, client_address):
         with self._conn_lock:
             self._conns.add(request)
@@ -1166,6 +1218,11 @@ def make_server(
     prefill_chunk: int = 64,
     enable_debug_endpoints: bool = False,
     role: str = "",
+    history_capacity: int = 512,
+    history_interval_s: float = 0.0,
+    alerts: bool = True,
+    alert_rules=None,
+    ttft_slo_s: float = 0.25,
 ) -> ThreadingHTTPServer:
     """In-process server (tests and embedders); caller owns
     serve_forever/shutdown. The CLI binds 0.0.0.0 (pods must be
@@ -1293,6 +1350,32 @@ def make_server(
         role=role,
     )
     state.enable_debug = bool(enable_debug_endpoints)
+    # metric history: every registry family plus the engine's flat
+    # metrics dict, snapshotted per tick (telemetry/history.py). The
+    # flat provider reads state.engine at call time, so it picks the
+    # engine up whenever make_server (or an async warmup) installs it.
+    from ..telemetry import AlertManager, MetricHistory, serve_replica_rules
+
+    state.history = MetricHistory(capacity=history_capacity)
+    state.history.track_registry(state.registry)
+    state.history.track_flat(
+        lambda: state.engine.metrics() if state.engine is not None else {}
+    )
+    if alerts:
+        state.alerts = AlertManager(
+            state.history,
+            alert_rules if alert_rules is not None
+            else serve_replica_rules(
+                prefix="tf_operator_tpu_serve", ttft_slo_s=ttft_slo_s
+            ),
+            registry=state.registry,
+            flight=default_flight(),
+        )
+    if history_interval_s > 0:
+        if state.alerts is not None:
+            state.alerts.start(history_interval_s)
+        else:
+            state.history.start(history_interval_s)
     if batching == "window":
         from .batching import DynamicBatcher
 
@@ -1621,6 +1704,31 @@ def main(argv=None) -> int:
         "/debug/threads",
     )
     parser.add_argument(
+        "--history-interval", type=float, default=5.0,
+        help="seconds between metric-history samples (telemetry/"
+        "history.py): every registry family and engine counter is "
+        "ring-buffered for the windowed queries /debug/historyz and "
+        "the alert rules evaluate (0 disables the background cadence; "
+        "the endpoints still answer with whatever was sampled)",
+    )
+    parser.add_argument(
+        "--history-capacity", type=int, default=512,
+        help="samples kept per history series (the ring bound; 512 "
+        "slots at the default 5s cadence remembers ~42 minutes)",
+    )
+    parser.add_argument(
+        "--alerts", choices=["on", "off"], default="on",
+        help="evaluate the serve alert rule set (telemetry/alerts.py: "
+        "TTFT burn rate, queue depth, kv occupancy, pool-audit "
+        "failures) against the history each sample; states at "
+        "/debug/alertz, transitions flight-recorded kind=alert",
+    )
+    parser.add_argument(
+        "--ttft-slo-ms", type=float, default=250.0,
+        help="the TTFT objective the burn-rate rule guards (95%% of "
+        "first tokens under this; must sit on a TTFT bucket edge)",
+    )
+    parser.add_argument(
         "--smoke", action="store_true",
         help="self-contained telemetry smoke: boot a tiny continuous-"
         "batching server, drive two requests, validate the /metrics "
@@ -1826,6 +1934,10 @@ def main(argv=None) -> int:
         kv_blocks=args.kv_blocks, prefill_chunk=args.prefill_chunk,
         enable_debug_endpoints=args.enable_debug_endpoints,
         role=args.role,
+        history_capacity=max(2, args.history_capacity),
+        history_interval_s=max(0.0, args.history_interval),
+        alerts=args.alerts == "on",
+        ttft_slo_s=args.ttft_slo_ms / 1000.0,
     )
     logger.info("decode server on :%d", server.server_address[1])
     # graceful drain — the serving sibling of the training-side

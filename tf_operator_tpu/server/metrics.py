@@ -25,12 +25,17 @@ from typing import Dict, Optional, Tuple
 from ..telemetry import (
     LATENCY_BUCKETS,
     WORKQUEUE_BUCKETS,
+    AlertManager,
     FlightRecorder,
+    MetricHistory,
     MetricRegistry,
     SpanTracer,
     default_flight,
     default_profiler,
+    operator_rules,
+    render_alertz,
     render_flightz,
+    render_historyz,
     render_profilez,
 )
 
@@ -163,6 +168,12 @@ class OperatorMetrics:
             buckets=LATENCY_BUCKETS,
         )
         self._workqueues: Dict[str, WorkqueueMetrics] = {}
+        # time-series ring + alert rules: opt-in (enable_history /
+        # enable_alerts) so embedders that only want counters pay
+        # nothing; the monitoring server exposes them at
+        # /debug/historyz and /debug/alertz when debug is enabled
+        self.history: Optional[MetricHistory] = None
+        self.alerts: Optional[AlertManager] = None
         # job-lifecycle spans: observed -> pods-created -> running ->
         # terminal, keyed by "namespace/name"
         self._span_lock = threading.Lock()
@@ -228,6 +239,46 @@ class OperatorMetrics:
             wq = WorkqueueMetrics(self.registry, name)
             self._workqueues[name] = wq
         return wq
+
+    # -- history / alerts ----------------------------------------------------
+
+    def enable_history(
+        self, capacity: int = 512, clock=None
+    ) -> MetricHistory:
+        """Get-or-create the operator's time-series ring, tracking
+        every family in this registry (leader transitions, workqueue
+        depth, reconcile histograms, ...)."""
+        if self.history is None:
+            self.history = MetricHistory(capacity=capacity, clock=clock)
+            self.history.track_registry(self.registry)
+        return self.history
+
+    def enable_alerts(self, rules=None, clock=None) -> AlertManager:
+        """Get-or-create the operator AlertManager over the history
+        ring (default rules: leader churn, fence rejections, degraded
+        latch, workqueue depth — telemetry/alerts.py operator_rules)."""
+        history = self.enable_history(clock=clock)
+        if self.alerts is None:
+            self.alerts = AlertManager(
+                history,
+                rules if rules is not None
+                else operator_rules(prefix=self.prefix),
+                registry=self.registry,
+                clock=clock,
+                flight=self.flight,
+            )
+        return self.alerts
+
+    def track_fence_rejections(self, substrate) -> None:
+        """Feed substrate.fence_rejections (a plain list, not a
+        metric) into history as fence_rejections_total so the
+        fence-rejections alert rule has a series to watch."""
+        history = self.enable_history()
+        history.track_provider(
+            "fence_rejections_total",
+            "counter",
+            lambda: float(len(substrate.fence_rejections)),
+        )
 
     # -- job-lifecycle spans -----------------------------------------------
 
@@ -368,6 +419,26 @@ class MonitoringServer:
                     )
                     self.send_response(200)
                     self.send_header("Content-Type", ctype)
+                elif (
+                    path == "/debug/historyz"
+                    and server.enable_debug
+                    and metrics.history is not None
+                ):
+                    # windowed time-series queries over the operator's
+                    # history ring (telemetry/history.py): ?series= /
+                    # ?window= / ?q= / ?points=1
+                    body = render_historyz(metrics.history, query)
+                    self.send_response(200)
+                    self.send_header("Content-Type", "application/json")
+                elif (
+                    path == "/debug/alertz"
+                    and server.enable_debug
+                    and metrics.alerts is not None
+                ):
+                    # alert rule/instance states; ?firing=1 filters
+                    body = render_alertz(metrics.alerts, query)
+                    self.send_response(200)
+                    self.send_header("Content-Type", "application/json")
                 elif self.path == "/metrics":
                     body = metrics.render().encode()
                     self.send_response(200)

@@ -45,6 +45,15 @@ from .registry import (
     format_value,
     histogram_quantile,
 )
+from .alerts import (
+    AlertManager,
+    BurnRateRule,
+    ThresholdRule,
+    fleet_rules,
+    operator_rules,
+    render_alertz,
+    serve_replica_rules,
+)
 from .flight import (
     FlightRecord,
     FlightRecorder,
@@ -56,6 +65,7 @@ from .flight import (
     render_flightz,
     set_default_flight,
 )
+from .history import MetricHistory, render_historyz
 from .profiler import (
     ProfileSample,
     SamplingProfiler,
@@ -111,6 +121,15 @@ __all__ = [
     "bucket_pairs",
     "quantile_from_flat",
     "ExpositionError",
+    "MetricHistory",
+    "render_historyz",
+    "AlertManager",
+    "BurnRateRule",
+    "ThresholdRule",
+    "serve_replica_rules",
+    "operator_rules",
+    "fleet_rules",
+    "render_alertz",
     "LATENCY_BUCKETS",
     "FAST_BUCKETS",
     "TTFT_BUCKETS",

@@ -38,6 +38,17 @@ exports the merged cross-process Perfetto timeline:
         http://127.0.0.1:8443 http://127.0.0.1:8444 --perfetto t.json
     python -m tf_operator_tpu.telemetry tracez --trace <id> \
         --observatory http://127.0.0.1:9090
+
+The `historyz` and `alertz` subcommands fan the matching /debug/
+pages out fleet-wide (collector.collect_history / collect_alerts) or
+ask a running observatory for its fleet-level ring; `alertz` exits 3
+when anything is firing, so it scripts as a health probe:
+
+    python -m tf_operator_tpu.telemetry historyz \
+        http://127.0.0.1:8443 --series tf_operator_tpu_serve_ttft \
+        --window 300 --q 0.95
+    python -m tf_operator_tpu.telemetry alertz \
+        --observatory http://127.0.0.1:9090 --firing
 """
 
 from __future__ import annotations
@@ -354,6 +365,181 @@ def tracez_main(argv) -> int:
     return 0
 
 
+def historyz_main(argv) -> int:
+    """Fleet history fan-out (`historyz` subcommand): fan
+    /debug/historyz out to replica URLs (collector.collect_history)
+    or fetch one page from a running observatory, and print windowed
+    rates/quantiles per replica."""
+    parser = argparse.ArgumentParser(
+        prog="python -m tf_operator_tpu.telemetry historyz",
+        description="Query the telemetry history rings fleet-wide "
+        "(telemetry/history.py).",
+    )
+    parser.add_argument(
+        "replicas", nargs="*", metavar="URL",
+        help="replica base URLs to fan out to directly",
+    )
+    parser.add_argument(
+        "--observatory", metavar="URL",
+        help="fetch the fleet-level ring from a router observatory's "
+        "/debug/historyz instead of fanning out from here",
+    )
+    parser.add_argument(
+        "--series", help="series name or prefix filter",
+    )
+    parser.add_argument(
+        "--window", type=float, default=300.0,
+        help="query window in seconds (default 300)",
+    )
+    parser.add_argument(
+        "--q", type=float, help="add this quantile for histogram series",
+    )
+    parser.add_argument(
+        "--json", action="store_true", help="dump the raw JSON page",
+    )
+    args = parser.parse_args(argv)
+    if bool(args.observatory) == bool(args.replicas):
+        print(
+            "error: give replica URLs or --observatory, not both/neither",
+            file=sys.stderr,
+        )
+        return 2
+
+    if args.observatory:
+        import urllib.parse
+        import urllib.request
+
+        params = {"window": args.window}
+        if args.series:
+            params["series"] = args.series
+        if args.q is not None:
+            params["q"] = args.q
+        url = (
+            args.observatory.rstrip("/")
+            + "/debug/historyz?"
+            + urllib.parse.urlencode(params)
+        )
+        try:
+            with urllib.request.urlopen(url, timeout=60) as resp:
+                inner = json.loads(resp.read())
+        except OSError as e:
+            print(f"error: {url}: {e}", file=sys.stderr)
+            return 1
+        page = {
+            "replicas": {"observatory": inner},
+            "scrape_errors": {},
+            "partial": False,
+        }
+    else:
+        from ..serve.client import DecodeClient
+        from .collector import collect_history
+
+        clients = {u: DecodeClient(u) for u in args.replicas}
+        page = collect_history(
+            clients, series=args.series, window_s=args.window, q=args.q
+        )
+
+    if args.json:
+        print(json.dumps(page, indent=1))
+    else:
+        for name, doc in sorted(page["replicas"].items()):
+            print(
+                f"# {name}: {len(doc.get('series', []))} series, "
+                f"{doc.get('ticks', 0)} ticks, window {args.window:g}s"
+            )
+            for row in doc.get("series", []):
+                cells = [
+                    f"{k}={row[k]}" for k in sorted(row)
+                    if k not in ("series", "kind") and row[k] is not None
+                ]
+                print(f"  {row['series']:<50} [{row['kind']}] "
+                      + " ".join(cells))
+        for name, err in sorted(page["scrape_errors"].items()):
+            print(f"# {name}: SCRAPE FAILED: {err}", file=sys.stderr)
+    return 1 if page["partial"] else 0
+
+
+def alertz_main(argv) -> int:
+    """Fleet alert fan-out (`alertz` subcommand): merge every
+    replica's /debug/alertz into one page (collector.collect_alerts)
+    or fetch one from a running observatory."""
+    parser = argparse.ArgumentParser(
+        prog="python -m tf_operator_tpu.telemetry alertz",
+        description="Collect alert rule states fleet-wide "
+        "(telemetry/alerts.py).",
+    )
+    parser.add_argument(
+        "replicas", nargs="*", metavar="URL",
+        help="replica base URLs to fan out to directly",
+    )
+    parser.add_argument(
+        "--observatory", metavar="URL",
+        help="fetch the fleet-level alert page from a router "
+        "observatory's /debug/alertz instead of fanning out",
+    )
+    parser.add_argument(
+        "--firing", action="store_true",
+        help="show only instances currently firing",
+    )
+    parser.add_argument(
+        "--json", action="store_true", help="dump the raw JSON page",
+    )
+    args = parser.parse_args(argv)
+    if bool(args.observatory) == bool(args.replicas):
+        print(
+            "error: give replica URLs or --observatory, not both/neither",
+            file=sys.stderr,
+        )
+        return 2
+
+    if args.observatory:
+        import urllib.request
+
+        url = args.observatory.rstrip("/") + "/debug/alertz"
+        if args.firing:
+            url += "?firing=1"
+        try:
+            with urllib.request.urlopen(url, timeout=60) as resp:
+                inner = json.loads(resp.read())
+        except OSError as e:
+            print(f"error: {url}: {e}", file=sys.stderr)
+            return 1
+        page = {
+            "replicas": {"observatory": inner},
+            "firing": inner.get("firing", []),
+            "scrape_errors": {},
+            "partial": False,
+        }
+    else:
+        from ..serve.client import DecodeClient
+        from .collector import collect_alerts
+
+        clients = {u: DecodeClient(u) for u in args.replicas}
+        page = collect_alerts(clients)
+
+    if args.json:
+        print(json.dumps(page, indent=1))
+    else:
+        print(
+            f"# firing fleet-wide: "
+            f"{', '.join(page['firing']) if page['firing'] else '(none)'}"
+        )
+        for name, doc in sorted(page["replicas"].items()):
+            for inst in doc.get("instances", []):
+                if args.firing and inst["state"] != "firing":
+                    continue
+                print(
+                    f"  {name:<28} {inst['instance']:<28} "
+                    f"{inst['state']:<9} value={inst['value']} "
+                    f"fire>{inst['fire_above']}"
+                )
+        for name, err in sorted(page["scrape_errors"].items()):
+            print(f"# {name}: SCRAPE FAILED: {err}", file=sys.stderr)
+    if page["firing"]:
+        return 3  # distinct from scrape failure: alerts ARE firing
+    return 1 if page["partial"] else 0
+
+
 def main(argv=None) -> int:
     argv = list(sys.argv[1:] if argv is None else argv)
     if argv and argv[0] == "profile":
@@ -362,6 +548,10 @@ def main(argv=None) -> int:
         return profile_main(argv[1:])
     if argv and argv[0] == "tracez":
         return tracez_main(argv[1:])
+    if argv and argv[0] == "historyz":
+        return historyz_main(argv[1:])
+    if argv and argv[0] == "alertz":
+        return alertz_main(argv[1:])
     parser = argparse.ArgumentParser(
         prog="python -m tf_operator_tpu.telemetry",
         description="Merge and inspect flight-recorder JSONL dumps.",

@@ -53,7 +53,10 @@ from typing import Dict, List, NamedTuple, Optional, Tuple
 
 __all__ = [
     "clock_offset",
+    "ClockCache",
     "collect_trace",
+    "collect_history",
+    "collect_alerts",
     "hop_breakdown",
     "KNOWN_OPS",
     "HOP_NAMES",
@@ -112,6 +115,84 @@ def clock_offset(client, samples: int = 3) -> ClockMap:
         if best is None or cm.rtt < best.rtt:
             best = cm
     return best
+
+
+class ClockCache:
+    """Per-replica ClockMap cache with a TTL and RTT-degrade
+    invalidation.
+
+    The handshake costs `samples` /debug/clockz round-trips per
+    replica; a tracez invocation over an N-replica fleet used to pay
+    N*samples of them EVERY call even though a process's monotonic
+    offset only changes on restart. The cache keeps each replica's
+    min-RTT handshake until it goes stale (ttl_s) — or until the
+    network it was measured on visibly degrades: callers report each
+    later fetch's round-trip through observe_rtt(), and a fetch
+    taking far longer than the cached handshake's RTT (degrade_factor
+    x, past an absolute floor) means the cached offset error bound no
+    longer holds, so the entry is dropped and the next get()
+    re-handshakes."""
+
+    def __init__(
+        self,
+        ttl_s: float = 30.0,
+        samples: int = 3,
+        degrade_factor: float = 3.0,
+        degrade_floor_s: float = 0.05,
+        clock=time.monotonic,
+    ) -> None:
+        self.ttl_s = float(ttl_s)
+        self.samples = int(samples)
+        self.degrade_factor = float(degrade_factor)
+        self.degrade_floor_s = float(degrade_floor_s)
+        self._clock = clock
+        # name -> (ClockMap, acquired_at)
+        self._entries: Dict[str, Tuple[ClockMap, float]] = {}
+        self.hits = 0
+        self.misses = 0
+        self.invalidations = 0
+
+    def get(self, name: str, client) -> ClockMap:
+        """The replica's ClockMap: cached when fresh, re-handshaken
+        when absent or stale."""
+        now = self._clock()
+        entry = self._entries.get(name)
+        if entry is not None and now - entry[1] < self.ttl_s:
+            self.hits += 1
+            return entry[0]
+        self.misses += 1
+        cm = clock_offset(client, samples=self.samples)
+        self._entries[name] = (cm, self._clock())
+        return cm
+
+    def observe_rtt(self, name: str, rtt_s: float) -> None:
+        """Report a non-handshake round-trip to `name`. A fetch far
+        slower than the cached handshake suggests the offset error
+        bound (RTT/2) no longer describes the path; invalidate so the
+        next get() re-measures."""
+        entry = self._entries.get(name)
+        if entry is None:
+            return
+        bound = max(
+            self.degrade_factor * entry[0].rtt, self.degrade_floor_s
+        )
+        if rtt_s > bound:
+            del self._entries[name]
+            self.invalidations += 1
+
+    def invalidate(self, name: Optional[str] = None) -> None:
+        if name is None:
+            self._entries.clear()
+        else:
+            self._entries.pop(name, None)
+
+    def stats(self) -> Dict[str, int]:
+        return {
+            "entries": len(self._entries),
+            "hits": self.hits,
+            "misses": self.misses,
+            "invalidations": self.invalidations,
+        }
 
 
 def _dedupe(records: List[dict]) -> List[dict]:
@@ -296,12 +377,18 @@ def collect_trace(
     local_records: Optional[List[dict]] = None,
     local_name: str = "router",
     handshake_samples: int = 3,
+    clock_cache: Optional[ClockCache] = None,
 ) -> dict:
     """Fan out to every replica, merge, decompose. `replicas` maps
     name -> client (DecodeClient API: clockz(), flightz(trace=)).
     `local_records` are this process's own matching records (already
     on the local clock — the router process passes its flight ring's
     snapshot through FlightRecord.to_dict()).
+
+    `clock_cache` reuses handshakes across calls (ClockCache): the
+    flightz fetch's own round-trip is reported back to the cache, so
+    a degraded path invalidates the entry it was measured on. None
+    keeps the historical handshake-every-call behavior.
 
     Returns {"trace", "records" (normalized, source-tagged, time-
     ordered), "breakdown" (hop_breakdown), "orphans", "replicas":
@@ -315,9 +402,16 @@ def collect_trace(
     handshakes: Dict[str, ClockMap] = {}
     fetched: List[dict] = []
     for name, client in replicas.items():
-        cm = clock_offset(client, samples=handshake_samples)
+        if clock_cache is not None:
+            cm = clock_cache.get(name, client)
+        else:
+            cm = clock_offset(client, samples=handshake_samples)
         handshakes[name] = cm
-        for r in client.flightz(trace=trace_id):
+        f0 = time.monotonic()
+        rows = client.flightz(trace=trace_id)
+        if clock_cache is not None:
+            clock_cache.observe_rtt(name, time.monotonic() - f0)
+        for r in rows:
             row = dict(r)
             row["source"] = name
             row["t_raw"] = row["t"]
@@ -362,4 +456,56 @@ def collect_trace(
             "traceEvents": _perfetto(merged, breakdown, origin),
             "displayTimeUnit": "ms",
         },
+    }
+
+
+def collect_history(
+    replicas: Dict[str, object],
+    series: Optional[str] = None,
+    window_s: float = 300.0,
+    q: Optional[float] = None,
+) -> dict:
+    """Fan /debug/historyz out to every replica (DecodeClient API:
+    historyz()). Per-replica pages come back keyed by replica name;
+    scrape failures are collected, not raised, so one dead replica
+    doesn't hide the rest of the fleet's history."""
+    pages: Dict[str, dict] = {}
+    errors: Dict[str, str] = {}
+    for name, client in replicas.items():
+        try:
+            pages[name] = client.historyz(
+                series=series, window=window_s, q=q
+            )
+        except Exception as err:  # noqa: BLE001 — a fleet page must
+            # survive any one replica's failure mode
+            errors[name] = str(err)
+    return {
+        "series": series,
+        "window_s": window_s,
+        "replicas": pages,
+        "scrape_errors": errors,
+        "partial": bool(errors),
+    }
+
+
+def collect_alerts(replicas: Dict[str, object]) -> dict:
+    """Fan /debug/alertz out to every replica; same partial-tolerant
+    shape as collect_history."""
+    pages: Dict[str, dict] = {}
+    errors: Dict[str, str] = {}
+    for name, client in replicas.items():
+        try:
+            pages[name] = client.alertz()
+        except Exception as err:  # noqa: BLE001
+            errors[name] = str(err)
+    firing = sorted({
+        inst
+        for page in pages.values()
+        for inst in page.get("firing", [])
+    })
+    return {
+        "replicas": pages,
+        "firing": firing,
+        "scrape_errors": errors,
+        "partial": bool(errors),
     }
