@@ -24,7 +24,7 @@ IMAGE_DIR := build/images
 DIST      := build/dist
 
 .PHONY: ci presubmit lint analyze native native-test native-race test wire-test e2e e2e-kind bench \
-        chaos-soak serve-soak serve-paged serve-sharded serve-disagg trace-smoke alert-smoke bench-regression ha-soak controller-profile images release mnist-acc clean
+        chaos-soak serve-soak serve-paged serve-sharded serve-disagg trace-smoke alert-smoke autoscale-smoke bench-regression ha-soak controller-profile images release mnist-acc clean
 
 # `test` already runs the whole tests/ tree (native bindings, wire,
 # E2E suites included) — native-test/wire-test exist for targeted runs,
@@ -136,6 +136,15 @@ trace-smoke:
 # trace-correlated kind="alert" flight records (CI's alert-smoke)
 alert-smoke:
 	env JAX_PLATFORMS=cpu $(PY) -m tf_operator_tpu.serve.fleet --alert-smoke
+
+# closed-loop autoscaling proof (docs/serving.md "Autoscaling & QoS"):
+# chaos latency fires the fast burn window -> scale-out through the
+# real controller; fault clears -> drain-based scale-in; asserts no
+# thrash (one direction change per cooldown), trace-correlated
+# kind="scale" records, and zero lost/diverged streams (CI's
+# autoscale-smoke)
+autoscale-smoke:
+	env JAX_PLATFORMS=cpu $(PY) -m tf_operator_tpu.serve.fleet --autoscale-smoke
 
 # perf-regression sentinel (docs/monitoring.md "Regression sentinel"):
 # replay the committed benchmark artifacts against noise-banded

@@ -87,6 +87,47 @@ BASELINES = [
         "direction": "min",
         "band": 1.0,  # slot arithmetic, not a measurement
     },
+    {
+        "check": "serve-tenant-small-ttft-p95",
+        "artifact": "serve_bench",
+        "path": "mixed_tenant.small_ttft_p95_s",
+        "baseline": 0.0632,
+        "direction": "max",
+        "band": 2.0,  # must also stay under the 0.25s SLO the bench
+        # itself asserts; the band catches creep before the cliff
+    },
+    {
+        "check": "serve-tenant-noisy-throttled",
+        "artifact": "serve_bench",
+        "path": "mixed_tenant.noisy_rejected_429",
+        "baseline": 1,
+        "direction": "min",
+        "band": 1.0,  # zero 429s = QoS admission stopped enforcing
+    },
+    {
+        "check": "serve-tenant-not-starved",
+        "artifact": "serve_bench",
+        "path": "mixed_tenant.noisy_streams_completed",
+        "baseline": 1,
+        "direction": "min",
+        "band": 1.0,  # throttled, never starved to zero
+    },
+    {
+        "check": "serve-autoscale-scaled-out",
+        "artifact": "serve_bench",
+        "path": "mixed_tenant.scale_out_records",
+        "baseline": 1,
+        "direction": "min",
+        "band": 1.0,  # the ramp must actuate a scale-out
+    },
+    {
+        "check": "serve-autoscale-no-thrash",
+        "artifact": "serve_bench",
+        "path": "mixed_tenant.min_decision_gap_s",
+        "baseline": 4.0,
+        "direction": "min",
+        "band": 0.95,  # decisions at least a cooldown apart
+    },
     # -- controller scale (CONTROLLER_SCALE.json) ------------------------
     {
         "check": "controller-all-ready-100",

@@ -1037,6 +1037,361 @@ def disagg_scenarios() -> dict:
     return out
 
 
+def mixed_tenant_scenarios(seed: int = 0) -> dict:
+    """The ``mixed_tenant`` section: QoS + autoscaling under an
+    adversarial tenant mix (docs/serving.md "Autoscaling & QoS"). One
+    noisy tenant (batch priority, tight token bucket, chaos-slowed
+    streams) floods a 1-replica fleet while several small tenants
+    (high priority) keep chatting; the noisy ramp burns the TTFT
+    budget, the fast window fires, and the autoscaler must scale the
+    decode group out mid-run — then back in by drain once the noisy
+    tenant stops. Raises unless: the small tenants' client-side TTFT
+    p95 holds within the SLO through BOTH the noisy tenant and the
+    scale events; the noisy tenant is throttled with 429s carrying a
+    positive Retry-After but still completes streams (throttled, not
+    starved); every completed chain is bit-identical to its greedy
+    reference (zero lost on the scale-in drain); and no two scaling
+    decisions for a role land closer than the cooldown (direction
+    changes at most once per window)."""
+    import random as _random
+
+    from tf_operator_tpu.api.types import (
+        ServeAutoscalePolicy, ServeReplicaGroup, ServeService,
+        ServeServiceSpec,
+    )
+    from tf_operator_tpu.controller.serve import ServeServiceController
+    from tf_operator_tpu.models import gpt as gpt_lib
+    from tf_operator_tpu.runtime import InMemorySubstrate
+    from tf_operator_tpu.serve.client import DecodeError
+    from tf_operator_tpu.serve.fleet import (
+        FaultLog, InProcessFleet, LatencyClientFactory,
+    )
+    from tf_operator_tpu.serve.observatory import fleet_slo
+    from tf_operator_tpu.serve.router import LeastLoadedRouter
+    from tf_operator_tpu.serve.autoscaler import ServeAutoscaler
+    from tf_operator_tpu.telemetry.alerts import AlertManager, BurnRateRule
+    from tf_operator_tpu.telemetry.flight import default_flight
+    from tf_operator_tpu.telemetry.history import MetricHistory
+
+    cfg = gpt_lib.GPT_TINY
+    params = _make_params(cfg)
+    rng = _random.Random(seed)
+    max_new = 8
+    slo_s = 0.25
+    cooldown_s = 4.0
+    noisy_delay_s = 0.4
+    small_tenants = 3
+    noisy_threads = 10
+    namespace = "bench-tenant"
+
+    # quota table: the noisy tenant is batch-class behind a tight
+    # bucket (cost = max_new x rows = 8 tokens/request, so rate 64
+    # admits ~8 req/s); everyone else is high-class and unmetered in
+    # practice
+    quotas = {
+        "noisy": {"rate": 64.0, "burst": 96.0, "priority": "batch"},
+        "*": {"rate": 1e5, "burst": 1e5, "priority": "high"},
+    }
+
+    flight = default_flight()
+    fault_log = FaultLog(flight=flight, seed=seed)
+    factory = LatencyClientFactory(fault_log=fault_log)
+    factory.only_tenant = "noisy"  # the chaos latency is the noisy
+    # tenant's own slowness, not the fleet's
+    substrate = InMemorySubstrate()
+    router = LeastLoadedRouter(client_factory=factory, retry_wait=0.02)
+    fleet = InProcessFleet(
+        substrate, router, cfg, {"v1": params}, slots=2,
+        namespace=namespace, fault_log=fault_log,
+        tenant_quotas=quotas,
+    )
+    controller = ServeServiceController(
+        substrate, namespace=namespace,
+        weight_update=fleet.update_weights,
+    )
+    svc = ServeService(
+        spec=ServeServiceSpec(
+            preset="tiny", slots=2, weights_version="v1",
+            replica_groups={
+                "decode": ServeReplicaGroup(
+                    replicas=1, min_replicas=1, max_replicas=3,
+                ),
+            },
+            autoscale=ServeAutoscalePolicy(
+                enabled=True, cooldown_seconds=cooldown_s,
+                max_queue_per_replica=1e9,  # the burn alert is the
+                # trigger under test, not queue pressure
+            ),
+        )
+    )
+    svc.metadata.name = "bench-tenant"
+    svc.metadata.namespace = namespace
+
+    history = MetricHistory(capacity=2048)
+    history.track_registry(router.registry)
+    manager = AlertManager(
+        history,
+        [
+            BurnRateRule(
+                "fleet-ttft-slo",
+                "tf_operator_tpu_router_ttft_seconds",
+                threshold_s=slo_s, windows=((2.0, 2.0), (6.0, 1.5)),
+            ),
+        ],
+        registry=router.registry,
+        flight=flight,
+    )
+    autoscaler = ServeAutoscaler(
+        substrate, namespace, "bench-tenant", manager, history,
+        registry=router.registry, flight=flight,
+        rule_name="fleet-ttft-slo",
+    )
+
+    prompts = [
+        [rng.randrange(1, cfg.vocab_size) for _ in range(rng.randint(2, 5))]
+        for _ in range(6)
+    ]
+    expected = [
+        [int(t) for t in gpt_lib.generate(
+            cfg, params, jnp.asarray([p], jnp.int32), max_new
+        )[0]]
+        for p in prompts
+    ]
+
+    lock = threading.Lock()
+    small_ttfts: list = []
+    small_done = [0]
+    noisy_done = [0]
+    noisy_429s: list = []
+    errors: list = []
+    diverged = [0]
+    stop_small = threading.Event()
+    stop_noisy = threading.Event()
+    counter = [0]
+
+    def stream_once(tenant: str, ttfts) -> None:
+        with lock:
+            k = counter[0]
+            counter[0] += 1
+        i = k % len(prompts)
+        t0 = time.perf_counter()
+        ttft = None
+        chain = None
+        for event in router.generate_stream(
+            prompts[i], max_new, corr=f"{tenant}-{k}",
+            timeout=120.0, tenant=tenant,
+        ):
+            if "token" in event and ttft is None:
+                ttft = time.perf_counter() - t0
+            if event.get("done"):
+                chain = event["tokens"][0]
+        with lock:
+            if ttfts is not None and ttft is not None:
+                ttfts.append(ttft)
+            if chain is None:
+                errors.append(f"{tenant}-{k}: stream ended without done")
+            elif chain != expected[i]:
+                diverged[0] += 1
+
+    def small_driver(tenant: str) -> None:
+        while not stop_small.is_set():
+            try:
+                stream_once(tenant, small_ttfts)
+                with lock:
+                    small_done[0] += 1
+            except Exception as err:  # noqa: BLE001 — asserted below
+                with lock:
+                    errors.append(f"{tenant}: {type(err).__name__}: {err}")
+            time.sleep(0.04)
+
+    def noisy_driver() -> None:
+        while not stop_noisy.is_set():
+            try:
+                stream_once("noisy", None)
+                with lock:
+                    noisy_done[0] += 1
+            except DecodeError as err:
+                if err.status != 429:
+                    with lock:
+                        errors.append(f"noisy: {err}")
+                    continue
+                ra = float(getattr(err, "retry_after", 0) or 0)
+                with lock:
+                    noisy_429s.append(ra)
+                # honor the hint, bounded so the bench keeps offering
+                # load while the quota refills
+                time.sleep(min(ra, 0.25))
+            except Exception as err:  # noqa: BLE001 — asserted below
+                with lock:
+                    errors.append(f"noisy: {type(err).__name__}: {err}")
+
+    seen_scale: dict = {}
+
+    def pump() -> None:
+        history.tick()
+        fleet_slo(router, history=history, alerts=manager)
+        autoscaler.tick()
+        controller.run_until_quiet()
+        fleet.sync()
+        router.probe()
+        for rec in flight.snapshot(kind="scale"):
+            seen_scale.setdefault(rec.seq, rec)
+
+    def live_ready() -> int:
+        return sum(
+            1 for r in router.stats()["replicas"].values() if r["ready"]
+        )
+
+    problems: list = []
+    peak_replicas = 1
+    scaled_out = False
+    scaled_in = False
+    baseline_scales = 0
+    small_ts = [
+        threading.Thread(
+            target=small_driver, args=(f"small-{i}",), daemon=True,
+        )
+        for i in range(small_tenants)
+    ]
+    noisy_ts = [
+        threading.Thread(target=noisy_driver, daemon=True)
+        for _ in range(noisy_threads)
+    ]
+    started = time.perf_counter()
+    try:
+        substrate.create_serve_service(svc)
+        controller.run_until_quiet()
+        fleet.sync()
+        fleet.wait_ready(1)
+        for t in small_ts:
+            t.start()
+
+        deadline = time.perf_counter() + 2.0
+        while time.perf_counter() < deadline:  # baseline: hold still
+            pump()
+            time.sleep(0.1)
+        baseline_scales = len(seen_scale)
+
+        factory.delay_s = noisy_delay_s  # the noisy ramp
+        for t in noisy_ts:
+            t.start()
+        deadline = time.perf_counter() + 60.0
+        while time.perf_counter() < deadline:
+            pump()
+            peak_replicas = max(peak_replicas, len(fleet.replica_names()))
+            if len(fleet.replica_names()) >= 2 and live_ready() >= 2:
+                scaled_out = True
+                break
+            time.sleep(0.05)
+
+        deadline = time.perf_counter() + 3.0
+        while time.perf_counter() < deadline:  # mixed load, scaled out
+            pump()
+            peak_replicas = max(peak_replicas, len(fleet.replica_names()))
+            time.sleep(0.05)
+
+        stop_noisy.set()
+        for t in noisy_ts:
+            t.join(timeout=120.0)
+        factory.delay_s = 0.0
+        deadline = time.perf_counter() + 90.0
+        while time.perf_counter() < deadline:
+            pump()
+            if len(fleet.replica_names()) == 1 and not manager.firing():
+                scaled_in = True
+                break
+            time.sleep(0.05)
+    finally:
+        stop_small.set()
+        stop_noisy.set()
+        for t in small_ts + noisy_ts:
+            t.join(timeout=120.0)
+        fleet.stop()
+        controller.stop()
+
+    if baseline_scales:
+        problems.append(
+            f"{baseline_scales} scale decisions on baseline traffic"
+        )
+    if not scaled_out:
+        problems.append("fleet never scaled out under the noisy ramp")
+    if not scaled_in:
+        problems.append("fleet did not drain back to minReplicas")
+
+    ttfts = sorted(small_ttfts)
+    small_p95 = percentile(ttfts, 0.95) if ttfts else None
+    if small_p95 is None or small_p95 > slo_s:
+        problems.append(
+            f"small tenants' TTFT p95 {small_p95} outside the "
+            f"{slo_s}s SLO"
+        )
+    if not noisy_429s:
+        problems.append("noisy tenant was never throttled with a 429")
+    elif min(noisy_429s) <= 0:
+        problems.append("a 429 carried no positive Retry-After")
+    if noisy_done[0] < 1:
+        problems.append("noisy tenant starved to zero completions")
+    if errors:
+        problems.append(f"lost streams: {errors[:5]}")
+    if diverged[0]:
+        problems.append(f"{diverged[0]} diverged chains")
+
+    records = [seen_scale[s] for s in sorted(seen_scale)]
+    outs = [r for r in records if r.fields.get("direction") == "out"]
+    ins = [r for r in records if r.fields.get("direction") == "in"]
+    if not outs or not ins:
+        problems.append("missing kind=scale out/in flight records")
+    if outs and not any(
+        str(r.fields.get("reason", "")).startswith("burn:") for r in outs
+    ):
+        problems.append("no scale-out attributed to the burn alert")
+    min_gap = None
+    by_role: dict = {}
+    for rec in records:
+        by_role.setdefault(str(rec.fields.get("role")), []).append(rec)
+    for role, recs in by_role.items():
+        recs.sort(key=lambda r: r.t)
+        for prev, cur in zip(recs, recs[1:]):
+            gap = cur.t - prev.t
+            min_gap = gap if min_gap is None else min(min_gap, gap)
+            if gap < cooldown_s * 0.95:
+                problems.append(
+                    f"{role}: decisions {gap:.2f}s apart "
+                    f"(< cooldown {cooldown_s}s): thrash"
+                )
+
+    reject_rates = autoscaler.tenant_reject_rates()
+    out = {
+        "slo_s": slo_s,
+        "cooldown_s": cooldown_s,
+        "small_tenants": small_tenants,
+        "noisy_threads": noisy_threads,
+        "noisy_quota": quotas["noisy"],
+        "small_streams": small_done[0],
+        "small_ttft_p50_s": round(percentile(ttfts, 0.50), 5),
+        "small_ttft_p95_s": round(small_p95, 5),
+        "noisy_streams_completed": noisy_done[0],
+        "noisy_rejected_429": len(noisy_429s),
+        "noisy_retry_after_p50_s": round(
+            percentile(sorted(noisy_429s), 0.50), 4
+        ),
+        "noisy_reject_rate_per_s": reject_rates.get("noisy"),
+        "peak_replicas": peak_replicas,
+        "scale_out_records": len(outs),
+        "scale_in_records": len(ins),
+        "min_decision_gap_s": (
+            round(min_gap, 3) if min_gap is not None else None
+        ),
+        "seconds": round(time.perf_counter() - started, 1),
+    }
+    if problems:
+        raise AssertionError(
+            f"mixed_tenant failed: {problems}; artifact so far: "
+            f"{json.dumps(out)}"
+        )
+    return out
+
+
 def run(write: bool = True) -> dict:
     on_tpu = jax.devices()[0].platform == "tpu"
     cfg, prompt_len, new, n_clients, reqs_per_client = _shapes(on_tpu)
@@ -1091,6 +1446,7 @@ def run(write: bool = True) -> dict:
         "paged_kv": paged_scenarios(cfg, params),
         "sharded": sharded_scenarios(),
         "disaggregated": disagg_scenarios(),
+        "mixed_tenant": mixed_tenant_scenarios(),
         "notes": (
             "plain/batched/continuous drive the live HTTP server "
             "(in-process, loopback) with single-row greedy requests "
@@ -1136,7 +1492,17 @@ def run(write: bool = True) -> dict:
             "chat ITL p95 must be strictly better disaggregated, "
             "chat TTFT p95 within the 0.071s paged pin, every chain "
             "bit-identical across the migration boundary, both pools "
-            "audited empty at shutdown."
+            "audited empty at shutdown. mixed_tenant is the QoS + "
+            "autoscaling adversarial mix (docs/serving.md "
+            "\"Autoscaling & QoS\"): one batch-class noisy tenant "
+            "behind a tight token bucket floods a 1-replica fleet "
+            "while high-class small tenants chat; the ramp burns the "
+            "TTFT budget, the autoscaler scales out mid-run and "
+            "drains back in afterwards — small tenants' TTFT p95 "
+            "must hold within the 0.25s SLO throughout, the noisy "
+            "tenant must be throttled with 429+Retry-After but not "
+            "starved, chains stay bit-identical (zero lost on "
+            "scale-in), and decisions sit at least a cooldown apart."
         ),
     }
     if write:
@@ -1148,19 +1514,19 @@ def run(write: bool = True) -> dict:
     return result
 
 
-def _merge_disagg_only() -> dict:
-    """Re-run just the disaggregated section and merge it into the
-    existing SERVE_BENCH.json (the full sweep takes much longer)."""
+def _merge_section(key: str, scenario) -> dict:
+    """Re-run just one section and merge it into the existing
+    SERVE_BENCH.json (the full sweep takes much longer)."""
     path = os.path.join(
         os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
         "SERVE_BENCH.json",
     )
     with open(path) as fh:
         artifact = json.load(fh)
-    artifact["disaggregated"] = disagg_scenarios()
+    artifact[key] = scenario()
     with open(path, "w") as fh:
         json.dump(artifact, fh, indent=1)
-    return artifact["disaggregated"]
+    return artifact[key]
 
 
 if __name__ == "__main__":
@@ -1168,6 +1534,14 @@ if __name__ == "__main__":
         print(json.dumps(_sharded_child()))
         sys.exit(0)
     if len(sys.argv) > 1 and sys.argv[1] == "--disagg-only":
-        print(json.dumps(_merge_disagg_only(), indent=1))
+        print(json.dumps(
+            _merge_section("disaggregated", disagg_scenarios), indent=1
+        ))
+        sys.exit(0)
+    if len(sys.argv) > 1 and sys.argv[1] == "--mixed-tenant-only":
+        print(json.dumps(
+            _merge_section("mixed_tenant", mixed_tenant_scenarios),
+            indent=1,
+        ))
         sys.exit(0)
     print(json.dumps(run(), indent=1))

@@ -4,7 +4,8 @@
 Usage:
     python hack/graftlint.py [paths ...]
         [--baseline hack/graftlint_baseline.json]
-        [--update-baseline] [--rules rule1,rule2] [--list-rules]
+        [--update-baseline --justification "why"]
+        [--rules rule1,rule2] [--list-rules]
 
 Exit status: 0 when every finding is baselined (stale baseline entries
 only warn), 1 on any non-baselined finding, 2 on usage errors.
@@ -104,8 +105,14 @@ def main(argv=None) -> int:
     )
     parser.add_argument(
         "--update-baseline", action="store_true",
-        help="rewrite the baseline from current findings (placeholder "
-             "justifications must then be edited by hand)",
+        help="rewrite the baseline from current findings; requires "
+             "--justification (no placeholder is ever written)",
+    )
+    parser.add_argument(
+        "--justification", default=None,
+        help="the human-written reason stamped on every entry written "
+             "by --update-baseline; empty or TODO-prefixed text is "
+             "rejected",
     )
     parser.add_argument(
         "--rules", default="",
@@ -141,10 +148,21 @@ def main(argv=None) -> int:
             finding.path = os.path.relpath(finding.path, REPO)
 
     if args.update_baseline:
-        Baseline.dump(findings, args.baseline)
+        if not args.justification:
+            print(
+                "graftlint: error: --update-baseline requires "
+                "--justification (a real reason, not a placeholder)",
+                file=sys.stderr,
+            )
+            return 2
+        try:
+            Baseline.dump(findings, args.baseline, args.justification)
+        except analysis.AnalysisError as err:
+            print(f"graftlint: error: {err}", file=sys.stderr)
+            return 2
         print(
             f"graftlint: wrote {len(findings)} finding(s) to "
-            f"{args.baseline}; edit the justifications before committing"
+            f"{args.baseline}"
         )
         return 0
 

@@ -202,6 +202,74 @@ class TestThreshold:
             ThresholdRule("r", "s", fire_above=1.0, mode="nope")
 
 
+class TestHysteresisAcrossReplicaChurn:
+    """The autoscaler actuates on alert state, so a chaos-killed
+    replica must not fake a recovery: through the kill (scrape gap +
+    fleet-sum counter reset) and the replacement's no-data warmup
+    window, a firing burn alert HOLDS — no flap, no spurious resolve —
+    and only genuinely healthy complete scrapes clear it."""
+
+    def rule(self):
+        return BurnRateRule(
+            "ttft-slo", "ttft", threshold_s=SLO, objective=0.95,
+            windows=((60.0, 14.4), (300.0, 6.0)),
+        )
+
+    def test_firing_holds_through_kill_and_replacement(self):
+        manager, history, clock, flight, _ = make_manager([self.rule()])
+        feed = _TtftFeed(history, clock)
+        fast = "ttft-slo[60s]"
+
+        def transitions_for(key, batch):
+            return [t for t in batch if t["instance"] == key]
+
+        log = []
+        for _ in range(40):
+            feed.tick(good=10)
+            log += manager.evaluate()
+        for _ in range(8):
+            feed.tick(bad=10)
+            log += manager.evaluate()
+        assert fast in manager.firing()
+
+        # the burning replica is chaos-killed: scrapes error (partial),
+        # and the series goes silent while the pod is replaced — a
+        # no-data window must hold state, not resolve it
+        for _ in range(6):
+            clock.advance(10.0)
+            log += manager.evaluate(partial=True)
+            assert fast in manager.firing()
+
+        # the replacement comes up: the fleet-summed cumulative
+        # counters RESET (the dead replica's contribution left the
+        # sum; the new one starts at zero) and its first scrapes are
+        # healthy but still partial — resolve stays suppressed
+        feed.good = feed.good * 0.5
+        feed.total = feed.total * 0.5
+        for _ in range(12):
+            feed.tick(good=10)
+            log += manager.evaluate(partial=True)
+            assert fast in manager.firing()
+
+        # complete healthy scrapes finally resolve it
+        for _ in range(4):
+            feed.tick(good=10)
+            log += manager.evaluate()
+        assert fast not in manager.firing()
+
+        # the whole arc produced exactly ONE firing and ONE resolved
+        # transition for the fast window: hysteresis, not flapping
+        states = [t["state"] for t in transitions_for(fast, log)]
+        assert states == ["firing", "resolved"]
+        records = [
+            r for r in flight.snapshot(kind="alert")
+            if r.fields.get("instance") == fast
+        ]
+        assert [r.fields["state"] for r in records] == [
+            "firing", "resolved",
+        ]
+
+
 class TestTransitions:
     def test_flight_records_carry_traces(self):
         manager, history, clock, flight, _ = make_manager(

@@ -275,6 +275,37 @@ class TestBaseline:
         with pytest.raises(AnalysisError):
             Baseline.load(str(path))
 
+    def test_todo_justification_rejected(self, tmp_path):
+        # placeholder suppressions are not reviewed suppressions
+        path = tmp_path / "baseline.json"
+        path.write_text(json.dumps({"findings": [{
+            "rule": "r", "path": "p", "symbol": "", "message": "m",
+            "justification": "TODO: justify",
+        }]}))
+        with pytest.raises(AnalysisError):
+            Baseline.load(str(path))
+
+    def test_dump_requires_real_justification(self, tmp_path):
+        path = str(tmp_path / "baseline.json")
+        with pytest.raises(AnalysisError):
+            Baseline.dump([self._finding()], path, justification="")
+        with pytest.raises(AnalysisError):
+            Baseline.dump(
+                [self._finding()], path, justification="todo later",
+            )
+
+    def test_add_requires_explicit_justification(self):
+        baseline = Baseline({})
+        f = self._finding()
+        with pytest.raises(AnalysisError):
+            baseline.add(f, "")
+        with pytest.raises(AnalysisError):
+            baseline.add(f, "TODO")
+        baseline.add(f, "decode lock by design")
+        assert baseline.entries[f.fingerprint()] == (
+            "decode lock by design"
+        )
+
     def test_missing_file_is_empty(self, tmp_path):
         baseline = Baseline.load(str(tmp_path / "nope.json"))
         assert baseline.entries == {}
@@ -304,7 +335,20 @@ class TestCli:
     def test_update_baseline_then_clean(self, tmp_path):
         baseline = str(tmp_path / "b.json")
         bad = os.path.join(FIXTURES, "blocking_bad.py")
+        # no --justification: refused, nothing written
         proc = self._run(bad, "--baseline", baseline, "--update-baseline")
+        assert proc.returncode == 2
+        assert not os.path.exists(baseline)
+        # a TODO placeholder is refused too
+        proc = self._run(
+            bad, "--baseline", baseline, "--update-baseline",
+            "--justification", "TODO: justify",
+        )
+        assert proc.returncode == 2
+        proc = self._run(
+            bad, "--baseline", baseline, "--update-baseline",
+            "--justification", "seeded fixture, blocking by design",
+        )
         assert proc.returncode == 0
         proc = self._run(bad, "--baseline", baseline)
         assert proc.returncode == 0, proc.stdout + proc.stderr

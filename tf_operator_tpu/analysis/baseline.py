@@ -23,6 +23,20 @@ from .core import AnalysisError, Finding
 _FpKey = Tuple[str, str, str, str]
 
 
+def _require_justification(justification, where: str) -> str:
+    """A baseline justification must be real prose: non-empty and not
+    a TODO placeholder (a baseline entry nobody justified is a
+    suppression nobody reviewed)."""
+    if not isinstance(justification, str) or not justification.strip():
+        raise AnalysisError(f"{where} needs a non-empty justification")
+    if justification.strip().lower().startswith("todo"):
+        raise AnalysisError(
+            f"{where} has a placeholder justification "
+            f"({justification.strip()!r}); write the real reason"
+        )
+    return justification
+
+
 class Baseline:
     def __init__(self, entries: Dict[_FpKey, str]) -> None:
         # fingerprint -> justification
@@ -55,13 +69,23 @@ class Baseline:
                 raise AnalysisError(
                     f"baseline {path} entry {i} missing field: {err}"
                 )
-            if not isinstance(justification, str) or not justification.strip():
-                raise AnalysisError(
-                    f"baseline {path} entry {i} ({key[0]} at {key[1]}) "
-                    f"needs a non-empty justification"
-                )
+            _require_justification(
+                justification,
+                f"baseline {path} entry {i} ({key[0]} at {key[1]})",
+            )
             entries[key] = justification
         return cls(entries)
+
+    def add(self, finding: Finding, justification: str) -> None:
+        """Accept ONE finding into the baseline. The justification is
+        mandatory and must be real prose — there is no placeholder
+        path; an unjustified acceptance is exactly what the baseline
+        exists to prevent."""
+        _require_justification(
+            justification,
+            f"baseline entry for {finding.rule} at {finding.path}",
+        )
+        self.entries[finding.fingerprint()] = justification
 
     def split(
         self, findings: Sequence[Finding]
@@ -82,11 +106,15 @@ class Baseline:
 
     @staticmethod
     def dump(findings: Sequence[Finding], path: str,
-             justification: str = "TODO: justify") -> None:
+             justification: str) -> None:
         """--update-baseline: write entries for `findings`, each stamped
-        with a placeholder justification the author must then edit (the
-        loader rejects empty ones, not placeholders — review catches
-        those)."""
+        with the given justification. There is no placeholder default —
+        the loader rejects empty and TODO-prefixed justifications, so a
+        baseline written here must already carry the real reason (pass
+        it via graftlint --justification)."""
+        _require_justification(
+            justification, f"baseline dump to {path}"
+        )
         payload = {
             "findings": [
                 {

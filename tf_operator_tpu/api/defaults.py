@@ -124,6 +124,14 @@ def set_serve_defaults(svc: t.ServeService) -> t.ServeService:
                 continue  # validation reports nil groups; don't crash
             if group.replicas is None:
                 group.replicas = 1
+            # autoscaler band defaults to pinned at the current scale;
+            # widening [minReplicas, maxReplicas] opts the group in
+            if group.min_replicas is None:
+                group.min_replicas = min(
+                    group.replicas, group.max_replicas or group.replicas
+                )
+            if group.max_replicas is None:
+                group.max_replicas = max(group.replicas, group.min_replicas)
             if group.slots is None:
                 group.slots = spec.slots
     pod_spec = spec.template.spec

@@ -379,6 +379,16 @@ class ServeReplicaGroup:
     role-differentiating engine knobs."""
 
     replicas: Optional[int] = None
+    # autoscaler bounds: the closed loop (serve/autoscaler.py) moves
+    # `replicas` only within [minReplicas, maxReplicas]. Both default
+    # to `replicas`, so a group without explicit bounds is pinned —
+    # autoscaling is opt-in by widening the band
+    min_replicas: Optional[int] = field(
+        default=None, metadata={"json": "minReplicas"}
+    )
+    max_replicas: Optional[int] = field(
+        default=None, metadata={"json": "maxReplicas"}
+    )
     # engine slot-grid width for this role's replicas; None inherits
     # spec.slots (prefill pools usually run narrow, decode pools wide)
     slots: Optional[int] = None
@@ -387,6 +397,39 @@ class ServeReplicaGroup:
     # prompts arrive as cached blocks and skip prefill entirely
     prefill_chunk: Optional[int] = field(
         default=None, metadata={"json": "prefillChunk"}
+    )
+    extra: Dict[str, Any] = field(default_factory=dict)
+
+
+@dataclass
+class ServeAutoscalePolicy:
+    """spec.autoscale — policy for the closed-loop autoscaler.
+
+    The loop scales OUT a role group when the fast TTFT-SLO burn
+    window fires (or queue depth per replica exceeds
+    maxQueuePerReplica), and scales IN only after the slow window has
+    been resolved for a full cooldown with the queue quiet. Every
+    decision starts a cooldown, so the fleet changes direction at
+    most once per cooldownSeconds."""
+
+    enabled: bool = False
+    # seconds both directions must wait after any decision (and the
+    # slow window's resolve must age past) before the next decision
+    cooldown_seconds: float = field(
+        default=300.0, metadata={"json": "cooldownSeconds"}
+    )
+    # replicas added per scale-out / removed per scale-in decision
+    scale_out_step: int = field(
+        default=1, metadata={"json": "scaleOutStep"}
+    )
+    scale_in_step: int = field(
+        default=1, metadata={"json": "scaleInStep"}
+    )
+    # queue-depth pressure: mean queued requests per replica above
+    # which the group scales out even before the burn window fires,
+    # and below a quarter of which scale-in is allowed
+    max_queue_per_replica: float = field(
+        default=4.0, metadata={"json": "maxQueuePerReplica"}
     )
     extra: Dict[str, Any] = field(default_factory=dict)
 
@@ -422,6 +465,10 @@ class ServeServiceSpec:
     replica_groups: Dict[str, ServeReplicaGroup] = field(
         default_factory=dict, metadata={"json": "replicaGroups"}
     )
+    # closed-loop autoscaling policy; None = no autoscaler (the
+    # observatory still observes, nothing actuates). Requires
+    # replicaGroups — the loop scales role pools, not monoliths
+    autoscale: Optional[ServeAutoscalePolicy] = None
     template: PodTemplateSpec = field(default_factory=PodTemplateSpec)
     extra: Dict[str, Any] = field(default_factory=dict)
 

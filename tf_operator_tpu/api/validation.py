@@ -254,6 +254,57 @@ def validate_serve_service(svc: t.ServeService) -> None:
                 f"ServeServiceSpec.replicaGroups[{role!r}].prefillChunk "
                 f"must be >= 0, got {group.prefill_chunk}"
             )
+        if group.min_replicas is not None and group.min_replicas < 1:
+            errs.append(
+                f"ServeServiceSpec.replicaGroups[{role!r}].minReplicas "
+                f"must be >= 1, got {group.min_replicas}"
+            )
+        if (
+            group.min_replicas is not None
+            and group.max_replicas is not None
+            and group.max_replicas < group.min_replicas
+        ):
+            errs.append(
+                f"ServeServiceSpec.replicaGroups[{role!r}].maxReplicas="
+                f"{group.max_replicas} is below minReplicas="
+                f"{group.min_replicas}"
+            )
+        elif (
+            group.replicas is not None
+            and group.min_replicas is not None
+            and group.max_replicas is not None
+            and not (
+                group.min_replicas <= group.replicas <= group.max_replicas
+            )
+        ):
+            errs.append(
+                f"ServeServiceSpec.replicaGroups[{role!r}].replicas="
+                f"{group.replicas} is outside [minReplicas="
+                f"{group.min_replicas}, maxReplicas={group.max_replicas}]"
+            )
+    if spec.autoscale is not None:
+        policy = spec.autoscale
+        if policy.enabled and not spec.replica_groups:
+            errs.append(
+                "ServeServiceSpec.autoscale.enabled requires "
+                "replicaGroups — the autoscaler scales role pools"
+            )
+        if policy.cooldown_seconds <= 0:
+            errs.append(
+                "ServeServiceSpec.autoscale.cooldownSeconds must be "
+                f"> 0, got {policy.cooldown_seconds}"
+            )
+        if policy.scale_out_step < 1 or policy.scale_in_step < 1:
+            errs.append(
+                "ServeServiceSpec.autoscale scale steps must be >= 1, "
+                f"got scaleOutStep={policy.scale_out_step} "
+                f"scaleInStep={policy.scale_in_step}"
+            )
+        if policy.max_queue_per_replica <= 0:
+            errs.append(
+                "ServeServiceSpec.autoscale.maxQueuePerReplica must be "
+                f"> 0, got {policy.max_queue_per_replica}"
+            )
     container = spec.template.spec.container(t.SERVE_CONTAINER_NAME)
     if container is None:
         errs.append(

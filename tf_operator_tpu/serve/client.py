@@ -29,7 +29,12 @@ import urllib.error
 import urllib.request
 from typing import Dict, List, Optional
 
-from ..runtime.retry import RetryPolicy, call_with_retries, retry_after_hint
+from ..runtime.retry import (
+    RETRY_AFTER_CAP,
+    RetryPolicy,
+    call_with_retries,
+    retry_after_hint,
+)
 from ..telemetry.tracecontext import trace_headers
 
 # 500/504 are deliberately absent (unlike the substrate's transport
@@ -37,6 +42,10 @@ from ..telemetry.tracecontext import trace_headers
 # a blind replay re-pays a full decode for — the caller or router
 # decides, not the transport.
 RETRYABLE_DECODE_STATUSES = frozenset({429, 502, 503})
+
+# request header naming the tenant for QoS admission; must match the
+# server's TENANT_HEADER (serve/server.py)
+TENANT_HEADER = "X-Tenant"
 
 
 def _is_retryable(err: BaseException) -> bool:
@@ -98,12 +107,20 @@ class DecodeClient:
             op=op,
         )
 
-    def _request(self, path: str, payload: Optional[dict] = None):
+    def _request(
+        self,
+        path: str,
+        payload: Optional[dict] = None,
+        tenant: Optional[str] = None,
+    ):
         data = json.dumps(payload).encode() if payload is not None else None
+        headers = {"Content-Type": "application/json"}
+        if tenant:
+            headers[TENANT_HEADER] = tenant
         req = urllib.request.Request(
             self.base_url + path,
             data=data,
-            headers=trace_headers({"Content-Type": "application/json"}),
+            headers=trace_headers(headers),
             method="POST" if data is not None else "GET",
         )
         try:
@@ -120,6 +137,7 @@ class DecodeClient:
         top_k: int = 0,
         top_p: float = 1.0,
         seed: int = 0,
+        tenant: Optional[str] = None,
     ) -> List[List[int]]:
         """Each row's full chain: its own prompt + max_new_tokens."""
         body = json.loads(self._request("/generate", {
@@ -129,7 +147,7 @@ class DecodeClient:
             "top_k": top_k,
             "top_p": top_p,
             "seed": seed,
-        }))
+        }, tenant=tenant))
         return body["tokens"]
 
     def generate_stream(
@@ -140,6 +158,7 @@ class DecodeClient:
         top_k: int = 0,
         top_p: float = 1.0,
         seed: int = 0,
+        tenant: Optional[str] = None,
     ):
         """Yield one event dict per line of the chunked ndjson
         /generate_stream response for ONE prompt row: {"token": t,
@@ -151,6 +170,16 @@ class DecodeClient:
         DecodeError here. Retries cover the connect only — past the
         first byte a failure propagates (a stream body is not
         idempotent; the router owns mid-stream failover).
+
+        A QoS early-reject (HTTP 429 from tenant admission, after the
+        connect retries give up) is NOT an error: it yields exactly one
+        typed terminal event {"rejected": true, "status": 429,
+        "retry_after": <seconds, capped at RETRY_AFTER_CAP>,
+        "error": <server message>} so callers can back off without
+        string-matching a stream exception. The retry_after honored
+        here is the server's Retry-After delta-seconds header (same
+        parse the connect retries use); once the first stream byte has
+        arrived a 429 can no longer occur.
 
         NOT a generator function: the request is built and connected
         HERE, so an ambient trace context (telemetry trace_scope) at
@@ -165,15 +194,30 @@ class DecodeClient:
             "top_p": top_p,
             "seed": seed,
         }).encode()
+        headers = {"Content-Type": "application/json"}
+        if tenant:
+            headers[TENANT_HEADER] = tenant
         req = urllib.request.Request(
             self.base_url + "/generate_stream",
             data=data,
-            headers=trace_headers({"Content-Type": "application/json"}),
+            headers=trace_headers(headers),
             method="POST",
         )
         try:
             resp = self._open(req, "decode/generate_stream")
         except urllib.error.HTTPError as err:
+            if err.code == 429:
+                hint = retry_after_hint(err)
+                rejected = {
+                    "rejected": True,
+                    "status": 429,
+                    "retry_after": min(
+                        RETRY_AFTER_CAP,
+                        hint if hint is not None else 1.0,
+                    ),
+                    "error": str(_to_decode_error(err)),
+                }
+                return iter((rejected,))
             raise _to_decode_error(err) from None
 
         def events():

@@ -92,6 +92,7 @@ class InProcessFleet:
         fault_log: Optional[FaultLog] = None,
         block_size: int = 64,
         prefill_chunk: int = 64,
+        tenant_quotas: Optional[Dict[str, Dict]] = None,
     ) -> None:
         self.substrate = substrate
         self.router = router
@@ -110,6 +111,9 @@ class InProcessFleet:
         # one --mesh-shape flag the default pod command carries
         self.mesh_shape = mesh_shape
         self.namespace = namespace
+        # per-tenant QoS quotas every replica boots with (the
+        # in-process analog of --tenant-quotas on the pod command)
+        self.tenant_quotas = tenant_quotas
         self.fault_log = fault_log
         self._lock = locks.make_lock("InProcessFleet._lock")
         self._replicas: Dict[str, _ReplicaProcess] = {}
@@ -144,10 +148,13 @@ class InProcessFleet:
         return value
 
     def sync(self) -> List[str]:
-        """Boot a server for every pending serve pod without one.
-        Returns the pod names booted this pass."""
+        """Boot a server for every pending serve pod without one, and
+        drain-decommission every live replica whose pod record the
+        reconciler deleted (scale-in). Returns the pod names booted
+        this pass."""
         from .server import make_server
 
+        self.reap()
         booted: List[str] = []
         pods = self.substrate.list_pods(self.namespace)
         for pod in pods:
@@ -182,6 +189,7 @@ class InProcessFleet:
                 block_size=self.block_size,
                 prefill_chunk=prefill_chunk,
                 role=role,
+                tenant_quotas=self.tenant_quotas,
             )
             thread = threading.Thread(
                 target=server.serve_forever, name=f"serve-{name}",
@@ -202,6 +210,52 @@ class InProcessFleet:
                 f" (role {role})" if role else "",
             )
         return booted
+
+    def reap(self) -> List[str]:
+        """Drain-decommission live replicas whose pod records are gone
+        from the substrate — the reconciler scaled the group in (or
+        removed a role group) by deleting the pod, and the fleet is
+        the kubelet that retires the body. The graceful inverse of
+        kill(): zero lost streams. Returns the names decommissioned."""
+        present = {
+            pod.metadata.name
+            for pod in self.substrate.list_pods(self.namespace)
+            if LABEL_SERVE_NAME in pod.metadata.labels
+        }
+        with self._lock:
+            departed = [
+                name for name in self._replicas if name not in present
+            ]
+        for name in departed:
+            self.decommission(name)
+        return departed
+
+    def decommission(self, pod_name: str) -> None:
+        """Gracefully retire one replica: router stops picking it,
+        the server 503s new work, the engine finishes its in-flight
+        slots behind the admission gate (the same drain sequence the
+        rolling weight update walks), and only then do the listener
+        and engine come down — so scale-in loses zero streams."""
+        with self._lock:
+            proc = self._replicas.pop(pod_name, None)
+        if proc is None:
+            return
+        self.router.set_draining(pod_name, True)
+        state = proc.server.state
+        engine = getattr(state, "engine", None)
+        try:
+            state.phase = "draining"
+            if engine is not None and not engine.drain(timeout=60.0):
+                logger.warning(
+                    "replica %s did not drain within 60s; "
+                    "decommissioning anyway", pod_name,
+                )
+        finally:
+            proc.server.shutdown()
+            self._quiesce_engine(proc)
+            proc.server.server_close()
+            self.router.remove_replica(pod_name)
+        logger.info("decommissioned replica %s (drained)", pod_name)
 
     def kill(self, pod_name: str, exit_code: int = 137) -> None:
         """Chaos kill: sever every live connection with an RST (the
@@ -450,7 +504,9 @@ class _SlowClient:
         return getattr(self._inner, name)
 
     def generate_stream(self, input_ids, max_new_tokens: int = 16, **kw):
-        delay = self._factory.draw(self._inner.base_url)
+        delay = self._factory.draw(
+            self._inner.base_url, tenant=kw.get("tenant")
+        )
         inner = self._inner.generate_stream(
             input_ids, max_new_tokens, **kw
         )
@@ -468,10 +524,16 @@ class LatencyClientFactory:
 
     def __init__(self, fault_log: Optional[FaultLog] = None) -> None:
         self.delay_s = 0.0
+        # when set, only streams carrying this tenant id are slowed —
+        # the mixed-tenant bench's noisy neighbor, leaving every other
+        # tenant's TTFT untouched
+        self.only_tenant = ""
         self.fault_log = fault_log
         self.injected = 0
 
-    def draw(self, url: str) -> float:
+    def draw(self, url: str, tenant: Optional[str] = None) -> float:
+        if self.only_tenant and tenant != self.only_tenant:
+            return 0.0
         delay = self.delay_s
         if delay > 0:
             self.injected += 1
@@ -1224,6 +1286,333 @@ def run_alert_smoke(
     return summary
 
 
+def run_autoscale_smoke(
+    seed: int = 0,
+    max_new: int = 8,
+    namespace: str = "autoscale",
+    slo_s: float = 0.25,
+    delay_s: float = 0.4,
+    cooldown_s: float = 3.0,
+) -> dict:
+    """End-to-end proof of the closed scaling loop (CI step
+    `autoscale-smoke`): a 1-replica decode group with a [1, 3] band
+    and an enabled autoscale policy serves continuous traffic; chaos
+    latency pushes TTFT out of SLO, the fast burn window fires, the
+    ServeAutoscaler raises spec.replicas, the reconciler creates the
+    pod, and the fleet boots it. The fault then clears, the slow
+    window resolves, the cooldown passes, and the fleet scales back
+    in — by drain, not kill. Asserts: scale-out AND scale-in both
+    happened and are kind="scale" flight records (the out record
+    trace-correlated with the requests that burned the budget), no
+    two decisions for a role land closer than the cooldown (no
+    oscillation), zero lost or diverged streams across the whole arc,
+    and the group ends back at minReplicas. Raises AssertionError on
+    any violation."""
+    import jax
+    import jax.numpy as jnp
+
+    from ..api.types import ServeAutoscalePolicy
+    from ..controller.serve import ServeServiceController
+    from ..models import gpt as gpt_lib
+    from ..runtime import InMemorySubstrate
+    from ..telemetry.alerts import AlertManager, BurnRateRule
+    from ..telemetry.history import MetricHistory
+    from .autoscaler import ServeAutoscaler
+
+    cfg = gpt_lib.GPT_TINY
+    params = gpt_lib.GPT(cfg).init(
+        jax.random.PRNGKey(0), jnp.zeros((1, 8), jnp.int32)
+    )["params"]
+
+    rng = random.Random(seed)
+    flight = default_flight()
+    fault_log = FaultLog(flight=flight, seed=seed)
+    factory = LatencyClientFactory(fault_log=fault_log)
+    substrate = InMemorySubstrate()
+    router = LeastLoadedRouter(client_factory=factory, retry_wait=0.02)
+    fleet = InProcessFleet(
+        substrate, router, cfg, {"v1": params}, slots=2,
+        namespace=namespace, fault_log=fault_log,
+    )
+    controller = ServeServiceController(
+        substrate, namespace=namespace,
+        weight_update=fleet.update_weights,
+    )
+    svc = ServeService(
+        spec=ServeServiceSpec(
+            preset="tiny", slots=2, weights_version="v1",
+            replica_groups={
+                "decode": ServeReplicaGroup(
+                    replicas=1, min_replicas=1, max_replicas=3,
+                ),
+            },
+            # queue pressure is not under test here (the burn alert
+            # is); park the queue trigger out of reach
+            autoscale=ServeAutoscalePolicy(
+                enabled=True, cooldown_seconds=cooldown_s,
+                max_queue_per_replica=1e9,
+            ),
+        )
+    )
+    svc.metadata.name = "autoscale"
+    svc.metadata.namespace = namespace
+
+    # same smoke-scaled burn windows as run_alert_smoke: the rule
+    # shape production uses, in seconds so the whole ramp-out-in arc
+    # fits in a CI step
+    series = "tf_operator_tpu_router_ttft_seconds"
+    fast_key = "ttft-slo[2s]"
+    history = MetricHistory(capacity=1024)
+    history.track_registry(router.registry)
+    manager = AlertManager(
+        history,
+        [
+            BurnRateRule(
+                "ttft-slo", series, threshold_s=slo_s,
+                windows=((2.0, 2.0), (6.0, 1.5)),
+            ),
+        ],
+        registry=router.registry,
+        flight=flight,
+    )
+    autoscaler = ServeAutoscaler(
+        substrate, namespace, "autoscale", manager, history,
+        registry=router.registry, flight=flight, rule_name="ttft-slo",
+    )
+
+    # a small prompt family with precomputed inline greedy ground
+    # truth; the driver cycles through it so every completed stream
+    # can be pinned bit-for-bit
+    prompts = [
+        [rng.randrange(1, cfg.vocab_size) for _ in range(rng.randint(2, 5))]
+        for _ in range(6)
+    ]
+    expected = [
+        [int(t) for t in gpt_lib.generate(
+            cfg, params, jnp.asarray([prompt], jnp.int32), max_new,
+        )[0]]
+        for prompt in prompts
+    ]
+
+    stop_evt = threading.Event()
+    out_lock = locks.make_lock("autoscale_smoke.outcomes")
+    outcomes: List[dict] = []
+
+    def driver() -> None:
+        # continuous load, one stream at a time: streams keep flowing
+        # through the chaos window, the scale-out boot, and the
+        # scale-in drain, so "zero lost streams" covers all of it
+        k = 0
+        while not stop_evt.is_set():
+            i = k % len(prompts)
+            slowed = factory.delay_s > 0
+            rec = {
+                "i": i, "chain": None, "error": None,
+                "trace": None, "slowed": slowed,
+            }
+            try:
+                final = None
+                for event in router.generate_stream(
+                    prompts[i], max_new,
+                    corr=f"autoscale-{seed}-{k}", timeout=120.0,
+                ):
+                    if event.get("done"):
+                        final = event
+                if final is not None:
+                    rec["chain"] = final["tokens"][0]
+                    rec["trace"] = final.get("trace_id")
+            except Exception as err:  # noqa: BLE001 — asserted below
+                rec["error"] = f"{type(err).__name__}: {err}"
+            with out_lock:
+                outcomes.append(rec)
+            k += 1
+            time.sleep(0.01)
+
+    # the flight ring is shared with every in-process replica (engine
+    # admit/evict records etc.) and wraps well within the run, so the
+    # scale records are accumulated per pump, not snapshotted at the end
+    seen_scale: Dict[int, object] = {}
+
+    def pump() -> None:
+        # one observatory-shaped control step: refresh history,
+        # evaluate alerts, let the autoscaler act, reconcile, sync,
+        # and re-probe (the router only probes on demand; the real
+        # deployment's observatory interval ticker covers this)
+        history.tick()
+        manager.evaluate()
+        autoscaler.tick()
+        controller.run_until_quiet()
+        fleet.sync()
+        router.probe()
+        for rec in flight.snapshot(kind="scale"):
+            seen_scale.setdefault(rec.seq, rec)
+
+    def live_ready() -> int:
+        return sum(
+            1 for r in router.stats()["replicas"].values() if r["ready"]
+        )
+
+    started = time.monotonic()
+    problems: List[str] = []
+    baseline_scales = 0
+    scaled_out = False
+    scaled_in = False
+    driver_t = threading.Thread(
+        target=driver, name="autoscale-driver", daemon=True
+    )
+    try:
+        substrate.create_serve_service(svc)
+        controller.run_until_quiet()
+        fleet.sync()
+        fleet.wait_ready(1)
+        driver_t.start()
+
+        # phase 1 — baseline: in-SLO traffic, the autoscaler must
+        # hold still
+        deadline = time.monotonic() + 2.0
+        while time.monotonic() < deadline:
+            pump()
+            time.sleep(0.1)
+        baseline_scales = len(seen_scale)
+
+        # phase 2 — ramp: every request +delay_s TTFT; the fast burn
+        # window fires, the autoscaler scales out, the reconciler
+        # creates the pod, the fleet boots it
+        factory.delay_s = delay_s
+        deadline = time.monotonic() + 60.0
+        while time.monotonic() < deadline:
+            pump()
+            if len(fleet.replica_names()) >= 2 and live_ready() >= 2:
+                scaled_out = True
+                break
+            time.sleep(0.05)
+
+        # phase 3 — clear: fault off; the slow window resolves, the
+        # cooldown passes, the autoscaler steps the group back to
+        # minReplicas, and each departing replica drains out
+        factory.delay_s = 0.0
+        deadline = time.monotonic() + 90.0
+        while time.monotonic() < deadline:
+            pump()
+            if (
+                len(fleet.replica_names()) == 1
+                and not manager.firing()
+            ):
+                scaled_in = True
+                break
+            time.sleep(0.05)
+    finally:
+        stop_evt.set()
+        driver_t.join(timeout=120.0)
+        fleet.stop()
+        controller.stop()
+
+    if baseline_scales:
+        problems.append(
+            f"{baseline_scales} scale decisions on baseline traffic"
+        )
+    if not scaled_out:
+        problems.append("fleet never scaled out under chaos latency")
+    if not scaled_in:
+        problems.append(
+            "fleet did not scale back to minReplicas after recovery"
+        )
+
+    scale_records = [
+        seen_scale[seq] for seq in sorted(seen_scale)
+    ]
+    outs = [
+        r for r in scale_records
+        if r.fields.get("direction") == "out"
+    ]
+    ins = [
+        r for r in scale_records
+        if r.fields.get("direction") == "in"
+    ]
+    if not outs:
+        problems.append("no kind=scale direction=out flight records")
+    if not ins:
+        problems.append("no kind=scale direction=in flight records")
+    if outs and not any(
+        str(r.fields.get("reason", "")).startswith("burn:")
+        for r in outs
+    ):
+        problems.append(
+            "no scale-out decision attributed to the burn alert"
+        )
+
+    # no-oscillation: within a role, consecutive decisions must sit
+    # at least a cooldown apart (each decision starts one) — so the
+    # direction can change at most once per cooldown window
+    by_role: Dict[str, List] = {}
+    for rec in scale_records:
+        by_role.setdefault(str(rec.fields.get("role")), []).append(rec)
+    for role, recs in by_role.items():
+        recs.sort(key=lambda r: r.t)
+        for prev, cur in zip(recs, recs[1:]):
+            gap = cur.t - prev.t
+            if gap < cooldown_s * 0.95:
+                problems.append(
+                    f"{role}: decisions {gap:.2f}s apart "
+                    f"(< cooldown {cooldown_s}s): thrash"
+                )
+
+    # the out record must carry the triggering alert's trace samples,
+    # and they must intersect the requests slowed by the fault
+    with out_lock:
+        done = list(outcomes)
+    slowed_traces = {
+        rec["trace"] for rec in done if rec["slowed"] and rec["trace"]
+    }
+    out_traces = {
+        t
+        for rec in outs
+        for t in str(rec.fields.get("traces", "")).split(",")
+        if t
+    }
+    if outs and not (out_traces & slowed_traces):
+        problems.append(
+            f"scale-out trace samples {sorted(out_traces)[:4]} do not "
+            f"intersect the slowed requests "
+            f"{sorted(slowed_traces)[:4]}"
+        )
+
+    lost = [
+        f"{i}: {rec['error']}" for i, rec in enumerate(done)
+        if rec["chain"] is None
+    ]
+    diverged = [
+        i for i, rec in enumerate(done)
+        if rec["chain"] is not None and rec["chain"] != expected[rec["i"]]
+    ]
+    if lost:
+        problems.append(f"lost streams: {lost}")
+    if diverged:
+        problems.append(f"diverged streams: {diverged}")
+    if not done:
+        problems.append("driver completed no streams")
+
+    summary = {
+        "seed": seed,
+        "streams": len(done),
+        "scale_out_records": len(outs),
+        "scale_in_records": len(ins),
+        "fast_window": fast_key,
+        "autoscaler": autoscaler.describe(),
+        "latency_faults": fault_log.counts().get(FAULT_LATENCY, 0),
+        "lost": lost,
+        "diverged": diverged,
+        "problems": problems,
+        "seconds": round(time.monotonic() - started, 2),
+        "ok": not problems,
+    }
+    if not summary["ok"]:
+        raise AssertionError(
+            f"autoscale smoke failed: {json.dumps(summary)}"
+        )
+    return summary
+
+
 def main(argv: Optional[List[str]] = None) -> int:
     parser = argparse.ArgumentParser(
         description="ServeService fleet soaks (failover / disagg)"
@@ -1246,6 +1635,13 @@ def main(argv: Optional[List[str]] = None) -> int:
         "of SLO, the fast burn window fires, the fault clears, the "
         "alert resolves — with trace-correlated alert flight records",
     )
+    mode.add_argument(
+        "--autoscale-smoke", action="store_true",
+        help="closed-loop autoscaling smoke: chaos latency trips the "
+        "burn alert, the autoscaler scales the decode group out, the "
+        "fault clears, the group drains back in — no oscillation, "
+        "zero lost streams, trace-correlated kind=scale records",
+    )
     parser.add_argument("--seed", type=int, default=0)
     parser.add_argument("--replicas", type=int, default=3)
     parser.add_argument("--streams", type=int, default=6)
@@ -1262,6 +1658,10 @@ def main(argv: Optional[List[str]] = None) -> int:
         summary = run_trace_smoke(seed=args.seed, max_new=args.max_new)
     elif args.alert_smoke:
         summary = run_alert_smoke(seed=args.seed, max_new=args.max_new)
+    elif args.autoscale_smoke:
+        summary = run_autoscale_smoke(
+            seed=args.seed, max_new=args.max_new
+        )
     else:
         summary = run_failover_soak(
             seed=args.seed, replicas=args.replicas, streams=args.streams,

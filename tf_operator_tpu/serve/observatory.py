@@ -63,6 +63,13 @@ _FLEET_FAMILIES = {
 _Q_DEPTH = _SERVE + "engine_queue_depth"
 _KV_IN_USE = _SERVE + "engine_kv_blocks_in_use"
 _KV_TOTAL = _SERVE + "engine_kv_blocks_total"
+# per-tenant QoS counters (server.py admission); summed fleet-wide and
+# ingested as fleet_tenant_* history series so the autoscaler's
+# describe() can report live reject rates per tenant
+_TENANT_PREFIXES = (
+    _SERVE + "tenant_requests_total{",
+    _SERVE + "tenant_rejected_total{",
+)
 _ROUTER = "tf_operator_tpu_router_"
 # router-registry families: the hops only the router can time, plus
 # the client-visible end-to-end numbers (observed per streamed token,
@@ -134,6 +141,7 @@ def fleet_slo(router, history=None, alerts=None) -> dict:
     queue_depth = 0.0
     kv_in_use = 0.0
     kv_total = 0.0
+    tenant_sums: Dict[str, float] = {}
     unreachable: List[str] = []
     clients = router.clients()
     for name, client in clients.items():
@@ -147,6 +155,12 @@ def fleet_slo(router, history=None, alerts=None) -> dict:
         queue_depth += flat.get(_Q_DEPTH, 0.0)
         kv_in_use += flat.get(_KV_IN_USE, 0.0)
         kv_total += flat.get(_KV_TOTAL, 0.0)
+        for sample, value in flat.items():
+            if sample.startswith(_TENANT_PREFIXES):
+                # "..._serve_tenant_x_total{tenant=\"t\"}" ->
+                # "fleet_tenant_x_total{tenant=\"t\"}"
+                short = "fleet_" + sample[len(_SERVE):]
+                tenant_sums[short] = tenant_sums.get(short, 0.0) + value
 
     fleet = {
         key: _quantiles(sorted(acc.items()))
@@ -226,6 +240,19 @@ def fleet_slo(router, history=None, alerts=None) -> dict:
         history.ingest_value(
             "fleet_scrape_errors", "gauge", float(len(unreachable))
         )
+        # fleet-summed per-tenant counters stay cumulative: rate()
+        # over the series is the live reject/request rate per tenant
+        for series, value in sorted(tenant_sums.items()):
+            history.ingest_value(series, "counter", value)
+
+    tenants: Dict[str, Dict[str, float]] = {}
+    for series, value in tenant_sums.items():
+        tenant = series.split('tenant="', 1)[-1].rstrip('"}')
+        field = (
+            "rejected" if "tenant_rejected_total" in series
+            else "requests"
+        )
+        tenants.setdefault(tenant, {})[field] = value
 
     report = {
         "fleet": {
@@ -236,6 +263,7 @@ def fleet_slo(router, history=None, alerts=None) -> dict:
             "unreachable": unreachable,
             "scrape_errors": len(unreachable),
             "partial": partial,
+            "tenants": tenants,
         },
         "router": {
             **router_slo,
@@ -277,12 +305,15 @@ def router_trace(
     )
 
 
-def observatory_tick(router, history, alerts) -> dict:
+def observatory_tick(router, history, alerts, autoscaler=None) -> dict:
     """One observatory cadence step: scrape the fleet into history,
-    snapshot any tracked sources, evaluate alert rules. Returns the
-    fleet_slo report (with the alerts summary folded in)."""
+    snapshot any tracked sources, evaluate alert rules, and — when an
+    autoscaler is wired — let the alert state actuate. Returns the
+    fleet_slo report (with alerts and scaling decisions folded in)."""
     report = fleet_slo(router, history=history, alerts=alerts)
     history.tick()
+    if autoscaler is not None:
+        report["scale_decisions"] = autoscaler.tick()
     return report
 
 
@@ -294,6 +325,7 @@ def make_observatory(
     alerts: Optional[AlertManager] = None,
     history_capacity: int = 512,
     interval_s: float = 0.0,
+    autoscaler=None,
 ) -> ThreadingHTTPServer:
     """In-process observatory server over `router`; caller owns
     serve_forever/shutdown (same contract as serve/server.py
@@ -344,9 +376,12 @@ def make_observatory(
             elif parsed.path == "/debug/routez":
                 self._reply_json(200, router.stats())
             elif parsed.path == "/debug/slozz":
-                self._reply_json(
-                    200, fleet_slo(router, history=history, alerts=alerts)
+                report = fleet_slo(
+                    router, history=history, alerts=alerts
                 )
+                if autoscaler is not None:
+                    report["autoscaler"] = autoscaler.describe()
+                self._reply_json(200, report)
             elif parsed.path == "/debug/historyz":
                 raw = render_historyz(history, parsed.query)
                 self.send_response(200)
@@ -392,6 +427,7 @@ def make_observatory(
     server = ObservatoryServer((host, port), Handler)
     server.history = history  # type: ignore[attr-defined]
     server.alerts = alerts  # type: ignore[attr-defined]
+    server.autoscaler = autoscaler  # type: ignore[attr-defined]
     server.clock_cache = clock_cache  # type: ignore[attr-defined]
     if interval_s > 0:
         stop = threading.Event()
@@ -399,7 +435,9 @@ def make_observatory(
         def _ticker() -> None:
             while not stop.wait(interval_s):
                 try:
-                    observatory_tick(router, history, alerts)
+                    observatory_tick(
+                        router, history, alerts, autoscaler=autoscaler
+                    )
                 except Exception:
                     pass
 

@@ -247,6 +247,12 @@ class LeastLoadedRouter:
         # as the routing dump: what was asked, who won, and every
         # candidate's itemized score at decision time
         self._decisions: collections.deque = collections.deque(maxlen=64)
+        # tenant budget state folded into placement: (replica, tenant)
+        # -> monotonic time until which that replica's QoS admission
+        # has said "not this tenant" (429 + Retry-After). A blocked
+        # pair is skipped while alternatives exist — the next replica
+        # may hold budget — and expires on its own
+        self._tenant_blocks: Dict[tuple, float] = {}
 
     # -- membership --------------------------------------------------------
 
@@ -364,6 +370,7 @@ class LeastLoadedRouter:
         role: Optional[str] = None,
         prefix_hashes: Optional[dict] = None,
         trace: Optional[str] = None,
+        tenant: Optional[str] = None,
     ) -> Replica:
         """Pick the lowest-scored ready replica, preferring ones this
         request hasn't failed on; blocks (probing) until one exists or
@@ -374,7 +381,11 @@ class LeastLoadedRouter:
         ready set (the monolithic path — every replica serves every
         route). prefix_hashes ({block_size: set-of-hashes}) folds
         prefix overlap into the score so shared-prefix families land
-        where their blocks already live."""
+        where their blocks already live. tenant folds QoS budget state
+        in: replicas that recently 429'd this tenant are avoided while
+        un-blocked alternatives exist (soft preference — when every
+        candidate is blocked the lowest score still wins, and the
+        caller's all-rejected check decides whether to propagate)."""
         while True:
             with self._lock:
                 ready = [
@@ -393,6 +404,16 @@ class LeastLoadedRouter:
                     # have recovered; the probe below re-vetted it)
                     tried.clear()
                     candidates = pool
+                if tenant and candidates:
+                    now_m = time.monotonic()
+                    unblocked = [
+                        r for r in candidates
+                        if self._tenant_blocks.get(
+                            (r.name, tenant), 0.0
+                        ) <= now_m
+                    ]
+                    if unblocked:
+                        candidates = unblocked
                 if candidates:
                     best = min(
                         candidates,
@@ -537,12 +558,29 @@ class LeastLoadedRouter:
             replica.failures += 1
             self.failovers += 1
 
+    def _note_tenant_reject(
+        self, replica: Replica, tenant: str, retry_after: float
+    ) -> None:
+        """Remember a replica's QoS 429 for this tenant until its
+        Retry-After elapses, so placement steers the tenant's next
+        streams elsewhere first."""
+        until = time.monotonic() + max(0.1, float(retry_after))
+        with self._lock:
+            self._tenant_blocks[(replica.name, tenant)] = until
+            if len(self._tenant_blocks) > 256:
+                now_m = time.monotonic()
+                self._tenant_blocks = {
+                    k: v for k, v in self._tenant_blocks.items()
+                    if v > now_m
+                }
+
     def generate_stream(
         self,
         input_ids: List[int],
         max_new_tokens: int = 16,
         corr: Optional[str] = None,
         timeout: Optional[float] = None,
+        tenant: Optional[str] = None,
     ):
         """One logical stream across the fleet: yields {"token",
         "index", "replica"} per generated token, then a final
@@ -552,10 +590,16 @@ class LeastLoadedRouter:
         Mid-stream replica failures are replayed on another replica
         with prompt+emitted (see module docstring); 4xx rejections
         propagate as DecodeError (replaying a request the server
-        called invalid cannot help). Every hop — the stream itself,
-        migrations, failover replays — carries the request's ONE
-        trace id, so /debug/tracez?trace=<id> merges the whole
-        cross-replica journey."""
+        called invalid cannot help). The exception is a QoS 429 (the
+        typed {"rejected": ...} event the client surfaces before the
+        first byte): budget is per-replica, so the stream tries the
+        other ready replicas first and only propagates DecodeError
+        429 — carrying the smallest Retry-After seen as a
+        `retry_after` attribute — once every one of them has said no.
+        tenant rides out as the X-Tenant header on every hop. Every
+        hop — the stream itself, migrations, failover replays —
+        carries the request's ONE trace id, so /debug/tracez?trace=
+        <id> merges the whole cross-replica journey."""
         prompt = [int(t) for t in input_ids]
         new = int(max_new_tokens)
         if corr is None:
@@ -569,6 +613,9 @@ class LeastLoadedRouter:
         emitted: List[int] = []
         failovers = 0
         tried: set = set()
+        # replica name -> Retry-After from a QoS 429; once every ready
+        # replica is in here the request is fleet-rejected
+        rejected_by: Dict[str, float] = {}
         self._record(
             corr, "route", trace=trace.trace_id,
             prompt_tokens=len(prompt), new=new,
@@ -586,6 +633,7 @@ class LeastLoadedRouter:
             replica = self._acquire(
                 tried, deadline, corr, role="decode",
                 prefix_hashes=prefix_hashes, trace=trace.trace_id,
+                tenant=tenant,
             )
             if not emitted:
                 if not migrate_tried:
@@ -605,6 +653,41 @@ class LeastLoadedRouter:
                 self._maybe_migrate(
                     replica, prompt, corr, prefix_hashes, trace=trace,
                 )
+            def handle_reject(retry_after: float, message: str):
+                """Shared 429 bookkeeping (typed event or raised
+                DecodeError): steer the tenant away from the replica,
+                and once EVERY ready replica has said no, propagate a
+                DecodeError 429 carrying the smallest Retry-After —
+                the fleet itself is over budget for this tenant."""
+                rejected_by[replica.name] = retry_after
+                tried.add(replica.name)
+                self._note_tenant_reject(
+                    replica, tenant or "default", retry_after
+                )
+                self._record(
+                    corr, "qos-reject", trace=trace.trace_id,
+                    replica=replica.name, tenant=tenant or "",
+                    retry_after=round(retry_after, 3),
+                )
+                with self._lock:
+                    pool = [
+                        r.name for r in self._replicas.values()
+                        if r.ready and not r.draining
+                    ]
+                if pool and all(n in rejected_by for n in pool):
+                    err = DecodeError(
+                        429, message or "tenant over budget on "
+                        "every ready replica",
+                    )
+                    err.retry_after = min(rejected_by.values())
+                    self._record(
+                        corr, "route-rejected", trace=trace.trace_id,
+                        tenant=tenant or "",
+                        retry_after=round(err.retry_after, 3),
+                    )
+                    raise err
+
+            rejected = None
             try:
                 # bind the trace around the CONNECT only (the client's
                 # generate_stream builds + sends the request eagerly
@@ -612,9 +695,16 @@ class LeastLoadedRouter:
                 # rides out, and no yield happens inside the scope
                 with trace_scope(trace_id=trace.trace_id):
                     inner = replica.client.generate_stream(
-                        prompt + emitted, new - len(emitted)
+                        prompt + emitted, new - len(emitted),
+                        tenant=tenant,
                     )
                 for event in inner:
+                    if event.get("rejected"):
+                        # QoS early-reject — always pre-first-byte
+                        # (the client's contract), so nothing was
+                        # emitted and another replica can serve whole
+                        rejected = event
+                        break
                     if "token" in event:
                         now = time.perf_counter()
                         if first_token_at is None:
@@ -634,6 +724,16 @@ class LeastLoadedRouter:
                     if event.get("done"):
                         break
             except DecodeError as err:
+                if err.status == 429:
+                    # QoS reject raised instead of surfaced as a typed
+                    # event (an injected/legacy client): same budget
+                    # bookkeeping, then try the rest of the fleet
+                    self._release(replica)
+                    handle_reject(
+                        float(getattr(err, "retry_after", 0) or 1.0),
+                        str(err),
+                    )
+                    continue
                 if err.status < 500 and err.status != 200:
                     # the server judged the request itself bad; a
                     # different replica will say the same thing
@@ -671,6 +771,12 @@ class LeastLoadedRouter:
                 raise
             else:
                 self._release(replica)
+                if rejected is not None:
+                    handle_reject(
+                        float(rejected.get("retry_after") or 1.0),
+                        str(rejected.get("error") or ""),
+                    )
+                    continue
                 if len(emitted) < new:
                     # clean end-of-stream before the token budget was
                     # met (e.g. the replica began draining and closed
@@ -701,6 +807,7 @@ class LeastLoadedRouter:
         max_new_tokens: int = 16,
         corr: Optional[str] = None,
         timeout: Optional[float] = None,
+        tenant: Optional[str] = None,
     ) -> List[List[int]]:
         """Non-streaming fan-out: each row rides its own
         generate_stream (so every row gets mid-request failover), and
@@ -709,7 +816,8 @@ class LeastLoadedRouter:
         for row in input_ids:
             final: Optional[dict] = None
             for event in self.generate_stream(
-                row, max_new_tokens, corr=corr, timeout=timeout
+                row, max_new_tokens, corr=corr, timeout=timeout,
+                tenant=tenant,
             ):
                 if event.get("done"):
                     final = event
@@ -723,10 +831,17 @@ class LeastLoadedRouter:
         (score_components), the prefix-cache counters scraped from
         each engine, and the recent placement-decision ring."""
         with self._lock:
+            now_m = time.monotonic()
             return {
                 "failovers": self.failovers,
                 "migrations": self.migrations,
                 "migrate_failures": self.migrate_failures,
+                "tenant_blocks": {
+                    f"{name}/{tenant}": round(until - now_m, 3)
+                    for (name, tenant), until
+                    in self._tenant_blocks.items()
+                    if until > now_m
+                },
                 "replicas": {
                     r.name: {
                         "ready": r.ready,

@@ -163,6 +163,10 @@ class _State:
         self.lock = locks.make_lock("_State.lock")
         self.batcher = None  # set by make_server (batching="window")
         self.engine = None  # set by make_server (batching="continuous")
+        # per-tenant QoS admission (TenantQoS), set by make_server
+        # when tenant quotas are configured; None = every request
+        # admitted as the default tenant, no early reject
+        self.qos = None
         # metric history + alert manager (telemetry/history.py,
         # telemetry/alerts.py), wired by make_server so the capacity /
         # rule knobs stay construction params; served at
@@ -245,6 +249,165 @@ class _State:
                 rows.append(f"{full} {format_value(value)}")
             out += "\n".join(rows) + "\n"
         return out
+
+
+# the tenant header the admission layer reads; absent -> DEFAULT_TENANT
+TENANT_HEADER = "X-Tenant"
+DEFAULT_TENANT = "default"
+
+# priority classes: name -> (engine priority, SLO-reject multiple).
+# Engine priority orders the scheduler stage (higher overtakes lower
+# while queued); the multiple scales the SLO-aware early-reject
+# threshold — batch work is shed first under queue pressure, high
+# holds on the longest.
+PRIORITY_CLASSES = {
+    "high": (2, 4.0),
+    "standard": (1, 2.0),
+    "batch": (0, 1.0),
+}
+
+
+class TenantQoS:
+    """Per-tenant token-bucket quotas + priority classes + SLO-aware
+    early reject, enforced at POST admission.
+
+    quotas: {tenant: {"rate": tokens/s, "burst": tokens,
+    "priority": "high"|"standard"|"batch"}}; the "*" entry is the
+    default for tenants not named (no "*" = unnamed tenants are
+    unmetered at standard priority). Cost is the request's worst-case
+    generated tokens (max_new_tokens x rows) — the unit the engine
+    actually spends.
+
+    Two reject paths, both HTTP 429 with a Retry-After the caller can
+    trust (never a silent queue timeout):
+    - bucket empty: Retry-After = time for the bucket to refill to the
+      request's cost;
+    - queue pressure: the live queue-wait p95 over the last minute
+      (history.quantile_over_window) projected past the class's
+      multiple of the TTFT SLO — Retry-After = that projected wait.
+    Both are capped at the client/router's RETRY_AFTER_CAP."""
+
+    def __init__(
+        self,
+        quotas,
+        ttft_slo_s: float = 0.25,
+        history=None,
+        registry=None,
+        queue_wait_series: str =
+        "tf_operator_tpu_serve_queue_wait_seconds",
+        queue_window_s: float = 60.0,
+        clock=None,
+    ) -> None:
+        import time as _time
+
+        self.clock = clock if clock is not None else _time
+        self.ttft_slo_s = float(ttft_slo_s)
+        self.history = history
+        self.queue_wait_series = queue_wait_series
+        self.queue_window_s = float(queue_window_s)
+        self.quotas = {}
+        for tenant, quota in (quotas or {}).items():
+            cls = quota.get("priority", "standard")
+            if cls not in PRIORITY_CLASSES:
+                raise ValueError(
+                    f"tenant {tenant!r}: priority must be one of "
+                    f"{sorted(PRIORITY_CLASSES)}, got {cls!r}"
+                )
+            rate = quota.get("rate")
+            if rate is not None and float(rate) <= 0:
+                raise ValueError(
+                    f"tenant {tenant!r}: rate must be > 0, got {rate}"
+                )
+            self.quotas[str(tenant)] = {
+                "rate": float(rate) if rate is not None else None,
+                "burst": float(
+                    quota.get("burst", (rate or 0) * 2 or 1)
+                ),
+                "priority": cls,
+            }
+        self._lock = locks.make_lock("TenantQoS._lock")
+        # tenant -> [bucket level, last refill monotonic]
+        self._buckets = {}
+        self._c_requests = None
+        self._c_rejected = None
+        if registry is not None:
+            self._c_requests = registry.counter(
+                "tenant_requests_total",
+                "Decode requests seen at admission, by tenant",
+                labelnames=("tenant",),
+            )
+            self._c_rejected = registry.counter(
+                "tenant_rejected_total",
+                "Requests early-rejected with 429, by tenant",
+                labelnames=("tenant",),
+            )
+
+    def _quota(self, tenant: str):
+        return self.quotas.get(tenant) or self.quotas.get("*")
+
+    def priority(self, tenant: str) -> int:
+        quota = self._quota(tenant)
+        cls = quota["priority"] if quota else "standard"
+        return PRIORITY_CLASSES[cls][0]
+
+    def admit(self, tenant: str, cost: float) -> dict:
+        """-> {"ok": True, "priority": n} or {"ok": False,
+        "retry_after": s, "reason": ...}. Counts the request either
+        way; the caller turns a reject into the 429 reply."""
+        from ..runtime.retry import RETRY_AFTER_CAP
+
+        if self._c_requests is not None:
+            self._c_requests.labels(tenant=tenant).inc()
+        quota = self._quota(tenant)
+        cls = quota["priority"] if quota else "standard"
+        priority, slo_multiple = PRIORITY_CLASSES[cls]
+
+        # SLO-aware early reject: if the queue is already making
+        # requests wait past this class's budget, say so NOW with a
+        # projection instead of letting the stream time out silently
+        if self.history is not None:
+            projected = self.history.quantile_over_window(
+                self.queue_wait_series, 0.95, self.queue_window_s
+            )
+            if (
+                projected is not None
+                and projected > slo_multiple * self.ttft_slo_s
+            ):
+                if self._c_rejected is not None:
+                    self._c_rejected.labels(tenant=tenant).inc()
+                return {
+                    "ok": False,
+                    "reason": (
+                        f"queue wait p95 {projected:.3f}s exceeds "
+                        f"{slo_multiple:g}x the {self.ttft_slo_s:g}s "
+                        f"TTFT SLO for priority {cls!r}"
+                    ),
+                    "retry_after": min(RETRY_AFTER_CAP, max(1.0, projected)),
+                }
+
+        if quota is None or quota["rate"] is None:
+            return {"ok": True, "priority": priority}
+        now = self.clock.monotonic()
+        with self._lock:
+            level, last = self._buckets.get(
+                tenant, (quota["burst"], now)
+            )
+            level = min(quota["burst"], level + quota["rate"] * (now - last))
+            if level >= cost:
+                self._buckets[tenant] = (level - cost, now)
+                return {"ok": True, "priority": priority}
+            self._buckets[tenant] = (level, now)
+            wait = (cost - level) / quota["rate"]
+        if self._c_rejected is not None:
+            self._c_rejected.labels(tenant=tenant).inc()
+        return {
+            "ok": False,
+            "reason": (
+                f"tenant {tenant!r} over its token budget "
+                f"({quota['rate']:g} tokens/s, burst {quota['burst']:g})"
+            ),
+            "retry_after": min(RETRY_AFTER_CAP, max(1.0, wait)),
+        }
 
 
 def _bad(payload) -> tuple:
@@ -473,7 +636,9 @@ def DecodeHandlerFactory(state: _State):
         _request_corr = None
         _request_trace = None
 
-        def _reply(self, code: int, payload: dict) -> None:
+        def _reply(
+            self, code: int, payload: dict, headers=None
+        ) -> None:
             if self._request_corr is not None:
                 payload.setdefault("request_id", self._request_corr)
             if self._request_trace is not None:
@@ -482,6 +647,8 @@ def DecodeHandlerFactory(state: _State):
             self.send_response(code)
             self.send_header("Content-Type", "application/json")
             self.send_header("Content-Length", str(len(body)))
+            for name, value in (headers or {}).items():
+                self.send_header(name, value)
             self.end_headers()
             self.wfile.write(body)
 
@@ -713,12 +880,47 @@ def DecodeHandlerFactory(state: _State):
                 return self._reply(*result)
             (prompt, lens, new, temperature, seed, top_k, top_p,
              num_beams) = result
+
+            # per-tenant QoS admission: quota + SLO-aware early
+            # reject, BEFORE any engine/batcher work is queued. A 429
+            # always carries Retry-After (projected queue wait or
+            # bucket refill) — never a silent queue timeout.
+            tenant = (
+                self.headers.get(TENANT_HEADER) or DEFAULT_TENANT
+            ).strip() or DEFAULT_TENANT
+            priority = 0
+            if state.qos is not None:
+                import math
+
+                verdict = state.qos.admit(tenant, new * len(lens))
+                if not verdict["ok"]:
+                    with state.lock:
+                        state.request_errors += 1
+                    retry_after = verdict["retry_after"]
+                    default_flight().record(
+                        "serve", op="early-reject", tenant=tenant,
+                        retry_after=round(retry_after, 3),
+                        reason=verdict["reason"][:120],
+                    )
+                    return self._reply(
+                        429,
+                        {
+                            "error": verdict["reason"],
+                            "tenant": tenant,
+                            "retry_after": round(retry_after, 3),
+                        },
+                        headers={
+                            "Retry-After":
+                            str(int(math.ceil(retry_after)))
+                        },
+                    )
+                priority = verdict["priority"]
             import jax
 
             if self.path == "/generate_stream":
                 return self._do_stream(
                     prompt, lens, new, temperature, seed, top_k, top_p,
-                    num_beams,
+                    num_beams, priority,
                 )
 
             if num_beams > 1:
@@ -761,7 +963,9 @@ def DecodeHandlerFactory(state: _State):
                 # Sampled requests keep the inline path (the engine is
                 # greedy-only, same scoping as the batcher).
                 try:
-                    chains = state.engine.generate(prompt, lens, new)
+                    chains = state.engine.generate(
+                        prompt, lens, new, priority=priority
+                    )
                 except ValueError as err:
                     # the engine judged the request itself invalid
                     # (oversized prompt, over-budget KV reservation):
@@ -987,7 +1191,7 @@ def DecodeHandlerFactory(state: _State):
 
         def _do_stream(
             self, prompt, lens, new, temperature, seed, top_k, top_p,
-            num_beams,
+            num_beams, priority=0,
         ) -> None:
             """/generate_stream: chunked ndjson, one event per
             generated token. With the continuous engine, events leave
@@ -1013,7 +1217,8 @@ def DecodeHandlerFactory(state: _State):
             if state.engine is not None and greedy:
                 try:
                     req = state.engine.submit(
-                        prompt[0, :lens[0]].tolist(), new
+                        prompt[0, :lens[0]].tolist(), new,
+                        priority=priority,
                     )
                 except ValueError as err:
                     # invalid request (oversized prompt / KV budget):
@@ -1223,6 +1428,7 @@ def make_server(
     alerts: bool = True,
     alert_rules=None,
     ttft_slo_s: float = 0.25,
+    tenant_quotas=None,
 ) -> ThreadingHTTPServer:
     """In-process server (tests and embedders); caller owns
     serve_forever/shutdown. The CLI binds 0.0.0.0 (pods must be
@@ -1376,6 +1582,16 @@ def make_server(
             state.alerts.start(history_interval_s)
         else:
             state.history.start(history_interval_s)
+    if tenant_quotas is not None:
+        # per-tenant QoS admission: quotas/priority classes from the
+        # caller, the queue-wait projection from the same history the
+        # alert rules read — one clock, one source of truth
+        state.qos = TenantQoS(
+            tenant_quotas,
+            ttft_slo_s=ttft_slo_s,
+            history=state.history,
+            registry=state.registry,
+        )
     if batching == "window":
         from .batching import DynamicBatcher
 
@@ -1729,6 +1945,18 @@ def main(argv=None) -> int:
         "first tokens under this; must sit on a TTFT bucket edge)",
     )
     parser.add_argument(
+        "--tenant-quotas", default="",
+        metavar="JSON",
+        help="per-tenant QoS admission, e.g. "
+        '\'{"noisy": {"rate": 100, "burst": 200, "priority": '
+        '"batch"}, "*": {"priority": "standard"}}\': token-bucket '
+        "rate/burst in generated tokens, priority class high/"
+        "standard/batch ('*' = default for unnamed tenants). Tenant "
+        "id comes from the X-Tenant request header; over-budget or "
+        "queue-pressured requests get 429 + Retry-After instead of "
+        "a queue timeout. Empty = QoS off",
+    )
+    parser.add_argument(
         "--smoke", action="store_true",
         help="self-contained telemetry smoke: boot a tiny continuous-"
         "batching server, drive two requests, validate the /metrics "
@@ -1802,6 +2030,15 @@ def main(argv=None) -> int:
             )
     if args.slots < 1:
         parser.error("--slots must be >= 1")
+    tenant_quotas = None
+    if args.tenant_quotas:
+        try:
+            tenant_quotas = json.loads(args.tenant_quotas)
+            if not isinstance(tenant_quotas, dict):
+                raise ValueError("must be a JSON object")
+            TenantQoS(tenant_quotas)  # field validation, pre-jax
+        except ValueError as exc:
+            parser.error(f"--tenant-quotas: {exc}")
     if args.batching == "continuous" and args.kv_layout == "paged":
         if args.block_size < 1 or _max_seq(cfg) % args.block_size:
             parser.error(
@@ -1938,6 +2175,7 @@ def main(argv=None) -> int:
         history_interval_s=max(0.0, args.history_interval),
         alerts=args.alerts == "on",
         ttft_slo_s=args.ttft_slo_ms / 1000.0,
+        tenant_quotas=tenant_quotas,
     )
     logger.info("decode server on :%d", server.server_address[1])
     # graceful drain — the serving sibling of the training-side
