@@ -24,7 +24,7 @@ IMAGE_DIR := build/images
 DIST      := build/dist
 
 .PHONY: ci presubmit lint analyze native native-test native-race test wire-test e2e e2e-kind bench \
-        chaos-soak serve-soak serve-paged serve-sharded serve-disagg trace-smoke alert-smoke autoscale-smoke bench-regression ha-soak controller-profile images release mnist-acc clean
+        chaos-soak serve-soak serve-paged serve-sharded serve-spec serve-disagg trace-smoke alert-smoke autoscale-smoke bench-regression ha-soak controller-profile images release mnist-acc clean
 
 # `test` already runs the whole tests/ tree (native bindings, wire,
 # E2E suites included) — native-test/wire-test exist for targeted runs,
@@ -112,6 +112,16 @@ serve-paged:
 serve-sharded:
 	env JAX_PLATFORMS=cpu $(PY) -m tf_operator_tpu.serve.engine --smoke \
 	    --layout paged --block-size 8 --prefill-chunk 6 --mesh 1x2
+
+# speculative decoding smoke (docs/serving.md "Speculative
+# decoding"): ngram prompt-lookup drafts + the multi-token verify
+# program on the paged engine, every chain bit-identical to inline
+# generate, tokens proposed/accepted counted, one compile per program
+# including verify (CI's serve-spec-smoke)
+serve-spec:
+	env JAX_PLATFORMS=cpu $(PY) -m tf_operator_tpu.serve.engine --smoke \
+	    --layout paged --block-size 16 --prefill-chunk 16 \
+	    --speculate ngram --spec-depth 4
 
 # disaggregated prefill/decode smoke (docs/serving.md "Disaggregated
 # prefill/decode"): 1 prefill + 1 decode replica via role-typed

@@ -88,6 +88,34 @@ BASELINES = [
         "band": 1.0,  # slot arithmetic, not a measurement
     },
     {
+        "check": "serve-spec-accept-rate",
+        "artifact": "serve_bench",
+        "path": "engine_speculative.ngram.accept_rate",
+        "baseline": 0.9726,
+        "direction": "min",
+        "band": 0.6,  # memorized workload: acceptance collapsing
+        # toward the 0.5 floor the bench itself asserts is the signal
+    },
+    {
+        "check": "serve-spec-tokens-per-verify",
+        "artifact": "serve_bench",
+        "path": "engine_speculative.ngram.tokens_per_verify_step",
+        "baseline": 19.75,
+        "direction": "min",
+        "band": 0.5,  # the dispatch-amortization claim itself
+    },
+    {
+        "check": "serve-spec-itl-p95-speedup",
+        "artifact": "serve_bench",
+        "path": "engine_speculative.itl_p95_speedup",
+        "baseline": 170.0,
+        "direction": "min",
+        "band": 0.05,  # wide: intra-round gaps are near the clock's
+        # floor so the ratio is noisy — any value over ~8.5x still
+        # proves the win; < 1.0 additionally fails the bench's own
+        # its-not-better assertion
+    },
+    {
         "check": "serve-tenant-small-ttft-p95",
         "artifact": "serve_bench",
         "path": "mixed_tenant.small_ttft_p95_s",

@@ -544,6 +544,114 @@ def paged_scenarios(cfg, params) -> dict:
     return out
 
 
+def engine_spec_scenarios(cfg=None) -> dict:
+    """Engine-level speculative decoding A/B: the continuous-batching
+    engine with ``speculate='ngram'`` (host-side prompt lookup over
+    each slot's committed chain + one multi-token verify program)
+    against the same engine with speculation off, on the memorized
+    workload (the input-grounded regime prompt lookup exists for —
+    a random-init model's continuation is not n-gram predictable,
+    see _memorizing_params). Measures streamed inter-token latency
+    p50/p95, committed tokens per verify step, and the acceptance
+    rate; raises on any acceptance regression — chains diverging
+    between modes, accept rate under 0.5, or ITL p95 not better
+    speculated — so a stale SERVE_BENCH.json can never hide one."""
+    from tf_operator_tpu.models import gpt as gpt_lib
+    from tf_operator_tpu.serve.engine import ContinuousBatchingEngine
+
+    if cfg is None:
+        cfg = _shapes(jax.devices()[0].platform == "tpu")[0]
+    params, pat, loss = _memorizing_params(cfg)
+    prompt_len = max(48, cfg.max_seq_len // 8)
+    prompt = [int(t) for t in pat[0][:prompt_len]]
+    new = min(64, cfg.max_seq_len - prompt_len - 1)
+    depth = 24  # deep window: the memorized chain keeps accepting
+    out = {
+        "workload": "memorized",
+        "mode": "ngram",
+        "spec_depth": depth,
+        "prompt_len": prompt_len,
+        "new_tokens": new,
+        "train_loss": round(loss, 5),
+    }
+    chains = {}
+    for mode in ("off", "ngram"):
+        eng = ContinuousBatchingEngine(
+            cfg, params, n_slots=4, kv_layout="paged", block_size=16,
+            prefill_chunk=16, speculate=mode, spec_depth=depth,
+        )
+        try:
+            # solo pass first: warms every program, and for the
+            # speculated engine slot-rounds == engine rounds here, so
+            # the committed-tokens-per-verify ratio is exact
+            solo = eng.submit(prompt, new)
+            solo_chain = solo.result(600)
+            solo_rounds = eng.spec_rounds
+            solo_accepted = eng.spec_accepted
+            gaps = []
+            glock = threading.Lock()
+
+            def consume(req):
+                last = None
+                for _ in req.stream(timeout=600):
+                    now = time.perf_counter()
+                    if last is not None:
+                        with glock:
+                            gaps.append(now - last)
+                    last = now
+
+            handles = [eng.submit(prompt, new) for _ in range(4)]
+            threads = [
+                threading.Thread(target=consume, args=(r,))
+                for r in handles
+            ]
+            for t in threads:
+                t.start()
+            for t in threads:
+                t.join(timeout=600)
+            chains[mode] = [solo_chain] + [h.result(600) for h in handles]
+            gaps.sort()
+            row = {
+                "streams": len(handles),
+                "itl_p50_s": round(percentile(gaps, 0.50), 5),
+                "itl_p95_s": round(percentile(gaps, 0.95), 5),
+            }
+            if mode == "ngram":
+                row["accept_rate"] = round(
+                    eng.spec_accepted / max(eng.spec_proposed, 1), 4
+                )
+                row["tokens_per_verify_step"] = round(
+                    (solo_accepted + solo_rounds)
+                    / max(solo_rounds, 1), 2
+                )
+                row["verify_rounds"] = eng.spec_rounds
+                row["fallback_steps"] = eng.spec_fallback_steps
+            eng.pool.check()
+            out[mode] = row
+        finally:
+            eng.stop()
+    if chains["ngram"] != chains["off"]:
+        raise AssertionError(
+            "speculative chains diverged from the non-speculative "
+            "engine's"
+        )
+    if len(set(map(tuple, chains["ngram"]))) != 1:
+        raise AssertionError("identical prompts produced split chains")
+    if out["ngram"]["accept_rate"] < 0.5:
+        raise AssertionError(
+            f"memorized-workload accept rate "
+            f"{out['ngram']['accept_rate']} under the 0.5 floor"
+        )
+    if out["ngram"]["itl_p95_s"] >= out["off"]["itl_p95_s"]:
+        raise AssertionError(
+            "speculated ITL p95 not better than non-speculative"
+        )
+    out["itl_p95_speedup"] = round(
+        out["off"]["itl_p95_s"] / max(out["ngram"]["itl_p95_s"], 1e-9), 2
+    )
+    return out
+
+
 def _sharded_child() -> dict:
     """Runs in a subprocess (see sharded_scenarios): JAX_PLATFORMS=cpu
     with --xla_force_host_platform_device_count=2 already in the
@@ -1443,6 +1551,7 @@ def run(write: bool = True) -> dict:
             moe_cfg, moe_params, moe_prompts, moe_new, n_clients
         ),
         "speculative": spec_scenarios(cfg, params, prompt_len, new),
+        "engine_speculative": engine_spec_scenarios(cfg),
         "paged_kv": paged_scenarios(cfg, params),
         "sharded": sharded_scenarios(),
         "disaggregated": disagg_scenarios(),
@@ -1464,7 +1573,14 @@ def run(write: bool = True) -> dict:
             "random-init model = worst case, memorized model = the "
             "favorable input-grounded regime; memorized_mixed_batch4 is "
             "the batch-min exposure (one random row dragging three "
-            "high-acceptance rows). moe_plain serves the MoE family "
+            "high-acceptance rows). engine_speculative is the "
+            "ENGINE-level A/B (serve --speculate): ngram prompt-lookup "
+            "drafts + one multi-token verify program against the "
+            "single-token engine on the memorized workload — streamed "
+            "ITL p50/p95, committed tokens per verify step, and accept "
+            "rate, chains bit-identical between modes; raises on "
+            "chain divergence, accept rate under 0.5, or ITL p95 not "
+            "better speculated. moe_plain serves the MoE family "
             "through the same live-HTTP harness (plain server; the "
             "batcher is a gpt-family feature). paged_kv A/Bs the "
             "paged KV layout against the dense grid at the engine "
@@ -1532,6 +1648,12 @@ def _merge_section(key: str, scenario) -> dict:
 if __name__ == "__main__":
     if len(sys.argv) > 1 and sys.argv[1] == "--sharded-child":
         print(json.dumps(_sharded_child()))
+        sys.exit(0)
+    if len(sys.argv) > 1 and sys.argv[1] == "--spec-engine-only":
+        print(json.dumps(
+            _merge_section("engine_speculative", engine_spec_scenarios),
+            indent=1,
+        ))
         sys.exit(0)
     if len(sys.argv) > 1 and sys.argv[1] == "--disagg-only":
         print(json.dumps(

@@ -70,6 +70,13 @@ DONATING_CALLABLES = {
     "PagedSlotDecodeStep:self._step": (1,),
     "PagedSlotDecodeStep:self._prefill": (1,),
     "PagedSlotDecodeStep:self._copy": (0,),
+    # speculative decoding: the multi-token verify program donates the
+    # paged cache exactly like the single-token step, and the engine
+    # calls it through both the jit'd handle and the public wrapper
+    "PagedSlotDecodeStep:self._verify": (1,),
+    "ContinuousBatchingEngine:self.step.verify": (1,),
+    # the draft model's compiled step donates its own (dense) cache
+    "ContinuousBatchingEngine:self.draft": (1,),
     "Trainer:self.step": (0,),
 }
 

@@ -1,0 +1,40 @@
+"""Use-after-donation on the speculative-decode programs.  The verify
+step donates the paged KV cache at position 1 (same platform-computed
+`(1,) if backend != "cpu" else ()` form as the single-token step that
+the literal detector cannot see), and the draft model's compiled step
+donates its own dense cache.  Coverage comes from DONATING_CALLABLES
+(hack/graftlint.py): the `PagedSlotDecodeStep:self._verify` entry must
+fire inside the step's wrapper, and the engine-scope entries
+(`self.step.verify`, `self.draft`) must fire in the spec round.  Must
+fire use-after-donation in all three methods below."""
+
+import jax
+
+
+class PagedSlotDecodeStep:
+    def __init__(self, verify):
+        donate = (1,) if jax.default_backend() != "cpu" else ()
+        self._verify = jax.jit(verify, donate_argnums=donate)
+
+    def verify(self, params, cache, toks, index, prompt, lens, tables):
+        new_cache, nxt = self._verify(
+            params, cache, toks, index, prompt, lens, tables)
+        return new_cache, nxt, cache  # BAD: cache was donated at position 1
+
+
+class ContinuousBatchingEngine:
+    def __init__(self, step, draft):
+        self.step = step
+        self.draft = draft
+
+    def spec_verify_round(self, params, cache, toks, index, prompt,
+                          lens, tables):
+        new_cache, nxt = self.step.verify(
+            params, cache, toks, index, prompt, lens, tables)
+        cache.block_until_ready()  # BAD: reads the donated verify cache
+        return new_cache, nxt
+
+    def draft_round(self, params, d_cache, tok, index, prompt, lens):
+        new_cache, nxt = self.draft(params, d_cache, tok, index,
+                                    prompt, lens)
+        return new_cache, nxt, d_cache  # BAD: d_cache donated at position 1

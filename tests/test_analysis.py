@@ -210,6 +210,37 @@ class TestJaxRules:
         )
         assert [f for f in good if f.rule == "use-after-donation"] == []
 
+    def test_spec_decode_donation_entries_cover_verify_and_draft(self):
+        """The speculative-decode programs donate their caches the same
+        platform-computed way: DONATING_CALLABLES must carry the verify
+        entry (step scope) plus the engine-scope verify/draft entries,
+        and all three must fire on the known-bad fixture."""
+        import importlib.util
+
+        spec = importlib.util.spec_from_file_location(
+            "graftlint", os.path.join(REPO, "hack", "graftlint.py"))
+        graftlint = importlib.util.module_from_spec(spec)
+        spec.loader.exec_module(graftlint)
+        for key, donated in (
+            ("PagedSlotDecodeStep:self._verify", (1,)),
+            ("ContinuousBatchingEngine:self.step.verify", (1,)),
+            ("ContinuousBatchingEngine:self.draft", (1,)),
+        ):
+            assert graftlint.DONATING_CALLABLES.get(key) == donated
+
+        config = JaxConfig(
+            donating_callables=graftlint.DONATING_CALLABLES)
+        bad = analysis.run(
+            [os.path.join(FIXTURES, "spec_donation_bad.py")],
+            jax_config=config,
+        )
+        hits = [f for f in bad if f.rule == "use-after-donation"]
+        assert {f.symbol for f in hits} == {
+            "PagedSlotDecodeStep.verify",
+            "ContinuousBatchingEngine.spec_verify_round",
+            "ContinuousBatchingEngine.draft_round",
+        }
+
 
 class TestNamesRules:
     def test_names_bad_fires_every_rule(self):

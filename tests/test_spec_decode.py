@@ -1,0 +1,351 @@
+"""Speculative decoding on the paged engine (serve/engine.py +
+models/gpt.py PagedVerifyStep): greedy chains bit-identical to the
+non-speculative path — the accept/reject rule commits exactly the
+tokens single-token decode would have — across prefix-cache sharing,
+CoW, near-max prompts (the verify window's sentinel overshoot), and
+cancel-mid-speculation; one compile per program (step, prefill,
+copy_block, verify, draft); pool audits empty after rejected
+suffixes; and the per-slot adaptive depth controller deterministic
+under seeded adversarial prompts. Manual-drive (start=False), same
+as TestPagedEngine."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from tf_operator_tpu.models import gpt as gpt_lib
+from tf_operator_tpu.serve.engine import (
+    ContinuousBatchingEngine,
+    DecodeCancelled,
+    _SPEC_PROBE_ROUNDS,
+)
+
+CFG = gpt_lib.GPT_TINY
+
+
+@pytest.fixture(scope="module")
+def params():
+    return gpt_lib.GPT(CFG).init(
+        jax.random.PRNGKey(0), jnp.zeros((1, 8), jnp.int32)
+    )["params"]
+
+
+@pytest.fixture(scope="module")
+def draft_params():
+    return gpt_lib.GPT(gpt_lib.GPT_DRAFT).init(
+        jax.random.PRNGKey(1), jnp.zeros((1, 8), jnp.int32)
+    )["params"]
+
+
+def inline_chain(params, row, new):
+    """The reference: the plain whole-scan generate() path, solo."""
+    out = gpt_lib.generate(
+        CFG, params, jnp.asarray([row], jnp.int32), max_new_tokens=new
+    )
+    return np.asarray(out)[0].tolist()
+
+
+def drive(engine, handles, cancel_at=None, max_iters=5000):
+    """The scheduler loop, by hand: admit, evict, one quantum.
+    cancel_at: {iteration: [handle, ...]} fired between quanta."""
+    cancel_at = cancel_at or {}
+    for it in range(max_iters):
+        for handle in cancel_at.get(it, ()):
+            handle.cancel()
+        if all(h.done.is_set() for h in handles):
+            return
+        engine._admit()
+        engine._evict_cancelled()
+        if engine.active_slots:
+            engine._work_once()
+    raise AssertionError("drive() did not converge")
+
+
+def spec_engine(params, **kw):
+    kw.setdefault("speculate", "ngram")
+    kw.setdefault("spec_depth", 4)
+    return ContinuousBatchingEngine(
+        CFG, params, start=False, kv_layout="paged", **kw
+    )
+
+
+class TestSpecNgramEngine:
+    """speculate='ngram' (host-side prompt lookup, zero extra device
+    programs beyond verify): the tier-1 bit-identity pins."""
+
+    def test_spec_random_soak_matches_inline(self, params):
+        """The acceptance pin: a seeded mix of shared-prefix family
+        (prefix cache + CoW), a near-max prompt, repetitive rows (so
+        acceptance is exercised, not just rejection), random fill, and
+        mid-flight cancels — every surviving chain equals the inline
+        greedy chain token-for-token, with one compile per program and
+        the pool audit empty despite every rejected suffix."""
+        rng = np.random.default_rng(23)
+        system = rng.integers(0, CFG.vocab_size, size=16).tolist()
+        jobs = [(system, 4), (system, 4), (system + [9, 9], 4)]
+        long_row = rng.integers(
+            0, CFG.vocab_size, size=CFG.max_seq_len - 6
+        ).tolist()
+        jobs.append((long_row, 4))
+        jobs.append(([5, 6, 7] * 8, 10))  # repetitive: ngram should hit
+        for _ in range(6):
+            new = int(rng.integers(1, 6))
+            p_len = int(rng.integers(1, 36))
+            jobs.append(
+                (rng.integers(0, CFG.vocab_size, size=p_len).tolist(),
+                 new)
+            )
+        eng = spec_engine(
+            params, n_slots=3, block_size=8, prefill_chunk=8,
+        )
+        head = eng.submit(*jobs[0])
+        drive(eng, [head])
+        handles = [head] + [eng.submit(row, new) for row, new in jobs[1:]]
+        cancel_at = {2: [handles[6]], 7: [handles[9]]}
+        drive(eng, handles, cancel_at=cancel_at)
+        results = []
+        for handle in handles:
+            try:
+                results.append(handle.result(1))
+            except DecodeCancelled:
+                results.append(None)
+        eng.stop()
+        assert eng.step.compiles == 1
+        assert eng.step.prefill_compiles == 1
+        assert eng.step.verify_compiles == 1
+        assert eng.spec_rounds > 0
+        assert eng.spec_proposed > 0
+        assert eng.spec_accepted > 0       # the repetitive row paid off
+        assert eng.pool.hits > 0           # shared prefix reused
+        eng.pool.check()                   # no leak / double-free
+        assert eng.pool.in_use() == 0
+        # metric families ride the same engine (no extra build time)
+        flat = {name: val for (name, _), val in eng.metrics().items()}
+        assert flat["spec_rounds_total"] > 0
+        assert flat["spec_tokens_proposed_total"] > 0
+        assert flat["engine_verify_compiles_total"] == 1
+        assert 0.0 <= flat["spec_accept_rate"] <= 1.0
+        assert (flat["spec_tokens_accepted_total"]
+                <= flat["spec_tokens_proposed_total"])
+        for (row, new), got in zip(jobs, results):
+            if got is not None:
+                assert got == inline_chain(params, row, new), \
+                    (len(row), new)
+
+    def test_off_and_ngram_engines_emit_identical_chains(self, params):
+        """The flag-level pin: the same jobs through --speculate off
+        and --speculate ngram engines produce byte-equal chains."""
+        jobs = [([3, 1, 4, 1, 5, 9, 2, 6], 8), ([2, 7] * 6, 12),
+                (list(range(40, 70)), 6)]
+        chains = {}
+        for speculate in ("off", "ngram"):
+            eng = ContinuousBatchingEngine(
+                CFG, params, n_slots=2, start=False, kv_layout="paged",
+                block_size=8, prefill_chunk=6, speculate=speculate,
+                spec_depth=4,
+            )
+            handles = [eng.submit(row, new) for row, new in jobs]
+            drive(eng, handles)
+            chains[speculate] = [h.result(1) for h in handles]
+            eng.stop()
+            eng.pool.check()
+            assert eng.pool.in_use() == 0
+        assert chains["ngram"] == chains["off"]
+
+    @pytest.mark.slow  # tier-1 budget; the soak's near-max row keeps
+    #                    sentinel-overshoot covered there, and CI's
+    #                    unit step runs slow tests
+    def test_near_max_prompt_overshoot_is_sentinel_safe(self, params):
+        """depth > remaining budget at the end of a chain: effective
+        depth clamps and the verify window's overshoot positions route
+        to the sentinel block — the committed KV in the slot's last
+        REAL block must survive (a naive block-index clamp would
+        overwrite it, corrupting the final tokens)."""
+        row = [(i * 11) % CFG.vocab_size for i in range(CFG.max_seq_len - 3)]
+        eng = spec_engine(
+            params, n_slots=2, block_size=8, prefill_chunk=16,
+            spec_depth=4,
+        )
+        h = eng.submit(row, 3)
+        drive(eng, [h])
+        got = h.result(1)
+        eng.stop()
+        eng.pool.check()
+        assert eng.pool.in_use() == 0
+        assert got == inline_chain(params, row, 3)
+
+    def test_spec_validation(self, params):
+        with pytest.raises(ValueError, match="paged"):
+            ContinuousBatchingEngine(
+                CFG, params, n_slots=2, start=False, kv_layout="dense",
+                speculate="ngram",
+            )
+        with pytest.raises(ValueError, match="speculate"):
+            ContinuousBatchingEngine(
+                CFG, params, n_slots=2, start=False, kv_layout="paged",
+                block_size=8, speculate="medusa",
+            )
+        with pytest.raises(ValueError, match="spec_depth"):
+            ContinuousBatchingEngine(
+                CFG, params, n_slots=2, start=False, kv_layout="paged",
+                block_size=8, speculate="ngram", spec_depth=0,
+            )
+        with pytest.raises(ValueError, match="draft"):
+            ContinuousBatchingEngine(
+                CFG, params, n_slots=2, start=False, kv_layout="paged",
+                block_size=8, speculate="draft",
+            )
+
+
+class TestAdaptiveDepth:
+    """The per-slot controller: depth shrinks on sustained rejection,
+    sits out on the plain step at depth 0, probes back in after
+    _SPEC_PROBE_ROUNDS, and recovers toward the cap on sustained
+    acceptance — all deterministic, never affecting the chain."""
+
+    def test_depth_collapse_and_probe_deterministic(self, params):
+        """A seeded adversarial (incompressible) prompt: ngram drafts
+        never match, so depth must walk down to 0, fall back to the
+        single-token step, then probe at depth 1 — and two identical
+        runs must produce identical depth trajectories, counters, and
+        the inline-greedy chain."""
+        rng = np.random.default_rng(31)
+        row = rng.integers(0, CFG.vocab_size, size=12).tolist()
+        new = 90  # long enough to walk 4 -> 0 and probe back in
+        runs = []
+        for _ in range(2):
+            eng = spec_engine(
+                params, n_slots=2, block_size=8, spec_depth=4,
+            )
+            h = eng.submit(row, new)
+            trajectory = []
+            for _it in range(5000):
+                if h.done.is_set():
+                    break
+                eng._admit()
+                eng._evict_cancelled()
+                if eng.active_slots:
+                    eng._work_once()
+                    trajectory.append(int(eng._slot_depth[0]))
+            got = h.result(1)
+            counters = (eng.spec_rounds, eng.spec_proposed,
+                        eng.spec_accepted, eng.spec_fallback_steps)
+            eng.stop()
+            eng.pool.check()
+            assert eng.pool.in_use() == 0
+            runs.append((trajectory, counters, got))
+        (traj, counters, got), (traj2, counters2, got2) = runs
+        assert traj == traj2
+        assert counters == counters2
+        assert got == got2 == inline_chain(params, row, new)
+        assert 0 in traj                       # collapsed all the way
+        assert counters[3] >= _SPEC_PROBE_ROUNDS - 1  # sat out on step
+        # probe re-entry: depth returns to 1 after a run of zeros
+        first_zero = traj.index(0)
+        assert 1 in traj[first_zero:]
+
+    @pytest.mark.slow  # tier-1 budget; CI's unit step runs slow tests
+    def test_depth_recovers_toward_cap_on_forced_prompt(self, params):
+        """The grow branch: a prefix-cache partial hit leaves the rest
+        of the prompt to decode under forcing — acceptance is 1.0
+        there, so a slot whose depth was knocked down must climb back
+        to the cap (and the chain stays bit-identical)."""
+        eng = spec_engine(
+            params, n_slots=2, block_size=8, prefill_chunk=0,
+            spec_depth=4,
+        )
+        system = [7 * (i % 5) + 1 for i in range(16)]  # 2 full blocks
+        head = eng.submit(system, 4)
+        drive(eng, [head])
+        tail = [(i * 13) % CFG.vocab_size for i in range(88)]
+        h = eng.submit(system + tail, 4)
+        eng._admit()                    # prefix hit: decode from 16
+        assert eng.pool.hits > 0
+        eng._slot_depth[:] = 1          # knock the controller down
+        eng._accept_hist[0].clear()
+        eng._accept_hist[1].clear()
+        drive(eng, [h])
+        got = h.result(1)
+        assert int(eng._slot_depth.max()) == eng.spec_depth
+        eng.stop()
+        eng.pool.check()
+        assert eng.pool.in_use() == 0
+        assert got == inline_chain(params, system + tail, 4)
+
+
+class TestSpecDraftEngine:
+    """speculate='draft' (compiled GPT_DRAFT proposer): same
+    bit-identity contract, plus the draft program's own compile pin
+    and resync across rejected suffixes."""
+
+    @pytest.mark.slow  # tier-1 budget (draft engine compiles a second
+    #                    model); CI's unit step runs slow tests
+    def test_draft_mode_bit_identical(self, params, draft_params):
+        jobs = [([1, 2, 3, 4, 5, 6, 7, 8], 8), ([4, 4, 4, 4] * 3, 10),
+                (list(range(30, 55)), 5)]
+        eng = ContinuousBatchingEngine(
+            CFG, params, n_slots=2, start=False, kv_layout="paged",
+            block_size=8, prefill_chunk=6, speculate="draft",
+            spec_depth=3, draft_cfg=gpt_lib.GPT_DRAFT,
+            draft_params=draft_params,
+        )
+        handles = [eng.submit(row, new) for row, new in jobs]
+        drive(eng, handles)
+        got = [h.result(1) for h in handles]
+        eng.stop()
+        assert eng.step.compiles == 1
+        assert eng.step.verify_compiles == 1
+        assert eng.draft.compiles == 1
+        assert eng.spec_rounds > 0
+        eng.pool.check()
+        assert eng.pool.in_use() == 0
+        for (row, new), chain in zip(jobs, got):
+            assert chain == inline_chain(params, row, new)
+
+
+class TestShardedSpec:
+    """Speculation over the ('batch','model') mesh: verify reuses the
+    sharded step's placement rules (tables replicated, rows on batch),
+    the draft runs fully replicated, and chains stay bit-identical to
+    the single-device non-speculative engine."""
+
+    # compiles four pjit programs (~5s each on CPU) — slow-marked per
+    # the TestShardedEngine precedent; CI's unit step runs it and
+    # serve-spec-smoke is the always-on executable pin
+    @pytest.mark.slow
+    def test_sharded_ngram_matches_single_device_off(self, params):
+        rng = np.random.default_rng(17)
+        system = rng.integers(0, CFG.vocab_size, size=16).tolist()
+        jobs = [(system, 4), (system + [9, 9], 4), ([8, 1] * 9, 8)]
+        jobs.append(
+            (rng.integers(0, CFG.vocab_size,
+                          size=CFG.max_seq_len - 6).tolist(), 4)
+        )
+        sharded = ContinuousBatchingEngine(
+            CFG, params, n_slots=4, start=False, kv_layout="paged",
+            block_size=8, prefill_chunk=8, mesh_shape=(1, 2),
+            speculate="ngram", spec_depth=4,
+        )
+        head = sharded.submit(*jobs[0])
+        drive(sharded, [head])
+        handles = [head] + [
+            sharded.submit(row, new) for row, new in jobs[1:]
+        ]
+        drive(sharded, handles)
+        got = [h.result(1) for h in handles]
+        sharded.stop()
+        assert sharded.step.compiles == 1
+        assert sharded.step.verify_compiles == 1
+        assert sharded.spec_rounds > 0
+        sharded.pool.check()
+        assert sharded.pool.in_use() == 0
+        single = ContinuousBatchingEngine(
+            CFG, params, n_slots=4, start=False, kv_layout="paged",
+            block_size=8, prefill_chunk=8,
+        )
+        refs = [single.submit(row, new) for row, new in jobs]
+        drive(single, refs)
+        for (row, new), chain, ref in zip(jobs, got, refs):
+            assert chain == ref.result(1), (len(row), new)
+        single.stop()

@@ -398,6 +398,15 @@ class ServeReplicaGroup:
     prefill_chunk: Optional[int] = field(
         default=None, metadata={"json": "prefillChunk"}
     )
+    # speculative decoding for this role's replicas ("off" / "ngram" /
+    # "draft"); decode-pool-only — validation refuses it on a prefill
+    # group, whose replicas never decode
+    speculate: Optional[str] = None
+    # max drafted tokens per speculative round (the verify window is
+    # specDepth + 1); None inherits the engine default
+    spec_depth: Optional[int] = field(
+        default=None, metadata={"json": "specDepth"}
+    )
     extra: Dict[str, Any] = field(default_factory=dict)
 
 

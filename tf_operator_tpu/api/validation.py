@@ -254,6 +254,28 @@ def validate_serve_service(svc: t.ServeService) -> None:
                 f"ServeServiceSpec.replicaGroups[{role!r}].prefillChunk "
                 f"must be >= 0, got {group.prefill_chunk}"
             )
+        if group.speculate is not None:
+            if group.speculate not in ("off", "ngram", "draft"):
+                errs.append(
+                    f"ServeServiceSpec.replicaGroups[{role!r}]."
+                    f"speculate must be off/ngram/draft, got "
+                    f"{group.speculate!r}"
+                )
+            elif (
+                group.speculate != "off"
+                and role == t.SERVE_ROLE_PREFILL
+            ):
+                errs.append(
+                    f"ServeServiceSpec.replicaGroups[{role!r}]."
+                    f"speculate={group.speculate!r} is decode-pool-"
+                    "only: prefill replicas never decode, so their "
+                    "draft/verify programs would be dead compiles"
+                )
+        if group.spec_depth is not None and group.spec_depth < 1:
+            errs.append(
+                f"ServeServiceSpec.replicaGroups[{role!r}].specDepth "
+                f"must be >= 1, got {group.spec_depth}"
+            )
         if group.min_replicas is not None and group.min_replicas < 1:
             errs.append(
                 f"ServeServiceSpec.replicaGroups[{role!r}].minReplicas "

@@ -353,6 +353,16 @@ class ServeReconciler:
                     container.command += [
                         "--prefill-chunk", str(group.prefill_chunk)
                     ]
+                if group is not None and group.speculate is not None:
+                    # validation already refused speculate on prefill
+                    # groups — decode-pool-only under disaggregation
+                    container.command += [
+                        "--speculate", group.speculate
+                    ]
+                if group is not None and group.spec_depth is not None:
+                    container.command += [
+                        "--spec-depth", str(group.spec_depth)
+                    ]
         else:
             template.metadata.name = serve_replica_name(svc.name, index)
         template.metadata.labels.update(labels)

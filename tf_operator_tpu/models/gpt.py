@@ -50,6 +50,14 @@ GPT_TINY = GPTConfig(
     vocab_size=512, hidden_size=128, num_layers=2, num_heads=2,
     intermediate_size=256, max_seq_len=128,
 )
+# the draft twin of GPT_TINY for speculative decoding: the SAME
+# tokenizer (vocab) and position range, half the width and a single
+# layer, so one draft step costs a fraction of the target step's
+# FLOPs (serve/engine.py --speculate draft)
+GPT_DRAFT = GPTConfig(
+    vocab_size=512, hidden_size=64, num_layers=1, num_heads=2,
+    intermediate_size=128, max_seq_len=128,
+)
 
 
 def _causal_attention(query, key, value, mask=None):
@@ -864,7 +872,8 @@ class SlotDecodeStep:
     to a universe of exactly one, asserted in tests."""
 
     def __init__(self, cfg: GPTConfig, n_slots: int, max_total: int,
-                 kv_quant_int8: bool = False, weights_int8: bool = False):
+                 kv_quant_int8: bool = False, weights_int8: bool = False,
+                 mesh=None):
         if max_total > cfg.max_seq_len:
             raise ValueError(
                 f"max_total {max_total} exceeds max_seq_len "
@@ -873,6 +882,7 @@ class SlotDecodeStep:
         self.cfg = cfg
         self.n_slots = int(n_slots)
         self.max_total = int(max_total)
+        self.mesh = mesh
         self.compiles = 0
         model = GPTDecodeStep(
             cfg, cache_len=max_total, kv_quant_int8=kv_quant_int8,
@@ -909,12 +919,37 @@ class SlotDecodeStep:
         # donation keeps the cache a single fixed allocation on TPU;
         # the CPU runtime cannot donate (it would only warn per compile)
         donate = (1,) if jax.default_backend() != "cpu" else ()
-        self._step = jax.jit(step, donate_argnums=donate)
+        if mesh is not None:
+            # fully-REPLICATED pjit placement: the speculative draft
+            # model is small enough that replicating it beats paying
+            # collective latency per draft token, and the sharded
+            # engine's verify/commit loop feeds on host numpy either
+            # way. Pinned in/out shardings keep the one-compile
+            # invariant (an inferred placement could retrace).
+            from jax.sharding import NamedSharding, PartitionSpec
+
+            rep = NamedSharding(mesh, PartitionSpec())
+            self._rep = rep
+            self._step = jax.jit(
+                step, donate_argnums=donate,
+                in_shardings=(rep,) * 6, out_shardings=(rep, rep),
+            )
+        else:
+            self._rep = None
+            self._step = jax.jit(step, donate_argnums=donate)
 
     def init_cache(self):
         """Fresh zero cache for the whole grid — created from abstract
         shapes, one allocation of [n_slots, max_total, ...] per layer
-        per k/v (+ scales under int8)."""
+        per k/v (+ scales under int8). Mesh-replicated steps hand the
+        cache back pre-placed so the first step never pays a reshard."""
+        if self._rep is not None:
+            return jax.tree_util.tree_map(
+                lambda s: jax.device_put(
+                    jnp.zeros(s.shape, s.dtype), self._rep
+                ),
+                self._cache_shapes,
+            )
         return jax.tree_util.tree_map(
             lambda s: jnp.zeros(s.shape, s.dtype), self._cache_shapes
         )
@@ -1140,10 +1175,102 @@ class PagedPrefillSelfAttention(nn.Module):
         )(out)
 
 
+class PagedVerifySelfAttention(nn.Module):
+    """Multi-token VERIFY attention over the paged block pool for the
+    whole slot grid — the speculative-decoding sibling of
+    PagedSelfAttention (identical child param paths), scoring k+1
+    provisional tokens per slot in one call.
+
+    x: [slots, k1, hidden] at logical positions index[i] + j for row
+    (i, j). K/V writes land first (the write-then-attend discipline of
+    the prefill path), then each query row attends positions <= its
+    own — row 0 reproduces the single-token step's dataflow exactly,
+    and rows 1..k see the drafted tokens before them through the same
+    pool bytes a later decode step would read.
+
+    Overshoot discipline: a verify window near the end of a slot's
+    budget can extend past the blocks its admission reserved, or even
+    past max_total. Positions beyond the reservation hit table tail
+    entries parked on the sentinel (garbage by contract); positions >=
+    max_total are routed to the sentinel EXPLICITLY — never clamped
+    into the table's last entry, which can be a real block holding
+    committed K/V. Rows such garbage could influence sit past the
+    slot's commit limit, and the engine's accept rule discards them."""
+
+    num_heads: int
+    head_dim: int
+    num_blocks: int
+    block_size: int
+    dtype: jnp.dtype = jnp.bfloat16
+    kv_quant_int8: bool = False
+    weights_int8: bool = False
+    mesh: Any = None
+
+    @nn.compact
+    def __call__(self, x, index, tables):
+        # x: [slots, k1, hidden]; index: [slots]; tables: [slots, B]
+        slots, k1, _ = x.shape
+        proj = _projections(self.weights_int8)
+        dense = lambda name: proj.head(  # noqa: E731
+            self.num_heads, self.head_dim, self.dtype, name
+        )
+        query = dense("query")(x)       # [s, k1, h, d]
+        key_new = dense("key")(x)
+        value_new = dense("value")(x)
+        bs = self.block_size
+        max_blocks = tables.shape[1]
+        length = max_blocks * bs
+        pos = index[:, None] + jnp.arange(k1)[None, :]  # [s, k1]
+        blk = jnp.minimum(pos // bs, max_blocks - 1)
+        phys = jnp.take_along_axis(tables, blk, axis=1)
+        # out-of-range provisional positions scatter to the sentinel
+        phys = jnp.where(pos <= length - 1, phys, 0)
+        off = pos % bs
+        flat = slots * k1
+        key_pool, key_scale = _paged_store_kv(
+            self, "k",
+            key_new.reshape(flat, self.num_heads, self.head_dim),
+            self.num_blocks, bs, self.dtype, self.kv_quant_int8,
+            phys.reshape(flat), off.reshape(flat),
+        )
+        value_pool, value_scale = _paged_store_kv(
+            self, "v",
+            value_new.reshape(flat, self.num_heads, self.head_dim),
+            self.num_blocks, bs, self.dtype, self.kv_quant_int8,
+            phys.reshape(flat), off.reshape(flat),
+        )
+        keys = key_pool[tables].reshape(
+            slots, length, self.num_heads, self.head_dim
+        )
+        values = value_pool[tables].reshape(
+            slots, length, self.num_heads, self.head_dim
+        )
+        if key_scale is not None:
+            key_scale = key_scale[tables].reshape(
+                slots, length, self.num_heads
+            )
+            value_scale = value_scale[tables].reshape(
+                slots, length, self.num_heads
+            )
+        valid = (
+            jnp.arange(length)[None, None, :] <= pos[:, :, None]
+        )[:, None]  # [s, 1, k1, L]
+        out = _cache_attention(
+            query, keys, key_scale, values, value_scale, valid
+        )  # [s, k1, h, d]
+        if self.mesh is not None:
+            out = _gather_model_axis(self.mesh, out, rows=True)
+        return proj.general(
+            features=x.shape[-1], axis=(-2, -1), dtype=self.dtype,
+            name="attn_out",
+        )(out)
+
+
 class _PagedBlock(nn.Module):
-    """One decoder block over the paged pool for either phase: 2-D x
-    is the per-slot one-token decode step, 3-D x a prefill chunk — the
-    two attention classes share param paths ("attention"), so the
+    """One decoder block over the paged pool for any phase: 2-D x is
+    the per-slot one-token decode step; 3-D x with `tables` is the
+    multi-token speculative verify; 3-D x with `table` a prefill chunk
+    — the attention classes share param paths ("attention"), so the
     dispatch only switches dataflow (the dense twin is _CachedBlock).
     """
 
@@ -1172,6 +1299,10 @@ class _PagedBlock(nn.Module):
             y = PagedSelfAttention(**kwargs)(
                 y.astype(cfg.dtype), index, tables
             )
+        elif tables is not None:
+            y = PagedVerifySelfAttention(**kwargs)(
+                y.astype(cfg.dtype), index, tables
+            )
         else:
             y = PagedPrefillSelfAttention(**kwargs)(
                 y.astype(cfg.dtype), start, table
@@ -1180,8 +1311,10 @@ class _PagedBlock(nn.Module):
         y = nn.LayerNorm(dtype=jnp.float32, name="ln_mlp")(x)
         constrain = None
         if self.mesh is not None:
+            # decode and verify activations are row-sharded across the
+            # batch axis; a prefill chunk is a single slot (replicated)
             constrain = lambda h: _gather_model_axis(  # noqa: E731
-                self.mesh, h, rows=h.ndim == 2
+                self.mesh, h, rows=h.ndim == 2 or tables is not None
             )
         return x + transformer_mlp(
             cfg, y, dense_cls=_projections(self.weights_int8).dense,
@@ -1265,13 +1398,62 @@ class PagedPrefillChunk(nn.Module):
         return x
 
 
+class PagedVerifyStep(nn.Module):
+    """Speculative-verify forward over the paged pool: scores k+1
+    provisional tokens for EVERY slot in one call. Param-path
+    identical to PagedDecodeStep (token_embed/position_embed/layer_i/
+    ln_final/lm_head), so the engine feeds it the same target weights
+    as the single-token step — the precondition for greedy accept/
+    reject being bit-identical to stepping one token at a time."""
+
+    config: GPTConfig
+    num_blocks: int
+    block_size: int
+    kv_quant_int8: bool = False
+    weights_int8: bool = False
+    mesh: Any = None
+
+    @nn.compact
+    def __call__(self, tokens, index, tables):
+        # tokens: [slots, k1]; index: [slots]; tables: [slots, B]
+        cfg = self.config
+        k1 = tokens.shape[1]
+        x = nn.Embed(
+            cfg.vocab_size, cfg.hidden_size, dtype=cfg.dtype,
+            name="token_embed",
+        )(tokens)
+        # clip like GPTVerifyBlock: a near-the-end window's tail can
+        # overshoot max_seq_len; those rows sit past the slot's commit
+        # limit, so a clamped embedding is correctness-neutral
+        x = x + nn.Embed(
+            cfg.max_seq_len, cfg.hidden_size, dtype=cfg.dtype,
+            name="position_embed",
+        )(jnp.minimum(
+            index[:, None] + jnp.arange(k1)[None, :],
+            cfg.max_seq_len - 1,
+        ))
+        for layer in range(cfg.num_layers):
+            x = _PagedBlock(
+                cfg, num_blocks=self.num_blocks,
+                block_size=self.block_size,
+                kv_quant_int8=self.kv_quant_int8,
+                weights_int8=self.weights_int8, name=f"layer_{layer}",
+                mesh=self.mesh,
+            )(x, index=index, tables=tables)
+        x = nn.LayerNorm(dtype=jnp.float32, name="ln_final")(x)
+        return _projections(self.weights_int8).dense(
+            cfg.vocab_size, dtype=cfg.dtype, name="lm_head"
+        )(x.astype(cfg.dtype))
+
+
 class PagedSlotDecodeStep:
     """ONE compiled single-token decode over a fixed [n_slots] grid
     whose KV lives in a shared pool of fixed-size blocks — the paged
     twin of SlotDecodeStep and the device half of the paged engine
     (serve/engine.py kv_layout="paged").
 
-    Three compiled programs, each counted by its own trace counter:
+    Up to four compiled programs, each counted by its own trace
+    counter:
 
     - `step(...)`: identical contract to SlotDecodeStep.__call__ plus
       a [n_slots, max_blocks] block-table argument; gather/scatter by
@@ -1282,6 +1464,10 @@ class PagedSlotDecodeStep:
       exactly `prefill_chunk` tokens, so it too compiles once).
     - `copy_block(...)`: device-side block copy for prefix-cache
       copy-on-write (one compile; src/dst are traced scalars).
+    - `verify(...)` (only when spec_depth > 0): the speculative-decode
+      scorer — all spec_depth+1 provisional tokens of every slot in
+      one call, K/V written through the same pool, cache donated; the
+      fixed window width keeps it to one compile too.
 
     max_total must divide evenly into blocks: the gathered attention
     width is max_blocks * block_size, and only when that equals the
@@ -1293,7 +1479,7 @@ class PagedSlotDecodeStep:
                  block_size: int, num_blocks: int,
                  kv_quant_int8: bool = False,
                  weights_int8: bool = False,
-                 mesh=None):
+                 mesh=None, spec_depth: int = 0):
         if max_total > cfg.max_seq_len:
             raise ValueError(
                 f"max_total {max_total} exceeds max_seq_len "
@@ -1321,6 +1507,8 @@ class PagedSlotDecodeStep:
         self.compiles = 0
         self.prefill_compiles = 0
         self.copy_compiles = 0
+        self.spec_depth = int(spec_depth)
+        self.verify_compiles = 0
         model = PagedDecodeStep(
             cfg, num_blocks=self.num_blocks, block_size=self.block_size,
             kv_quant_int8=kv_quant_int8, weights_int8=weights_int8,
@@ -1414,11 +1602,23 @@ class PagedSlotDecodeStep:
                 in_shardings=(self.cache_shardings, rep, rep),
                 out_shardings=self.cache_shardings,
             )
+            # verify rides the step's placement: [slots, k1] token
+            # windows shard their slot rows on 'batch' exactly like the
+            # single-token path, so the pool never moves between a
+            # verify call and the step it replaces
+            verify_shardings = dict(
+                in_shardings=(
+                    self.param_shardings, self.cache_shardings,
+                    rows2, rows, rows2, rows, rep,
+                ),
+                out_shardings=(self.cache_shardings, rows2),
+            )
         else:
             self.batch_shards = self.model_shards = 1
             self.param_shardings = self.cache_shardings = None
             self.kv_bytes_per_shard = self.kv_bytes_total
             step_shardings = prefill_shardings = copy_shardings = {}
+            verify_shardings = {}
 
         def step(params, cache, tok, index, prompt, lens, tables):
             # trace-time side effect: runs once per compilation, so the
@@ -1473,6 +1673,63 @@ class PagedSlotDecodeStep:
         copy_donate = (0,) if jax.default_backend() != "cpu" else ()
         self._copy = jax.jit(copy_block, donate_argnums=copy_donate,
                              **copy_shardings)
+
+        if self.spec_depth > 0:
+            verify_model = PagedVerifyStep(
+                cfg, num_blocks=self.num_blocks,
+                block_size=self.block_size,
+                kv_quant_int8=kv_quant_int8, weights_int8=weights_int8,
+                mesh=mesh,
+            )
+            k1 = self.spec_depth + 1
+
+            def verify(params, cache, toks, index, prompt, lens,
+                       tables):
+                self.verify_compiles += 1
+                logits, updates = verify_model.apply(
+                    {"params": params, "cache": cache}, toks, index,
+                    tables, mutable=["cache"],
+                )
+                nxt = jnp.argmax(logits, axis=-1)  # [s, k1]
+                # the forcing rule, broadcast over the window: row j
+                # scores logical position index + j, predicting
+                # index + j + 1 — rows whose PREDICTED position is
+                # still inside the prompt emit the prompt token, so
+                # speculation over an unconsumed prompt tail behaves
+                # exactly like the single-token step would
+                pos_next = index[:, None] + 1 + jnp.arange(k1)[None, :]
+                in_prompt = pos_next < lens[:, None]
+                forced = jnp.take_along_axis(
+                    prompt,
+                    jnp.minimum(pos_next, prompt.shape[1] - 1), axis=1,
+                )
+                nxt = jnp.where(in_prompt, forced, nxt).astype(
+                    jnp.int32
+                )
+                return updates["cache"], nxt
+
+            self._verify = jax.jit(verify, donate_argnums=donate,
+                                   **verify_shardings)
+        else:
+            self._verify = None
+
+    def verify(self, params, cache, toks, index, prompt, lens, tables):
+        """Score the speculated window for every slot: toks
+        [n_slots, spec_depth + 1] int32 where column 0 is each slot's
+        committed current token and columns 1.. are drafts at logical
+        positions index + 1, index + 2, ... Returns (cache, nxt) with
+        nxt [n_slots, spec_depth + 1] — the target model's greedy next
+        token after each window position. The engine accepts the
+        longest prefix where nxt[:, j] == toks[:, j + 1] and rolls the
+        rejected suffix back by resetting the slot write cursor (the
+        next window rewrites those pool rows before anything reads
+        them: write-then-attend)."""
+        if self._verify is None:
+            raise RuntimeError(
+                "verify() needs spec_depth > 0 at construction"
+            )
+        return self._verify(params, cache, toks, index, prompt, lens,
+                            tables)
 
     def init_cache(self):
         """Fresh zero pool — created from abstract shapes, one
@@ -1536,7 +1793,7 @@ class ShardedPagedSlotDecodeStep(PagedSlotDecodeStep):
     def __init__(self, cfg: GPTConfig, n_slots: int, max_total: int,
                  block_size: int, num_blocks: int, mesh,
                  kv_quant_int8: bool = False,
-                 weights_int8: bool = False):
+                 weights_int8: bool = False, spec_depth: int = 0):
         if mesh is None:
             raise ValueError(
                 "ShardedPagedSlotDecodeStep requires a mesh "
@@ -1545,7 +1802,7 @@ class ShardedPagedSlotDecodeStep(PagedSlotDecodeStep):
         super().__init__(
             cfg, n_slots, max_total, block_size, num_blocks,
             kv_quant_int8=kv_quant_int8, weights_int8=weights_int8,
-            mesh=mesh,
+            mesh=mesh, spec_depth=spec_depth,
         )
 
 

@@ -144,7 +144,34 @@ METRIC_HELP = {
     "engine_pool_audit_failures_total":
         "BlockPool.check() audits (drain/stop) that found a refcount "
         "leak or double free",
+    "spec_tokens_proposed_total":
+        "Draft tokens proposed to the speculative verify step",
+    "spec_tokens_accepted_total":
+        "Draft tokens the verify step accepted (greedy exact match)",
+    "spec_accept_rate":
+        "Lifetime accepted/proposed ratio of speculative drafts",
+    "spec_rounds_total":
+        "Speculative draft+verify rounds executed",
+    "spec_fallback_steps_total":
+        "Scheduler quanta that fell back to the single-token step "
+        "(every live slot's adaptive depth at zero)",
+    "spec_verify_seconds_total":
+        "Wall-clock seconds spent inside speculative verify rounds",
+    "engine_verify_compiles_total":
+        "XLA compilations of the speculative verify program "
+        "(expected: 1)",
+    "engine_draft_compiles_total":
+        "XLA compilations of the draft model's decode step "
+        "(expected: 1)",
 }
+
+# adaptive-depth controller constants: the trailing accept-rate window
+# per slot, the collapse / recovery thresholds, and how many quanta a
+# depth-0 slot sits out before probing speculation again
+_SPEC_WIN = 8
+_SPEC_LOW = 0.3
+_SPEC_HIGH = 0.7
+_SPEC_PROBE_ROUNDS = 16
 
 
 def _parse_mesh_shape(mesh_shape):
@@ -453,6 +480,11 @@ class ContinuousBatchingEngine:
         prefix_cache: bool = True,
         mesh_shape=None,
         role: str = "",
+        speculate: str = "off",
+        spec_depth: int = 4,
+        draft_cfg=None,
+        draft_params=None,
+        spec_ngram: int = 3,
     ):
         from ..models import gpt as gpt_lib
 
@@ -462,6 +494,39 @@ class ContinuousBatchingEngine:
             raise ValueError(
                 f"kv_layout must be 'paged' or 'dense', got {kv_layout!r}"
             )
+        if speculate not in ("off", "ngram", "draft"):
+            raise ValueError(
+                "speculate must be 'off', 'ngram' or 'draft', got "
+                f"{speculate!r}"
+            )
+        self.speculate = speculate
+        self._spec = speculate != "off"
+        if self._spec:
+            if kv_layout != "paged":
+                raise ValueError(
+                    "speculative decoding requires kv_layout='paged' "
+                    "(the verify program scores windows against the "
+                    "block pool)"
+                )
+            if int(spec_depth) < 1:
+                raise ValueError(
+                    f"spec_depth must be >= 1, got {spec_depth}"
+                )
+            if speculate == "draft":
+                if draft_cfg is None or draft_params is None:
+                    raise ValueError(
+                        "speculate='draft' needs draft_cfg + "
+                        "draft_params (a small model sharing the "
+                        "tokenizer)"
+                    )
+                if draft_cfg.vocab_size != cfg.vocab_size:
+                    raise ValueError(
+                        f"draft vocab {draft_cfg.vocab_size} != target "
+                        f"vocab {cfg.vocab_size} (the draft must share "
+                        "the tokenizer)"
+                    )
+        self.spec_depth = int(spec_depth) if self._spec else 0
+        self.spec_ngram = int(spec_ngram)
         max_total = int(max_total) or cfg.max_seq_len
         self.cfg = cfg
         self.params = params
@@ -501,6 +566,7 @@ class ContinuousBatchingEngine:
                     cfg, s, max_total, block_size, usable + 1,
                     self.mesh, kv_quant_int8=kv_quant_int8,
                     weights_int8=weights_int8,
+                    spec_depth=self.spec_depth,
                 )
                 self.params = sharding_lib.place(
                     params, self.step.param_shardings
@@ -511,6 +577,7 @@ class ContinuousBatchingEngine:
                     cfg, s, max_total, block_size, usable + 1,
                     kv_quant_int8=kv_quant_int8,
                     weights_int8=weights_int8,
+                    spec_depth=self.spec_depth,
                 )
             self.pool = BlockPool(usable + 1, block_size)
             self.prefill_chunk = int(prefill_chunk)
@@ -544,6 +611,49 @@ class ContinuousBatchingEngine:
         self.model_shards = (
             int(self.mesh.shape["model"]) if self.mesh is not None else 1
         )
+        # speculative decoding state. The draft model (speculate=
+        # "draft") is a second compiled single-token program over the
+        # same slot grid — small enough that on a mesh it runs fully
+        # REPLICATED (SlotDecodeStep mesh placement) instead of paying
+        # collective latency per proposed token. speculate="ngram"
+        # needs no second model at all: drafts come from a host-side
+        # prompt-lookup over each slot's committed chain (_spec_buf),
+        # so a verify round costs ONE device dispatch instead of K+1.
+        self.draft = None
+        self.draft_params = None
+        if self.speculate == "draft":
+            if draft_cfg.max_seq_len < max_total:
+                raise ValueError(
+                    f"draft max_seq_len {draft_cfg.max_seq_len} < "
+                    f"engine max_total {max_total} (the draft must "
+                    "cover every position it proposes at)"
+                )
+            import jax
+
+            self.draft = gpt_lib.SlotDecodeStep(
+                draft_cfg, s, max_total, mesh=self.mesh
+            )
+            self.draft_params = (
+                jax.device_put(draft_params, self.draft._rep)
+                if self.mesh is not None else draft_params
+            )
+            self._d_cache = self.draft.init_cache()
+            self._d_tok = np.zeros((s,), np.int32)
+            self._d_index = np.zeros((s,), np.int32)
+        if self._spec:
+            # committed-chain buffer (prompt + accepted tokens) — the
+            # ngram drafter's corpus and the bit-identity audit trail
+            # (+1: the final emitted token lands at position
+            # lens + new - 1, which can equal max_total)
+            self._spec_buf = np.zeros((s, max_total + 1), np.int32)
+            # per-slot adaptive depth: shrink when the trailing accept
+            # rate collapses (draft cost verify throws away), grow back
+            # toward spec_depth when it recovers
+            self._slot_depth = np.full((s,), self.spec_depth, np.int32)
+            self._accept_hist = [
+                collections.deque(maxlen=_SPEC_WIN) for _ in range(s)
+            ]
+            self._depth_idle = np.zeros((s,), np.int32)
         # slot -> {"offset", "decode_start"} while chunk-prefilling;
         # always present (empty under dense) so the loop can test it
         self._prefilling: dict = {}
@@ -593,6 +703,15 @@ class ContinuousBatchingEngine:
         self.migrations_out = 0
         self.migrations_in = 0
         self.pool_audit_failures = 0
+        # speculative accounting (engine-thread-owned): proposed /
+        # accepted drive the accept-rate gauge; fallback_steps counts
+        # quanta that ran the single-token program because every live
+        # slot's adaptive depth had collapsed to zero
+        self.spec_rounds = 0
+        self.spec_proposed = 0
+        self.spec_accepted = 0
+        self.spec_fallback_steps = 0
+        self.spec_verify_seconds = 0.0
         # quantum attribution (engine-thread-owned, like the above):
         # where each scheduler quantum's wall time goes — admission,
         # compiled-step dispatch, host-side device sync, stream fan-out
@@ -612,6 +731,7 @@ class ContinuousBatchingEngine:
         self._flight = flight
         self._h_ttft = self._h_itl = self._h_queue_wait = None
         self._h_batch = self._h_prefill = None
+        self._h_verify = self._g_spec_depth = None
         if registry is not None:
             from ..telemetry import (
                 FAST_BUCKETS,
@@ -650,6 +770,22 @@ class ContinuousBatchingEngine:
                     "Wall-clock latency of one chunked-prefill chunk",
                     buckets=TTFT_BUCKETS,
                 )
+            if self._spec:
+                self._h_verify = registry.histogram(
+                    "spec_verify_seconds",
+                    "Wall-clock latency of one speculative verify "
+                    "round (draft proposals + the multi-token verify "
+                    "call)",
+                    buckets=FAST_BUCKETS,
+                )
+                # per-slot labeled gauge: the adaptive controller's
+                # current depth, visible per slot so a collapsed row is
+                # distinguishable from a fleet-wide regression
+                self._g_spec_depth = registry.gauge(
+                    "spec_depth",
+                    "Current adaptive speculation depth per slot",
+                    labelnames=("slot",),
+                )
         # THE one compile (per program), paid at construction instead
         # of inside the first request's latency (the engine twin of
         # serve --warm). Paged additionally warms the prefill-chunk
@@ -667,6 +803,20 @@ class ContinuousBatchingEngine:
                     0, np.zeros((self.max_blocks,), np.int32),
                 )
             self._cache = self.step.copy_block(self._cache, 0, 0)
+            if self._spec:
+                # warm the verify program (and the draft step) too —
+                # their one compile belongs at construction, not inside
+                # the first speculative round's latency
+                self._cache, _ = self.step.verify(
+                    self.params, self._cache,
+                    np.zeros((s, self.spec_depth + 1), np.int32),
+                    self._index, self._prompt, self._lens, self._tables,
+                )
+                if self.draft is not None:
+                    self._d_cache, _ = self.draft(
+                        self.draft_params, self._d_cache, self._d_tok,
+                        self._d_index, self._prompt, self._lens,
+                    )
         else:
             self._cache, _ = self.step(
                 self.params, self._cache, self._tok, self._index,
@@ -1141,6 +1291,28 @@ class ContinuousBatchingEngine:
                 ("engine_pool_audit_failures_total", "counter"):
                     self.pool_audit_failures,
             })
+        if self._spec:
+            out.update({
+                ("spec_tokens_proposed_total", "counter"):
+                    self.spec_proposed,
+                ("spec_tokens_accepted_total", "counter"):
+                    self.spec_accepted,
+                ("spec_accept_rate", "gauge"): (
+                    self.spec_accepted / self.spec_proposed
+                    if self.spec_proposed else 0.0
+                ),
+                ("spec_rounds_total", "counter"): self.spec_rounds,
+                ("spec_fallback_steps_total", "counter"):
+                    self.spec_fallback_steps,
+                ("spec_verify_seconds_total", "counter"):
+                    self.spec_verify_seconds,
+                ("engine_verify_compiles_total", "counter"):
+                    self.step.verify_compiles,
+            })
+            if self.draft is not None:
+                out[("engine_draft_compiles_total", "counter")] = (
+                    self.draft.compiles
+                )
         return out
 
     # -- engine thread -----------------------------------------------------
@@ -1322,6 +1494,12 @@ class ContinuousBatchingEngine:
         n = len(req.prompt)
         self._prompt[slot, :] = 0
         self._prompt[slot, :n] = req.prompt
+        if self._spec:
+            # seed the committed-chain buffer with the prompt: the
+            # ngram drafter mines it immediately, even before the
+            # chain has generated anything
+            self._spec_buf[slot, :] = 0
+            self._spec_buf[slot, :n] = req.prompt
         self.admitted += 1
         self.peak_active = max(self.peak_active, self.active_slots)
         if not self._paged:
@@ -1393,6 +1571,21 @@ class ContinuousBatchingEngine:
         self._lens[slot] = len(req.prompt)
         self._index[slot] = start
         self._tok[slot] = req.prompt[start]
+        if self._spec:
+            # fresh occupant: full configured depth, clean controller
+            # history, no probe debt
+            self._slot_depth[slot] = self.spec_depth
+            self._accept_hist[slot].clear()
+            self._depth_idle[slot] = 0
+            if self.draft is not None:
+                # the draft row joins at the same position. A
+                # prefix-cache or chunked-prefill start leaves the
+                # draft cache without history for positions < start —
+                # its proposals there are noise, which only costs
+                # acceptance (the controller shrinks depth), never
+                # correctness.
+                self._d_tok[slot] = req.prompt[start]
+                self._d_index[slot] = start
 
     def _evict_cancelled(self) -> None:
         for slot, req in enumerate(self._reqs):
@@ -1410,6 +1603,9 @@ class ContinuousBatchingEngine:
         self._tok[slot] = 0
         self._index[slot] = 0
         self._lens[slot] = 1
+        if self.draft is not None:
+            self._d_tok[slot] = 0
+            self._d_index[slot] = 0
         if self._paged:
             self._prefilling.pop(slot, None)
             self._tables[slot, :] = 0  # back onto the sentinel
@@ -1449,10 +1645,30 @@ class ContinuousBatchingEngine:
         whole point of chunked prefill."""
         if self._prefilling:
             self._prefill_once()
-        if any(
-            req is not None and slot not in self._prefilling
-            for slot, req in enumerate(self._reqs)
-        ):
+        live = [
+            slot for slot, req in enumerate(self._reqs)
+            if req is not None and slot not in self._prefilling
+        ]
+        if not live:
+            return
+        if not self._spec:
+            self._step_once()
+            return
+        # depth-0 probe: a slot whose adaptive depth collapsed sits
+        # out _SPEC_PROBE_ROUNDS quanta on the plain step, then
+        # re-enters speculation at depth 1 to test whether the
+        # workload turned acceptable again
+        for slot in live:
+            if self._slot_depth[slot] == 0:
+                self._depth_idle[slot] += 1
+                if self._depth_idle[slot] >= _SPEC_PROBE_ROUNDS:
+                    self._slot_depth[slot] = 1
+                    self._depth_idle[slot] = 0
+                    self._accept_hist[slot].clear()
+        if any(self._slot_depth[slot] > 0 for slot in live):
+            self._spec_once(live)
+        else:
+            self.spec_fallback_steps += 1
             self._step_once()
 
     def _prefill_once(self) -> None:
@@ -1499,6 +1715,8 @@ class ContinuousBatchingEngine:
             slots=self.active_slots,
         )
         self._cache = self.step.init_cache()
+        if self.draft is not None:
+            self._d_cache = self.draft.init_cache()
         for slot, req in enumerate(self._reqs):
             if req is not None:
                 self._release(slot, error=err)
@@ -1542,30 +1760,13 @@ class ContinuousBatchingEngine:
             pos = int(self._index[slot]) + 1
             self._tok[slot] = nxt[slot]
             self._index[slot] = pos
+            if self._spec:
+                # fallback steps still feed the committed chain the
+                # ngram drafter mines
+                self._spec_buf[slot, pos] = nxt[slot]
             if pos >= int(self._lens[slot]):
                 req._emit(int(nxt[slot]))
-                if req.last_token_at is None:
-                    if self._h_ttft is not None:
-                        self._h_ttft.observe(now - req.created)
-                    if req.span is not None:
-                        req.span.annotate("first-token")
-                    # the TTFT endpoint is a hop boundary the trace
-                    # collector decomposes on (telemetry/collector.py)
-                    self._fl().record(
-                        "serve", corr=req.corr, trace=req.trace,
-                        op="first-token", slot=slot,
-                        ttft=round(now - req.created, 6),
-                    )
-                    if self._paged and self._slot_keys[slot]:
-                        # the prompt's full blocks now hold final K/V:
-                        # publish them so later prompts sharing the
-                        # prefix skip prefill (cache takes its own ref)
-                        for key, block in self._slot_keys[slot]:
-                            self.pool.publish(key, block)
-                        self._slot_keys[slot] = []
-                elif self._h_itl is not None:
-                    self._h_itl.observe(now - req.last_token_at)
-                req.last_token_at = now
+                self._post_emit(slot, req, now)
                 if pos == int(self._lens[slot]) + req.new - 1:
                     self.finished += 1
                     self._release(slot)
@@ -1578,6 +1779,237 @@ class ContinuousBatchingEngine:
         # quantum split: dispatch / device sync / stream fan-out.
         self._fl().record(
             "serve", op="step", step=self.steps, slots=slots_now,
+            dispatch=round(dispatched - start, 6),
+            sync=round(synced - dispatched, 6),
+            fanout=round(fanout, 6),
+        )
+
+    def _post_emit(self, slot: int, req, now: float) -> None:
+        """Per-emitted-token bookkeeping shared by the single-token
+        step and the speculative round: first emit observes TTFT and
+        publishes the slot's prompt blocks to the prefix cache; later
+        emits observe inter-token latency."""
+        if req.last_token_at is None:
+            if self._h_ttft is not None:
+                self._h_ttft.observe(now - req.created)
+            if req.span is not None:
+                req.span.annotate("first-token")
+            # the TTFT endpoint is a hop boundary the trace
+            # collector decomposes on (telemetry/collector.py)
+            self._fl().record(
+                "serve", corr=req.corr, trace=req.trace,
+                op="first-token", slot=slot,
+                ttft=round(now - req.created, 6),
+            )
+            if self._paged and self._slot_keys[slot]:
+                # the prompt's full blocks now hold final K/V:
+                # publish them so later prompts sharing the
+                # prefix skip prefill (cache takes its own ref)
+                for key, block in self._slot_keys[slot]:
+                    self.pool.publish(key, block)
+                self._slot_keys[slot] = []
+        elif self._h_itl is not None:
+            self._h_itl.observe(now - req.last_token_at)
+        req.last_token_at = now
+
+    def _host_drafts(self, live, depth) -> np.ndarray:
+        """Prompt-lookup drafting on the host (speculate='ngram'):
+        for each live slot, propose the continuation of the most
+        recent earlier occurrence of the chain's current ngram tail —
+        zero extra device dispatches, which on a dispatch-bound
+        harness is the entire speedup. Unconsumed prompt tokens draft
+        as themselves (the forcing rule accepts them for free); when
+        no ngram match exists the draft repeats the current token."""
+        k = self.spec_depth
+        n = self.spec_ngram
+        drafts = np.zeros((self.n_slots, k), np.int32)
+        for slot in live:
+            d = int(depth[slot])
+            if d < 1:
+                continue
+            idx = int(self._index[slot])
+            lens = int(self._lens[slot])
+            buf = self._spec_buf[slot]
+            # positions idx+1 .. idx+d want proposals; prompt
+            # positions are simply known
+            row = drafts[slot]
+            filled = 0
+            while filled < d and idx + 1 + filled < lens:
+                row[filled] = self._prompt[slot, idx + 1 + filled]
+                filled += 1
+            if filled >= d:
+                continue
+            fallback = int(self._tok[slot])
+            cont = None
+            if idx + 1 >= n:
+                tail = buf[idx + 1 - n:idx + 1]
+                # committed chain is buf[:idx+1]; a match at p means
+                # buf[p:p+n] == tail with its continuation starting at
+                # p+n, which must itself be committed history
+                windows = np.lib.stride_tricks.sliding_window_view(
+                    buf[:idx + 1], n
+                )
+                hits = np.nonzero(
+                    (windows[:idx + 1 - n] == tail).all(axis=1)
+                )[0] if idx + 1 - n > 0 else np.empty(0, np.int64)
+                if hits.size:
+                    # the most recent occurrence whose continuation
+                    # covers the whole window; else the earliest one
+                    # (longest available continuation) — recency wins
+                    # on quality, length wins when recency can't fill
+                    # the window (short-period loops)
+                    need = d - filled
+                    covering = hits[hits + n + need <= idx + 1]
+                    m = int(covering[-1]) if covering.size else \
+                        int(hits[0])
+                    cont = buf[m + n:idx + 1]
+            j = 0
+            while filled < d:
+                row[filled] = (
+                    int(cont[j]) if cont is not None and j < len(cont)
+                    else fallback
+                )
+                filled += 1
+                j += 1
+        return drafts
+
+    def _spec_once(self, live) -> None:
+        """One speculative round: propose up to slot_depth tokens per
+        slot (draft model or ngram lookup), score the whole window in
+        ONE verify call, commit the longest accepted prefix plus the
+        verify step's own correction, and roll the rejected suffix
+        back by cursor reset alone — the pool rows it wrote are
+        rewritten by the next window before anything reads them
+        (write-then-attend), so no block ever reallocates.
+
+        Greedy accept/reject is exact: an accepted draft equals the
+        target's argmax at that position, so every committed chain is
+        bit-identical to the single-token engine's."""
+        start = time.perf_counter()
+        k = self.spec_depth
+        depth = np.zeros((self.n_slots,), np.int32)
+        for slot in live:
+            req = self._reqs[slot]
+            # never speculate past the request's budget: the chain has
+            # remaining = lens + new - 1 - index tokens to go, one of
+            # which the verify correction itself supplies
+            remaining = (
+                int(self._lens[slot]) + req.new - 1
+                - int(self._index[slot])
+            )
+            depth[slot] = max(0, min(
+                int(self._slot_depth[slot]), remaining - 1
+            ))
+        try:
+            if self.speculate == "draft":
+                # d_max sequential draft steps propose column by
+                # column; rows needing fewer just ignore the tail
+                drafts = np.zeros((self.n_slots, k), np.int32)
+                for j in range(int(depth.max())):
+                    self._d_cache, d_nxt = self.draft(
+                        self.draft_params, self._d_cache, self._d_tok,
+                        self._d_index, self._prompt, self._lens,
+                    )
+                    d_nxt = np.asarray(d_nxt)
+                    drafts[:, j] = d_nxt
+                    self._d_tok[:] = d_nxt
+                    self._d_index += 1
+            else:
+                drafts = self._host_drafts(live, depth)
+            drafted = time.perf_counter()
+            toks = np.concatenate(
+                [self._tok[:, None], drafts], axis=1
+            ).astype(np.int32)
+            self._cache, nxt = self.step.verify(
+                self.params, self._cache, toks, self._index,
+                self._prompt, self._lens, self._tables,
+            )
+            dispatched = time.perf_counter()
+            nxt = np.asarray(nxt)
+        except Exception as err:  # noqa: BLE001 — fan out, stay alive
+            self._fail_all(err)
+            return
+        synced = time.perf_counter()
+        self.decode_seconds += synced - start
+        self.dispatch_seconds += dispatched - start
+        self.sync_seconds += synced - dispatched
+        self.spec_verify_seconds += synced - drafted
+        if self._h_verify is not None:
+            self._h_verify.observe(synced - start)
+        self.steps += 1
+        self.spec_rounds += 1
+        slots_now = self.active_slots
+        if self._h_batch is not None:
+            self._h_batch.observe(slots_now)
+        now = time.monotonic()
+        proposed_now = accepted_now = 0
+        for slot in live:
+            req = self._reqs[slot]
+            if req is None:
+                continue
+            d = int(depth[slot])
+            # greedy acceptance: the longest prefix where the draft
+            # matches the target's own argmax, then ONE corrected
+            # token from the verify output — d == 0 rows commit
+            # exactly the single-token step's result
+            accepted = 0
+            while (
+                accepted < d
+                and drafts[slot, accepted] == nxt[slot, accepted]
+            ):
+                accepted += 1
+            commit = accepted + 1
+            self.spec_proposed += d
+            self.spec_accepted += accepted
+            proposed_now += d
+            accepted_now += accepted
+            if d > 0:
+                hist = self._accept_hist[slot]
+                hist.append(accepted / d)
+                if len(hist) >= _SPEC_WIN // 2:
+                    rate = sum(hist) / len(hist)
+                    if rate < _SPEC_LOW:
+                        self._slot_depth[slot] -= 1
+                        self._depth_idle[slot] = 0
+                        hist.clear()
+                    elif (
+                        rate > _SPEC_HIGH
+                        and self._slot_depth[slot] < self.spec_depth
+                    ):
+                        self._slot_depth[slot] += 1
+                        hist.clear()
+            index = int(self._index[slot])
+            lens = int(self._lens[slot])
+            final = lens + req.new - 1
+            for j in range(commit):
+                pos = index + 1 + j
+                tok = int(nxt[slot, j])
+                self._spec_buf[slot, pos] = tok
+                if pos >= lens:
+                    req._emit(tok)
+                    self._post_emit(slot, req, now)
+            self._tok[slot] = nxt[slot, commit - 1]
+            self._index[slot] = index + commit
+            self.row_steps += 1
+            if index + commit >= final:
+                self.finished += 1
+                self._release(slot)
+        if self.draft is not None:
+            # resync the draft grid to the committed chain: rejected
+            # draft rows and parked rows alike snap back, so the draft
+            # cursor can never drift from the target's
+            self._d_tok[:] = self._tok
+            self._d_index[:] = self._index
+        if self._g_spec_depth is not None:
+            for slot in range(self.n_slots):
+                self._g_spec_depth.labels(slot=str(slot)).set(
+                    int(self._slot_depth[slot])
+                )
+        fanout = time.perf_counter() - synced
+        self.fanout_seconds += fanout
+        self._fl().record(
+            "serve", op="spec-step", step=self.steps, slots=slots_now,
+            proposed=proposed_now, accepted=accepted_now,
             dispatch=round(dispatched - start, 6),
             sync=round(synced - dispatched, 6),
             fanout=round(fanout, 6),
@@ -1605,10 +2037,21 @@ def main(argv=None) -> int:
              "step, e.g. 1x2; hosts short on devices get CPU virtual "
              "devices via --xla_force_host_platform_device_count",
     )
+    parser.add_argument(
+        "--speculate", choices=("off", "ngram", "draft"),
+        default="off",
+        help="speculative decoding: 'ngram' drafts from a host-side "
+             "prompt lookup (zero extra dispatches), 'draft' from a "
+             "small compiled draft model (GPT_DRAFT, random weights "
+             "in the smoke)",
+    )
+    parser.add_argument("--spec-depth", type=int, default=4)
     parser.add_argument("--smoke", action="store_true",
                         help="accepted for CI-invocation clarity")
     args = parser.parse_args(argv)
 
+    if args.speculate != "off" and args.layout != "paged":
+        parser.error("--speculate requires --layout paged")
     mesh_shape = None
     if args.mesh:
         if args.layout != "paged":
@@ -1635,10 +2078,21 @@ def main(argv=None) -> int:
     params = gpt_lib.GPT(cfg).init(
         jax.random.PRNGKey(0), jnp.zeros((1, 8), jnp.int32)
     )["params"]
+    draft_cfg = draft_params = None
+    if args.speculate == "draft":
+        # random draft weights: acceptance will be near zero, but the
+        # smoke's contract is bit-identity + compile counts, which
+        # must hold REGARDLESS of draft quality
+        draft_cfg = gpt_lib.GPT_DRAFT
+        draft_params = gpt_lib.GPT(draft_cfg).init(
+            jax.random.PRNGKey(1), jnp.zeros((1, 8), jnp.int32)
+        )["params"]
     engine = ContinuousBatchingEngine(
         cfg, params, n_slots=args.slots, kv_layout=args.layout,
         block_size=args.block_size, kv_blocks=args.kv_blocks,
         prefill_chunk=args.prefill_chunk, mesh_shape=mesh_shape,
+        speculate=args.speculate, spec_depth=args.spec_depth,
+        draft_cfg=draft_cfg, draft_params=draft_params,
     )
     paged = args.layout == "paged"
     rng = np.random.default_rng(0)
@@ -1691,6 +2145,16 @@ def main(argv=None) -> int:
         report["cow_copies"] = engine.pool.cow_copies
         ok = ok and engine.step.prefill_compiles <= 1
         ok = ok and engine.pool.hits > 0
+        if args.speculate != "off":
+            report["verify_compiles"] = engine.step.verify_compiles
+            report["spec_rounds"] = engine.spec_rounds
+            report["spec_proposed"] = engine.spec_proposed
+            report["spec_accepted"] = engine.spec_accepted
+            ok = ok and engine.step.verify_compiles == 1
+            ok = ok and engine.spec_rounds > 0
+            if engine.draft is not None:
+                report["draft_compiles"] = engine.draft.compiles
+                ok = ok and engine.draft.compiles == 1
         if mesh_shape is not None:
             # the sharded acceptance bar, read off the gauges the
             # router scrapes: the requested mesh actually formed (no

@@ -147,6 +147,19 @@ class InProcessFleet:
                         pass
         return value
 
+    @staticmethod
+    def _command_str(pod: k8s.Pod, flag: str, default: str) -> str:
+        """String twin of _command_int, same last-wins semantics."""
+        value = default
+        for container in pod.spec.containers:
+            if container.name != SERVE_CONTAINER_NAME:
+                continue
+            command = container.command or []
+            for i, tok in enumerate(command):
+                if tok == flag and i + 1 < len(command):
+                    value = command[i + 1]
+        return value
+
     def sync(self) -> List[str]:
         """Boot a server for every pending serve pod without one, and
         drain-decommission every live replica whose pod record the
@@ -176,6 +189,11 @@ class InProcessFleet:
             prefill_chunk = self._command_int(
                 pod, "--prefill-chunk", self.prefill_chunk
             )
+            # speculative decoding rides the command line the same way;
+            # the controller only stamps it on decode groups, and a
+            # prefill role with a stray flag is refused by make_server
+            speculate = self._command_str(pod, "--speculate", "off")
+            spec_depth = self._command_int(pod, "--spec-depth", 4)
             # warm_async: the listener binds first, /readyz answers
             # "warming" (503) through the engine's construction
             # compile, and the router only admits the replica when its
@@ -190,6 +208,7 @@ class InProcessFleet:
                 prefill_chunk=prefill_chunk,
                 role=role,
                 tenant_quotas=self.tenant_quotas,
+                speculate=speculate, spec_depth=spec_depth,
             )
             thread = threading.Thread(
                 target=server.serve_forever, name=f"serve-{name}",

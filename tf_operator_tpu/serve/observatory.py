@@ -59,10 +59,15 @@ _FLEET_FAMILIES = {
     "itl": _SERVE + "inter_token_seconds",
     "queue_wait": _SERVE + "queue_wait_seconds",
     "prefill_chunk": _SERVE + "prefill_chunk_seconds",
+    "spec_verify": _SERVE + "spec_verify_seconds",
 }
 _Q_DEPTH = _SERVE + "engine_queue_depth"
 _KV_IN_USE = _SERVE + "engine_kv_blocks_in_use"
 _KV_TOTAL = _SERVE + "engine_kv_blocks_total"
+# speculative-decoding counters (engines with --speculate off simply
+# don't export the families; their replicas contribute 0)
+_SPEC_PROPOSED = _SERVE + "spec_tokens_proposed_total"
+_SPEC_ACCEPTED = _SERVE + "spec_tokens_accepted_total"
 # per-tenant QoS counters (server.py admission); summed fleet-wide and
 # ingested as fleet_tenant_* history series so the autoscaler's
 # describe() can report live reject rates per tenant
@@ -141,6 +146,8 @@ def fleet_slo(router, history=None, alerts=None) -> dict:
     queue_depth = 0.0
     kv_in_use = 0.0
     kv_total = 0.0
+    spec_proposed = 0.0
+    spec_accepted = 0.0
     tenant_sums: Dict[str, float] = {}
     unreachable: List[str] = []
     clients = router.clients()
@@ -155,6 +162,8 @@ def fleet_slo(router, history=None, alerts=None) -> dict:
         queue_depth += flat.get(_Q_DEPTH, 0.0)
         kv_in_use += flat.get(_KV_IN_USE, 0.0)
         kv_total += flat.get(_KV_TOTAL, 0.0)
+        spec_proposed += flat.get(_SPEC_PROPOSED, 0.0)
+        spec_accepted += flat.get(_SPEC_ACCEPTED, 0.0)
         for sample, value in flat.items():
             if sample.startswith(_TENANT_PREFIXES):
                 # "..._serve_tenant_x_total{tenant=\"t\"}" ->
@@ -218,6 +227,21 @@ def fleet_slo(router, history=None, alerts=None) -> dict:
     )
     for hop, value in hops_p95.items():
         g.labels(hop=hop).set(value or 0.0)
+    spec_accept_rate = (
+        spec_accepted / spec_proposed if spec_proposed else 0.0
+    )
+    reg.gauge(
+        "fleet_spec_tokens_proposed_total",
+        "Speculative draft tokens proposed, summed across replicas",
+    ).set(spec_proposed)
+    reg.gauge(
+        "fleet_spec_tokens_accepted_total",
+        "Speculative draft tokens accepted, summed across replicas",
+    ).set(spec_accepted)
+    reg.gauge(
+        "fleet_spec_accept_rate",
+        "Fleet-wide accepted/proposed ratio of speculative drafts",
+    ).set(spec_accept_rate)
     partial = bool(unreachable)
     reg.gauge(
         "fleet_scrape_errors",
@@ -239,6 +263,18 @@ def fleet_slo(router, history=None, alerts=None) -> dict:
         history.ingest_value("fleet_kv_blocks_total", "gauge", kv_total)
         history.ingest_value(
             "fleet_scrape_errors", "gauge", float(len(unreachable))
+        )
+        # cumulative fleet-summed speculative counters: rate() over
+        # the pair is the fleet's live accept rate; the gauge ingests
+        # too so burn/trend queries can read it directly
+        history.ingest_value(
+            "fleet_spec_tokens_proposed_total", "counter", spec_proposed
+        )
+        history.ingest_value(
+            "fleet_spec_tokens_accepted_total", "counter", spec_accepted
+        )
+        history.ingest_value(
+            "fleet_spec_accept_rate", "gauge", spec_accept_rate
         )
         # fleet-summed per-tenant counters stay cumulative: rate()
         # over the series is the live reject/request rate per tenant
@@ -264,6 +300,11 @@ def fleet_slo(router, history=None, alerts=None) -> dict:
             "scrape_errors": len(unreachable),
             "partial": partial,
             "tenants": tenants,
+            "spec": {
+                "proposed": spec_proposed,
+                "accepted": spec_accepted,
+                "accept_rate": round(spec_accept_rate, 6),
+            },
         },
         "router": {
             **router_slo,

@@ -62,6 +62,9 @@ _KV_TOTAL = "tf_operator_tpu_serve_engine_kv_blocks_total"
 _MESH_DEVICES = "tf_operator_tpu_serve_engine_mesh_devices"
 _PREFIX_HITS = "tf_operator_tpu_serve_engine_prefix_cache_hits_total"
 _PREFIX_HIT_TOKENS = "tf_operator_tpu_serve_engine_prefix_hit_tokens_total"
+_SPEC_ACCEPT_RATE = "tf_operator_tpu_serve_spec_accept_rate"
+_SPEC_PROPOSED = "tf_operator_tpu_serve_spec_tokens_proposed_total"
+_SPEC_ACCEPTED = "tf_operator_tpu_serve_spec_tokens_accepted_total"
 
 # prefix-overlap discount: each already-cached full block of the
 # request's prompt shaves this much off the load score (capped, so a
@@ -104,6 +107,11 @@ class Replica:
         self.mesh_devices = 1.0  # decode mesh size (1 = single-device)
         self.prefix_hits = 0.0        # engine_prefix_cache_hits_total
         self.prefix_hit_tokens = 0.0  # engine_prefix_hit_tokens_total
+        # speculative decoding (replicas with --speculate off simply
+        # never export the families; these stay 0)
+        self.spec_accept_rate = 0.0
+        self.spec_proposed = 0.0
+        self.spec_accepted = 0.0
         self.block_size = 0    # paged block width, from /kv/digest
         self.digest: set = set()  # rolling prefix digest (hash strings)
         self.failures = 0
@@ -335,6 +343,11 @@ class LeastLoadedRouter:
                     replica.prefix_hit_tokens = flat.get(
                         _PREFIX_HIT_TOKENS, 0.0
                     )
+                    replica.spec_accept_rate = flat.get(
+                        _SPEC_ACCEPT_RATE, 0.0
+                    )
+                    replica.spec_proposed = flat.get(_SPEC_PROPOSED, 0.0)
+                    replica.spec_accepted = flat.get(_SPEC_ACCEPTED, 0.0)
                     # rolling prefix digest (paged engines; dense ones
                     # answer block_size 0 + empty digest, which keeps
                     # their overlap at 0)
@@ -854,6 +867,9 @@ class LeastLoadedRouter:
                         "mesh_devices": r.mesh_devices,
                         "prefix_hits": r.prefix_hits,
                         "prefix_hit_tokens": r.prefix_hit_tokens,
+                        "spec_accept_rate": r.spec_accept_rate,
+                        "spec_proposed": r.spec_proposed,
+                        "spec_accepted": r.spec_accepted,
                         "block_size": r.block_size,
                         "digest_size": len(r.digest),
                         "failures": r.failures,

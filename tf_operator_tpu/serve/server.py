@@ -1400,6 +1400,16 @@ class DecodeHTTPServer(ThreadingHTTPServer):
         super().handle_error(request, client_address)
 
 
+def _draft_presets():
+    """Named draft-model configs for --speculate draft (lazy: models
+    imports jax, and the CLI must set XLA_FLAGS first). 'draft-tiny'
+    is the default — the 1-layer/half-width twin of GPT_TINY sharing
+    its tokenizer."""
+    from ..models import gpt as gpt_lib
+
+    return {"draft-tiny": gpt_lib.GPT_DRAFT, "tiny": gpt_lib.GPT_TINY}
+
+
 def make_server(
     cfg,
     params,
@@ -1429,6 +1439,9 @@ def make_server(
     alert_rules=None,
     ttft_slo_s: float = 0.25,
     tenant_quotas=None,
+    speculate: str = "off",
+    spec_depth: int = 4,
+    draft_preset: str = "",
 ) -> ThreadingHTTPServer:
     """In-process server (tests and embedders); caller owns
     serve_forever/shutdown. The CLI binds 0.0.0.0 (pods must be
@@ -1550,6 +1563,29 @@ def make_server(
         raise ValueError(
             f"role must be '', 'prefill' or 'decode', got {role!r}"
         )
+    if speculate not in ("off", "ngram", "draft"):
+        raise ValueError(
+            f"speculate must be 'off', 'ngram' or 'draft', got "
+            f"{speculate!r}"
+        )
+    if speculate != "off":
+        if batching != "continuous":
+            raise ValueError(
+                "speculate requires batching='continuous' (the engine "
+                "owns the draft/verify loop; the inline prompt-lookup "
+                "path is the `speculative` flag)"
+            )
+        if kv_layout != "paged":
+            raise ValueError(
+                "speculate requires kv_layout='paged' (the verify "
+                "program scores windows against the block pool)"
+            )
+        if role == "prefill":
+            raise ValueError(
+                "speculate is decode-pool-only: a prefill replica "
+                "never decodes, so its draft/verify programs would be "
+                "dead compiles"
+            )
     state = _State(
         cfg, params, kv_quant_int8, model_name, max_new_cap,
         speculative=speculative, weights_int8=weights_int8, mesh=mesh,
@@ -1610,6 +1646,29 @@ def make_server(
             # quantize, which the engine's step reads the same way
             # generate does); the engine pays its ONE compile here, at
             # startup
+            draft_cfg = draft_params = None
+            if speculate == "draft":
+                import jax
+                import jax.numpy as jnp
+
+                from ..models import gpt as gpt_lib
+
+                presets = _draft_presets()
+                draft_cfg = presets.get(draft_preset or "draft-tiny")
+                if draft_cfg is None:
+                    raise ValueError(
+                        f"unknown draft preset {draft_preset!r} "
+                        f"(have: {sorted(presets)})"
+                    )
+                # deterministic random init (PRNGKey(0)): every
+                # replica drafts identically, so routing a chain to a
+                # different replica cannot change its acceptance
+                # pattern. A trained draft arrives via swap the same
+                # way target weights do.
+                draft_params = gpt_lib.GPT(draft_cfg).init(
+                    jax.random.PRNGKey(0),
+                    jnp.zeros((1, 8), jnp.int32),
+                )["params"]
             state.engine = ContinuousBatchingEngine(
                 cfg, state.params, n_slots=n_slots,
                 kv_quant_int8=kv_quant_int8, weights_int8=weights_int8,
@@ -1617,6 +1676,8 @@ def make_server(
                 kv_layout=kv_layout, block_size=block_size,
                 kv_blocks=kv_blocks, prefill_chunk=prefill_chunk,
                 mesh_shape=mesh_shape, role=role,
+                speculate=speculate, spec_depth=spec_depth,
+                draft_cfg=draft_cfg, draft_params=draft_params,
             )
 
         if warm_async:
@@ -1912,6 +1973,30 @@ def main(argv=None) -> int:
         "in one engine",
     )
     parser.add_argument(
+        "--speculate", choices=["off", "ngram", "draft"],
+        default="off",
+        help="speculative decoding for the continuous-batching "
+        "engine (requires --batching continuous --kv-layout paged): "
+        "'ngram' drafts from a host-side prompt lookup over each "
+        "chain (zero extra device dispatches), 'draft' from a small "
+        "compiled draft model (--draft-preset) replicated across the "
+        "mesh. Greedy chains stay bit-identical to --speculate off; "
+        "decode-pool-only under disaggregation",
+    )
+    parser.add_argument(
+        "--draft-preset", default="",
+        help="draft model config for --speculate draft (default "
+        "draft-tiny: the 1-layer/half-width twin of GPT_TINY sharing "
+        "its tokenizer)",
+    )
+    parser.add_argument(
+        "--spec-depth", type=int, default=4,
+        help="max tokens drafted per speculative round (K); the "
+        "verify step scores K+1 positions in one call. The per-slot "
+        "adaptive controller shrinks toward 0 when the trailing "
+        "accept rate collapses and regrows toward this cap",
+    )
+    parser.add_argument(
         "--enable-debug-endpoints", action="store_true",
         help="serve GET /debug/profilez (sampling wall-clock profiler: "
         "start/stop/snapshot, folded or speedscope output — "
@@ -2030,6 +2115,29 @@ def main(argv=None) -> int:
             )
     if args.slots < 1:
         parser.error("--slots must be >= 1")
+    if args.speculate != "off":
+        if args.batching != "continuous":
+            parser.error("--speculate requires --batching continuous")
+        if args.kv_layout != "paged":
+            parser.error("--speculate requires --kv-layout paged")
+        if args.role == "prefill":
+            parser.error(
+                "--speculate is decode-pool-only (a prefill replica "
+                "never decodes)"
+            )
+        if args.spec_depth < 1:
+            parser.error("--spec-depth must be >= 1")
+    if args.draft_preset and args.speculate != "draft":
+        parser.error("--draft-preset requires --speculate draft")
+    if args.draft_preset and args.draft_preset not in (
+        "draft-tiny", "tiny"
+    ):
+        # mirror of _draft_presets(), checked pre-jax so a typo is an
+        # argparse error rather than a post-init traceback
+        parser.error(
+            f"unknown --draft-preset {args.draft_preset!r} "
+            "(have: draft-tiny, tiny)"
+        )
     tenant_quotas = None
     if args.tenant_quotas:
         try:
@@ -2055,6 +2163,7 @@ def main(argv=None) -> int:
                 ("--kv-int8", args.kv_int8),
                 ("--weights-int8", args.weights_int8),
                 ("--speculative", args.speculative),
+                ("--speculate", args.speculate != "off"),
                 ("--batch-window-ms", args.batch_window_ms > 0),
                 ("--batching", args.batching not in ("", "none")),
                 ("--tp", args.tp > 1),
@@ -2176,6 +2285,8 @@ def main(argv=None) -> int:
         alerts=args.alerts == "on",
         ttft_slo_s=args.ttft_slo_ms / 1000.0,
         tenant_quotas=tenant_quotas,
+        speculate=args.speculate, spec_depth=args.spec_depth,
+        draft_preset=args.draft_preset,
     )
     logger.info("decode server on :%d", server.server_address[1])
     # graceful drain — the serving sibling of the training-side
