@@ -24,7 +24,7 @@ IMAGE_DIR := build/images
 DIST      := build/dist
 
 .PHONY: ci presubmit lint analyze native native-test native-race test wire-test e2e e2e-kind bench \
-        chaos-soak serve-soak serve-paged serve-sharded serve-spec serve-disagg trace-smoke alert-smoke autoscale-smoke bench-regression ha-soak controller-profile images release mnist-acc clean
+        chaos-soak serve-soak serve-paged serve-sharded serve-spec serve-disagg trace-smoke alert-smoke autoscale-smoke kv-observatory bench-regression ha-soak controller-profile images release mnist-acc clean
 
 # `test` already runs the whole tests/ tree (native bindings, wire,
 # E2E suites included) — native-test/wire-test exist for targeted runs,
@@ -50,7 +50,7 @@ presubmit:
 # lint analog; this image ships no pyflakes/ruff, so the checker is
 # vendored in tf_operator_tpu/analysis). The name rules run baseline-
 # free: they must stay at zero, no exceptions accrue.
-LINT_RULES := syntax-error,undefined-name,unused-import,redefinition,mutable-default-arg,bare-except-pass,wall-clock-interval
+LINT_RULES := syntax-error,undefined-name,unused-import,redefinition,mutable-default-arg,bare-except-pass,wall-clock-interval,duplicate-metric-registration
 lint:
 	$(PY) -m compileall -q tf_operator_tpu tests benchmarks hack bench.py __graft_entry__.py
 	$(PY) hack/graftlint.py --no-baseline --rules $(LINT_RULES) \
@@ -155,6 +155,15 @@ alert-smoke:
 # autoscale-smoke)
 autoscale-smoke:
 	env JAX_PLATFORMS=cpu $(PY) -m tf_operator_tpu.serve.fleet --autoscale-smoke
+
+# fleet KV observatory proof (docs/monitoring.md "KV observatory"):
+# two paged replicas with prefix affinity off serve a shared preamble
+# — the fleet prefix directory must show duplication > 1, the
+# re-prefill waste counter must move, every /kv/statz page must
+# render with its advertised digests resident, and the pool audits
+# must stay clean (CI's kv-observatory)
+kv-observatory:
+	env JAX_PLATFORMS=cpu $(PY) -m tf_operator_tpu.serve.fleet --kv-observatory
 
 # perf-regression sentinel (docs/monitoring.md "Regression sentinel"):
 # replay the committed benchmark artifacts against noise-banded

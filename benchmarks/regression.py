@@ -156,6 +156,57 @@ BASELINES = [
         "direction": "min",
         "band": 0.95,  # decisions at least a cooldown apart
     },
+    {
+        "check": "serve-kv-duplication-off",
+        "artifact": "serve_bench",
+        "path": "kv_observatory.affinity_off.duplication_factor",
+        "baseline": 2.0,
+        "direction": "min",
+        "band": 0.95,  # load-only routing must duplicate the preamble
+        # on both replicas or the directory stopped seeing residency
+    },
+    {
+        "check": "serve-kv-waste-off",
+        "artifact": "serve_bench",
+        "path": "kv_observatory.affinity_off.reprefill_waste_tokens",
+        "baseline": 16,
+        "direction": "min",
+        "band": 1.0,  # exactly the preamble: 2 blocks x 8 tokens,
+        # deterministic — less means attribution went blind
+    },
+    {
+        "check": "serve-kv-duplication-on",
+        "artifact": "serve_bench",
+        "path": "kv_observatory.affinity_on.duplication_factor",
+        "baseline": 1.0,
+        "direction": "max",
+        "band": 1.0,  # prefix-aware routing leaking duplication IS
+        # the regression
+    },
+    {
+        "check": "serve-kv-waste-on",
+        "artifact": "serve_bench",
+        "path": "kv_observatory.affinity_on.reprefill_waste_tokens",
+        "baseline": 0,
+        "direction": "max",
+        "band": 1.0,  # pinned at zero: warm routing re-prefills nothing
+    },
+    {
+        "check": "serve-kv-orphans-off",
+        "artifact": "serve_bench",
+        "path": "kv_observatory.affinity_off.digest_orphans",
+        "baseline": 0,
+        "direction": "max",
+        "band": 1.0,  # every advertised digest resident in /kv/statz
+    },
+    {
+        "check": "serve-kv-orphans-on",
+        "artifact": "serve_bench",
+        "path": "kv_observatory.affinity_on.digest_orphans",
+        "baseline": 0,
+        "direction": "max",
+        "band": 1.0,
+    },
     # -- controller scale (CONTROLLER_SCALE.json) ------------------------
     {
         "check": "controller-all-ready-100",

@@ -759,13 +759,16 @@ def sharded_scenarios() -> dict:
 
 
 def _boot_disagg_fleet(cfg, params, roles, block_size: int,
-                       prefill_chunk: int, n_slots: int):
+                       prefill_chunk: int, n_slots: int,
+                       prefix_affinity: bool = True):
     """One in-process continuous-batching server per (name, role),
     wired into a prefix-aware router. Returns (router, servers)."""
     from tf_operator_tpu.serve.router import LeastLoadedRouter
     from tf_operator_tpu.serve.server import make_server
 
-    router = LeastLoadedRouter(retry_wait=0.02)
+    router = LeastLoadedRouter(
+        retry_wait=0.02, prefix_affinity=prefix_affinity
+    )
     servers = []
     for name, role in roles:
         server = make_server(
@@ -1142,6 +1145,180 @@ def disagg_scenarios() -> dict:
         )
     if dis["migrations"] < 1:
         raise AssertionError("disaggregated run performed no migration")
+    return out
+
+
+def kv_observatory_scenarios() -> dict:
+    """The ``kv_observatory`` section: the fleet prefix directory and
+    re-prefill waste attribution, A/B'd over the routing policy that
+    causes them. Two role-less paged replicas serve a shared preamble;
+    with prefix affinity OFF the load-only scorer spreads the streams,
+    so both replicas prefill (and cache) the same preamble blocks —
+    the directory must show duplication factor 2.0 and the
+    reprefill_waste_tokens counter must charge exactly the preamble
+    (one stream lands cold while a warm peer advertises it). With
+    affinity ON the overlap credit overrides the load tie and keeps
+    the preamble on one replica: duplication pinned at 1.0, waste
+    pinned at ZERO. Every replica's /kv/statz residency page must
+    cover its advertised /kv/digest set (digest_orphans = 0), every
+    chain stays bit-identical to the inline reference, and both pools
+    audit clean/empty at shutdown. Raises on any violation so the
+    artifact cannot go stale past an acceptance regression."""
+    from tf_operator_tpu.models import gpt as gpt_lib
+    from tf_operator_tpu.serve.observatory import fleet_kv_directory
+
+    cfg = gpt_lib.GPT_TINY
+    params = _make_params(cfg)
+    bs = 8
+    n_slots = 4
+    new = 8
+    shared = [int(t) for t in jax.random.randint(
+        jax.random.PRNGKey(500), (2 * bs,), 1, cfg.vocab_size
+    )]
+    prompts = {
+        corr: shared + [int(t) for t in jax.random.randint(
+            jax.random.PRNGKey(510 + i), (3,), 1, cfg.vocab_size
+        )]
+        for i, corr in enumerate(("warm", "pin", "spread"))
+    }
+    expected = {
+        corr: [int(t) for t in gpt_lib.generate(
+            cfg, params, jnp.asarray([row], jnp.int32), new
+        )[0]]
+        for corr, row in prompts.items()
+    }
+
+    out = {
+        "block_size": bs,
+        "shared_preamble_blocks": len(shared) // bs,
+        "streams": len(prompts),
+    }
+    for arm, affinity in (
+        ("affinity_off", False), ("affinity_on", True),
+    ):
+        router, servers = _boot_disagg_fleet(
+            cfg, params, [("kv-0", ""), ("kv-1", "")], bs, bs, n_slots,
+            prefix_affinity=affinity,
+        )
+        engines = [s.state.engine for s in servers]
+        try:
+            results: dict = {}
+            # warm exactly one replica with the preamble, then probe so
+            # the router's scraped digests advertise it
+            _route_stream(router, prompts["warm"], new, "warm", results)
+            router.probe()
+
+            # hold one stream in flight (it pins whichever replica the
+            # scorer picks), then route another: affinity OFF,
+            # least-loaded lands it on the other — cold — replica and
+            # waste attribution must charge the preamble; affinity ON,
+            # the overlap credit overrides the one-in-flight penalty
+            # and the stream stays warm
+            first_token = threading.Event()
+
+            def _pinned() -> None:
+                t0 = time.perf_counter()
+                ttft = None
+                tokens = None
+                for event in router.generate_stream(
+                    prompts["pin"], new, corr="pin", timeout=600.0
+                ):
+                    if "token" in event and ttft is None:
+                        ttft = time.perf_counter() - t0
+                        first_token.set()
+                    if event.get("done"):
+                        tokens = event["tokens"][0]
+                results["pin"] = {"ttft": ttft, "tokens": tokens}
+
+            pin = threading.Thread(target=_pinned, name="kv-pin")
+            pin.start()
+            if not first_token.wait(timeout=60.0):
+                raise AssertionError(
+                    f"{arm}: pinned stream produced no token in 60s"
+                )
+            _route_stream(
+                router, prompts["spread"], new, "spread", results
+            )
+            pin.join(timeout=600.0)
+
+            for corr in prompts:
+                if results.get(corr, {}).get("tokens") != expected[corr]:
+                    raise AssertionError(
+                        f"{arm}: {corr} chain diverged from the inline "
+                        "reference"
+                    )
+
+            router.probe()  # the directory must see the final state
+            kv_dir = fleet_kv_directory(router)
+            stats = router.stats()
+            digests = router.digests()
+            orphans = 0
+            cached_idle = 0
+            for name, client in sorted(router.clients().items()):
+                page = client.kv_statz(top=5)
+                if not page.get("paged"):
+                    raise AssertionError(
+                        f"{arm}: {name} /kv/statz reports paged=False"
+                    )
+                resident = set(page["resident_digests"])
+                orphans += len(set(digests[name]["digest"]) - resident)
+                cached_idle += page["split"]["cached_idle"]
+            out[arm] = {
+                "duplication_factor": kv_dir["duplication_factor"],
+                "unique_blocks": kv_dir["unique_blocks"],
+                "held_blocks": kv_dir["held_blocks"],
+                "reprefill_waste_tokens": (
+                    stats["reprefill_waste_tokens"]
+                ),
+                "reprefill_waste_events": (
+                    stats["reprefill_waste_events"]
+                ),
+                "digest_orphans": orphans,
+                "cached_idle_blocks": cached_idle,
+            }
+        finally:
+            for server in servers:
+                server.shutdown()
+                server.state.engine.stop()  # audits the pool
+                server.server_close()
+        for (name, _), eng in zip(
+            [("kv-0", ""), ("kv-1", "")], engines
+        ):
+            if eng.pool_audit_failures:
+                raise AssertionError(
+                    f"{arm}: pool audit failed on {name}"
+                )
+            if eng.pool.in_use() != 0:
+                raise AssertionError(
+                    f"{arm}: {name} pool not empty at shutdown "
+                    f"({eng.pool.in_use()} blocks in use)"
+                )
+
+    off, on = out["affinity_off"], out["affinity_on"]
+    if off["duplication_factor"] <= 1.0:
+        raise AssertionError(
+            "affinity-off run produced no duplication (factor "
+            f"{off['duplication_factor']})"
+        )
+    if off["reprefill_waste_tokens"] <= 0:
+        raise AssertionError(
+            "affinity-off run charged no re-prefill waste"
+        )
+    if on["duplication_factor"] != 1.0:
+        raise AssertionError(
+            "prefix-aware routing leaked duplication (factor "
+            f"{on['duplication_factor']})"
+        )
+    if on["reprefill_waste_tokens"] != 0:
+        raise AssertionError(
+            "prefix-aware routing charged re-prefill waste ("
+            f"{on['reprefill_waste_tokens']} tokens)"
+        )
+    if off["digest_orphans"] or on["digest_orphans"]:
+        raise AssertionError(
+            "advertised digests absent from /kv/statz residency "
+            f"(off={off['digest_orphans']}, on={on['digest_orphans']})"
+        )
     return out
 
 
@@ -1555,6 +1732,7 @@ def run(write: bool = True) -> dict:
         "paged_kv": paged_scenarios(cfg, params),
         "sharded": sharded_scenarios(),
         "disaggregated": disagg_scenarios(),
+        "kv_observatory": kv_observatory_scenarios(),
         "mixed_tenant": mixed_tenant_scenarios(),
         "notes": (
             "plain/batched/continuous drive the live HTTP server "
@@ -1608,7 +1786,16 @@ def run(write: bool = True) -> dict:
             "chat ITL p95 must be strictly better disaggregated, "
             "chat TTFT p95 within the 0.071s paged pin, every chain "
             "bit-identical across the migration boundary, both pools "
-            "audited empty at shutdown. mixed_tenant is the QoS + "
+            "audited empty at shutdown. kv_observatory A/Bs the fleet "
+            "prefix directory and re-prefill waste attribution over "
+            "the routing policy (docs/monitoring.md \"KV "
+            "observatory\"): prefix affinity OFF must show "
+            "duplication factor 2.0 on the shared preamble with the "
+            "waste counter charging exactly the preamble tokens; "
+            "affinity ON pins duplication to 1.0 and waste to zero; "
+            "/kv/statz residency must cover every advertised digest "
+            "(orphans = 0) and both pools audit clean. "
+            "mixed_tenant is the QoS + "
             "autoscaling adversarial mix (docs/serving.md "
             "\"Autoscaling & QoS\"): one batch-class noisy tenant "
             "behind a tight token bucket floods a 1-replica fleet "
@@ -1658,6 +1845,12 @@ if __name__ == "__main__":
     if len(sys.argv) > 1 and sys.argv[1] == "--disagg-only":
         print(json.dumps(
             _merge_section("disaggregated", disagg_scenarios), indent=1
+        ))
+        sys.exit(0)
+    if len(sys.argv) > 1 and sys.argv[1] == "--kv-observatory-only":
+        print(json.dumps(
+            _merge_section("kv_observatory", kv_observatory_scenarios),
+            indent=1,
         ))
         sys.exit(0)
     if len(sys.argv) > 1 and sys.argv[1] == "--mixed-tenant-only":

@@ -1,0 +1,36 @@
+"""Seeded fixture: the duplicate-metric-registration footgun. One
+family name registered on the process-default registry as a counter
+here and as a gauge there — the second registration raises ValueError
+at runtime. graftlint must flag the conflicting (gauge) site and stay
+silent on same-kind re-registration and on private registries."""
+
+from tf_operator_tpu.telemetry import default_registry
+from tf_operator_tpu.telemetry.registry import MetricRegistry
+
+reg = default_registry()
+
+requests = reg.counter(
+    "serve_fixture_requests_total", "requests observed"
+)
+
+# BAD: same family name, different kind, same default registry
+requests_gauge = default_registry().gauge(
+    "serve_fixture_requests_total", "requests observed, but as a gauge"
+)
+
+# fine: same-kind re-registration is get-or-create, the repo idiom
+requests_again = reg.counter(
+    "serve_fixture_requests_total", "requests observed"
+)
+
+# fine: a private registry may reuse any name it likes
+private = MetricRegistry()
+private_gauge = private.gauge(
+    "serve_fixture_requests_total", "private scratch copy"
+)
+
+# fine: this name is rebound to something untraceable, so nothing
+# registered through it may count as default-registry-backed
+maybe = default_registry()
+maybe = private
+maybe_gauge = maybe.gauge("serve_fixture_requests_total", "untraced")

@@ -251,6 +251,51 @@ class TestNamesRules:
         }
 
 
+class TestMetricDupeRule:
+    def test_fixture_fires_once_at_conflicting_site(self):
+        findings = run_on("dup_metric_bad.py")
+        assert rules_of(findings) == {"duplicate-metric-registration"}
+        # one finding: the gauge site; same-kind re-registration, the
+        # private registry, and the rebound alias all stay silent
+        assert len(findings) == 1
+        f = findings[0]
+        assert "registered as gauge" in f.message
+        assert "as counter at" in f.message
+        assert "serve_fixture_requests_total" in f.message
+
+    def test_conflict_across_modules(self, tmp_path):
+        (tmp_path / "a.py").write_text(textwrap.dedent("""\
+            from tf_operator_tpu.telemetry import default_registry
+
+            c = default_registry().counter("serve_x_total", "x")
+        """))
+        (tmp_path / "b.py").write_text(textwrap.dedent("""\
+            from tf_operator_tpu.telemetry import default_registry
+
+            reg = default_registry()
+            g = reg.gauge("serve_x_total", "x, but a gauge")
+        """))
+        findings = analysis.run([str(tmp_path)])
+        dupes = [
+            f for f in findings
+            if f.rule == "duplicate-metric-registration"
+        ]
+        assert len(dupes) == 1
+        assert dupes[0].path.endswith("b.py")
+        assert "a.py" in dupes[0].message
+
+    def test_suppression_honored(self, tmp_path):
+        (tmp_path / "mod.py").write_text(textwrap.dedent("""\
+            from tf_operator_tpu.telemetry import default_registry
+
+            c = default_registry().counter("serve_y_total", "y")
+            g = default_registry().gauge(  # graftlint: disable=duplicate-metric-registration
+                "serve_y_total", "y")
+        """))
+        findings = analysis.run([str(tmp_path)])
+        assert "duplicate-metric-registration" not in rules_of(findings)
+
+
 class TestGoodCorpus:
     def test_clean_fixture_is_clean(self):
         assert run_on("clean_good.py") == []

@@ -1,0 +1,430 @@
+"""Fleet KV observatory: per-block residency (/kv/statz), the fleet
+prefix directory, re-prefill waste attribution, digest-scrape
+staleness, clock-cache epoch invalidation, and the /healthz
+pool-audit surface (tf_operator_tpu/serve/{engine,router,
+observatory}.py, telemetry/{collector,__main__}.py —
+docs/monitoring.md "KV observatory")."""
+
+import json
+import threading
+
+import jax
+import jax.numpy as jnp
+import pytest
+
+from tf_operator_tpu.models import gpt as gpt_lib
+from tf_operator_tpu.runtime.retry import RetryPolicy
+from tf_operator_tpu.serve.client import DecodeClient
+from tf_operator_tpu.serve.engine import BlockPool
+from tf_operator_tpu.serve.observatory import fleet_kv_directory
+from tf_operator_tpu.serve.prefix import block_prefix_hashes, prefix_hash
+from tf_operator_tpu.serve.router import LeastLoadedRouter
+from tf_operator_tpu.telemetry.collector import ClockCache
+from tf_operator_tpu.telemetry.flight import default_flight
+
+CFG = gpt_lib.GPT_TINY
+
+
+@pytest.fixture(scope="module")
+def params():
+    return gpt_lib.GPT(CFG).init(
+        jax.random.PRNGKey(0), jnp.zeros((1, 8), jnp.int32)
+    )["params"]
+
+
+# -- BlockPool residency metadata -------------------------------------------
+
+
+class TestBlockPoolResidency:
+    def test_split_accounts_for_every_block(self):
+        pool = BlockPool(8, 4)
+        key = tuple(range(4))
+        cached = pool.alloc()
+        pool.publish(key, cached)          # cached, ref 2 (cache+slot)
+        private = pool.alloc()             # ref 1, not cached
+        page = pool.residency()
+        split = page["split"]
+        assert split == {
+            "free": 5, "cached_idle": 0, "cached_shared": 1,
+            "private": 1, "sentinel": 1,
+        }
+        assert sum(split.values()) == pool.num_blocks
+        # releasing the slot's reference turns shared into idle
+        pool.release(cached)
+        split = pool.residency()["split"]
+        assert split["cached_idle"] == 1
+        assert split["cached_shared"] == 0
+
+    def test_hot_prefixes_and_resident_digests(self):
+        pool = BlockPool(8, 4)
+        key = tuple(range(4))
+        block = pool.alloc()
+        pool.publish(key, block)
+        hit = pool.lookup(key)
+        assert hit == block
+        pool.release(block)  # the slot's reference; cache keeps its own
+        page = pool.residency()
+        assert page["resident_digests"] == [prefix_hash(key)]
+        (row,) = page["hot_prefixes"]
+        assert row["digest"] == prefix_hash(key)
+        assert row["hits"] == 1
+        assert row["attaches"] >= 2  # alloc + publish (+ lookup)
+        assert row["idle_ticks"] <= row["age_ticks"]
+        # the histogram is cumulative over resident non-sentinel blocks
+        resident = (
+            page["split"]["cached_idle"] + page["split"]["cached_shared"]
+            + page["split"]["private"]
+        )
+        assert page["age_histogram"][-1] == {
+            "le": "+Inf", "count": resident,
+        }
+        # counters mirror the pool's own (the engine, not lookup(),
+        # accounts hits/misses — the page must report whatever it says)
+        assert page["counters"]["hits"] == pool.hits
+        assert page["counters"]["misses"] == pool.misses
+
+    def test_metadata_resets_on_reallocation(self):
+        pool = BlockPool(4, 4)
+        key = tuple(range(4))
+        block = pool.alloc()
+        pool.publish(key, block)
+        assert pool.lookup(key) == block
+        # drop every reference and reclaim the block for a new chain:
+        # the residency metadata must describe the NEW residency
+        pool.release(block)  # the slot's reference
+        pool.flush()         # the cache's reference: block fully free
+        fresh = pool.alloc()
+        page = pool.residency()
+        assert page["split"]["private"] == 1
+        assert page["resident_digests"] == []
+        # a freshly allocated block starts its counts over
+        assert pool._attaches[fresh] == 1
+        assert pool._block_hits[fresh] == 0
+
+    def test_fragmentation_ratio(self):
+        pool = BlockPool(8, 4)
+        key = tuple(range(4))
+        block = pool.alloc()
+        pool.publish(key, block)  # shared: cache + the holding slot
+        frag = pool.residency()["fragmentation"]
+        assert frag["unreclaimable_cached"] == 1
+        assert frag["sentinel"] == 1
+        assert frag["ratio"] == round(2 / 8, 6)
+
+
+# -- /kv/statz + /healthz over a live paged server ---------------------------
+
+
+class TestKvStatzServer:
+    @pytest.fixture(scope="class")
+    def server(self, params):
+        from tf_operator_tpu.serve import make_server
+
+        server = make_server(
+            CFG, params, port=0, model_name="kvstatz",
+            batching="continuous", n_slots=2, block_size=4,
+            prefill_chunk=4,
+        )
+        thread = threading.Thread(
+            target=server.serve_forever, daemon=True
+        )
+        thread.start()
+        yield server
+        server.shutdown()
+        server.state.engine.stop()
+        server.server_close()
+
+    def _client(self, server):
+        host, port = server.server_address[:2]
+        return DecodeClient(
+            f"http://{host}:{port}", timeout=30.0,
+            retry_policy=RetryPolicy(max_attempts=1),
+        )
+
+    def test_statz_renders_and_covers_digest(self, server):
+        client = self._client(server)
+        client.generate([list(range(1, 9))], max_new_tokens=2)
+        page = client.kv_statz()
+        assert page["paged"] is True
+        assert page["block_size"] == 4
+        assert page["resident_digests"]
+        advertised = set(client.kv_digest()["digest"])
+        assert advertised <= set(page["resident_digests"])
+        assert page["hot_prefixes"]
+        assert sum(page["split"].values()) == page["num_blocks"]
+
+    def test_top_clamps_hot_prefix_rows(self, server):
+        client = self._client(server)
+        client.generate([list(range(1, 9))], max_new_tokens=2)
+        page = client.kv_statz(top=1)
+        assert len(page["hot_prefixes"]) <= 1
+
+    def test_healthz_surfaces_pool_audit(self, server):
+        client = self._client(server)
+        assert client.healthy()["pool_audit"] == "ok"
+        engine = server.state.engine
+        engine.pool_audit_ok = False
+        engine.pool_audit_error = "seeded: block double-freed"
+        try:
+            payload = client.healthy()
+            assert payload["status"] == "degraded"
+            assert payload["pool_audit"] == "failed"
+            assert "double-freed" in payload["pool_audit_error"]
+        finally:
+            engine.pool_audit_ok = True
+            engine.pool_audit_error = ""
+        assert client.healthy()["status"] == "ok"
+
+    def test_kvz_cli_direct_mode(self, server, capsys):
+        from tf_operator_tpu.telemetry.__main__ import kvz_main
+
+        client = self._client(server)
+        client.generate([list(range(1, 9))], max_new_tokens=2)
+        host, port = server.server_address[:2]
+        rc = kvz_main(["--json", f"http://{host}:{port}"])
+        assert rc == 0
+        page = json.loads(capsys.readouterr().out)
+        assert page["unique_blocks"] >= 1
+        assert not page["partial"]
+        (doc,) = page["statz"].values()
+        assert doc["paged"] is True
+
+    def test_kvz_cli_rejects_ambiguous_invocation(self, capsys):
+        from tf_operator_tpu.telemetry.__main__ import kvz_main
+
+        assert kvz_main([]) == 2
+        assert kvz_main(
+            ["http://x", "--observatory", "http://y"]
+        ) == 2
+
+
+# -- fleet prefix directory --------------------------------------------------
+
+
+class _DigestRouter:
+    def __init__(self, rows):
+        self.rows = rows
+
+    def digests(self):
+        return self.rows
+
+
+def digest_row(digest, role="", block_size=4, ready=True):
+    return {
+        "role": role, "block_size": block_size, "ready": ready,
+        "digest": frozenset(digest),
+    }
+
+
+class TestFleetDirectory:
+    def test_duplication_factor_and_top_duplicated(self):
+        router = _DigestRouter({
+            "r0": digest_row({"aa", "bb"}),
+            "r1": digest_row({"bb", "cc"}),
+            "r2": digest_row(set()),
+        })
+        page = fleet_kv_directory(router)
+        assert page["unique_blocks"] == 3
+        assert page["held_blocks"] == 4
+        assert page["duplication_factor"] == round(4 / 3, 6)
+        assert page["replicas_with_digest"] == 2
+        assert page["directory"]["bb"] == ["r0", "r1"]
+        assert page["top_duplicated"] == [
+            {"digest": "bb", "replicas": ["r0", "r1"]},
+        ]
+
+    def test_empty_fleet(self):
+        page = fleet_kv_directory(_DigestRouter({}))
+        assert page["directory"] == {}
+        assert page["duplication_factor"] == 0.0
+        assert page["top_duplicated"] == []
+
+
+# -- re-prefill waste attribution (stub replicas) ----------------------------
+
+
+def scripted_chain(prompt, n):
+    out, last = [], prompt[-1]
+    for _ in range(n):
+        last = (last * 7 + 3) % 50
+        out.append(last)
+    return out
+
+
+class StubKvReplica:
+    """Stub decode client with a scriptable /kv/digest page."""
+
+    def __init__(self, url):
+        self.url = url
+        self.queue_depth = 0.0
+        self.digest_rows = []
+        self.digest_error = False
+        self.calls = 0
+
+    def ready(self):
+        return True
+
+    def metrics(self):
+        return {
+            "tf_operator_tpu_serve_engine_queue_depth": self.queue_depth,
+            "tf_operator_tpu_serve_engine_active_slots": 0.0,
+            "tf_operator_tpu_serve_engine_row_steps_total": 0.0,
+            "tf_operator_tpu_serve_engine_steps_total": 0.0,
+        }
+
+    def kv_digest(self):
+        if self.digest_error:
+            raise ConnectionResetError("scripted digest failure")
+        return {"role": "", "block_size": 4, "digest": self.digest_rows}
+
+    def generate_stream(self, input_ids, max_new_tokens=16, **kw):
+        self.calls += 1
+        prompt = list(input_ids)
+        chain = scripted_chain(prompt, max_new_tokens)
+        for i, tok in enumerate(chain):
+            yield {"token": tok, "index": len(prompt) + i}
+        yield {
+            "done": True,
+            "tokens": [prompt + chain],
+            "prompt_lens": [len(prompt)],
+        }
+
+
+def mk_kv_router(n=2, **kw):
+    stubs = {}
+
+    def factory(url):
+        stubs[url] = StubKvReplica(url)
+        return stubs[url]
+
+    router = LeastLoadedRouter(
+        client_factory=factory, retry_wait=0.01, **kw
+    )
+    for i in range(n):
+        router.add_replica(f"r{i}", f"stub://r{i}")
+    return router, [stubs[f"stub://r{i}"] for i in range(n)]
+
+
+class TestWasteAttribution:
+    PROMPT = list(range(1, 9))  # two full blocks at block_size 4
+
+    def _hashes(self):
+        return list(block_prefix_hashes(self.PROMPT, 4))
+
+    def test_cold_pick_with_warm_peer_charges_waste(self):
+        router, (a, b) = mk_kv_router(2, prefix_affinity=False)
+        a.digest_rows = self._hashes()
+        a.queue_depth = 9.0  # load-only scoring picks the cold b
+        router.probe()
+        corr = "kvwaste-test-cold"
+        list(router.generate_stream(self.PROMPT, 4, corr=corr))
+        assert b.calls == 1 and a.calls == 0
+        assert router.reprefill_waste_tokens == 2 * 4
+        assert router.reprefill_waste_events == 1
+        records = default_flight().snapshot(kind="kvwaste", corr=corr)
+        assert len(records) == 1
+        fields = records[0].fields
+        assert fields["replica"] == "r1"
+        assert fields["peer"] == "r0"
+        assert fields["blocks"] == 2
+        assert fields["tokens"] == 8
+        stats = router.stats()
+        assert stats["prefix_affinity"] is False
+        assert stats["reprefill_waste_tokens"] == 8
+
+    def test_prefix_affinity_routes_warm_and_charges_nothing(self):
+        router, (a, b) = mk_kv_router(2)  # affinity on by default
+        a.digest_rows = self._hashes()
+        router.probe()
+        list(router.generate_stream(
+            self.PROMPT, 4, corr="kvwaste-test-warm"
+        ))
+        assert a.calls == 1 and b.calls == 0
+        assert router.reprefill_waste_tokens == 0
+        assert router.reprefill_waste_events == 0
+
+    def test_no_waste_without_any_warm_peer(self):
+        router, (a, b) = mk_kv_router(2, prefix_affinity=False)
+        router.probe()
+        list(router.generate_stream(
+            self.PROMPT, 4, corr="kvwaste-test-nopeer"
+        ))
+        assert router.reprefill_waste_tokens == 0
+
+
+class TestDigestStaleness:
+    def test_last_digest_survives_blips_then_expires(self):
+        router, (a, b) = mk_kv_router(2)
+        a.digest_rows = ["aa", "bb"]
+        router.probe()
+        assert router.digests()["r0"]["digest"] == {"aa", "bb"}
+        a.digest_error = True
+        for failures in (1, 2):
+            router.probe()
+            stats = router.stats()["replicas"]["r0"]
+            assert stats["digest_failures"] == failures
+            # one or two blips keep the last digest scoreable
+            assert router.digests()["r0"]["digest"] == {"aa", "bb"}
+        router.probe()  # third consecutive failure: expire
+        assert router.digests()["r0"]["digest"] == frozenset()
+        assert router.stats()["replicas"]["r0"]["digest_failures"] == 3
+
+    def test_success_resets_failure_streak(self):
+        router, (a, b) = mk_kv_router(2)
+        a.digest_rows = ["aa"]
+        router.probe()
+        a.digest_error = True
+        router.probe()
+        router.probe()
+        a.digest_error = False
+        router.probe()  # success: streak back to zero
+        assert router.stats()["replicas"]["r0"]["digest_failures"] == 0
+        a.digest_error = True
+        router.probe()
+        router.probe()
+        assert router.digests()["r0"]["digest"] == {"aa"}
+
+
+# -- clock-cache epoch invalidation ------------------------------------------
+
+
+class TestClockCacheEpoch:
+    def test_epoch_drop_invalidates_cached_offset(self):
+        cache = ClockCache()
+        cache._entries["r0"] = (object(), 0.0)
+        cache.observe_epoch("r0", 5.0)   # first observation: baseline
+        cache.observe_epoch("r0", 7.0)   # growth: same process
+        assert "r0" in cache._entries
+        assert cache.invalidations == 0
+        cache.observe_epoch("r0", 1.0)   # DROP: the replica restarted
+        assert "r0" not in cache._entries
+        assert cache.invalidations == 1
+        # the next observation re-baselines against the new process
+        cache.observe_epoch("r0", 2.0)
+        assert cache.invalidations == 1
+
+    def test_epoch_drop_without_entry_is_harmless(self):
+        cache = ClockCache()
+        cache.observe_epoch("r1", 9.0)
+        cache.observe_epoch("r1", 0.0)
+        assert cache.invalidations == 0
+
+
+# -- alert rule + collector op registration ----------------------------------
+
+
+class TestObservatoryWiring:
+    def test_cached_idle_pressure_rule_registered(self):
+        from tf_operator_tpu.telemetry.alerts import fleet_rules
+
+        (rule,) = [
+            r for r in fleet_rules()
+            if r.name == "fleet-kv-cached-idle-pressure"
+        ]
+        assert rule.series == "fleet_kv_cached_idle_blocks"
+        assert rule.denominator == "fleet_kv_blocks_total"
+
+    def test_kvwaste_is_a_known_trace_op(self):
+        from tf_operator_tpu.telemetry.collector import KNOWN_OPS
+
+        assert "kvwaste" in KNOWN_OPS

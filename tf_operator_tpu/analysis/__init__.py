@@ -1,6 +1,6 @@
 """graftlint: static analysis for the tf-operator-tpu reproduction.
 
-Three pass families over one shared parse (ISSUE 5):
+Pass families over one shared parse (ISSUE 5):
 
 - lock discipline (`lockgraph`) — lock-order inversions, blocking ops
   under lock, callbacks/event emission under lock, nested
@@ -10,7 +10,10 @@ Three pass families over one shared parse (ISSUE 5):
   use-after-donation;
 - residual name lint (`names`) — the old hack/lint.py rules (F821
   undefined-name, F401 unused-import) plus redefinition,
-  mutable-default-arg and bare-except-pass.
+  mutable-default-arg and bare-except-pass;
+- telemetry hygiene (`metricdupe`) — a metric family name registered
+  on the process-default registry with two different kinds across the
+  tree (the second registration raises ValueError at runtime).
 
 Entry point: :func:`run`. The CLI lives in hack/graftlint.py.
 """
@@ -29,6 +32,7 @@ from .core import (
 )
 from .jaxhazards import JaxConfig, run_jax_pass
 from .lockgraph import LockConfig, run_lock_pass
+from .metricdupe import run_metric_pass
 from .names import run_names_pass
 
 # every rule graftlint can emit, for --rules validation and the docs
@@ -50,6 +54,8 @@ ALL_RULES = (
     "mutable-default-arg",
     "bare-except-pass",
     "wall-clock-interval",
+    # telemetry hygiene
+    "duplicate-metric-registration",
     # parse failures
     "syntax-error",
 )
@@ -78,6 +84,7 @@ def run(
     findings.extend(
         run_names_pass(modules, wall_clock_paths=wall_clock_paths)
     )
+    findings.extend(run_metric_pass(modules))
     if rules:
         keep = set(rules) | {"syntax-error"}
         findings = [f for f in findings if f.rule in keep]

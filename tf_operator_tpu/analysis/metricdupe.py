@@ -1,0 +1,142 @@
+"""Duplicate metric-family registration on the process-default registry.
+
+MetricRegistry.counter/gauge/histogram are get-or-create: registering
+the SAME name with the SAME kind returns the existing family (the
+idiom — router, engine, and observatory all do it), but registering a
+name that already exists with a DIFFERENT kind raises ValueError at
+runtime — typically at import or first-scrape time, far from the
+second caller that introduced the clash. Because every serve module
+shares one `default_registry()`, the two conflicting registrations are
+usually in different files and no single-module review sees both.
+
+This pass catches the footgun statically and fleet-wide: it collects
+every string-literal registration whose receiver is traceably the
+process-default registry — `default_registry().counter(...)` called
+directly, or through a local name every one of whose assignments is a
+bare `default_registry()` call — then flags each site whose kind
+disagrees with the first registration of that family name across the
+analyzed tree.
+
+Conservative by design (zero false positives beat coverage, same bar
+as names.py): receivers it cannot trace — `self.registry`, registries
+passed as parameters, private `MetricRegistry()` instances — are
+ignored, names that are ever rebound to anything else are ignored, and
+same-kind re-registration is never flagged.
+
+Rule: ``duplicate-metric-registration``. Suppression: `# noqa` or
+`# graftlint: disable=duplicate-metric-registration`.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, List, Sequence, Set, Tuple
+
+from .core import Finding, SourceFile
+
+RULE = "duplicate-metric-registration"
+
+# MetricRegistry's family constructors; the attr name IS the kind
+_KINDS = ("counter", "gauge", "histogram")
+
+_FACTORY = "default_registry"
+
+
+def _is_factory_call(node: ast.AST) -> bool:
+    """True for a bare `default_registry()` / `telemetry.default_registry()`
+    call (no arguments — the process-default accessor takes none)."""
+    if not isinstance(node, ast.Call) or node.args or node.keywords:
+        return False
+    func = node.func
+    if isinstance(func, ast.Name):
+        return func.id == _FACTORY
+    if isinstance(func, ast.Attribute):
+        return func.attr == _FACTORY
+    return False
+
+
+def _default_aliases(tree: ast.Module) -> Set[str]:
+    """Names that are ONLY ever assigned `default_registry()` anywhere
+    in the module (any scope). A name rebound to anything else — even
+    once — is dropped: `reg = router.registry` elsewhere must not make
+    `reg.gauge(...)` look default-registry-backed."""
+    assigned: Dict[str, List[bool]] = {}
+    for node in ast.walk(tree):
+        targets: List[ast.expr] = []
+        value = None
+        if isinstance(node, ast.Assign):
+            targets, value = node.targets, node.value
+        elif isinstance(node, ast.AnnAssign) and node.value is not None:
+            targets, value = [node.target], node.value
+        elif isinstance(node, ast.AugAssign):
+            targets, value = [node.target], node.value
+        for target in targets:
+            if isinstance(target, ast.Name):
+                assigned.setdefault(target.id, []).append(
+                    _is_factory_call(value)
+                )
+    return {
+        name for name, from_factory in assigned.items()
+        if all(from_factory)
+    }
+
+
+def _registrations(
+    module: SourceFile,
+) -> List[Tuple[str, str, int]]:
+    """(family_name, kind, line) for every literal-named registration
+    on a receiver traceable to the default registry."""
+    aliases = _default_aliases(module.tree)
+    out: List[Tuple[str, str, int]] = []
+    for node in ast.walk(module.tree):
+        if not isinstance(node, ast.Call):
+            continue
+        func = node.func
+        if not isinstance(func, ast.Attribute) or func.attr not in _KINDS:
+            continue
+        receiver = func.value
+        if not (
+            _is_factory_call(receiver)
+            or (isinstance(receiver, ast.Name) and receiver.id in aliases)
+        ):
+            continue
+        if not node.args:
+            continue
+        first = node.args[0]
+        if not (isinstance(first, ast.Constant)
+                and isinstance(first.value, str)):
+            continue
+        out.append((first.value, func.attr, node.lineno))
+    return out
+
+
+def run_metric_pass(modules: Sequence[SourceFile]) -> List[Finding]:
+    """Cross-module pass: group default-registry registrations by
+    family name; any name seen with two kinds flags every site whose
+    kind disagrees with the first (lowest path:line) registration."""
+    # family name -> [(path, line, kind, module)]
+    sites: Dict[str, List[Tuple[str, int, str, SourceFile]]] = {}
+    for module in modules:
+        for name, kind, line in _registrations(module):
+            sites.setdefault(name, []).append(
+                (module.path, line, kind, module)
+            )
+    findings: List[Finding] = []
+    for name, regs in sites.items():
+        if len({kind for _, _, kind, _ in regs}) < 2:
+            continue
+        regs.sort(key=lambda r: (r[0], r[1]))
+        canon_path, canon_line, canon_kind, _ = regs[0]
+        for path, line, kind, module in regs:
+            if kind == canon_kind:
+                continue
+            if module.suppressed(line, RULE):
+                continue
+            findings.append(Finding(
+                RULE, path, line,
+                f"metric family '{name}' registered as {kind} on the "
+                f"default registry but as {canon_kind} at "
+                f"{canon_path}:{canon_line} — conflicting kinds raise "
+                "ValueError at runtime",
+            ))
+    return findings

@@ -289,6 +289,15 @@ class DecodeClient:
         prefix_hash vocabulary)."""
         return json.loads(self._request("/kv/digest"))
 
+    def kv_statz(self, top: int = 10) -> dict:
+        """The replica's KV residency page from /kv/statz: block
+        split, occupancy-by-age histogram, hot-prefix top-N, resident
+        digests, and fragmentation accounting (paged engines;
+        non-paged replicas answer {"paged": False})."""
+        return json.loads(
+            self._request(f"/kv/statz?top={int(top)}")
+        )
+
     def healthy(self) -> dict:
         return json.loads(self._request("/healthz"))
 

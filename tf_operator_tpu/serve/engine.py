@@ -95,6 +95,10 @@ METRIC_HELP = {
     "engine_kv_blocks_in_use":
         "KV blocks held by live slots (excludes idle prefix-cache "
         "blocks)",
+    "engine_kv_cached_idle_blocks":
+        "Prefix-cache blocks no live slot shares (reclaimable; the "
+        "fleet KV observatory sums these into "
+        "fleet_kv_cached_idle_blocks)",
     "engine_prefix_cache_blocks":
         "Blocks currently indexed by the prefix cache",
     "engine_prefix_cache_hits_total":
@@ -231,6 +235,16 @@ class BlockPool:
         self.hit_tokens = 0
         self.cow_copies = 0
         self.reclaimed = 0
+        # per-block residency metadata (the fleet KV observatory's
+        # /kv/statz raw material). All times are pool ticks — the same
+        # monotonic counter the LRU uses, never wall clock — so the
+        # page is deterministic under the bit-identity soak. A block's
+        # metadata is reset when it is re-allocated, so the counts
+        # describe the CURRENT residency, not the block id's lifetime.
+        self._created = [0] * self.num_blocks      # tick at alloc
+        self._last_access = [0] * self.num_blocks  # tick at last touch
+        self._attaches = [0] * self.num_blocks     # retains + publish
+        self._block_hits = [0] * self.num_blocks   # lookup hits served
 
     # -- accounting --------------------------------------------------------
 
@@ -253,6 +267,8 @@ class BlockPool:
 
     def retain(self, block: int) -> None:
         self._ref[block] += 1
+        self._attaches[block] += 1
+        self._last_access[block] = self._tick
 
     def release(self, block: int) -> None:
         if self._ref[block] <= 0:
@@ -276,6 +292,11 @@ class BlockPool:
                     "KV block pool exhausted despite reservation"
                 )
         self._ref[block] = 1
+        self._tick += 1
+        self._created[block] = self._tick
+        self._last_access[block] = self._tick
+        self._attaches[block] = 1
+        self._block_hits[block] = 0
         return block
 
     def _reclaim(self):
@@ -303,6 +324,8 @@ class BlockPool:
         if block is not None:
             self._tick += 1
             self._lru[key] = self._tick
+            self._block_hits[block] += 1
+            self._last_access[block] = self._tick
         return block
 
     def publish(self, key, block: int) -> None:
@@ -316,9 +339,88 @@ class BlockPool:
         self._ref[block] += 1
         self._tick += 1
         self._lru[key] = self._tick
+        self._attaches[block] += 1
+        self._last_access[block] = self._tick
 
     def cached_blocks(self) -> int:
         return len(self._cached)
+
+    def residency(self, top_n: int = 10) -> dict:
+        """The /kv/statz page: per-block residency rolled up into an
+        occupancy-by-age histogram, the hot-prefix top-N by hit count,
+        the cached-idle vs shared vs private split, and fragmentation
+        (blocks that LOOK reclaimable but aren't: cached blocks shared
+        with live slots, plus the permanently pinned sentinel).
+
+        Engine-thread only (walks _cached/_ref mid-mutation-free);
+        observers go through ContinuousBatchingEngine.kv_statz(),
+        which submits here as an engine op. Ages are pool ticks, not
+        seconds — deterministic by construction."""
+        rev = {block: key for key, block in self._cached.items()}
+        split = {"free": len(self._free), "cached_idle": 0,
+                 "cached_shared": 0, "private": 0, "sentinel": 1}
+        ages: list = []
+        hot: list = []
+        for block in range(1, self.num_blocks):
+            if self._ref[block] <= 0:
+                continue
+            key = rev.get(block)
+            if key is not None:
+                if self._ref[block] == 1:
+                    split["cached_idle"] += 1
+                else:
+                    split["cached_shared"] += 1
+                hot.append({
+                    "digest": prefix_hash(key),
+                    "hits": self._block_hits[block],
+                    "attaches": self._attaches[block],
+                    "age_ticks": self._tick - self._created[block],
+                    "idle_ticks":
+                        self._tick - self._last_access[block],
+                    "idle": self._ref[block] == 1,
+                })
+            else:
+                split["private"] += 1
+            ages.append(self._tick - self._created[block])
+        # log2 occupancy-by-age buckets over resident blocks: the
+        # shape answers "is the cache full of fresh or fossil blocks"
+        # without per-block dumps
+        edges = [1, 4, 16, 64, 256, 1024, 4096]
+        age_hist = [
+            {"le": le, "count": sum(1 for a in ages if a <= le)}
+            for le in edges
+        ]
+        age_hist.append({"le": "+Inf", "count": len(ages)})
+        hot.sort(
+            key=lambda row: (-row["hits"], -row["attaches"],
+                             row["digest"])
+        )
+        unreclaimable = split["cached_shared"] + split["sentinel"]
+        return {
+            "block_size": self.block_size,
+            "num_blocks": self.num_blocks,
+            "total": self.total,
+            "tick": self._tick,
+            "split": split,
+            "age_histogram": age_hist,
+            "hot_prefixes": hot[:max(0, int(top_n))],
+            "resident_digests": sorted(
+                prefix_hash(key) for key in self._cached
+            ),
+            "fragmentation": {
+                "free": len(self._free),
+                "unreclaimable_cached": split["cached_shared"],
+                "sentinel": split["sentinel"],
+                "ratio": round(unreclaimable / self.num_blocks, 6),
+            },
+            "counters": {
+                "hits": self.hits,
+                "misses": self.misses,
+                "hit_tokens": self.hit_tokens,
+                "cow_copies": self.cow_copies,
+                "reclaimed": self.reclaimed,
+            },
+        }
 
     def flush(self) -> None:
         """Drop the whole prefix cache (weights swapped or the device
@@ -703,6 +805,11 @@ class ContinuousBatchingEngine:
         self.migrations_out = 0
         self.migrations_in = 0
         self.pool_audit_failures = 0
+        # most recent BlockPool.check() verdict + message: /healthz
+        # reads these so a failed audit flips the health payload
+        # instead of hiding in a counter nobody polls
+        self.pool_audit_ok = True
+        self.pool_audit_error = ""
         # speculative accounting (engine-thread-owned): proposed /
         # accepted drive the accept-rate gauge; fallback_steps counts
         # quanta that ran the single-token program because every live
@@ -1160,6 +1267,20 @@ class ContinuousBatchingEngine:
 
         return self._submit_op(op)
 
+    def kv_statz(self, top_n: int = 10) -> dict:
+        """The pool's residency page (BlockPool.residency) computed on
+        the engine thread — the per-replica half of the fleet KV
+        observatory. Non-paged engines answer {"paged": False}."""
+        if not self._paged:
+            return {"paged": False}
+
+        def op():
+            page = self.pool.residency(top_n=top_n)
+            page["paged"] = True
+            return page
+
+        return self._submit_op(op)
+
     def audit_pool(self, where: str = "audit") -> bool:
         """Run BlockPool.check() on the engine thread; a failed audit
         is surfaced as a flight record + counter (never an unhandled
@@ -1172,11 +1293,15 @@ class ContinuousBatchingEngine:
                 self.pool.check()
             except AssertionError as err:
                 self.pool_audit_failures += 1
+                self.pool_audit_ok = False
+                self.pool_audit_error = str(err)
                 self._fl().record(
                     "serve", op="pool-audit", ok=False, where=where,
                     error=str(err),
                 )
                 return False
+            self.pool_audit_ok = True
+            self.pool_audit_error = ""
             self._fl().record(
                 "serve", op="pool-audit", ok=True, where=where,
                 in_use=self.pool.in_use(),
@@ -1260,6 +1385,8 @@ class ContinuousBatchingEngine:
             out.update({
                 ("engine_kv_blocks_total", "gauge"): pool.total,
                 ("engine_kv_blocks_in_use", "gauge"): pool.in_use(),
+                ("engine_kv_cached_idle_blocks", "gauge"):
+                    pool.cached_idle(),
                 ("engine_prefix_cache_blocks", "gauge"):
                     pool.cached_blocks(),
                 ("engine_prefix_cache_hits_total", "counter"):

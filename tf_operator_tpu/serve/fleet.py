@@ -1111,6 +1111,224 @@ def run_trace_smoke(
     return summary
 
 
+def run_kv_observatory_smoke(
+    seed: int = 0,
+    max_new: int = 8,
+    namespace: str = "kvobs",
+) -> dict:
+    """End-to-end proof of the fleet KV observatory (CI step
+    `kv-observatory`): two paged monolithic replicas serve
+    shared-preamble prompts with prefix-aware routing OFF, so the
+    preamble gets prefilled — and cached — on both. Asserts the fleet
+    prefix directory is non-empty with duplication factor > 1, the
+    re-prefill waste counter moved (a stream was routed to a cold
+    replica while a warm peer already held its prefix), every
+    replica's /kv/statz page renders with resident digests covering
+    its advertised /kv/digest set (no orphans), /healthz reports a
+    clean pool audit, and the observatory's /debug/slozz carries the
+    fleet "kv" block. Raises AssertionError on any violation."""
+    import urllib.request
+
+    import jax
+    import jax.numpy as jnp
+
+    from ..controller.serve import ServeServiceController
+    from ..models import gpt as gpt_lib
+    from ..runtime import InMemorySubstrate
+    from .observatory import fleet_kv_directory, make_observatory
+
+    cfg = gpt_lib.GPT_TINY
+    params = gpt_lib.GPT(cfg).init(
+        jax.random.PRNGKey(0), jnp.zeros((1, 8), jnp.int32)
+    )["params"]
+
+    rng = random.Random(seed)
+    block_size = 8
+    substrate = InMemorySubstrate()
+    # prefix_affinity=False is the point of the exercise: the router
+    # still *sees* overlaps (decision ring, waste attribution) but
+    # stops steering toward them, so duplication and re-prefill waste
+    # become observable instead of being routed away
+    router = LeastLoadedRouter(retry_wait=0.02, prefix_affinity=False)
+    fleet = InProcessFleet(
+        substrate, router, cfg, {"v1": params}, slots=2,
+        namespace=namespace, block_size=block_size,
+        prefill_chunk=block_size,
+    )
+    controller = ServeServiceController(
+        substrate, namespace=namespace,
+        weight_update=fleet.update_weights,
+    )
+    svc = ServeService(
+        spec=ServeServiceSpec(
+            replicas=2, preset="tiny", slots=2, weights_version="v1",
+        )
+    )
+    svc.metadata.name = "kvobs"
+    svc.metadata.namespace = namespace
+
+    shared = [
+        rng.randrange(1, cfg.vocab_size) for _ in range(2 * block_size)
+    ]
+
+    def _tail() -> List[int]:
+        return [
+            rng.randrange(1, cfg.vocab_size)
+            for _ in range(rng.randint(1, 3))
+        ]
+
+    started = time.monotonic()
+    obs = None
+    obs_thread = None
+    problems: List[str] = []
+    try:
+        substrate.create_serve_service(svc)
+        controller.run_until_quiet()
+        fleet.sync()
+        fleet.wait_ready(2)
+
+        def _drain(prompt: List[int], corr: str,
+                   first: Optional[threading.Event] = None) -> None:
+            for event in router.generate_stream(
+                prompt, max_new, corr=corr, timeout=120.0,
+            ):
+                if first is not None and event.get("token") is not None:
+                    first.set()
+
+        # wave 1: warm exactly one replica with the shared preamble,
+        # then probe so the router's scraped digests know about it
+        _drain(shared + _tail(), f"kvobs-{seed}-warm")
+        router.probe()
+
+        # wave 2: hold one stream in flight (it pins whichever replica
+        # the load-only scorer picks), then route a second — least-
+        # loaded forces it onto the *other* replica; one of the two is
+        # cold while a warm peer advertises the preamble, so waste
+        # attribution must fire for it
+        first_token = threading.Event()
+        pin_error: List[Optional[str]] = [None]
+
+        def _pinned() -> None:
+            try:
+                _drain(shared + _tail(), f"kvobs-{seed}-pin", first_token)
+            except Exception as err:  # noqa: BLE001 — asserted below
+                pin_error[0] = f"{type(err).__name__}: {err}"
+
+        pin = threading.Thread(target=_pinned, name="kvobs-pin")
+        pin.start()
+        if not first_token.wait(timeout=60.0):
+            problems.append("pinned stream produced no token in 60s")
+        _drain(shared + _tail(), f"kvobs-{seed}-spread")
+        pin.join(timeout=120.0)
+        if pin_error[0]:
+            problems.append(f"pinned stream failed: {pin_error[0]}")
+
+        # both replicas have now prefilled the preamble; re-probe so
+        # the directory sees the duplication
+        router.probe()
+        kv_dir = fleet_kv_directory(router)
+        stats = router.stats()
+        digests = router.digests()
+        statz = {
+            name: client.kv_statz(top=5)
+            for name, client in router.clients().items()
+        }
+        health = {
+            name: client.healthy()
+            for name, client in router.clients().items()
+        }
+
+        obs = make_observatory(router)
+        obs_thread = threading.Thread(
+            target=obs.serve_forever, daemon=True, name="observatory"
+        )
+        obs_thread.start()
+        host, port = obs.server_address[:2]
+        # trace-exempt: observatory debug fetches are reads about
+        # streams, not members of one
+        with urllib.request.urlopen(
+            f"http://{host}:{port}/debug/slozz", timeout=30
+        ) as resp:
+            slozz = json.loads(resp.read())
+    finally:
+        if obs is not None:
+            obs.shutdown()
+            obs.server_close()
+        fleet.stop()
+        controller.stop()
+
+    if not kv_dir["directory"]:
+        problems.append("fleet prefix directory is empty")
+    if kv_dir["duplication_factor"] <= 1.0:
+        problems.append(
+            "no duplication with prefix_affinity off (factor "
+            f"{kv_dir['duplication_factor']})"
+        )
+    if not any(
+        len(holders) >= 2 for holders in kv_dir["directory"].values()
+    ):
+        problems.append("no digest held by more than one replica")
+    if stats["reprefill_waste_tokens"] <= 0:
+        problems.append(
+            "re-prefill waste counter did not move (tokens "
+            f"{stats['reprefill_waste_tokens']}, events "
+            f"{stats['reprefill_waste_events']})"
+        )
+    for name, page in statz.items():
+        if not page.get("paged"):
+            problems.append(f"{name}: /kv/statz reports paged=False")
+            continue
+        resident = set(page.get("resident_digests", []))
+        if not resident:
+            problems.append(f"{name}: /kv/statz has no resident digests")
+        advertised = set(digests[name]["digest"])
+        orphans = advertised - resident
+        if orphans:
+            problems.append(
+                f"{name}: advertised digests absent from /kv/statz "
+                f"residency: {sorted(orphans)}"
+            )
+        if not page.get("hot_prefixes"):
+            problems.append(f"{name}: /kv/statz hot_prefixes is empty")
+    for name, payload in health.items():
+        if payload.get("pool_audit") != "ok":
+            problems.append(
+                f"{name}: /healthz pool_audit={payload.get('pool_audit')}"
+                f" ({payload.get('pool_audit_error', '')})"
+            )
+    kv_block = slozz.get("kv")
+    if not kv_block:
+        problems.append("/debug/slozz has no kv block")
+    elif kv_block["reprefill_waste_tokens_total"] <= 0:
+        problems.append("/debug/slozz kv block shows zero waste")
+
+    summary = {
+        "seed": seed,
+        "duplication_factor": kv_dir["duplication_factor"],
+        "unique_blocks": kv_dir["unique_blocks"],
+        "held_blocks": kv_dir["held_blocks"],
+        "reprefill_waste_tokens": stats["reprefill_waste_tokens"],
+        "reprefill_waste_events": stats["reprefill_waste_events"],
+        "replicas": {
+            name: {
+                "split": page.get("split"),
+                "resident": len(page.get("resident_digests", [])),
+                "pool_audit": health[name].get("pool_audit"),
+            }
+            for name, page in statz.items()
+        },
+        "slozz_kv": kv_block,
+        "problems": problems,
+        "seconds": round(time.monotonic() - started, 2),
+        "ok": not problems,
+    }
+    if not summary["ok"]:
+        raise AssertionError(
+            f"kv observatory smoke failed: {json.dumps(summary)}"
+        )
+    return summary
+
+
 def run_alert_smoke(
     seed: int = 0,
     max_new: int = 8,
@@ -1655,6 +1873,13 @@ def main(argv: Optional[List[str]] = None) -> int:
         "alert resolves — with trace-correlated alert flight records",
     )
     mode.add_argument(
+        "--kv-observatory", action="store_true",
+        help="fleet KV observatory smoke: two paged replicas, shared "
+        "preamble, prefix affinity off — the prefix directory shows "
+        "duplication > 1, the re-prefill waste counter moves, "
+        "/kv/statz renders, and the pool audits stay clean",
+    )
+    mode.add_argument(
         "--autoscale-smoke", action="store_true",
         help="closed-loop autoscaling smoke: chaos latency trips the "
         "burn alert, the autoscaler scales the decode group out, the "
@@ -1675,6 +1900,10 @@ def main(argv: Optional[List[str]] = None) -> int:
         )
     elif args.trace_smoke:
         summary = run_trace_smoke(seed=args.seed, max_new=args.max_new)
+    elif args.kv_observatory:
+        summary = run_kv_observatory_smoke(
+            seed=args.seed, max_new=args.max_new
+        )
     elif args.alert_smoke:
         summary = run_alert_smoke(seed=args.seed, max_new=args.max_new)
     elif args.autoscale_smoke:

@@ -46,6 +46,7 @@ from ..telemetry.registry import histogram_quantile
 
 __all__ = [
     "fleet_slo",
+    "fleet_kv_directory",
     "router_trace",
     "make_observatory",
     "observatory_tick",
@@ -64,6 +65,11 @@ _FLEET_FAMILIES = {
 _Q_DEPTH = _SERVE + "engine_queue_depth"
 _KV_IN_USE = _SERVE + "engine_kv_blocks_in_use"
 _KV_TOTAL = _SERVE + "engine_kv_blocks_total"
+_KV_CACHED_IDLE = _SERVE + "engine_kv_cached_idle_blocks"
+# restart epoch for the clock cache: a per-process counter that only
+# grows within one process lifetime, so a drop across scrapes means
+# the replica restarted (ClockCache.observe_epoch)
+_COMPILES = _SERVE + "engine_compiles_total"
 # speculative-decoding counters (engines with --speculate off simply
 # don't export the families; their replicas contribute 0)
 _SPEC_PROPOSED = _SERVE + "spec_tokens_proposed_total"
@@ -128,7 +134,50 @@ def _exact_quantiles(samples: List[float]) -> Dict[str, Optional[float]]:
     return {"p50": pick(0.50), "p95": pick(0.95)}
 
 
-def fleet_slo(router, history=None, alerts=None) -> dict:
+def fleet_kv_directory(router) -> dict:
+    """The fleet prefix directory: digest -> sorted list of replicas
+    holding that prefix block, built from the per-replica digests the
+    router's probes already scrape (no extra network). Derived
+    series:
+
+      duplication_factor  mean replicas holding each resident digest
+                          (1.0 = perfectly partitioned; 2.0 = every
+                          prefix block derived twice fleet-wide)
+      unique_blocks       distinct digests anywhere in the fleet
+
+    A digest held by N replicas represents prefill work done N times;
+    the directory is the map a peer-to-peer block fetch would consult
+    (ROADMAP item 3), surfaced here first as measurement."""
+    directory: Dict[str, List[str]] = {}
+    per_replica = router.digests()
+    for name, info in sorted(per_replica.items()):
+        for digest in info["digest"]:
+            directory.setdefault(digest, []).append(name)
+    for holders in directory.values():
+        holders.sort()
+    unique = len(directory)
+    held = sum(len(holders) for holders in directory.values())
+    dup = held / unique if unique else 0.0
+    return {
+        "directory": directory,
+        "unique_blocks": unique,
+        "held_blocks": held,
+        "duplication_factor": round(dup, 6),
+        "replicas_with_digest": sum(
+            1 for info in per_replica.values() if info["digest"]
+        ),
+        "top_duplicated": sorted(
+            (
+                {"digest": digest, "replicas": holders}
+                for digest, holders in directory.items()
+                if len(holders) > 1
+            ),
+            key=lambda row: (-len(row["replicas"]), row["digest"]),
+        )[:10],
+    }
+
+
+def fleet_slo(router, history=None, alerts=None, clock_cache=None) -> dict:
     """Scrape every replica once, sum histogram buckets fleet-wide,
     and return the SLO snapshot. Side effect: refreshes the fleet_*
     gauges on router.registry so a plain Prometheus scrape of the
@@ -139,13 +188,18 @@ def fleet_slo(router, history=None, alerts=None) -> dict:
     the series fleet_rules() watch). With `alerts`, the AlertManager is
     evaluated against that history after ingestion; a scrape that
     missed any replica marks the sample `partial`, which holds firing
-    alerts instead of resolving them on missing data."""
+    alerts instead of resolving them on missing data. With
+    `clock_cache`, each replica's engine_compiles_total is reported as
+    its restart epoch (ClockCache.observe_epoch), so a restarted
+    replica's stale clock offset is invalidated by the very scrape
+    that noticed the restart."""
     merged: Dict[str, Dict[float, float]] = {
         key: {} for key in _FLEET_FAMILIES
     }
     queue_depth = 0.0
     kv_in_use = 0.0
     kv_total = 0.0
+    kv_cached_idle = 0.0
     spec_proposed = 0.0
     spec_accepted = 0.0
     tenant_sums: Dict[str, float] = {}
@@ -157,11 +211,14 @@ def fleet_slo(router, history=None, alerts=None) -> dict:
         except Exception:
             unreachable.append(name)
             continue
+        if clock_cache is not None:
+            clock_cache.observe_epoch(name, flat.get(_COMPILES, 0.0))
         for key, family in _FLEET_FAMILIES.items():
             _merge(merged[key], bucket_pairs(flat, family))
         queue_depth += flat.get(_Q_DEPTH, 0.0)
         kv_in_use += flat.get(_KV_IN_USE, 0.0)
         kv_total += flat.get(_KV_TOTAL, 0.0)
+        kv_cached_idle += flat.get(_KV_CACHED_IDLE, 0.0)
         spec_proposed += flat.get(_SPEC_PROPOSED, 0.0)
         spec_accepted += flat.get(_SPEC_ACCEPTED, 0.0)
         for sample, value in flat.items():
@@ -227,6 +284,26 @@ def fleet_slo(router, history=None, alerts=None) -> dict:
     )
     for hop, value in hops_p95.items():
         g.labels(hop=hop).set(value or 0.0)
+    # the fleet prefix directory (KV observatory): duplication and
+    # cached-idle pressure, from digests the probes already scraped
+    kv_dir = fleet_kv_directory(router)
+    waste_tokens = float(
+        getattr(router, "reprefill_waste_tokens", 0)
+    )
+    router.registry.gauge(
+        "fleet_kv_duplication_factor",
+        "Mean replicas holding each resident prefix block "
+        "(1.0 = partitioned, higher = duplicated prefill work)",
+    ).set(kv_dir["duplication_factor"])
+    router.registry.gauge(
+        "fleet_prefix_unique_blocks",
+        "Distinct prefix-block digests resident anywhere in the fleet",
+    ).set(float(kv_dir["unique_blocks"]))
+    router.registry.gauge(
+        "fleet_kv_cached_idle_blocks",
+        "Cached prefix blocks no live slot shares, summed across "
+        "replicas (reclaimable; peer-fetch candidates)",
+    ).set(kv_cached_idle)
     spec_accept_rate = (
         spec_accepted / spec_proposed if spec_proposed else 0.0
     )
@@ -261,6 +338,24 @@ def fleet_slo(router, history=None, alerts=None) -> dict:
         history.ingest_value("fleet_queue_depth", "gauge", queue_depth)
         history.ingest_value("fleet_kv_blocks_in_use", "gauge", kv_in_use)
         history.ingest_value("fleet_kv_blocks_total", "gauge", kv_total)
+        # fleet KV observatory series: duplication + cached-idle feed
+        # the cached-idle-pressure rule; the waste counter stays
+        # cumulative so rate() over it is live waste tokens/s
+        history.ingest_value(
+            "fleet_kv_duplication_factor", "gauge",
+            kv_dir["duplication_factor"],
+        )
+        history.ingest_value(
+            "fleet_prefix_unique_blocks", "gauge",
+            float(kv_dir["unique_blocks"]),
+        )
+        history.ingest_value(
+            "fleet_kv_cached_idle_blocks", "gauge", kv_cached_idle
+        )
+        history.ingest_value(
+            "fleet_reprefill_waste_tokens_total", "counter",
+            waste_tokens,
+        )
         history.ingest_value(
             "fleet_scrape_errors", "gauge", float(len(unreachable))
         )
@@ -306,6 +401,24 @@ def fleet_slo(router, history=None, alerts=None) -> dict:
                 "accept_rate": round(spec_accept_rate, 6),
             },
         },
+        "kv": {
+            "duplication_factor": kv_dir["duplication_factor"],
+            "unique_blocks": kv_dir["unique_blocks"],
+            "held_blocks": kv_dir["held_blocks"],
+            "cached_idle_blocks": kv_cached_idle,
+            "cached_idle_fraction": round(
+                kv_cached_idle / kv_total if kv_total else 0.0, 6
+            ),
+            "replicas_with_digest": kv_dir["replicas_with_digest"],
+            "top_duplicated": kv_dir["top_duplicated"],
+            "reprefill_waste_tokens_total": waste_tokens,
+            "reprefill_waste_events": int(
+                getattr(router, "reprefill_waste_events", 0)
+            ),
+            "prefix_affinity": bool(
+                getattr(router, "prefix_affinity", True)
+            ),
+        },
         "router": {
             **router_slo,
             "failovers": router.failovers,
@@ -346,12 +459,16 @@ def router_trace(
     )
 
 
-def observatory_tick(router, history, alerts, autoscaler=None) -> dict:
+def observatory_tick(
+    router, history, alerts, autoscaler=None, clock_cache=None
+) -> dict:
     """One observatory cadence step: scrape the fleet into history,
     snapshot any tracked sources, evaluate alert rules, and — when an
     autoscaler is wired — let the alert state actuate. Returns the
     fleet_slo report (with alerts and scaling decisions folded in)."""
-    report = fleet_slo(router, history=history, alerts=alerts)
+    report = fleet_slo(
+        router, history=history, alerts=alerts, clock_cache=clock_cache
+    )
     history.tick()
     if autoscaler is not None:
         report["scale_decisions"] = autoscaler.tick()
@@ -418,7 +535,8 @@ def make_observatory(
                 self._reply_json(200, router.stats())
             elif parsed.path == "/debug/slozz":
                 report = fleet_slo(
-                    router, history=history, alerts=alerts
+                    router, history=history, alerts=alerts,
+                    clock_cache=clock_cache,
                 )
                 if autoscaler is not None:
                     report["autoscaler"] = autoscaler.describe()
@@ -477,7 +595,8 @@ def make_observatory(
             while not stop.wait(interval_s):
                 try:
                     observatory_tick(
-                        router, history, alerts, autoscaler=autoscaler
+                        router, history, alerts,
+                        autoscaler=autoscaler, clock_cache=clock_cache,
                     )
                 except Exception:
                     pass

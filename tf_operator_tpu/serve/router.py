@@ -72,6 +72,13 @@ _SPEC_ACCEPTED = "tf_operator_tpu_serve_spec_tokens_accepted_total"
 _OVERLAP_WEIGHT = 2.0
 _OVERLAP_CAP = 8
 
+# digest-scrape staleness: a replica whose /kv/digest scrape fails
+# keeps its LAST digest (one blip shouldn't zero its overlap), but
+# after this many consecutive failures the digest expires to the
+# empty set — scoring with a digest the replica may no longer hold
+# routes streams at phantom warmth
+_DIGEST_STALE_PROBES = 3
+
 # connection-level failures that mean "this replica, this attempt" —
 # the stream fails over, the replica gets a probe before reuse
 FAILOVER_ERRORS = (
@@ -114,6 +121,7 @@ class Replica:
         self.spec_accepted = 0.0
         self.block_size = 0    # paged block width, from /kv/digest
         self.digest: set = set()  # rolling prefix digest (hash strings)
+        self.digest_failures = 0  # consecutive failed digest scrapes
         self.failures = 0
 
     def overlap(self, prefix_hashes: Optional[dict]) -> int:
@@ -187,6 +195,7 @@ class LeastLoadedRouter:
         flight=None,
         stream_deadline: float = 120.0,
         retry_wait: float = 0.05,
+        prefix_affinity: bool = True,
     ) -> None:
         # router-owned clients do NOT retry at the transport layer:
         # the router's failover IS the retry, and it must see failures
@@ -202,11 +211,25 @@ class LeastLoadedRouter:
         self._flight = flight
         self.stream_deadline = stream_deadline
         self.retry_wait = retry_wait
+        # prefix_affinity=False zeroes the overlap discount in
+        # placement (pure load balancing). The waste attribution below
+        # still sees the true overlaps, so the A/B in serve_bench's
+        # kv_observatory section measures exactly what turning the
+        # discount off costs in re-prefilled tokens.
+        self.prefix_affinity = bool(prefix_affinity)
         self._lock = locks.make_lock("LeastLoadedRouter._lock")
         self._replicas: Dict[str, Replica] = {}
         self.failovers = 0     # lifetime counter, for tests/metrics
         self.migrations = 0    # prefill->decode block-set handoffs
         self.migrate_failures = 0
+        # re-prefill waste attribution (fleet KV observatory): per
+        # placed stream, the best prefix overlap anywhere in the fleet
+        # minus the overlap on the replica actually chosen, in tokens.
+        # This is prefill work SOMEBODY already did that the chosen
+        # replica re-derives — the direct business case for fleet-wide
+        # KV peer fetch (ROADMAP item 3).
+        self.reprefill_waste_tokens = 0
+        self.reprefill_waste_events = 0
         # router-side SLO registry: the hops only the router can time
         # live (route decision, migration round-trip, client-visible
         # TTFT/ITL across failovers) land in histograms here; the
@@ -238,6 +261,11 @@ class LeastLoadedRouter:
             "itl_seconds",
             "Gap between consecutive streamed tokens, across failovers",
             buckets=FAST_BUCKETS,
+        )
+        self._c_waste = self.registry.counter(
+            "reprefill_waste_tokens_total",
+            "Prompt tokens re-prefilled on the chosen replica that "
+            "were already warm on some other replica at route time",
         )
         # exact-sample reservoirs behind the histograms: a bucket-
         # interpolated p95 is only as sharp as its bucket edges (a
@@ -298,6 +326,22 @@ class LeastLoadedRouter:
         with self._lock:
             return {name: r.client for name, r in self._replicas.items()}
 
+    def digests(self) -> Dict[str, dict]:
+        """Per-replica prefix-digest snapshot — the raw material of
+        the observatory's fleet prefix directory: name -> {"role",
+        "block_size", "ready", "digest": frozenset of hash strings},
+        straight from the probe-scraped state (no network)."""
+        with self._lock:
+            return {
+                r.name: {
+                    "role": r.role,
+                    "block_size": r.block_size,
+                    "ready": r.ready,
+                    "digest": frozenset(r.digest),
+                }
+                for r in self._replicas.values()
+            }
+
     def slo_window(self) -> Dict[str, List[float]]:
         """Exact recent client-visible samples — TTFT and inter-token
         gaps, one float per observation, newest last — for the
@@ -357,11 +401,19 @@ class LeastLoadedRouter:
                             dig.get("block_size", 0) or 0
                         )
                         replica.digest = set(dig.get("digest") or [])
+                        replica.digest_failures = 0
                         if not replica.role and dig.get("role"):
                             replica.role = str(dig["role"])
                     except Exception:  # noqa: BLE001 — pre-digest
-                        # servers (older builds) just don't share
-                        pass
+                        # servers (older builds) just don't share.
+                        # The LAST digest stays scoreable through a
+                        # scrape blip, but expires to empty after
+                        # _DIGEST_STALE_PROBES consecutive failures:
+                        # stale overlap must not keep attracting
+                        # shared-prefix streams to cold blocks.
+                        replica.digest_failures += 1
+                        if replica.digest_failures >= _DIGEST_STALE_PROBES:
+                            replica.digest = set()
                 replica.ready = ok
             except Exception:  # noqa: BLE001 — an unreachable replica
                 # is simply not ready; the reconciler replaces it
@@ -428,9 +480,25 @@ class LeastLoadedRouter:
                     if unblocked:
                         candidates = unblocked
                 if candidates:
+                    # overlap feeds the score only under prefix
+                    # affinity; the decision ring records the TRUE
+                    # overlap either way so /debug/routez (and the
+                    # waste attribution) can audit what the pick
+                    # ignored
+                    overlaps = {
+                        r.name: r.overlap(prefix_hashes)
+                        for r in candidates
+                    }
+
+                    def effective(r: Replica) -> int:
+                        return (
+                            overlaps[r.name]
+                            if self.prefix_affinity else 0
+                        )
+
                     best = min(
                         candidates,
-                        key=lambda r: r.score(r.overlap(prefix_hashes)),
+                        key=lambda r: r.score(effective(r)),
                     )
                     self._decisions.append({
                         "corr": corr,
@@ -440,10 +508,12 @@ class LeastLoadedRouter:
                         "trace": trace,
                         "role_requested": role or "",
                         "pool": "role" if pool is not ready else "all",
+                        "prefix_affinity": self.prefix_affinity,
                         "picked": best.name,
                         "candidates": {
-                            r.name: r.score_components(
-                                r.overlap(prefix_hashes)
+                            r.name: dict(
+                                r.score_components(effective(r)),
+                                prefix_overlap=overlaps[r.name],
                             )
                             for r in candidates
                         },
@@ -463,6 +533,57 @@ class LeastLoadedRouter:
     def _release(self, replica: Replica) -> None:
         with self._lock:
             replica.inflight = max(0, replica.inflight - 1)
+
+    def _attribute_waste(
+        self,
+        replica: Replica,
+        prefix_hashes: Optional[dict],
+        corr,
+        trace: Optional[str],
+    ) -> None:
+        """Re-prefill waste accounting for one placed stream: the best
+        prefix overlap anywhere in the ready fleet minus the overlap
+        on the chosen replica, in tokens (blocks x the warm peer's
+        block size). Charged once per stream at the first pick — the
+        route-time decision is what left warm blocks unused. Counter
+        increments and the kind="kvwaste" flight record happen OUTSIDE
+        the router lock (the flight ring and registry have their own
+        locks; no ordering edge wanted)."""
+        if not prefix_hashes:
+            return
+        with self._lock:
+            chosen = replica.overlap(prefix_hashes)
+            peer_name = ""
+            peer_overlap = chosen
+            peer_bs = replica.block_size
+            for r in self._replicas.values():
+                if not r.ready or r.draining or r.name == replica.name:
+                    continue
+                ov = r.overlap(prefix_hashes)
+                if ov > peer_overlap or (
+                    ov == peer_overlap and peer_name
+                    and r.name < peer_name
+                ):
+                    peer_name = r.name
+                    peer_overlap = ov
+                    peer_bs = r.block_size
+        waste_blocks = peer_overlap - chosen
+        if waste_blocks <= 0 or not peer_name:
+            return
+        waste_tokens = waste_blocks * peer_bs
+        with self._lock:
+            self.reprefill_waste_tokens += waste_tokens
+            self.reprefill_waste_events += 1
+        self._c_waste.inc(float(waste_tokens))
+        flight = (
+            self._flight if self._flight is not None
+            else default_flight()
+        )
+        flight.record(
+            "kvwaste", corr=corr, op="kvwaste", trace=trace,
+            replica=replica.name, peer=peer_name,
+            blocks=waste_blocks, tokens=waste_tokens,
+        )
 
     # -- disaggregated prefill/decode --------------------------------------
 
@@ -658,6 +779,14 @@ class LeastLoadedRouter:
                     replica=replica.name, role=replica.role,
                 )
             if not emitted and not migrate_tried:
+                # re-prefill waste is attributed at the FIRST pick,
+                # before the migration below can optimistically update
+                # the target's digest — the route-time gap between the
+                # warmest peer and the chosen replica is the number
+                # being measured
+                self._attribute_waste(
+                    replica, prefix_hashes, corr, trace.trace_id,
+                )
                 # one migration attempt per request, before the first
                 # byte: prefill happens on the prefill pool, the block
                 # set ships to THIS decode target, and the stream below
@@ -849,6 +978,9 @@ class LeastLoadedRouter:
                 "failovers": self.failovers,
                 "migrations": self.migrations,
                 "migrate_failures": self.migrate_failures,
+                "prefix_affinity": self.prefix_affinity,
+                "reprefill_waste_tokens": self.reprefill_waste_tokens,
+                "reprefill_waste_events": self.reprefill_waste_events,
                 "tenant_blocks": {
                     f"{name}/{tenant}": round(until - now_m, 3)
                     for (name, tenant), until
@@ -872,6 +1004,7 @@ class LeastLoadedRouter:
                         "spec_accepted": r.spec_accepted,
                         "block_size": r.block_size,
                         "digest_size": len(r.digest),
+                        "digest_failures": r.digest_failures,
                         "failures": r.failures,
                         "score_components": r.score_components(),
                     }

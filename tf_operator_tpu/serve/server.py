@@ -66,6 +66,7 @@ import os
 import sys
 import threading
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from urllib.parse import parse_qs
 
 from ..telemetry.flight import correlate, default_flight, render_flightz
 from ..telemetry.profiler import default_profiler, render_profilez
@@ -661,14 +662,35 @@ def DecodeHandlerFactory(state: _State):
                 # status string tells pollers the truth — "ok" only
                 # while actually admitting requests
                 phase = state.phase
-                self._reply(200, {
-                    "status": "ok" if phase == "ready" else phase,
+                # a failed BlockPool.check() audit flips the payload:
+                # the process is still alive (200) but "degraded"
+                # tells the router and the fleet smokes the pool's
+                # accounting can no longer be trusted
+                engine = state.engine
+                audit_ok = bool(
+                    engine is None
+                    or getattr(engine, "pool_audit_ok", True)
+                )
+                status = "ok" if phase == "ready" else phase
+                if not audit_ok:
+                    status = "degraded"
+                payload = {
+                    "status": status,
                     "model": state.model_name,
                     "role": state.role,
                     "kv_int8": state.kv_quant_int8,
                     "weights_int8": state.weights_int8,
                     "decodes": int(state.decodes),
-                })
+                    "pool_audit": "ok" if audit_ok else "failed",
+                }
+                if not audit_ok:
+                    payload["pool_audit_error"] = str(
+                        getattr(engine, "pool_audit_error", "")
+                    )[:200]
+                    payload["pool_audit_failures"] = int(
+                        getattr(engine, "pool_audit_failures", 0)
+                    )
+                self._reply(200, payload)
             elif self.path == "/readyz":
                 # readiness: 503 during warmup compile and drain so the
                 # router (serve/router.py) excludes this replica
@@ -692,6 +714,27 @@ def DecodeHandlerFactory(state: _State):
                     "block_size": int(engine.pool.block_size),
                     "digest": engine.prefix_digest(),
                 })
+            elif self.path.partition("?")[0] == "/kv/statz":
+                # per-replica KV residency: the occupancy-by-age
+                # histogram, hot-prefix top-N, cached-idle vs pinned
+                # split, and fragmentation accounting the fleet KV
+                # observatory (and `telemetry kvz`) renders. ?top=N
+                # widens the hot-prefix table.
+                engine = state.engine
+                if engine is None or getattr(engine, "pool", None) is None:
+                    return self._reply(200, {
+                        "role": state.role, "paged": False,
+                    })
+                query = parse_qs(self.path.partition("?")[2])
+                try:
+                    top_n = int((query.get("top") or ["10"])[0])
+                except ValueError:
+                    return self._reply(
+                        400, {"error": "?top= must be an integer"}
+                    )
+                page = engine.kv_statz(top_n=top_n)
+                page["role"] = state.role
+                self._reply(200, page)
             elif self.path == "/metrics":
                 body = state.render_metrics().encode()
                 self.send_response(200)

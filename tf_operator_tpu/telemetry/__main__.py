@@ -39,6 +39,17 @@ exports the merged cross-process Perfetto timeline:
     python -m tf_operator_tpu.telemetry tracez --trace <id> \
         --observatory http://127.0.0.1:9090
 
+The `kvz` subcommand is the fleet KV observatory's viewer: it builds
+the fleet prefix directory (digest -> replicas) from /kv/digest plus
+each replica's /kv/statz residency split, or reads a running
+observatory's /debug/slozz kv block (which adds the router's
+re-prefill waste attribution):
+
+    python -m tf_operator_tpu.telemetry kvz \
+        http://127.0.0.1:8443 http://127.0.0.1:8444
+    python -m tf_operator_tpu.telemetry kvz \
+        --observatory http://127.0.0.1:9090
+
 The `historyz` and `alertz` subcommands fan the matching /debug/
 pages out fleet-wide (collector.collect_history / collect_alerts) or
 ask a running observatory for its fleet-level ring; `alertz` exits 3
@@ -540,6 +551,146 @@ def alertz_main(argv) -> int:
     return 1 if page["partial"] else 0
 
 
+def kvz_main(argv) -> int:
+    """The fleet KV observatory as a CLI (`kvz` subcommand): build
+    the fleet prefix directory from replica /kv/digest pages plus the
+    per-replica /kv/statz residency split, or read a running
+    observatory's /debug/slozz kv block (which adds the router's
+    re-prefill waste attribution), and render it as tables."""
+    parser = argparse.ArgumentParser(
+        prog="python -m tf_operator_tpu.telemetry kvz",
+        description="Fleet KV observatory: prefix directory, "
+        "duplication, cached-idle split, and re-prefill waste "
+        "(serve/observatory.py).",
+    )
+    parser.add_argument(
+        "replicas", nargs="*", metavar="URL",
+        help="replica base URLs to fan out to directly",
+    )
+    parser.add_argument(
+        "--observatory", metavar="URL",
+        help="read the kv block from a router observatory's "
+        "/debug/slozz instead of fanning out from here",
+    )
+    parser.add_argument(
+        "--top", type=int, default=10,
+        help="rows in the hot-prefix / duplication tables",
+    )
+    parser.add_argument(
+        "--json", action="store_true", help="dump the raw JSON page",
+    )
+    args = parser.parse_args(argv)
+    if bool(args.observatory) == bool(args.replicas):
+        print(
+            "error: give replica URLs or --observatory, not both/neither",
+            file=sys.stderr,
+        )
+        return 2
+
+    if args.observatory:
+        import urllib.request
+
+        url = args.observatory.rstrip("/") + "/debug/slozz"
+        try:
+            with urllib.request.urlopen(url, timeout=60) as resp:
+                slozz = json.loads(resp.read())
+        except OSError as e:
+            print(f"error: {url}: {e}", file=sys.stderr)
+            return 1
+        kv = slozz.get("kv") or {}
+        if args.json:
+            print(json.dumps(kv, indent=1))
+            return 0
+        print(
+            f"# fleet kv: duplication_factor="
+            f"{kv.get('duplication_factor')} "
+            f"unique_blocks={kv.get('unique_blocks')} "
+            f"held_blocks={kv.get('held_blocks')} "
+            f"cached_idle={kv.get('cached_idle_blocks')}"
+        )
+        print(
+            f"# reprefill waste: "
+            f"{kv.get('reprefill_waste_tokens_total', 0.0):g} tokens "
+            f"over {kv.get('reprefill_waste_events', 0)} streams "
+            f"(prefix_affinity="
+            f"{'on' if kv.get('prefix_affinity', True) else 'off'})"
+        )
+        for row in kv.get("top_duplicated", [])[:args.top]:
+            print(
+                f"  {row['digest']}  x{len(row['replicas'])}  "
+                f"{','.join(row['replicas'])}"
+            )
+        return 0
+
+    from ..serve.client import DecodeClient
+
+    directory: dict = {}
+    statz: dict = {}
+    errors: dict = {}
+    for url in args.replicas:
+        client = DecodeClient(url)
+        try:
+            dig = client.kv_digest()
+            statz[url] = client.kv_statz(top=args.top)
+            for digest in dig.get("digest") or []:
+                directory.setdefault(digest, []).append(url)
+        except Exception as err:  # noqa: BLE001 — a fleet page must
+            # survive any one replica's failure mode
+            errors[url] = str(err)
+    unique = len(directory)
+    held = sum(len(holders) for holders in directory.values())
+    page = {
+        "directory": directory,
+        "unique_blocks": unique,
+        "held_blocks": held,
+        "duplication_factor": round(held / unique, 6) if unique else 0.0,
+        "statz": statz,
+        "scrape_errors": errors,
+        "partial": bool(errors),
+    }
+    if args.json:
+        print(json.dumps(page, indent=1))
+    else:
+        print(
+            f"# fleet kv: duplication_factor="
+            f"{page['duplication_factor']} unique_blocks={unique} "
+            f"held_blocks={held} over {len(statz)} replica(s)"
+        )
+        dup_rows = sorted(
+            (
+                (digest, holders)
+                for digest, holders in directory.items()
+                if len(holders) > 1
+            ),
+            key=lambda kv_row: (-len(kv_row[1]), kv_row[0]),
+        )
+        for digest, holders in dup_rows[:args.top]:
+            print(f"  {digest}  x{len(holders)}  {','.join(holders)}")
+        for url, doc in sorted(statz.items()):
+            if not doc.get("paged"):
+                print(f"# {url}: not paged")
+                continue
+            split = doc.get("split") or {}
+            frag = doc.get("fragmentation") or {}
+            print(
+                f"# {url}: free={split.get('free')} "
+                f"cached_idle={split.get('cached_idle')} "
+                f"cached_shared={split.get('cached_shared')} "
+                f"private={split.get('private')} "
+                f"frag_ratio={frag.get('ratio')}"
+            )
+            for row in doc.get("hot_prefixes", [])[:args.top]:
+                print(
+                    f"    {row['digest']}  hits={row['hits']} "
+                    f"attaches={row['attaches']} "
+                    f"age={row['age_ticks']}t "
+                    f"{'idle' if row['idle'] else 'shared'}"
+                )
+        for url, err in sorted(errors.items()):
+            print(f"# {url}: SCRAPE FAILED: {err}", file=sys.stderr)
+    return 1 if page["partial"] else 0
+
+
 def main(argv=None) -> int:
     argv = list(sys.argv[1:] if argv is None else argv)
     if argv and argv[0] == "profile":
@@ -552,6 +703,8 @@ def main(argv=None) -> int:
         return historyz_main(argv[1:])
     if argv and argv[0] == "alertz":
         return alertz_main(argv[1:])
+    if argv and argv[0] == "kvz":
+        return kvz_main(argv[1:])
     parser = argparse.ArgumentParser(
         prog="python -m tf_operator_tpu.telemetry",
         description="Merge and inspect flight-recorder JSONL dumps.",

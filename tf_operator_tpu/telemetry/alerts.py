@@ -557,6 +557,15 @@ def fleet_rules(
             description="replica scrapes failing (fleet sample "
             "partial)",
         ),
+        ThresholdRule(
+            "fleet-kv-cached-idle-pressure",
+            "fleet_kv_cached_idle_blocks",
+            denominator="fleet_kv_blocks_total", mode="ratio",
+            fire_above=0.5, resolve_below=0.35, for_s=10.0,
+            description="over half the fleet's KV blocks sit as idle "
+            "cached prefixes (duplication pressure: reclaim churn "
+            "ahead; fleet peer fetch would convert these to hits)",
+        ),
     ]
 
 

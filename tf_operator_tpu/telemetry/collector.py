@@ -71,6 +71,10 @@ _BOUNDARY_OPS = frozenset({
 _ANCILLARY_OPS = frozenset({
     "submit", "kv-plan", "prefill-chunk", "step", "migrate",
     "migrate-failed", "failover", "route-done", "serve-sync",
+    # re-prefill waste attribution (router _attribute_waste): one
+    # trace-stamped record per placed stream whose prefix was warmer
+    # on some peer than on the chosen replica
+    "kvwaste",
 })
 KNOWN_OPS = _BOUNDARY_OPS | _ANCILLARY_OPS
 
@@ -148,6 +152,11 @@ class ClockCache:
         self._clock = clock
         # name -> (ClockMap, acquired_at)
         self._entries: Dict[str, Tuple[ClockMap, float]] = {}
+        # name -> last observed per-process epoch counter (see
+        # observe_epoch): a monotone counter going BACKWARDS means the
+        # process restarted, and its monotonic clock (and therefore
+        # the cached offset) restarted with it
+        self._epochs: Dict[str, float] = {}
         self.hits = 0
         self.misses = 0
         self.invalidations = 0
@@ -177,6 +186,21 @@ class ClockCache:
             self.degrade_factor * entry[0].rtt, self.degrade_floor_s
         )
         if rtt_s > bound:
+            del self._entries[name]
+            self.invalidations += 1
+
+    def observe_epoch(self, name: str, value: float) -> None:
+        """Report a per-process monotone counter scraped from `name`
+        (the observatory passes engine_compiles_total). The counter
+        only ever grows within one process lifetime, so a DROP means
+        the replica restarted: its monotonic clock reset, the cached
+        offset is garbage, and the entry is invalidated so the next
+        get() re-handshakes against the new process."""
+        prev = self._epochs.get(name)
+        self._epochs[name] = float(value)
+        if prev is None or float(value) >= prev:
+            return
+        if name in self._entries:
             del self._entries[name]
             self.invalidations += 1
 
