@@ -24,7 +24,7 @@ IMAGE_DIR := build/images
 DIST      := build/dist
 
 .PHONY: ci presubmit lint analyze native native-test native-race test wire-test e2e e2e-kind bench \
-        chaos-soak serve-soak serve-paged serve-sharded serve-spec serve-disagg trace-smoke alert-smoke autoscale-smoke kv-observatory bench-regression ha-soak controller-profile images release mnist-acc clean
+        chaos-soak serve-soak serve-paged serve-sharded serve-spec serve-disagg trace-smoke alert-smoke autoscale-smoke kv-observatory train-observe bench-train bench-regression ha-soak controller-profile images release mnist-acc clean
 
 # `test` already runs the whole tests/ tree (native bindings, wire,
 # E2E suites included) — native-test/wire-test exist for targeted runs,
@@ -164,6 +164,21 @@ autoscale-smoke:
 # must stay clean (CI's kv-observatory)
 kv-observatory:
 	env JAX_PLATFORMS=cpu $(PY) -m tf_operator_tpu.serve.fleet --kv-observatory
+
+# training-plane observatory proof (docs/monitoring.md "Training
+# observability"): 2-worker CPU-mesh MNIST job, per-worker telemetry
+# servers + fleet view; injected latency fault fires the straggler
+# alert, clears, alert resolves; phase coverage >= 95%, goodput
+# ledger reconciles step-for-step (CI's train-observe-smoke)
+train-observe:
+	env JAX_PLATFORMS=cpu $(PY) -m tf_operator_tpu.train.observe --smoke
+
+# training observability bench: writes TRAIN_BENCH.json (measured
+# phase coverage + attribution overhead, scripted goodput fraction)
+# and replays it through the regression sentinel
+bench-train:
+	env JAX_PLATFORMS=cpu $(PY) benchmarks/train_bench.py
+	$(PY) -m benchmarks.regression --dry-run
 
 # perf-regression sentinel (docs/monitoring.md "Regression sentinel"):
 # replay the committed benchmark artifacts against noise-banded

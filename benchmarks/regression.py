@@ -241,12 +241,38 @@ BASELINES = [
         "direction": "max",
         "band": 3.0,  # observer overhead must stay ~free
     },
+    # -- training observatory (TRAIN_BENCH.json) -------------------------
+    {
+        "check": "train-phase-coverage",
+        "artifact": "train_bench",
+        "path": "train_observe.phase_coverage",
+        "baseline": 0.95,
+        "direction": "min",
+        "band": 1.0,  # the >=95% attribution contract, verbatim
+    },
+    {
+        "check": "train-attribution-overhead",
+        "artifact": "train_bench",
+        "path": "train_observe.attribution_overhead",
+        "baseline": 0.02,
+        "direction": "max",
+        "band": 1.0,  # phase timer must cost <2% of step wall
+    },
+    {
+        "check": "train-goodput-fraction",
+        "artifact": "train_bench",
+        "path": "train_observe.goodput_fraction",
+        "baseline": 0.745098,
+        "direction": "min",
+        "band": 1.0,  # FakeClock-scripted ledger: exact, no noise band
+    },
 ]
 
 ARTIFACTS = {
     "serve_bench": "SERVE_BENCH.json",
     "controller_scale": "CONTROLLER_SCALE.json",
     "controller_profile": "CONTROLLER_PROFILE.json",
+    "train_bench": "TRAIN_BENCH.json",
 }
 
 
@@ -359,6 +385,10 @@ def main(argv: Optional[List[str]] = None) -> int:
         default=os.path.join(REPO_ROOT, ARTIFACTS["controller_profile"]),
     )
     parser.add_argument(
+        "--train-bench",
+        default=os.path.join(REPO_ROOT, ARTIFACTS["train_bench"]),
+    )
+    parser.add_argument(
         "--trend", default=os.path.join(REPO_ROOT, "BENCH_TREND.json")
     )
     parser.add_argument(
@@ -372,6 +402,7 @@ def main(argv: Optional[List[str]] = None) -> int:
             "serve_bench": args.serve_bench,
             "controller_scale": args.controller_scale,
             "controller_profile": args.controller_profile,
+            "train_bench": args.train_bench,
         }
     )
     rows = run_checks(artifacts)

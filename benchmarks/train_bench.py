@@ -1,0 +1,128 @@
+"""Training-plane observability bench — TRAIN_BENCH.json.
+
+Locks the training observatory's guarantees (train/observe.py) into
+numbers the regression sentinel (benchmarks/regression.py) bands:
+
+- `phase_coverage` / `attribution_overhead` — a real CPU-mesh MNIST
+  run through the instrumented Trainer.fit: the fraction of step wall
+  attributed to a named phase (contract: >= 0.95) and the timer's own
+  bookkeeping cost as a fraction of step wall (contract: < 2%).
+- `goodput_fraction` — a FakeClock-scripted GoodputLedger exercise
+  with a pinned warmup/useful/checkpoint/restore/preempted split, so
+  the committed baseline is exact and compile-time noise can't move
+  it; the scripted run also re-proves the integer reconciliation
+  identity (accounted steps == executed steps).
+
+    JAX_PLATFORMS=cpu python benchmarks/train_bench.py
+
+Run via `make bench-train`, which feeds the sentinel afterwards.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO_ROOT)
+
+
+def measured_attribution(steps: int = 40) -> dict:
+    """Real instrumented run: small MNIST CNN on the CPU mesh."""
+    import jax
+    import optax
+
+    from tf_operator_tpu.models import mnist as mnist_lib
+    from tf_operator_tpu.parallel.sharding import REPLICATED_RULES
+    from tf_operator_tpu.telemetry import MetricRegistry
+    from tf_operator_tpu.train.trainer import Trainer, classification_task
+
+    registry = MetricRegistry("tf_operator_tpu")
+    model = mnist_lib.MnistCNN()
+    trainer = Trainer(
+        model, classification_task(model), optax.adam(1e-3),
+        rules=REPLICATED_RULES, metrics_registry=registry,
+    )
+    rng = jax.random.PRNGKey(0)
+    state = trainer.init(rng, mnist_lib.synthetic_batch(rng, 32))
+
+    def batches():
+        key = jax.random.PRNGKey(1)
+        while True:
+            key, sub = jax.random.split(key)
+            yield mnist_lib.synthetic_batch(sub, 32)
+
+    state, _ = trainer.fit(state, batches(), steps=steps, log_every=10)
+    timer = trainer.phase_timer
+    assert timer.steps == steps and trainer.goodput.reconciles(steps)
+    return {
+        "steps": timer.steps,
+        "wall_seconds": round(timer.wall_seconds, 4),
+        "phase_coverage": round(timer.coverage(), 6),
+        "attribution_overhead": round(timer.overhead_fraction(), 6),
+        "phase_seconds": {
+            p: round(s, 4) for p, s in timer.phase_seconds.items()
+        },
+    }
+
+
+def scripted_goodput() -> dict:
+    """Deterministic ledger arithmetic on a FakeClock timeline: one
+    2s warmup step, 38 useful steps at 0.25s, a 0.5s checkpoint, a
+    0.25s restore, and a 2-step 0.5s preemption-lost tail."""
+    from tf_operator_tpu.telemetry import MetricRegistry
+    from tf_operator_tpu.train.observe import GoodputLedger
+
+    ledger = GoodputLedger(MetricRegistry("tf_operator_tpu"))
+    ledger.waste("warmup", 2.0, steps=1)
+    for _ in range(38):
+        ledger.useful(0.25, steps=1)
+    ledger.waste("checkpoint", 0.5)
+    ledger.waste("restore", 0.25)
+    ledger.waste("preempted", 0.5, steps=2)
+    executed = 39  # warmup + useful; lost steps are re-work, not new
+    assert ledger.reconciles(executed), ledger.snapshot()
+    snap = ledger.snapshot()
+    return {
+        "executed_steps": executed,
+        "reconciles": ledger.reconciles(executed),
+        "goodput_fraction": snap["goodput_fraction"],
+        "snapshot": snap,
+    }
+
+
+def main(argv=None) -> int:
+    parser = argparse.ArgumentParser(description=__doc__)
+    parser.add_argument(
+        "--out", default=os.path.join(REPO_ROOT, "TRAIN_BENCH.json")
+    )
+    parser.add_argument("--steps", type=int, default=40)
+    args = parser.parse_args(argv)
+
+    attribution = measured_attribution(steps=args.steps)
+    goodput = scripted_goodput()
+    doc = {
+        "metric": "train_observe",
+        "train_observe": {
+            "phase_coverage": attribution["phase_coverage"],
+            "attribution_overhead": attribution["attribution_overhead"],
+            "goodput_fraction": goodput["goodput_fraction"],
+            "measured": attribution,
+            "scripted": goodput,
+        },
+        "note": "phase_coverage/attribution_overhead measured on a "
+        "real CPU-mesh MNIST run; goodput_fraction is FakeClock-"
+        "scripted ledger arithmetic (deterministic baseline)",
+    }
+    with open(args.out, "w") as handle:
+        json.dump(doc, handle, indent=1, sort_keys=True)
+        handle.write("\n")
+    print(json.dumps(doc["train_observe"], indent=1)[:400])
+    print(f"wrote {args.out}")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -87,6 +87,9 @@ DONATING_CALLABLES = {
 WALL_CLOCK_PATHS = (
     "tf_operator_tpu/runtime/",
     "tf_operator_tpu/controller/clock.py",
+    # trainer timing feeds the goodput ledger and phase histograms;
+    # route through Clock.monotonic() (train/observe.py)
+    "tf_operator_tpu/train/",
 )
 
 

@@ -1,0 +1,409 @@
+"""Training-plane observatory (train/observe.py): phase attribution
+must cover the step wall it laps, the goodput ledger must reconcile
+step-for-step, the fleet view must fire/resolve train_rules off
+scripted worker skew, the per-worker telemetry server must serve every
+debug page, summaries scalars must work as an ad-hoc MetricHistory
+provider with windowed delta/rate queries, and the TFJob status fold
+must survive a serde round trip."""
+
+import json
+import urllib.request
+
+import pytest
+
+from tf_operator_tpu.controller.clock import FakeClock
+from tf_operator_tpu.telemetry import (
+    AlertManager,
+    MetricHistory,
+    MetricRegistry,
+    default_flight,
+    train_rules,
+)
+from tf_operator_tpu.train.observe import (
+    PHASES,
+    SLOWDOWN_SERIES,
+    STALL_SERIES,
+    GoodputLedger,
+    HealthPhase,
+    StepPhaseTimer,
+    TrainFleetView,
+    TrainTelemetry,
+    WorkerClient,
+    fold_train_observability,
+)
+from tf_operator_tpu.train.summaries import SummaryWriter
+
+
+class TestStepPhaseTimer:
+    def test_scripted_laps_attribute_exactly(self):
+        clock = FakeClock()
+        timer = StepPhaseTimer(
+            MetricRegistry("tf_operator_tpu"), clock=clock, flight_every=100
+        )
+        script = {
+            "data_wait": 0.10,
+            "host_to_device": 0.02,
+            "step_dispatch": 0.50,
+            "device_sync": 0.05,
+            "checkpoint": 0.20,
+            "eval_publish": 0.03,
+        }
+        timer.start()
+        for phase, seconds in script.items():
+            clock.advance(seconds)
+            assert timer.lap(phase) == pytest.approx(seconds)
+        split = timer.finish(step=1)
+        assert split["wall"] == pytest.approx(sum(script.values()))
+        for phase, seconds in script.items():
+            assert split[phase] == pytest.approx(seconds)
+        # every lapped second is attributed: coverage is exactly 1
+        assert timer.coverage() == pytest.approx(1.0)
+        assert timer.steps == 1
+        # FakeClock makes the bookkeeping itself take zero time
+        assert timer.overhead_fraction() == 0.0
+
+    def test_unlapped_time_is_visible_as_coverage_loss(self):
+        clock = FakeClock()
+        timer = StepPhaseTimer(
+            MetricRegistry("tf_operator_tpu"), clock=clock, flight_every=100
+        )
+        timer.start()
+        clock.advance(0.9)
+        timer.lap("step_dispatch")
+        clock.advance(0.1)  # never lapped — must NOT silently vanish
+        timer.finish(step=1)
+        assert timer.coverage() == pytest.approx(0.9)
+
+    def test_repeated_lap_accumulates_into_one_phase(self):
+        clock = FakeClock()
+        timer = StepPhaseTimer(
+            MetricRegistry("tf_operator_tpu"), clock=clock, flight_every=100
+        )
+        timer.start()
+        clock.advance(0.2)
+        timer.lap("device_sync")
+        clock.advance(0.3)
+        timer.lap("device_sync")
+        split = timer.finish(step=1)
+        assert split["device_sync"] == pytest.approx(0.5)
+        assert timer.phase_seconds["device_sync"] == pytest.approx(0.5)
+
+    def test_flight_record_every_n_steps(self):
+        from tf_operator_tpu.telemetry.flight import (
+            FlightRecorder,
+            set_default_flight,
+        )
+
+        previous = default_flight()
+        flight = set_default_flight(FlightRecorder())
+        try:
+            clock = FakeClock()
+            timer = StepPhaseTimer(
+                MetricRegistry("tf_operator_tpu"), clock=clock,
+                flight_every=3,
+            )
+            for i in range(1, 7):
+                timer.start()
+                clock.advance(0.25)
+                timer.lap("step_dispatch")
+                timer.finish(step=9000 + i)
+            records = [
+                r.to_dict() for r in flight.snapshot(kind="trainstep")
+            ]
+        finally:
+            set_default_flight(previous)
+        # one record per flight_every=3 finishes: steps 3 and 6
+        assert [r["fields"]["step"] for r in records] == [9003, 9006]
+        assert records[-1]["fields"]["coverage"] == 1.0
+        assert records[-1]["fields"]["step_dispatch"] == pytest.approx(0.25)
+
+    def test_summary_shape(self):
+        timer = StepPhaseTimer(MetricRegistry("tf_operator_tpu"))
+        summary = timer.summary()
+        assert summary["steps"] == 0
+        assert summary["coverage"] == 1.0
+        assert set(summary["phase_seconds"]) == set(PHASES)
+
+
+class TestGoodputLedger:
+    def scripted(self):
+        """The bench's pinned timeline: warmup 2.0s, 38 x 0.25s useful,
+        0.5s checkpoint, 0.25s restore, 2 lost steps / 0.5s."""
+        ledger = GoodputLedger(MetricRegistry("tf_operator_tpu"))
+        ledger.waste("warmup", 2.0, steps=1)
+        for _ in range(38):
+            ledger.useful(0.25, steps=1)
+        ledger.waste("checkpoint", 0.5)
+        ledger.waste("restore", 0.25)
+        ledger.waste("preempted", 0.5, steps=2)
+        return ledger
+
+    def test_fraction_exact(self):
+        ledger = self.scripted()
+        assert ledger.fraction() == pytest.approx(9.5 / 12.75)
+        assert ledger.snapshot()["goodput_fraction"] == 0.745098
+
+    def test_reconciles_exactly(self):
+        ledger = self.scripted()
+        # 1 warmup + 38 useful; preemption-lost steps are re-work,
+        # not new optimizer steps — they must NOT enter the identity
+        assert ledger.accounted_steps() == 39
+        assert ledger.reconciles(39)
+        assert not ledger.reconciles(38)
+        assert not ledger.reconciles(41)
+
+    def test_idle_ledger_is_perfect(self):
+        ledger = GoodputLedger(MetricRegistry("tf_operator_tpu"))
+        assert ledger.fraction() == 1.0
+        assert ledger.reconciles(0)
+
+    def test_unknown_reason_rejected(self):
+        ledger = GoodputLedger(MetricRegistry("tf_operator_tpu"))
+        with pytest.raises(ValueError):
+            ledger.waste("coffee", 1.0)
+
+    def test_counters_are_monotone_in_render(self):
+        registry = MetricRegistry("tf_operator_tpu")
+        ledger = GoodputLedger(registry)
+        ledger.useful(1.0, steps=1)
+        ledger.waste("preempted", 0.5, steps=2)
+        text = registry.render()
+        assert "tf_operator_tpu_train_goodput_useful_seconds_total 1" in text
+        assert 'reason="preempted"' in text
+
+
+class _FakeWorker:
+    """Scriptable stand-in for WorkerClient: the fleet view only calls
+    metrics() and healthz()."""
+
+    def __init__(self):
+        self.steps = 0.0
+        self.dead = False
+
+    def metrics(self):
+        if self.dead:
+            raise ConnectionError("scrape refused")
+        return {"tf_operator_tpu_train_steps_total": self.steps}
+
+    def healthz(self):
+        return {"phase": "training"}
+
+
+class TestTrainFleetView:
+    def make_fleet(self):
+        clock = FakeClock()
+        workers = {"worker-0": _FakeWorker(), "worker-1": _FakeWorker()}
+        history = MetricHistory(capacity=256, clock=clock)
+        manager = AlertManager(
+            history,
+            train_rules(sorted(workers), straggler_ratio=0.7, stall_k=8.0),
+            registry=MetricRegistry("tf_operator_tpu"),
+            clock=clock,
+        )
+        view = TrainFleetView(
+            workers, history=history, alerts=manager,
+            registry=MetricRegistry("tf_operator_tpu"),
+            clock=clock, rate_window_s=4.0,
+        )
+        return clock, workers, manager, view
+
+    def drive(self, clock, workers, view, seconds, rates):
+        report = None
+        for _ in range(int(seconds)):
+            for name, rate in rates.items():
+                workers[name].steps += rate
+            clock.advance(1.0)
+            report = view.observe()
+        return report
+
+    def test_straggler_fires_then_resolves(self):
+        clock, workers, manager, view = self.make_fleet()
+        report = self.drive(
+            clock, workers, view, 6, {"worker-0": 4, "worker-1": 4}
+        )
+        assert report["stragglers"] == []
+        assert manager.firing() == []
+        # worker-1 drops to a quarter of the fleet median
+        report = self.drive(
+            clock, workers, view, 6, {"worker-0": 4, "worker-1": 1}
+        )
+        assert report["stragglers"] == ["worker-1"]
+        assert "train-straggler[worker-1]" in manager.firing()
+        slowdown = view.history.latest(
+            f'{SLOWDOWN_SERIES}{{worker="worker-1"}}'
+        )
+        assert slowdown is not None and slowdown > 1.0 / 0.7
+        # recovery: the skew washes out of the rate window
+        report = self.drive(
+            clock, workers, view, 8, {"worker-0": 4, "worker-1": 4}
+        )
+        assert report["stragglers"] == []
+        assert manager.firing() == []
+
+    def test_stall_fires_when_counter_stops(self):
+        clock, workers, manager, view = self.make_fleet()
+        self.drive(clock, workers, view, 6, {"worker-0": 4, "worker-1": 4})
+        # worker-1's counter freezes: a synchronous-collective stall
+        report = self.drive(
+            clock, workers, view, 6, {"worker-0": 4, "worker-1": 0}
+        )
+        assert "worker-1" in report["stalled"]
+        assert "train-stall[worker-1]" in manager.firing()
+        ratio = view.history.latest(f'{STALL_SERIES}{{worker="worker-1"}}')
+        assert ratio is not None and ratio > 8.0
+
+    def test_dead_scrape_marks_partial_and_holds_alerts(self):
+        clock, workers, manager, view = self.make_fleet()
+        self.drive(clock, workers, view, 6, {"worker-0": 4, "worker-1": 1})
+        assert "train-straggler[worker-1]" in manager.firing()
+        workers["worker-1"].dead = True
+        report = self.drive(clock, workers, view, 3, {"worker-0": 4})
+        assert report["partial"] is True
+        assert "worker-1" in report["scrape_errors"]
+        # a dead scrape must not fake a recovery
+        assert "train-straggler[worker-1]" in manager.firing()
+
+    def test_report_shape(self):
+        clock, workers, _, view = self.make_fleet()
+        report = self.drive(
+            clock, workers, view, 4, {"worker-0": 3, "worker-1": 3}
+        )
+        assert view.last_report is report
+        w = report["workers"]["worker-0"]
+        assert w["phase"] == "training"
+        assert w["steps_per_sec"] == pytest.approx(3.0, rel=0.1)
+        assert report["last_step"] == int(workers["worker-0"].steps)
+
+
+class _FakeTrainer:
+    """The duck-typed surface TrainTelemetry reads off a Trainer."""
+
+    def __init__(self, registry):
+        self.metrics_registry = registry
+        self.health = HealthPhase()
+        self.phase_timer = StepPhaseTimer(registry, clock=FakeClock())
+        self.goodput = GoodputLedger(registry)
+
+
+class TestTrainTelemetryEndpoints:
+    def serve(self):
+        registry = MetricRegistry("tf_operator_tpu")
+        trainer = _FakeTrainer(registry)
+        trainer.health.set("training")
+        trainer.goodput.useful(1.0, steps=1)
+        telemetry = TrainTelemetry(
+            trainer=trainer, worker="worker-7", history_interval_s=0,
+        )
+        port = telemetry.start("127.0.0.1:0")
+        return telemetry, f"http://127.0.0.1:{port}"
+
+    def get(self, url):
+        with urllib.request.urlopen(url, timeout=5.0) as resp:
+            return resp.status, resp.read()
+
+    def test_all_endpoints_serve(self):
+        telemetry, base = self.serve()
+        try:
+            for path in ("/metrics", "/healthz", "/debug/slozz",
+                         "/debug/flightz", "/debug/historyz",
+                         "/debug/alertz", "/debug/profilez"):
+                status, _ = self.get(base + path)
+                assert status == 200, path
+            status, _ = self.get(base + "/nope")
+        except urllib.error.HTTPError as err:
+            assert err.code == 404
+        finally:
+            telemetry.stop()
+
+    def test_healthz_and_slozz_content(self):
+        telemetry, base = self.serve()
+        try:
+            _, body = self.get(base + "/healthz")
+            health = json.loads(body)
+            assert health["phase"] == "training"
+            assert health["worker"] == "worker-7"
+            _, body = self.get(base + "/debug/slozz")
+            slozz = json.loads(body)["train"]
+            assert slozz["goodput_fraction"] == 1.0
+            assert set(slozz["phases"]["phase_seconds"]) == set(PHASES)
+        finally:
+            telemetry.stop()
+
+    def test_worker_client_round_trip(self):
+        telemetry, base = self.serve()
+        try:
+            client = WorkerClient(base)
+            flat = client.metrics()
+            assert (
+                flat["tf_operator_tpu_train_goodput_useful_seconds_total"]
+                == 1.0
+            )
+            assert client.healthz()["phase"] == "training"
+            assert "goodput" in client.slozz()["train"]
+        finally:
+            telemetry.stop()
+
+
+class TestSummariesAsHistoryProvider:
+    """train/summaries.py scalars replayed as an ad-hoc MetricHistory
+    provider: tail metrics.jsonl into track_provider sources and ask
+    windowed delta/rate questions of the training curve."""
+
+    def test_windowed_delta_and_rate(self, tmp_path):
+        log_dir = tmp_path / "summaries"
+        clock = FakeClock()
+        history = MetricHistory(capacity=64, clock=clock)
+        jsonl = log_dir / "metrics.jsonl"
+
+        def tail(field):
+            def read():
+                last = jsonl.read_text().splitlines()[-1]
+                return float(json.loads(last)[field])
+            return read
+
+        history.track_provider("train_summary_step", "counter", tail("step"))
+        history.track_provider("train_summary_loss", "gauge", tail("loss"))
+
+        with SummaryWriter(str(log_dir)) as writer:
+            for i in range(1, 11):
+                writer.scalars(step=i * 10, values={"loss": 5.0 / i})
+                clock.advance(2.0)
+                history.tick()
+
+        # last 3 samples land in a 5s window: steps 80 -> 100
+        assert history.delta("train_summary_step", 5.0) == pytest.approx(20.0)
+        assert history.rate("train_summary_step", 5.0) == pytest.approx(5.0)
+        # the loss gauge's latest value is the curve's tail
+        assert history.latest("train_summary_loss") == pytest.approx(0.5)
+        # loss fell across the window (delta on gauges: last - first)
+        wide = history.delta("train_summary_step", 100.0)
+        assert wide == pytest.approx(90.0)
+
+    def test_disabled_writer_writes_nothing(self, tmp_path):
+        writer = SummaryWriter(str(tmp_path / "off"), enabled=False)
+        writer.scalars(step=1, values={"loss": 1.0})
+        writer.close()
+        assert not (tmp_path / "off").exists()
+
+
+class TestFoldTrainObservability:
+    def test_fold_and_serde_round_trip(self):
+        from tf_operator_tpu.api.serde import from_jsonable, to_jsonable
+        from tf_operator_tpu.api.types import TFJob
+
+        report = {
+            "last_step": 1234,
+            "median_steps_per_sec": 3.9,
+            "stragglers": ["worker-1"],
+            "stalled": [],
+            "partial": False,
+            "alerts": {"firing": ["train-straggler[worker-1]"]},
+        }
+        job = TFJob()
+        fold_train_observability(job, report)
+        block = job.status.extra["trainObservability"]
+        assert block["lastStep"] == 1234
+        assert block["stragglers"] == ["worker-1"]
+        assert block["alertsFiring"] == ["train-straggler[worker-1]"]
+        rt = from_jsonable(to_jsonable(job), TFJob)
+        assert rt.status.extra["trainObservability"] == block

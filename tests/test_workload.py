@@ -1058,11 +1058,34 @@ class TestPreemptionGuard:
         assert rc == PREEMPTED_EXIT_CODE == 143  # 128 + SIGTERM
         assert trainer.saved == [7]
 
+        # the observability contract rides the same path: one
+        # kind="preempt" flight record (step + whether a checkpoint
+        # made it out) and a bump of train_preemptions_total
+        from tf_operator_tpu.telemetry import default_registry
+        from tf_operator_tpu.telemetry.flight import default_flight
+
+        records = [
+            r.to_dict() for r in default_flight().snapshot(kind="preempt")
+        ]
+        assert records, "maybe_preempt_exit emitted no preempt record"
+        fields = records[-1]["fields"]
+        assert fields["step"] == 7
+        assert fields["saved"] is True
+        assert "seconds_since_last_save" in fields
+        assert (
+            "tf_operator_tpu_train_preemptions_total"
+            in default_registry().render()
+        )
+
         # no checkpoint_dir: still exits 143, but saves nothing
         trainer2 = FakeTrainer()
         rc = maybe_preempt_exit(guard, trainer2, state, "")
         assert rc == PREEMPTED_EXIT_CODE
         assert trainer2.saved == []
+        fields = [
+            r.to_dict() for r in default_flight().snapshot(kind="preempt")
+        ][-1]["fields"]
+        assert fields["saved"] is False
 
 
 class TestGradientAccumulation:
@@ -1257,7 +1280,7 @@ class TestFusedCrossEntropyRobustness:
 
 
 class TestStepProfiler:
-    """train/profiling.py: window clamping, trace capture on the CPU
+    """telemetry/profiler.py StepProfiler: window clamping, trace capture on the CPU
     backend, and the close() safety net for early-ending loops."""
 
     def test_fit_profile_writes_trace(self, tmp_path):
@@ -1280,7 +1303,7 @@ class TestStepProfiler:
         assert plane, f"no xplane under {trace_dir}"
 
     def test_close_stops_early_ended_window(self, tmp_path):
-        from tf_operator_tpu.train.profiling import StepProfiler
+        from tf_operator_tpu.telemetry.profiler import StepProfiler
 
         prof = StepProfiler(str(tmp_path / "t"), total_steps=10, window=(0, 8))
         prof.before_step(0)  # trace active
@@ -1293,7 +1316,7 @@ class TestStepProfiler:
         assert list((tmp_path / "t2").rglob("*.xplane.pb"))
 
     def test_none_dir_noop(self):
-        from tf_operator_tpu.train.profiling import StepProfiler
+        from tf_operator_tpu.telemetry.profiler import StepProfiler
 
         prof = StepProfiler(None, total_steps=5)
         prof.before_step(0)

@@ -428,13 +428,17 @@ class _NameChecker(ast.NodeVisitor):
 
 
 def _check_wall_clock(checker: _NameChecker) -> None:
-    """Flag raw wall-clock reads in interval-timing modules: both the
-    ``time.time()`` attribute form and a bare ``time()`` bound by
-    ``from time import time``. Aliased imports (``import time as t``)
-    are followed; anything cleverer (getattr, indirection) is out of
-    conservative-lint scope."""
+    """Flag raw clock reads in interval-timing modules: the
+    ``time.time()`` / ``time.perf_counter()`` attribute forms and the
+    bare names bound by ``from time import time, perf_counter``.
+    perf_counter is monotonic but bypasses the Clock seam, so timed
+    code can't be driven by FakeClock in tests — the trainer's phase
+    timer and goodput ledger depend on that seam. Aliased imports
+    (``import time as t``) are followed; anything cleverer (getattr,
+    indirection) is out of conservative-lint scope."""
+    flagged = ("time", "perf_counter")
     time_modules = {"time"}  # names bound to the time module
-    time_funcs = set()  # names bound to time.time itself
+    time_funcs = {}  # bare name -> original time.* function name
     for node in ast.walk(checker.tree):
         if isinstance(node, ast.Import):
             for alias in node.names:
@@ -442,28 +446,30 @@ def _check_wall_clock(checker: _NameChecker) -> None:
                     time_modules.add(alias.asname or "time")
         elif isinstance(node, ast.ImportFrom) and node.module == "time":
             for alias in node.names:
-                if alias.name == "time":
-                    time_funcs.add(alias.asname or "time")
+                if alias.name in flagged:
+                    time_funcs[alias.asname or alias.name] = alias.name
     for node in ast.walk(checker.tree):
         if not isinstance(node, ast.Call):
             continue
         func = node.func
-        hit = (
+        if (
             isinstance(func, ast.Attribute)
-            and func.attr == "time"
+            and func.attr in flagged
             and isinstance(func.value, ast.Name)
             and func.value.id in time_modules
-        ) or (
-            isinstance(func, ast.Name) and func.id in time_funcs
+        ):
+            name = func.attr
+        elif isinstance(func, ast.Name) and func.id in time_funcs:
+            name = time_funcs[func.id]
+        else:
+            continue
+        checker._note(
+            node.lineno, "wall-clock-interval",
+            f"raw time.{name}() in an interval-timing module — "
+            "leases/retries/drains must use time.monotonic() (or the "
+            "Clock.monotonic seam, which timed-code tests drive via "
+            "FakeClock) so an NTP step can't bend a duration",
         )
-        if hit:
-            checker._note(
-                node.lineno, "wall-clock-interval",
-                "wall-clock time.time() in an interval-timing module — "
-                "leases/retries/drains must use time.monotonic() (or the "
-                "Clock.monotonic seam) so an NTP step can't bend a "
-                "duration",
-            )
 
 
 def check_module(module: SourceFile, wall_clock: bool = False) -> List[Finding]:

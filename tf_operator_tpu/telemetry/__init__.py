@@ -53,6 +53,7 @@ from .alerts import (
     operator_rules,
     render_alertz,
     serve_replica_rules,
+    train_rules,
 )
 from .flight import (
     FlightRecord,
@@ -129,6 +130,7 @@ __all__ = [
     "serve_replica_rules",
     "operator_rules",
     "fleet_rules",
+    "train_rules",
     "render_alertz",
     "LATENCY_BUCKETS",
     "FAST_BUCKETS",

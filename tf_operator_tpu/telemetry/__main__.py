@@ -691,6 +691,115 @@ def kvz_main(argv) -> int:
     return 1 if page["partial"] else 0
 
 
+def trainz_main(argv) -> int:
+    """The training observatory as a CLI (`trainz` subcommand, kvz's
+    train-plane mirror): fan out to worker /debug/slozz pages for the
+    goodput ledger + phase split, or read a fleet observatory's
+    train_fleet block for the straggler/stall view."""
+    parser = argparse.ArgumentParser(
+        prog="python -m tf_operator_tpu.telemetry trainz",
+        description="Training observatory: per-worker goodput, step-"
+        "phase split, straggler/stall skew (train/observe.py).",
+    )
+    parser.add_argument(
+        "workers", nargs="*", metavar="URL",
+        help="worker telemetry base URLs to fan out to directly",
+    )
+    parser.add_argument(
+        "--observatory", metavar="URL",
+        help="read the train_fleet block from a fleet observatory's "
+        "/debug/slozz instead of fanning out from here",
+    )
+    parser.add_argument(
+        "--json", action="store_true", help="dump the raw JSON page",
+    )
+    args = parser.parse_args(argv)
+    if bool(args.observatory) == bool(args.workers):
+        print(
+            "error: give worker URLs or --observatory, not both/neither",
+            file=sys.stderr,
+        )
+        return 2
+
+    import urllib.request
+
+    if args.observatory:
+        url = args.observatory.rstrip("/") + "/debug/slozz"
+        try:
+            with urllib.request.urlopen(url, timeout=60) as resp:
+                slozz = json.loads(resp.read())
+        except OSError as e:
+            print(f"error: {url}: {e}", file=sys.stderr)
+            return 1
+        fleet = slozz.get("train_fleet") or {}
+        if args.json:
+            print(json.dumps(fleet, indent=1))
+            return 0
+        print(
+            f"# train fleet: last_step={fleet.get('last_step')} "
+            f"median_steps_per_sec={fleet.get('median_steps_per_sec')} "
+            f"stragglers={fleet.get('stragglers')} "
+            f"stalled={fleet.get('stalled')}"
+        )
+        for name, row in sorted((fleet.get("workers") or {}).items()):
+            print(
+                f"  {name:<20} step={row.get('steps')} "
+                f"rate={row.get('steps_per_sec')}/s "
+                f"slowdown={row.get('slowdown')} "
+                f"stall_ratio={row.get('stall_ratio')} "
+                f"phase={row.get('phase')}"
+            )
+        return 0
+
+    pages: dict = {}
+    errors: dict = {}
+    for url in args.workers:
+        try:
+            with urllib.request.urlopen(
+                url.rstrip("/") + "/debug/slozz", timeout=60
+            ) as resp:
+                pages[url] = json.loads(resp.read()).get("train") or {}
+        except Exception as err:  # noqa: BLE001 — a fleet page must
+            # survive any one worker's failure mode
+            errors[url] = str(err)
+    page = {
+        "workers": pages,
+        "scrape_errors": errors,
+        "partial": bool(errors),
+    }
+    if args.json:
+        print(json.dumps(page, indent=1))
+    else:
+        for url, block in sorted(pages.items()):
+            health = block.get("healthz") or {}
+            goodput = block.get("goodput") or {}
+            phases = block.get("phases") or {}
+            print(
+                f"# {url}: phase={health.get('phase')} "
+                f"steps={phases.get('steps')} "
+                f"goodput={goodput.get('goodput_fraction')} "
+                f"coverage={phases.get('coverage')}"
+            )
+            wasted = goodput.get("wasted") or {}
+            if wasted:
+                print(
+                    "    wasted: " + " ".join(
+                        f"{reason}={entry['seconds']:g}s"
+                        for reason, entry in sorted(wasted.items())
+                        if entry.get("seconds")
+                    )
+                )
+            for phase, seconds in sorted(
+                (phases.get("phase_seconds") or {}).items(),
+                key=lambda row: -row[1],
+            ):
+                if seconds:
+                    print(f"    {phase:<16} {seconds:g}s")
+        for url, err in sorted(errors.items()):
+            print(f"# {url}: SCRAPE FAILED: {err}", file=sys.stderr)
+    return 1 if page["partial"] else 0
+
+
 def main(argv=None) -> int:
     argv = list(sys.argv[1:] if argv is None else argv)
     if argv and argv[0] == "profile":
@@ -705,6 +814,8 @@ def main(argv=None) -> int:
         return alertz_main(argv[1:])
     if argv and argv[0] == "kvz":
         return kvz_main(argv[1:])
+    if argv and argv[0] == "trainz":
+        return trainz_main(argv[1:])
     parser = argparse.ArgumentParser(
         prog="python -m tf_operator_tpu.telemetry",
         description="Merge and inspect flight-recorder JSONL dumps.",

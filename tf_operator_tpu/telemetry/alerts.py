@@ -51,6 +51,7 @@ __all__ = [
     "serve_replica_rules",
     "operator_rules",
     "fleet_rules",
+    "train_rules",
 ]
 
 
@@ -570,6 +571,56 @@ def fleet_rules(
 
 
 # -- /debug/alertz -----------------------------------------------------------
+
+def train_rules(
+    workers: Sequence[str],
+    straggler_ratio: float = 0.7,
+    stall_k: float = 8.0,
+    for_s: float = 0.0,
+) -> List:
+    """The training-plane rule pack, over the per-worker skew series
+    the TrainFleetView (train/observe.py) ingests from worker scrapes:
+
+    - ``train-straggler[w]`` — the worker's step rate fell below
+      `straggler_ratio` x the fleet median (the slowdown gauge is
+      median_rate / worker_rate, so the fire line is its reciprocal);
+      resolves with hysteresis well below the fire line so a worker
+      hovering at the threshold doesn't flap.
+    - ``train-stall[w]`` — no step progress for `stall_k` x the fleet
+      median step time (the synchronous-collective death knell: one
+      stalled worker holds every peer's all-reduce hostage).
+
+    One rule pair per worker name: the fleet view writes one labeled
+    gauge sample per worker, and ThresholdRule instances are keyed by
+    rule name, so the per-worker series name is baked in here."""
+    rules: List = []
+    for worker in workers:
+        rules.append(ThresholdRule(
+            f"train-straggler[{worker}]",
+            f'tf_operator_tpu_train_fleet_worker_slowdown'
+            f'{{worker="{worker}"}}',
+            fire_above=1.0 / straggler_ratio,
+            resolve_below=1.15,
+            for_s=for_s,
+            description=(
+                f"{worker} step rate below {straggler_ratio:g}x the "
+                "fleet median"
+            ),
+        ))
+        rules.append(ThresholdRule(
+            f"train-stall[{worker}]",
+            f'tf_operator_tpu_train_fleet_worker_stall_ratio'
+            f'{{worker="{worker}"}}',
+            fire_above=stall_k,
+            resolve_below=max(2.0, stall_k / 4.0),
+            for_s=for_s,
+            description=(
+                f"{worker} made no step progress for {stall_k:g}x the "
+                "median step time"
+            ),
+        ))
+    return rules
+
 
 def render_alertz(manager: AlertManager, query: str = "") -> bytes:
     """The shared /debug/alertz page: one JSON document of rules,

@@ -46,6 +46,7 @@ Stdlib only, like the rest of the telemetry core.
 from __future__ import annotations
 
 import json
+import logging
 import os
 import sys
 import threading
@@ -54,9 +55,12 @@ from typing import Dict, List, NamedTuple, Optional, Tuple
 
 from ..utils import locks
 
+_logger = logging.getLogger("tf_operator_tpu.telemetry.profiler")
+
 __all__ = [
     "ProfileSample",
     "SamplingProfiler",
+    "StepProfiler",
     "default_profiler",
     "set_default_profiler",
     "render_profilez",
@@ -94,6 +98,18 @@ _DEFAULT_ROLES: Tuple[Tuple[str, str], ...] = (
     ("monitoring", "monitoring"),
     ("scale-kubelet", "kubelet"),
     ("process_request_thread", "server"),
+    # trainer threads (train/trainer.py, train/input_pipeline.py,
+    # train/observe.py): the step loop runs on MainThread, so the
+    # train-step role is claimed by the fleet-view/telemetry threads'
+    # explicit names; input prefetch and async checkpoint save get
+    # their own lanes so a data-bound vs save-bound step profile
+    # attributes without symbolizing
+    ("train-input", "train-input"),
+    ("input-pipeline", "train-input"),
+    ("train-checkpoint", "train-checkpoint"),
+    ("checkpoint-save", "train-checkpoint"),
+    ("train-telemetry", "train-step"),
+    ("train-step", "train-step"),
     ("MainThread", "main"),
 )
 
@@ -644,3 +660,65 @@ def write_signal_snapshot(
         target=_capture, name="profiler-usr2", daemon=True
     ).start()
     return path
+
+
+# -- XLA/TPU step-window capture ---------------------------------------------
+
+class StepProfiler:
+    """Captures [start, stop) steps of a training loop into
+    ``profile_dir`` via the XLA profiler (folded here from the old
+    train/profiling.py so both samplers — this device-trace capture
+    and the wall-clock SamplingProfiler above — live in one module).
+
+    Usage:
+        profiler = StepProfiler(args.profile_dir, total_steps, (3, 8))
+        for i in range(total_steps):
+            profiler.before_step(i)
+            ... run step i ...
+            profiler.after_step(i, drain=lambda: float(loss))
+
+    A None/empty profile_dir makes every call a no-op. The start/stop
+    discipline (skip the compile step, drain the device before
+    stopping, always stop if the loop ends early) lives here so every
+    train CLI shares it.
+    """
+
+    def __init__(
+        self,
+        profile_dir: Optional[str],
+        total_steps: int,
+        window: Tuple[int, int] = (3, 8),
+    ) -> None:
+        self.profile_dir = profile_dir or None
+        self._active = False
+        if self.profile_dir is None or total_steps <= 0:
+            self.start_step = self.stop_after = -1
+            return
+        # clamp into the run: short runs still produce a trace
+        self.start_step = min(window[0], total_steps - 1)
+        self.stop_after = min(max(window[1], self.start_step + 1), total_steps)
+
+    def before_step(self, i: int) -> None:
+        if self.profile_dir is not None and i == self.start_step:
+            import jax
+
+            jax.profiler.start_trace(self.profile_dir)
+            self._active = True
+
+    def after_step(self, i: int, drain=None) -> None:
+        if self._active and i + 1 >= self.stop_after:
+            self._stop(drain)
+
+    def close(self, drain=None) -> None:
+        """Safety net for loops that end before the window does."""
+        if self._active:
+            self._stop(drain)
+
+    def _stop(self, drain) -> None:
+        import jax
+
+        if drain is not None:
+            drain()  # wait for in-flight device work so the trace is complete
+        jax.profiler.stop_trace()
+        self._active = False
+        _logger.info("profiler trace written to %s", self.profile_dir)

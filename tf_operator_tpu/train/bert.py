@@ -14,7 +14,6 @@ from __future__ import annotations
 import argparse
 import logging
 import sys
-import time
 
 logger = logging.getLogger("tf_operator_tpu.train.bert")
 
@@ -55,6 +54,11 @@ def main(argv=None) -> int:
         help="Capture an XLA/TPU profiler trace of steady-state steps",
     )
     parser.add_argument("--log-every", type=int, default=20)
+    parser.add_argument(
+        "--monitoring-bind-addr", default=None,
+        help="host:port for the trainer telemetry server (/metrics, "
+        "/healthz, /debug/* — train/observe.py)",
+    )
     args = parser.parse_args(argv)
     logging.basicConfig(level=logging.INFO, stream=sys.stderr)
 
@@ -111,6 +115,14 @@ def main(argv=None) -> int:
         shard_sequence=args.sp > 1, checkpoint_dir=args.checkpoint_dir,
         accum_steps=args.accum_steps,
     )
+    telemetry = None
+    if args.monitoring_bind_addr:
+        from .observe import TrainTelemetry
+
+        telemetry = TrainTelemetry(
+            trainer=trainer, worker=f"worker-{proc.process_id}"
+        )
+        telemetry.start(args.monitoring_bind_addr)
     rng = jax.random.PRNGKey(0)
     sample = bert_lib.synthetic_batch(rng, args.batch_size, args.seq_len, cfg)
     state = trainer.init(rng, sample)
@@ -123,17 +135,18 @@ def main(argv=None) -> int:
     # warmup/compile
     state, metrics = trainer.step(state, trainer.place_batch(sample))
     float(metrics["loss"])
+    trainer.health.set("training")
 
     from .input_pipeline import InputPipeline, synthetic_source
     from .preemption import PreemptionGuard, maybe_preempt_exit
-    from .profiling import StepProfiler
+    from ..telemetry.profiler import StepProfiler
 
     # --steps is the TOTAL budget: a resumed process runs the remainder
     remaining = max(0, args.steps - int(state.step))
     profiler = StepProfiler(args.profile_dir, remaining, window=(0, 5))
     guard = PreemptionGuard()
     steps_run = 0
-    start = time.perf_counter()
+    start = trainer.clock.monotonic()
     try:
         guard.__enter__()
         # fresh per-step synthetic batches through the host input
@@ -169,7 +182,9 @@ def main(argv=None) -> int:
     finally:
         guard.__exit__()
         profiler.close()
-    elapsed = time.perf_counter() - start
+        if telemetry is not None:
+            telemetry.stop()
+    elapsed = trainer.clock.monotonic() - start
     tokens = args.batch_size * args.seq_len * max(steps_run, 1)
     n_chips = len(jax.devices())
     logger.info(

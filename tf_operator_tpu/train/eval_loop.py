@@ -98,19 +98,42 @@ def main(argv=None) -> int:
         "--max-polls", type=int, default=None,
         help="give up (exit 1) after this many empty polls in a row",
     )
+    parser.add_argument(
+        "--monitoring-bind-addr", default=None,
+        help="host:port for the evaluator telemetry server (/metrics, "
+        "/healthz, /debug/* — train/observe.py)",
+    )
     args = parser.parse_args(argv)
     logging.basicConfig(level=logging.INFO, stream=sys.stderr)
 
+    from ..telemetry.flight import flight_record
+    from ..telemetry.tracecontext import trace_scope
     from ..train.trainer import held_out_eval
 
     trainer, make_batch, rng = _build(
         args.task, args.batch_size, args.checkpoint_dir,
         args.preset, args.seq_len,
     )
+    telemetry = None
+    if args.monitoring_bind_addr:
+        from .observe import TrainTelemetry
+
+        telemetry = TrainTelemetry(trainer=trainer, worker="evaluator")
+        telemetry.start(args.monitoring_bind_addr)
     # the evaluator's own state skeleton — the restore target
     sample = make_batch(rng)
     state = trainer.init(rng, sample)
 
+    try:
+        return _poll_loop(args, trainer, make_batch, rng, state,
+                          held_out_eval, trace_scope, flight_record)
+    finally:
+        if telemetry is not None:
+            telemetry.stop()
+
+
+def _poll_loop(args, trainer, make_batch, rng, state,
+               held_out_eval, trace_scope, flight_record) -> int:
     last_evaluated = -1
     empty_polls = 0
     while True:
@@ -140,7 +163,15 @@ def main(argv=None) -> int:
             continue
         empty_polls = 0
         step = int(state.step)
-        metrics = held_out_eval(trainer, state, make_batch, rng)
+        # each evaluation publish gets its own trace context, mirroring
+        # the trainer's per-checkpoint stamping: the eval record for a
+        # step correlates with that step's checkpoint roll
+        with trace_scope():
+            metrics = held_out_eval(trainer, state, make_batch, rng)
+            flight_record(
+                "evalpub", step=step,
+                loss=round(float(metrics.get("loss", float("nan"))), 6),
+            )
         logger.info("step %d eval: %s", step, metrics)
         if args.out:
             with open(args.out, "a") as handle:

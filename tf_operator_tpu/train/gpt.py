@@ -19,7 +19,6 @@ import argparse
 import dataclasses
 import logging
 import sys
-import time
 
 logger = logging.getLogger("tf_operator_tpu.train.gpt")
 
@@ -69,6 +68,11 @@ def main(argv=None) -> int:
         "to 10%% over --steps (0 = constant lr)",
     )
     parser.add_argument("--log-every", type=int, default=20)
+    parser.add_argument(
+        "--monitoring-bind-addr", default=None,
+        help="host:port for the trainer telemetry server (/metrics, "
+        "/healthz, /debug/* — train/observe.py)",
+    )
     args = parser.parse_args(argv)
     logging.basicConfig(level=logging.INFO, stream=sys.stderr)
 
@@ -121,6 +125,14 @@ def main(argv=None) -> int:
         shard_sequence=args.sp > 1, checkpoint_dir=args.checkpoint_dir,
         accum_steps=args.accum_steps,
     )
+    telemetry = None
+    if args.monitoring_bind_addr:
+        from .observe import TrainTelemetry
+
+        telemetry = TrainTelemetry(
+            trainer=trainer, worker=f"worker-{proc.process_id}"
+        )
+        telemetry.start(args.monitoring_bind_addr)
     rng = jax.random.PRNGKey(0)
     sample = gpt_lib.synthetic_batch(rng, args.batch_size, args.seq_len, cfg)
     state = trainer.init(rng, sample)
@@ -132,6 +144,7 @@ def main(argv=None) -> int:
 
     state, metrics = trainer.step(state, trainer.place_batch(sample))
     float(metrics["loss"])  # compile + warm
+    trainer.health.set("training")
 
     from .input_pipeline import InputPipeline, synthetic_source
     from .preemption import PreemptionGuard, maybe_preempt_exit
@@ -139,33 +152,37 @@ def main(argv=None) -> int:
     # --steps is the TOTAL budget: a resumed process runs the remainder
     remaining = max(0, args.steps - int(state.step))
     steps_run = 0
-    start = time.perf_counter()
+    start = trainer.clock.monotonic()
     # host batch prep + device placement overlap the previous step's
     # compute (train/input_pipeline.py: background producer, depth-2
     # double buffering) instead of running synchronously between steps
-    with PreemptionGuard() as guard, InputPipeline(
-        source=synthetic_source(
-            lambda key: gpt_lib.synthetic_batch(
-                key, args.batch_size, args.seq_len, cfg
-            )
-        ),
-        trainer=trainer, depth=2, steps=remaining,
-    ) as pipe:
-        for step, batch in enumerate(pipe):
-            state, metrics = trainer.step(state, batch)
-            steps_run += 1
-            rc = maybe_preempt_exit(
-                guard, trainer, state, args.checkpoint_dir
-            )
-            if rc is not None:
-                return rc
-            if (step + 1) % args.log_every == 0:
-                logger.info(
-                    "step %d loss=%.4f", int(state.step),
-                    float(metrics["loss"]),
+    try:
+        with PreemptionGuard() as guard, InputPipeline(
+            source=synthetic_source(
+                lambda key: gpt_lib.synthetic_batch(
+                    key, args.batch_size, args.seq_len, cfg
                 )
+            ),
+            trainer=trainer, depth=2, steps=remaining,
+        ) as pipe:
+            for step, batch in enumerate(pipe):
+                state, metrics = trainer.step(state, batch)
+                steps_run += 1
+                rc = maybe_preempt_exit(
+                    guard, trainer, state, args.checkpoint_dir
+                )
+                if rc is not None:
+                    return rc
+                if (step + 1) % args.log_every == 0:
+                    logger.info(
+                        "step %d loss=%.4f", int(state.step),
+                        float(metrics["loss"]),
+                    )
+    finally:
+        if telemetry is not None:
+            telemetry.stop()
     loss = float(metrics["loss"])
-    elapsed = time.perf_counter() - start
+    elapsed = trainer.clock.monotonic() - start
     tokens = args.batch_size * args.seq_len * max(steps_run, 1)
     n_chips = len(jax.devices())
     logger.info(

@@ -43,6 +43,12 @@ def main(argv=None) -> int:
         "steps to this directory (TensorBoard/Perfetto viewable)",
     )
     parser.add_argument("--log-every", type=int, default=50)
+    parser.add_argument(
+        "--monitoring-bind-addr", default=None,
+        help="host:port for the trainer telemetry server (/metrics, "
+        "/healthz, /debug/{flightz,historyz,alertz,profilez,slozz}) — "
+        "what the fleet view scrapes (train/observe.py)",
+    )
     args = parser.parse_args(argv)
     logging.basicConfig(level=logging.INFO, stream=sys.stderr)
 
@@ -73,6 +79,14 @@ def main(argv=None) -> int:
         rules=REPLICATED_RULES,
         checkpoint_dir=args.checkpoint_dir,
     )
+    telemetry = None
+    if args.monitoring_bind_addr:
+        from .observe import TrainTelemetry
+
+        telemetry = TrainTelemetry(
+            trainer=trainer, worker=f"worker-{proc.process_id}"
+        )
+        telemetry.start(args.monitoring_bind_addr)
     rng = jax.random.PRNGKey(0)
     sample = mnist_lib.synthetic_batch(rng, args.batch_size)
     state = trainer.init(rng, sample)
@@ -90,17 +104,19 @@ def main(argv=None) -> int:
 
     from .summaries import maybe_writer
 
-    import time as _time
-
-    train_start = _time.perf_counter()
-    with maybe_writer(args.summary_dir, proc.process_id) as writer:
-        state, metrics = trainer.fit(
-            state, batches(), steps=args.steps, log_every=args.log_every,
-            checkpoint_every=100 if args.checkpoint_dir else None,
-            metrics_callback=writer.scalars,
-            profile_dir=args.profile_dir,
-        )
-    wall_seconds = _time.perf_counter() - train_start
+    train_start = trainer.clock.monotonic()
+    try:
+        with maybe_writer(args.summary_dir, proc.process_id) as writer:
+            state, metrics = trainer.fit(
+                state, batches(), steps=args.steps, log_every=args.log_every,
+                checkpoint_every=100 if args.checkpoint_dir else None,
+                metrics_callback=writer.scalars,
+                profile_dir=args.profile_dir,
+            )
+    finally:
+        if telemetry is not None:
+            telemetry.stop()
+    wall_seconds = trainer.clock.monotonic() - train_start
     logger.info("final: %s", metrics)
     if metrics.get("preempted"):
         # graceful-preemption contract (train/preemption.py): the

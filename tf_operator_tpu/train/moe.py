@@ -15,7 +15,6 @@ from __future__ import annotations
 import argparse
 import logging
 import sys
-import time
 
 logger = logging.getLogger("tf_operator_tpu.train.moe")
 
@@ -41,6 +40,11 @@ def main(argv=None) -> int:
         "to 10%% over --steps (0 = constant lr)",
     )
     parser.add_argument("--log-every", type=int, default=20)
+    parser.add_argument(
+        "--monitoring-bind-addr", default=None,
+        help="host:port for the trainer telemetry server (/metrics, "
+        "/healthz, /debug/* — train/observe.py)",
+    )
     args = parser.parse_args(argv)
     logging.basicConfig(level=logging.INFO, stream=sys.stderr)
 
@@ -87,6 +91,14 @@ def main(argv=None) -> int:
         mesh=mesh, rules=MOE_RULES, checkpoint_dir=args.checkpoint_dir,
         accum_steps=args.accum_steps,
     )
+    telemetry = None
+    if args.monitoring_bind_addr:
+        from .observe import TrainTelemetry
+
+        telemetry = TrainTelemetry(
+            trainer=trainer, worker=f"worker-{proc.process_id}"
+        )
+        telemetry.start(args.monitoring_bind_addr)
     rng = jax.random.PRNGKey(0)
     sample = moe_lib.synthetic_batch(rng, args.batch_size, args.seq_len, cfg)
     state = trainer.init(rng, sample)
@@ -98,40 +110,45 @@ def main(argv=None) -> int:
 
     state, metrics = trainer.step(state, trainer.place_batch(sample))  # compile
     float(metrics["loss"])
+    trainer.health.set("training")
 
     from .preemption import PreemptionGuard, maybe_preempt_exit
 
     # --steps is the TOTAL budget: a resumed process runs the remainder
     remaining = max(0, args.steps - int(state.step))
     steps_run = 0
-    start = time.perf_counter()
-    with PreemptionGuard() as guard:
-        for step in range(remaining):
-            # fresh synthetic batch per step (same pattern as
-            # train/gpt.py): loss tracks training progress, not single-
-            # batch memorization, and the router sees a changing token
-            # distribution
-            batch = trainer.place_batch(
-                moe_lib.synthetic_batch(
-                    jax.random.fold_in(rng, step), args.batch_size,
-                    args.seq_len, cfg,
+    start = trainer.clock.monotonic()
+    try:
+        with PreemptionGuard() as guard:
+            for step in range(remaining):
+                # fresh synthetic batch per step (same pattern as
+                # train/gpt.py): loss tracks training progress, not single-
+                # batch memorization, and the router sees a changing token
+                # distribution
+                batch = trainer.place_batch(
+                    moe_lib.synthetic_batch(
+                        jax.random.fold_in(rng, step), args.batch_size,
+                        args.seq_len, cfg,
+                    )
                 )
-            )
-            state, metrics = trainer.step(state, batch)
-            steps_run += 1
-            rc = maybe_preempt_exit(
-                guard, trainer, state, args.checkpoint_dir
-            )
-            if rc is not None:
-                return rc
-            if (step + 1) % args.log_every == 0:
-                logger.info(
-                    "step %d loss=%.4f router_aux=%.5f",
-                    int(state.step), float(metrics["loss"]),
-                    float(metrics["router_aux"]),
+                state, metrics = trainer.step(state, batch)
+                steps_run += 1
+                rc = maybe_preempt_exit(
+                    guard, trainer, state, args.checkpoint_dir
                 )
+                if rc is not None:
+                    return rc
+                if (step + 1) % args.log_every == 0:
+                    logger.info(
+                        "step %d loss=%.4f router_aux=%.5f",
+                        int(state.step), float(metrics["loss"]),
+                        float(metrics["router_aux"]),
+                    )
+    finally:
+        if telemetry is not None:
+            telemetry.stop()
     loss = float(metrics["loss"])
-    elapsed = time.perf_counter() - start
+    elapsed = trainer.clock.monotonic() - start
     tokens = args.batch_size * args.seq_len * max(steps_run, 1)
     n_chips = len(jax.devices())
     logger.info(

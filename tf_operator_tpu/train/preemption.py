@@ -63,6 +63,38 @@ class PreemptionGuard:
             self._installed = False
 
 
+def record_preemption(trainer, state, saved: bool) -> None:
+    """Post-mortem trail for a SIGTERM: a `kind="preempt"` flight
+    record (step, whether a checkpoint landed, seconds since the last
+    durable save) plus a `train_preemptions_total` counter. Tolerant
+    of bare trainers (the 143-contract tests drive this with fakes
+    that have no registry or clock): every attribute is getattr'd."""
+    from ..telemetry.flight import flight_record
+
+    step = int(state.step)
+    since_save = None
+    last_mono = getattr(trainer, "_last_save_mono", None)
+    clock = getattr(trainer, "clock", None)
+    if last_mono is not None and clock is not None:
+        since_save = round(clock.monotonic() - last_mono, 3)
+    flight_record(
+        "preempt",
+        step=step,
+        saved=bool(saved),
+        seconds_since_last_save=since_save,
+    )
+    registry = getattr(trainer, "metrics_registry", None)
+    if registry is None:
+        from ..telemetry import default_registry
+
+        registry = default_registry()
+    registry.counter(
+        "train_preemptions_total",
+        "SIGTERM preemptions latched by the guard (graceful drain + "
+        "checkpoint path)",
+    ).inc()
+
+
 def maybe_preempt_exit(guard, trainer, state, checkpoint_dir):
     """The CLI-side preemption epilogue, shared by every train CLI that
     runs its own step loop (bert/gpt/moe/resnet; Trainer.fit embeds the
@@ -71,8 +103,13 @@ def maybe_preempt_exit(guard, trainer, state, checkpoint_dir):
     the CLI to exit with; None means keep training."""
     if not guard.triggered.is_set():
         return None
+    health = getattr(trainer, "health", None)
+    saved = False
     if checkpoint_dir:
+        if health is not None:
+            health.set("checkpointing")
         trainer.save(state)
+        saved = True
         logger.warning(
             "preempted at step %d — checkpoint saved, resume will "
             "continue from here", int(state.step),
@@ -82,4 +119,7 @@ def maybe_preempt_exit(guard, trainer, state, checkpoint_dir):
             "preempted at step %d with NO checkpoint_dir — progress "
             "will be lost on restart", int(state.step),
         )
+    if health is not None:
+        health.set("preempted")
+    record_preemption(trainer, state, saved=saved)
     return PREEMPTED_EXIT_CODE

@@ -45,7 +45,10 @@ class SummaryWriter:
     def scalars(self, step: int, values: Dict[str, float]) -> None:
         if not self.enabled:
             return
-        record = {"step": step, "time": time.time()}
+        # a wall TIMESTAMP for the record, not an interval — readers
+        # (TensorBoard, metrics.jsonl tailers) align runs by calendar
+        # time, so Clock.monotonic() would be wrong here
+        record = {"step": step, "time": time.time()}  # noqa: wall-clock-interval
         record.update({k: float(v) for k, v in values.items()})
         self._jsonl.write(json.dumps(record) + "\n")
         self._jsonl.flush()

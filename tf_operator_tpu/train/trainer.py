@@ -18,7 +18,6 @@ from __future__ import annotations
 
 import dataclasses
 import logging
-import time
 from typing import Any, Callable, Dict, Optional, Tuple
 
 import jax
@@ -195,6 +194,8 @@ class Trainer:
         checkpoint_dir: Optional[str] = None,
         accum_steps: int = 1,
         metrics_registry=None,
+        clock=None,
+        phase_flight_every: int = 50,
     ) -> None:
         if accum_steps < 1:
             raise ValueError(f"accum_steps must be >= 1, got {accum_steps}")
@@ -238,6 +239,28 @@ class Trainer:
             "train_tokens_per_sec",
             "Training token throughput over the last logging interval",
         )
+        self._c_steps = registry.counter(
+            "train_steps_total",
+            "Optimizer steps executed by this process — the fleet "
+            "view's progress signal (train/observe.py)",
+        )
+        # step-phase attribution, goodput accounting, and the
+        # lifecycle phase /healthz reports (train/observe.py). All
+        # interval timing goes through the Clock seam so FakeClock can
+        # drive the stall detector and the ledger in tests.
+        from ..controller.clock import Clock
+        from .observe import GoodputLedger, HealthPhase, StepPhaseTimer
+
+        self.clock = clock if clock is not None else Clock()
+        self.phase_timer = StepPhaseTimer(
+            registry, clock=self.clock, flight_every=phase_flight_every
+        )
+        self.goodput = GoodputLedger(registry)
+        self.health = HealthPhase()
+        # step of the newest durable checkpoint (what a restart resumes
+        # from) — the preemption-lost tail is measured against it
+        self._last_saved_step = 0
+        self._last_save_mono: Optional[float] = None
 
     # -- init --------------------------------------------------------------
 
@@ -488,7 +511,9 @@ class Trainer:
         if self._train_step is None:
             self._train_step = self._build_train_step()
         with self.mesh:
-            return self._train_step(state, batch)
+            out = self._train_step(state, batch)
+        self._c_steps.inc()
+        return out
 
     def evaluate(
         self, state: TrainState, batch
@@ -535,7 +560,9 @@ class Trainer:
         if fn is None:
             fn = self._multi_steps[n] = self._build_multi_step(n)
         with self.mesh:
-            return fn(state, batch)
+            out = fn(state, batch)
+        self._c_steps.inc(n)
+        return out
 
     def place_batch(self, batch):
         batch = self._prepare_batch(batch)
@@ -545,6 +572,27 @@ class Trainer:
         )
 
     # -- loops -------------------------------------------------------------
+
+    def _account_step(self, i, start_step, state, ckpt_seconds) -> None:
+        """Close the phase timer for loop iteration `i` and attribute
+        its wall to the goodput ledger: iteration 0 is warmup (jit
+        compile) — rewarmup when resumed from a checkpoint — checkpoint
+        seconds are waste, the rest useful. Every executed step lands
+        in exactly one integer bucket (useful/warmup/rewarmup), so the
+        ledger reconciles exactly against the step counter."""
+        step = int(state.step)  # blocks on the async device counter
+        self.phase_timer.lap("device_sync")
+        split = self.phase_timer.finish(step)
+        productive = max(split.get("wall", 0.0) - ckpt_seconds, 0.0)
+        if ckpt_seconds > 0:
+            self.goodput.waste("checkpoint", ckpt_seconds)
+        if i == 0:
+            self.goodput.waste(
+                "warmup" if start_step == 0 else "rewarmup",
+                productive, steps=1,
+            )
+        else:
+            self.goodput.useful(productive, steps=1)
 
     def fit(
         self,
@@ -578,11 +626,11 @@ class Trainer:
         metrics carry "preempted": 1.0 so the CLI can exit with the
         retryable code 143 — slice restart + resume instead of lost
         work (train/preemption.py)."""
-        from .preemption import PreemptionGuard
-        from .profiling import StepProfiler
+        from .preemption import PreemptionGuard, record_preemption
+        from ..telemetry.profiler import StepProfiler
 
         last_metrics: Dict[str, float] = {}
-        interval_start = time.perf_counter()
+        interval_start = self.clock.monotonic()
         interval_steps = 0
         # `steps` is the TOTAL step budget, counting steps already in
         # state.step: a restarted process that restored a checkpoint
@@ -598,20 +646,27 @@ class Trainer:
             )
         profiler = StepProfiler(profile_dir, remaining, profile_window)
         guard = PreemptionGuard()
+        timer = self.phase_timer
+        self.health.set("warming")  # until the compile step lands
+        # steps restored from a checkpoint are already durable: the
+        # preemption-lost tail is measured against whichever is newer
+        self._last_saved_step = max(self._last_saved_step, start_step)
         try:
             guard.__enter__()
             for i in range(remaining):
+                ckpt_seconds = 0.0
+                timer.start()
                 profiler.before_step(i)
-                batch = self.place_batch(next(batches))
-                step_start = time.perf_counter()
+                batch = next(batches)
+                timer.lap("data_wait")
+                batch = self.place_batch(batch)
+                timer.lap("host_to_device")
                 state, metrics = self.step(state, batch)
                 # dispatch time, not device time: jax is async, so a
                 # step only blocks here once the device queue backs up
                 # — the distribution still shows compiles (first
                 # observation) and sustained-rate shifts
-                self._h_step_seconds.observe(
-                    time.perf_counter() - step_start
-                )
+                self._h_step_seconds.observe(timer.lap("step_dispatch"))
                 interval_steps += 1
                 profiler.after_step(
                     i,
@@ -619,13 +674,18 @@ class Trainer:
                         lambda x: x.block_until_ready(), metrics
                     ),
                 )
+                timer.lap("device_sync")
                 if guard.triggered.is_set():
                     last_metrics = {k: float(v) for k, v in metrics.items()}
                     last_metrics["preempted"] = 1.0
+                    saved = False
                     if self._ckpt is not None:
                         # blocking: the grace period is short and the
                         # next thing this process does is exit
+                        self.health.set("checkpointing")
                         self.save(state)
+                        ckpt_seconds += timer.lap("checkpoint")
+                        saved = True
                         logger.warning(
                             "preempted at step %d — checkpoint saved, "
                             "resume will continue from here",
@@ -637,20 +697,42 @@ class Trainer:
                             "— progress will be lost on restart",
                             int(state.step),
                         )
+                    self.health.set("preempted")
+                    # the executed-then-lost tail since the newest
+                    # durable checkpoint (zero when the SIGTERM save
+                    # just landed): monotone re-work accounting —
+                    # counters can't retract already-counted useful time
+                    lost = max(int(state.step) - self._last_saved_step, 0)
+                    if lost > 0:
+                        avg = (
+                            timer.wall_seconds / timer.steps
+                            if timer.steps else 0.0
+                        )
+                        self.goodput.waste(
+                            "preempted", lost * avg, steps=lost
+                        )
+                    record_preemption(self, state, saved=saved)
                     if metrics_callback is not None:
                         # the summary stream records the preemption
                         # point, not just the last log_every interval
                         metrics_callback(int(state.step), dict(last_metrics))
+                    self._account_step(i, start_step, state, ckpt_seconds)
                     break
                 if checkpoint_every and (i + 1) % checkpoint_every == 0:
                     # async: the write overlaps the next steps' compute;
                     # the finally block flushes whatever is in flight
+                    self.health.set("checkpointing")
                     self.save(state, block=False)
+                    ckpt_seconds += timer.lap("checkpoint")
+                    self.health.set("training")
                 if (i + 1) % log_every == 0 or i + 1 == remaining:
                     last_metrics = {
                         k: float(v) for k, v in metrics.items()
                     }
-                    now = time.perf_counter()
+                    # the float() conversions above block on device
+                    # results — that wait is device_sync, not publish
+                    timer.lap("device_sync")
+                    now = self.clock.monotonic()
                     # per-interval rate, not a cumulative mean: the
                     # first point absorbs the jit compile, later points
                     # must show the true current rate so mid-run
@@ -683,6 +765,10 @@ class Trainer:
                     )
                     if metrics_callback is not None:
                         metrics_callback(int(state.step), dict(last_metrics))
+                    timer.lap("eval_publish")
+                self._account_step(i, start_step, state, ckpt_seconds)
+                if i == 0:
+                    self.health.set("training")
         finally:
             guard.__exit__()
             # an exception mid-loop must still stop the (process-global)
@@ -703,14 +789,37 @@ class Trainer:
     def save(self, state: TrainState, block: bool = True) -> None:
         if self._ckpt is None:
             raise ValueError("Trainer built without checkpoint_dir")
-        self._ckpt.save(int(state.step), state, block=block)
+        from ..telemetry.tracecontext import trace_scope
+
+        step = int(state.step)
+        t0 = self.clock.monotonic()
+        # each checkpoint publish gets its own trace context so the
+        # eventual train-to-serve weight roll (ROADMAP item 5) is
+        # traceable end to end: the flight record carries the trace id
+        with trace_scope():
+            self._ckpt.save(step, state, block=block)
+            flight_record(
+                "checkpoint", op="save", step=step, block=block,
+                seconds=round(self.clock.monotonic() - t0, 6),
+            )
+        self._last_saved_step = step
+        self._last_save_mono = self.clock.monotonic()
 
     def restore(self, state: TrainState) -> Optional[TrainState]:
         """Restore the latest checkpoint into the (sharded) structure of
         `state`; None if no checkpoint exists yet."""
         if self._ckpt is None:
             raise ValueError("Trainer built without checkpoint_dir")
-        return self._ckpt.restore_latest(state)
+        t0 = self.clock.monotonic()
+        restored = self._ckpt.restore_latest(state)
+        if restored is not None:
+            # restore time is recovery overhead, not training
+            self.goodput.waste(
+                "restore", self.clock.monotonic() - t0
+            )
+            self._last_saved_step = int(restored.step)
+            self._last_save_mono = self.clock.monotonic()
+        return restored
 
     def reload_checkpoints(self):
         """Cross-process refresh: re-scan for steps another process

@@ -14,7 +14,6 @@ from __future__ import annotations
 import argparse
 import logging
 import sys
-import time
 
 logger = logging.getLogger("tf_operator_tpu.train.vit")
 
@@ -42,6 +41,11 @@ def main(argv=None) -> int:
         help="Capture an XLA/TPU profiler trace of steady-state steps",
     )
     parser.add_argument("--log-every", type=int, default=20)
+    parser.add_argument(
+        "--monitoring-bind-addr", default=None,
+        help="host:port for the trainer telemetry server (/metrics, "
+        "/healthz, /debug/* — train/observe.py)",
+    )
     args = parser.parse_args(argv)
     logging.basicConfig(level=logging.INFO, stream=sys.stderr)
 
@@ -79,6 +83,14 @@ def main(argv=None) -> int:
         checkpoint_dir=args.checkpoint_dir,
         accum_steps=args.accum_steps,
     )
+    telemetry = None
+    if args.monitoring_bind_addr:
+        from .observe import TrainTelemetry
+
+        telemetry = TrainTelemetry(
+            trainer=trainer, worker=f"worker-{proc.process_id}"
+        )
+        telemetry.start(args.monitoring_bind_addr)
     rng = jax.random.PRNGKey(0)
     global_batch = args.per_chip_batch * n_chips
     batch = trainer.place_batch(
@@ -92,16 +104,17 @@ def main(argv=None) -> int:
             logger.info("resumed from step %d", int(state.step))
 
     from .preemption import PreemptionGuard, maybe_preempt_exit
-    from .profiling import StepProfiler
+    from ..telemetry.profiler import StepProfiler
 
     state, metrics = trainer.step(state, batch)  # compile
     float(metrics["loss"])
+    trainer.health.set("training")
     # --steps is the TOTAL budget: a resumed process runs the remainder
     remaining = max(0, args.steps - int(state.step))
     steps_run = 0
     profiler = StepProfiler(args.profile_dir, remaining, window=(0, 5))
     guard = PreemptionGuard()
-    start = time.perf_counter()
+    start = trainer.clock.monotonic()
     try:
         guard.__enter__()
         for step in range(remaining):
@@ -123,7 +136,9 @@ def main(argv=None) -> int:
     finally:
         guard.__exit__()
         profiler.close()
-    elapsed = time.perf_counter() - start
+        if telemetry is not None:
+            telemetry.stop()
+    elapsed = trainer.clock.monotonic() - start
     logger.info(
         "images/sec/chip: %.1f",
         global_batch * max(steps_run, 1) / elapsed / n_chips,
