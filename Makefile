@@ -50,7 +50,7 @@ presubmit:
 # lint analog; this image ships no pyflakes/ruff, so the checker is
 # vendored in tf_operator_tpu/analysis). The name rules run baseline-
 # free: they must stay at zero, no exceptions accrue.
-LINT_RULES := syntax-error,undefined-name,unused-import,redefinition,mutable-default-arg,bare-except-pass,wall-clock-interval,duplicate-metric-registration
+LINT_RULES := syntax-error,undefined-name,unused-import,redefinition,mutable-default-arg,bare-except-pass,wall-clock-interval,duplicate-metric-registration,conflicting-metric-labels,outbound-http-missing-traceparent
 lint:
 	$(PY) -m compileall -q tf_operator_tpu tests benchmarks hack bench.py __graft_entry__.py
 	$(PY) hack/graftlint.py --no-baseline --rules $(LINT_RULES) \
@@ -60,8 +60,10 @@ lint:
 # The full graftlint suite — lock discipline (order inversions, nested
 # non-reentrant acquire, blocking/callbacks under lock, signal-handler
 # locks) + JAX hazards (host-sync in jit, unroll bombs, use-after-
-# donation) + the name lints — against the committed baseline
-# (hack/graftlint_baseline.json). See docs/static-analysis.md.
+# donation) + hot-path dispatch budgets (new jits / host syncs /
+# shape-varying operands on scheduler hot paths) + GSPMD reduction
+# drift (the PR 11 class) + the name lints — against the committed
+# baseline (hack/graftlint_baseline.json). See docs/static-analysis.md.
 analyze:
 	$(PY) hack/graftlint.py
 	@echo "analyze: clean"

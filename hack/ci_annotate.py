@@ -1,0 +1,63 @@
+#!/usr/bin/env python3
+"""Turn `graftlint --format json` output into CI error annotations.
+
+Reads the JSON finding array from stdin (or a file argument) and emits
+one `::error file=...,line=...,title=...::message` workflow command
+per finding — the format CI runners render as inline PR annotations.
+Exit 1 when any finding was annotated, 0 on an empty array, 2 on
+unparseable input, so the presubmit step fails exactly when graftlint
+itself would.
+
+Pipeline (ci/presubmit.yaml):
+
+    python hack/graftlint.py --format json -q | python hack/ci_annotate.py
+"""
+
+from __future__ import annotations
+
+import json
+import sys
+
+
+def main(argv=None) -> int:
+    argv = sys.argv[1:] if argv is None else argv
+    if len(argv) > 1:
+        print("usage: ci_annotate.py [findings.json] < findings.json",
+              file=sys.stderr)
+        return 2
+    try:
+        if argv:
+            with open(argv[0], encoding="utf-8") as handle:
+                findings = json.load(handle)
+        else:
+            findings = json.load(sys.stdin)
+    except (OSError, ValueError) as err:
+        print(f"ci_annotate: unreadable findings JSON: {err}",
+              file=sys.stderr)
+        return 2
+    if not isinstance(findings, list):
+        print("ci_annotate: expected a JSON array of findings",
+              file=sys.stderr)
+        return 2
+    for finding in findings:
+        rule = finding.get("rule", "finding")
+        # workflow-command property values must stay one-line; the
+        # message itself is the annotation body after `::`
+        message = str(finding.get("message", "")).replace("\n", " ")
+        print(
+            f"::error file={finding.get('file', '')},"
+            f"line={finding.get('line', 0)},"
+            f"title=graftlint {rule}::{message}"
+        )
+    if findings:
+        print(
+            f"ci_annotate: {len(findings)} non-baselined finding(s) — "
+            f"see inline annotations (fingerprints: "
+            f"{', '.join(f.get('fingerprint', '?')[:12] for f in findings)})",
+            file=sys.stderr,
+        )
+    return 1 if findings else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -19,6 +19,8 @@ generic. See docs/static-analysis.md for the rule catalog.
 from __future__ import annotations
 
 import argparse
+import hashlib
+import json
 import os
 import sys
 import time
@@ -27,7 +29,13 @@ REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 sys.path.insert(0, REPO)
 
 from tf_operator_tpu import analysis  # noqa: E402
-from tf_operator_tpu.analysis import Baseline, JaxConfig, LockConfig  # noqa: E402
+from tf_operator_tpu.analysis import (  # noqa: E402
+    Baseline,
+    DispatchConfig,
+    JaxConfig,
+    LockConfig,
+    ShardriftConfig,
+)
 
 DEFAULT_PATHS = ("tf_operator_tpu", "tests", "benchmarks")
 DEFAULT_BASELINE = os.path.join("hack", "graftlint_baseline.json")
@@ -90,6 +98,59 @@ WALL_CLOCK_PATHS = (
     # trainer timing feeds the goodput ledger and phase histograms;
     # route through Clock.monotonic() (train/observe.py)
     "tf_operator_tpu/train/",
+    # the serve plane times quanta, routes, and leases; telemetry
+    # times sampler duty cycles — intervals everywhere, so raw
+    # time.time()/perf_counter is a hazard there too. Deliberate
+    # calendar-time records (flight wall stamps, the /debug clock
+    # handshake's cross-clock sample) carry `# noqa`.
+    "tf_operator_tpu/serve/",
+    "tf_operator_tpu/telemetry/",
+)
+
+# Hot roots for the dispatch-budget pass: functions that run once per
+# scheduler quantum / train step / route decision, mapped to the
+# number of compiled-callable call SITES statically reachable from
+# them. The budget is a regression pin — adding a dispatch to the
+# quantum moves the count and the finding names the new site.
+HOT_PATH_ROOTS = {
+    # one scheduler quantum: at most one prefill chunk (1 site) + a
+    # decode step (2 sites: paged/dense branches of _step_once) or a
+    # speculative round (draft + verify)
+    "ContinuousBatchingEngine._work_once": 5,
+    "ContinuousBatchingEngine._prefill_once": 1,
+    "ContinuousBatchingEngine._step_once": 2,
+    "ContinuousBatchingEngine._spec_once": 2,
+    # the router's replica pick is pure host-side bookkeeping: zero
+    # compiled dispatches, ever
+    "LeastLoadedRouter._acquire": 0,
+    # one train step dispatches exactly one compiled program
+    "Trainer.step": 1,
+}
+
+# Call patterns that dispatch a compiled XLA program, scoped like
+# DONATING_CALLABLES so unrelated `self.step` attributes don't match.
+COMPILED_CALLABLES = (
+    "ContinuousBatchingEngine:self.step",
+    "ContinuousBatchingEngine:self.step.prefill",
+    "ContinuousBatchingEngine:self.step.copy_block",
+    "ContinuousBatchingEngine:self.step.verify",
+    "ContinuousBatchingEngine:self.draft",
+    "Trainer:self._train_step",
+)
+
+# Reduction-drift scan scope: the sharded model code plus the engine
+# that drives it. Producer/gather/down-projection names follow the
+# gpt.py idiom (see analysis/shardrift.py and docs/static-analysis.md
+# for the PR 11 worked example).
+SHARDRIFT_PATHS = (
+    "tf_operator_tpu/models/",
+    "tf_operator_tpu/serve/engine.py",
+)
+
+# Outbound HTTP in these modules must carry trace context
+# (trace_headers() or an explicit `# trace-exempt: <reason>`).
+TRACE_HEADER_PATHS = (
+    "tf_operator_tpu/serve/",
 )
 
 
@@ -99,7 +160,15 @@ def build_configs():
         receiver_types=RECEIVER_TYPES,
     )
     jax = JaxConfig(donating_callables=DONATING_CALLABLES)
-    return lock, jax
+    dispatch = DispatchConfig(
+        hot_roots=HOT_PATH_ROOTS,
+        compiled_callables=COMPILED_CALLABLES,
+    )
+    shardrift = ShardriftConfig(
+        paths=SHARDRIFT_PATHS,
+        donating_callables=DONATING_CALLABLES,
+    )
+    return lock, jax, dispatch, shardrift
 
 
 def main(argv=None) -> int:
@@ -129,6 +198,13 @@ def main(argv=None) -> int:
         help="comma-separated rule names to run (default: all)",
     )
     parser.add_argument("--list-rules", action="store_true")
+    parser.add_argument(
+        "--format", choices=("human", "json"), default="human",
+        help="human (default): path:line: rule message. json: a "
+             "machine-readable array of non-baselined findings "
+             "(file/line/rule/message/symbol/fingerprint) on stdout "
+             "for the CI annotation step (hack/ci_annotate.py)",
+    )
     parser.add_argument("-q", "--quiet", action="store_true")
     args = parser.parse_args(argv)
 
@@ -143,10 +219,15 @@ def main(argv=None) -> int:
 
     started = time.monotonic()
     try:
-        lock_config, jax_config = build_configs()
+        lock_config, jax_config, dispatch_config, shardrift_config = (
+            build_configs()
+        )
         findings = analysis.run(
             paths, lock_config=lock_config, jax_config=jax_config,
             rules=rules or None, wall_clock_paths=WALL_CLOCK_PATHS,
+            dispatch_config=dispatch_config,
+            shardrift_config=shardrift_config,
+            trace_paths=TRACE_HEADER_PATHS,
         )
     except analysis.AnalysisError as err:
         print(f"graftlint: error: {err}", file=sys.stderr)
@@ -186,8 +267,23 @@ def main(argv=None) -> int:
             return 2
         new, baselined, stale = baseline.split(findings)
 
-    for finding in new:
-        print(finding.render())
+    if args.format == "json":
+        print(json.dumps([
+            {
+                "file": f.path,
+                "line": f.line,
+                "rule": f.rule,
+                "message": f.message,
+                "symbol": f.symbol,
+                "fingerprint": hashlib.sha1(
+                    "\x1f".join(f.fingerprint()).encode("utf-8")
+                ).hexdigest(),
+            }
+            for f in new
+        ], indent=2))
+    else:
+        for finding in new:
+            print(finding.render())
     if not args.quiet:
         for key in stale:
             print(

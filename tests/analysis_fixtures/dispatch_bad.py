@@ -1,0 +1,35 @@
+"""Seeded fixture: all four hot-path dispatch hazards. The test's
+DispatchConfig names FixtureEngine._work_once as a hot root with
+budget 1 and "FixtureEngine:self.step" / "FixtureEngine:self.step.verify"
+as the compiled callables; reachability follows the self-method call
+into _step_once, so the budget counts that function's sites too."""
+
+import jax
+import numpy as np
+
+
+class FixtureEngine:
+    def __init__(self, step, params):
+        self.step = step
+        self.params = params
+        self._cache = None
+        self._tok = np.zeros((2,), np.int32)
+
+    def _work_once(self, off, chunk):
+        # BAD hot-loop-new-jit: a fresh compiled callable per quantum
+        warm = jax.jit(lambda x: x + 1)
+        warm(self._tok)
+        # BAD shape-varying-compiled-call: off varies per call, so the
+        # operand's extent (and the compiled signature) varies with it
+        self._cache, nxt = self.step(self.params, self._cache, self._tok[off:off + chunk])
+        # BAD hot-loop-host-sync: a second sync on the step's result
+        host = np.asarray(nxt)
+        self._step_once()
+        return host
+
+    def _step_once(self):
+        # two more compiled sites: with _work_once's one, three sites
+        # reachable from the root against a budget of one
+        self._cache, a = self.step(self.params, self._cache, self._tok)
+        self._cache, b = self.step.verify(self.params, self._cache, self._tok)
+        return a, b

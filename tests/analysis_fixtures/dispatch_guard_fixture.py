@@ -1,0 +1,72 @@
+"""Runtime dispatch-guard fixture: a real (tiny, CPU) engine driven
+three ways.
+
+Not collected by default discovery (the filename matches neither
+test_*.py nor *_test.py); tests/test_dispatch_guard.py runs it in a
+pytest subprocess, expecting test_intentional_recompile to be flagged
+(a teardown error) under --dispatch-guard and everything to PASS
+without the flag. The
+recompile is provoked the way real regressions arrive: a direct step
+call with a new operand shape (a wider prompt grid), which retraces
+the compiled program after the construction-time warmup already paid
+the one budgeted compile."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from tf_operator_tpu.models import gpt as gpt_lib
+from tf_operator_tpu.serve.engine import ContinuousBatchingEngine
+
+CFG = gpt_lib.GPT_TINY
+
+
+@pytest.fixture(scope="module")
+def params():
+    return gpt_lib.GPT(CFG).init(
+        jax.random.PRNGKey(0), jnp.zeros((1, 8), jnp.int32)
+    )["params"]
+
+
+def test_clean_quanta(params):
+    """The engine's own loop: compiles stay at 1 and every quantum
+    dispatches exactly one program — silent under the guard."""
+    eng = ContinuousBatchingEngine(CFG, params, n_slots=2, start=False)
+    try:
+        req = eng.submit([1, 2, 3], 2)
+        eng._admit()
+        for _ in range(4):
+            eng._step_once()
+        assert req.done.is_set()
+    finally:
+        eng.stop()
+
+
+def test_intentional_recompile(params):
+    """A second trace after warmup: MUST fail under --dispatch-guard,
+    pass without it."""
+    eng = ContinuousBatchingEngine(CFG, params, n_slots=2, start=False)
+    try:
+        wider = np.zeros((2, eng._prompt.shape[1] + 1), np.int32)
+        eng.step(
+            eng.params, eng._cache, eng._tok, eng._index, wider,
+            eng._lens, eng._tables,
+        )
+    finally:
+        eng.stop()
+
+
+@pytest.mark.dispatch_budget(compiles=2)
+def test_marked_budget_override(params):
+    """The same retrace, but the test DECLARES the second compile via
+    the dispatch_budget marker — passes under the guard."""
+    eng = ContinuousBatchingEngine(CFG, params, n_slots=2, start=False)
+    try:
+        wider = np.zeros((2, eng._prompt.shape[1] + 1), np.int32)
+        eng.step(
+            eng.params, eng._cache, eng._tok, eng._index, wider,
+            eng._lens, eng._tables,
+        )
+    finally:
+        eng.stop()

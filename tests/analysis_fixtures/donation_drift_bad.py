@@ -1,0 +1,21 @@
+"""Seeded fixture: manual DONATING_CALLABLES entries vs the AST. The
+test's config declares DriftStep:self._step -> (1,), self._prefill ->
+(1,), self._copy -> (0,), self._verify -> (1,). Three of the four jit
+assignments drift from that; the computed form stays silent (it is
+exactly what the manual config exists for)."""
+
+import jax
+
+
+class DriftStep:
+    def __init__(self, step, prefill, copy_block, verify, backend):
+        # BAD: config claims position (1,) is donated; no donate_argnums
+        self._step = jax.jit(step)
+        # BAD: config says (1,), the literal here says (2,)
+        self._prefill = jax.jit(prefill, donate_argnums=(2,))
+        # BAD: literal (0,) duplicates the config entry — drop the entry
+        self._copy = jax.jit(copy_block, donate_argnums=(0,))
+        # fine: platform-computed donation is invisible to the literal
+        # detector — the manual entry is doing its job
+        donate = (1,) if backend != "cpu" else ()
+        self._verify = jax.jit(verify, donate_argnums=donate)

@@ -1,0 +1,38 @@
+"""Seeded fixture: one metric family registered with two different
+label-name sets (and one labeled-vs-unlabeled clash). The registry's
+get-or-create compares labelnames, so the second registration raises
+ValueError far from the site that introduced the clash — and the two
+sites disagree about the family's dashboard schema either way."""
+
+from tf_operator_tpu.telemetry import default_registry
+
+reg = default_registry()
+
+requests = reg.counter(
+    "fixture_route_requests_total", "routed requests",
+    labelnames=("replica", "code"),
+)
+
+# BAD: same family, different label names
+requests_other = default_registry().counter(
+    "fixture_route_requests_total", "routed requests",
+    labelnames=("replica", "tenant"),
+)
+
+# BAD: labeled family re-registered unlabeled
+requests_bare = reg.counter(
+    "fixture_route_requests_total", "routed requests"
+)
+
+# fine: identical label set is the get-or-create idiom
+requests_again = reg.counter(
+    "fixture_route_requests_total", "routed requests",
+    labelnames=("replica", "code"),
+)
+
+# fine: computed labelnames are untraceable — skipped, not guessed
+_names = ("replica", "code")
+requests_dyn = reg.counter(
+    "fixture_route_requests_total", "routed requests",
+    labelnames=_names,
+)

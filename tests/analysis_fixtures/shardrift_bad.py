@@ -1,0 +1,54 @@
+"""Seeded fixture: the PR 11 bug, reintroduced. A faithful twin of
+gpt.py's PagedSelfAttention with the ONE `_gather_model_axis` call
+deleted: the _cache_attention output (head axis 'model'-sharded under
+SERVE_DECODE_RULES) flows straight into the replicated attn_out
+down-projection, so GSPMD may psum partial contractions — the 1-ulp
+bf16 chain drift the sharded-engine soak caught days after merge.
+Exactly ONE gspmd-reduction-drift finding, at the down-projection
+line."""
+
+from typing import Any
+
+import jax.numpy as jnp
+
+
+def _projections(weights_int8):
+    raise NotImplementedError  # fixture stub
+
+
+def _paged_kv(mod, key_new, value_new, index, tables):
+    raise NotImplementedError  # fixture stub
+
+
+def _cache_attention(query, keys, key_scale, values, value_scale, valid):
+    raise NotImplementedError  # fixture stub
+
+
+class PagedSelfAttention:
+    num_heads: int
+    head_dim: int
+    num_blocks: int
+    block_size: int
+    dtype: Any = jnp.bfloat16
+    mesh: Any = None
+
+    def __call__(self, x, index, tables):
+        proj = _projections(False)
+        dense = lambda name: proj.head(  # noqa: E731
+            self.num_heads, self.head_dim, self.dtype, name
+        )
+        query = dense("query")(x)[:, None]
+        key_new = dense("key")(x)
+        value_new = dense("value")(x)
+        keys, values, valid = _paged_kv(
+            self, key_new, value_new, index, tables
+        )
+        out = _cache_attention(
+            query, keys, None, values, None, valid
+        )[:, 0]
+        # PR 11: the `if self.mesh is not None: out = _gather_model_axis(...)`
+        # guard that belongs HERE was deleted
+        return proj.general(
+            features=x.shape[-1], axis=(-2, -1), dtype=self.dtype,
+            name="attn_out",
+        )(out)

@@ -1,0 +1,75 @@
+"""Clean twins for the reduction-drift pass: the repo idiom (gather
+under the mesh guard), a dense class with no mesh field (replicated by
+construction — out of scope), and a suppressed twin. Zero findings."""
+
+from typing import Any
+
+import jax.numpy as jnp
+
+
+def _projections(weights_int8):
+    raise NotImplementedError  # fixture stub
+
+
+def _paged_kv(mod, x, index, tables):
+    raise NotImplementedError  # fixture stub
+
+
+def _cache_attention(query, keys, key_scale, values, value_scale, valid):
+    raise NotImplementedError  # fixture stub
+
+
+def _gather_model_axis(mesh, y, rows):
+    raise NotImplementedError  # fixture stub
+
+
+class PagedSelfAttention:
+    num_heads: int
+    head_dim: int
+    dtype: Any = jnp.bfloat16
+    mesh: Any = None
+
+    def __call__(self, x, index, tables):
+        proj = _projections(False)
+        query = proj.head(self.num_heads, self.head_dim, self.dtype,
+                          "query")(x)[:, None]
+        keys, values, valid = _paged_kv(self, x, index, tables)
+        out = _cache_attention(
+            query, keys, None, values, None, valid
+        )[:, 0]
+        # the repo idiom: the linear statement stream walks through
+        # the guard, and the gather clears the taint
+        if self.mesh is not None:
+            out = _gather_model_axis(self.mesh, out, rows=True)
+        return proj.general(
+            features=x.shape[-1], axis=(-2, -1), dtype=self.dtype,
+            name="attn_out",
+        )(out)
+
+
+class CachedSelfAttention:
+    """No mesh field: every contraction is whole on every chip, so a
+    bare producer-to-down-projection flow is fine here."""
+
+    num_heads: int
+    head_dim: int
+    dtype: Any = jnp.bfloat16
+
+    def __call__(self, x, index):
+        proj = _projections(False)
+        out = _cache_attention(x, x, None, x, None, None)[:, 0]
+        return proj.general(
+            features=x.shape[-1], axis=(-2, -1), dtype=self.dtype,
+            name="attn_out",
+        )(out)
+
+
+class SuppressedAttention:
+    mesh: Any = None
+
+    def __call__(self, x):
+        out = _cache_attention(x, x, None, x, None, None)[:, 0]
+        return _projections(False).general(  # graftlint: disable=gspmd-reduction-drift
+            features=x.shape[-1], axis=(-2, -1),
+            name="attn_out",
+        )(out)

@@ -1,0 +1,19 @@
+"""Seeded fixture: outbound HTTP with no trace context. Both forms
+must fire outbound-http-missing-traceparent: a urllib Request built
+with ad-hoc headers, and an urlopen() on an inline URL (an implicit
+header-less Request)."""
+
+import json
+import urllib.request
+
+
+def push_state(url, payload):
+    req = urllib.request.Request(
+        url, data=json.dumps(payload).encode(),
+        headers={"Content-Type": "application/json"},
+    )
+    return urllib.request.urlopen(req, timeout=2)
+
+
+def poll_health(base):
+    return urllib.request.urlopen(base + "/healthz", timeout=1)

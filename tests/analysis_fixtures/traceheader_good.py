@@ -1,0 +1,29 @@
+"""Clean twins for the trace-propagation rule: the blessed
+trace_headers() path, the documented trace-exempt escape, the
+graftlint suppression, and urlopen on a prebuilt Request variable
+(the Request site owns the finding, not the send)."""
+
+import json
+import urllib.request
+
+from tf_operator_tpu.telemetry.tracecontext import trace_headers
+
+
+def push_state(url, payload):
+    req = urllib.request.Request(
+        url, data=json.dumps(payload).encode(),
+        headers={"Content-Type": "application/json", **trace_headers()},
+    )
+    return urllib.request.urlopen(req, timeout=2)
+
+
+def poll_health(base):
+    # liveness probes predate any trace and must stay header-free
+    # trace-exempt: health checks are not part of a request trace
+    return urllib.request.urlopen(base + "/healthz", timeout=1)
+
+
+def bootstrap_fetch(url):
+    return urllib.request.urlopen(  # graftlint: disable=outbound-http-missing-traceparent
+        url, timeout=5
+    )

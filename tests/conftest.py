@@ -33,6 +33,13 @@ def pytest_addoption(parser):
              "checking; any observed inversion fails the test that "
              "triggered it (see docs/static-analysis.md)",
     )
+    parser.addoption(
+        "--dispatch-guard", action="store_true", default=False,
+        help="register every ContinuousBatchingEngine with the runtime "
+             "dispatch guard; a recompile after warmup or a dispatch "
+             "count over the per-quantum budget fails the test that "
+             "built the engine (see docs/static-analysis.md)",
+    )
 
 
 def pytest_configure(config):
@@ -40,29 +47,66 @@ def pytest_configure(config):
         "markers",
         "slow: long-running soaks, excluded from tier-1 (-m 'not slow')",
     )
+    config.addinivalue_line(
+        "markers",
+        "dispatch_budget(compiles, per_quantum): override the "
+        "--dispatch-guard budgets for one test (e.g. a test that "
+        "deliberately provokes a recompile)",
+    )
     if config.getoption("--lockdep"):
         from tf_operator_tpu.utils import locks
 
         locks.enable_lockdep()
+    if config.getoption("--dispatch-guard"):
+        from tf_operator_tpu.utils import dispatchguard
+
+        dispatchguard.enable_dispatch_guard()
 
 
+import pytest  # noqa: E402
+
+
+@pytest.hookimpl(wrapper=True)
 def pytest_runtest_teardown(item, nextitem):
     """With --lockdep on, an inversion observed during a test fails
     THAT test (kernel-lockdep style: one observed order is enough, no
     real deadlock required). The order graph persists across tests so
     orders learned in one test catch reversals in another; violations
-    are cleared so each is reported once."""
-    if not item.config.getoption("--lockdep"):
-        return
-    from tf_operator_tpu.utils import locks
+    are cleared so each is reported once.
 
-    violations = locks.lockdep_violations()
-    if violations:
-        locks.clear_lockdep_violations()
-        import pytest
+    With --dispatch-guard on, every engine built during the test is
+    audited for recompiles and per-quantum dispatch overruns (budgets
+    overridable per test via the dispatch_budget marker).
 
-        pytest.fail(
-            "lockdep: lock-order inversion(s) observed:\n\n"
-            + "\n\n".join(v.render() for v in violations),
-            pytrace=False,
+    A hookwrapper so the builtin teardown (fixture finalization, setup
+    stack unwind) always runs first — raising from a plain hookimpl
+    would abort the chain and poison every later test with "previous
+    item was not torn down properly"."""
+    yield
+    failures = []
+    if item.config.getoption("--dispatch-guard"):
+        from tf_operator_tpu.utils import dispatchguard
+
+        marker = item.get_closest_marker("dispatch_budget")
+        kwargs = dict(marker.kwargs) if marker else {}
+        violations = dispatchguard.check_and_reset(
+            compiles=kwargs.get("compiles", 1),
+            per_quantum=kwargs.get("per_quantum"),
         )
+        if violations:
+            failures.append(
+                "dispatch-guard: budget violation(s) observed:\n\n"
+                + "\n\n".join(v.render() for v in violations)
+            )
+    if item.config.getoption("--lockdep"):
+        from tf_operator_tpu.utils import locks
+
+        violations = locks.lockdep_violations()
+        if violations:
+            locks.clear_lockdep_violations()
+            failures.append(
+                "lockdep: lock-order inversion(s) observed:\n\n"
+                + "\n\n".join(v.render() for v in violations)
+            )
+    if failures:
+        pytest.fail("\n\n".join(failures), pytrace=False)

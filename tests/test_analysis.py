@@ -434,3 +434,274 @@ class TestCli:
         assert proc.returncode == 0
         listed = set(proc.stdout.split())
         assert set(analysis.ALL_RULES) == listed
+
+
+def _load_graftlint():
+    import importlib.util
+
+    spec = importlib.util.spec_from_file_location(
+        "graftlint", os.path.join(REPO, "hack", "graftlint.py"))
+    module = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(module)
+    return module
+
+
+FIXTURE_DISPATCH_CONFIG = dict(
+    hot_roots={
+        "FixtureEngine._work_once": 1,
+        "FixtureEngine._quiet_budget": 1,
+    },
+    compiled_callables=(
+        "FixtureEngine:self.step", "FixtureEngine:self.step.verify",
+    ),
+)
+
+
+class TestDispatchRules:
+    """Hot-path dispatch-budget pass (ISSUE 20 tentpole)."""
+
+    def _config(self):
+        from tf_operator_tpu.analysis import DispatchConfig
+
+        return DispatchConfig(**FIXTURE_DISPATCH_CONFIG)
+
+    def test_bad_fixture_fires_all_four_rules_at_exact_lines(self):
+        findings = run_on("dispatch_bad.py", dispatch_config=self._config())
+        by_rule = {f.rule: f for f in findings}
+        assert set(by_rule) == {
+            "hot-loop-new-jit", "hot-loop-host-sync",
+            "shape-varying-compiled-call", "dispatch-budget-exceeded",
+        }
+        assert by_rule["hot-loop-new-jit"].line == 20
+        assert by_rule["shape-varying-compiled-call"].line == 24
+        assert by_rule["hot-loop-host-sync"].line == 26
+        assert "asarray(nxt)" in by_rule["hot-loop-host-sync"].message
+        # the budget finding lands on the ROOT's def line and names
+        # every reachable site, including the ones a call away
+        budget = by_rule["dispatch-budget-exceeded"]
+        assert budget.line == 18
+        assert budget.symbol == "FixtureEngine._work_once"
+        assert "3 compiled-callable call site(s)" in budget.message
+        assert "budget 1" in budget.message
+        assert "_step_once→self.step.verify" in budget.message
+
+    def test_good_fixture_silent_including_suppressed_sync(self):
+        findings = run_on("dispatch_good.py", dispatch_config=self._config())
+        assert findings == []
+
+    def test_unscoped_class_does_not_match_scoped_pattern(self, tmp_path):
+        # another class with a `self.step` attribute must not count
+        from tf_operator_tpu.analysis import DispatchConfig
+
+        (tmp_path / "other.py").write_text(textwrap.dedent("""\
+            import numpy as np
+
+
+            class Stepper:
+                def _work_once(self):
+                    out = self.step(1)
+                    more = self.step(2)
+                    return np.asarray(out), more
+        """))
+        config = DispatchConfig(
+            hot_roots={"Stepper._work_once": 0},
+            compiled_callables=("FixtureEngine:self.step",),
+        )
+        findings = analysis.run([str(tmp_path)], dispatch_config=config)
+        assert findings == []
+
+    def test_repo_hot_roots_match_engine_reality(self):
+        """The CLI config names the real engine quanta and the real
+        trainer/router roots; the repo run must stay inside budget
+        (the baseline holds the designed syncs, not budget excesses)."""
+        graftlint = _load_graftlint()
+        for root in (
+            "ContinuousBatchingEngine._work_once",
+            "ContinuousBatchingEngine._prefill_once",
+            "ContinuousBatchingEngine._step_once",
+            "ContinuousBatchingEngine._spec_once",
+            "LeastLoadedRouter._acquire",
+            "Trainer.step",
+        ):
+            assert root in graftlint.HOT_PATH_ROOTS
+        assert graftlint.HOT_PATH_ROOTS["LeastLoadedRouter._acquire"] == 0
+        _, _, dispatch_config, _ = graftlint.build_configs()
+        findings = analysis.run(
+            [os.path.join(REPO, "tf_operator_tpu")],
+            dispatch_config=dispatch_config,
+        )
+        assert not [
+            f for f in findings if f.rule == "dispatch-budget-exceeded"
+        ]
+
+
+class TestShardriftRules:
+    """GSPMD reduction-drift pass: the PR 11 bug class as a lint."""
+
+    def test_pr11_reintroduction_fires_exactly_once_at_down_projection(self):
+        findings = run_on("shardrift_bad.py")
+        drift = [f for f in findings if f.rule == "gspmd-reduction-drift"]
+        assert len(drift) == 1
+        f = drift[0]
+        # line 51 is the `return proj.general(` down-projection —
+        # exactly where the deleted gather's absence bites
+        assert f.line == 51
+        assert f.symbol == "PagedSelfAttention.__call__"
+        assert "'out'" in f.message
+        assert "attn_out" in f.message
+        assert "1-ulp" in f.message
+        assert rules_of(findings) == {"gspmd-reduction-drift"}
+
+    def test_good_fixture_silent(self):
+        # gather-under-guard, dense no-mesh class, suppressed twin
+        assert run_on("shardrift_good.py") == []
+
+    def test_repo_models_are_clean(self):
+        graftlint = _load_graftlint()
+        _, _, _, shardrift_config = graftlint.build_configs()
+        findings = analysis.run(
+            [os.path.join(REPO, "tf_operator_tpu")],
+            shardrift_config=shardrift_config,
+        )
+        assert not [
+            f for f in findings
+            if f.rule in ("gspmd-reduction-drift", "donation-config-drift")
+        ]
+
+    def test_donation_drift_all_three_forms(self):
+        from tf_operator_tpu.analysis import ShardriftConfig
+
+        config = ShardriftConfig(donating_callables={
+            "DriftStep:self._step": (1,),
+            "DriftStep:self._prefill": (1,),
+            "DriftStep:self._copy": (0,),
+            "DriftStep:self._verify": (1,),
+        })
+        findings = run_on(
+            "donation_drift_bad.py", shardrift_config=config)
+        drift = [f for f in findings if f.rule == "donation-config-drift"]
+        assert {f.symbol for f in drift} == {
+            "DriftStep._step", "DriftStep._prefill", "DriftStep._copy",
+        }
+        messages = " | ".join(f.message for f in drift)
+        assert "donation that does not happen" in messages
+        assert "config drift" in messages
+        assert "drop the entry" in messages
+        # the platform-computed form (self._verify) stays silent: it
+        # is exactly what the manual config exists for
+
+
+class TestMetricLabelRule:
+    def test_conflicting_labels_fire_at_both_divergent_sites(self):
+        findings = run_on("labels_bad.py")
+        labels = [
+            f for f in findings if f.rule == "conflicting-metric-labels"
+        ]
+        assert len(labels) == 2
+        assert {f.line for f in labels} == {17, 23}
+        messages = " | ".join(f.message for f in labels)
+        assert "('replica', 'tenant')" in messages   # divergent set
+        assert "()" in messages                      # unlabeled clash
+        assert "fixture_route_requests_total" in messages
+        # same-set re-registration and computed labelnames are silent
+        assert rules_of(findings) == {"conflicting-metric-labels"}
+
+    def test_kind_conflict_not_double_flagged(self, tmp_path):
+        (tmp_path / "mod.py").write_text(textwrap.dedent("""\
+            from tf_operator_tpu.telemetry import default_registry
+
+            c = default_registry().counter(
+                "serve_z_total", "z", labelnames=("a",))
+            g = default_registry().gauge(
+                "serve_z_total", "z", labelnames=("b",))
+        """))
+        findings = analysis.run([str(tmp_path)])
+        assert rules_of(findings) == {"duplicate-metric-registration"}
+
+
+class TestTraceHeaderRule:
+    def test_bad_fixture_fires_both_forms(self):
+        findings = run_on("traceheader_bad.py")
+        assert rules_of(findings) == {"outbound-http-missing-traceparent"}
+        assert {f.line for f in findings} == {11, 19}
+        messages = " | ".join(f.message for f in findings)
+        assert "urllib.request.Request(" in messages
+        assert "urlopen" in messages
+
+    def test_good_fixture_silent_all_three_escapes(self):
+        # trace_headers(), trace-exempt comment, graftlint disable,
+        # and urlopen on a prebuilt Request variable
+        assert run_on("traceheader_good.py") == []
+
+    def test_path_scoping_matches_cli_config(self, tmp_path):
+        # outside the configured trace paths, the rule stays quiet
+        graftlint = _load_graftlint()
+        assert "tf_operator_tpu/serve/" in graftlint.TRACE_HEADER_PATHS
+        (tmp_path / "notserve.py").write_text(
+            "import urllib.request\n"
+            "req = urllib.request.Request('http://x/y')\n"
+        )
+        findings = analysis.run(
+            [str(tmp_path)], trace_paths=graftlint.TRACE_HEADER_PATHS)
+        assert findings == []
+
+
+class TestJsonFormat:
+    def _run(self, *args):
+        return subprocess.run(
+            [sys.executable, os.path.join(REPO, "hack", "graftlint.py"),
+             *args],
+            capture_output=True, text=True, cwd=REPO,
+        )
+
+    def test_json_format_shape_and_fingerprints(self):
+        # the metric-label rule runs unscoped, so the fixture fires
+        # even under the CLI's own path configs
+        proc = self._run(
+            os.path.join(FIXTURES, "labels_bad.py"),
+            "--format", "json", "--no-baseline", "-q",
+        )
+        assert proc.returncode == 1
+        payload = json.loads(proc.stdout)
+        assert len(payload) == 2
+        for entry in payload:
+            assert set(entry) == {
+                "file", "line", "rule", "message", "symbol",
+                "fingerprint",
+            }
+            assert entry["rule"] == "conflicting-metric-labels"
+            assert isinstance(entry["line"], int)
+            # stable hex fingerprint for CI annotation dedup
+            assert len(entry["fingerprint"]) == 40
+            int(entry["fingerprint"], 16)
+        assert len({e["fingerprint"] for e in payload}) == 2
+
+    def test_json_empty_on_clean_repo(self):
+        proc = self._run("--format", "json", "-q")
+        assert proc.returncode == 0, proc.stdout + proc.stderr
+        assert json.loads(proc.stdout) == []
+
+    def test_ci_annotate_consumes_json(self):
+        proc = self._run(
+            os.path.join(FIXTURES, "labels_bad.py"),
+            "--format", "json", "--no-baseline", "-q",
+        )
+        annotate = subprocess.run(
+            [sys.executable, os.path.join(REPO, "hack", "ci_annotate.py")],
+            input=proc.stdout, capture_output=True, text=True, cwd=REPO,
+        )
+        assert annotate.returncode == 1
+        lines = [
+            line for line in annotate.stdout.splitlines()
+            if line.startswith("::error ")
+        ]
+        assert len(lines) == 2
+        assert "file=" in lines[0] and "line=" in lines[0]
+        assert "conflicting-metric-labels" in lines[0]
+        # clean input exits 0 with no annotations
+        annotate = subprocess.run(
+            [sys.executable, os.path.join(REPO, "hack", "ci_annotate.py")],
+            input="[]", capture_output=True, text=True, cwd=REPO,
+        )
+        assert annotate.returncode == 0
+        assert "::error" not in annotate.stdout

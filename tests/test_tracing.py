@@ -9,7 +9,6 @@ replica sharing one trace id over real sockets, merged through
 `trace-smoke`); these tests pin each layer in isolation so a
 regression names the layer that broke."""
 
-import ast
 import json
 import os
 import sys
@@ -432,58 +431,28 @@ class TestProfilerRoles:
 SERVE_DIR = os.path.join(REPO, "tf_operator_tpu", "serve")
 
 
-def _outbound_call_sites(path):
-    """(lineno, source_segment, context_lines) for every outbound
-    HTTP construction in a serve module: urllib Request() builds and
-    urlopen() calls whose argument is built inline (not a prebuilt
-    Request variable)."""
-    with open(path) as f:
-        source = f.read()
-    lines = source.splitlines()
-    tree = ast.parse(source)
-    sites = []
-    for node in ast.walk(tree):
-        if not isinstance(node, ast.Call):
-            continue
-        target = ast.unparse(node.func)
-        if target.endswith("Request") and "urllib" in target:
-            pass  # a request object is being built: must carry headers
-        elif target.endswith("urlopen") and node.args and not isinstance(
-            node.args[0], ast.Name
-        ):
-            pass  # urlopen on an inline URL builds an implicit request
-        else:
-            continue
-        segment = ast.get_source_segment(source, node) or ""
-        context = lines[max(0, node.lineno - 4):node.lineno]
-        sites.append((node.lineno, segment, context))
-    return sites
-
-
 class TestTraceHeaderLint:
-    """Graftlint-style sweep: every outbound serve HTTP call site
-    either goes through the blessed trace_headers() helper or carries
-    an explicit `# trace-exempt: <reason>` comment. A new call site
-    that silently drops correlation context fails here, not in a
-    3am debugging session."""
+    """The sweep this file used to implement inline now lives in
+    tf_operator_tpu.analysis.traceheader (rule
+    outbound-http-missing-traceparent), where `make analyze` and the
+    CI annotation step see it too. These tests pin the delegation:
+    the serve tree stays clean under the promoted rule, and the rule
+    still fires/exempts the way the inline lint did."""
+
+    def _run_pass(self, paths, trace_paths=()):
+        from tf_operator_tpu.analysis import load_paths
+        from tf_operator_tpu.analysis.traceheader import run_trace_pass
+
+        modules, parse_failures = load_paths(paths)
+        assert parse_failures == []
+        return run_trace_pass(modules, trace_paths)
 
     def test_every_serve_call_site_traced_or_exempt(self):
-        offenders = []
-        for name in sorted(os.listdir(SERVE_DIR)):
-            if not name.endswith(".py"):
-                continue
-            path = os.path.join(SERVE_DIR, name)
-            for lineno, segment, context in _outbound_call_sites(path):
-                traced = "trace_headers(" in segment
-                exempt = any(
-                    "trace-exempt:" in line for line in context
-                )
-                if not traced and not exempt:
-                    offenders.append(f"serve/{name}:{lineno}: {segment}")
-        assert not offenders, (
-            "outbound serve HTTP call sites without trace_headers() "
-            "or a '# trace-exempt: <reason>' comment:\n"
-            + "\n".join(offenders)
+        findings = self._run_pass(
+            [SERVE_DIR], trace_paths=("tf_operator_tpu/serve/",)
+        )
+        assert findings == [], "\n".join(
+            f.render() for f in findings
         )
 
     def test_lint_actually_fires_on_seeded_offender(self, tmp_path):
@@ -492,11 +461,10 @@ class TestTraceHeaderLint:
             "import urllib.request\n"
             "req = urllib.request.Request('http://x/generate')\n"
         )
-        sites = _outbound_call_sites(str(seeded))
-        assert len(sites) == 1
-        traced = "trace_headers(" in sites[0][1]
-        exempt = any("trace-exempt:" in x for x in sites[0][2])
-        assert not traced and not exempt
+        (finding,) = self._run_pass([str(seeded)])
+        assert finding.rule == "outbound-http-missing-traceparent"
+        assert finding.line == 2
+        assert "trace_headers()" in finding.message
 
     def test_lint_honors_exemption_comment(self, tmp_path):
         seeded = tmp_path / "ok.py"
@@ -505,5 +473,4 @@ class TestTraceHeaderLint:
             "# trace-exempt: liveness probe\n"
             "req = urllib.request.Request('http://x/readyz')\n"
         )
-        (lineno, segment, context), = _outbound_call_sites(str(seeded))
-        assert any("trace-exempt:" in x for x in context)
+        assert self._run_pass([str(seeded)]) == []

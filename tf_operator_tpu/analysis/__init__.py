@@ -13,7 +13,18 @@ Pass families over one shared parse (ISSUE 5):
   mutable-default-arg and bare-except-pass;
 - telemetry hygiene (`metricdupe`) — a metric family name registered
   on the process-default registry with two different kinds across the
-  tree (the second registration raises ValueError at runtime).
+  tree (the second registration raises ValueError at runtime), or a
+  labeled family re-registered with a conflicting label-name set;
+- hot-path dispatch discipline (`dispatch`) — jit construction, host
+  syncs, shape-varying operands, and dispatch-budget regressions
+  reachable from the configured hot roots (engine quantum, spec
+  round, router pick, trainer step);
+- GSPMD reduction drift (`shardrift`) — model-sharded contractions
+  consumed by a replicated down-projection without a dominating
+  gather (the PR 11 1-ulp bf16 drift class), plus manual-vs-AST
+  donation config drift;
+- trace propagation (`traceheader`) — outbound serve HTTP without
+  trace_headers() or a `# trace-exempt:` escape.
 
 Entry point: :func:`run`. The CLI lives in hack/graftlint.py.
 """
@@ -30,10 +41,13 @@ from .core import (
     load_paths,
     parse_source,
 )
+from .dispatch import DispatchConfig, run_dispatch_pass
 from .jaxhazards import JaxConfig, run_jax_pass
 from .lockgraph import LockConfig, run_lock_pass
 from .metricdupe import run_metric_pass
 from .names import run_names_pass
+from .shardrift import ShardriftConfig, run_shardrift_pass
+from .traceheader import run_trace_pass
 
 # every rule graftlint can emit, for --rules validation and the docs
 ALL_RULES = (
@@ -56,6 +70,17 @@ ALL_RULES = (
     "wall-clock-interval",
     # telemetry hygiene
     "duplicate-metric-registration",
+    "conflicting-metric-labels",
+    # hot-path dispatch discipline
+    "hot-loop-new-jit",
+    "hot-loop-host-sync",
+    "shape-varying-compiled-call",
+    "dispatch-budget-exceeded",
+    # GSPMD reduction drift
+    "gspmd-reduction-drift",
+    "donation-config-drift",
+    # trace propagation
+    "outbound-http-missing-traceparent",
     # parse failures
     "syntax-error",
 )
@@ -67,6 +92,9 @@ def run(
     jax_config: Optional[JaxConfig] = None,
     rules: Optional[Sequence[str]] = None,
     wall_clock_paths: Sequence[str] = (),
+    dispatch_config: Optional[DispatchConfig] = None,
+    shardrift_config: Optional[ShardriftConfig] = None,
+    trace_paths: Sequence[str] = (),
 ) -> List[Finding]:
     """Parse every .py under `paths` once and run all passes.
 
@@ -85,6 +113,13 @@ def run(
         run_names_pass(modules, wall_clock_paths=wall_clock_paths)
     )
     findings.extend(run_metric_pass(modules))
+    findings.extend(
+        run_dispatch_pass(modules, dispatch_config or DispatchConfig())
+    )
+    findings.extend(
+        run_shardrift_pass(modules, shardrift_config or ShardriftConfig())
+    )
+    findings.extend(run_trace_pass(modules, trace_paths))
     if rules:
         keep = set(rules) | {"syntax-error"}
         findings = [f for f in findings if f.rule in keep]
@@ -96,9 +131,11 @@ __all__ = [
     "ALL_RULES",
     "AnalysisError",
     "Baseline",
+    "DispatchConfig",
     "Finding",
     "JaxConfig",
     "LockConfig",
+    "ShardriftConfig",
     "SourceFile",
     "load_paths",
     "parse_source",

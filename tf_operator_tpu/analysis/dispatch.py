@@ -1,0 +1,457 @@
+"""Hot-path dispatch-budget pass: the decode engine's perf contract.
+
+Chen et al. (arXiv:2302.01318) observe that decode latency in this
+regime is dominated by per-token dispatch overheads, not FLOPs — so a
+change that slips one extra compiled dispatch, a recompile, or a host
+sync into the scheduler quantum is a first-order perf bug that no
+functional test catches (the chain is still bit-identical, just
+slower). This pass pins the budget statically.
+
+The repo config (hack/graftlint.py) names the *hot roots* — functions
+that run once per scheduler quantum / train step / route decision —
+and the *compiled callables* — call patterns that dispatch a compiled
+XLA program (class-scoped like DONATING_CALLABLES, so two classes
+with a `self.step` attribute don't cross-contaminate). From each root
+this pass builds a conservative intra-module call graph (self-method
+calls, bare-name calls to module-level or nested functions) and scans
+every reachable function for four hazards:
+
+- ``hot-loop-new-jit`` — a `jax.jit` / `pjit` construction reachable
+  from a hot root: each pass through the loop builds a fresh compiled
+  callable (or at best re-hashes into the jit cache) — compile cost
+  lands inside the latency path.
+- ``hot-loop-host-sync`` — `np.asarray` / `np.array` /
+  `jax.device_get` / `int()` / `float()` / `.item()` / `.tolist()` /
+  `.block_until_ready()` applied to a value produced by a compiled
+  callable: a device round-trip per quantum beyond the engine's one
+  designed sync. (The jit-host-sync rule covers code *inside* jitted
+  functions; this rule covers the host-side loop *around* them.)
+- ``shape-varying-compiled-call`` — an operand of a compiled call
+  whose shape derives from a Python-level varying slice
+  (`x[off:off+k]` where a bound is not a constant): every new extent
+  is a new input shape, i.e. a recompile storm.
+- ``dispatch-budget-exceeded`` — the count of compiled-callable call
+  *sites* reachable from a root exceeds its configured budget. The
+  budget is a static regression pin: it counts sites, not dynamic
+  calls, so adding a new dispatch to the quantum moves the number and
+  the finding names the site that did it.
+
+Runtime twin: utils/dispatchguard.py counts *actual* compiles and
+per-quantum dispatches under `pytest --dispatch-guard`; this pass is
+the presubmit-time static half (docs/static-analysis.md).
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, Iterator, List, Optional, Sequence, Set, Tuple
+
+from .core import Finding, SourceFile, dotted_name
+
+_HOST_SYNC_DOTTED = (
+    "np.asarray", "np.array", "numpy.asarray", "numpy.array",
+    "jax.device_get", "device_get", "onp.asarray", "int", "float",
+)
+_HOST_SYNC_METHODS = ("item", "tolist", "block_until_ready")
+
+_JIT_NAMES = ("jax.jit", "jit", "pjit", "jax.pjit", "pjit.pjit")
+
+
+class DispatchConfig:
+    """hot_roots: qualname ("Class.method" or "func") -> max reachable
+    compiled-callable call sites. compiled_callables: call patterns
+    that dispatch a compiled program, optionally class-scoped
+    ("Engine:self.step") against the *calling* function's class."""
+
+    def __init__(
+        self,
+        hot_roots: Optional[Dict[str, int]] = None,
+        compiled_callables: Sequence[str] = (),
+    ) -> None:
+        self.hot_roots = dict(hot_roots or {})
+        self.compiled_callables = tuple(compiled_callables)
+
+
+class _Fn:
+    __slots__ = ("node", "qualname", "cls", "module")
+
+    def __init__(self, node, qualname: str, cls: Optional[str],
+                 module: SourceFile) -> None:
+        self.node = node
+        self.qualname = qualname
+        self.cls = cls
+        self.module = module
+
+
+def _index_functions(module: SourceFile) -> Dict[str, _Fn]:
+    """qualname -> _Fn for every def in the module (methods keep their
+    class prefix, nested defs their parent chain)."""
+    out: Dict[str, _Fn] = {}
+
+    def visit(node, prefix: str, cls: Optional[str]) -> None:
+        for child in ast.iter_child_nodes(node):
+            if isinstance(child, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                qual = f"{prefix}{child.name}"
+                out.setdefault(qual, _Fn(child, qual, cls, module))
+                visit(child, f"{qual}.", cls)
+            elif isinstance(child, ast.ClassDef):
+                visit(child, f"{prefix}{child.name}.", child.name)
+            else:
+                visit(child, prefix, cls)
+
+    visit(module.tree, "", None)
+    return out
+
+
+def _own_nodes(fn) -> Iterator[ast.AST]:
+    """Walk fn's body without descending into nested function/class
+    defs (those are separate _Fn entries)."""
+    stack: List[ast.AST] = list(ast.iter_child_nodes(fn))
+    while stack:
+        node = stack.pop()
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
+                             ast.ClassDef)):
+            continue
+        yield node
+        stack.extend(ast.iter_child_nodes(node))
+
+
+def _callees(fn: _Fn, index: Dict[str, _Fn]) -> Set[str]:
+    """Conservative resolution: `self.x(...)` to the same class,
+    bare `x(...)` to a nested def of this function or a module-level
+    function of the same module. `obj.x(...)` stays unresolved."""
+    out: Set[str] = set()
+    for node in _own_nodes(fn.node):
+        if not isinstance(node, ast.Call):
+            continue
+        name = dotted_name(node.func) or ""
+        if name.startswith("self.") and name.count(".") == 1 and fn.cls:
+            target = f"{fn.cls}.{name[5:]}"
+            if target in index:
+                out.add(target)
+        elif name and "." not in name:
+            nested = f"{fn.qualname}.{name}"
+            if nested in index:
+                out.add(nested)
+            elif name in index:
+                out.add(name)
+    return out
+
+
+def _match_compiled(
+    patterns: Sequence[str], callee: str, cls: Optional[str]
+) -> Optional[str]:
+    """-> the matching pattern (scope stripped) or None. Patterns may
+    be class-scoped ('Engine:self.step'), checked against the calling
+    function's class."""
+    for pattern in patterns:
+        scope = None
+        body = pattern
+        if ":" in pattern:
+            scope, body = pattern.split(":", 1)
+        if scope is not None and cls != scope:
+            continue
+        if callee == body or callee.endswith("." + body):
+            return body
+    return None
+
+
+def _is_jit_construction(node: ast.Call) -> bool:
+    name = dotted_name(node.func) or ""
+    if name in _JIT_NAMES:
+        return True
+    if name.endswith("partial") and node.args:
+        inner = dotted_name(node.args[0]) or ""
+        return inner in _JIT_NAMES
+    return False
+
+
+def _flatten(body) -> List[ast.stmt]:
+    """Linear statement stream (the donation pass's model): compound
+    statements contribute their header via _own_exprs, their bodies
+    appear later in the stream."""
+    out: List[ast.stmt] = []
+
+    def walk(stmts) -> None:
+        for stmt in stmts:
+            if isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                 ast.ClassDef)):
+                continue
+            out.append(stmt)
+            for field in ("body", "orelse", "finalbody"):
+                sub = getattr(stmt, field, None)
+                if isinstance(sub, list):
+                    walk([s for s in sub if isinstance(s, ast.stmt)])
+            if isinstance(stmt, ast.Try):
+                for handler in stmt.handlers:
+                    walk(handler.body)
+
+    walk(body)
+    return out
+
+
+def _own_exprs(stmt: ast.stmt) -> List[ast.AST]:
+    if isinstance(stmt, (ast.If, ast.While)):
+        return [stmt.test]
+    if isinstance(stmt, (ast.For, ast.AsyncFor)):
+        return [stmt.iter]
+    if isinstance(stmt, (ast.With, ast.AsyncWith)):
+        return [item.context_expr for item in stmt.items]
+    if isinstance(stmt, ast.Try):
+        return []
+    if isinstance(stmt, ast.Assign):
+        return [stmt.value]
+    if isinstance(stmt, (ast.AnnAssign, ast.AugAssign)):
+        return [stmt.value] if stmt.value is not None else []
+    return [stmt]
+
+
+def _name_targets(stmt: ast.stmt) -> Set[str]:
+    """Plain-Name assignment targets (tuple unpacking included;
+    self-attrs and subscripts excluded — taint tracks locals only)."""
+    out: Set[str] = set()
+    if isinstance(stmt, (ast.Assign, ast.AnnAssign, ast.AugAssign)):
+        targets = (
+            stmt.targets if isinstance(stmt, ast.Assign) else [stmt.target]
+        )
+        for target in targets:
+            for sub in ast.walk(target):
+                if isinstance(sub, ast.Name):
+                    out.add(sub.id)
+    return out
+
+
+def _has_varying_slice(expr: ast.AST) -> bool:
+    """True when expr contains a subscript slice with a non-constant
+    bound — `x[off:off+k]` — i.e. a Python-varying extent."""
+    for sub in ast.walk(expr):
+        if not isinstance(sub, ast.Subscript):
+            continue
+        sl = sub.slice
+        parts = sl.elts if isinstance(sl, ast.Tuple) else [sl]
+        for part in parts:
+            if not isinstance(part, ast.Slice):
+                continue
+            for bound in (part.lower, part.upper):
+                if bound is None or isinstance(bound, ast.Constant):
+                    continue
+                if (isinstance(bound, ast.UnaryOp)
+                        and isinstance(bound.operand, ast.Constant)):
+                    continue  # x[:-1] is a constant extent
+                return True
+    return False
+
+
+def _contains_name(expr: ast.AST, names: Set[str]) -> Optional[str]:
+    for sub in ast.walk(expr):
+        if isinstance(sub, ast.Name) and sub.id in names:
+            return sub.id
+    return None
+
+
+def run_dispatch_pass(
+    modules: Sequence[SourceFile], config: Optional[DispatchConfig] = None
+) -> List[Finding]:
+    config = config or DispatchConfig()
+    if not config.hot_roots:
+        return []
+    findings: List[Finding] = []
+    for module in modules:
+        findings.extend(_scan_module(module, config))
+    return findings
+
+
+def _scan_module(module: SourceFile, config: DispatchConfig) -> List[Finding]:
+    index = _index_functions(module)
+    roots = {
+        qual: budget
+        for qual, budget in config.hot_roots.items()
+        if qual in index
+    }
+    if not roots:
+        return []
+    findings: List[Finding] = []
+    emitted: Set[Tuple[str, int]] = set()  # (rule, line) across roots
+
+    def emit(rule: str, line: int, message: str, symbol: str) -> None:
+        if (rule, line) in emitted or module.suppressed(line, rule):
+            return
+        emitted.add((rule, line))
+        findings.append(Finding(rule, module.path, line, message, symbol))
+
+    edges: Dict[str, Set[str]] = {}
+
+    def reachable(root: str) -> List[str]:
+        seen: Set[str] = {root}
+        queue = [root]
+        while queue:
+            qual = queue.pop()
+            if qual not in edges:
+                edges[qual] = _callees(index[qual], index)
+            for callee in edges[qual]:
+                if callee not in seen:
+                    seen.add(callee)
+                    queue.append(callee)
+        return sorted(seen)
+
+    scanned: Set[str] = set()
+    site_cache: Dict[str, List[Tuple[str, int]]] = {}
+
+    for root in sorted(roots):
+        budget = roots[root]
+        sites: List[Tuple[str, str, int]] = []  # (fn short, callee, line)
+        for qual in reachable(root):
+            fn = index[qual]
+            if qual not in site_cache:
+                site_cache[qual] = _compiled_sites(fn, config)
+            for callee, line in site_cache[qual]:
+                sites.append((qual.rsplit(".", 1)[-1], callee, line))
+            if qual not in scanned:
+                scanned.add(qual)
+                _scan_hot_fn(fn, config, emit)
+        if len(sites) > budget:
+            root_line = index[root].node.lineno
+            described = sorted(f"{fn}→{callee}" for fn, callee, _ in sites)
+            counted: List[str] = []
+            for desc in dict.fromkeys(described):
+                n = described.count(desc)
+                counted.append(desc if n == 1 else f"{desc} ×{n}")
+            emit(
+                "dispatch-budget-exceeded", root_line,
+                f"{len(sites)} compiled-callable call site(s) reachable "
+                f"from hot root (budget {budget}): {', '.join(counted)} — "
+                f"every extra site is an extra device dispatch per "
+                f"quantum in the dispatch-bound decode regime",
+                root,
+            )
+    return findings
+
+
+def _compiled_sites(fn: _Fn, config: DispatchConfig) -> List[Tuple[str, int]]:
+    out: List[Tuple[str, int]] = []
+    for node in _own_nodes(fn.node):
+        if isinstance(node, ast.Call):
+            callee = dotted_name(node.func) or ""
+            if callee and _match_compiled(
+                config.compiled_callables, callee, fn.cls
+            ):
+                out.append((callee, node.lineno))
+    return out
+
+
+def _scan_hot_fn(fn: _Fn, config: DispatchConfig, emit) -> None:
+    module = fn.module
+    qualname = fn.qualname
+
+    # -- hot-loop-new-jit: any jit construction in the reachable set
+    for node in _own_nodes(fn.node):
+        if isinstance(node, ast.Call) and _is_jit_construction(node):
+            emit(
+                "hot-loop-new-jit", node.lineno,
+                "jax.jit/pjit constructed on the hot path — compile "
+                "cost (or at best a jit-cache re-hash) lands inside "
+                "the per-quantum latency; build the compiled callable "
+                "once at construction time",
+                qualname,
+            )
+
+    # -- taint scan: values produced by compiled callables (host-sync)
+    # and values whose shape derives from a varying slice (recompile)
+    def is_compiled_call(node: ast.AST) -> Optional[str]:
+        if isinstance(node, ast.Call):
+            callee = dotted_name(node.func) or ""
+            if callee and _match_compiled(
+                config.compiled_callables, callee, fn.cls
+            ):
+                return callee
+        return None
+
+    def expr_has_compiled(expr: ast.AST) -> Optional[str]:
+        for sub in ast.walk(expr):
+            callee = is_compiled_call(sub)
+            if callee:
+                return callee
+        return None
+
+    device_tainted: Set[str] = set()
+    shape_tainted: Set[str] = set()
+
+    for stmt in _flatten(fn.node.body):
+        roots = _own_exprs(stmt)
+        # 1. flag syncs on device-tainted values
+        for root in roots:
+            for sub in ast.walk(root):
+                if not isinstance(sub, ast.Call):
+                    continue
+                name = dotted_name(sub.func) or ""
+                attr = (
+                    sub.func.attr
+                    if isinstance(sub.func, ast.Attribute) else None
+                )
+                hit = None
+                if any(
+                    name == d or name.endswith("." + d)
+                    for d in _HOST_SYNC_DOTTED
+                ) and sub.args:
+                    hit = _contains_name(sub.args[0], device_tainted)
+                    if hit is None and expr_has_compiled(sub.args[0]):
+                        hit = dotted_name(sub.args[0].func) \
+                            if isinstance(sub.args[0], ast.Call) else None
+                        hit = hit or "compiled-call result"
+                elif attr in _HOST_SYNC_METHODS and not sub.args:
+                    hit = _contains_name(sub.func.value, device_tainted)
+                if hit is not None:
+                    label = name.split(".")[-1] if name else f".{attr}"
+                    emit(
+                        "hot-loop-host-sync", sub.lineno,
+                        f"host sync '{label}({hit})' on the hot path — "
+                        f"a device round-trip per quantum beyond the "
+                        f"engine's one designed sync",
+                        qualname,
+                    )
+            # 2. flag shape-varying operands at compiled call sites
+            for sub in ast.walk(root):
+                callee = is_compiled_call(sub)
+                if callee is None:
+                    continue
+                for arg in list(sub.args) + [
+                    kw.value for kw in sub.keywords
+                ]:
+                    varying = _has_varying_slice(arg)
+                    via = None if varying else _contains_name(
+                        arg, shape_tainted
+                    )
+                    if varying or via:
+                        what = via or "a Python-varying slice"
+                        emit(
+                            "shape-varying-compiled-call", sub.lineno,
+                            f"operand of compiled call {callee}() has a "
+                            f"shape derived from {what} — every new "
+                            f"extent is a new input signature, i.e. a "
+                            f"recompile per value",
+                            qualname,
+                        )
+                        break
+        # 3. update taint
+        targets = _name_targets(stmt)
+        if targets:
+            value = (
+                stmt.value
+                if isinstance(stmt, (ast.Assign, ast.AnnAssign, ast.AugAssign))
+                else None
+            )
+            if value is not None and expr_has_compiled(value):
+                device_tainted |= targets
+            else:
+                device_tainted -= targets
+            if value is not None and _has_varying_slice(value):
+                shape_tainted |= targets
+            else:
+                shape_tainted -= targets
+        elif isinstance(stmt, (ast.For, ast.AsyncFor)):
+            loop_targets = {
+                sub.id for sub in ast.walk(stmt.target)
+                if isinstance(sub, ast.Name)
+            }
+            device_tainted -= loop_targets
+            shape_tainted -= loop_targets

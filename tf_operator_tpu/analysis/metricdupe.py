@@ -23,18 +23,32 @@ passed as parameters, private `MetricRegistry()` instances — are
 ignored, names that are ever rebound to anything else are ignored, and
 same-kind re-registration is never flagged.
 
-Rule: ``duplicate-metric-registration``. Suppression: `# noqa` or
-`# graftlint: disable=duplicate-metric-registration`.
+A second footgun rides the same registration seam: a labeled family
+re-registered with a *different label-name set* (same kind). The
+registry's get-or-create compares labelnames too, so the second
+registration raises the same far-from-cause ValueError — and even
+when only one side ever runs, the two sites disagree about the
+family's schema, which corrupts every dashboard query joining on the
+label. Rule ``conflicting-metric-labels`` flags each site whose
+literal labelnames disagree with the first registration of the family
+(labeled-vs-unlabeled counts as a conflict; non-literal labelnames
+are skipped, conservative as above). Kind conflicts are reported by
+the kind rule alone, not double-flagged.
+
+Rules: ``duplicate-metric-registration``,
+``conflicting-metric-labels``. Suppression: `# noqa` or
+`# graftlint: disable=<rule>`.
 """
 
 from __future__ import annotations
 
 import ast
-from typing import Dict, List, Sequence, Set, Tuple
+from typing import Dict, List, Optional, Sequence, Set, Tuple
 
-from .core import Finding, SourceFile
+from .core import Finding, SourceFile, call_keyword
 
 RULE = "duplicate-metric-registration"
+LABEL_RULE = "conflicting-metric-labels"
 
 # MetricRegistry's family constructors; the attr name IS the kind
 _KINDS = ("counter", "gauge", "histogram")
@@ -81,13 +95,31 @@ def _default_aliases(tree: ast.Module) -> Set[str]:
     }
 
 
+def _literal_labelnames(node: ast.Call, kind: str):
+    """() when unlabeled, a tuple of label names when literal, None
+    when computed (untraceable — skipped by the label rule). Accepts
+    the keyword form everywhere plus the positional slot for
+    counter/gauge (arg 2; histogram's arg 2 is buckets)."""
+    expr: Optional[ast.expr] = call_keyword(node, "labelnames")
+    if expr is None and kind in ("counter", "gauge") and len(node.args) > 2:
+        expr = node.args[2]
+    if expr is None:
+        return ()
+    if isinstance(expr, (ast.Tuple, ast.List)) and all(
+        isinstance(e, ast.Constant) and isinstance(e.value, str)
+        for e in expr.elts
+    ):
+        return tuple(e.value for e in expr.elts)
+    return None
+
+
 def _registrations(
     module: SourceFile,
-) -> List[Tuple[str, str, int]]:
-    """(family_name, kind, line) for every literal-named registration
-    on a receiver traceable to the default registry."""
+) -> List[Tuple[str, str, int, object]]:
+    """(family_name, kind, line, labelnames) for every literal-named
+    registration on a receiver traceable to the default registry."""
     aliases = _default_aliases(module.tree)
-    out: List[Tuple[str, str, int]] = []
+    out: List[Tuple[str, str, int, object]] = []
     for node in ast.walk(module.tree):
         if not isinstance(node, ast.Call):
             continue
@@ -106,37 +138,60 @@ def _registrations(
         if not (isinstance(first, ast.Constant)
                 and isinstance(first.value, str)):
             continue
-        out.append((first.value, func.attr, node.lineno))
+        out.append((
+            first.value, func.attr, node.lineno,
+            _literal_labelnames(node, func.attr),
+        ))
     return out
 
 
 def run_metric_pass(modules: Sequence[SourceFile]) -> List[Finding]:
     """Cross-module pass: group default-registry registrations by
     family name; any name seen with two kinds flags every site whose
-    kind disagrees with the first (lowest path:line) registration."""
-    # family name -> [(path, line, kind, module)]
-    sites: Dict[str, List[Tuple[str, int, str, SourceFile]]] = {}
+    kind disagrees with the first (lowest path:line) registration,
+    and a single-kind family seen with two literal label-name sets
+    flags every site whose labels disagree with the first."""
+    # family name -> [(path, line, kind, labels, module)]
+    sites: Dict[str, List[Tuple[str, int, str, object, SourceFile]]] = {}
     for module in modules:
-        for name, kind, line in _registrations(module):
+        for name, kind, line, labels in _registrations(module):
             sites.setdefault(name, []).append(
-                (module.path, line, kind, module)
+                (module.path, line, kind, labels, module)
             )
     findings: List[Finding] = []
     for name, regs in sites.items():
-        if len({kind for _, _, kind, _ in regs}) < 2:
-            continue
         regs.sort(key=lambda r: (r[0], r[1]))
-        canon_path, canon_line, canon_kind, _ = regs[0]
-        for path, line, kind, module in regs:
-            if kind == canon_kind:
+        canon_path, canon_line, canon_kind, canon_labels, _ = regs[0]
+        if len({kind for _, _, kind, _, _ in regs}) >= 2:
+            for path, line, kind, _, module in regs:
+                if kind == canon_kind:
+                    continue
+                if module.suppressed(line, RULE):
+                    continue
+                findings.append(Finding(
+                    RULE, path, line,
+                    f"metric family '{name}' registered as {kind} on the "
+                    f"default registry but as {canon_kind} at "
+                    f"{canon_path}:{canon_line} — conflicting kinds raise "
+                    "ValueError at runtime",
+                ))
+            continue  # kind conflict owns the report; don't double-flag
+        known = [labels for _, _, _, labels, _ in regs if labels is not None]
+        if len(set(known)) < 2:
+            continue
+        if canon_labels is None:
+            continue  # first site untraceable: no canonical schema
+        for path, line, kind, labels, module in regs:
+            if labels is None or labels == canon_labels:
                 continue
-            if module.suppressed(line, RULE):
+            if module.suppressed(line, LABEL_RULE):
                 continue
             findings.append(Finding(
-                RULE, path, line,
-                f"metric family '{name}' registered as {kind} on the "
-                f"default registry but as {canon_kind} at "
-                f"{canon_path}:{canon_line} — conflicting kinds raise "
-                "ValueError at runtime",
+                LABEL_RULE, path, line,
+                f"metric family '{name}' ({kind}) registered with "
+                f"labels {tuple(labels)} but with {tuple(canon_labels)} "
+                f"at {canon_path}:{canon_line} — the registry rejects "
+                "the second registration (ValueError), and the two "
+                "sites disagree about the family's label schema",
             ))
     return findings

@@ -1,0 +1,344 @@
+"""GSPMD reduction-drift pass: the PR 11 bug class, as a lint.
+
+SERVE_DECODE_RULES (parallel/sharding.py) shard the attention heads
+and the MLP fan-in over the 'model' mesh axis and leave every
+down-projection kernel replicated. A contraction whose *reduced* axis
+is model-sharded therefore needs an explicit all-gather
+(`_gather_model_axis`, a `with_sharding_constraint` to the ungathered
+spec) before the replicated down-projection consumes it; without one,
+GSPMD is free to contract partial shards and `psum` the partials —
+numerically a re-association of the fp reduction, which drifted the
+sharded decode chain by 1 ulp in bf16 against the single-chip engine
+(PR 11). The chain-equality soak caught it days later; this pass
+catches the *shape* of the bug at presubmit time.
+
+Rules:
+
+- ``gspmd-reduction-drift`` — inside a mesh-capable module class (one
+  declaring a `mesh` field — replicated/dense classes without one are
+  skipped), a value produced by a model-sharded producer
+  (`_cache_attention`: its output's head axis is 'model'-sharded)
+  reaches a down-projection contraction (a projection constructed
+  with `name="attn_out"`-style down names, an einsum/dot/matmul, or
+  the `@` operator) without a dominating gather. The taint clears
+  when the value is reassigned through `_gather_model_axis` /
+  `with_sharding_constraint` — including inside an
+  `if self.mesh is not None:` guard, which is the repo idiom.
+- ``donation-config-drift`` — the CLI's manual DONATING_CALLABLES
+  entries exist for donation the AST can't see (platform-computed
+  `donate_argnums`). Where the AST *can* see a literal
+  (`self._step = jax.jit(fn, donate_argnums=(1,))`), a manual entry
+  is either redundant (same positions — shrink the config) or wrong
+  (different positions, or the jit call doesn't donate at all): both
+  are config drift waiting to mask a real donation bug.
+"""
+
+from __future__ import annotations
+
+import ast
+import os
+from typing import Dict, List, Optional, Sequence, Set, Tuple
+
+from .core import Finding, SourceFile, call_keyword, dotted_name, is_self_attr
+from .jaxhazards import _donated_positions, _is_jax_jit
+
+_CONTRACTION_FUNCS = ("einsum", "dot", "dot_general", "matmul", "tensordot")
+
+
+class ShardriftConfig:
+    """paths: fragments limiting the reduction-drift scan (empty =
+    every module — fixture mode). producers: calls whose result is
+    model-sharded on its reduced-next axis. gathers: calls that
+    restore replication. down_projections: projection names whose
+    kernel SERVE_DECODE_RULES leaves replicated. donating_callables:
+    the CLI's manual donation config, diffed for drift."""
+
+    def __init__(
+        self,
+        paths: Sequence[str] = (),
+        producers: Sequence[str] = ("_cache_attention",),
+        gathers: Sequence[str] = (
+            "_gather_model_axis", "with_sharding_constraint",
+        ),
+        down_projections: Sequence[str] = (
+            "attn_out", "mlp_out", "down_proj", "out_proj",
+        ),
+        donating_callables: Optional[Dict[str, Tuple[int, ...]]] = None,
+    ) -> None:
+        self.paths = tuple(paths)
+        self.producers = tuple(producers)
+        self.gathers = tuple(gathers)
+        self.down_projections = tuple(down_projections)
+        self.donating_callables = dict(donating_callables or {})
+
+
+def run_shardrift_pass(
+    modules: Sequence[SourceFile], config: Optional[ShardriftConfig] = None
+) -> List[Finding]:
+    config = config or ShardriftConfig()
+    findings: List[Finding] = []
+    for module in modules:
+        if _path_matches(module.path, config.paths):
+            findings.extend(_scan_drift(module, config))
+        findings.extend(_scan_donation_drift(module, config))
+    return findings
+
+
+def _path_matches(path: str, fragments: Sequence[str]) -> bool:
+    if not fragments:
+        return True
+    normalized = path.replace(os.sep, "/")
+    return any(frag in normalized for frag in fragments)
+
+
+# -- gspmd-reduction-drift ---------------------------------------------------
+
+def _mesh_classes(tree: ast.Module) -> List[ast.ClassDef]:
+    """Classes declaring a `mesh` member: a dataclass/flax field
+    (`mesh: Any = None`) or a `self.mesh = ...` assignment. Dense
+    replicated classes carry no mesh and are skipped — their
+    contractions are whole on every chip by construction."""
+    out = []
+    for node in ast.walk(tree):
+        if not isinstance(node, ast.ClassDef):
+            continue
+        has_mesh = False
+        for child in ast.walk(node):
+            if (
+                isinstance(child, ast.AnnAssign)
+                and isinstance(child.target, ast.Name)
+                and child.target.id == "mesh"
+            ):
+                has_mesh = True
+                break
+            if (
+                isinstance(child, ast.Assign)
+                and any(is_self_attr(t) == "mesh" for t in child.targets)
+            ):
+                has_mesh = True
+                break
+        if has_mesh:
+            out.append(node)
+    return out
+
+
+def _calls_any(expr: ast.AST, names: Sequence[str]) -> bool:
+    for sub in ast.walk(expr):
+        if isinstance(sub, ast.Call):
+            callee = dotted_name(sub.func) or ""
+            if any(callee == n or callee.endswith("." + n) for n in names):
+                return True
+    return False
+
+
+def _sharded_value(
+    expr: ast.AST, tainted: Set[str], config: ShardriftConfig
+) -> Optional[str]:
+    """-> a description of the model-sharded value inside expr, or
+    None. A gather call dominates its own subtree: anything wrapped in
+    one is already replicated and does not count."""
+    def visit(node: ast.AST) -> Optional[str]:
+        if isinstance(node, ast.Call):
+            callee = dotted_name(node.func) or ""
+            if any(
+                callee == g or callee.endswith("." + g)
+                for g in config.gathers
+            ):
+                return None  # gathered subtree is clean
+            if any(
+                callee == p or callee.endswith("." + p)
+                for p in config.producers
+            ):
+                return callee.split(".")[-1] + "()"
+        if isinstance(node, ast.Name) and node.id in tainted:
+            return node.id
+        for child in ast.iter_child_nodes(node):
+            hit = visit(child)
+            if hit:
+                return hit
+        return None
+
+    return visit(expr)
+
+
+def _down_projection_name(call: ast.Call, config: ShardriftConfig
+                          ) -> Optional[str]:
+    """'attn_out' when call's func is itself a call carrying
+    name=<down name> (the `proj.general(..., name="attn_out")(out)`
+    idiom) or a down name as its sole string argument
+    (`dense("attn_out")(out)`)."""
+    inner = call.func
+    if not isinstance(inner, ast.Call):
+        return None
+    kw = call_keyword(inner, "name")
+    if (
+        isinstance(kw, ast.Constant) and isinstance(kw.value, str)
+        and kw.value in config.down_projections
+    ):
+        return kw.value
+    if (
+        len(inner.args) == 1
+        and isinstance(inner.args[0], ast.Constant)
+        and isinstance(inner.args[0].value, str)
+        and inner.args[0].value in config.down_projections
+    ):
+        return inner.args[0].value
+    return None
+
+
+def _scan_drift(module: SourceFile, config: ShardriftConfig) -> List[Finding]:
+    from .dispatch import _flatten, _name_targets, _own_exprs
+
+    findings: List[Finding] = []
+    rule = "gspmd-reduction-drift"
+    for cls in _mesh_classes(module.tree):
+        for item in cls.body:
+            if not isinstance(item, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                continue
+            qualname = f"{cls.name}.{item.name}"
+            tainted: Set[str] = set()
+
+            def emit(line: int, what: str, sink: str) -> None:
+                if module.suppressed(line, rule):
+                    return
+                findings.append(Finding(
+                    rule, module.path, line,
+                    f"model-sharded value {what} reaches {sink} without "
+                    f"a dominating _gather_model_axis/"
+                    f"with_sharding_constraint — GSPMD may psum partial "
+                    f"contractions, re-associating the fp reduction "
+                    f"(the 1-ulp bf16 drift class)",
+                    qualname,
+                ))
+
+            for stmt in _flatten(item.body):
+                for root in _own_exprs(stmt):
+                    for sub in ast.walk(root):
+                        if isinstance(sub, ast.Call):
+                            down = _down_projection_name(sub, config)
+                            if down is not None:
+                                for arg in sub.args:
+                                    hit = _sharded_value(
+                                        arg, tainted, config
+                                    )
+                                    if hit:
+                                        emit(
+                                            sub.lineno, f"'{hit}'",
+                                            f"down-projection "
+                                            f"'{down}'",
+                                        )
+                                        break
+                                continue
+                            callee = dotted_name(sub.func) or ""
+                            short = callee.split(".")[-1]
+                            if short in _CONTRACTION_FUNCS and not \
+                                    _down_projection_name(sub, config):
+                                for arg in sub.args:
+                                    hit = _sharded_value(
+                                        arg, tainted, config
+                                    )
+                                    if hit:
+                                        emit(
+                                            sub.lineno, f"'{hit}'",
+                                            f"contraction "
+                                            f"'{short}()'",
+                                        )
+                                        break
+                        elif (
+                            isinstance(sub, ast.BinOp)
+                            and isinstance(sub.op, ast.MatMult)
+                        ):
+                            hit = (
+                                _sharded_value(sub.left, tainted, config)
+                                or _sharded_value(
+                                    sub.right, tainted, config
+                                )
+                            )
+                            if hit:
+                                emit(
+                                    sub.lineno, f"'{hit}'",
+                                    "a '@' contraction",
+                                )
+                # taint update: producer output taints the targets,
+                # a gather (even under `if self.mesh is not None:`,
+                # which the linear stream walks through) clears them
+                targets = _name_targets(stmt)
+                if not targets:
+                    continue
+                value = getattr(stmt, "value", None)
+                if value is None:
+                    continue
+                if _calls_any(value, config.gathers):
+                    tainted -= targets
+                elif _sharded_value(value, tainted, config):
+                    tainted |= targets
+                else:
+                    tainted -= targets
+    return findings
+
+
+# -- donation-config-drift ---------------------------------------------------
+
+def _scan_donation_drift(
+    module: SourceFile, config: ShardriftConfig
+) -> List[Finding]:
+    manual = {
+        key: positions
+        for key, positions in config.donating_callables.items()
+        if ":" in key
+    }
+    if not manual:
+        return []
+    rule = "donation-config-drift"
+    findings: List[Finding] = []
+    for cls in ast.walk(module.tree):
+        if not isinstance(cls, ast.ClassDef):
+            continue
+        for node in ast.walk(cls):
+            if not isinstance(node, ast.Assign):
+                continue
+            jit_call = _is_jax_jit(node.value)
+            if jit_call is None or not getattr(jit_call, "args", None):
+                continue
+            for target in node.targets:
+                attr = is_self_attr(target)
+                if attr is None:
+                    continue
+                key = f"{cls.name}:self.{attr}"
+                if key not in manual:
+                    continue
+                declared = tuple(manual[key])
+                literal = _donated_positions(jit_call)
+                has_kw = call_keyword(jit_call, "donate_argnums") is not None
+                if module.suppressed(node.lineno, rule):
+                    continue
+                if not has_kw:
+                    findings.append(Finding(
+                        rule, module.path, node.lineno,
+                        f"manual DONATING_CALLABLES entry '{key}' declares "
+                        f"positions {declared} but this jax.jit call "
+                        f"passes no donate_argnums — the config claims a "
+                        f"donation that does not happen",
+                        f"{cls.name}.{attr}",
+                    ))
+                elif literal and literal != declared:
+                    findings.append(Finding(
+                        rule, module.path, node.lineno,
+                        f"manual DONATING_CALLABLES entry '{key}' declares "
+                        f"positions {declared} but the literal "
+                        f"donate_argnums here is {literal} — config "
+                        f"drift",
+                        f"{cls.name}.{attr}",
+                    ))
+                elif literal:
+                    findings.append(Finding(
+                        rule, module.path, node.lineno,
+                        f"manual DONATING_CALLABLES entry '{key}' "
+                        f"duplicates a literal donate_argnums the "
+                        f"analyzer derives itself — drop the entry so "
+                        f"the config shrinks to computed-only cases",
+                        f"{cls.name}.{attr}",
+                    ))
+                # computed donate_argnums (a Name/expr): exactly what
+                # the manual config exists for — silent
+    return findings

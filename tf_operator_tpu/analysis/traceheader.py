@@ -1,0 +1,88 @@
+"""Outbound trace-propagation pass, promoted from tests/test_tracing.py.
+
+Every outbound HTTP call in the serve plane must carry W3C trace
+context, or the fleet's cross-process spans go dark exactly where
+they matter (router → replica → engine). The blessed path is the
+`trace_headers()` helper; deliberate exceptions (liveness probes,
+bootstrap fetches that predate a trace) carry an explicit
+`# trace-exempt: <reason>` comment within the three lines above the
+call site.
+
+Rule: ``outbound-http-missing-traceparent`` — a urllib `Request(...)`
+construction, or an `urlopen(...)` call whose first argument is built
+inline (an inline URL is an implicit header-less Request), with
+neither `trace_headers(` in the call's source segment nor a
+trace-exempt comment in context. Suppressible the graftlint way too
+(`# graftlint: disable=outbound-http-missing-traceparent`), but the
+trace-exempt comment is preferred — it carries the reason.
+
+This pass ran inside tests/test_tracing.py since the tracing PR;
+living here means `make analyze` (and the JSON presubmit annotations)
+covers it, and the escape hatch is documented with the other
+suppressions in docs/static-analysis.md.
+"""
+
+from __future__ import annotations
+
+import ast
+import os
+from typing import List, Sequence, Tuple
+
+from .core import Finding, SourceFile
+
+RULE = "outbound-http-missing-traceparent"
+
+_CONTEXT_LINES = 3  # exempt comment may sit up to 3 lines above
+
+
+def outbound_call_sites(module: SourceFile) -> List[Tuple[int, str, List[str]]]:
+    """(lineno, source_segment, context_lines) for every outbound HTTP
+    construction: urllib Request() builds and urlopen() calls whose
+    argument is built inline (not a prebuilt Request variable)."""
+    sites: List[Tuple[int, str, List[str]]] = []
+    for node in ast.walk(module.tree):
+        if not isinstance(node, ast.Call):
+            continue
+        target = ast.unparse(node.func)
+        if target.endswith("Request") and "urllib" in target:
+            pass  # a request object is being built: must carry headers
+        elif target.endswith("urlopen") and node.args and not isinstance(
+            node.args[0], ast.Name
+        ):
+            pass  # urlopen on an inline URL builds an implicit request
+        else:
+            continue
+        segment = ast.get_source_segment(module.source, node) or ""
+        context = module.lines[
+            max(0, node.lineno - 1 - _CONTEXT_LINES):node.lineno
+        ]
+        sites.append((node.lineno, segment, context))
+    return sites
+
+
+def run_trace_pass(
+    modules: Sequence[SourceFile], trace_paths: Sequence[str] = ()
+) -> List[Finding]:
+    """trace_paths: path fragments selecting the modules whose
+    outbound HTTP must propagate context (the CLI passes the serve
+    tree); empty means every module (fixture mode)."""
+    findings: List[Finding] = []
+    for module in modules:
+        normalized = module.path.replace(os.sep, "/")
+        if trace_paths and not any(f in normalized for f in trace_paths):
+            continue
+        for lineno, segment, context in outbound_call_sites(module):
+            if "trace_headers(" in segment:
+                continue
+            if any("trace-exempt:" in line for line in context):
+                continue
+            if module.suppressed(lineno, RULE):
+                continue
+            head = segment.splitlines()[0] if segment else ""
+            findings.append(Finding(
+                RULE, module.path, lineno,
+                f"outbound HTTP call `{head.strip()}` carries no "
+                f"traceparent — route headers through trace_headers() "
+                f"or add `# trace-exempt: <reason>` above the call",
+            ))
+    return findings

@@ -68,7 +68,7 @@ import numpy as np
 
 from ..telemetry.flight import current_correlation, default_flight
 from ..telemetry.tracecontext import current_trace
-from ..utils import locks
+from ..utils import dispatchguard, locks
 from .prefix import prefix_hash
 
 _DONE = object()
@@ -87,6 +87,12 @@ METRIC_HELP = {
         "Wall-clock seconds spent inside decode steps",
     "engine_compiles_total":
         "XLA compilations of the slot decode step (expected: 1)",
+    "engine_quanta_total":
+        "Scheduler quanta executed (prefill chunks + decode steps + "
+        "speculative rounds)",
+    "engine_quantum_dispatches_total":
+        "Compiled-program dispatches attempted across all quanta "
+        "(the --dispatch-guard budget numerator)",
     "engine_active_slots": "Slots currently occupied by a request",
     "engine_queue_depth": "Requests waiting for a free slot",
     "engine_peak_active_slots":
@@ -826,6 +832,13 @@ class ContinuousBatchingEngine:
         self.dispatch_seconds = 0.0
         self.sync_seconds = 0.0
         self.fanout_seconds = 0.0
+        # dispatch-budget accounting: one quantum per scheduler leaf
+        # (_prefill_once/_step_once/_spec_once), one dispatch per
+        # compiled call ATTEMPT (counted before the call so _fail_all
+        # paths keep quantum_dispatches <= per_quantum * quanta); the
+        # --dispatch-guard pytest plugin pins the ratio at teardown
+        self.quanta = 0
+        self.quantum_dispatches = 0
         # latency distributions + request spans (telemetry.MetricRegistry
         # / SpanTracer, both optional): TTFT and queue-wait are per
         # request, inter-token per emitted token, batch size per step.
@@ -932,6 +945,12 @@ class ContinuousBatchingEngine:
         # start=False: no scheduler thread — tests drive _admit /
         # _evict_cancelled / _step_once by hand for deterministic
         # ordering assertions
+        # runtime dispatch-guard registration (pytest --dispatch-guard):
+        # after warmup, so "one compile per program" is already paid and
+        # any later trace is a violation; before the thread starts, so
+        # no quantum predates registration
+        if dispatchguard.dispatch_guard_enabled():
+            dispatchguard.register_engine(self)
         self.thread = None
         if start:
             # role-suffixed thread name ("decode-engine-prefill" /
@@ -1374,6 +1393,9 @@ class ContinuousBatchingEngine:
             ("engine_fanout_seconds_total", "counter"):
                 self.fanout_seconds,
             ("engine_compiles_total", "counter"): self.step.compiles,
+            ("engine_quanta_total", "counter"): self.quanta,
+            ("engine_quantum_dispatches_total", "counter"):
+                self.quantum_dispatches,
             ("engine_active_slots", "gauge"): self.active_slots,
             ("engine_queue_depth", "gauge"): self.queue_depth,
             ("engine_peak_active_slots", "gauge"): self.peak_active,
@@ -1525,7 +1547,7 @@ class ContinuousBatchingEngine:
         self._pending.append(req)
 
     def _admit(self) -> None:
-        started = time.perf_counter()
+        started = time.monotonic()
         # drain the client queue into the scheduler-owned stage first:
         # arrival order holds across the two hops within a priority
         # class; classes reorder at the stage hop only
@@ -1546,7 +1568,7 @@ class ContinuousBatchingEngine:
                     break
             self._pending.popleft()
             self._place(req, plan)
-        self.admit_seconds += time.perf_counter() - started
+        self.admit_seconds += time.monotonic() - started
 
     def _plan(self, req: EngineRequest):
         """Prefix-cache match + block budget for one request ->
@@ -1806,7 +1828,9 @@ class ContinuousBatchingEngine:
         tokens = np.asarray(
             [req.prompt[off:off + chunk]], np.int32
         )
-        start = time.perf_counter()
+        self.quanta += 1
+        self.quantum_dispatches += 1
+        start = time.monotonic()
         try:
             self._cache = self.step.prefill(
                 self.params, self._cache, tokens, off,
@@ -1815,7 +1839,7 @@ class ContinuousBatchingEngine:
         except Exception as err:  # noqa: BLE001 — fan out, stay alive
             self._fail_all(err)
             return
-        took = time.perf_counter() - start
+        took = time.monotonic() - start
         self.prefill_chunks += 1
         self.prefill_seconds += took
         if self._h_prefill is not None:
@@ -1851,7 +1875,9 @@ class ContinuousBatchingEngine:
             self.pool.flush()
 
     def _step_once(self) -> None:
-        start = time.perf_counter()
+        self.quanta += 1
+        self.quantum_dispatches += 1
+        start = time.monotonic()
         try:
             if self._paged:
                 self._cache, nxt = self.step(
@@ -1863,12 +1889,12 @@ class ContinuousBatchingEngine:
                     self.params, self._cache, self._tok, self._index,
                     self._prompt, self._lens,
                 )
-            dispatched = time.perf_counter()
+            dispatched = time.monotonic()
             nxt = np.asarray(nxt)
         except Exception as err:  # noqa: BLE001 — fan out, stay alive
             self._fail_all(err)
             return
-        synced = time.perf_counter()
+        synced = time.monotonic()
         self.decode_seconds += synced - start
         self.dispatch_seconds += dispatched - start
         self.sync_seconds += synced - dispatched
@@ -1897,7 +1923,7 @@ class ContinuousBatchingEngine:
                 if pos == int(self._lens[slot]) + req.new - 1:
                     self.finished += 1
                     self._release(slot)
-        fanout = time.perf_counter() - synced
+        fanout = time.monotonic() - synced
         self.fanout_seconds += fanout
         # the per-step breadcrumb: the slot grid's occupancy over time
         # IS the engine's narrative (one ring slot per step, no
@@ -2012,7 +2038,8 @@ class ContinuousBatchingEngine:
         Greedy accept/reject is exact: an accepted draft equals the
         target's argmax at that position, so every committed chain is
         bit-identical to the single-token engine's."""
-        start = time.perf_counter()
+        self.quanta += 1
+        start = time.monotonic()
         k = self.spec_depth
         depth = np.zeros((self.n_slots,), np.int32)
         for slot in live:
@@ -2033,6 +2060,7 @@ class ContinuousBatchingEngine:
                 # column; rows needing fewer just ignore the tail
                 drafts = np.zeros((self.n_slots, k), np.int32)
                 for j in range(int(depth.max())):
+                    self.quantum_dispatches += 1
                     self._d_cache, d_nxt = self.draft(
                         self.draft_params, self._d_cache, self._d_tok,
                         self._d_index, self._prompt, self._lens,
@@ -2043,20 +2071,21 @@ class ContinuousBatchingEngine:
                     self._d_index += 1
             else:
                 drafts = self._host_drafts(live, depth)
-            drafted = time.perf_counter()
+            drafted = time.monotonic()
             toks = np.concatenate(
                 [self._tok[:, None], drafts], axis=1
             ).astype(np.int32)
+            self.quantum_dispatches += 1
             self._cache, nxt = self.step.verify(
                 self.params, self._cache, toks, self._index,
                 self._prompt, self._lens, self._tables,
             )
-            dispatched = time.perf_counter()
+            dispatched = time.monotonic()
             nxt = np.asarray(nxt)
         except Exception as err:  # noqa: BLE001 — fan out, stay alive
             self._fail_all(err)
             return
-        synced = time.perf_counter()
+        synced = time.monotonic()
         self.decode_seconds += synced - start
         self.dispatch_seconds += dispatched - start
         self.sync_seconds += synced - dispatched
@@ -2132,7 +2161,7 @@ class ContinuousBatchingEngine:
                 self._g_spec_depth.labels(slot=str(slot)).set(
                     int(self._slot_depth[slot])
                 )
-        fanout = time.perf_counter() - synced
+        fanout = time.monotonic() - synced
         self.fanout_seconds += fanout
         self._fl().record(
             "serve", op="spec-step", step=self.steps, slots=slots_now,

@@ -636,7 +636,7 @@ class LeastLoadedRouter:
             )
             pre.inflight += 1
         tid = trace.trace_id if trace is not None else None
-        start = time.perf_counter()
+        start = time.monotonic()
         try:
             if trace is not None:
                 # bind the trace only around the outbound connect (no
@@ -664,7 +664,7 @@ class LeastLoadedRouter:
         finally:
             self._release(pre)
         if report.get("migrated"):
-            self._h_migrate.observe(time.perf_counter() - start)
+            self._h_migrate.observe(time.monotonic() - start)
             with self._lock:
                 self.migrations += 1
                 # optimistic digest update: the next probe would learn
@@ -742,7 +742,7 @@ class LeastLoadedRouter:
         # explicitly (this is a generator — no ambient binding may
         # span a yield), outbound connects bind it in a scope
         trace = TraceContext(new_trace_id(), new_span_id())
-        t_start = time.perf_counter()
+        t_start = time.monotonic()
         deadline = time.monotonic() + (timeout or self.stream_deadline)
         emitted: List[int] = []
         failovers = 0
@@ -773,7 +773,7 @@ class LeastLoadedRouter:
                 if not migrate_tried:
                     # the pick that will serve the first byte: the
                     # route_decision hop ends here
-                    self._h_route.observe(time.perf_counter() - t_start)
+                    self._h_route.observe(time.monotonic() - t_start)
                 self._record(
                     corr, "pick", trace=trace.trace_id,
                     replica=replica.name, role=replica.role,
@@ -848,7 +848,7 @@ class LeastLoadedRouter:
                         rejected = event
                         break
                     if "token" in event:
-                        now = time.perf_counter()
+                        now = time.monotonic()
                         if first_token_at is None:
                             first_token_at = now
                             self._h_ttft.observe(now - t_start)

@@ -189,7 +189,7 @@ class FlightRecorder:
             elif "trace" in fields:
                 del fields["trace"]  # explicit None = unset, not a field
         t = self._clock()
-        wall = time.time()
+        wall = time.time()  # noqa — deliberate calendar stamp on the record
         with self._lock:
             seq = self._seq
             self._seq = seq + 1
@@ -247,7 +247,8 @@ class FlightRecorder:
         """Write the ring as JSONL; -> the path written."""
         if path is None:
             path = os.path.join(
-                _dump_dir(), f"flight-{os.getpid()}-{int(time.time())}.jsonl"
+                _dump_dir(),
+                f"flight-{os.getpid()}-{int(time.time())}.jsonl",  # noqa — wall time names the dump file
             )
         with open(path, "w") as f:
             f.write(self.to_jsonl(**filters))

@@ -247,14 +247,14 @@ class SamplingProfiler:
         stop = self._stop_event
         next_t = time.monotonic()
         while not stop.is_set():
-            t0 = time.perf_counter()
+            t0 = time.monotonic()
             try:
                 self._sample_once()
             except Exception:  # noqa: BLE001 — the sampler observes a
                 # process; it must never take one down (a thread dying
                 # mid-walk can surface RuntimeError from frame access)
                 pass
-            self._sample_seconds += time.perf_counter() - t0
+            self._sample_seconds += time.monotonic() - t0
             self._ticks += 1
             next_t += period
             delay = next_t - time.monotonic()
@@ -273,7 +273,7 @@ class SamplingProfiler:
         names = {t.ident: t.name for t in threading.enumerate()}
         frames = sys._current_frames()
         t = time.monotonic()
-        wall = time.time()
+        wall = time.time()  # noqa — deliberate calendar stamp on the sample
         folded: List[Tuple[str, str]] = []
         for ident, frame in frames.items():
             if ident == me:

@@ -1,0 +1,152 @@
+"""Opt-in runtime dispatch guard (the lockdep twin for dispatch cost).
+
+The static dispatch pass (``tf_operator_tpu.analysis.dispatch``) pins
+the NUMBER OF CALL SITES reachable from each hot root; this module pins
+what actually happens at runtime. With the guard enabled (pytest
+``--dispatch-guard``), every ContinuousBatchingEngine registers itself
+at construction, and the pytest plugin calls :func:`check_and_reset`
+after each test to assert two invariants over the engines the test
+built:
+
+- **compiles**: every compiled program (decode step, prefill chunk,
+  copy-on-write, verify, draft) traced at most ``compiles`` times
+  (default 1 — the construction-time warmup IS the one compile; a
+  second trace means a shape or dtype leaked into a signature);
+- **dispatch budget**: ``quantum_dispatches <= per_quantum * quanta``,
+  where the engine counts one quantum per scheduler leaf
+  (``_prefill_once`` / ``_step_once`` / ``_spec_once``) and one
+  dispatch per compiled call *attempt* (counted before the call, so a
+  failing dispatch that routes through ``_fail_all`` still holds the
+  invariant). The default ``per_quantum`` is 1, or
+  ``1 + spec_depth`` when a draft model runs (the sequential draft
+  chain plus one verify).
+
+Like lockdep, violations are recorded, never raised: the check point
+is a test teardown, not the hot path. Zero overhead when disabled —
+the engine's two counter increments are plain int adds that exist
+regardless; "enabled" only controls registration and checking.
+"""
+
+from __future__ import annotations
+
+from typing import List, Optional
+
+
+class DispatchViolation:
+    """One budget breach observed on one engine."""
+
+    __slots__ = ("kind", "engine", "detail")
+
+    def __init__(self, kind: str, engine: str, detail: str) -> None:
+        self.kind = kind        # "recompile" | "dispatch-budget"
+        self.engine = engine
+        self.detail = detail
+
+    def render(self) -> str:
+        return f"{self.kind} on {self.engine}: {self.detail}"
+
+    def __repr__(self) -> str:  # pragma: no cover - debugging aid
+        return f"DispatchViolation({self.kind!r}, {self.engine!r})"
+
+
+_enabled = False
+# strong refs, NOT weakrefs: a test-local engine is refcount-freed the
+# moment the test function returns — before the teardown hook that
+# judges it. check_and_reset() clears the list every test, so nothing
+# is held longer than one test's teardown.
+_engines: List[object] = []
+
+
+def enable_dispatch_guard() -> None:
+    global _enabled
+    _enabled = True
+
+
+def disable_dispatch_guard() -> None:
+    global _enabled
+    _enabled = False
+    del _engines[:]
+
+
+def dispatch_guard_enabled() -> bool:
+    return _enabled
+
+
+def register_engine(engine) -> None:
+    """Called by ContinuousBatchingEngine.__init__ (after warmup) when
+    the guard is enabled."""
+    _engines.append(engine)
+
+
+def _engine_name(engine) -> str:
+    thread = getattr(engine, "thread", None)
+    if thread is not None:
+        return thread.name
+    role = getattr(engine, "role", "") or ""
+    return "engine" + (f"-{role}" if role else "")
+
+
+# (attribute-holder, counter, program) triples checked per engine; a
+# holder or counter that does not exist on this engine config (dense
+# step has no prefill program, no draft without speculation) is skipped
+_COMPILE_COUNTERS = (
+    ("step", "compiles", "decode step"),
+    ("step", "prefill_compiles", "prefill chunk"),
+    ("step", "copy_compiles", "copy-on-write"),
+    ("step", "verify_compiles", "verify"),
+    ("draft", "compiles", "draft step"),
+)
+
+
+def _check_engine(
+    engine, compiles: int, per_quantum: Optional[int],
+    out: List[DispatchViolation],
+) -> None:
+    name = _engine_name(engine)
+    for holder_attr, counter, program in _COMPILE_COUNTERS:
+        holder = getattr(engine, holder_attr, None)
+        if holder is None:
+            continue
+        count = getattr(holder, counter, None)
+        if count is None or count <= compiles:
+            continue
+        out.append(DispatchViolation(
+            "recompile", name,
+            f"{program} program traced {count} time(s), budget "
+            f"{compiles} — a shape, dtype, or static argument varied "
+            f"across calls (every extra trace is a full XLA compile "
+            f"on the hot path)",
+        ))
+    quanta = getattr(engine, "quanta", 0)
+    dispatches = getattr(engine, "quantum_dispatches", 0)
+    if per_quantum is None:
+        if getattr(engine, "draft", None) is not None:
+            # sequential draft chain (<= spec_depth steps) + one verify
+            per_quantum = 1 + int(getattr(engine, "spec_depth", 0))
+        else:
+            # one prefill chunk, one decode step, or one verify round
+            # (host-side drafting dispatches nothing)
+            per_quantum = 1
+    budget = per_quantum * quanta
+    if dispatches > budget:
+        out.append(DispatchViolation(
+            "dispatch-budget", name,
+            f"{dispatches} compiled dispatches over {quanta} "
+            f"quanta exceeds {per_quantum}/quantum (= {budget}) — "
+            f"something added a device round-trip to the scheduler "
+            f"quantum",
+        ))
+
+
+def check_and_reset(
+    compiles: int = 1, per_quantum: Optional[int] = None,
+) -> List[DispatchViolation]:
+    """Check every engine registered since the last call, then clear
+    the registry (each engine is judged by the test that built it).
+    ``per_quantum=None`` derives the budget per engine from its own
+    speculation config."""
+    violations: List[DispatchViolation] = []
+    engines, _engines[:] = list(_engines), []
+    for engine in engines:
+        _check_engine(engine, compiles, per_quantum, violations)
+    return violations
